@@ -1,0 +1,16 @@
+"""ddlbench_tpu — a TPU-native distributed deep-learning training benchmark framework.
+
+Re-creates the capability surface of sara-nl/DDLBench (reference layout documented
+in SURVEY.md) on JAX/XLA: one model zoo expressed as flat layer lists, four
+parallelization strategies (single, dp, gpipe, pipedream) sharing one train-loop
+harness, a layer-graph profiler, and a hierarchical pipeline partitioner with a
+TPU (ICI/DCN/HBM) cost model.
+
+Reference parity pointers are cited in docstrings as ``/root/reference/<file>:<lines>``.
+"""
+
+__version__ = "0.1.0"
+
+from ddlbench_tpu.config import RunConfig, HardwareModel, DATASETS, DatasetSpec
+
+__all__ = ["RunConfig", "HardwareModel", "DATASETS", "DatasetSpec", "__version__"]

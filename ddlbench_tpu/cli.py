@@ -1,0 +1,96 @@
+"""CLI mirroring the reference harness flags.
+
+``run/run/run.sh -b benchmark -f framework -g gpus -n nodes -m model -q queue
+-p loginterval -s`` (run.sh:16-47) becomes::
+
+    python -m ddlbench_tpu.cli -b cifar10 -f dp -g 8 -m resnet50 -p 25
+
+plus explicit overrides for batch/microbatch/epochs that the reference passes
+through env vars (run_template.sh:70-73). Constraint checks (multi-device only
+for dp/gpipe/pipedream — run.sh:51-54) live in RunConfig.validate().
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from ddlbench_tpu.config import RunConfig, STRATEGIES, DATASETS
+from ddlbench_tpu.models.zoo import MODEL_NAMES
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(prog="ddlbench_tpu", description=__doc__)
+    p.add_argument("-b", "--benchmark", default="mnist", choices=sorted(DATASETS))
+    p.add_argument("-f", "--framework", default="single", choices=STRATEGIES,
+                   help="parallelization strategy (reference: pytorch|horovod|gpipe|pipedream)")
+    p.add_argument("-g", "--devices", type=int, default=1,
+                   help="total number of chips (reference: gpus x nodes)")
+    p.add_argument("-m", "--model", default="resnet18", choices=MODEL_NAMES)
+    p.add_argument("-p", "--log-interval", type=int, default=25)
+    p.add_argument("-s", "--real-data", action="store_true",
+                   help="use on-disk data instead of synthetic (reference -s flag, inverted)")
+    p.add_argument("-e", "--epochs", type=int, default=3)
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--micro-batch-size", type=int, default=None)
+    p.add_argument("--num-microbatches", type=int, default=None)
+    p.add_argument("--stages", type=int, default=None)
+    p.add_argument("--dp-replicas", type=int, default=1)
+    p.add_argument("--steps-per-epoch", type=int, default=None)
+    p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
+    p.add_argument("--platform", default=None,
+                   help="force a jax platform (e.g. 'cpu' with "
+                        "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh)")
+    return p
+
+
+def config_from_args(args) -> RunConfig:
+    return RunConfig(
+        benchmark=args.benchmark,
+        strategy=args.framework,
+        arch=args.model,
+        num_devices=args.devices,
+        synthetic=not args.real_data,
+        epochs=args.epochs,
+        log_interval=args.log_interval,
+        batch_size=args.batch_size,
+        micro_batch_size=args.micro_batch_size,
+        num_microbatches=args.num_microbatches,
+        num_stages=args.stages,
+        dp_replicas=args.dp_replicas,
+        steps_per_epoch=args.steps_per_epoch,
+        lr=args.lr,
+        compute_dtype=args.dtype,
+        seed=args.seed,
+    )
+
+
+def main(argv=None) -> int:
+    args = build_parser().parse_args(argv)
+    if args.platform:
+        import jax
+
+        jax.config.update("jax_platforms", args.platform)
+    cfg = config_from_args(args)
+    cfg.validate()
+
+    from ddlbench_tpu.train.loop import run_benchmark
+    from ddlbench_tpu.train.metrics import MetricLogger
+
+    # Run manifest (info.txt parity, run.sh:88-96).
+    manifest = {k: v for k, v in vars(args).items()}
+    print("run manifest: " + json.dumps(manifest), flush=True)
+
+    logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=args.jsonl)
+    result = run_benchmark(cfg, logger=logger)
+    result.pop("train_state", None)
+    print("result: " + json.dumps(result), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,217 @@
+"""Typed configuration for the whole framework.
+
+The reference stacks four config mechanisms (bash getopts flags, env vars as a
+cross-process bus, per-script argparse, and PipeDream's generated JSON confs —
+see reference run/run/run.sh:16-47, run/run/run_template.sh:70-73,
+benchmark/mnist/mnist_pytorch.py:157-160, optimizer/templates/conf.json.template).
+Here there is exactly one: a frozen dataclass, constructible from CLI flags
+(see ddlbench_tpu/cli.py) or from a dict.
+
+Hardware cost-model constants (the reference inlines NETWORK_BANDWIDTH=5e9,
+PCIE_BANDWIDTH=32e9, MEMORY_SIZE=11e9|24e9 in bash, run_template.sh:414-420)
+live in :class:`HardwareModel`, defaulted to TPU v5e numbers, and feed the
+pipeline partitioner (ddlbench_tpu/partition/optimizer.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Mapping, Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DatasetSpec:
+    """Shape/size blueprint of one benchmark dataset.
+
+    Mirrors the synthetic-data factory specs in the reference
+    (benchmark/generate_synthetic_data.py:75-107).
+    """
+
+    name: str
+    image_size: Tuple[int, int, int]  # (H, W, C), NHWC
+    num_classes: int
+    train_size: int
+    test_size: int
+
+
+DATASETS: Mapping[str, DatasetSpec] = {
+    "mnist": DatasetSpec("mnist", (28, 28, 1), 10, 60_000, 10_000),
+    "cifar10": DatasetSpec("cifar10", (32, 32, 3), 10, 50_000, 10_000),
+    "imagenet": DatasetSpec("imagenet", (224, 224, 3), 1000, 1_281_167, 50_000),
+    # "highres" is the reference's activation-memory stressor
+    # (generate_synthetic_data.py:100-107): 512x512x3, 1000 classes.
+    "highres": DatasetSpec("highres", (512, 512, 3), 1000, 50_000, 10_000),
+}
+
+STRATEGIES = ("single", "dp", "gpipe", "pipedream")
+
+# Per-framework default batch sizes from the reference harness
+# (run_template.sh:186-266,377-394; see BASELINE.md). For gpipe the tuple is
+# (micro_batch_size, num_microbatches) and the effective global batch is the
+# product (benchmark/mnist/mnist_gpipe.py:37-41). For pipedream the number is
+# the global batch.
+DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
+    "single": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32},
+    "dp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32},
+    "gpipe": {
+        "mnist": (128, 24),
+        "cifar10": (64, 32),
+        "imagenet": (24, 12),
+        "highres": (4, 12),
+    },
+    "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64},
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class HardwareModel:
+    """Bandwidth/memory constants feeding the partitioner cost model.
+
+    Defaults describe one TPU v5e chip and its interconnect; the reference's
+    equivalents (Ethernet 5 GB/s, PCIe 32 GB/s, 11/24 GB HBM) are inlined in
+    bash at run_template.sh:414-420.
+    """
+
+    # Per-link ICI bandwidth (bytes/s). v5e: ~45 GB/s per direction per link.
+    ici_bandwidth: float = 4.5e10
+    # DCN (inter-host) bandwidth per host (bytes/s).
+    dcn_bandwidth: float = 2.5e10
+    # HBM per chip (bytes). v5e: 16 GiB.
+    hbm_bytes: float = 16 * 1024**3
+    # Peak bf16 matmul throughput per chip (FLOP/s). v5e: ~197 TFLOP/s.
+    peak_flops: float = 1.97e14
+
+    def levels(self, num_hosts: int, chips_per_host: int):
+        """Hierarchical (bandwidth, machines-per-group) levels, fastest first.
+
+        The reference's hierarchical partitioner solves intra-node (PCIe) then
+        inter-node (Ethernet) (optimizer_graph_hierarchical.py:282-297); on TPU
+        the analogous levels are ICI within a pod slice and DCN across hosts.
+        """
+        levels = [(self.ici_bandwidth, chips_per_host)]
+        if num_hosts > 1:
+            levels.append((self.dcn_bandwidth, num_hosts))
+        return levels
+
+
+@dataclasses.dataclass(frozen=True)
+class RunConfig:
+    """One benchmark run = dataset x strategy x model x topology.
+
+    CLI surface mirrors the reference's ``run.sh -b -f -g -n -m -q -p -s``
+    (run/run/run.sh:16-47).
+    """
+
+    benchmark: str = "mnist"  # mnist | cifar10 | imagenet | highres
+    strategy: str = "single"  # single | dp | gpipe | pipedream
+    arch: str = "resnet18"
+    num_devices: int = 1  # total chips (reference: gpus x nodes)
+    num_hosts: int = 1
+    synthetic: bool = True
+    data_dir: Optional[str] = None
+
+    # Training protocol (reference: EPOCHS=3, LOGINTER=25;
+    # run_template.sh:71, run.sh:6).
+    epochs: int = 3
+    log_interval: int = 25
+    batch_size: Optional[int] = None  # per-device for single/dp; global for pipedream
+    micro_batch_size: Optional[int] = None  # gpipe/pipedream microbatch size
+    num_microbatches: Optional[int] = None
+    steps_per_epoch: Optional[int] = None  # override dataset-size-derived count
+
+    # Optimizer (reference defaults: mnist/cifar lr .01 momentum .5;
+    # imagenet .1/.9 + wd 1e-4, step decay /10 every 30 epochs —
+    # mnist_pytorch.py:153-156, imagenet_pytorch.py:44-50,225-229).
+    lr: Optional[float] = None
+    momentum: Optional[float] = None
+    weight_decay: Optional[float] = None
+    lr_step_epochs: int = 30
+    lr_step_gamma: float = 0.1
+    scale_lr_by_world: bool = True  # Horovod parity: lr x world (mnist_horovod.py:226)
+
+    # Pipeline topology.
+    num_stages: Optional[int] = None  # defaults to num_devices // dp_replicas
+    dp_replicas: int = 1  # hybrid PPxDP: replicas per stage
+
+    # Numerics.
+    compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
+    param_dtype: str = "float32"
+    remat_stages: bool = False  # jax.checkpoint each stage in pipeline modes
+    seed: int = 1  # reference seeds torch.manual_seed(1) (imagenet_pytorch.py:58-66)
+
+    hardware: HardwareModel = dataclasses.field(default_factory=HardwareModel)
+
+    # ---- derived ----
+
+    def dataset(self) -> DatasetSpec:
+        return DATASETS[self.benchmark]
+
+    def resolved_lr(self) -> float:
+        if self.lr is not None:
+            return self.lr
+        return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
+
+    def resolved_momentum(self) -> float:
+        if self.momentum is not None:
+            return self.momentum
+        return 0.9 if self.benchmark in ("imagenet", "highres") else 0.5
+
+    def resolved_weight_decay(self) -> float:
+        if self.weight_decay is not None:
+            return self.weight_decay
+        return 1e-4 if self.benchmark in ("imagenet", "highres") else 0.0
+
+    def resolved_stages(self) -> int:
+        if self.num_stages is not None:
+            return self.num_stages
+        return max(1, self.num_devices // max(1, self.dp_replicas))
+
+    def resolved_batches(self) -> Tuple[int, int]:
+        """Return (micro_batch_size, num_microbatches).
+
+        For single/dp, num_microbatches == 1 and micro_batch_size is the
+        per-device batch. Defaults follow the reference matrix (BASELINE.md).
+        """
+        if self.strategy in ("single", "dp"):
+            b = self.batch_size or DEFAULT_BATCH[self.strategy][self.benchmark]
+            return int(b), 1
+        if self.strategy == "gpipe":
+            mb, chunks = DEFAULT_BATCH["gpipe"][self.benchmark]
+            mb = self.micro_batch_size or mb
+            if self.num_microbatches:
+                chunks = self.num_microbatches
+            elif self.batch_size:
+                # interpret batch_size as the effective global batch
+                chunks = max(1, self.batch_size // mb)
+            return int(mb), int(chunks)
+        # pipedream: global batch split into microbatches of micro_batch_size.
+        global_b = self.batch_size or DEFAULT_BATCH["pipedream"][self.benchmark]
+        mb = self.micro_batch_size or max(1, global_b // (2 * self.resolved_stages()))
+        chunks = self.num_microbatches or max(1, global_b // mb)
+        return int(mb), int(chunks)
+
+    def global_batch(self) -> int:
+        mb, chunks = self.resolved_batches()
+        if self.strategy == "single":
+            return mb
+        if self.strategy == "dp":
+            return mb * self.num_devices
+        return mb * chunks * max(1, self.dp_replicas)
+
+    def validate(self) -> None:
+        if self.benchmark not in DATASETS:
+            raise ValueError(f"unknown benchmark {self.benchmark!r}")
+        if self.strategy not in STRATEGIES:
+            raise ValueError(f"unknown strategy {self.strategy!r}")
+        if self.strategy == "single" and self.num_devices != 1:
+            raise ValueError("single strategy uses exactly 1 device")
+        if self.strategy in ("gpipe", "pipedream"):
+            s = self.resolved_stages()
+            if s * max(1, self.dp_replicas) != self.num_devices:
+                raise ValueError(
+                    f"stages ({s}) x dp_replicas ({self.dp_replicas}) must equal "
+                    f"num_devices ({self.num_devices})"
+                )
+
+    def replace(self, **kw: Any) -> "RunConfig":
+        return dataclasses.replace(self, **kw)

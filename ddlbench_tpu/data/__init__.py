@@ -1,0 +1,3 @@
+from ddlbench_tpu.data.synthetic import SyntheticData, make_synthetic
+
+__all__ = ["SyntheticData", "make_synthetic"]

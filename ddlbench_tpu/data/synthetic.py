@@ -1,0 +1,107 @@
+"""Synthetic dataset factory — device-side, PRNG-generated.
+
+The reference writes random JPEGs to disk in ImageFolder layout with a
+multiprocess pool and re-reads them through torchvision
+(benchmark/generate_synthetic_data.py:21-107); that round-trip exists only
+because torch DataLoaders want files. On TPU the idiomatic equivalent generates
+batches directly on device from a JAX PRNG: zero host I/O, deterministic per
+(seed, epoch, step), and shape-compatible with the same four dataset blueprints
+(mnist 60k 28x28x1, cifar10 50k 32x32x3, imagenet 1.28M 224x224x3/1000cls,
+highres 50k 512x512x3/1000cls).
+
+An on-disk loader for *real* data is planned (gated on torchvision); synthetic
+is the benchmark default, as in the reference (run/run/run.sh:9).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Iterator, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddlbench_tpu.config import DatasetSpec
+
+
+# Channel statistics used only to make synthetic pixels roughly unit-normal,
+# mirroring the normalization transforms in the reference drivers
+# (benchmark/mnist/mnist_pytorch.py:172-216).
+@dataclasses.dataclass(frozen=True)
+class SyntheticData:
+    """Iterable synthetic dataset bound to one DatasetSpec.
+
+    Batches are generated inside jit directly on the default device; ``epoch``
+    and ``step`` are folded into the key so every batch is distinct but
+    reproducible.
+    """
+
+    spec: DatasetSpec
+    batch_size: int  # global batch produced per step (callers shard it)
+    seed: int = 1
+    dtype: jnp.dtype = jnp.float32
+    train_size_override: int | None = None
+    test_size_override: int | None = None
+
+    @property
+    def train_size(self) -> int:
+        return self.train_size_override or self.spec.train_size
+
+    @property
+    def test_size(self) -> int:
+        return self.test_size_override or self.spec.test_size
+
+    def steps_per_epoch(self, train: bool = True) -> int:
+        n = self.train_size if train else self.test_size
+        return max(1, n // self.batch_size)
+
+    def batch(self, epoch: int, step: int, train: bool = True) -> Tuple[jax.Array, jax.Array]:
+        return _gen_batch(
+            self.seed + (0 if train else 1_000_003),
+            epoch,
+            step,
+            self.batch_size,
+            self.spec.image_size,
+            self.spec.num_classes,
+            self.dtype,
+        )
+
+    def epoch_iter(self, epoch: int, train: bool = True) -> Iterator[Tuple[jax.Array, jax.Array]]:
+        for step in range(self.steps_per_epoch(train)):
+            yield self.batch(epoch, step, train)
+
+
+@functools.partial(jax.jit, static_argnums=(1, 2))
+def _synthetic_images(key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Array:
+    # Uniform pixels in [0,1) then normalized — matches the statistics of the
+    # reference's random-uint8 JPEGs after its Normalize transform.
+    x = jax.random.uniform(key, shape, dtype=jnp.float32)
+    x = (x - 0.5) / 0.2887  # std of U[0,1)
+    return x.astype(dtype)
+
+
+def _gen_batch(seed, epoch, step, batch, image_size, num_classes, dtype):
+    key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), epoch), step)
+    kx, ky = jax.random.split(key)
+    x = _synthetic_images(kx, (batch, *image_size), dtype)
+    y = jax.random.randint(ky, (batch,), 0, num_classes, dtype=jnp.int32)
+    return x, y
+
+
+def make_synthetic(spec: DatasetSpec, batch_size: int, seed: int = 1,
+                   dtype=jnp.float32, steps_per_epoch: int | None = None) -> SyntheticData:
+    """Build a SyntheticData; ``steps_per_epoch`` overrides dataset-size-derived
+    step counts (useful for smoke tests and the 3-epoch benchmark protocol on
+    imagenet-scale specs)."""
+    train_override = steps_per_epoch * batch_size if steps_per_epoch else None
+    test_override = max(batch_size, (steps_per_epoch or 0) * batch_size // 5) if steps_per_epoch else None
+    return SyntheticData(
+        spec=spec,
+        batch_size=batch_size,
+        seed=seed,
+        dtype=dtype,
+        train_size_override=train_override,
+        test_size_override=test_override,
+    )

@@ -1,0 +1,18 @@
+from ddlbench_tpu.models.layers import (
+    Layer,
+    LayerModel,
+    init_model,
+    apply_model,
+    apply_slice,
+)
+from ddlbench_tpu.models.zoo import get_model, MODEL_NAMES
+
+__all__ = [
+    "Layer",
+    "LayerModel",
+    "init_model",
+    "apply_model",
+    "apply_slice",
+    "get_model",
+    "MODEL_NAMES",
+]

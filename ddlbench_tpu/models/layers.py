@@ -1,0 +1,376 @@
+"""Functional flat-layer model representation.
+
+The reference maintains THREE parallel model families per architecture because
+each engine has different structural needs: idiomatic nn.Modules for
+pytorch/horovod, flattened nn.Sequential with @skippable stash/pop residuals
+for torchgpipe, and tracer-friendly module-only graphs for PipeDream
+(SURVEY.md §2 B5-B7; gpipemodels/resnet/block.py:31-51 for the skip API).
+
+Here a model is ONE flat ``list[Layer]``; residual blocks are single layers
+(closures over their sub-params), so there is no stash/pop machinery, partitioning
+a pipeline is slicing the list, and the same definition serves every strategy.
+
+Each ``Layer`` is a pair of pure functions:
+
+* ``init(key, in_shape) -> (params, state, out_shape)`` — shapes are per-example
+  (no batch dim), NHWC.
+* ``apply(params, state, x, train) -> (y, new_state)`` — x is batched [B, ...];
+  ``state`` carries BatchNorm running statistics (functional analog of torch's
+  buffers). In train mode BN uses batch statistics and returns updated running
+  stats; in eval mode it uses running stats unchanged.
+
+Everything is NHWC with HWIO kernels — the TPU-native convolution layout.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import math
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+Params = Any
+State = Any
+Shape = Tuple[int, ...]
+
+CONV_DIMS = ("NHWC", "HWIO", "NHWC")
+BN_MOMENTUM = 0.1  # torch's default BatchNorm momentum
+BN_EPS = 1e-5
+
+
+@dataclasses.dataclass(frozen=True)
+class Layer:
+    """One pipeline-atomic unit of a model."""
+
+    name: str
+    init: Callable[[jax.Array, Shape], Tuple[Params, State, Shape]]
+    apply: Callable[[Params, State, jax.Array, bool], Tuple[jax.Array, State]]
+
+
+@dataclasses.dataclass(frozen=True)
+class LayerModel:
+    """A named flat stack of layers plus metadata the strategies need."""
+
+    name: str
+    layers: List[Layer]
+    in_shape: Shape  # (H, W, C)
+    num_classes: int
+
+
+def init_model(model: LayerModel, key: jax.Array):
+    """Initialize every layer; returns (params_list, state_list, shapes).
+
+    ``shapes[i]`` is the per-example input shape of layer i; ``shapes[-1]`` is
+    the final output shape. These boundary shapes drive pipeline activation
+    buffers and the profiler's activation_size fields.
+    """
+    params, states, shapes = [], [], [model.in_shape]
+    shape = model.in_shape
+    for layer in model.layers:
+        key, sub = jax.random.split(key)
+        p, s, shape = layer.init(sub, shape)
+        params.append(p)
+        states.append(s)
+        shapes.append(shape)
+    return params, states, shapes
+
+
+def apply_slice(layers: Sequence[Layer], params, states, x, train: bool):
+    new_states = []
+    for layer, p, s in zip(layers, params, states):
+        x, s2 = layer.apply(p, s, x, train)
+        new_states.append(s2)
+    return x, new_states
+
+
+def apply_model(model: LayerModel, params, states, x, train: bool):
+    return apply_slice(model.layers, params, states, x, train)
+
+
+# ---------------------------------------------------------------------------
+# Parameter initializers (match torch defaults where the reference relies on
+# them: kaiming-normal fan_out for convs, BN gamma=1 beta=0, linear kaiming-uniform).
+# ---------------------------------------------------------------------------
+
+def _conv_kernel_init(key, kh, kw, cin, cout):
+    fan_out = kh * kw * cout
+    std = math.sqrt(2.0 / fan_out)
+    return jax.random.normal(key, (kh, kw, cin, cout), jnp.float32) * std
+
+
+def _linear_init(key, cin, cout):
+    bound = 1.0 / math.sqrt(cin)
+    kw, kb = jax.random.split(key)
+    w = jax.random.uniform(kw, (cin, cout), jnp.float32, -bound, bound)
+    b = jax.random.uniform(kb, (cout,), jnp.float32, -bound, bound)
+    return w, b
+
+
+def _conv_out_hw(h, w, kh, kw, stride, padding):
+    if padding == "SAME":
+        return math.ceil(h / stride), math.ceil(w / stride)
+    return (h - kh) // stride + 1, (w - kw) // stride + 1
+
+
+# ---------------------------------------------------------------------------
+# Stateless primitive helpers used *inside* composite layers.
+# ---------------------------------------------------------------------------
+
+def conv2d(x, kernel, stride=1, padding="SAME", groups=1):
+    return lax.conv_general_dilated(
+        x,
+        kernel.astype(x.dtype),
+        window_strides=(stride, stride),
+        padding=padding,
+        dimension_numbers=CONV_DIMS,
+        feature_group_count=groups,
+    )
+
+
+def batchnorm(p, s, x, train: bool):
+    """Returns (y, new_state). p = {scale, bias}; s = {mean, var}.
+
+    Statistics are computed in float32 regardless of compute dtype (bf16-safe).
+    """
+    if train:
+        xf = x.astype(jnp.float32)
+        mean = jnp.mean(xf, axis=tuple(range(x.ndim - 1)))
+        var = jnp.var(xf, axis=tuple(range(x.ndim - 1)))
+        # Running var uses the unbiased estimator (torch BatchNorm semantics);
+        # normalization below uses the biased batch var, also matching torch.
+        n = xf.size // xf.shape[-1]
+        unbiased = var * (n / max(1, n - 1))
+        new_s = {
+            "mean": (1 - BN_MOMENTUM) * s["mean"] + BN_MOMENTUM * mean,
+            "var": (1 - BN_MOMENTUM) * s["var"] + BN_MOMENTUM * unbiased,
+        }
+    else:
+        mean, var = s["mean"], s["var"]
+        new_s = s
+    inv = lax.rsqrt(var + BN_EPS) * p["scale"]
+    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
+    return y.astype(x.dtype), new_s
+
+
+def bn_init(c):
+    params = {"scale": jnp.ones((c,), jnp.float32), "bias": jnp.zeros((c,), jnp.float32)}
+    state = {"mean": jnp.zeros((c,), jnp.float32), "var": jnp.ones((c,), jnp.float32)}
+    return params, state
+
+
+# ---------------------------------------------------------------------------
+# Layer constructors.
+# ---------------------------------------------------------------------------
+
+def conv_bn(name: str, out_ch: int, kernel: int = 3, stride: int = 1,
+            relu: bool = True, padding: str = "SAME", groups: int = 1) -> Layer:
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k = _conv_kernel_init(key, kernel, kernel, c // groups, out_ch)
+        bn_p, bn_s = bn_init(out_ch)
+        oh, ow = _conv_out_hw(h, w, kernel, kernel, stride, padding)
+        return {"kernel": k, "bn": bn_p}, {"bn": bn_s}, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        y = conv2d(x, p["kernel"], stride, padding, groups)
+        y, bn_s = batchnorm(p["bn"], s["bn"], y, train)
+        if relu:
+            y = jax.nn.relu(y)
+        return y, {"bn": bn_s}
+
+    return Layer(name, init, apply)
+
+
+def max_pool(name: str, window: int = 2, stride: int | None = None, padding: str = "VALID") -> Layer:
+    stride = stride or window
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        oh, ow = _conv_out_hw(h, w, window, window, stride, padding)
+        return {}, {}, (oh, ow, c)
+
+    def apply(p, s, x, train):
+        y = lax.reduce_window(
+            x, -jnp.inf, lax.max,
+            (1, window, window, 1), (1, stride, stride, 1), padding,
+        )
+        return y, s
+
+    return Layer(name, init, apply)
+
+
+def global_avg_pool(name: str = "gap") -> Layer:
+    def init(key, in_shape):
+        h, w, c = in_shape
+        return {}, {}, (c,)
+
+    def apply(p, s, x, train):
+        return jnp.mean(x, axis=(1, 2)), s
+
+    return Layer(name, init, apply)
+
+
+def flatten(name: str = "flatten") -> Layer:
+    def init(key, in_shape):
+        return {}, {}, (int(math.prod(in_shape)),)
+
+    def apply(p, s, x, train):
+        return x.reshape(x.shape[0], -1), s
+
+    return Layer(name, init, apply)
+
+
+def dense(name: str, out_features: int, relu: bool = False, dropout: float = 0.0) -> Layer:
+    """Linear layer over flattened features. Dropout is a no-op here (the
+    benchmark protocol measures throughput; reference VGG classifiers carry
+    Dropout but it does not change shapes/FLOPs materially) — documented
+    deviation."""
+
+    def init(key, in_shape):
+        cin = int(in_shape[0]) if len(in_shape) == 1 else int(math.prod(in_shape))
+        w, b = _linear_init(key, cin, out_features)
+        return {"w": w, "b": b}, {}, (out_features,)
+
+    def apply(p, s, x, train):
+        x = x.reshape(x.shape[0], -1)
+        y = x @ p["w"].astype(x.dtype) + p["b"].astype(x.dtype)
+        if relu:
+            y = jax.nn.relu(y)
+        return y, s
+
+    return Layer(name, init, apply)
+
+
+# ---------------------------------------------------------------------------
+# Residual blocks — each is ONE Layer (pipeline-atomic), so skip connections
+# never cross stage boundaries and the reference's stash/pop machinery
+# (gpipemodels/resnet/block.py:31-51) has no TPU analog to build.
+# ---------------------------------------------------------------------------
+
+def basic_block(name: str, out_ch: int, stride: int = 1) -> Layer:
+    """ResNet BasicBlock: 3x3 -> 3x3 with identity/projection shortcut."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {
+            "conv1": _conv_kernel_init(k1, 3, 3, c, out_ch),
+            "conv2": _conv_kernel_init(k2, 3, 3, out_ch, out_ch),
+        }
+        s = {}
+        p["bn1"], s["bn1"] = bn_init(out_ch)
+        p["bn2"], s["bn2"] = bn_init(out_ch)
+        if stride != 1 or c != out_ch:
+            p["proj"] = _conv_kernel_init(k3, 1, 1, c, out_ch)
+            p["bn_proj"], s["bn_proj"] = bn_init(out_ch)
+        oh, ow = _conv_out_hw(h, w, 3, 3, stride, "SAME")
+        return p, s, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        ns = {}
+        y = conv2d(x, p["conv1"], stride)
+        y, ns["bn1"] = batchnorm(p["bn1"], s["bn1"], y, train)
+        y = jax.nn.relu(y)
+        y = conv2d(y, p["conv2"], 1)
+        y, ns["bn2"] = batchnorm(p["bn2"], s["bn2"], y, train)
+        if "proj" in p:
+            sc = conv2d(x, p["proj"], stride)
+            sc, ns["bn_proj"] = batchnorm(p["bn_proj"], s["bn_proj"], sc, train)
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), ns
+
+    return Layer(name, init, apply)
+
+
+def bottleneck_block(name: str, mid_ch: int, stride: int = 1, expansion: int = 4) -> Layer:
+    """ResNet Bottleneck: 1x1 -> 3x3 -> 1x1(x4) with projection shortcut."""
+    out_ch = mid_ch * expansion
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k1, k2, k3, k4 = jax.random.split(key, 4)
+        p = {
+            "conv1": _conv_kernel_init(k1, 1, 1, c, mid_ch),
+            "conv2": _conv_kernel_init(k2, 3, 3, mid_ch, mid_ch),
+            "conv3": _conv_kernel_init(k3, 1, 1, mid_ch, out_ch),
+        }
+        s = {}
+        p["bn1"], s["bn1"] = bn_init(mid_ch)
+        p["bn2"], s["bn2"] = bn_init(mid_ch)
+        p["bn3"], s["bn3"] = bn_init(out_ch)
+        if stride != 1 or c != out_ch:
+            p["proj"] = _conv_kernel_init(k4, 1, 1, c, out_ch)
+            p["bn_proj"], s["bn_proj"] = bn_init(out_ch)
+        oh, ow = _conv_out_hw(h, w, 3, 3, stride, "SAME")
+        return p, s, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        ns = {}
+        y = conv2d(x, p["conv1"], 1)
+        y, ns["bn1"] = batchnorm(p["bn1"], s["bn1"], y, train)
+        y = jax.nn.relu(y)
+        y = conv2d(y, p["conv2"], stride)
+        y, ns["bn2"] = batchnorm(p["bn2"], s["bn2"], y, train)
+        y = jax.nn.relu(y)
+        y = conv2d(y, p["conv3"], 1)
+        y, ns["bn3"] = batchnorm(p["bn3"], s["bn3"], y, train)
+        if "proj" in p:
+            sc = conv2d(x, p["proj"], stride)
+            sc, ns["bn_proj"] = batchnorm(p["bn_proj"], s["bn_proj"], sc, train)
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), ns
+
+    return Layer(name, init, apply)
+
+
+def inverted_residual(name: str, out_ch: int, stride: int, expand: int) -> Layer:
+    """MobileNetV2 inverted residual: 1x1 expand -> 3x3 depthwise -> 1x1 project,
+    residual add when stride==1 and channels match."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        hidden = c * expand
+        k1, k2, k3 = jax.random.split(key, 3)
+        p, s = {}, {}
+        if expand != 1:
+            p["expand"] = _conv_kernel_init(k1, 1, 1, c, hidden)
+            p["bn_e"], s["bn_e"] = bn_init(hidden)
+        # depthwise: HWIO with I=1, groups=hidden
+        p["dw"] = _conv_kernel_init(k2, 3, 3, 1, hidden)
+        p["bn_d"], s["bn_d"] = bn_init(hidden)
+        p["project"] = _conv_kernel_init(k3, 1, 1, hidden, out_ch)
+        p["bn_p"], s["bn_p"] = bn_init(out_ch)
+        oh, ow = _conv_out_hw(h, w, 3, 3, stride, "SAME")
+        return p, s, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        ns = {}
+        y = x
+        hidden_groups = p["dw"].shape[-1]
+        if "expand" in p:
+            y = conv2d(y, p["expand"], 1)
+            y, ns["bn_e"] = batchnorm(p["bn_e"], s["bn_e"], y, train)
+            y = jax.nn.relu6(y)
+        y = conv2d(y, p["dw"], stride, groups=hidden_groups)
+        y, ns["bn_d"] = batchnorm(p["bn_d"], s["bn_d"], y, train)
+        y = jax.nn.relu6(y)
+        y = conv2d(y, p["project"], 1)
+        y, ns["bn_p"] = batchnorm(p["bn_p"], s["bn_p"], y, train)
+        if stride == 1 and x.shape[-1] == y.shape[-1]:
+            y = y + x
+        return y, ns
+
+    return Layer(name, init, apply)
+
+
+def param_count(params) -> int:
+    return sum(int(jnp.size(l)) for l in jax.tree.leaves(params))
+
+
+def param_bytes(params) -> int:
+    return sum(int(jnp.size(l)) * l.dtype.itemsize for l in jax.tree.leaves(params))

@@ -1,0 +1,55 @@
+"""MobileNetV2 as a flat layer list.
+
+Parity with the reference's three MobileNetV2 variants
+(benchmark/mnist/models/mnistmobilenetv2.py,
+benchmark/cifar10/pytorchcifargitmodels/mobilenetv2.py, torchvision for
+imagenet; GPipe skip-wrapped build at
+benchmark/*/gpipemodels/mobilenetv2/mobilenetv2.py:15-39). Small-input variants
+use a stride-1 stem (the pytorch-cifar convention) so 28/32-px inputs are not
+downsampled to nothing.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ddlbench_tpu.models.layers import (
+    Layer,
+    LayerModel,
+    conv_bn,
+    dense,
+    global_avg_pool,
+    inverted_residual,
+)
+
+# (expansion t, output channels c, repeats n, first-block stride s) — the
+# standard MobileNetV2 table.
+_CFG = [
+    (1, 16, 1, 1),
+    (6, 24, 2, 2),
+    (6, 32, 3, 2),
+    (6, 64, 4, 2),
+    (6, 96, 3, 1),
+    (6, 160, 3, 2),
+    (6, 320, 1, 1),
+]
+
+
+def build_mobilenetv2(arch: str, in_shape, num_classes: int) -> LayerModel:
+    small_input = in_shape[0] <= 64
+    layers: List[Layer] = []
+    layers.append(conv_bn("stem", 32, kernel=3, stride=1 if small_input else 2))
+    block_i = 0
+    for t, c, n, s in _CFG:
+        for i in range(n):
+            stride = s if i == 0 else 1
+            if small_input and block_i < 2:
+                # keep early resolution on 28/32-px inputs
+                stride = 1
+            block_i += 1
+            layers.append(inverted_residual(f"block{block_i}", c, stride, t))
+    layers.append(conv_bn("head_conv", 1280, kernel=1, stride=1))
+    layers.append(global_avg_pool())
+    layers.append(dense("fc", num_classes))
+    return LayerModel(name="mobilenetv2", layers=layers, in_shape=tuple(in_shape),
+                      num_classes=num_classes)

@@ -1,0 +1,60 @@
+"""ResNet-18/50/152 as flat layer lists, with per-dataset stems.
+
+Capability parity with the reference's three ResNet families:
+* MNIST variant — 1-channel 3x3 stride-1 stem, no maxpool, 4-window avgpool
+  (benchmark/mnist/models/mnistresnet.py:68-76),
+* CIFAR variant — 3x3 stride-1 stem (benchmark/cifar10/pytorchcifargitmodels/resnet.py),
+* ImageNet/highres variant — torchvision-style 7x7 stride-2 stem + 3x3 maxpool
+  (benchmark/imagenet/imagenet_pytorch.py:19-30 uses torchvision.models).
+
+One builder serves all strategies; each residual block is one pipeline-atomic
+Layer (see models/layers.py).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ddlbench_tpu.models.layers import (
+    Layer,
+    LayerModel,
+    basic_block,
+    bottleneck_block,
+    conv_bn,
+    dense,
+    global_avg_pool,
+    max_pool,
+)
+
+# (block_kind, per-group block counts)
+_DEPTHS = {
+    "resnet18": ("basic", [2, 2, 2, 2]),
+    "resnet50": ("bottleneck", [3, 4, 6, 3]),
+    "resnet152": ("bottleneck", [3, 8, 36, 3]),
+}
+_WIDTHS = [64, 128, 256, 512]
+
+
+def build_resnet(arch: str, in_shape, num_classes: int) -> LayerModel:
+    kind, counts = _DEPTHS[arch]
+    small_input = in_shape[0] <= 64  # mnist/cifar stems keep resolution
+
+    layers: List[Layer] = []
+    if small_input:
+        layers.append(conv_bn("stem", 64, kernel=3, stride=1))
+    else:
+        layers.append(conv_bn("stem", 64, kernel=7, stride=2))
+        layers.append(max_pool("stem_pool", window=3, stride=2, padding="SAME"))
+
+    for group, (width, n_blocks) in enumerate(zip(_WIDTHS, counts)):
+        for b in range(n_blocks):
+            stride = 2 if (b == 0 and group > 0) else 1
+            name = f"group{group + 1}_block{b + 1}"
+            if kind == "basic":
+                layers.append(basic_block(name, width, stride))
+            else:
+                layers.append(bottleneck_block(name, width, stride))
+
+    layers.append(global_avg_pool())
+    layers.append(dense("fc", num_classes))
+    return LayerModel(name=arch, layers=layers, in_shape=tuple(in_shape), num_classes=num_classes)

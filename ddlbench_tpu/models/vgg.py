@@ -1,0 +1,54 @@
+"""VGG-11/16 (batch-norm variants) as flat layer lists.
+
+Parity with the reference's VGG families (benchmark/mnist/models/mnistvgg.py,
+benchmark/cifar10/pytorchcifargitmodels/vgg.py, torchvision VGG for imagenet,
+plus the GPipe nn.Sequential builds under benchmark/*/gpipemodels/vgg/).
+Small-input variants classify straight from the 512-channel feature map (the
+pytorch-cifar convention); large-input variants keep the 4096-wide two-layer
+classifier head so FLOP/parameter footprints match torchvision's.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from ddlbench_tpu.models.layers import (
+    Layer,
+    LayerModel,
+    conv_bn,
+    dense,
+    flatten,
+    global_avg_pool,
+    max_pool,
+)
+
+_CFG = {
+    # torchvision cfgs A (vgg11) and D (vgg16); 'M' = 2x2 maxpool.
+    "vgg11": [64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"],
+    "vgg16": [64, 64, "M", 128, 128, "M", 256, 256, 256, "M",
+              512, 512, 512, "M", 512, 512, 512, "M"],
+}
+
+
+def build_vgg(arch: str, in_shape, num_classes: int) -> LayerModel:
+    small_input = in_shape[0] <= 64
+    layers: List[Layer] = []
+    conv_i = 0
+    pool_i = 0
+    for item in _CFG[arch]:
+        if item == "M":
+            pool_i += 1
+            layers.append(max_pool(f"pool{pool_i}", window=2, stride=2))
+        else:
+            conv_i += 1
+            layers.append(conv_bn(f"conv{conv_i}", int(item), kernel=3, stride=1))
+
+    if small_input:
+        layers.append(global_avg_pool())
+        layers.append(dense("fc", num_classes))
+    else:
+        layers.append(flatten())
+        layers.append(dense("fc1", 4096, relu=True))
+        layers.append(dense("fc2", 4096, relu=True))
+        layers.append(dense("fc3", num_classes))
+    return LayerModel(name=arch, layers=layers, in_shape=tuple(in_shape), num_classes=num_classes)

@@ -1,0 +1,27 @@
+"""Model registry: (arch, dataset) -> LayerModel.
+
+Replaces the reference's three parallel model families and per-dataset
+directories (SURVEY.md §2 B5-B7) with one registry; the dataset spec chooses
+the stem/classifier variant.
+"""
+
+from __future__ import annotations
+
+from ddlbench_tpu.config import DATASETS, DatasetSpec
+from ddlbench_tpu.models.layers import LayerModel
+from ddlbench_tpu.models.mobilenetv2 import build_mobilenetv2
+from ddlbench_tpu.models.resnet import build_resnet
+from ddlbench_tpu.models.vgg import build_vgg
+
+MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16", "mobilenetv2")
+
+
+def get_model(arch: str, dataset: str | DatasetSpec) -> LayerModel:
+    spec = dataset if isinstance(dataset, DatasetSpec) else DATASETS[dataset]
+    if arch.startswith("resnet"):
+        return build_resnet(arch, spec.image_size, spec.num_classes)
+    if arch.startswith("vgg"):
+        return build_vgg(arch, spec.image_size, spec.num_classes)
+    if arch == "mobilenetv2":
+        return build_mobilenetv2(arch, spec.image_size, spec.num_classes)
+    raise ValueError(f"unknown arch {arch!r}; known: {MODEL_NAMES}")

@@ -1,0 +1,3 @@
+from ddlbench_tpu.parallel.api import make_strategy
+
+__all__ = ["make_strategy"]

@@ -1,0 +1,44 @@
+"""Strategy factory: one entry point for the four parallelization engines.
+
+The reference binds workloads to engines by having nine separate driver
+scripts (SURVEY.md §1 L4); here ``make_strategy(cfg)`` returns an object with
+a uniform interface consumed by one train loop (ddlbench_tpu/train/loop.py):
+
+* ``init(key) -> train_state`` (device-placed/sharded)
+* ``train_step(train_state, x, y, lr) -> (train_state, metrics)`` (jitted)
+* ``eval_step(train_state, x, y) -> {loss, correct, count}`` (jitted)
+* ``shard_batch(x, y)`` — place a global batch onto the strategy's mesh
+* ``world_size``
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.zoo import get_model
+
+
+def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None):
+    cfg.validate()
+    model = get_model(cfg.arch, cfg.benchmark)
+    if cfg.strategy == "single":
+        from ddlbench_tpu.parallel.single import SingleStrategy
+
+        return SingleStrategy(model, cfg)
+    if cfg.strategy == "dp":
+        from ddlbench_tpu.parallel.dp import DPStrategy, make_data_mesh
+
+        mesh = make_data_mesh(cfg.num_devices, devices)
+        return DPStrategy(model, cfg, mesh)
+    if cfg.strategy == "gpipe":
+        from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+
+        return GPipeStrategy(model, cfg, devices=devices)
+    if cfg.strategy == "pipedream":
+        from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
+
+        return PipeDreamStrategy(model, cfg, devices=devices)
+    raise ValueError(cfg.strategy)

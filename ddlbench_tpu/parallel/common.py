@@ -1,0 +1,69 @@
+"""Shared train-step machinery: loss, SGD with momentum/weight-decay, LR schedule.
+
+Optimizer semantics follow the reference drivers: plain SGD+momentum
+(benchmark/mnist/mnist_pytorch.py:153-156), imagenet adds weight decay 1e-4 and
+step decay /10 every 30 epochs (benchmark/imagenet/imagenet_pytorch.py:44-50,
+225-229). Implemented directly (not via optax) so the same update rule applies
+unchanged to packed flat-vector stage parameters in the pipeline strategies.
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+
+
+def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+
+
+class SGDState(NamedTuple):
+    momentum: Any  # pytree matching params
+
+
+def sgd_init(params) -> SGDState:
+    return SGDState(momentum=jax.tree.map(jnp.zeros_like, params))
+
+
+def sgd_update(params, grads, opt_state: SGDState, lr, momentum: float,
+               weight_decay: float):
+    """torch.optim.SGD semantics: buf = mu*buf + (grad + wd*p); p -= lr*buf."""
+
+    def upd(p, g, m):
+        g = g.astype(p.dtype)
+        if weight_decay:
+            g = g + weight_decay * p
+        m2 = momentum * m + g
+        return p - lr * m2, m2
+
+    flat_p = jax.tree.leaves(params)
+    flat_g = jax.tree.leaves(grads)
+    flat_m = jax.tree.leaves(opt_state.momentum)
+    out = [upd(p, g, m) for p, g, m in zip(flat_p, flat_g, flat_m)]
+    treedef = jax.tree.structure(params)
+    new_p = jax.tree.unflatten(treedef, [o[0] for o in out])
+    new_m = jax.tree.unflatten(treedef, [o[1] for o in out])
+    return new_p, SGDState(momentum=new_m)
+
+
+def step_decay_lr(base_lr: float, epoch, step_epochs: int, gamma: float):
+    """Step decay /gamma every step_epochs (imagenet_pytorch.py:225-229)."""
+    return base_lr * (gamma ** (epoch // step_epochs))
+
+
+def cast_params(params, dtype):
+    """Cast floating-point leaves to the compute dtype (bf16 on TPU)."""
+    if dtype is None:
+        return params
+    return jax.tree.map(
+        lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
+        params,
+    )

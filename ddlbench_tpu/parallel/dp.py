@@ -1,0 +1,117 @@
+"""Data-parallel strategy — the reference's Horovod engine, TPU-native.
+
+Reference mechanism (benchmark/mnist/mnist_horovod.py): hvd.init + one process
+per GPU (:162-171), DistributedSampler batch sharding (:207-219), lr scaled by
+world size (:226), rank-0 parameter/optimizer broadcast (:230-231),
+DistributedOptimizer hooking an NCCL allreduce onto every gradient (:234-236),
+and allreduced eval metrics via metric_average (:129-132).
+
+TPU-native design: one jit over a 1-D 'data' mesh. The batch is sharded on the
+leading axis; parameters are replicated. XLA's SPMD partitioner inserts the
+gradient all-reduce over ICI automatically (the explicit analog of Horovod's
+per-gradient NCCL hook), metric means are global by construction (allreduced
+eval-metric parity), and the initial `device_put` of replicated params is the
+broadcast-init. Helper processes, samplers, and hooks all disappear into the
+compiled program.
+
+Deviation (documented): BatchNorm statistics are computed over the *global*
+batch (sync-BN) because the batch axis is sharded under one jit; Horovod
+computes per-replica statistics. Throughput is unaffected; accuracy parity is
+equal or better (SURVEY.md §7 "BatchNorm under pipeline/DP").
+"""
+
+from __future__ import annotations
+
+from typing import Any, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
+from ddlbench_tpu.parallel.common import (
+    accuracy,
+    cast_params,
+    cross_entropy_loss,
+    sgd_init,
+    sgd_update,
+)
+from ddlbench_tpu.parallel.single import TrainState
+
+
+def make_data_mesh(num_devices: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices or jax.devices())[:num_devices]
+    if len(devs) < num_devices:
+        raise ValueError(f"need {num_devices} devices, have {len(devs)}")
+    import numpy as np
+
+    return Mesh(np.array(devs), axis_names=("data",))
+
+
+class DPStrategy:
+    """strategy='dp': batch sharded over the 'data' mesh axis, params replicated."""
+
+    def __init__(self, model: LayerModel, cfg: RunConfig, mesh: Optional[Mesh] = None):
+        self.model = model
+        self.cfg = cfg
+        self.mesh = mesh or make_data_mesh(cfg.num_devices)
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        mom = cfg.resolved_momentum()
+        wd = cfg.resolved_weight_decay()
+
+        self._replicated = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, P("data"))
+
+        def train_step(ts: TrainState, x, y, lr):
+            def loss_fn(params):
+                p = cast_params(params, self.compute_dtype)
+                logits, new_state = apply_model(
+                    model, p, ts.model_state, x.astype(self.compute_dtype), True
+                )
+                return cross_entropy_loss(logits, y), (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            metrics = {"loss": loss, "accuracy": accuracy(logits, y)}
+            return TrainState(params, new_state, opt), metrics
+
+        def eval_step(ts: TrainState, x, y):
+            p = cast_params(ts.params, self.compute_dtype)
+            logits, _ = apply_model(
+                model, p, ts.model_state, x.astype(self.compute_dtype), False
+            )
+            return {
+                "loss": cross_entropy_loss(logits, y),
+                "correct": jnp.sum(jnp.argmax(logits, -1) == y),
+                "count": jnp.asarray(y.shape[0], jnp.int32),
+            }
+
+        self.train_step = jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
+            out_shardings=None,
+        )
+        self.eval_step = jax.jit(
+            eval_step,
+            in_shardings=(None, self._batch_sharding, self._batch_sharding),
+        )
+
+    def init(self, key) -> TrainState:
+        params, state, _ = init_model(self.model, key)
+        ts = TrainState(params, state, sgd_init(params))
+        # Broadcast-init parity (mnist_horovod.py:230-231): replicate to mesh.
+        return jax.device_put(ts, self._replicated)
+
+    def shard_batch(self, x, y):
+        return (
+            jax.device_put(x, self._batch_sharding),
+            jax.device_put(y, self._batch_sharding),
+        )
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size

@@ -1,0 +1,82 @@
+"""Single-device strategy — the reference's PyTorch baseline.
+
+Parity target: benchmark/mnist/mnist_pytorch.py (train loop :52-99, eval
+:102-133): SGD+momentum cross-entropy training with per-interval throughput and
+peak-memory logging. Here the entire step (fwd, bwd, update, metrics) is one
+jitted function; donated arguments keep params in place in HBM.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Any
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
+from ddlbench_tpu.parallel.common import (
+    SGDState,
+    accuracy,
+    cast_params,
+    cross_entropy_loss,
+    sgd_init,
+    sgd_update,
+)
+
+
+class TrainState(NamedTuple):
+    params: Any
+    model_state: Any  # BN running stats
+    opt: SGDState
+
+
+class SingleStrategy:
+    """strategy='single': one chip, no collectives."""
+
+    def __init__(self, model: LayerModel, cfg: RunConfig):
+        self.model = model
+        self.cfg = cfg
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        mom = cfg.resolved_momentum()
+        wd = cfg.resolved_weight_decay()
+
+        def train_step(ts: TrainState, x, y, lr):
+            def loss_fn(params):
+                p = cast_params(params, self.compute_dtype)
+                logits, new_state = apply_model(
+                    model, p, ts.model_state, x.astype(self.compute_dtype), True
+                )
+                return cross_entropy_loss(logits, y), (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            metrics = {"loss": loss, "accuracy": accuracy(logits, y)}
+            return TrainState(params, new_state, opt), metrics
+
+        def eval_step(ts: TrainState, x, y):
+            p = cast_params(ts.params, self.compute_dtype)
+            logits, _ = apply_model(
+                model, p, ts.model_state, x.astype(self.compute_dtype), False
+            )
+            return {
+                "loss": cross_entropy_loss(logits, y),
+                "correct": jnp.sum(jnp.argmax(logits, -1) == y),
+                "count": jnp.asarray(y.shape[0], jnp.int32),
+            }
+
+        self.train_step = jax.jit(train_step, donate_argnums=(0,))
+        self.eval_step = jax.jit(eval_step)
+
+    def init(self, key) -> TrainState:
+        params, state, _ = init_model(self.model, key)
+        return TrainState(params, state, sgd_init(params))
+
+    def shard_batch(self, x, y):
+        return x, y
+
+    @property
+    def world_size(self) -> int:
+        return 1

@@ -1,0 +1,3 @@
+from ddlbench_tpu.train.metrics import AverageMeter, MetricLogger
+
+__all__ = ["AverageMeter", "MetricLogger"]

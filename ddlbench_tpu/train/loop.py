@@ -1,0 +1,110 @@
+"""The benchmark train/eval loop — shared by all strategies.
+
+Parity with the reference's per-driver loops (benchmark/mnist/mnist_pytorch.py:
+train_epoch :52-99, test_epoch :102-133, summary :222-226): `epochs` training
+epochs, per-LOGINTER throughput/memory lines, one validation epoch per training
+epoch, and a final averaged summary. The loop is strategy-agnostic; all
+device-side work lives in the strategy's jitted steps.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.data.synthetic import make_synthetic
+from ddlbench_tpu.parallel.api import make_strategy
+from ddlbench_tpu.train.metrics import AverageMeter, MetricLogger
+from ddlbench_tpu.parallel.common import step_decay_lr
+
+
+def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] = None,
+                  warmup_steps: int = 1) -> Dict[str, Any]:
+    """Run the full 3-epoch benchmark protocol; returns the summary dict."""
+    cfg.validate()
+    strategy = strategy or make_strategy(cfg)
+    logger = logger or MetricLogger(cfg.epochs, cfg.log_interval)
+
+    mb, chunks = cfg.resolved_batches()
+    global_batch = cfg.global_batch()
+    spec = cfg.dataset()
+    data = make_synthetic(
+        spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
+    )
+
+    base_lr = cfg.resolved_lr()
+    if cfg.strategy == "dp" and cfg.scale_lr_by_world:
+        # Horovod parity: lr scaled by world size (mnist_horovod.py:226).
+        base_lr = base_lr * strategy.world_size
+
+    if not cfg.synthetic:
+        raise NotImplementedError(
+            "on-disk (real-data) loading is not wired up yet; run with synthetic data"
+        )
+
+    # Warmup: trigger compilation outside the timed region (first XLA compile is
+    # tens of seconds; the reference's closest analog is cudnn.benchmark=True,
+    # imagenet_pytorch.py:58-66). Runs on a throwaway state so the measured run
+    # starts from pristine params/momentum/BN stats.
+    if warmup_steps > 0:
+        ts_warm = strategy.init(jax.random.key(cfg.seed))
+        x, y = strategy.shard_batch(*data.batch(epoch=0, step=0))
+        for _ in range(warmup_steps):
+            ts_warm, m = strategy.train_step(ts_warm, x, y, jnp.float32(base_lr))
+        jax.block_until_ready(m["loss"])
+        del ts_warm
+
+    ts = strategy.init(jax.random.key(cfg.seed))
+
+    summary_acc = 0.0
+    for epoch in range(1, cfg.epochs + 1):
+        lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
+        steps = data.steps_per_epoch(train=True)
+        loss_meter = AverageMeter("loss")
+        tick = time.perf_counter()
+        interval_tick, interval_samples = tick, 0
+        for step in range(steps):
+            x, y = strategy.shard_batch(*data.batch(epoch, step))
+            ts, metrics = strategy.train_step(ts, x, y, jnp.float32(lr))
+            interval_samples += global_batch
+            if (step + 1) % cfg.log_interval == 0 or step == steps - 1:
+                loss = float(jax.block_until_ready(metrics["loss"]))
+                loss_meter.update(loss)
+                now = time.perf_counter()
+                logger.train_interval(
+                    epoch,
+                    100.0 * (step + 1) / steps,
+                    interval_samples / max(1e-9, now - interval_tick),
+                    loss,
+                )
+                interval_tick, interval_samples = now, 0
+        jax.block_until_ready(jax.tree.leaves(ts.params)[0])
+        epoch_time = time.perf_counter() - tick
+        logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time)
+
+        # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
+        val = evaluate(cfg, strategy, ts, data, epoch)
+        logger.valid_epoch(epoch, val["loss"], val["accuracy"])
+        summary_acc = val["accuracy"]
+
+    result = logger.summary(summary_acc)
+    result["train_state"] = ts
+    return result
+
+
+def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int) -> Dict[str, float]:
+    total_loss, total_correct, total_count = 0.0, 0, 0
+    for step in range(data.steps_per_epoch(train=False)):
+        x, y = strategy.shard_batch(*data.batch(epoch, step, train=False))
+        m = strategy.eval_step(ts, x, y)
+        total_loss += float(m["loss"]) * int(m["count"])
+        total_correct += int(m["correct"])
+        total_count += int(m["count"])
+    return {
+        "loss": total_loss / max(1, total_count),
+        "accuracy": total_correct / max(1, total_count),
+    }

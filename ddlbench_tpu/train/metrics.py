@@ -1,0 +1,154 @@
+"""Metrics accumulation and the log-line schema.
+
+The reference's observability is print-based and machine-scraped; the exact line
+formats are its public metric interface (SURVEY.md §5.5):
+
+* per-interval train line: ``train | <e>/<E> epoch (<p>%) | <X> samples/sec | ...``
+  with peak memory (benchmark/mnist/mnist_pytorch.py:79-97),
+* final summary: ``valid accuracy: <A> | <X> samples/sec, <S> sec/epoch (average)``
+  (benchmark/mnist/mnist_pytorch.py:225-226),
+* ``AverageMeter`` val/avg accumulators
+  (pipedream-fork/runtime/image_classification/main_with_runtime.py:587-602).
+
+We keep the same schema so the reference's log scrapers
+(pipedream-fork/runtime/scripts/process_output.py) would parse our output, and
+substitute TPU HBM stats (jax ``memory_stats``) for ``torch.cuda.memory_stats``.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+import time
+from typing import Any, Dict, Optional
+
+import jax
+
+
+class AverageMeter:
+    """Running value/average/sum/count accumulator."""
+
+    def __init__(self, name: str = ""):
+        self.name = name
+        self.reset()
+
+    def reset(self) -> None:
+        self.val = 0.0
+        self.avg = 0.0
+        self.sum = 0.0
+        self.count = 0
+
+    def update(self, val: float, n: int = 1) -> None:
+        self.val = float(val)
+        self.sum += float(val) * n
+        self.count += n
+        self.avg = self.sum / max(1, self.count)
+
+
+def device_memory_gb(device: Optional[Any] = None) -> Dict[str, float]:
+    """Peak/in-use device memory in GB (TPU analog of torch.cuda.memory_stats)."""
+    try:
+        dev = device or jax.local_devices()[0]
+        stats = dev.memory_stats() or {}
+    except Exception:
+        stats = {}
+    gb = 1024.0**3
+    return {
+        "in_use": stats.get("bytes_in_use", 0) / gb,
+        "peak": stats.get("peak_bytes_in_use", stats.get("bytes_in_use", 0)) / gb,
+        "limit": stats.get("bytes_limit", 0) / gb,
+    }
+
+
+class MetricLogger:
+    """Produces the reference-schema log lines plus a structured JSONL stream."""
+
+    def __init__(self, total_epochs: int, log_interval: int = 25, jsonl_path: Optional[str] = None, rank: int = 0):
+        self.total_epochs = total_epochs
+        self.log_interval = log_interval
+        self.rank = rank
+        self._jsonl = open(jsonl_path, "a") if jsonl_path else None
+        self.epoch_throughputs: list[float] = []
+        self.epoch_times: list[float] = []
+
+    def _emit(self, line: str, record: Dict[str, Any]) -> None:
+        if self.rank == 0:
+            print(line, flush=True)
+            if self._jsonl:
+                self._jsonl.write(json.dumps(record) + "\n")
+                self._jsonl.flush()
+
+    def train_interval(self, epoch: int, progress_pct: float, samples_per_sec: float, loss: float) -> None:
+        mem = device_memory_gb()
+        line = (
+            f"train | {epoch}/{self.total_epochs} epoch ({progress_pct:.0f}%) | "
+            f"{samples_per_sec:.2f} samples/sec | loss {loss:.4f} | "
+            f"mem {mem['in_use']:.2f} GB in use, {mem['peak']:.2f} GB peak"
+        )
+        self._emit(
+            line,
+            {
+                "kind": "train_interval",
+                "epoch": epoch,
+                "progress_pct": progress_pct,
+                "samples_per_sec": samples_per_sec,
+                "loss": loss,
+                **{f"mem_{k}_gb": v for k, v in mem.items()},
+            },
+        )
+
+    def epoch_done(self, epoch: int, samples_per_sec: float, epoch_seconds: float) -> None:
+        self.epoch_throughputs.append(samples_per_sec)
+        self.epoch_times.append(epoch_seconds)
+        self._emit(
+            f"epoch {epoch}/{self.total_epochs} done | {samples_per_sec:.2f} samples/sec | "
+            f"{epoch_seconds:.2f} sec",
+            {
+                "kind": "epoch",
+                "epoch": epoch,
+                "samples_per_sec": samples_per_sec,
+                "epoch_seconds": epoch_seconds,
+            },
+        )
+
+    def valid_epoch(self, epoch: int, loss: float, accuracy: float) -> None:
+        self._emit(
+            f"valid | {epoch}/{self.total_epochs} epoch | loss {loss:.4f} | accuracy {accuracy:.4f}",
+            {"kind": "valid", "epoch": epoch, "loss": loss, "accuracy": accuracy},
+        )
+
+    def summary(self, valid_accuracy: float) -> Dict[str, float]:
+        """Final line matching mnist_pytorch.py:225-226's schema."""
+        avg_tp = sum(self.epoch_throughputs) / max(1, len(self.epoch_throughputs))
+        avg_t = sum(self.epoch_times) / max(1, len(self.epoch_times))
+        self._emit(
+            f"valid accuracy: {valid_accuracy:.4f} | "
+            f"{avg_tp:.2f} samples/sec, {avg_t:.2f} sec/epoch (average)",
+            {
+                "kind": "summary",
+                "valid_accuracy": valid_accuracy,
+                "samples_per_sec": avg_tp,
+                "sec_per_epoch": avg_t,
+            },
+        )
+        return {
+            "valid_accuracy": valid_accuracy,
+            "samples_per_sec": avg_tp,
+            "sec_per_epoch": avg_t,
+        }
+
+    def close(self) -> None:
+        if self._jsonl:
+            self._jsonl.close()
+            self._jsonl = None
+
+
+class Stopwatch:
+    def __init__(self) -> None:
+        self.t0 = time.perf_counter()
+
+    def lap(self) -> float:
+        now = time.perf_counter()
+        dt = now - self.t0
+        self.t0 = now
+        return dt

@@ -1,0 +1,30 @@
+"""Test harness: force an 8-virtual-device CPU backend.
+
+The reference has no pytest/CI harness at all (SURVEY.md §4); its only
+"fake backend" is launching gloo ranks as localhost processes
+(pipedream-fork/runtime/tests/communication/README.md:3-16). Here every
+distributed strategy is testable in-process on a virtual CPU mesh.
+
+Note: jax may already be imported by sitecustomize (TPU-tunnel images), so env
+vars are too late — we force the platform through jax.config before the first
+backend touch instead.
+"""
+
+import os
+
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+)
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
+    return devs

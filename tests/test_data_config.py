@@ -1,0 +1,40 @@
+import jax.numpy as jnp
+import pytest
+
+from ddlbench_tpu.config import DATASETS, RunConfig
+from ddlbench_tpu.data import make_synthetic
+
+
+def test_synthetic_batches_deterministic():
+    data = make_synthetic(DATASETS["mnist"], batch_size=8)
+    x1, y1 = data.batch(epoch=0, step=0)
+    x2, y2 = data.batch(epoch=0, step=0)
+    assert jnp.array_equal(x1, x2) and jnp.array_equal(y1, y2)
+    x3, _ = data.batch(epoch=0, step=1)
+    assert not jnp.array_equal(x1, x3)
+    assert x1.shape == (8, 28, 28, 1)
+    assert y1.dtype == jnp.int32 and int(y1.max()) < 10
+
+
+def test_steps_per_epoch_matches_blueprint():
+    data = make_synthetic(DATASETS["cifar10"], batch_size=64)
+    assert data.steps_per_epoch(train=True) == 50_000 // 64
+
+
+def test_config_batch_matrix():
+    # Reference harness batch matrix (BASELINE.md / run_template.sh:186-266).
+    assert RunConfig(benchmark="mnist", strategy="single").resolved_batches() == (128, 1)
+    assert RunConfig(benchmark="cifar10", strategy="dp").resolved_batches() == (64, 1)
+    assert RunConfig(benchmark="imagenet", strategy="gpipe", num_devices=4,
+                     num_stages=4).resolved_batches() == (24, 12)
+    mb, chunks = RunConfig(benchmark="mnist", strategy="pipedream", num_devices=4,
+                           num_stages=4).resolved_batches()
+    assert mb * chunks == 512  # pipedream global batch (run_template.sh:377-394)
+
+
+def test_config_validation():
+    with pytest.raises(ValueError):
+        RunConfig(strategy="gpipe", num_devices=4, num_stages=3).validate()
+    with pytest.raises(ValueError):
+        RunConfig(benchmark="nope").validate()
+    RunConfig(strategy="dp", num_devices=8).validate()

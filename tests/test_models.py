@@ -1,0 +1,73 @@
+"""Shape/parameter sanity for the model zoo.
+
+Plays the role of the reference's run/summary + benchmark/network_summary.py
+CPU shape-smoke-test (network_summary.py:27-33), as pytest.
+"""
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddlbench_tpu.config import DATASETS
+from ddlbench_tpu.models import get_model, init_model, apply_model
+from ddlbench_tpu.models.layers import param_count
+
+CASES = [
+    ("resnet18", "mnist"),
+    ("resnet18", "cifar10"),
+    ("resnet50", "cifar10"),
+    ("vgg11", "mnist"),
+    ("vgg16", "cifar10"),
+    ("mobilenetv2", "cifar10"),
+]
+
+
+@pytest.mark.parametrize("arch,ds", CASES)
+def test_forward_shapes(arch, ds):
+    spec = DATASETS[ds]
+    model = get_model(arch, ds)
+    params, state, shapes = init_model(model, jax.random.key(0))
+    assert shapes[0] == spec.image_size
+    assert shapes[-1] == (spec.num_classes,)
+    x = jnp.zeros((2, *spec.image_size), jnp.float32)
+    y, new_state = apply_model(model, params, state, x, train=True)
+    assert y.shape == (2, spec.num_classes)
+    assert jax.tree.structure(new_state) == jax.tree.structure(state)
+
+
+def test_imagenet_variants_build():
+    # Large-input stems: just init (no forward; 224x224 fwd is slow on 1-core CPU).
+    for arch in ("resnet50", "vgg16", "mobilenetv2"):
+        model = get_model(arch, "imagenet")
+        params, state, shapes = init_model(model, jax.random.key(0))
+        assert shapes[-1] == (1000,)
+
+
+def test_param_counts_match_torch_families():
+    # Known torchvision-scale parameter counts (imagenet heads):
+    # resnet18 ~11.7M, resnet50 ~25.6M, vgg16 ~138M, mobilenetv2 ~3.5M.
+    expect = {"resnet18": 11.7e6, "resnet50": 25.6e6, "mobilenetv2": 3.5e6}
+    for arch, target in expect.items():
+        model = get_model(arch, "imagenet")
+        params, _, _ = init_model(model, jax.random.key(0))
+        n = param_count(params)
+        assert abs(n - target) / target < 0.05, (arch, n)
+
+
+def test_bn_state_updates_in_train_only():
+    model = get_model("resnet18", "mnist")
+    params, state, _ = init_model(model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (4, 28, 28, 1))
+    _, st_train = apply_model(model, params, state, x, train=True)
+    _, st_eval = apply_model(model, params, state, x, train=False)
+    # eval leaves state untouched
+    assert all(
+        jnp.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(st_eval), jax.tree.leaves(state))
+    )
+    # train changes running stats
+    changed = [
+        not jnp.array_equal(a, b)
+        for a, b in zip(jax.tree.leaves(st_train), jax.tree.leaves(state))
+    ]
+    assert any(changed)
