@@ -136,7 +136,9 @@ class RunConfig:
     # Numerics.
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
     param_dtype: str = "float32"
-    remat_stages: bool = False  # jax.checkpoint each stage in pipeline modes
+    # jax.checkpoint each (microbatch, stage) in pipeline modes — parity with
+    # torchgpipe's default activation checkpointing.
+    remat_stages: bool = True
     seed: int = 1  # reference seeds torch.manual_seed(1) (imagenet_pytorch.py:58-66)
 
     hardware: HardwareModel = dataclasses.field(default_factory=HardwareModel)

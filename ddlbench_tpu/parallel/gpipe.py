@@ -1,0 +1,314 @@
+"""Synchronous micro-batch pipeline parallelism — the reference's GPipe engine,
+TPU-native.
+
+Reference mechanism (benchmark/mnist/mnist_gpipe.py): flatten the model to
+nn.Sequential, `balance_by_time` auto-partitions (:215-217), `GPipe(model,
+balance, chunks=MICROBATCHES)` (:219) runs a clock-cycle schedule moving
+micro-batch j through partition k with per-stage CUDA streams, stash/pop skip
+connections across partitions, synchronous flush at the step end.
+
+TPU-native design — the whole schedule is ONE compiled XLA program:
+
+* mesh axes ``('data', 'stage')``; stage s's parameters live on its mesh row as
+  a row of a packed ``[S, L]`` matrix (parallel/packing.py);
+* `lax.scan` over the M + S - 1 clock ticks; each tick every device runs its
+  stage via `lax.switch` and hands its activation to the right neighbor with
+  `lax.ppermute` — the TPU analog of the reference's stream copies
+  (SURVEY.md §3.4);
+* the backward pipeline is not hand-written: `jax.grad` through the
+  scan+ppermute forward yields the reversed schedule automatically (ppermute
+  transposes to the opposite permutation), and `jax.checkpoint` on each stage
+  reproduces torchgpipe's per-(microbatch, stage) activation checkpointing;
+* hybrid PPxDP comes from the 'data' mesh axis: batches shard across it and
+  shard_map's transpose machinery inserts the gradient all-reduce over ICI.
+
+There is no stash/pop skip machinery: residual blocks are pipeline-atomic
+layers (models/layers.py), so skips never cross a stage boundary.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+try:  # jax >= 0.4.35 exposes shard_map at top level
+    from jax import shard_map as _shard_map
+except ImportError:  # pragma: no cover
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
+from ddlbench_tpu.parallel.common import cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.packing import (
+    balanced_stage_bounds,
+    layer_flop_costs,
+    pack_stages,
+    pad_vec,
+)
+
+
+_PIPE_AXES = ("data", "stage")
+
+
+def _vary(v, axes=_PIPE_AXES):
+    """Mark v as varying over any of `axes` it isn't already varying over.
+
+    shard_map's VMA type system requires lax.switch branches (and scan carries)
+    to agree on varying-axes; constants (jnp.zeros) start invariant.
+    """
+    cur = jax.typeof(v).vma
+    missing = tuple(a for a in axes if a not in cur)
+    return lax.pcast(v, missing, to="varying") if missing else v
+
+
+class PipeTrainState(NamedTuple):
+    params: jax.Array  # [S, L] f32, P('stage', None)
+    model_state: jax.Array  # [S, Ls] f32, P('stage', None)
+    momentum: jax.Array  # [S, L] f32, P('stage', None)
+
+
+def make_pipe_mesh(num_stages: int, dp_replicas: int,
+                   devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
+    devs = list(devices or jax.devices())
+    need = num_stages * dp_replicas
+    if len(devs) < need:
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    arr = np.array(devs[:need]).reshape(dp_replicas, num_stages)
+    return Mesh(arr, axis_names=("data", "stage"))
+
+
+class GPipeStrategy:
+    """strategy='gpipe': synchronous micro-batch pipeline over a 'stage' mesh axis."""
+
+    def __init__(self, model: LayerModel, cfg: RunConfig,
+                 mesh: Optional[Mesh] = None,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 stage_bounds: Optional[List[int]] = None):
+        self.model = model
+        self.cfg = cfg
+        self.num_stages = cfg.resolved_stages()
+        self.dp = max(1, cfg.dp_replicas)
+        self.mesh = mesh or make_pipe_mesh(self.num_stages, self.dp, devices)
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        self.mb, self.num_microbatches = cfg.resolved_batches()
+        self._stage_bounds_override = stage_bounds
+        self._built = False
+        self._mom = cfg.resolved_momentum()
+        self._wd = cfg.resolved_weight_decay()
+
+    # -- initialization ----------------------------------------------------
+
+    def init(self, key) -> PipeTrainState:
+        params_list, state_list, shapes = init_model(self.model, key)
+        S = self.num_stages
+        bounds = getattr(self, "bounds", None)
+        if bounds is None:
+            if self._stage_bounds_override is not None:
+                bounds = list(self._stage_bounds_override)
+            else:
+                costs = layer_flop_costs(params_list, shapes)
+                bounds = balanced_stage_bounds(costs, S)
+            assert len(bounds) == S + 1 and bounds[0] == 0 and bounds[-1] == len(self.model.layers)
+            self.bounds = bounds
+            self.shapes = shapes
+
+        params_mat, p_unravels, p_lens = pack_stages(
+            [params_list[bounds[s]:bounds[s + 1]] for s in range(S)]
+        )
+        state_mat, s_unravels, s_lens = pack_stages(
+            [state_list[bounds[s]:bounds[s + 1]] for s in range(S)]
+        )
+
+        if not self._built:
+            self._p_unravels, self._p_lens = p_unravels, p_lens
+            self._s_unravels, self._s_lens = s_unravels, s_lens
+            # Per-device activation buffer: the largest activation crossing a
+            # stage boundary for one microbatch (per data replica).
+            interior = [
+                self.mb * math.prod(shapes[bounds[s]]) for s in range(1, S)
+            ]
+            self._act_size = max(interior) if interior else 1
+            self._build_steps()
+
+        sharding = NamedSharding(self.mesh, P("stage", None))
+        params_mat = jax.device_put(params_mat, sharding)
+        state_mat = jax.device_put(state_mat, sharding)
+        momentum = jnp.zeros_like(params_mat)
+        return PipeTrainState(params_mat, state_mat, momentum)
+
+    # -- stage branch construction ----------------------------------------
+
+    def _make_branch(self, s: int, train: bool):
+        """Branch for lax.switch: identical signature across stages."""
+        S, M, mb, A = self.num_stages, self.num_microbatches, self.mb, self._act_size
+        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
+        in_shape = self.shapes[self.bounds[s]]
+        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
+        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
+        cdtype = self.compute_dtype
+        num_classes = self.model.num_classes
+        last = s == S - 1
+
+        def branch(param_row, state_row, x_buf, xs, ys, t):
+            m = jnp.clip(t - s, 0, M - 1)
+            if s == 0:
+                x = lax.dynamic_index_in_dim(xs, m, keepdims=False)
+            else:
+                x = x_buf[: mb * math.prod(in_shape)].reshape(mb, *in_shape)
+            params = cast_params(p_unravel(param_row[:p_len]), cdtype)
+            states = s_unravel(state_row[:s_len])
+            y, new_states = apply_slice(layers, params, states, x.astype(cdtype), train)
+            if last:
+                labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                loss = cross_entropy_loss(y, labels)
+                correct = jnp.sum(
+                    (jnp.argmax(y, -1) == labels).astype(jnp.int32)
+                )
+                y_out = jnp.zeros((A,), cdtype)
+            else:
+                loss = jnp.zeros((), jnp.float32)
+                correct = jnp.zeros((), jnp.int32)
+                y_out = pad_vec(y.astype(cdtype), A)
+            new_state_row = pad_vec(
+                ravel_pytree(new_states)[0].astype(jnp.float32),
+                state_row.shape[0],
+            )
+            # Constant-valued outputs (zeros) carry no varying-axes annotation;
+            # normalize every output's VMA type so lax.switch branches agree.
+            return (_vary(y_out), _vary(new_state_row), _vary(loss), _vary(correct))
+
+        if train and self.cfg.remat_stages:
+            branch = jax.checkpoint(branch)
+        return branch
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _build_steps(self):
+        S, M, mb, A = self.num_stages, self.num_microbatches, self.mb, self._act_size
+        dp = self.dp
+        mesh = self.mesh
+
+        def make_pipe_fn(train: bool):
+            branches = [self._make_branch(s, train) for s in range(S)]
+            perm = [(i, i + 1) for i in range(S - 1)]
+
+            def inner(params_rows, state_rows, xs, ys):
+                # params_rows [1, L]; state_rows [1, Ls]; xs [M, mb, ...]; ys [M, mb]
+                # Mark everything varying over both mesh axes up front so all
+                # switch branches produce identical VMA types; the pcast on
+                # params transposes to the gradient psum over 'data' (the DP
+                # all-reduce) in the backward pass.
+                param_row = _vary(params_rows[0])
+                state_row = _vary(state_rows[0])
+                xs = _vary(xs)
+                ys = _vary(ys)
+                s_idx = lax.axis_index("stage")
+                T = M + S - 1
+
+                def body(carry, t):
+                    x_buf, st_row, loss_acc, corr_acc = carry
+                    y_buf, new_st, loss_mb, corr_mb = lax.switch(
+                        s_idx, branches, param_row, st_row, x_buf, xs, ys, t
+                    )
+                    m_idx = t - s_idx
+                    valid = (m_idx >= 0) & (m_idx < M)
+                    st_row = jnp.where(valid, new_st, st_row)
+                    loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
+                    corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
+                    if perm:
+                        x_next = lax.ppermute(y_buf, "stage", perm)
+                    else:
+                        x_next = y_buf
+                    return (x_next, st_row, loss_acc, corr_acc), None
+
+                init_carry = (
+                    _vary(jnp.zeros((A,), self.compute_dtype)),
+                    state_row,
+                    _vary(jnp.zeros((), jnp.float32)),
+                    _vary(jnp.zeros((), jnp.int32)),
+                )
+                (x_buf, st_row, loss_acc, corr_acc), _ = lax.scan(
+                    body, init_carry, jnp.arange(T)
+                )
+                # Loss lives on the last stage only; make it global.
+                loss = lax.psum(loss_acc, "stage") / M
+                loss = lax.pmean(loss, "data")
+                correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+                # Sync BN running stats across data replicas (sync-BN choice,
+                # documented deviation — SURVEY.md §7).
+                st_row = lax.pmean(st_row, "data")
+                return loss, st_row[None], correct
+
+            return _shard_map(
+                inner,
+                mesh=mesh,
+                in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
+                out_specs=(P(), P("stage", None), P()),
+            )
+
+        pipe_train = make_pipe_fn(train=True)
+        pipe_eval = make_pipe_fn(train=False)
+        mom, wd = self._mom, self._wd
+        total = M * mb * dp
+
+        def train_step(ts: PipeTrainState, xs, ys, lr):
+            def loss_fn(params_mat):
+                loss, new_state, correct = pipe_train(params_mat, ts.model_state, xs, ys)
+                return loss, (new_state, correct)
+
+            (loss, (new_state, correct)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            g = grads + wd * ts.params if wd else grads
+            momentum = mom * ts.momentum + g
+            params = ts.params - lr * momentum
+            metrics = {
+                "loss": loss,
+                "accuracy": correct.astype(jnp.float32) / total,
+            }
+            return PipeTrainState(params, new_state, momentum), metrics
+
+        def eval_step(ts: PipeTrainState, xs, ys):
+            loss, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
+            return {
+                "loss": loss,
+                "correct": correct,
+                "count": jnp.asarray(total, jnp.int32),
+            }
+
+        stage_sh = NamedSharding(self.mesh, P("stage", None))
+        batch_sh_x = NamedSharding(self.mesh, P(None, "data"))
+        ts_sh = PipeTrainState(stage_sh, stage_sh, stage_sh)
+        self.train_step = jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(ts_sh, batch_sh_x, batch_sh_x, None),
+        )
+        self.eval_step = jax.jit(
+            eval_step, in_shardings=(ts_sh, batch_sh_x, batch_sh_x)
+        )
+        self._batch_sharding = batch_sh_x
+        self._built = True
+
+    # -- data placement ----------------------------------------------------
+
+    def shard_batch(self, x, y):
+        """Global batch [M*mb*dp, ...] -> [M, mb*dp, ...] sharded over 'data'."""
+        M, mb, dp = self.num_microbatches, self.mb, self.dp
+        x = x.reshape(M, dp * mb, *x.shape[1:])
+        y = y.reshape(M, dp * mb)
+        return (
+            jax.device_put(x, self._batch_sharding),
+            jax.device_put(y, self._batch_sharding),
+        )
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size

@@ -1,0 +1,126 @@
+"""Stage packing: heterogeneous per-stage pytrees as one sharded matrix.
+
+TPU pipeline parallelism wants SPMD: every device runs the same program over a
+mesh 'stage' axis, with `lax.switch(stage_index, ...)` selecting that device's
+stage computation. But a CNN's stages have *heterogeneous* parameter pytrees
+(different conv shapes per stage), which cannot be stacked into one
+mesh-shardable array directly.
+
+The trick: flatten each stage's pytree to a single f32 vector
+(`jax.flatten_util.ravel_pytree`), right-pad every vector to the longest one,
+and stack into a ``[num_stages, max_len]`` matrix sharded ``P('stage')`` — each
+device holds exactly its own stage's parameters (plus padding). Each switch
+branch closes over its stage's ``unravel`` to reconstruct the pytree from its
+row. SGD/momentum updates apply elementwise to the packed matrix, so the
+optimizer is stage-agnostic, and weight-version stashing (PipeDream) is just a
+leading axis on the same matrix.
+
+Activations crossing stage boundaries get the same treatment: padded flat
+vectors of the largest boundary, so `lax.ppermute` moves one fixed-shape buffer
+between neighbors.
+
+This replaces the reference's per-stage generated Python modules
+(pipedream-fork/optimizer/convert_graph_to_model.py:224-329): partition = data,
+not source code.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Callable, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax.flatten_util import ravel_pytree
+
+
+def pack_stage(tree: Any) -> Tuple[jax.Array, Callable[[jax.Array], Any], int]:
+    """Flatten one stage's pytree. Returns (vec_f32, unravel, true_len)."""
+    vec, unravel = ravel_pytree(tree)
+    if vec.size == 0:
+        vec = jnp.zeros((1,), jnp.float32)
+        empty_unravel = unravel
+
+        def unravel_empty(v, _u=empty_unravel):
+            return _u(v[:0])
+
+        return vec.astype(jnp.float32), unravel_empty, 0
+    return vec.astype(jnp.float32), unravel, int(vec.size)
+
+
+def pack_stages(stage_trees: Sequence[Any]):
+    """Pack a list of per-stage pytrees into ([S, L] matrix, unravels, lens).
+
+    ``unravels[s]`` maps a length-``lens[s]`` prefix of row ``s`` back to the
+    stage's pytree.
+    """
+    vecs, unravels, lens = [], [], []
+    for tree in stage_trees:
+        v, u, n = pack_stage(tree)
+        vecs.append(v)
+        unravels.append(u)
+        lens.append(n)
+    max_len = max(max(lens), 1)
+    mat = jnp.stack([jnp.pad(v, (0, max_len - v.size)) for v in vecs])
+    return mat, unravels, lens
+
+
+def unpack_row(row: jax.Array, unravel: Callable, true_len: int) -> Any:
+    return unravel(row[:true_len]) if true_len else unravel(row)
+
+
+def pad_vec(vec: jax.Array, size: int) -> jax.Array:
+    return jnp.pad(vec.reshape(-1), (0, size - vec.size))
+
+
+def balanced_stage_bounds(costs: Sequence[float], num_stages: int) -> List[int]:
+    """Split a chain of per-layer costs into contiguous stages minimizing the
+    max stage cost (the load-balance objective of torchgpipe's balance_by_time,
+    benchmark/mnist/mnist_gpipe.py:215-217). Exact DP; n is small.
+
+    Returns bounds of length num_stages+1 with bounds[0]=0, bounds[-1]=n.
+    """
+    n = len(costs)
+    if num_stages >= n:
+        # degenerate: one layer per stage, pad trailing bounds
+        return list(range(n + 1)) + [n] * (num_stages - n)
+    prefix = [0.0]
+    for c in costs:
+        prefix.append(prefix[-1] + float(c))
+
+    def span(i, j):  # cost of layers [i, j)
+        return prefix[j] - prefix[i]
+
+    INF = float("inf")
+    # dp[k][j] = min over splits of max-load using k stages for first j layers
+    dp = [[INF] * (n + 1) for _ in range(num_stages + 1)]
+    cut = [[0] * (n + 1) for _ in range(num_stages + 1)]
+    dp[0][0] = 0.0
+    for k in range(1, num_stages + 1):
+        for j in range(k, n + 1):
+            for i in range(k - 1, j):
+                v = max(dp[k - 1][i], span(i, j))
+                if v < dp[k][j]:
+                    dp[k][j] = v
+                    cut[k][j] = i
+    bounds = [n]
+    j = n
+    for k in range(num_stages, 0, -1):
+        j = cut[k][j]
+        bounds.append(j)
+    return bounds[::-1]
+
+
+def layer_flop_costs(params_list: Sequence[Any], shapes: Sequence[Tuple[int, ...]]) -> List[float]:
+    """Analytic per-layer FLOP estimate for load balancing.
+
+    For convolutions FLOPs = 2 * n_params * out_H * out_W (exact for dense
+    layers with spatial=1), which is what dominates these CNNs. ``shapes`` are
+    the per-example boundary shapes from init_model.
+    """
+    costs = []
+    for p, out_shape in zip(params_list, shapes[1:]):
+        n_params = sum(int(x.size) for x in jax.tree.leaves(p))
+        spatial = math.prod(out_shape[:-1]) if len(out_shape) > 1 else 1
+        costs.append(max(1.0, 2.0 * n_params * spatial))
+    return costs
