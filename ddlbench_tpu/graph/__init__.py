@@ -1,0 +1,3 @@
+from ddlbench_tpu.graph.graph import Graph, Node
+
+__all__ = ["Graph", "Node"]

@@ -1,0 +1,245 @@
+"""Profile-graph IR: a text-serializable weighted DAG of model layers.
+
+Capability parity with the reference's graph IR
+(pipedream-fork/graph/graph.py): nodes carry per-layer forward/backward compute
+times, activation and parameter sizes, and an optional stage_id; the graph
+supports topological sort, predecessor/successor queries, antichain-DAG
+construction (the partitioner's state space, graph.py:350-449), partitioning by
+stage_id (:117-137), and a text round-trip (:451-480) kept line-compatible with
+the reference's ``graph.txt`` format so its downstream tooling could parse our
+profiles:
+
+    node{id} -- {desc} -- forward_compute_time={f}, backward_compute_time={b},
+        activation_size={a}, parameter_size={p}[ -- stage_id={s}]
+    \\tnode{src} -- node{dst}
+
+(one line per node, one tab-prefixed line per edge).
+
+In this framework models are flat layer chains by construction
+(models/layers.py), so profile graphs are chains and every maximal antichain is
+a singleton; the general-DAG algorithms are kept because the IR is also the
+import path for externally produced graphs (e.g. the reference's own fixtures).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import re
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+
+@dataclasses.dataclass
+class Node:
+    node_id: str
+    node_desc: str
+    forward_compute_time: float = 0.0  # ms
+    backward_compute_time: float = 0.0  # ms
+    activation_size: float = 0.0  # bytes (output activation)
+    parameter_size: float = 0.0  # bytes
+    stage_id: Optional[int] = None
+
+    def __str__(self) -> str:
+        s = (
+            f"node{self.node_id} -- {self.node_desc} -- "
+            f"forward_compute_time={self.forward_compute_time:.3f}, "
+            f"backward_compute_time={self.backward_compute_time:.3f}, "
+            f"activation_size={self.activation_size:.3f}, "
+            f"parameter_size={self.parameter_size:.3f}"
+        )
+        if self.stage_id is not None:
+            s += f" -- stage_id={self.stage_id}"
+        return s
+
+    _LINE_RE = re.compile(
+        r"node(?P<id>\S+) -- (?P<desc>.*) -- "
+        r"forward_compute_time=(?P<f>[-\d.e]+), "
+        r"backward_compute_time=(?P<b>[-\d.e]+), "
+        r"activation_size=(?P<a>[-\d.e+]+), "
+        r"parameter_size=(?P<p>[-\d.e+]+?)"
+        r"(?: -- stage_id=(?P<stage>\d+))?$"
+    )
+
+    @classmethod
+    def from_str(cls, line: str) -> "Node":
+        m = cls._LINE_RE.match(line.strip())
+        if not m:
+            raise ValueError(f"unparseable node line: {line!r}")
+        return cls(
+            node_id=m.group("id"),
+            node_desc=m.group("desc"),
+            forward_compute_time=float(m.group("f")),
+            backward_compute_time=float(m.group("b")),
+            activation_size=float(m.group("a")),
+            parameter_size=float(m.group("p")),
+            stage_id=int(m.group("stage")) if m.group("stage") else None,
+        )
+
+
+class Graph:
+    def __init__(self):
+        self.nodes: Dict[str, Node] = {}
+        self.edges: Dict[str, List[str]] = {}  # node_id -> successor ids
+        self.in_edges: Dict[str, List[str]] = {}  # node_id -> predecessor ids
+
+    # -- construction ------------------------------------------------------
+
+    def add_node(self, node: Node) -> None:
+        self.nodes[node.node_id] = node
+        self.edges.setdefault(node.node_id, [])
+        self.in_edges.setdefault(node.node_id, [])
+
+    def add_edge(self, src: str, dst: str) -> None:
+        self.edges.setdefault(src, []).append(dst)
+        self.in_edges.setdefault(dst, []).append(src)
+
+    @classmethod
+    def chain(cls, nodes: Sequence[Node]) -> "Graph":
+        g = cls()
+        for n in nodes:
+            g.add_node(n)
+        for a, b in zip(nodes, nodes[1:]):
+            g.add_edge(a.node_id, b.node_id)
+        return g
+
+    # -- queries -----------------------------------------------------------
+
+    def sources(self) -> List[Node]:
+        return [self.nodes[i] for i in self.nodes if not self.in_edges.get(i)]
+
+    def sinks(self) -> List[Node]:
+        return [self.nodes[i] for i in self.nodes if not self.edges.get(i)]
+
+    def topological_sort(self) -> List[Node]:
+        indeg = {i: len(self.in_edges.get(i, [])) for i in self.nodes}
+        # stable: seed with insertion order
+        ready = [i for i in self.nodes if indeg[i] == 0]
+        order: List[Node] = []
+        while ready:
+            i = ready.pop(0)
+            order.append(self.nodes[i])
+            for j in self.edges.get(i, []):
+                indeg[j] -= 1
+                if indeg[j] == 0:
+                    ready.append(j)
+        if len(order) != len(self.nodes):
+            raise ValueError("graph has a cycle")
+        return order
+
+    def predecessors(self, node_id: str) -> Set[str]:
+        """All transitive predecessors."""
+        seen: Set[str] = set()
+        stack = list(self.in_edges.get(node_id, []))
+        while stack:
+            i = stack.pop()
+            if i not in seen:
+                seen.add(i)
+                stack.extend(self.in_edges.get(i, []))
+        return seen
+
+    def successors(self, node_id: str) -> Set[str]:
+        seen: Set[str] = set()
+        stack = list(self.edges.get(node_id, []))
+        while stack:
+            i = stack.pop()
+            if i not in seen:
+                seen.add(i)
+                stack.extend(self.edges.get(i, []))
+        return seen
+
+    def is_chain(self) -> bool:
+        return all(len(v) <= 1 for v in self.edges.values()) and all(
+            len(v) <= 1 for v in self.in_edges.values()
+        )
+
+    # -- antichain DAG (partitioner state space) ---------------------------
+
+    def antichain_dag(self) -> Tuple[List[frozenset], Dict[frozenset, List[frozenset]]]:
+        """States of the partitioning DP: each state is an antichain (a set of
+        mutually incomparable nodes) representing a cut frontier; an edge moves
+        the frontier forward past one node. Returns (states in topological
+        order, adjacency). For chain graphs this is the chain of singletons.
+
+        Functional analog of reference graph.py:399-449 (next_antichains /
+        antichain_dag), computed as reachable frontier sets.
+        """
+        order = self.topological_sort()
+        start = frozenset(n.node_id for n in self.sources())
+        states: List[frozenset] = []
+        adj: Dict[frozenset, List[frozenset]] = {}
+        seen = {start}
+        queue = [start]
+        while queue:
+            st = queue.pop(0)
+            states.append(st)
+            adj[st] = []
+            # advance: pick a node in the frontier whose successors' other
+            # predecessors are already behind the frontier
+            behind = set()
+            for i in st:
+                behind |= self.predecessors(i)
+            behind |= st
+            for i in sorted(st):
+                for j in self.edges.get(i, []):
+                    if all(p in behind for p in self.in_edges.get(j, [])):
+                        nxt = frozenset((st - {i}) | {j})
+                        adj[st].append(nxt)
+                        if nxt not in seen:
+                            seen.add(nxt)
+                            queue.append(nxt)
+        return states, adj
+
+    # -- partitioning ------------------------------------------------------
+
+    def partition(self) -> List["Graph"]:
+        """Split into per-stage subgraphs by stage_id (reference graph.py:117-137)."""
+        stage_ids = sorted(
+            {n.stage_id for n in self.nodes.values() if n.stage_id is not None}
+        )
+        out = []
+        for sid in stage_ids:
+            sub = Graph()
+            members = {i for i, n in self.nodes.items() if n.stage_id == sid}
+            for i in members:
+                sub.add_node(self.nodes[i])
+            for i in members:
+                for j in self.edges.get(i, []):
+                    if j in members:
+                        sub.add_edge(i, j)
+            out.append(sub)
+        return out
+
+    # -- serialization -----------------------------------------------------
+
+    def __str__(self) -> str:
+        lines = [str(n) for n in self.topological_sort()]
+        for i in self.nodes:
+            for j in self.edges.get(i, []):
+                lines.append(f"\tnode{i} -- node{j}")
+        return "\n".join(lines)
+
+    @classmethod
+    def from_str(cls, text: str) -> "Graph":
+        g = cls()
+        edge_re = re.compile(r"\tnode(\S+) -- node(\S+)")
+        for line in text.splitlines():
+            if not line.strip():
+                continue
+            if line.startswith("\t"):
+                m = edge_re.match(line)
+                if not m:
+                    raise ValueError(f"unparseable edge line: {line!r}")
+                g.add_edge(m.group(1), m.group(2))
+            else:
+                g.add_node(Node.from_str(line))
+        return g
+
+    # -- aggregates --------------------------------------------------------
+
+    def total_compute(self) -> float:
+        return sum(
+            n.forward_compute_time + n.backward_compute_time
+            for n in self.nodes.values()
+        )
+
+    def total_parameter_bytes(self) -> float:
+        return sum(n.parameter_size for n in self.nodes.values())

@@ -191,72 +191,86 @@ class GPipeStrategy:
     # -- compiled steps ----------------------------------------------------
 
     def _build_steps(self):
-        S, M, mb, A = self.num_stages, self.num_microbatches, self.mb, self._act_size
-        dp = self.dp
+        stage_sh = NamedSharding(self.mesh, P("stage", None))
+        self._stage_sharding = stage_sh
+        self._batch_sharding = NamedSharding(self.mesh, P(None, "data"))
+        self.train_step = self._make_train_step()
+        self.eval_step = self._make_eval_step()
+        self._built = True
+
+    def _make_pipe_fn(self, train: bool):
+        """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval)."""
+        S, M, A = self.num_stages, self.num_microbatches, self._act_size
         mesh = self.mesh
+        branches = [self._make_branch(s, train) for s in range(S)]
+        perm = [(i, i + 1) for i in range(S - 1)]
 
-        def make_pipe_fn(train: bool):
-            branches = [self._make_branch(s, train) for s in range(S)]
-            perm = [(i, i + 1) for i in range(S - 1)]
+        def inner(params_rows, state_rows, xs, ys):
+            # params_rows [1, L]; state_rows [1, Ls]; xs [M, mb, ...]; ys [M, mb]
+            # Mark everything varying over both mesh axes up front so all
+            # switch branches produce identical VMA types; the pcast on
+            # params transposes to the gradient psum over 'data' (the DP
+            # all-reduce) in the backward pass.
+            param_row = _vary(params_rows[0])
+            state_row = _vary(state_rows[0])
+            xs = _vary(xs)
+            ys = _vary(ys)
+            s_idx = lax.axis_index("stage")
+            T = M + S - 1
 
-            def inner(params_rows, state_rows, xs, ys):
-                # params_rows [1, L]; state_rows [1, Ls]; xs [M, mb, ...]; ys [M, mb]
-                # Mark everything varying over both mesh axes up front so all
-                # switch branches produce identical VMA types; the pcast on
-                # params transposes to the gradient psum over 'data' (the DP
-                # all-reduce) in the backward pass.
-                param_row = _vary(params_rows[0])
-                state_row = _vary(state_rows[0])
-                xs = _vary(xs)
-                ys = _vary(ys)
-                s_idx = lax.axis_index("stage")
-                T = M + S - 1
-
-                def body(carry, t):
-                    x_buf, st_row, loss_acc, corr_acc = carry
-                    y_buf, new_st, loss_mb, corr_mb = lax.switch(
-                        s_idx, branches, param_row, st_row, x_buf, xs, ys, t
-                    )
-                    m_idx = t - s_idx
-                    valid = (m_idx >= 0) & (m_idx < M)
-                    st_row = jnp.where(valid, new_st, st_row)
-                    loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
-                    corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
-                    if perm:
-                        x_next = lax.ppermute(y_buf, "stage", perm)
-                    else:
-                        x_next = y_buf
-                    return (x_next, st_row, loss_acc, corr_acc), None
-
-                init_carry = (
-                    _vary(jnp.zeros((A,), self.compute_dtype)),
-                    state_row,
-                    _vary(jnp.zeros((), jnp.float32)),
-                    _vary(jnp.zeros((), jnp.int32)),
+            def body(carry, t):
+                x_buf, st_row, loss_acc, corr_acc = carry
+                y_buf, new_st, loss_mb, corr_mb = lax.switch(
+                    s_idx, branches, param_row, st_row, x_buf, xs, ys, t
                 )
-                (x_buf, st_row, loss_acc, corr_acc), _ = lax.scan(
-                    body, init_carry, jnp.arange(T)
-                )
-                # Loss lives on the last stage only; make it global.
-                loss = lax.psum(loss_acc, "stage") / M
-                loss = lax.pmean(loss, "data")
-                correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
-                # Sync BN running stats across data replicas (sync-BN choice,
-                # documented deviation — SURVEY.md §7).
-                st_row = lax.pmean(st_row, "data")
-                return loss, st_row[None], correct
+                m_idx = t - s_idx
+                valid = (m_idx >= 0) & (m_idx < M)
+                st_row = jnp.where(valid, new_st, st_row)
+                loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
+                corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
+                if perm:
+                    x_next = lax.ppermute(y_buf, "stage", perm)
+                else:
+                    x_next = y_buf
+                return (x_next, st_row, loss_acc, corr_acc), None
 
-            return _shard_map(
-                inner,
-                mesh=mesh,
-                in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
-                out_specs=(P(), P("stage", None), P()),
+            init_carry = (
+                _vary(jnp.zeros((A,), self.compute_dtype)),
+                state_row,
+                _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.int32)),
             )
+            (x_buf, st_row, loss_acc, corr_acc), _ = lax.scan(
+                body, init_carry, jnp.arange(T)
+            )
+            # Loss lives on the last stage only; make it global.
+            loss = lax.psum(loss_acc, "stage") / M
+            loss = lax.pmean(loss, "data")
+            correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+            # Sync BN running stats across data replicas (sync-BN choice,
+            # documented deviation — SURVEY.md §7).
+            st_row = lax.pmean(st_row, "data")
+            return loss, st_row[None], correct
 
-        pipe_train = make_pipe_fn(train=True)
-        pipe_eval = make_pipe_fn(train=False)
+        return _shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
+            out_specs=(P(), P("stage", None), P()),
+        )
+
+    @property
+    def _total_samples(self) -> int:
+        return self.num_microbatches * self.mb * self.dp
+
+    def _ts_sharding(self):
+        sh = self._stage_sharding
+        return PipeTrainState(sh, sh, sh)
+
+    def _make_train_step(self):
+        pipe_train = self._make_pipe_fn(train=True)
         mom, wd = self._mom, self._wd
-        total = M * mb * dp
+        total = self._total_samples
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
             def loss_fn(params_mat):
@@ -275,7 +289,18 @@ class GPipeStrategy:
             }
             return PipeTrainState(params, new_state, momentum), metrics
 
-        def eval_step(ts: PipeTrainState, xs, ys):
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding, None),
+        )
+
+    def _make_eval_step(self):
+        pipe_eval = self._make_pipe_fn(train=False)
+        total = self._total_samples
+
+        def eval_step(ts, xs, ys):
             loss, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
             return {
                 "loss": loss,
@@ -283,19 +308,11 @@ class GPipeStrategy:
                 "count": jnp.asarray(total, jnp.int32),
             }
 
-        stage_sh = NamedSharding(self.mesh, P("stage", None))
-        batch_sh_x = NamedSharding(self.mesh, P(None, "data"))
-        ts_sh = PipeTrainState(stage_sh, stage_sh, stage_sh)
-        self.train_step = jax.jit(
-            train_step,
-            donate_argnums=(0,),
-            in_shardings=(ts_sh, batch_sh_x, batch_sh_x, None),
+        return jax.jit(
+            eval_step,
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding),
         )
-        self.eval_step = jax.jit(
-            eval_step, in_shardings=(ts_sh, batch_sh_x, batch_sh_x)
-        )
-        self._batch_sharding = batch_sh_x
-        self._built = True
 
     # -- data placement ----------------------------------------------------
 

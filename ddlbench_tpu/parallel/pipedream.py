@@ -1,0 +1,355 @@
+"""Asynchronous 1F1B pipeline with weight stashing — the reference's PipeDream
+engine, TPU-native.
+
+Reference mechanism (pipedream-fork/): StageRuntime owns a stage and helper
+threads stream tensors between ranks (runtime/runtime.py, communication.py);
+the 1F1B loop runs num_warmup forwards then steady-state
+[forward; load_old_params; backward; load_new_params; step]
+(image_classification/main_with_runtime.py:432-494); weight stashing keeps
+num_versions = warmup+1 clones so backward uses the same weights as that
+minibatch's forward (runtime/optimizer.py:58-116); replicated stages are
+DDP-wrapped per stage (runtime.py:232-263).
+
+TPU-native design — the whole async schedule is ONE compiled XLA program:
+
+* Global clock of H = 2M + 2S - 2 half-ticks; at each half-tick a stage does
+  one forward, one backward, or idles, per the closed-form 1F1B timetable
+      F(s, f) = s + f + max(0, f - W_s)         W_s = S - 1 - s warmup count
+      B(s, b) = 2b + 2S - 1 - s
+  (derived from the reference's warmup/steady/drain loop). Forward activations
+  ppermute right; gradients ppermute left; a 2-slot queue absorbs the one
+  half-tick of skew between activation arrival and use.
+* Weight stashing: each stage carries its packed parameter vector plus a
+  [S, L] stash ring; forward f writes the vector it used into slot f mod S,
+  backward b reads slot b mod S — so backward grads are taken at exactly the
+  forward-time weights (OptimizerWithWeightStashing parity, but functional).
+* Backward is recompute-based: we stash the stage *input* (not the autograd
+  graph) and take jax.vjp of the stage at the stashed (weights, input). This
+  trades the reference's activation-stash memory for recompute FLOPs — the
+  TPU-friendly choice, and BN batch statistics are bit-identical on recompute.
+* The per-microbatch update runs right after each backward (update_interval=1
+  semantics); for replicated stages the gradient is psum'd over the 'data'
+  mesh axis first (the DDP-per-stage allreduce).
+* The reference's helper threads, CV queues, tensor tags, round-robin
+  messaging schedule, and gcd/LCM iteration fixes (communication.py:455-521,
+  runtime.py:663-690) have no analog: XLA's static schedule replaces all of
+  them, and each data-replica column exchanges only with its own column.
+
+Eval reuses the synchronous fill-drain pipeline from GPipeStrategy.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlbench_tpu.models.layers import apply_slice
+from ddlbench_tpu.parallel.common import cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.gpipe import GPipeStrategy, _shard_map, _vary
+from ddlbench_tpu.parallel.packing import pad_vec
+
+
+class PDTrainState(NamedTuple):
+    params: jax.Array  # [S, L] newest weights per stage
+    model_state: jax.Array  # [S, Ls] BN running stats
+    momentum: jax.Array  # [S, L]
+
+
+def fwd_mb_at(s: int, S: int, M: int, h):
+    """Microbatch index whose forward stage s runs at half-tick h (and validity).
+
+    Timetable (warmup W_s = S-1-s forwards, then one forward per backward):
+        F(s, f) = s + f          for f <= W_s   (fill)
+        F(s, f) = s + 2f         for f >  W_s   (steady 1F1B; parity s)
+        B(s, b) = 2b + 2S-1 - s                 (parity s+1 — never collides)
+    """
+    W = S - 1 - s
+    f_w = h - s
+    in_warm = (f_w >= 0) & (f_w <= W) & (f_w < M)
+    two_f = h - s
+    f_s = two_f // 2
+    in_steady = (two_f % 2 == 0) & (f_s > W) & (f_s < M)
+    f = jnp.where(in_warm, f_w, f_s)
+    return jnp.clip(f, 0, M - 1), in_warm | in_steady
+
+
+def bwd_mb_at(s: int, S: int, M: int, h):
+    two_b = h - (2 * S - 1 - s)
+    b = two_b // 2
+    valid = (two_b >= 0) & (two_b % 2 == 0) & (b < M)
+    return jnp.clip(b, 0, M - 1), valid
+
+
+class PipeDreamStrategy(GPipeStrategy):
+    """strategy='pipedream': async 1F1B + weight stashing over the stage mesh."""
+
+    # -- train step --------------------------------------------------------
+
+    def _ts_sharding(self):
+        sh = self._stage_sharding
+        return PDTrainState(sh, sh, sh)
+
+    def init(self, key) -> PDTrainState:
+        ts = super().init(key)
+        return PDTrainState(ts.params, ts.model_state, ts.momentum)
+
+    def _make_stage_fwd(self, s: int):
+        """Pure stage forward: (param_row, state_row, x) -> (y, new_state_row).
+
+        Unlike the gpipe branch this is vjp-friendly: no input unpacking from a
+        shared buffer, no loss; shapes are the stage's true shapes.
+        """
+        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
+        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
+        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
+        cdtype = self.compute_dtype
+
+        def stage_fwd(param_row, state_row, x):
+            params = cast_params(p_unravel(param_row[:p_len]), cdtype)
+            states = s_unravel(state_row[:s_len])
+            y, new_states = apply_slice(layers, params, states, x.astype(cdtype), True)
+            new_state_row = pad_vec(
+                ravel_pytree(new_states)[0].astype(jnp.float32), state_row.shape[0]
+            )
+            return y, new_state_row
+
+        return stage_fwd
+
+    def _make_train_step(self):
+        S, M, mb = self.num_stages, self.num_microbatches, self.mb
+        H = 2 * M + 2 * S - 2
+        NSLOT = min(S, M)
+        mom, wd = self._mom, self._wd
+        mesh = self.mesh
+        total = self._total_samples
+        cdtype = self.compute_dtype
+        fwd_perm = [(i, i + 1) for i in range(S - 1)]
+        bwd_perm = [(i + 1, i) for i in range(S - 1)]
+        stage_fwds = [self._make_stage_fwd(s) for s in range(S)]
+        in_shapes = [self.shapes[self.bounds[s]] for s in range(S)]
+        in_sizes = [mb * math.prod(sh) for sh in in_shapes]
+        # Unlike gpipe's interior-only buffer, the stash must also hold stage
+        # 0's input (for recompute), so size over ALL stage inputs.
+        A = max(in_sizes)
+
+        def make_branch(s: int):
+            stage_fwd = stage_fwds[s]
+            if self.cfg.remat_stages:
+                stage_fwd = jax.checkpoint(stage_fwd)
+            in_shape, in_size = in_shapes[s], in_sizes[s]
+            last = s == S - 1
+            W = S - 1 - s
+
+            def unpack_x(buf):
+                return buf[:in_size].reshape(mb, *in_shape)
+
+            def branch(carry, xs, ys, h, lr):
+                (params, momentum, st_row, stash_p, stash_x,
+                 fwd_q, g_buf, loss_acc, corr_acc) = carry
+
+                f, valid_f = fwd_mb_at(s, S, M, h)
+                b, valid_b = bwd_mb_at(s, S, M, h)
+
+                # ---- forward path (uses newest params; stashes them) ----
+                def do_fwd(op):
+                    params, st_row, stash_p, stash_x, fwd_q = op
+                    if s == 0:
+                        x = lax.dynamic_index_in_dim(xs, f, keepdims=False)
+                        x = x.astype(cdtype)
+                    else:
+                        x = unpack_x(lax.dynamic_index_in_dim(
+                            fwd_q, f % 2, keepdims=False))
+                    y, new_st = stage_fwd(params, st_row, x)
+                    if last:
+                        labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
+                        loss_mb = cross_entropy_loss(y, labels)
+                        corr_mb = jnp.sum((jnp.argmax(y, -1) == labels).astype(jnp.int32))
+                        y_out = jnp.zeros((A,), cdtype)
+                    else:
+                        loss_mb = jnp.zeros((), jnp.float32)
+                        corr_mb = jnp.zeros((), jnp.int32)
+                        y_out = pad_vec(y.astype(cdtype), A)
+                    slot = f % NSLOT
+                    stash_p = lax.dynamic_update_index_in_dim(stash_p, params, slot, 0)
+                    stash_x = lax.dynamic_update_index_in_dim(
+                        stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
+                    return jax.tree.map(
+                        _vary, (new_st, stash_p, stash_x, y_out, loss_mb, corr_mb))
+
+                def skip_fwd(op):
+                    params, st_row, stash_p, stash_x, fwd_q = op
+                    return jax.tree.map(
+                        _vary,
+                        (st_row, stash_p, stash_x, jnp.zeros((A,), cdtype),
+                         jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)))
+
+                st_row, stash_p, stash_x, y_out, loss_mb, corr_mb = lax.cond(
+                    valid_f, do_fwd, skip_fwd,
+                    (params, st_row, stash_p, stash_x, fwd_q),
+                )
+                loss_acc = loss_acc + loss_mb
+                corr_acc = corr_acc + corr_mb
+
+                # ---- backward path (stashed weights + stashed input) ----
+                def do_bwd(op):
+                    params, momentum, st_row, stash_p, stash_x, g_buf = op
+                    slot = b % NSLOT
+                    p_st = lax.dynamic_index_in_dim(stash_p, slot, keepdims=False)
+                    x_st = unpack_x(lax.dynamic_index_in_dim(stash_x, slot, keepdims=False))
+                    if last:
+                        labels = lax.dynamic_index_in_dim(ys, b, keepdims=False)
+
+                        def loss_of(pv, xv):
+                            y, _ = stage_fwd(pv, st_row, xv)
+                            return cross_entropy_loss(y, labels)
+
+                        gp, gx = jax.grad(loss_of, argnums=(0, 1))(p_st, x_st)
+                    else:
+                        def fwd_of(pv, xv):
+                            y, _ = stage_fwd(pv, st_row, xv)
+                            return y
+
+                        g_in = unpack_g(g_buf)
+                        y, vjp_fn = jax.vjp(fwd_of, p_st, x_st)
+                        gp, gx = vjp_fn(g_in.astype(y.dtype))
+                    # DDP-per-stage parity: sync grads across stage replicas.
+                    gp = lax.psum(gp, "data")
+                    gx_out = pad_vec(gx.astype(cdtype), A)
+                    g = gp.astype(jnp.float32)
+                    if wd:
+                        g = g + wd * params
+                    momentum = mom * momentum + g
+                    params = params - lr * momentum
+                    return jax.tree.map(_vary, (params, momentum, gx_out))
+
+                def skip_bwd(op):
+                    params, momentum, st_row, stash_p, stash_x, g_buf = op
+                    return jax.tree.map(
+                        _vary, (params, momentum, jnp.zeros((A,), cdtype)))
+
+                # grad w.r.t. THIS stage's input; next tick it is consumed by
+                # stage s-1, whose output shape equals this stage's input.
+                def unpack_g(buf):
+                    if last:
+                        return None
+                    out_shape = self.shapes[self.bounds[s + 1]]
+                    out_size = mb * math.prod(out_shape)
+                    return buf[:out_size].reshape(mb, *out_shape)
+
+                params, momentum, gx_out = lax.cond(
+                    valid_b, do_bwd, skip_bwd,
+                    (params, momentum, st_row, stash_p, stash_x, g_buf),
+                )
+
+                out = (params, momentum, st_row, stash_p, stash_x,
+                       fwd_q, y_out, gx_out, loss_acc, corr_acc)
+                return jax.tree.map(_vary, out)
+
+            return branch
+
+        branches = [make_branch(s) for s in range(S)]
+
+        def inner(params_rows, state_rows, mom_rows, xs, ys, lr):
+            params = _vary(params_rows[0])
+            st_row = _vary(state_rows[0])
+            momentum = _vary(mom_rows[0])
+            xs = _vary(xs)
+            ys = _vary(ys)
+            s_idx = lax.axis_index("stage")
+            L = params.shape[0]
+            Ls = st_row.shape[0]
+
+            def body(carry, h):
+                (params, momentum, st_row, stash_p, stash_x,
+                 fwd_q, x_in, g_buf, loss_acc, corr_acc) = carry
+
+                # Absorb the activation that arrived this half-tick into the
+                # 2-slot queue, keyed by the producing stage's (s-1) schedule.
+                def absorb(s):
+                    fi, vi = fwd_mb_at(s - 1, S, M, h - 1) if s > 0 else (
+                        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.bool_))
+                    return fi, vi
+
+                # switch over stages for the absorb indices
+                fi_vi = lax.switch(
+                    s_idx,
+                    [(lambda s=s: (
+                        jax.tree.map(_vary, absorb(s))
+                    )) for s in range(S)],
+                )
+                f_in, valid_in = fi_vi
+                fwd_q = jnp.where(
+                    valid_in,
+                    lax.dynamic_update_index_in_dim(fwd_q, x_in, f_in % 2, 0),
+                    fwd_q,
+                )
+
+                carry2 = (params, momentum, st_row, stash_p, stash_x,
+                          fwd_q, g_buf, loss_acc, corr_acc)
+                (params, momentum, st_row, stash_p, stash_x, fwd_q,
+                 y_out, gx_out, loss_acc, corr_acc) = lax.switch(
+                    s_idx, branches, carry2, xs, ys, h, lr
+                )
+
+                if fwd_perm:
+                    x_in = lax.ppermute(y_out, "stage", fwd_perm)
+                    g_buf = lax.ppermute(gx_out, "stage", bwd_perm)
+                else:
+                    x_in = y_out
+                    g_buf = gx_out
+                return (params, momentum, st_row, stash_p, stash_x,
+                        fwd_q, x_in, g_buf, loss_acc, corr_acc), None
+
+            zeros_A = _vary(jnp.zeros((A,), cdtype))
+            init_carry = (
+                params, momentum, st_row,
+                _vary(jnp.zeros((NSLOT, L), jnp.float32)),
+                _vary(jnp.zeros((NSLOT, A), cdtype)),
+                _vary(jnp.zeros((2, A), cdtype)),
+                zeros_A,
+                zeros_A,
+                _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.int32)),
+            )
+            (params, momentum, st_row, *_rest, loss_acc, corr_acc) = lax.scan(
+                body, init_carry, jnp.arange(H)
+            )[0]
+            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
+            correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+            st_row = lax.pmean(st_row, "data")
+            # params/momentum identical across 'data' (grads psum'd pre-update).
+            params = lax.pmean(params, "data")
+            momentum = lax.pmean(momentum, "data")
+            return (params[None], st_row[None], momentum[None], loss, correct)
+
+        pipe = _shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(P("stage", None), P("stage", None), P("stage", None),
+                      P(None, "data"), P(None, "data"), P()),
+            out_specs=(P("stage", None), P("stage", None), P("stage", None),
+                       P(), P()),
+        )
+
+        def train_step(ts: PDTrainState, xs, ys, lr):
+            params, st, momentum, loss, correct = pipe(
+                ts.params, ts.model_state, ts.momentum, xs, ys, lr
+            )
+            metrics = {
+                "loss": loss,
+                "accuracy": correct.astype(jnp.float32) / total,
+            }
+            return PDTrainState(params, st, momentum), metrics
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding, None),
+        )

@@ -1,0 +1,13 @@
+from ddlbench_tpu.partition.optimizer import (
+    PartitionResult,
+    StagePlan,
+    partition_hierarchical,
+    stage_bounds_from_graph,
+)
+
+__all__ = [
+    "PartitionResult",
+    "StagePlan",
+    "partition_hierarchical",
+    "stage_bounds_from_graph",
+]
