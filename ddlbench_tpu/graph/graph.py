@@ -162,30 +162,37 @@ class Graph:
         Functional analog of reference graph.py:399-449 (next_antichains /
         antichain_dag), computed as reachable frontier sets.
         """
-        order = self.topological_sort()
-        start = frozenset(n.node_id for n in self.sources())
+        # An antichain A denotes the done-set D = A ∪ predecessors(A); moving to
+        # the next state admits one node n ∉ D whose predecessors are all in D,
+        # giving antichain {n} ∪ {a ∈ A : a ∉ predecessors(n)} (the maximal
+        # elements of D ∪ {n}).
+        pred_cache = {i: self.predecessors(i) for i in self.nodes}
+        starts = [n.node_id for n in self.sources()]
         states: List[frozenset] = []
         adj: Dict[frozenset, List[frozenset]] = {}
-        seen = {start}
-        queue = [start]
+        seen: Set[frozenset] = set()
+        queue: List[frozenset] = []
+        for s0 in starts:
+            st = frozenset({s0})
+            if st not in seen:
+                seen.add(st)
+                queue.append(st)
         while queue:
             st = queue.pop(0)
             states.append(st)
             adj[st] = []
-            # advance: pick a node in the frontier whose successors' other
-            # predecessors are already behind the frontier
-            behind = set()
+            done = set(st)
             for i in st:
-                behind |= self.predecessors(i)
-            behind |= st
-            for i in sorted(st):
-                for j in self.edges.get(i, []):
-                    if all(p in behind for p in self.in_edges.get(j, [])):
-                        nxt = frozenset((st - {i}) | {j})
-                        adj[st].append(nxt)
-                        if nxt not in seen:
-                            seen.add(nxt)
-                            queue.append(nxt)
+                done |= pred_cache[i]
+            for n in sorted(self.nodes):
+                if n in done:
+                    continue
+                if all(p in done for p in self.in_edges.get(n, [])):
+                    nxt = frozenset({n} | {a for a in st if a not in pred_cache[n]})
+                    adj[st].append(nxt)
+                    if nxt not in seen:
+                        seen.add(nxt)
+                        queue.append(nxt)
         return states, adj
 
     # -- partitioning ------------------------------------------------------

@@ -146,7 +146,9 @@ def partition_hierarchical(
     def mem_ok(i, j, r, versions_bound):
         if not memory_check:
             return True
-        need = (1 + versions_bound) * span_params(i, j) / r
+        # DP replication copies the full stage parameters onto every replica
+        # (it shards the batch, not the weights), so r does not divide memory.
+        need = (1 + versions_bound) * span_params(i, j)
         return need <= hw.hbm_bytes
 
     # ---- level 0: chips over ICI ----
@@ -170,7 +172,7 @@ def partition_hierarchical(
     # ---- level 1: hosts over DCN; a "unit" is one full host ----
     def stage_cost1(i, j, r):
         base = dp0.A[(i, j, chips_per_host)][0]
-        if base == INF or not mem_ok(i, j, r * chips_per_host, versions_bound=num_hosts):
+        if base == INF:
             return INF
         return base / r + _allreduce_ms(span_params(i, j), r, hw.dcn_bandwidth)
 

@@ -1,0 +1,3 @@
+from ddlbench_tpu.profiler.profile import profile_model
+
+__all__ = ["profile_model"]

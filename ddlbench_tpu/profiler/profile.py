@@ -1,0 +1,153 @@
+"""Per-layer profiler: layer chain -> weighted profile Graph.
+
+Capability parity with the reference's profiling stack (SURVEY.md §5.1), which
+needs THREE hook mechanisms plus a C++ autograd patch:
+* torchsummary forward hooks for shapes/params (torchsummary.py:30-105),
+* torchprofiler forward monkey-patches + cuda.synchronize and backward
+  pre/post hooks — requiring the pre_hook.patch PyTorch rebuild (D1) —
+  for per-layer fwd/bwd times (profiling.py:104-168),
+* torchgraph TensorWrapper propagation for dataflow (graph_creator.py:55-195).
+
+On TPU none of that machinery exists or is needed:
+* shapes/params come from init_model's shape chain (the model IS a chain),
+* per-layer times come from jitting each layer's forward and forward+backward
+  separately and timing with block_until_ready ("time" mode) — accepting that
+  XLA fusion makes per-layer attribution approximate (documented deviation,
+  SURVEY.md §7 "hard parts"), or from XLA HLO cost analysis divided by peak
+  FLOP/s ("flops" mode: deterministic, device-free, used in tests),
+* dataflow is the layer chain itself; jaxpr capture is available via
+  jax.make_jaxpr for diagnostics.
+
+Output is a Graph in the reference-compatible text format (graph/graph.py), and
+``profile_and_partition`` chains straight into the hierarchical optimizer —
+replacing the reference's profile -> bash-parsing -> optimizer -> codegen
+4-phase pipeline (run_template.sh:396-565) with two function calls.
+"""
+
+from __future__ import annotations
+
+import statistics
+import time
+from typing import Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.config import HardwareModel
+from ddlbench_tpu.graph.graph import Graph, Node
+from ddlbench_tpu.models.layers import LayerModel, init_model, param_bytes
+
+
+def _time_callable(fn, *args, repeats: int = 5, warmup: int = 2) -> float:
+    """Median wall-time of fn(*args) in ms, synchronized."""
+    for _ in range(warmup):
+        jax.block_until_ready(fn(*args))
+    samples = []
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        jax.block_until_ready(fn(*args))
+        samples.append((time.perf_counter() - t0) * 1000.0)
+    return statistics.median(samples)
+
+
+def _flops_of(fn, *args) -> float:
+    """FLOP estimate from XLA's cost analysis of the compiled fn."""
+    compiled = jax.jit(fn).lower(*args).compile()
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, list):
+            ca = ca[0]
+        return float(ca.get("flops", 0.0))
+    except Exception:
+        return 0.0
+
+
+def profile_model(
+    model: LayerModel,
+    batch_size: int,
+    mode: str = "time",
+    dtype=jnp.float32,
+    hw: Optional[HardwareModel] = None,
+    repeats: int = 5,
+    seed: int = 0,
+) -> Graph:
+    """Profile every layer; returns a chain Graph with per-node
+    forward/backward times (ms), activation sizes and parameter sizes (bytes).
+    """
+    hw = hw or HardwareModel()
+    params_list, state_list, shapes = init_model(model, jax.random.key(seed))
+    itemsize = jnp.dtype(dtype).itemsize
+    nodes = []
+    key = jax.random.key(seed + 1)
+    for idx, layer in enumerate(model.layers):
+        in_shape, out_shape = shapes[idx], shapes[idx + 1]
+        p, s = params_list[idx], state_list[idx]
+        key, sub = jax.random.split(key)
+        x = jax.random.normal(sub, (batch_size, *in_shape), dtype)
+
+        def fwd(p, x, _layer=layer, _s=s):
+            return _layer.apply(p, _s, x, True)[0]
+
+        def fwd_bwd(p, x, _fwd=fwd):
+            def scalar(p, x):
+                return jnp.sum(_fwd(p, x).astype(jnp.float32))
+
+            gp, gx = jax.grad(scalar, argnums=(0, 1))(p, x)
+            return gp, gx
+
+        if mode == "time":
+            f_ms = _time_callable(jax.jit(fwd), p, x, repeats=repeats)
+            fb_ms = _time_callable(jax.jit(fwd_bwd), p, x, repeats=repeats)
+            b_ms = max(fb_ms - f_ms, 0.0)
+        elif mode == "flops":
+            f_flops = _flops_of(fwd, p, x)
+            b_flops = 2.0 * f_flops  # dL/dw + dL/dx each cost ~one forward
+            f_ms = 1000.0 * f_flops / hw.peak_flops
+            b_ms = 1000.0 * b_flops / hw.peak_flops
+        else:
+            raise ValueError(f"unknown profile mode {mode!r}")
+
+        act_bytes = float(batch_size) * _prod(out_shape) * itemsize
+        nodes.append(
+            Node(
+                node_id=str(idx),
+                node_desc=layer.name,
+                forward_compute_time=f_ms,
+                backward_compute_time=b_ms,
+                activation_size=act_bytes,
+                parameter_size=float(param_bytes(p)),
+            )
+        )
+    return Graph.chain(nodes)
+
+
+def _prod(shape: Sequence[int]) -> float:
+    out = 1.0
+    for d in shape:
+        out *= d
+    return out
+
+
+def profile_and_partition(
+    model: LayerModel,
+    batch_size: int,
+    num_chips: int,
+    num_hosts: int = 1,
+    mode: str = "time",
+    hw: Optional[HardwareModel] = None,
+):
+    """profile -> hierarchical partition; returns (graph, PartitionResult).
+
+    One-call replacement for the reference's 4-phase PipeDream pipeline
+    (profiler main.py -> optimizer_graph_hierarchical.py -> bash stdout
+    parsing -> convert_graph_to_model.py)."""
+    from ddlbench_tpu.partition.optimizer import (
+        partition_hierarchical,
+        stamp_stage_ids,
+    )
+
+    hw = hw or HardwareModel()
+    graph = profile_model(model, batch_size, mode=mode, hw=hw)
+    result = partition_hierarchical(graph, num_chips, hw, num_hosts=num_hosts)
+    stamp_stage_ids(graph, result)
+    return graph, result
