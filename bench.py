@@ -1,0 +1,90 @@
+#!/usr/bin/env python
+"""Headline benchmark: ResNet-50 / synthetic ImageNet throughput on one chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+
+Baseline for vs_baseline: the reference framework's hardware is a GTX 1080 Ti
+(run_template.sh:416-419); the commonly reported ResNet-50/ImageNet fp32
+training throughput for that card is ~200 images/sec (batch 32). The reference
+repo publishes no numbers of its own (BASELINE.md), so vs_baseline =
+value / 200.0 against that documented figure.
+
+Usage: python bench.py [--quick] [--batch-size N] [--steps N] [--arch resnet50]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+
+REFERENCE_1080TI_RESNET50_IPS = 200.0
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--arch", default="resnet50")
+    p.add_argument("--benchmark", default="imagenet")
+    p.add_argument("--batch-size", type=int, default=128)
+    p.add_argument("--steps", type=int, default=30)
+    p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--quick", action="store_true", help="tiny run for smoke testing")
+    args = p.parse_args()
+
+    if args.quick:
+        args.batch_size, args.steps, args.warmup = 32, 5, 2
+
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.data.synthetic import make_synthetic
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(
+        benchmark=args.benchmark,
+        strategy="single",
+        arch=args.arch,
+        batch_size=args.batch_size,
+        compute_dtype=args.dtype,
+        steps_per_epoch=args.steps,
+    )
+    strategy = make_strategy(cfg)
+    data = make_synthetic(cfg.dataset(), args.batch_size, steps_per_epoch=args.steps)
+    ts = strategy.init(jax.random.key(cfg.seed))
+    lr = jnp.float32(cfg.resolved_lr())
+
+    # Warmup/compile. NOTE: sync via float() (device transfer) rather than
+    # block_until_ready — on the experimental axon TPU tunnel the latter can
+    # return before execution finishes, inflating throughput ~100x.
+    x, y = data.batch(0, 0)
+    for _ in range(args.warmup):
+        ts, m = strategy.train_step(ts, x, y, lr)
+    float(m["loss"])
+
+    t0 = time.perf_counter()
+    for step in range(args.steps):
+        x, y = data.batch(1, step)
+        ts, m = strategy.train_step(ts, x, y, lr)
+    float(m["loss"])  # sequential ts dependency forces the whole chain
+    dt = time.perf_counter() - t0
+
+    ips = args.steps * args.batch_size / dt
+    print(
+        json.dumps(
+            {
+                "metric": f"{args.arch}_{args.benchmark}_images_per_sec_per_chip",
+                "value": round(ips, 2),
+                "unit": "images/sec",
+                "vs_baseline": round(ips / REFERENCE_1080TI_RESNET50_IPS, 3),
+            }
+        )
+    )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -55,7 +55,7 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
         x, y = strategy.shard_batch(*data.batch(epoch=0, step=0))
         for _ in range(warmup_steps):
             ts_warm, m = strategy.train_step(ts_warm, x, y, jnp.float32(base_lr))
-        jax.block_until_ready(m["loss"])
+        float(m["loss"])  # device transfer = real sync (axon block_until_ready is lazy)
         del ts_warm
 
     ts = strategy.init(jax.random.key(cfg.seed))
@@ -72,7 +72,7 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             ts, metrics = strategy.train_step(ts, x, y, jnp.float32(lr))
             interval_samples += global_batch
             if (step + 1) % cfg.log_interval == 0 or step == steps - 1:
-                loss = float(jax.block_until_ready(metrics["loss"]))
+                loss = float(metrics["loss"])  # transfer = sync
                 loss_meter.update(loss)
                 now = time.perf_counter()
                 logger.train_interval(
@@ -82,7 +82,7 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
                     loss,
                 )
                 interval_tick, interval_samples = now, 0
-        jax.block_until_ready(jax.tree.leaves(ts.params)[0])
+        float(metrics["loss"])  # transfer = sync (ts chain forces all steps)
         epoch_time = time.perf_counter() - tick
         logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time)
 

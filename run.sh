@@ -1,0 +1,13 @@
+#!/usr/bin/env bash
+# Benchmark harness CLI — mirrors the reference's run/run/run.sh flag surface
+# (-b benchmark -f framework -g devices -m model -p loginterval -s real-data;
+# reference run.sh:16-47) but dispatches to the in-process Python CLI instead
+# of generating SLURM jobs: on TPU one process drives the whole mesh, so the
+# sbatch/ssh/mpirun layer (run_template.sh) has no equivalent.
+#
+# Examples:
+#   ./run.sh -b mnist -f single -m resnet18
+#   ./run.sh -b cifar10 -f dp -g 8 -m resnet50
+#   ./run.sh -b imagenet -f gpipe -g 4 -m vgg16
+set -euo pipefail
+exec python -m ddlbench_tpu.cli "$@"
