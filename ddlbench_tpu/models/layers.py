@@ -133,15 +133,20 @@ def conv2d(x, kernel, stride=1, padding="SAME", groups=1):
 def batchnorm(p, s, x, train: bool):
     """Returns (y, new_state). p = {scale, bias}; s = {mean, var}.
 
-    Statistics are computed in float32 regardless of compute dtype (bf16-safe).
+    Statistics accumulate in float32 (f32-accumulated reductions over the bf16
+    activations); normalization itself stays in the compute dtype so no f32
+    copy of the activation tensor is ever materialized in HBM.
     """
+    axes = tuple(range(x.ndim - 1))
     if train:
-        xf = x.astype(jnp.float32)
-        mean = jnp.mean(xf, axis=tuple(range(x.ndim - 1)))
-        var = jnp.var(xf, axis=tuple(range(x.ndim - 1)))
+        # One-pass stats; the f32 converts fuse into the reductions (no f32
+        # copy of x hits HBM, unlike a two-pass mean-then-var).
+        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+        mean2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes, dtype=jnp.float32)
+        var = jnp.maximum(mean2 - lax.square(mean), 0.0)
         # Running var uses the unbiased estimator (torch BatchNorm semantics);
         # normalization below uses the biased batch var, also matching torch.
-        n = xf.size // xf.shape[-1]
+        n = x.size // x.shape[-1]
         unbiased = var * (n / max(1, n - 1))
         new_s = {
             "mean": (1 - BN_MOMENTUM) * s["mean"] + BN_MOMENTUM * mean,
@@ -151,8 +156,9 @@ def batchnorm(p, s, x, train: bool):
         mean, var = s["mean"], s["var"]
         new_s = s
     inv = lax.rsqrt(var + BN_EPS) * p["scale"]
-    y = (x.astype(jnp.float32) - mean) * inv + p["bias"]
-    return y.astype(x.dtype), new_s
+    shift = p["bias"] - mean * inv
+    y = x * inv.astype(x.dtype) + shift.astype(x.dtype)
+    return y, new_s
 
 
 def bn_init(c):
