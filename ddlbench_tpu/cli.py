@@ -42,6 +42,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
+    p.add_argument("--checkpoint-dir", default=None,
+                   help="save a checkpoint per epoch here (orbax)")
+    p.add_argument("--resume", action="store_true",
+                   help="resume from the latest checkpoint in --checkpoint-dir")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh)")
@@ -66,6 +70,8 @@ def config_from_args(args) -> RunConfig:
         lr=args.lr,
         compute_dtype=args.dtype,
         seed=args.seed,
+        checkpoint_dir=args.checkpoint_dir,
+        resume=args.resume,
     )
 
 

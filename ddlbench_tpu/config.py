@@ -141,6 +141,11 @@ class RunConfig:
     remat_stages: bool = True
     seed: int = 1  # reference seeds torch.manual_seed(1) (imagenet_pytorch.py:58-66)
 
+    # Checkpoint/resume (reference: per-stage checkpoint.{stage}.pth.tar per
+    # epoch, main_with_runtime.py:580-584; resume :241-262).
+    checkpoint_dir: Optional[str] = None
+    resume: bool = False
+
     hardware: HardwareModel = dataclasses.field(default_factory=HardwareModel)
 
     # ---- derived ----

@@ -60,8 +60,31 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
 
     ts = strategy.init(jax.random.key(cfg.seed))
 
+    # Comm-volume accounting (RuntimeStats parity, SURVEY.md §5.5).
+    try:
+        from ddlbench_tpu.train.comm_stats import comm_stats
+
+        cs = comm_stats(strategy)
+        print(
+            f"comm volume/step: {cs['total_bytes'] / 1e6:.2f} MB "
+            f"(boundaries {cs['boundary_bytes'] / 1e6:.2f} MB, "
+            f"allreduce {cs['allreduce_bytes'] / 1e6:.2f} MB)",
+            flush=True,
+        )
+    except Exception:
+        pass
+
+    start_epoch = 1
+    if cfg.checkpoint_dir and cfg.resume:
+        from ddlbench_tpu.train.checkpoint import latest_epoch, restore_checkpoint
+
+        if latest_epoch(cfg.checkpoint_dir) is not None:
+            ep, ts = restore_checkpoint(cfg.checkpoint_dir, ts)
+            start_epoch = ep + 1
+            print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
+
     summary_acc = 0.0
-    for epoch in range(1, cfg.epochs + 1):
+    for epoch in range(start_epoch, cfg.epochs + 1):
         lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
         steps = data.steps_per_epoch(train=True)
         loss_meter = AverageMeter("loss")
@@ -90,6 +113,11 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
         val = evaluate(cfg, strategy, ts, data, epoch)
         logger.valid_epoch(epoch, val["loss"], val["accuracy"])
         summary_acc = val["accuracy"]
+
+        if cfg.checkpoint_dir:
+            from ddlbench_tpu.train.checkpoint import save_checkpoint
+
+            save_checkpoint(cfg.checkpoint_dir, epoch, ts)
 
     result = logger.summary(summary_acc)
     result["train_state"] = ts

@@ -1,0 +1,84 @@
+"""Checkpoint round-trip (incl. stage-sharded pipeline state), comm-volume
+accounting, and the network-summary tool."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models import get_model
+from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+from ddlbench_tpu.parallel.api import make_strategy
+from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+from ddlbench_tpu.train.checkpoint import latest_epoch, restore_checkpoint, save_checkpoint
+from ddlbench_tpu.train.comm_stats import comm_stats
+
+
+def test_checkpoint_roundtrip_single(tmp_path):
+    cfg = RunConfig(strategy="single", arch="resnet18", benchmark="mnist",
+                    compute_dtype="float32")
+    strat = make_strategy(cfg)
+    ts = strat.init(jax.random.key(0))
+    save_checkpoint(str(tmp_path), 1, ts)
+    # perturb, then restore over a fresh target
+    ts2 = strat.init(jax.random.key(7))
+    epoch, restored = restore_checkpoint(str(tmp_path), ts2)
+    assert epoch == 1
+    for a, b in zip(jax.tree.leaves(restored), jax.tree.leaves(ts)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+    assert latest_epoch(str(tmp_path)) == 1
+
+
+def tiny_model():
+    layers = [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)]
+    return LayerModel("tiny", layers, (4, 4, 1), 10)
+
+
+def test_checkpoint_roundtrip_stage_sharded(tmp_path, devices):
+    cfg = RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                    micro_batch_size=2, num_microbatches=2,
+                    compute_dtype="float32")
+    strat = GPipeStrategy(tiny_model(), cfg, stage_bounds=[0, 2, 3])
+    ts = strat.init(jax.random.key(0))
+    save_checkpoint(str(tmp_path), 2, ts)
+    ts2 = strat.init(jax.random.key(5))
+    epoch, restored = restore_checkpoint(str(tmp_path), ts2)
+    assert epoch == 2
+    np.testing.assert_array_equal(np.asarray(restored.params), np.asarray(ts.params))
+    # sharding preserved
+    assert restored.params.sharding == ts.params.sharding
+
+
+def test_comm_stats_dp(devices):
+    cfg = RunConfig(strategy="dp", num_devices=8, benchmark="mnist",
+                    arch="resnet18", compute_dtype="float32")
+    strat = make_strategy(cfg)
+    cs = comm_stats(strat)
+    # resnet18 mnist ~11.2M params x 4B x 2*(7/8)
+    assert 60e6 < cs["allreduce_bytes"] < 90e6
+    assert cs["boundary_bytes"] == 0.0
+
+
+def test_comm_stats_pipeline(devices):
+    cfg = RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                    micro_batch_size=2, num_microbatches=3,
+                    compute_dtype="float32")
+    strat = GPipeStrategy(tiny_model(), cfg, stage_bounds=[0, 2, 3])
+    strat.init(jax.random.key(0))
+    cs = comm_stats(strat)
+    # one interior boundary: shape (16,) x mb 2 x 4B x 2 dirs x 3 microbatches
+    assert cs["boundary_bytes"] == pytest.approx(16 * 2 * 4 * 2 * 3)
+    assert cs["allreduce_bytes"] == 0.0  # dp=1
+
+
+def test_summary_tool():
+    from ddlbench_tpu.tools.summary import summarize
+
+    out = summarize("resnet18", "mnist")
+    assert "group4_block2" in out
+    assert "total" in out
+    # param total matches known scale (~11.2M for mnist head)
+    total_line = out.strip().splitlines()[-1]
+    n = int(total_line.split()[-1].replace(",", ""))
+    assert 10e6 < n < 13e6
