@@ -1,0 +1,102 @@
+"""ctypes binding for the native (C++) partitioner DP core.
+
+Loads native/libpartitioner.so, building it with `make -C native` on first use
+if the toolchain is available; ddlbench_tpu.partition.optimizer falls back to
+the pure-Python DP when neither works, so the native core is an accelerator,
+not a dependency.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libpartitioner.so")
+
+_lib = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(
+                ["make", "-C", _NATIVE_DIR, "-s"],
+                check=True,
+                capture_output=True,
+                timeout=120,
+            )
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.solve_level.argtypes = [
+            ctypes.c_int, ctypes.c_int,
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.c_void_p,  # base_time or NULL
+            np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.solve_level.restype = None
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def solve_level_native(
+    times: np.ndarray,
+    params: np.ndarray,
+    acts: np.ndarray,
+    max_units: int,
+    bandwidth: float,
+    hbm_bytes: float,
+    versions_bound: int,
+    memory_check: bool,
+    base_time: Optional[np.ndarray] = None,
+) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
+    """Run one DP level natively. Returns (A, choice_k, choice_m) with shapes
+    [(n+1), (n+1), (max_units+1)]."""
+    lib = _load()
+    assert lib is not None
+    n = len(times)
+    shape = (n + 1, n + 1, max_units + 1)
+    A = np.full(shape, np.inf, np.float64)
+    ck = np.full(shape, -1, np.int32)
+    cm = np.full(shape, -1, np.int32)
+    bt_ptr = None
+    if base_time is not None:
+        base_time = np.ascontiguousarray(base_time, np.float64)
+        bt_ptr = base_time.ctypes.data_as(ctypes.c_void_p)
+    lib.solve_level(
+        n, max_units,
+        np.ascontiguousarray(times, np.float64),
+        np.ascontiguousarray(params, np.float64),
+        np.ascontiguousarray(acts, np.float64),
+        float(bandwidth), float(hbm_bytes), int(versions_bound),
+        int(bool(memory_check)), bt_ptr, A, ck, cm,
+    )
+    return A, ck, cm
+
+
+def backtrack(A: np.ndarray, ck: np.ndarray, cm: np.ndarray,
+              i: int, j: int, m: int):
+    """[(start, end, units)] from native choice tables."""
+    k, ml = int(ck[i, j, m]), int(cm[i, j, m])
+    if k < 0:
+        return [(i, j, m)]
+    return backtrack(A, ck, cm, i, k, m - ml) + [(k, j, ml)]

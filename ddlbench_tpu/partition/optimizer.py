@@ -112,13 +112,22 @@ def partition_hierarchical(
     hw: Optional[HardwareModel] = None,
     num_hosts: int = 1,
     memory_check: bool = True,
+    use_native: bool = True,
 ) -> PartitionResult:
     """Partition a (chain) profile graph over num_chips, optionally across hosts.
 
     Level 0: chips within a host/slice over ICI; level 1 (if num_hosts > 1):
-    hosts over DCN.
+    hosts over DCN. With use_native (default), the DP levels run in the C++
+    core (native/partitioner.cpp via ctypes) when it is buildable, falling
+    back to this module's pure-Python DP otherwise; both implement the same
+    recurrence and cost model.
     """
     hw = hw or HardwareModel()
+    if use_native:
+        from ddlbench_tpu.partition import native
+
+        if native.available():
+            return _partition_native(graph, num_chips, hw, num_hosts, memory_check)
     order = graph.topological_sort()
     n = len(order)
     times = [nd.forward_compute_time + nd.backward_compute_time for nd in order]
@@ -189,6 +198,50 @@ def partition_hierarchical(
             stages.append(StagePlan(a, b, r_chips * r_hosts))
     time = dp1.A[(0, n, num_hosts)][0]
     return PartitionResult(stages, time, sum(s.replication for s in stages))
+
+
+def _partition_native(graph: Graph, num_chips: int, hw: HardwareModel,
+                      num_hosts: int, memory_check: bool) -> PartitionResult:
+    import numpy as np
+
+    from ddlbench_tpu.partition import native
+
+    order = graph.topological_sort()
+    n = len(order)
+    times = np.array([nd.forward_compute_time + nd.backward_compute_time for nd in order])
+    params = np.array([nd.parameter_size for nd in order])
+    acts = np.array([nd.activation_size for nd in order])
+    if num_hosts > 1:
+        if num_chips % num_hosts:
+            raise ValueError("num_chips must divide evenly across hosts")
+        chips_per_host = num_chips // num_hosts
+    else:
+        chips_per_host = num_chips
+
+    A0, ck0, cm0 = native.solve_level_native(
+        times, params, acts, chips_per_host, hw.ici_bandwidth, hw.hbm_bytes,
+        versions_bound=chips_per_host, memory_check=memory_check,
+    )
+    if num_hosts == 1:
+        spans = native.backtrack(A0, ck0, cm0, 0, n, chips_per_host)
+        stages = [StagePlan(i, j, r) for i, j, r in spans]
+        return PartitionResult(
+            stages, float(A0[0, n, chips_per_host]),
+            sum(s.replication for s in stages),
+        )
+
+    base = A0[:, :, chips_per_host].copy()
+    A1, ck1, cm1 = native.solve_level_native(
+        times, params, acts, num_hosts, hw.dcn_bandwidth, hw.hbm_bytes,
+        versions_bound=num_hosts, memory_check=False, base_time=base,
+    )
+    stages: List[StagePlan] = []
+    for (i, j, r_hosts) in native.backtrack(A1, ck1, cm1, 0, n, num_hosts):
+        for (a, b, r_chips) in native.backtrack(A0, ck0, cm0, i, j, chips_per_host):
+            stages.append(StagePlan(a, b, r_chips * r_hosts))
+    return PartitionResult(
+        stages, float(A1[0, n, num_hosts]), sum(s.replication for s in stages)
+    )
 
 
 def stage_bounds_from_graph(graph: Graph, num_stages: int) -> List[int]:

@@ -1,0 +1,117 @@
+// Native core for the hierarchical pipeline-partitioning DP.
+//
+// The reference's native layer (C++/CUDA: the autograd pre-hook patch and the
+// pack_utils extension — SURVEY.md §2 D1/D2) served its profiler/runtime; the
+// TPU framework's equivalent hot spot is the partitioning dynamic program
+// (ddlbench_tpu/partition/optimizer.py), whose O(n^2 m) states x O(n m)
+// transitions make pure Python minutes-slow at pod scale (n~60 layers,
+// m~256 chips). This translation unit implements one DP level with the exact
+// same cost model; Python drives the hierarchy and backtracking via ctypes
+// (ddlbench_tpu/partition/native.py).
+//
+// Build: make -C native   (g++ -O3 -shared -fPIC, no dependencies)
+
+#include <cstdint>
+#include <cstring>
+#include <limits>
+#include <vector>
+
+namespace {
+
+constexpr double kInf = std::numeric_limits<double>::infinity();
+
+inline double ms(double bytes, double bandwidth) {
+  return bandwidth > 0 ? 1000.0 * bytes / bandwidth : 0.0;
+}
+
+inline double allreduce_ms(double param_bytes, int r, double bandwidth) {
+  if (r <= 1) return 0.0;
+  return ms(2.0 * (r - 1) / r * param_bytes, bandwidth);
+}
+
+struct Tables {
+  int n, m;
+  double* A;        // [(n+1)*(n+1)*(m+1)]
+  int32_t* ck;      // split point k, -1 if single stage
+  int32_t* cm;      // units of the last stage
+  inline size_t idx(int i, int j, int u) const {
+    return (static_cast<size_t>(i) * (n + 1) + j) * (m + 1) + u;
+  }
+};
+
+}  // namespace
+
+extern "C" {
+
+// Solve one DP level over a chain of n nodes with max_units units.
+//
+// node_times/node_params/node_acts: per-node fwd+bwd ms, parameter bytes,
+//   output-activation bytes.
+// base_time: nullptr for level 0 (stage compute = span time / r). For upper
+//   levels, a [(n+1)*(n+1)] row-major table where base_time[i*(n+1)+j] is the
+//   lower level's best time for span (i, j]; kInf marks infeasible.
+// memory_check/versions_bound/hbm_bytes: weight-stashing HBM constraint
+//   (1 + versions_bound) * span_params <= hbm_bytes.
+// Outputs: A (times), choice_k / choice_m (backtrack tables; k = -1 for a
+//   single replicated stage).
+void solve_level(int n, int max_units, const double* node_times,
+                 const double* node_params, const double* node_acts,
+                 double bandwidth, double hbm_bytes, int versions_bound,
+                 int memory_check, const double* base_time, double* A_out,
+                 int32_t* choice_k, int32_t* choice_m) {
+  std::vector<double> pre_t(n + 1, 0.0), pre_p(n + 1, 0.0);
+  for (int i = 0; i < n; ++i) {
+    pre_t[i + 1] = pre_t[i] + node_times[i];
+    pre_p[i + 1] = pre_p[i] + node_params[i];
+  }
+  Tables T{n, max_units, A_out, choice_k, choice_m};
+
+  auto span_params = [&](int i, int j) { return pre_p[j] - pre_p[i]; };
+  auto mem_ok = [&](int i, int j) {
+    if (!memory_check) return true;
+    return (1.0 + versions_bound) * span_params(i, j) <= hbm_bytes;
+  };
+  auto stage_cost = [&](int i, int j, int r) -> double {
+    if (!mem_ok(i, j)) return kInf;
+    double base;
+    if (base_time == nullptr) {
+      base = (pre_t[j] - pre_t[i]) / r;
+    } else {
+      base = base_time[static_cast<size_t>(i) * (n + 1) + j];
+      if (base == kInf) return kInf;
+      base /= r;
+    }
+    return base + allreduce_ms(span_params(i, j), r, bandwidth);
+  };
+  auto edge_cost = [&](int k) { return ms(node_acts[k - 1], bandwidth); };
+
+  for (int j = 1; j <= n; ++j) {
+    for (int i = j - 1; i >= 0; --i) {
+      for (int m = 1; m <= max_units; ++m) {
+        double best = stage_cost(i, j, m);
+        int32_t bk = -1, bm = -1;
+        for (int m_last = 1; m_last < m; ++m_last) {
+          for (int k = i + 1; k < j; ++k) {
+            double t_last = stage_cost(k, j, m_last);
+            if (t_last >= best) continue;
+            double t_rest = T.A[T.idx(i, k, m - m_last)];
+            double t = t_rest;
+            double e = edge_cost(k);
+            if (e > t) t = e;
+            if (t_last > t) t = t_last;
+            if (t < best) {
+              best = t;
+              bk = k;
+              bm = m_last;
+            }
+          }
+        }
+        T.A[T.idx(i, j, m)] = best;
+        T.ck[T.idx(i, j, m)] = bk;
+        T.cm[T.idx(i, j, m)] = bm;
+      }
+    }
+  }
+}
+
+}  // extern "C"
