@@ -30,7 +30,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-m", "--model", default="resnet18", choices=MODEL_NAMES)
     p.add_argument("-p", "--log-interval", type=int, default=25)
     p.add_argument("-s", "--real-data", action="store_true",
-                   help="use on-disk data instead of synthetic (reference -s flag, inverted)")
+                   help="use on-disk data via the native loader (reference -s flag, inverted)")
+    p.add_argument("--data-dir", default=None, help="on-disk dataset root (-s mode)")
     p.add_argument("-e", "--epochs", type=int, default=3)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--micro-batch-size", type=int, default=None)
@@ -59,6 +60,7 @@ def config_from_args(args) -> RunConfig:
         arch=args.model,
         num_devices=args.devices,
         synthetic=not args.real_data,
+        data_dir=args.data_dir,
         epochs=args.epochs,
         log_interval=args.log_interval,
         batch_size=args.batch_size,

@@ -1,0 +1,140 @@
+"""ctypes binding for the native data pipeline (native/dataloader.cpp):
+on-disk raw-tensor dataset factory + mmap-backed prefetching batch loader.
+
+This is the real-data path behind the CLI's ``-s`` flag (the reference stages
+random JPEGs and torch-DataLoader-reads them back,
+benchmark/generate_synthetic_data.py); the default benchmark path remains
+device-side PRNG synthesis (data/synthetic.py). Datasets are stored as
+``images.bin`` (N*H*W*C uint8) + ``labels.bin`` (N int32) + ``meta.json``.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import json
+import os
+import subprocess
+from typing import Iterator, Optional, Tuple
+
+import numpy as np
+
+from ddlbench_tpu.config import DatasetSpec
+
+_NATIVE_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__)))), "native")
+_LIB_PATH = os.path.join(_NATIVE_DIR, "libdataloader.so")
+
+_lib = None
+_lib_failed = False
+
+
+def _load() -> Optional[ctypes.CDLL]:
+    global _lib, _lib_failed
+    if _lib is not None or _lib_failed:
+        return _lib
+    try:
+        if not os.path.exists(_LIB_PATH):
+            subprocess.run(["make", "-C", _NATIVE_DIR, "-s"], check=True,
+                           capture_output=True, timeout=120)
+        lib = ctypes.CDLL(_LIB_PATH)
+        lib.dataset_generate.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int64, ctypes.c_uint64, ctypes.c_int,
+        ]
+        lib.dataset_generate.restype = ctypes.c_int
+        lib.loader_open.argtypes = [
+            ctypes.c_char_p, ctypes.c_int, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int, ctypes.c_int64, ctypes.c_int, ctypes.c_uint64,
+            ctypes.c_int, ctypes.c_int,
+        ]
+        lib.loader_open.restype = ctypes.c_void_p
+        lib.loader_next.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(np.uint8, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.loader_next.restype = ctypes.c_int
+        lib.loader_destroy.argtypes = [ctypes.c_void_p]
+        lib.loader_destroy.restype = None
+        _lib = lib
+    except Exception:
+        _lib_failed = True
+    return _lib
+
+
+def available() -> bool:
+    return _load() is not None
+
+
+def generate_dataset(data_dir: str, spec: DatasetSpec, split: str = "train",
+                     count: Optional[int] = None, seed: int = 1,
+                     threads: int = 4) -> str:
+    """Write a raw synthetic dataset for one split; returns its directory.
+
+    generate_synthetic_data.py parity: same blueprint sizes by default, raw
+    uint8 tensors instead of JPEGs (no decode cost on a benchmark that never
+    looks at the pixels).
+    """
+    lib = _load()
+    if lib is None:
+        raise RuntimeError("native dataloader unavailable (no toolchain?)")
+    count = count or (spec.train_size if split == "train" else spec.test_size)
+    h, w, c = spec.image_size
+    out = os.path.join(data_dir, spec.name, split)
+    os.makedirs(out, exist_ok=True)
+    rc = lib.dataset_generate(out.encode(), h, w, c, spec.num_classes,
+                              count, seed, threads)
+    if rc != 0:
+        raise RuntimeError(f"dataset_generate failed rc={rc}")
+    with open(os.path.join(out, "meta.json"), "w") as f:
+        json.dump({"h": h, "w": w, "c": c, "classes": spec.num_classes,
+                   "count": count, "seed": seed}, f)
+    return out
+
+
+class NativeDataLoader:
+    """Prefetching batch iterator over a generated dataset directory."""
+
+    def __init__(self, dataset_dir: str, batch_size: int, seed: int = 1,
+                 shuffle: bool = True, ring_depth: int = 4):
+        lib = _load()
+        if lib is None:
+            raise RuntimeError("native dataloader unavailable")
+        with open(os.path.join(dataset_dir, "meta.json")) as f:
+            meta = json.load(f)
+        self.meta = meta
+        self.batch_size = batch_size
+        self._lib = lib
+        self._handle = lib.loader_open(
+            dataset_dir.encode(), meta["h"], meta["w"], meta["c"],
+            meta["classes"], meta["count"], batch_size, seed,
+            int(shuffle), ring_depth,
+        )
+        if not self._handle:
+            raise RuntimeError(f"loader_open failed for {dataset_dir}")
+        self._img_buf = np.empty(
+            (batch_size, meta["h"], meta["w"], meta["c"]), np.uint8
+        )
+        self._lbl_buf = np.empty((batch_size,), np.int32)
+
+    @property
+    def steps_per_epoch(self) -> int:
+        return self.meta["count"] // self.batch_size
+
+    def next(self) -> Tuple[np.ndarray, np.ndarray]:
+        rc = self._lib.loader_next(self._handle, self._img_buf.reshape(-1),
+                                   self._lbl_buf)
+        if rc != 0:
+            raise RuntimeError(f"loader_next rc={rc}")
+        return self._img_buf.copy(), self._lbl_buf.copy()
+
+    def close(self) -> None:
+        if getattr(self, "_handle", None):
+            self._lib.loader_destroy(self._handle)
+            self._handle = None
+
+    def __del__(self):
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,56 @@
+"""On-disk dataset adapter with the SyntheticData batch interface.
+
+Backs the real-data path (CLI ``-s``): raw uint8 batches come from the native
+prefetching loader (data/native_loader.py), are uploaded to device, and are
+normalized inside jit — the reference's transforms.Normalize equivalent
+(benchmark/mnist/mnist_pytorch.py:172-216) without a JPEG decode.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.config import DatasetSpec
+from ddlbench_tpu.data.native_loader import NativeDataLoader, generate_dataset
+
+
+@functools.partial(jax.jit, static_argnums=(2,))
+def _normalize(imgs_u8, labels, dtype_name: str):
+    x = imgs_u8.astype(jnp.float32) / 255.0
+    x = (x - 0.5) / 0.2887  # match the synthetic path's statistics
+    return x.astype(jnp.dtype(dtype_name)), labels
+
+
+class OnDiskData:
+    """Mirrors SyntheticData's interface over generated raw datasets."""
+
+    def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
+                 seed: int = 1, dtype=jnp.float32,
+                 train_count: int | None = None, test_count: int | None = None):
+        self.spec = spec
+        self.batch_size = batch_size
+        self.dtype_name = str(jnp.dtype(dtype))
+        self._loaders = {}
+        for split, count in (("train", train_count), ("test", test_count)):
+            split_dir = os.path.join(data_dir, spec.name, split)
+            if not os.path.exists(os.path.join(split_dir, "meta.json")):
+                generate_dataset(data_dir, spec, split, count=count, seed=seed)
+            self._loaders[split] = NativeDataLoader(
+                split_dir, batch_size, seed=seed, shuffle=(split == "train")
+            )
+
+    def steps_per_epoch(self, train: bool = True) -> int:
+        return self._loaders["train" if train else "test"].steps_per_epoch
+
+    def batch(self, epoch: int, step: int, train: bool = True) -> Tuple[jax.Array, jax.Array]:
+        imgs, labels = self._loaders["train" if train else "test"].next()
+        return _normalize(jnp.asarray(imgs), jnp.asarray(labels), self.dtype_name)
+
+    def close(self) -> None:
+        for l in self._loaders.values():
+            l.close()

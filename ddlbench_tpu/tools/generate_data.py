@@ -1,0 +1,41 @@
+"""Synthetic on-disk dataset factory CLI.
+
+Parity with benchmark/generate_synthetic_data.py (multiprocess pool writing
+random JPEGs, :49-71): writes raw uint8 tensor stores for any of the four
+dataset blueprints via the multithreaded native generator.
+
+    python -m ddlbench_tpu.tools.generate_data -b mnist -o ./data
+    python -m ddlbench_tpu.tools.generate_data -b imagenet -o ./data --train-count 10000
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+import time
+
+from ddlbench_tpu.config import DATASETS
+from ddlbench_tpu.data.native_loader import generate_dataset
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-b", "--benchmark", required=True, choices=sorted(DATASETS))
+    p.add_argument("-o", "--out", default="./data")
+    p.add_argument("--train-count", type=int, default=None)
+    p.add_argument("--test-count", type=int, default=None)
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--threads", type=int, default=4)
+    args = p.parse_args(argv)
+    spec = DATASETS[args.benchmark]
+    for split, count in (("train", args.train_count), ("test", args.test_count)):
+        t0 = time.perf_counter()
+        out = generate_dataset(args.out, spec, split, count=count,
+                               seed=args.seed, threads=args.threads)
+        n = count or (spec.train_size if split == "train" else spec.test_size)
+        print(f"{split}: {n} samples -> {out} ({time.perf_counter() - t0:.1f}s)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

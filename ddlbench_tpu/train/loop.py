@@ -32,19 +32,24 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
     spec = cfg.dataset()
-    data = make_synthetic(
-        spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
-    )
+    if cfg.synthetic:
+        data = make_synthetic(
+            spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
+        )
+    else:
+        from ddlbench_tpu.data.ondisk import OnDiskData
+
+        train_count = (cfg.steps_per_epoch or 0) * global_batch or None
+        test_count = max(global_batch, (train_count or 0) // 5) if train_count else None
+        data = OnDiskData(
+            cfg.data_dir or "./data", spec, global_batch, seed=cfg.seed,
+            train_count=train_count, test_count=test_count,
+        )
 
     base_lr = cfg.resolved_lr()
     if cfg.strategy == "dp" and cfg.scale_lr_by_world:
         # Horovod parity: lr scaled by world size (mnist_horovod.py:226).
         base_lr = base_lr * strategy.world_size
-
-    if not cfg.synthetic:
-        raise NotImplementedError(
-            "on-disk (real-data) loading is not wired up yet; run with synthetic data"
-        )
 
     # Warmup: trigger compilation outside the timed region (first XLA compile is
     # tens of seconds; the reference's closest analog is cudnn.benchmark=True,

@@ -1,0 +1,73 @@
+"""Native data pipeline: generator determinism, loader coverage, e2e -s run."""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import DatasetSpec
+from ddlbench_tpu.data import native_loader
+
+
+TINY = DatasetSpec("tinyset", (8, 8, 3), 5, 64, 16)
+
+
+@pytest.fixture(scope="module")
+def dataset_dir(tmp_path_factory):
+    root = tmp_path_factory.mktemp("data")
+    assert native_loader.available(), "native dataloader failed to build"
+    native_loader.generate_dataset(str(root), TINY, "train", seed=7, threads=2)
+    native_loader.generate_dataset(str(root), TINY, "test", seed=7, threads=2)
+    return root
+
+
+def test_generate_layout_and_determinism(dataset_dir, tmp_path):
+    d = dataset_dir / "tinyset" / "train"
+    imgs = np.fromfile(d / "images.bin", np.uint8)
+    lbls = np.fromfile(d / "labels.bin", np.int32)
+    assert imgs.size == 64 * 8 * 8 * 3
+    assert lbls.size == 64
+    assert lbls.min() >= 0 and lbls.max() < 5
+    meta = json.loads((d / "meta.json").read_text())
+    assert meta["count"] == 64
+    # same seed -> identical bytes
+    native_loader.generate_dataset(str(tmp_path), TINY, "train", seed=7, threads=2)
+    imgs2 = np.fromfile(tmp_path / "tinyset" / "train" / "images.bin", np.uint8)
+    np.testing.assert_array_equal(imgs, imgs2)
+
+
+def test_loader_covers_epoch_without_repeats(dataset_dir):
+    d = str(dataset_dir / "tinyset" / "train")
+    loader = native_loader.NativeDataLoader(d, batch_size=16, seed=3)
+    assert loader.steps_per_epoch == 4
+    lbls_file = np.fromfile(os.path.join(d, "labels.bin"), np.int32)
+    imgs_file = np.fromfile(os.path.join(d, "images.bin"), np.uint8).reshape(64, -1)
+    seen = []
+    for _ in range(4):
+        imgs, lbls = loader.next()
+        assert imgs.shape == (16, 8, 8, 3)
+        # map each sample back to its dataset index by content
+        for row, lab in zip(imgs.reshape(16, -1), lbls):
+            matches = np.where((imgs_file == row).all(axis=1))[0]
+            assert len(matches) == 1
+            assert lbls_file[matches[0]] == lab
+            seen.append(int(matches[0]))
+    assert sorted(seen) == list(range(64))  # full shuffled coverage
+    loader.close()
+
+
+def test_ondisk_end_to_end(dataset_dir, devices):
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.train.loop import run_benchmark
+
+    cfg = RunConfig(
+        benchmark="mnist", strategy="single", arch="resnet18",
+        synthetic=False, data_dir=str(dataset_dir).replace("tinyset", ""),
+        epochs=1, steps_per_epoch=2, batch_size=8, log_interval=1,
+        compute_dtype="float32",
+    )
+    # use the real mnist spec dir (generated on demand into tmp)
+    cfg = cfg.replace(data_dir=str(dataset_dir))
+    result = run_benchmark(cfg, warmup_steps=0)
+    assert result["samples_per_sec"] > 0
