@@ -24,14 +24,23 @@ class DatasetSpec:
     """Shape/size blueprint of one benchmark dataset.
 
     Mirrors the synthetic-data factory specs in the reference
-    (benchmark/generate_synthetic_data.py:75-107).
+    (benchmark/generate_synthetic_data.py:75-107). ``kind`` distinguishes image
+    workloads (NHWC float input, one label per sample) from token workloads
+    (int sequence input, next-token labels) — the sequence-length benchmark
+    axis the reference approximates spatially with "highres" (SURVEY.md §5.7).
     """
 
     name: str
-    image_size: Tuple[int, int, int]  # (H, W, C), NHWC
-    num_classes: int
+    image_size: Tuple[int, ...]  # (H, W, C) for images; (T,) for tokens
+    num_classes: int  # classes, or vocab size for tokens
     train_size: int
     test_size: int
+    kind: str = "image"  # "image" | "tokens"
+
+    @property
+    def seq_len(self) -> int:
+        assert self.kind == "tokens"
+        return self.image_size[0]
 
 
 DATASETS: Mapping[str, DatasetSpec] = {
@@ -41,9 +50,13 @@ DATASETS: Mapping[str, DatasetSpec] = {
     # "highres" is the reference's activation-memory stressor
     # (generate_synthetic_data.py:100-107): 512x512x3, 1000 classes.
     "highres": DatasetSpec("highres", (512, 512, 3), 1000, 50_000, 10_000),
+    # Token workloads (new first-class axis, not reference parity): a standard
+    # LM context and a long-context stressor for sequence parallelism.
+    "synthtext": DatasetSpec("synthtext", (1024,), 32_768, 100_000, 10_000, kind="tokens"),
+    "longctx": DatasetSpec("longctx", (8192,), 32_768, 20_000, 2_000, kind="tokens"),
 }
 
-STRATEGIES = ("single", "dp", "gpipe", "pipedream")
+STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp")
 
 # Per-framework default batch sizes from the reference harness
 # (run_template.sh:186-266,377-394; see BASELINE.md). For gpipe the tuple is
@@ -51,15 +64,22 @@ STRATEGIES = ("single", "dp", "gpipe", "pipedream")
 # product (benchmark/mnist/mnist_gpipe.py:37-41). For pipedream the number is
 # the global batch.
 DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
-    "single": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32},
-    "dp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32},
+    "single": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
+               "synthtext": 16, "longctx": 2},
+    "dp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
+           "synthtext": 16, "longctx": 2},
     "gpipe": {
         "mnist": (128, 24),
         "cifar10": (64, 32),
         "imagenet": (24, 12),
         "highres": (4, 12),
+        "synthtext": (4, 8),
+        "longctx": (1, 8),
     },
-    "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64},
+    "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64,
+                  "synthtext": 64, "longctx": 8},
+    "sp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
+           "synthtext": 16, "longctx": 2},
 }
 
 
@@ -156,6 +176,8 @@ class RunConfig:
     def resolved_lr(self) -> float:
         if self.lr is not None:
             return self.lr
+        if self.dataset().kind == "tokens":
+            return 0.01
         return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
 
     def resolved_momentum(self) -> float:
@@ -179,7 +201,7 @@ class RunConfig:
         For single/dp, num_microbatches == 1 and micro_batch_size is the
         per-device batch. Defaults follow the reference matrix (BASELINE.md).
         """
-        if self.strategy in ("single", "dp"):
+        if self.strategy in ("single", "dp", "sp"):
             b = self.batch_size or DEFAULT_BATCH[self.strategy][self.benchmark]
             return int(b), 1
         if self.strategy == "gpipe":
@@ -199,8 +221,8 @@ class RunConfig:
 
     def global_batch(self) -> int:
         mb, chunks = self.resolved_batches()
-        if self.strategy == "single":
-            return mb
+        if self.strategy in ("single", "sp"):
+            return mb  # sp shards the sequence axis, not the batch
         if self.strategy == "dp":
             return mb * self.num_devices
         return mb * chunks * max(1, self.dp_replicas)
@@ -212,6 +234,8 @@ class RunConfig:
             raise ValueError(f"unknown strategy {self.strategy!r}")
         if self.strategy == "single" and self.num_devices != 1:
             raise ValueError("single strategy uses exactly 1 device")
+        if self.strategy == "sp" and self.dataset().kind != "tokens":
+            raise ValueError("sp (sequence parallelism) requires a token benchmark")
         if self.strategy in ("gpipe", "pipedream"):
             s = self.resolved_stages()
             if s * max(1, self.dp_replicas) != self.num_devices:

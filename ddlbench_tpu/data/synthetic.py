@@ -66,6 +66,7 @@ class SyntheticData:
             self.spec.image_size,
             self.spec.num_classes,
             self.dtype,
+            self.spec.kind,
         )
 
     def epoch_iter(self, epoch: int, train: bool = True) -> Iterator[Tuple[jax.Array, jax.Array]]:
@@ -82,9 +83,16 @@ def _synthetic_images(key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Arra
     return x.astype(dtype)
 
 
-def _gen_batch(seed, epoch, step, batch, image_size, num_classes, dtype):
+def _gen_batch(seed, epoch, step, batch, image_size, num_classes, dtype,
+               kind="image"):
     key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), epoch), step)
     kx, ky = jax.random.split(key)
+    if kind == "tokens":
+        # Next-token LM setup: sample T+1 tokens; inputs/labels are the two
+        # length-T shifts.
+        T = image_size[0]
+        seq = jax.random.randint(kx, (batch, T + 1), 0, num_classes, jnp.int32)
+        return seq[:, :-1], seq[:, 1:]
     x = _synthetic_images(kx, (batch, *image_size), dtype)
     y = jax.random.randint(ky, (batch,), 0, num_classes, dtype=jnp.int32)
     return x, y

@@ -1,0 +1,230 @@
+"""Decoder-only transformer LM as a flat layer chain.
+
+This is the framework's sequence workload — the modern analog of the
+reference's GNMT translation workload (pipedream-fork/{runtime,profiler}/
+translation, SURVEY.md §2 C13), re-designed rather than translated: a causal
+transformer whose blocks are pipeline-atomic layers, so the SAME model runs
+under single/dp/gpipe/pipedream, and whose attention has a sequence-parallel
+ring implementation (parallel/sp.py) for long-context training — the
+capability the reference approximates spatially with its "highres" dataset
+(SURVEY.md §5.7).
+
+Arch variants: transformer_s (8 x d512), transformer_m (12 x d768).
+Pre-LN blocks, learned positions, GELU MLP (4x), untied LM head.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import List
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddlbench_tpu.models.layers import Layer, LayerModel
+
+LN_EPS = 1e-5
+
+_VARIANTS = {
+    "transformer_s": dict(d_model=512, n_layers=8, n_heads=8),
+    "transformer_m": dict(d_model=768, n_layers=12, n_heads=12),
+}
+
+# Sequence-parallel context: when set (by parallel/sp.py inside its shard_map),
+# embed offsets positions by the shard index and attention runs the ring
+# algorithm over the named mesh axis. One model definition serves both modes.
+_SEQ_AXIS: list = []
+
+
+class sequence_parallel:
+    """Context manager: trace model applies in sequence-parallel mode."""
+
+    def __init__(self, axis: str):
+        self.axis = axis
+
+    def __enter__(self):
+        _SEQ_AXIS.append(self.axis)
+        return self
+
+    def __exit__(self, *exc):
+        _SEQ_AXIS.pop()
+        return False
+
+
+def _seq_axis():
+    return _SEQ_AXIS[-1] if _SEQ_AXIS else None
+
+
+def layer_norm(p, x):
+    """f32-accumulated LayerNorm over the feature axis, compute-dtype out."""
+    mean = jnp.mean(x, axis=-1, keepdims=True, dtype=jnp.float32)
+    mean2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    inv = lax.rsqrt(jnp.maximum(mean2 - lax.square(mean), 0.0) + LN_EPS)
+    y = (x.astype(jnp.float32) - mean) * inv
+    return (y.astype(x.dtype) * p["scale"].astype(x.dtype)
+            + p["bias"].astype(x.dtype))
+
+
+def _ln_init(d):
+    return {"scale": jnp.ones((d,), jnp.float32), "bias": jnp.zeros((d,), jnp.float32)}
+
+
+def _dense_init(key, din, dout, std=0.02):
+    return jax.random.normal(key, (din, dout), jnp.float32) * std
+
+
+def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
+    def init(key, in_shape):
+        (T,) = in_shape
+        k1, k2 = jax.random.split(key)
+        p = {
+            "tok": _dense_init(k1, vocab, d_model),
+            "pos": _dense_init(k2, max_len, d_model),
+        }
+        return p, {}, (T, d_model)
+
+    def apply(p, s, x, train):
+        # x: [B, T] int32 (T = local shard length under sequence parallelism)
+        T = x.shape[1]
+        axis = _seq_axis()
+        if axis is None:
+            pos = p["pos"][:T]
+        else:
+            offset = lax.axis_index(axis) * T
+            pos = lax.dynamic_slice_in_dim(p["pos"], offset, T, axis=0)
+        y = jnp.take(p["tok"], x, axis=0) + pos
+        return y, s
+
+    return Layer(name, init, apply)
+
+
+def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0):
+    """Masked attention for blocks of a causal sequence.
+
+    q: [B, H, Tq, Dh]; k/v: [B, H, Tk, Dh]. Offsets give each block's absolute
+    position so the same primitive serves full attention (offsets 0) and ring
+    attention over sequence shards (parallel/sp.py).
+    """
+    dh = q.shape[-1]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)
+    q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+    k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
+    scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    # numerically safe softmax that tolerates fully-masked rows
+    m = jnp.max(scores, axis=-1, keepdims=True)
+    m = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(scores - m)
+    z = jnp.sum(e, axis=-1, keepdims=True)
+    return jnp.einsum("bhqk,bhkd->bhqd", e / jnp.maximum(z, 1e-20), v)
+
+
+def ring_attention(q, k, v, axis: str):
+    """Causal attention over a sequence sharded on mesh axis `axis`.
+
+    Each device holds the Q/K/V block for its sequence shard; K/V blocks rotate
+    around the ring with `lax.ppermute` while a streaming (online-softmax)
+    accumulator — running max m, normalizer l, weighted sum acc — combines the
+    partial attention of the local queries against each visiting block. This is
+    blockwise/ring attention: peak memory is O(T_local^2) instead of O(T^2),
+    and the ring transfers ride ICI neighbor links.
+    """
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    B, H, Tl, dh = q.shape
+    qf = q.astype(jnp.float32)
+    q_pos = idx * Tl + jnp.arange(Tl)[:, None]  # absolute query positions
+
+    def step(carry, i):
+        k_blk, v_blk, m, l, acc = carry
+        src = (idx - i) % n  # which shard's K/V we hold this round
+        k_pos = src * Tl + jnp.arange(Tl)[None, :]
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+        s = s / math.sqrt(dh)
+        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
+        p = jnp.exp(s - safe_m)
+        corr = jnp.where(jnp.isfinite(m), jnp.exp(m - safe_m), 0.0)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, m_new, l, acc), None
+
+    from ddlbench_tpu.parallel.common import vary
+
+    m0 = vary(jnp.full((B, H, Tl, 1), -jnp.inf, jnp.float32), (axis,))
+    l0 = vary(jnp.zeros((B, H, Tl, 1), jnp.float32), (axis,))
+    acc0 = vary(jnp.zeros((B, H, Tl, dh), jnp.float32), (axis,))
+    (k, v, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
+    return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
+
+
+def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4) -> Layer:
+    dh = d_model // n_heads
+
+    def init(key, in_shape):
+        T, d = in_shape
+        assert d == d_model
+        ks = jax.random.split(key, 6)
+        p = {
+            "ln1": _ln_init(d),
+            "wqkv": _dense_init(ks[0], d, 3 * d),
+            "wo": _dense_init(ks[1], d, d),
+            "ln2": _ln_init(d),
+            "w1": _dense_init(ks[2], d, mlp_ratio * d),
+            "b1": jnp.zeros((mlp_ratio * d,), jnp.float32),
+            "w2": _dense_init(ks[3], mlp_ratio * d, d),
+            "b2": jnp.zeros((d,), jnp.float32),
+        }
+        return p, {}, (T, d)
+
+    def apply(p, s, x, train):
+        B, T, d = x.shape
+        h = layer_norm(p["ln1"], x)
+        qkv = h @ p["wqkv"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+
+        def heads(t):
+            return t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+
+        axis = _seq_axis()
+        if axis is None:
+            o = causal_attention(heads(q), heads(k), heads(v))
+        else:
+            o = ring_attention(heads(q), heads(k), heads(v), axis)
+        o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
+        x = x + o @ p["wo"].astype(x.dtype)
+        h = layer_norm(p["ln2"], x)
+        h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
+        x = x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))
+        return x, s
+
+    return Layer(name, init, apply)
+
+
+def lm_head(name: str, vocab: int) -> Layer:
+    def init(key, in_shape):
+        T, d = in_shape
+        p = {"ln_f": _ln_init(d), "head": _dense_init(key, d, vocab)}
+        return p, {}, (T, vocab)
+
+    def apply(p, s, x, train):
+        h = layer_norm(p["ln_f"], x)
+        return h @ p["head"].astype(x.dtype), s
+
+    return Layer(name, init, apply)
+
+
+def build_transformer(arch: str, in_shape, vocab: int) -> LayerModel:
+    cfgv = _VARIANTS[arch]
+    T = in_shape[0]
+    layers: List[Layer] = [embed("embed", vocab, cfgv["d_model"], T)]
+    for i in range(cfgv["n_layers"]):
+        layers.append(
+            transformer_block(f"block{i + 1}", cfgv["d_model"], cfgv["n_heads"])
+        )
+    layers.append(lm_head("lm_head", vocab))
+    return LayerModel(arch, layers, tuple(in_shape), vocab)

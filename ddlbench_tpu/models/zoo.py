@@ -13,11 +13,20 @@ from ddlbench_tpu.models.mobilenetv2 import build_mobilenetv2
 from ddlbench_tpu.models.resnet import build_resnet
 from ddlbench_tpu.models.vgg import build_vgg
 
-MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16", "mobilenetv2")
+MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
+               "mobilenetv2", "transformer_s", "transformer_m")
 
 
 def get_model(arch: str, dataset: str | DatasetSpec) -> LayerModel:
     spec = dataset if isinstance(dataset, DatasetSpec) else DATASETS[dataset]
+    if arch.startswith("transformer"):
+        from ddlbench_tpu.models.transformer import build_transformer
+
+        if spec.kind != "tokens":
+            raise ValueError(f"{arch} requires a token dataset, got {spec.name}")
+        return build_transformer(arch, spec.image_size, spec.num_classes)
+    if spec.kind != "image":
+        raise ValueError(f"{arch} requires an image dataset, got {spec.name}")
     if arch.startswith("resnet"):
         return build_resnet(arch, spec.image_size, spec.num_classes)
     if arch.startswith("vgg"):

@@ -41,4 +41,8 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
 
         return PipeDreamStrategy(model, cfg, devices=devices)
+    if cfg.strategy == "sp":
+        from ddlbench_tpu.parallel.sp import SPStrategy
+
+        return SPStrategy(model, cfg, devices=devices)
     raise ValueError(cfg.strategy)

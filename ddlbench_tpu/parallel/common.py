@@ -16,9 +16,11 @@ import jax.numpy as jnp
 
 
 def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Mean CE over every label position; works for classification
+    (logits [B, C], labels [B]) and LM heads (logits [B, T, V], labels [B, T])."""
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=-1))
+    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
 
 
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
@@ -67,3 +69,24 @@ def cast_params(params, dtype):
         lambda x: x.astype(dtype) if jnp.issubdtype(x.dtype, jnp.floating) else x,
         params,
     )
+
+
+def vary(v, axes):
+    """Mark v as varying over any of `axes` it isn't already varying over.
+
+    shard_map's VMA type system requires lax.switch branches and lax.scan
+    carries to agree on varying-axes; constants (jnp.zeros) start invariant.
+    """
+    from jax import lax
+
+    cur = jax.typeof(v).vma
+    missing = tuple(a for a in axes if a not in cur)
+    return lax.pcast(v, missing, to="varying") if missing else v
+
+
+def cast_input(x, dtype):
+    """Cast a batch to the compute dtype; integer inputs (token ids) pass
+    through untouched."""
+    if dtype is None or not jnp.issubdtype(x.dtype, jnp.floating):
+        return x
+    return x.astype(dtype)

@@ -32,6 +32,7 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
 from ddlbench_tpu.parallel.common import (
     accuracy,
+    cast_input,
     cast_params,
     cross_entropy_loss,
     sgd_init,
@@ -67,7 +68,7 @@ class DPStrategy:
             def loss_fn(params):
                 p = cast_params(params, self.compute_dtype)
                 logits, new_state = apply_model(
-                    model, p, ts.model_state, x.astype(self.compute_dtype), True
+                    model, p, ts.model_state, cast_input(x, self.compute_dtype), True
                 )
                 return cross_entropy_loss(logits, y), (logits, new_state)
 
@@ -81,12 +82,12 @@ class DPStrategy:
         def eval_step(ts: TrainState, x, y):
             p = cast_params(ts.params, self.compute_dtype)
             logits, _ = apply_model(
-                model, p, ts.model_state, x.astype(self.compute_dtype), False
+                model, p, ts.model_state, cast_input(x, self.compute_dtype), False
             )
             return {
                 "loss": cross_entropy_loss(logits, y),
                 "correct": jnp.sum(jnp.argmax(logits, -1) == y),
-                "count": jnp.asarray(y.shape[0], jnp.int32),
+                "count": jnp.asarray(y.size, jnp.int32),
             }
 
         self.train_step = jax.jit(

@@ -45,7 +45,7 @@ except ImportError:  # pragma: no cover
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
-from ddlbench_tpu.parallel.common import cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
 from ddlbench_tpu.parallel.packing import (
     balanced_stage_bounds,
     layer_flop_costs,
@@ -54,18 +54,13 @@ from ddlbench_tpu.parallel.packing import (
 )
 
 
+from ddlbench_tpu.parallel.common import vary as _vary_axes
+
 _PIPE_AXES = ("data", "stage")
 
 
 def _vary(v, axes=_PIPE_AXES):
-    """Mark v as varying over any of `axes` it isn't already varying over.
-
-    shard_map's VMA type system requires lax.switch branches (and scan carries)
-    to agree on varying-axes; constants (jnp.zeros) start invariant.
-    """
-    cur = jax.typeof(v).vma
-    missing = tuple(a for a in axes if a not in cur)
-    return lax.pcast(v, missing, to="varying") if missing else v
+    return _vary_axes(v, axes)
 
 
 class PipeTrainState(NamedTuple):
@@ -164,7 +159,8 @@ class GPipeStrategy:
                 x = x_buf[: mb * math.prod(in_shape)].reshape(mb, *in_shape)
             params = cast_params(p_unravel(param_row[:p_len]), cdtype)
             states = s_unravel(state_row[:s_len])
-            y, new_states = apply_slice(layers, params, states, x.astype(cdtype), train)
+            y, new_states = apply_slice(layers, params, states,
+                                        cast_input(x, cdtype), train)
             if last:
                 labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
                 loss = cross_entropy_loss(y, labels)
@@ -270,7 +266,6 @@ class GPipeStrategy:
     def _make_train_step(self):
         pipe_train = self._make_pipe_fn(train=True)
         mom, wd = self._mom, self._wd
-        total = self._total_samples
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
             def loss_fn(params_mat):
@@ -285,7 +280,9 @@ class GPipeStrategy:
             params = ts.params - lr * momentum
             metrics = {
                 "loss": loss,
-                "accuracy": correct.astype(jnp.float32) / total,
+                # ys.size counts every label position (samples, or tokens for
+                # LM workloads).
+                "accuracy": correct.astype(jnp.float32) / ys.size,
             }
             return PipeTrainState(params, new_state, momentum), metrics
 
@@ -298,14 +295,13 @@ class GPipeStrategy:
 
     def _make_eval_step(self):
         pipe_eval = self._make_pipe_fn(train=False)
-        total = self._total_samples
 
         def eval_step(ts, xs, ys):
             loss, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
             return {
                 "loss": loss,
                 "correct": correct,
-                "count": jnp.asarray(total, jnp.int32),
+                "count": jnp.asarray(ys.size, jnp.int32),
             }
 
         return jax.jit(
@@ -320,7 +316,7 @@ class GPipeStrategy:
         """Global batch [M*mb*dp, ...] -> [M, mb*dp, ...] sharded over 'data'."""
         M, mb, dp = self.num_microbatches, self.mb, self.dp
         x = x.reshape(M, dp * mb, *x.shape[1:])
-        y = y.reshape(M, dp * mb)
+        y = y.reshape(M, dp * mb, *y.shape[1:])
         return (
             jax.device_put(x, self._batch_sharding),
             jax.device_put(y, self._batch_sharding),

@@ -50,7 +50,7 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlbench_tpu.models.layers import apply_slice
-from ddlbench_tpu.parallel.common import cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
 from ddlbench_tpu.parallel.gpipe import GPipeStrategy, _shard_map, _vary
 from ddlbench_tpu.parallel.packing import pad_vec
 
@@ -113,7 +113,8 @@ class PipeDreamStrategy(GPipeStrategy):
         def stage_fwd(param_row, state_row, x):
             params = cast_params(p_unravel(param_row[:p_len]), cdtype)
             states = s_unravel(state_row[:s_len])
-            y, new_states = apply_slice(layers, params, states, x.astype(cdtype), True)
+            y, new_states = apply_slice(layers, params, states,
+                                        cast_input(x, cdtype), True)
             new_state_row = pad_vec(
                 ravel_pytree(new_states)[0].astype(jnp.float32), state_row.shape[0]
             )
@@ -160,8 +161,8 @@ class PipeDreamStrategy(GPipeStrategy):
                 def do_fwd(op):
                     params, st_row, stash_p, stash_x, fwd_q = op
                     if s == 0:
+                        # raw batch input (float images or int tokens)
                         x = lax.dynamic_index_in_dim(xs, f, keepdims=False)
-                        x = x.astype(cdtype)
                     else:
                         x = unpack_x(lax.dynamic_index_in_dim(
                             fwd_q, f % 2, keepdims=False))
@@ -177,8 +178,11 @@ class PipeDreamStrategy(GPipeStrategy):
                         y_out = pad_vec(y.astype(cdtype), A)
                     slot = f % NSLOT
                     stash_p = lax.dynamic_update_index_in_dim(stash_p, params, slot, 0)
-                    stash_x = lax.dynamic_update_index_in_dim(
-                        stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
+                    if s != 0:
+                        # stage 0's input is re-read from xs at backward time
+                        # (exact for int tokens, saves a stash write).
+                        stash_x = lax.dynamic_update_index_in_dim(
+                            stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
                     return jax.tree.map(
                         _vary, (new_st, stash_p, stash_x, y_out, loss_mb, corr_mb))
 
@@ -201,7 +205,13 @@ class PipeDreamStrategy(GPipeStrategy):
                     params, momentum, st_row, stash_p, stash_x, g_buf = op
                     slot = b % NSLOT
                     p_st = lax.dynamic_index_in_dim(stash_p, slot, keepdims=False)
-                    x_st = unpack_x(lax.dynamic_index_in_dim(stash_x, slot, keepdims=False))
+                    if s == 0:
+                        x_st = lax.dynamic_index_in_dim(xs, b, keepdims=False)
+                    else:
+                        x_st = unpack_x(
+                            lax.dynamic_index_in_dim(stash_x, slot, keepdims=False))
+                    # Stage 0 never sends an input gradient left (and its
+                    # input may be integer tokens, which have no tangent).
                     if last:
                         labels = lax.dynamic_index_in_dim(ys, b, keepdims=False)
 
@@ -209,18 +219,28 @@ class PipeDreamStrategy(GPipeStrategy):
                             y, _ = stage_fwd(pv, st_row, xv)
                             return cross_entropy_loss(y, labels)
 
-                        gp, gx = jax.grad(loss_of, argnums=(0, 1))(p_st, x_st)
+                        if s == 0:
+                            gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
+                            gx = None
+                        else:
+                            gp, gx = jax.grad(loss_of, argnums=(0, 1))(p_st, x_st)
                     else:
                         def fwd_of(pv, xv):
                             y, _ = stage_fwd(pv, st_row, xv)
                             return y
 
                         g_in = unpack_g(g_buf)
-                        y, vjp_fn = jax.vjp(fwd_of, p_st, x_st)
-                        gp, gx = vjp_fn(g_in.astype(y.dtype))
+                        if s == 0:
+                            y, vjp_fn = jax.vjp(lambda pv: fwd_of(pv, x_st), p_st)
+                            (gp,) = vjp_fn(g_in.astype(y.dtype))
+                            gx = None
+                        else:
+                            y, vjp_fn = jax.vjp(fwd_of, p_st, x_st)
+                            gp, gx = vjp_fn(g_in.astype(y.dtype))
                     # DDP-per-stage parity: sync grads across stage replicas.
                     gp = lax.psum(gp, "data")
-                    gx_out = pad_vec(gx.astype(cdtype), A)
+                    gx_out = (jnp.zeros((A,), cdtype) if gx is None
+                              else pad_vec(gx.astype(cdtype), A))
                     g = gp.astype(jnp.float32)
                     if wd:
                         g = g + wd * params
@@ -343,7 +363,7 @@ class PipeDreamStrategy(GPipeStrategy):
             )
             metrics = {
                 "loss": loss,
-                "accuracy": correct.astype(jnp.float32) / total,
+                "accuracy": correct.astype(jnp.float32) / ys.size,
             }
             return PDTrainState(params, st, momentum), metrics
 
