@@ -43,6 +43,11 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
+    p.add_argument("--auto-partition", action="store_true",
+                   help="profile + hierarchical partitioner choose stage bounds")
+    p.add_argument("--profile-mode", default="flops", choices=("flops", "time"))
+    p.add_argument("--trace-dir", default=None,
+                   help="write a jax.profiler trace of the run here")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save a checkpoint per epoch here (orbax)")
     p.add_argument("--resume", action="store_true",
@@ -74,6 +79,8 @@ def config_from_args(args) -> RunConfig:
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
+        auto_partition=args.auto_partition,
+        profile_mode=args.profile_mode,
     )
 
 
@@ -83,6 +90,10 @@ def main(argv=None) -> int:
         import jax
 
         jax.config.update("jax_platforms", args.platform)
+
+    from ddlbench_tpu.distributed import initialize
+
+    initialize()  # no-op unless DDLB_* multi-host env is set
     cfg = config_from_args(args)
     cfg.validate()
 
@@ -94,7 +105,15 @@ def main(argv=None) -> int:
     print("run manifest: " + json.dumps(manifest), flush=True)
 
     logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=args.jsonl)
-    result = run_benchmark(cfg, logger=logger)
+    if args.trace_dir:
+        # jax.profiler trace — the TPU-native replacement for the reference's
+        # hook-based torchprofiler (SURVEY.md §5.1).
+        import jax
+
+        with jax.profiler.trace(args.trace_dir):
+            result = run_benchmark(cfg, logger=logger)
+    else:
+        result = run_benchmark(cfg, logger=logger)
     result.pop("train_state", None)
     print("result: " + json.dumps(result), flush=True)
     return 0

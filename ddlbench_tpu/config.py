@@ -153,6 +153,12 @@ class RunConfig:
     num_stages: Optional[int] = None  # defaults to num_devices // dp_replicas
     dp_replicas: int = 1  # hybrid PPxDP: replicas per stage
 
+    # Auto-parallelism: profile the model and choose stage bounds with the
+    # hierarchical partitioner before building the pipeline strategies
+    # (reference: the whole PipeDream phase 1-3 pipeline).
+    auto_partition: bool = False
+    profile_mode: str = "flops"  # "flops" (device-free) | "time" (measured)
+
     # Numerics.
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
     param_dtype: str = "float32"

@@ -1,0 +1,113 @@
+"""Multi-host initialization and topology-aware mesh construction.
+
+The reference reaches multi-node through SLURM + ssh + per-rank process spawns
+with hand-computed global ranks (run_template.sh:539-558,
+pipedream_run.sh:83-101) over NCCL/Gloo/MPI. The TPU equivalent is one process
+per host in a single `jax.distributed` world: every process sees the global
+device list, and all cross-chip traffic is XLA collectives over ICI (within a
+slice) or DCN (across slices/hosts).
+
+`initialize()` is a no-op on single-process runs, so every entry point can
+call it unconditionally; on multi-host it reads either explicit env
+(DDLB_COORDINATOR, DDLB_NUM_PROCESSES, DDLB_PROCESS_ID) or defers to JAX's
+TPU auto-detection.
+
+`make_mesh()` builds meshes with DCN-friendly axis ordering: axes that carry
+heavy, latency-tolerant traffic (data parallel) span hosts, while
+bandwidth-hungry axes (pipeline stage transfers, sequence rings) stay inside a
+slice — the layout the partitioner's cost model assumes.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+_initialized = False
+
+
+def initialize() -> bool:
+    """Join the jax.distributed world if configured; returns True if multi-host."""
+    global _initialized
+    if _initialized:
+        return jax.process_count() > 1
+    coord = os.environ.get("DDLB_COORDINATOR")
+    nproc = os.environ.get("DDLB_NUM_PROCESSES")
+    pid = os.environ.get("DDLB_PROCESS_ID")
+    try:
+        if coord and nproc and pid:
+            jax.distributed.initialize(
+                coordinator_address=coord,
+                num_processes=int(nproc),
+                process_id=int(pid),
+            )
+            _initialized = True
+        elif os.environ.get("DDLB_AUTO_DISTRIBUTED") == "1":
+            jax.distributed.initialize()  # TPU metadata auto-detection
+            _initialized = True
+    except Exception as e:  # pragma: no cover - depends on environment
+        print(f"jax.distributed.initialize failed: {e}", flush=True)
+    return jax.process_count() > 1
+
+
+def make_mesh(axis_sizes: Sequence[Tuple[str, int]],
+              devices: Optional[Sequence[jax.Device]] = None,
+              dcn_axis: Optional[str] = None) -> Mesh:
+    """Build a mesh with the named axes.
+
+    axis_sizes: ordered (name, size) pairs, fastest-varying last. If dcn_axis
+    is given and the run spans multiple processes/slices, that axis is mapped
+    across hosts via mesh_utils.create_hybrid_device_mesh so its collectives
+    ride DCN and everything else stays on ICI.
+    """
+    names = [n for n, _ in axis_sizes]
+    sizes = [s for _, s in axis_sizes]
+    total = int(np.prod(sizes))
+    devs = list(devices or jax.devices())
+    if len(devs) < total:
+        raise ValueError(f"need {total} devices, have {len(devs)}")
+    devs = devs[:total]
+
+    if dcn_axis is not None and jax.process_count() > 1 and devices is None:
+        try:
+            from jax.experimental import mesh_utils
+
+            dcn_idx = names.index(dcn_axis)
+            per_slice = list(sizes)
+            dcn = [1] * len(sizes)
+            dcn[dcn_idx] = jax.process_count()
+            if per_slice[dcn_idx] % jax.process_count():
+                raise ValueError(
+                    f"axis {dcn_axis} ({per_slice[dcn_idx]}) must divide across "
+                    f"{jax.process_count()} processes"
+                )
+            per_slice[dcn_idx] //= jax.process_count()
+            arr = mesh_utils.create_hybrid_device_mesh(
+                per_slice, dcn_mesh_shape=dcn
+            )
+            return Mesh(arr, axis_names=tuple(names))
+        except Exception:
+            pass  # fall back to plain reshape below
+
+    if devices is None and total > 1:
+        try:
+            from jax.experimental import mesh_utils
+
+            arr = mesh_utils.create_device_mesh(sizes, devices=devs)
+            return Mesh(arr, axis_names=tuple(names))
+        except Exception:
+            pass
+    return Mesh(np.array(devs).reshape(sizes), axis_names=tuple(names))
+
+
+def local_batch_slice(global_batch: int) -> slice:
+    """This process's slice of a host-generated global batch (data staging for
+    multi-host: each host materializes only its shard)."""
+    n = jax.process_count()
+    i = jax.process_index()
+    per = global_batch // n
+    return slice(i * per, (i + 1) * per)

@@ -24,6 +24,30 @@ from ddlbench_tpu.models.zoo import get_model
 def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None):
     cfg.validate()
     model = get_model(cfg.arch, cfg.benchmark)
+
+    stage_bounds = None
+    if cfg.auto_partition and cfg.strategy in ("gpipe", "pipedream"):
+        # profile -> partition: the reference's PipeDream phases 1-3
+        # (profiler main.py -> optimizer_graph_hierarchical.py ->
+        # convert_graph_to_model.py) collapsed into two calls.
+        from ddlbench_tpu.partition.optimizer import (
+            partition_hierarchical,
+            stage_bounds_from_graph,
+        )
+        from ddlbench_tpu.profiler.profile import profile_model
+
+        mb, _ = cfg.resolved_batches()
+        graph = profile_model(model, mb, mode=cfg.profile_mode, hw=cfg.hardware)
+        stage_bounds = stage_bounds_from_graph(graph, cfg.resolved_stages())
+        plan = partition_hierarchical(
+            graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
+        )
+        print(
+            f"auto-partition: bounds={stage_bounds}; unconstrained plan: "
+            f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
+            f"bottleneck {plan.pipeline_time_ms:.3f} ms",
+            flush=True,
+        )
     if cfg.strategy == "single":
         from ddlbench_tpu.parallel.single import SingleStrategy
 
@@ -36,11 +60,11 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
     if cfg.strategy == "gpipe":
         from ddlbench_tpu.parallel.gpipe import GPipeStrategy
 
-        return GPipeStrategy(model, cfg, devices=devices)
+        return GPipeStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)
     if cfg.strategy == "pipedream":
         from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
 
-        return PipeDreamStrategy(model, cfg, devices=devices)
+        return PipeDreamStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)
     if cfg.strategy == "sp":
         from ddlbench_tpu.parallel.sp import SPStrategy
 

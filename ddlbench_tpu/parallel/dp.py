@@ -42,12 +42,10 @@ from ddlbench_tpu.parallel.single import TrainState
 
 
 def make_data_mesh(num_devices: int, devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    devs = list(devices or jax.devices())[:num_devices]
-    if len(devs) < num_devices:
-        raise ValueError(f"need {num_devices} devices, have {len(devs)}")
-    import numpy as np
+    from ddlbench_tpu.distributed import make_mesh
 
-    return Mesh(np.array(devs), axis_names=("data",))
+    # DP allreduce tolerates DCN latency; the 'data' axis spans hosts.
+    return make_mesh([("data", num_devices)], devices=devices, dcn_axis="data")
 
 
 class DPStrategy:

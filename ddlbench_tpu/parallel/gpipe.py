@@ -71,12 +71,15 @@ class PipeTrainState(NamedTuple):
 
 def make_pipe_mesh(num_stages: int, dp_replicas: int,
                    devices: Optional[Sequence[jax.Device]] = None) -> Mesh:
-    devs = list(devices or jax.devices())
-    need = num_stages * dp_replicas
-    if len(devs) < need:
-        raise ValueError(f"need {need} devices, have {len(devs)}")
-    arr = np.array(devs[:need]).reshape(dp_replicas, num_stages)
-    return Mesh(arr, axis_names=("data", "stage"))
+    from ddlbench_tpu.distributed import make_mesh
+
+    # 'stage' transfers are bandwidth-hungry: keep them on ICI; the 'data'
+    # replica axis may span hosts over DCN.
+    return make_mesh(
+        [("data", dp_replicas), ("stage", num_stages)],
+        devices=devices,
+        dcn_axis="data",
+    )
 
 
 class GPipeStrategy:
