@@ -56,7 +56,7 @@ DATASETS: Mapping[str, DatasetSpec] = {
     "longctx": DatasetSpec("longctx", (8192,), 32_768, 20_000, 2_000, kind="tokens"),
 }
 
-STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp")
+STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp", "tp", "fsdp")
 
 # Per-framework default batch sizes from the reference harness
 # (run_template.sh:186-266,377-394; see BASELINE.md). For gpipe the tuple is
@@ -207,8 +207,9 @@ class RunConfig:
         For single/dp, num_microbatches == 1 and micro_batch_size is the
         per-device batch. Defaults follow the reference matrix (BASELINE.md).
         """
-        if self.strategy in ("single", "dp", "sp"):
-            b = self.batch_size or DEFAULT_BATCH[self.strategy][self.benchmark]
+        if self.strategy in ("single", "dp", "sp", "tp", "fsdp"):
+            key = self.strategy if self.strategy in DEFAULT_BATCH else "dp"
+            b = self.batch_size or DEFAULT_BATCH[key][self.benchmark]
             return int(b), 1
         if self.strategy == "gpipe":
             mb, chunks = DEFAULT_BATCH["gpipe"][self.benchmark]
@@ -227,9 +228,9 @@ class RunConfig:
 
     def global_batch(self) -> int:
         mb, chunks = self.resolved_batches()
-        if self.strategy in ("single", "sp"):
-            return mb  # sp shards the sequence axis, not the batch
-        if self.strategy == "dp":
+        if self.strategy in ("single", "sp", "tp"):
+            return mb  # sp/tp shard sequence/features, not the batch
+        if self.strategy in ("dp", "fsdp"):
             return mb * self.num_devices
         return mb * chunks * max(1, self.dp_replicas)
 

@@ -69,4 +69,12 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         from ddlbench_tpu.parallel.sp import SPStrategy
 
         return SPStrategy(model, cfg, devices=devices)
+    if cfg.strategy == "tp":
+        from ddlbench_tpu.parallel.sharded import TPStrategy
+
+        return TPStrategy(model, cfg, devices=devices)
+    if cfg.strategy == "fsdp":
+        from ddlbench_tpu.parallel.sharded import FSDPStrategy
+
+        return FSDPStrategy(model, cfg, devices=devices)
     raise ValueError(cfg.strategy)

@@ -1,0 +1,169 @@
+"""Tensor-parallel and FSDP/ZeRO strategies via sharding annotations.
+
+Neither exists in the reference (SURVEY.md §2E marks TP and FSDP/ZeRO absent,
+with TP "recommended — cheap under XLA SPMD"). Under XLA both modes are the
+same program as data parallelism with different *placement annotations*; the
+SPMD partitioner derives the collectives:
+
+* `tp` (strategy='tp'): parameters sharded on their output-feature axis over a
+  'model' mesh axis, batch replicated. XLA partitions every matmul/conv
+  channel-wise and inserts the activation all-reduces — Megatron-style tensor
+  parallelism without a single explicit collective in user code.
+* `fsdp` (strategy='fsdp'): batch sharded over 'data' AND every parameter
+  sharded over the same axis (largest divisible dimension). XLA all-gathers
+  each layer's weights on use and reduce-scatters gradients — ZeRO-3
+  semantics, weights live sharded in HBM.
+
+Both reuse the single-device train-step math; only init/sharding differ.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
+from ddlbench_tpu.parallel.common import (
+    accuracy,
+    cast_input,
+    cast_params,
+    cross_entropy_loss,
+    sgd_init,
+    sgd_update,
+)
+from ddlbench_tpu.parallel.single import TrainState
+
+
+def _leaf_spec(x: jax.Array, axis: str, size: int, prefer_last: bool) -> P:
+    """Choose one divisible dimension to shard (None spec if nothing fits)."""
+    if not hasattr(x, "shape") or x.ndim == 0:
+        return P()
+    dims = range(x.ndim - 1, -1, -1) if prefer_last else range(x.ndim)
+    best = None
+    for d in dims:
+        if x.shape[d] % size == 0 and x.shape[d] >= size:
+            if prefer_last:
+                best = d
+                break
+            if best is None or x.shape[d] > x.shape[best]:
+                best = d
+    if best is None:
+        return P()
+    spec = [None] * x.ndim
+    spec[best] = axis
+    return P(*spec)
+
+
+class _ShardedParamStrategy:
+    """Shared machinery: single-step math + per-leaf parameter shardings."""
+
+    axis_name: str
+    batch_sharded: bool
+    prefer_last: bool
+
+    def __init__(self, model: LayerModel, cfg: RunConfig,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        from ddlbench_tpu.distributed import make_mesh
+
+        self.model = model
+        self.cfg = cfg
+        self.mesh = make_mesh([(self.axis_name, cfg.num_devices)],
+                              devices=devices,
+                              dcn_axis=self.axis_name if self.batch_sharded else None)
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        mom = cfg.resolved_momentum()
+        wd = cfg.resolved_weight_decay()
+        n = self.mesh.devices.size
+
+        if self.batch_sharded:
+            self._batch_sharding = NamedSharding(self.mesh, P(self.axis_name))
+        else:
+            self._batch_sharding = NamedSharding(self.mesh, P())
+
+        def train_step(ts: TrainState, x, y, lr):
+            def loss_fn(params):
+                p = cast_params(params, self.compute_dtype)
+                logits, new_state = apply_model(
+                    model, p, ts.model_state, cast_input(x, self.compute_dtype), True
+                )
+                return cross_entropy_loss(logits, y), (logits, new_state)
+
+            (loss, (logits, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            metrics = {"loss": loss, "accuracy": accuracy(logits, y)}
+            return TrainState(params, new_state, opt), metrics
+
+        def eval_step(ts: TrainState, x, y):
+            p = cast_params(ts.params, self.compute_dtype)
+            logits, _ = apply_model(
+                model, p, ts.model_state, cast_input(x, self.compute_dtype), False
+            )
+            return {
+                "loss": cross_entropy_loss(logits, y),
+                "correct": jnp.sum(jnp.argmax(logits, -1) == y),
+                "count": jnp.asarray(y.size, jnp.int32),
+            }
+
+        self.train_step = jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
+        )
+        self.eval_step = jax.jit(
+            eval_step,
+            in_shardings=(None, self._batch_sharding, self._batch_sharding),
+        )
+
+    def _state_sharding(self, ts: TrainState):
+        n = self.mesh.devices.size
+
+        def leaf_sh(x):
+            return NamedSharding(
+                self.mesh, _leaf_spec(x, self.axis_name, n, self.prefer_last)
+            )
+
+        param_sh = jax.tree.map(leaf_sh, ts.params)
+        return TrainState(
+            params=param_sh,
+            model_state=jax.tree.map(
+                lambda x: NamedSharding(self.mesh, P()), ts.model_state
+            ),
+            opt=type(ts.opt)(momentum=param_sh),
+        )
+
+    def init(self, key) -> TrainState:
+        params, state, _ = init_model(self.model, key)
+        ts = TrainState(params, state, sgd_init(params))
+        return jax.device_put(ts, self._state_sharding(ts))
+
+    def shard_batch(self, x, y):
+        return (
+            jax.device_put(x, self._batch_sharding),
+            jax.device_put(y, self._batch_sharding),
+        )
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size
+
+
+class TPStrategy(_ShardedParamStrategy):
+    """strategy='tp': Megatron-style tensor parallelism from annotations."""
+
+    axis_name = "model"
+    batch_sharded = False
+    prefer_last = True
+
+
+class FSDPStrategy(_ShardedParamStrategy):
+    """strategy='fsdp': ZeRO-3 — batch and parameters sharded on 'data'."""
+
+    axis_name = "data"
+    batch_sharded = True
+    prefer_last = False
