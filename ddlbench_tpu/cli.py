@@ -40,6 +40,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--dp-replicas", type=int, default=1)
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--moe-aux-weight", type=float, default=0.01,
+                   help="MoE router load-balance loss weight (MoE archs)")
+    p.add_argument("--moe-capacity-factor", type=float, default=1.25,
+                   help="MoE expert capacity = ceil(cf * tokens / experts)")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
@@ -75,6 +79,8 @@ def config_from_args(args) -> RunConfig:
         dp_replicas=args.dp_replicas,
         steps_per_epoch=args.steps_per_epoch,
         lr=args.lr,
+        moe_aux_weight=args.moe_aux_weight,
+        moe_capacity_factor=args.moe_capacity_factor,
         compute_dtype=args.dtype,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,

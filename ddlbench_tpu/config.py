@@ -56,7 +56,7 @@ DATASETS: Mapping[str, DatasetSpec] = {
     "longctx": DatasetSpec("longctx", (8192,), 32_768, 20_000, 2_000, kind="tokens"),
 }
 
-STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp", "tp", "fsdp")
+STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp", "tp", "fsdp", "ep")
 
 # Per-framework default batch sizes from the reference harness
 # (run_template.sh:186-266,377-394; see BASELINE.md). For gpipe the tuple is
@@ -80,6 +80,8 @@ DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
                   "synthtext": 64, "longctx": 8},
     "sp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
            "synthtext": 16, "longctx": 2},
+    # ep: per-device batch (batch and experts both shard the one mesh axis)
+    "ep": {"synthtext": 8, "longctx": 1},
 }
 
 
@@ -159,6 +161,11 @@ class RunConfig:
     auto_partition: bool = False
     profile_mode: str = "flops"  # "flops" (device-free) | "time" (measured)
 
+    # MoE (transformer_moe_* archs): Switch router load-balance loss weight
+    # and static per-expert capacity = ceil(cf * tokens / experts).
+    moe_aux_weight: float = 0.01
+    moe_capacity_factor: float = 1.25
+
     # Numerics.
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
     param_dtype: str = "float32"
@@ -207,7 +214,7 @@ class RunConfig:
         For single/dp, num_microbatches == 1 and micro_batch_size is the
         per-device batch. Defaults follow the reference matrix (BASELINE.md).
         """
-        if self.strategy in ("single", "dp", "sp", "tp", "fsdp"):
+        if self.strategy in ("single", "dp", "sp", "tp", "fsdp", "ep"):
             key = self.strategy if self.strategy in DEFAULT_BATCH else "dp"
             b = self.batch_size or DEFAULT_BATCH[key][self.benchmark]
             return int(b), 1
@@ -230,7 +237,7 @@ class RunConfig:
         mb, chunks = self.resolved_batches()
         if self.strategy in ("single", "sp", "tp"):
             return mb  # sp/tp shard sequence/features, not the batch
-        if self.strategy in ("dp", "fsdp"):
+        if self.strategy in ("dp", "fsdp", "ep"):
             return mb * self.num_devices
         return mb * chunks * max(1, self.dp_replicas)
 
@@ -243,6 +250,11 @@ class RunConfig:
             raise ValueError("single strategy uses exactly 1 device")
         if self.strategy == "sp" and self.dataset().kind != "tokens":
             raise ValueError("sp (sequence parallelism) requires a token benchmark")
+        if self.strategy == "ep":
+            if self.dataset().kind != "tokens":
+                raise ValueError("ep (expert parallelism) requires a token benchmark")
+            if "moe" not in self.arch:
+                raise ValueError("ep (expert parallelism) requires an MoE arch")
         if self.strategy in ("gpipe", "pipedream"):
             s = self.resolved_stages()
             if s * max(1, self.dp_replicas) != self.num_devices:

@@ -41,6 +41,34 @@ BN_MOMENTUM = 0.1  # torch's default BatchNorm momentum
 BN_EPS = 1e-5
 
 
+class axis_context:
+    """Trace-time marker that a named mesh axis is active for model applies.
+
+    Subclasses declare their own class-level ``_stack``; entering pushes the
+    axis name and ``current()`` peeks it. This is how one model definition
+    serves multiple execution modes: sequence_parallel (ring attention,
+    models/transformer.py) and expert_parallel (MoE all_to_all dispatch,
+    models/moe.py) are both instances.
+    """
+
+    _stack: List[str]
+
+    def __init__(self, axis: str):
+        self.axis = axis
+
+    def __enter__(self):
+        type(self)._stack.append(self.axis)
+        return self
+
+    def __exit__(self, *exc):
+        type(self)._stack.pop()
+        return False
+
+    @classmethod
+    def current(cls):
+        return cls._stack[-1] if cls._stack else None
+
+
 @dataclasses.dataclass(frozen=True)
 class Layer:
     """One pipeline-atomic unit of a model."""

@@ -22,7 +22,7 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ddlbench_tpu.models.layers import Layer, LayerModel
+from ddlbench_tpu.models.layers import Layer, LayerModel, axis_context
 
 LN_EPS = 1e-5
 
@@ -31,29 +31,17 @@ _VARIANTS = {
     "transformer_m": dict(d_model=768, n_layers=12, n_heads=12),
 }
 
-# Sequence-parallel context: when set (by parallel/sp.py inside its shard_map),
-# embed offsets positions by the shard index and attention runs the ring
-# algorithm over the named mesh axis. One model definition serves both modes.
-_SEQ_AXIS: list = []
+class sequence_parallel(axis_context):
+    """Context manager: trace model applies in sequence-parallel mode. When
+    active (parallel/sp.py enters it inside its shard_map), embed offsets
+    positions by the shard index and attention runs the ring algorithm over
+    the named mesh axis. One model definition serves both modes."""
 
-
-class sequence_parallel:
-    """Context manager: trace model applies in sequence-parallel mode."""
-
-    def __init__(self, axis: str):
-        self.axis = axis
-
-    def __enter__(self):
-        _SEQ_AXIS.append(self.axis)
-        return self
-
-    def __exit__(self, *exc):
-        _SEQ_AXIS.pop()
-        return False
+    _stack: list = []
 
 
 def _seq_axis():
-    return _SEQ_AXIS[-1] if _SEQ_AXIS else None
+    return sequence_parallel.current()
 
 
 def layer_norm(p, x):
@@ -162,6 +150,29 @@ def ring_attention(q, k, v, axis: str):
     return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
 
 
+def attention_sublayer(p, x, n_heads: int):
+    """Pre-LN causal self-attention sublayer with residual: reads p["ln1"],
+    p["wqkv"], p["wo"]. Dispatches to ring attention over the active
+    sequence_parallel axis, so every block (dense and MoE) gets the
+    sequence-parallel path from one implementation."""
+    B, T, d = x.shape
+    dh = d // n_heads
+    h = layer_norm(p["ln1"], x)
+    qkv = h @ p["wqkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+
+    def heads(t):
+        return t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+
+    axis = _seq_axis()
+    if axis is None:
+        o = causal_attention(heads(q), heads(k), heads(v))
+    else:
+        o = ring_attention(heads(q), heads(k), heads(v), axis)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
+    return x + o @ p["wo"].astype(x.dtype)
+
+
 def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4) -> Layer:
     dh = d_model // n_heads
 
@@ -182,21 +193,7 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4)
         return p, {}, (T, d)
 
     def apply(p, s, x, train):
-        B, T, d = x.shape
-        h = layer_norm(p["ln1"], x)
-        qkv = h @ p["wqkv"].astype(x.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-
-        def heads(t):
-            return t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
-
-        axis = _seq_axis()
-        if axis is None:
-            o = causal_attention(heads(q), heads(k), heads(v))
-        else:
-            o = ring_attention(heads(q), heads(k), heads(v), axis)
-        o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
-        x = x + o @ p["wo"].astype(x.dtype)
+        x = attention_sublayer(p, x, n_heads)
         h = layer_norm(p["ln2"], x)
         h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
         x = x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))

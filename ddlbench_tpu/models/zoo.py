@@ -14,16 +14,25 @@ from ddlbench_tpu.models.resnet import build_resnet
 from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
-               "mobilenetv2", "transformer_s", "transformer_m")
+               "mobilenetv2", "transformer_s", "transformer_m",
+               "transformer_moe_s")
 
 
-def get_model(arch: str, dataset: str | DatasetSpec) -> LayerModel:
+def get_model(arch: str, dataset: str | DatasetSpec,
+              moe_capacity_factor: float = 1.25) -> LayerModel:
     spec = dataset if isinstance(dataset, DatasetSpec) else DATASETS[dataset]
     if arch.startswith("transformer"):
-        from ddlbench_tpu.models.transformer import build_transformer
-
         if spec.kind != "tokens":
             raise ValueError(f"{arch} requires a token dataset, got {spec.name}")
+        if "moe" in arch:
+            from ddlbench_tpu.models.moe import build_transformer_moe
+
+            return build_transformer_moe(
+                arch, spec.image_size, spec.num_classes,
+                capacity_factor=moe_capacity_factor,
+            )
+        from ddlbench_tpu.models.transformer import build_transformer
+
         return build_transformer(arch, spec.image_size, spec.num_classes)
     if spec.kind != "image":
         raise ValueError(f"{arch} requires an image dataset, got {spec.name}")

@@ -23,7 +23,8 @@ from ddlbench_tpu.models.zoo import get_model
 
 def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None):
     cfg.validate()
-    model = get_model(cfg.arch, cfg.benchmark)
+    model = get_model(cfg.arch, cfg.benchmark,
+                      moe_capacity_factor=cfg.moe_capacity_factor)
 
     stage_bounds = None
     if cfg.auto_partition and cfg.strategy in ("gpipe", "pipedream"):
@@ -77,4 +78,8 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         from ddlbench_tpu.parallel.sharded import FSDPStrategy
 
         return FSDPStrategy(model, cfg, devices=devices)
+    if cfg.strategy == "ep":
+        from ddlbench_tpu.parallel.ep import EPStrategy
+
+        return EPStrategy(model, cfg, devices=devices)
     raise ValueError(cfg.strategy)

@@ -90,3 +90,26 @@ def cast_input(x, dtype):
     if dtype is None or not jnp.issubdtype(x.dtype, jnp.floating):
         return x
     return x.astype(dtype)
+
+
+def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
+                      aux_weight):
+    """Apply the model and return (total_loss, ce, logits, new_state).
+
+    total_loss = cross-entropy + aux_weight * (MoE router load-balance losses
+    collected during the apply — zero for dense models). Shared by every
+    strategy whose loss is computed from one traced apply (single/dp/tp/fsdp);
+    sp/ep inline the same pattern because their aux terms need a psum over the
+    shard_map axis first.
+    """
+    from ddlbench_tpu.models.layers import apply_model
+    from ddlbench_tpu.models.moe import collect_aux_losses
+
+    p = cast_params(params, compute_dtype)
+    aux: list = []
+    with collect_aux_losses(aux):
+        logits, new_state = apply_model(
+            model, p, model_state, cast_input(x, compute_dtype), train
+        )
+    ce = cross_entropy_loss(logits, y)
+    return ce + aux_weight * sum(aux, jnp.float32(0.0)), ce, logits, new_state

@@ -32,6 +32,7 @@ from ddlbench_tpu.parallel.common import (
     cast_input,
     cast_params,
     cross_entropy_loss,
+    loss_with_moe_aux,
     sgd_init,
     sgd_update,
 )
@@ -86,17 +87,17 @@ class _ShardedParamStrategy:
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
-                p = cast_params(params, self.compute_dtype)
-                logits, new_state = apply_model(
-                    model, p, ts.model_state, cast_input(x, self.compute_dtype), True
+                loss, ce, logits, new_state = loss_with_moe_aux(
+                    model, params, ts.model_state, x, y, True,
+                    self.compute_dtype, cfg.moe_aux_weight,
                 )
-                return cross_entropy_loss(logits, y), (logits, new_state)
+                return loss, (ce, logits, new_state)
 
-            (loss, (logits, new_state)), grads = jax.value_and_grad(
+            (_, (ce, logits, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
-            metrics = {"loss": loss, "accuracy": accuracy(logits, y)}
+            metrics = {"loss": ce, "accuracy": accuracy(logits, y)}
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):

@@ -67,14 +67,21 @@ class SPStrategy:
         cdtype = self.compute_dtype
 
         def fwd_local(params, state, xl, yl, train: bool):
-            with sequence_parallel("seq"):
+            from ddlbench_tpu.models.moe import collect_aux_losses
+
+            aux: list = []
+            with sequence_parallel("seq"), collect_aux_losses(aux):
                 logits, new_state = apply_model(
                     model, cast_params(params, cdtype), state, xl, train
                 )
             nll, correct, cnt = _local_ce_sums(logits, yl)
-            loss = lax.psum(nll, "seq") / lax.psum(jnp.float32(cnt), "seq")
+            ce = lax.psum(nll, "seq") / lax.psum(jnp.float32(cnt), "seq")
+            # MoE router load-balance term, averaged over sequence shards
+            # (empty list for dense models).
+            aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), "seq") / n
+            loss = ce + cfg.moe_aux_weight * aux_loss
             correct = lax.psum(correct, "seq")
-            return loss, correct, new_state
+            return loss, ce, correct, new_state
 
         def make_sharded(train: bool):
             def inner(params, state, xl, yl):
@@ -84,7 +91,7 @@ class SPStrategy:
                 inner,
                 mesh=self.mesh,
                 in_specs=(P(), P(), P(None, "seq"), P(None, "seq")),
-                out_specs=(P(), P(), P()),
+                out_specs=(P(), P(), P(), P()),
             )
 
         sp_train = make_sharded(True)
@@ -92,23 +99,23 @@ class SPStrategy:
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
-                loss, correct, new_state = sp_train(params, ts.model_state, x, y)
-                return loss, (correct, new_state)
+                loss, ce, correct, new_state = sp_train(params, ts.model_state, x, y)
+                return loss, (ce, correct, new_state)
 
-            (loss, (correct, new_state)), grads = jax.value_and_grad(
+            (_, (ce, correct, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
             metrics = {
-                "loss": loss,
+                "loss": ce,
                 "accuracy": correct.astype(jnp.float32) / y.size,
             }
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
-            loss, correct, _ = sp_eval(ts.params, ts.model_state, x, y)
+            _, ce, correct, _ = sp_eval(ts.params, ts.model_state, x, y)
             return {
-                "loss": loss,
+                "loss": ce,
                 "correct": correct,
                 "count": jnp.asarray(y.size, jnp.int32),
             }

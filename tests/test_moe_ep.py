@@ -1,0 +1,213 @@
+"""MoE transformer + expert parallelism.
+
+Key equivalences: with no tokens dropped, the expert-parallel step (experts +
+batch sharded over one mesh axis, all_to_all dispatch) must match the dense
+single-device step exactly; the Switch router must respect static capacity;
+aux losses must be collected one per MoE layer.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from ddlbench_tpu.config import DatasetSpec, RunConfig
+from ddlbench_tpu.models import apply_model, init_model
+from ddlbench_tpu.models.moe import (
+    build_transformer_moe,
+    collect_aux_losses,
+    switch_route,
+)
+from ddlbench_tpu.parallel.ep import EPStrategy, expert_param_specs
+from ddlbench_tpu.parallel.single import SingleStrategy
+
+TINY_LM = DatasetSpec("tinylm", (32,), 64, 1000, 100, kind="tokens")
+N_EXPERTS = 8
+
+# Registered once at import so test order can't matter.
+import ddlbench_tpu.models.moe as _moe_mod  # noqa: E402
+
+_moe_mod._VARIANTS.setdefault(
+    "transformer_moe_t", dict(d_model=32, n_layers=2, n_heads=4, n_experts=N_EXPERTS)
+)
+
+
+def tiny_moe(capacity_factor=float(N_EXPERTS)):
+    """2 blocks (1 dense + 1 MoE, 8 experts); default capacity never drops."""
+    return build_transformer_moe(
+        "transformer_moe_t", TINY_LM.image_size, TINY_LM.num_classes,
+        capacity_factor=capacity_factor,
+    )
+
+
+def test_switch_route_capacity():
+    S, E, C = 12, 4, 2
+    # route all tokens to expert 1: only C survive, in order
+    logits = jnp.full((S, E), -5.0).at[:, 1].set(5.0)
+    dispatch, combine, aux = switch_route(logits, C)
+    assert dispatch.shape == (S, E, C)
+    got = np.asarray(jnp.sum(dispatch, axis=(1, 2)))
+    np.testing.assert_array_equal(got, [1, 1] + [0] * (S - 2))
+    # every surviving combine weight is the chosen-expert softmax prob
+    probs = jax.nn.softmax(logits, axis=-1)[:, 1]
+    np.testing.assert_allclose(
+        np.asarray(jnp.sum(combine, axis=(1, 2))[:2]), np.asarray(probs[:2]),
+        rtol=1e-6,
+    )
+    # fully imbalanced top-1 routing maximizes the aux loss: E * 1 * P_max
+    assert float(aux) > 1.0
+
+
+def test_moe_forward_and_aux_collection():
+    model = tiny_moe()
+    params, state, shapes = init_model(model, jax.random.key(0))
+    assert shapes[-1] == (32, 64)
+    x = jax.random.randint(jax.random.key(1), (2, 32), 0, 64)
+    aux: list = []
+    with collect_aux_losses(aux):
+        logits, _ = apply_model(model, params, state, x, train=True)
+    assert logits.shape == (2, 32, 64)
+    assert np.all(np.isfinite(np.asarray(logits)))
+    assert len(aux) == 1  # exactly one MoE layer in 2 blocks
+    assert float(aux[0]) >= 1.0 - 1e-5  # aux is minimized at 1 (uniform)
+
+
+def test_moe_capacity_drop_is_residual():
+    """With capacity ~0 every token is dropped: the MoE MLP contributes
+    nothing and the block reduces to attention + residual."""
+    model = tiny_moe(capacity_factor=1e-9)  # capacity clamps to 1 slot
+    params, state, _ = init_model(model, jax.random.key(0))
+    x = jax.random.randint(jax.random.key(1), (2, 32), 0, 64)
+    logits, _ = apply_model(model, params, state, x, train=True)
+    assert np.all(np.isfinite(np.asarray(logits)))
+
+
+def test_expert_param_specs():
+    model = tiny_moe()
+    params, _, _ = init_model(model, jax.random.key(0))
+    specs = expert_param_specs(params)
+    flat = jax.tree_util.tree_leaves_with_path(params)
+    sflat = jax.tree.leaves(
+        specs, is_leaf=lambda x: str(type(x).__name__) == "PartitionSpec"
+    )
+    assert len(flat) == len(sflat)
+    n_sharded = sum(1 for s in sflat if len(s) and s[0] == "expert")
+    assert n_sharded == 4  # w1, b1, w2, b2 of the one MoE layer
+
+
+def test_ep_matches_dense_single(devices):
+    model = tiny_moe()  # cf=8 -> no token ever dropped, local or global
+    B = 8
+    cfg = RunConfig(strategy="ep", benchmark="synthtext",
+                    arch="transformer_moe_t", num_devices=8, batch_size=1,
+                    compute_dtype="float32", momentum=0.5, weight_decay=0.0,
+                    moe_aux_weight=0.0)
+    ep = EPStrategy(model, cfg)
+    single = SingleStrategy(model, cfg.replace(strategy="single", num_devices=1))
+
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 64)
+    lr = jnp.float32(0.1)
+
+    ts_ep = ep.init(jax.random.key(0))
+    # expert leaves actually sharded, momentum buffers too
+    specs = {str(l.sharding.spec) for l in jax.tree.leaves(ts_ep.params)}
+    assert any("expert" in s for s in specs), specs
+    specs_m = {str(l.sharding.spec) for l in jax.tree.leaves(ts_ep.opt.momentum)}
+    assert any("expert" in s for s in specs_m), specs_m
+
+    ts_1 = single.init(jax.random.key(0))
+    ts_ep2, m_ep = ep.train_step(ts_ep, *ep.shard_batch(x, y), lr)
+    ts_12, m_1 = single.train_step(ts_1, x, y, lr)
+
+    np.testing.assert_allclose(float(m_ep["loss"]), float(m_1["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(
+        float(m_ep["accuracy"]), float(m_1["accuracy"]), atol=1e-6
+    )
+    a = ravel_pytree(jax.device_get(ts_ep2.params))[0]
+    b = ravel_pytree(ts_12.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_ep_with_aux_loss_trains(devices):
+    model = tiny_moe(capacity_factor=1.25)
+    cfg = RunConfig(strategy="ep", benchmark="synthtext",
+                    arch="transformer_moe_t", num_devices=8, batch_size=1,
+                    compute_dtype="float32", momentum=0.5, weight_decay=0.0,
+                    moe_aux_weight=0.01)
+    ep = EPStrategy(model, cfg)
+    x = jax.random.randint(jax.random.key(1), (8, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (8, 32), 0, 64)
+    ts = ep.init(jax.random.key(0))
+    before = ravel_pytree(jax.device_get(ts.params))[0]
+    ts2, m = ep.train_step(ts, *ep.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
+    after = ravel_pytree(jax.device_get(ts2.params))[0]
+    assert not np.allclose(np.asarray(before), np.asarray(after))
+    # eval path
+    ev = ep.eval_step(ts2, *ep.shard_batch(x, y))
+    assert np.isfinite(float(ev["loss"]))
+    assert int(ev["count"]) == x.size
+
+
+def test_ep_config_validation():
+    with pytest.raises(ValueError, match="MoE arch"):
+        RunConfig(strategy="ep", benchmark="synthtext",
+                  arch="transformer_s", num_devices=8).validate()
+    with pytest.raises(ValueError, match="token benchmark"):
+        RunConfig(strategy="ep", benchmark="mnist",
+                  arch="transformer_moe_s", num_devices=8).validate()
+
+
+def test_fsdp_moe_includes_aux_loss(devices):
+    """tp/fsdp must train MoE with the same objective as single (incl. aux)."""
+    from ddlbench_tpu.parallel.sharded import FSDPStrategy
+
+    model = tiny_moe()
+    cfg = RunConfig(strategy="fsdp", benchmark="synthtext",
+                    arch="transformer_moe_t", num_devices=8, batch_size=1,
+                    compute_dtype="float32", momentum=0.5, weight_decay=0.0,
+                    moe_aux_weight=0.05)
+    fsdp = FSDPStrategy(model, cfg)
+    single = SingleStrategy(model, cfg.replace(strategy="single", num_devices=1))
+
+    x = jax.random.randint(jax.random.key(1), (8, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (8, 32), 0, 64)
+    lr = jnp.float32(0.1)
+    ts_f, _ = fsdp.train_step(fsdp.init(jax.random.key(0)),
+                              *fsdp.shard_batch(x, y), lr)
+    ts_1, _ = single.train_step(single.init(jax.random.key(0)), x, y, lr)
+    a = ravel_pytree(jax.device_get(ts_f.params))[0]
+    b = ravel_pytree(ts_1.params)[0]
+    # identical params only if both applied the identical aux-weighted grads
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_sp_moe_matches_single(devices):
+    """MoE blocks under sequence parallelism: the shared attention sublayer
+    must take the ring path, and the whole step must match single-device."""
+    from ddlbench_tpu.parallel.sp import SPStrategy
+
+    model = tiny_moe()  # cf=8 -> no drops with local or global routing
+    B = 2
+    cfg = RunConfig(strategy="sp", benchmark="synthtext",
+                    arch="transformer_moe_t", num_devices=4,
+                    compute_dtype="float32", momentum=0.5, weight_decay=0.0,
+                    moe_aux_weight=0.0)
+    sp = SPStrategy(model, cfg)
+    single = SingleStrategy(model, cfg.replace(strategy="single", num_devices=1))
+
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 64)
+    lr = jnp.float32(0.1)
+
+    ts_sp = sp.init(jax.random.key(0))
+    ts_1 = single.init(jax.random.key(0))
+    ts_sp2, m_sp = sp.train_step(ts_sp, *sp.shard_batch(x, y), lr)
+    ts_12, m_1 = single.train_step(ts_1, x, y, lr)
+
+    np.testing.assert_allclose(float(m_sp["loss"]), float(m_1["loss"]), rtol=1e-5)
+    a = ravel_pytree(jax.device_get(ts_sp2.params))[0]
+    b = ravel_pytree(ts_12.params)[0]
+    np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
