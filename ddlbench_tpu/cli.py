@@ -56,6 +56,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="save a checkpoint per epoch here (orbax)")
     p.add_argument("--resume", action="store_true",
                    help="resume from the latest checkpoint in --checkpoint-dir")
+    from ddlbench_tpu.train.watchdog import NAN_POLICIES
+
+    p.add_argument("--nan-policy", default="abort", choices=NAN_POLICIES,
+                   help="what to do when a loss goes non-finite")
+    p.add_argument("--hang-timeout-s", type=float, default=None,
+                   help="abort (with a stack dump) if any step takes longer "
+                        "than this; forces a per-step host sync while armed")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh)")
@@ -85,6 +92,8 @@ def config_from_args(args) -> RunConfig:
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
+        nan_policy=args.nan_policy,
+        hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
         profile_mode=args.profile_mode,
     )

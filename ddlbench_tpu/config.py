@@ -179,6 +179,12 @@ class RunConfig:
     checkpoint_dir: Optional[str] = None
     resume: bool = False
 
+    # Failure detection (reference has none beyond a 120-min process-group
+    # timeout, SURVEY.md §5.3): abort/warn/ignore on non-finite loss, and an
+    # optional per-sync hang deadline that stack-dumps and kills the process.
+    nan_policy: str = "abort"  # abort | warn | ignore
+    hang_timeout_s: Optional[float] = None
+
     hardware: HardwareModel = dataclasses.field(default_factory=HardwareModel)
 
     # ---- derived ----
@@ -248,6 +254,10 @@ class RunConfig:
             raise ValueError(f"unknown strategy {self.strategy!r}")
         if self.strategy == "single" and self.num_devices != 1:
             raise ValueError("single strategy uses exactly 1 device")
+        from ddlbench_tpu.train.watchdog import NAN_POLICIES
+
+        if self.nan_policy not in NAN_POLICIES:
+            raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
         if self.strategy == "sp" and self.dataset().kind != "tokens":
             raise ValueError("sp (sequence parallelism) requires a token benchmark")
         if self.strategy == "ep":

@@ -1,0 +1,169 @@
+"""Shared scaffolding for the shard_map-based one-axis strategies (sp, ep).
+
+Both sequence parallelism and expert parallelism are the same program shape:
+a 1-D mesh, a trace-time context that switches the model into the sharded
+execution mode, a shard_map'd forward computing psum-reduced (loss, ce,
+correct), value_and_grad around it (shard_map's transpose inserts the
+gradient collectives), and the shared SGD update. Subclasses provide only
+what actually differs: the axis name, the trace contexts, the param/batch
+partition specs, and the initial placement.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, apply_model, init_model
+from ddlbench_tpu.models.moe import collect_aux_losses
+from ddlbench_tpu.parallel.common import cast_params, sgd_init, sgd_update
+from ddlbench_tpu.parallel.gpipe import _shard_map
+from ddlbench_tpu.parallel.single import TrainState
+
+
+def _local_ce_sums(logits, labels):
+    """(sum of token NLL, sum of correct, count) over the local shard."""
+    logits = logits.astype(jnp.float32)
+    logp = jax.nn.log_softmax(logits, axis=-1)
+    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
+    correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
+    return -jnp.sum(ll), correct, labels.size
+
+
+class AxisShardedStrategy:
+    """Base for strategies that shard over ONE named mesh axis via shard_map."""
+
+    axis_name: str
+
+    def __init__(self, model: LayerModel, cfg: RunConfig,
+                 mesh: Optional[Mesh] = None,
+                 devices: Optional[Sequence[jax.Device]] = None):
+        self.model = model
+        self.cfg = cfg
+        devs = list(devices or jax.devices())[:cfg.num_devices]
+        if len(devs) < cfg.num_devices:
+            raise ValueError(f"need {cfg.num_devices} devices, have {len(devs)}")
+        self.mesh = mesh or Mesh(np.array(devs), axis_names=(self.axis_name,))
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        mom = cfg.resolved_momentum()
+        wd = cfg.resolved_weight_decay()
+        aux_w = cfg.moe_aux_weight
+        n = self.mesh.devices.size
+        axis = self.axis_name
+        self._check_divisibility(n)
+
+        self._replicated = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, self._batch_spec())
+        cdtype = self.compute_dtype
+
+        def fwd_local(params, state, xl, yl, train: bool):
+            aux: list = []
+            with contextlib.ExitStack() as stack:
+                for ctx in self._trace_contexts():
+                    stack.enter_context(ctx)
+                stack.enter_context(collect_aux_losses(aux))
+                logits, new_state = apply_model(
+                    model, cast_params(params, cdtype), state, xl, train
+                )
+            nll, correct, cnt = _local_ce_sums(logits, yl)
+            ce = lax.psum(nll, axis) / lax.psum(jnp.float32(cnt), axis)
+            # MoE router load-balance term, averaged over the axis shards
+            # (empty list for dense models).
+            aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), axis) / n
+            loss = ce + aux_w * aux_loss
+            correct = lax.psum(correct, axis)
+            return loss, ce, correct, new_state
+
+        def make_sharded(train: bool):
+            def inner(params, state, xl, yl):
+                return fwd_local(params, state, xl, yl, train)
+
+            return _shard_map(
+                inner,
+                mesh=self.mesh,
+                in_specs=(self._param_specs(), P(), self._batch_spec(),
+                          self._batch_spec()),
+                out_specs=(P(), P(), P(), P()),
+            )
+
+        fn_train = make_sharded(True)
+        fn_eval = make_sharded(False)
+
+        def train_step(ts: TrainState, x, y, lr):
+            def loss_fn(params):
+                loss, ce, correct, new_state = fn_train(params, ts.model_state, x, y)
+                return loss, (ce, correct, new_state)
+
+            (_, (ce, correct, new_state)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True
+            )(ts.params)
+            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            metrics = {
+                "loss": ce,  # headline metric stays comparable across strategies
+                "accuracy": correct.astype(jnp.float32) / y.size,
+            }
+            return TrainState(params, new_state, opt), metrics
+
+        def eval_step(ts: TrainState, x, y):
+            _, ce, correct, _ = fn_eval(ts.params, ts.model_state, x, y)
+            return {
+                "loss": ce,
+                "correct": correct,
+                "count": jnp.asarray(y.size, jnp.int32),
+            }
+
+        self.train_step = jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
+        )
+        self.eval_step = jax.jit(
+            eval_step,
+            in_shardings=(None, self._batch_sharding, self._batch_sharding),
+        )
+
+    # ---- subclass hooks -------------------------------------------------
+
+    def _check_divisibility(self, n: int) -> None:
+        """Raise if the model/config cannot be split n ways on this axis."""
+
+    def _trace_contexts(self):
+        """Context managers entered around the model apply (e.g. the
+        sequence_parallel / expert_parallel markers)."""
+        return ()
+
+    def _param_specs(self):
+        """PartitionSpec (pytree or prefix) for parameters inside shard_map."""
+        return P()
+
+    def _batch_spec(self) -> P:
+        """PartitionSpec for the (x, y) batch arrays."""
+        raise NotImplementedError
+
+    def _initial_state_sharding(self, ts: TrainState):
+        """Shardings for device_put of the freshly initialized TrainState."""
+        return self._replicated
+
+    # ---- uniform interface ---------------------------------------------
+
+    def init(self, key) -> TrainState:
+        params, state, _ = init_model(self.model, key)
+        ts = TrainState(params, state, sgd_init(params))
+        return jax.device_put(ts, self._initial_state_sharding(ts))
+
+    def shard_batch(self, x, y):
+        return (
+            jax.device_put(x, self._batch_sharding),
+            jax.device_put(y, self._batch_sharding),
+        )
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size

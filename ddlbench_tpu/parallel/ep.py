@@ -18,7 +18,8 @@ The reference has no MoE/EP anywhere (SURVEY.md §2E). TPU-native design:
   the whole point of EP.
 * The Switch router's load-balance loss is collected at trace time
   (models/moe.py collect_aux_losses) and added to the objective with weight
-  ``cfg.moe_aux_weight``; both terms are globally averaged with psum.
+  ``cfg.moe_aux_weight`` — handled uniformly by AxisShardedStrategy (shared
+  with sp).
 
 Dropped tokens (beyond expert capacity) pass through residually; capacity is
 static so the program has fixed shapes end to end.
@@ -26,26 +27,14 @@ static so the program has fixed shapes end to end.
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
-
 import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+from jax.sharding import NamedSharding, PartitionSpec as P
 
-from ddlbench_tpu.config import RunConfig
-from ddlbench_tpu.models.layers import LayerModel, apply_model, init_model
-from ddlbench_tpu.models.moe import collect_aux_losses, expert_parallel
-from ddlbench_tpu.parallel.common import (
-    SGDState,
-    cast_params,
-    sgd_init,
-    sgd_update,
-)
-from ddlbench_tpu.parallel.gpipe import _shard_map
+from ddlbench_tpu.models.layers import init_model
+from ddlbench_tpu.models.moe import expert_parallel
+from ddlbench_tpu.parallel.axis_sharded import AxisShardedStrategy
+from ddlbench_tpu.parallel.common import SGDState
 from ddlbench_tpu.parallel.single import TrainState
-from ddlbench_tpu.parallel.sp import _local_ce_sums
 
 
 def expert_param_specs(params, axis: str = "expert"):
@@ -63,121 +52,45 @@ def expert_param_specs(params, axis: str = "expert"):
     return jax.tree_util.tree_map_with_path(spec, params)
 
 
-class EPStrategy:
+class EPStrategy(AxisShardedStrategy):
     """strategy='ep': batch + experts sharded over one 'expert' mesh axis."""
 
-    def __init__(self, model: LayerModel, cfg: RunConfig,
-                 mesh: Optional[Mesh] = None,
-                 devices: Optional[Sequence[jax.Device]] = None):
-        self.model = model
-        self.cfg = cfg
-        devs = list(devices or jax.devices())[:cfg.num_devices]
-        if len(devs) < cfg.num_devices:
-            raise ValueError(f"need {cfg.num_devices} devices, have {len(devs)}")
-        self.mesh = mesh or Mesh(np.array(devs), axis_names=("expert",))
-        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
-        aux_w = cfg.moe_aux_weight
-        n = self.mesh.devices.size
+    axis_name = "expert"
 
-        # Shapes/specs need one abstract init; cheap (eval_shape, no compute).
-        p_shapes = jax.eval_shape(
-            lambda k: init_model(model, k)[0], jax.random.key(0)
+    def _abstract_params(self):
+        return jax.eval_shape(
+            lambda k: init_model(self.model, k)[0], jax.random.key(0)
         )
-        self._param_specs = expert_param_specs(p_shapes)
-        for leaf, sp in zip(jax.tree.leaves(p_shapes),
-                            jax.tree.leaves(self._param_specs,
-                                            is_leaf=lambda x: isinstance(x, P))):
-            if sp and sp[0] == "expert" and leaf.shape[0] % n:
+
+    def _check_divisibility(self, n: int) -> None:
+        p_shapes = self._abstract_params()
+        specs = expert_param_specs(p_shapes, self.axis_name)
+        for leaf, sp in zip(
+            jax.tree.leaves(p_shapes),
+            jax.tree.leaves(specs, is_leaf=lambda x: isinstance(x, P)),
+        ):
+            if len(sp) and sp[0] == self.axis_name and leaf.shape[0] % n:
                 raise ValueError(
                     f"{leaf.shape[0]} experts not divisible by {n} devices"
                 )
-        self._param_sharding = jax.tree.map(
-            lambda sp: NamedSharding(self.mesh, sp), self._param_specs,
+
+    def _trace_contexts(self):
+        return (expert_parallel(self.axis_name),)
+
+    def _param_specs(self):
+        return expert_param_specs(self._abstract_params(), self.axis_name)
+
+    def _batch_spec(self) -> P:
+        return P(self.axis_name)
+
+    def _initial_state_sharding(self, ts: TrainState):
+        param_sh = jax.tree.map(
+            lambda sp: NamedSharding(self.mesh, sp),
+            self._param_specs(),
             is_leaf=lambda x: isinstance(x, P),
         )
-        self._replicated = NamedSharding(self.mesh, P())
-        self._batch_sharding = NamedSharding(self.mesh, P("expert"))
-        cdtype = self.compute_dtype
-
-        def fwd_local(params, state, xl, yl, train: bool):
-            aux: list = []
-            with expert_parallel("expert"), collect_aux_losses(aux):
-                logits, new_state = apply_model(
-                    model, cast_params(params, cdtype), state, xl, train
-                )
-            nll, correct, cnt = _local_ce_sums(logits, yl)
-            ce = lax.psum(nll, "expert") / lax.psum(jnp.float32(cnt), "expert")
-            aux_loss = (
-                lax.psum(sum(aux, jnp.float32(0.0)), "expert") / n
-                if aux else jnp.float32(0.0)
-            )
-            correct = lax.psum(correct, "expert")
-            return ce + aux_w * aux_loss, ce, correct, new_state
-
-        def make_sharded(train: bool):
-            def inner(params, state, xl, yl):
-                return fwd_local(params, state, xl, yl, train)
-
-            return _shard_map(
-                inner,
-                mesh=self.mesh,
-                in_specs=(self._param_specs, P(), P("expert"), P("expert")),
-                out_specs=(P(), P(), P(), P()),
-            )
-
-        ep_train = make_sharded(True)
-        ep_eval = make_sharded(False)
-
-        def train_step(ts: TrainState, x, y, lr):
-            def loss_fn(params):
-                loss, ce, correct, new_state = ep_train(params, ts.model_state, x, y)
-                return loss, (ce, correct, new_state)
-
-            (_, (ce, correct, new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
-            metrics = {
-                "loss": ce,  # headline metric stays comparable across strategies
-                "accuracy": correct.astype(jnp.float32) / y.size,
-            }
-            return TrainState(params, new_state, opt), metrics
-
-        def eval_step(ts: TrainState, x, y):
-            _, ce, correct, _ = ep_eval(ts.params, ts.model_state, x, y)
-            return {
-                "loss": ce,
-                "correct": correct,
-                "count": jnp.asarray(y.size, jnp.int32),
-            }
-
-        self.train_step = jax.jit(
-            train_step,
-            donate_argnums=(0,),
-            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
+        return TrainState(
+            params=param_sh,
+            model_state=self._replicated,
+            opt=SGDState(momentum=param_sh),
         )
-        self.eval_step = jax.jit(
-            eval_step,
-            in_shardings=(None, self._batch_sharding, self._batch_sharding),
-        )
-
-    def init(self, key) -> TrainState:
-        params, state, _ = init_model(self.model, key)
-        params = jax.device_put(params, self._param_sharding)
-        state = jax.device_put(state, self._replicated)
-        opt = jax.device_put(
-            sgd_init(params), SGDState(momentum=self._param_sharding)
-        )
-        return TrainState(params, state, opt)
-
-    def shard_batch(self, x, y):
-        return (
-            jax.device_put(x, self._batch_sharding),
-            jax.device_put(y, self._batch_sharding),
-        )
-
-    @property
-    def world_size(self) -> int:
-        return self.mesh.devices.size

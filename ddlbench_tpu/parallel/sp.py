@@ -9,138 +9,32 @@ ring_attention) rotating K/V blocks over ICI neighbor links with an
 online-softmax accumulator, and every pointwise layer (LN, MLP, embeddings,
 head, loss) is trivially local. Parameters are replicated; shard_map's
 transpose inserts the gradient all-reduce, exactly as in DP.
+
+All step scaffolding lives in AxisShardedStrategy (shared with ep).
 """
 
 from __future__ import annotations
 
-from typing import Optional, Sequence
+from jax.sharding import PartitionSpec as P
 
-import jax
-import jax.numpy as jnp
-import numpy as np
-from jax import lax
-from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-
-from ddlbench_tpu.config import RunConfig
-from ddlbench_tpu.models.layers import LayerModel, apply_model, init_model
 from ddlbench_tpu.models.transformer import sequence_parallel
-from ddlbench_tpu.parallel.common import (
-    cast_params,
-    sgd_init,
-    sgd_update,
-)
-from ddlbench_tpu.parallel.gpipe import _shard_map
-from ddlbench_tpu.parallel.single import TrainState
+from ddlbench_tpu.parallel.axis_sharded import AxisShardedStrategy, _local_ce_sums
+
+__all__ = ["SPStrategy", "_local_ce_sums"]
 
 
-def _local_ce_sums(logits, labels):
-    """(sum of token NLL, sum of correct, count) over the local shard."""
-    logits = logits.astype(jnp.float32)
-    logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
-    return -jnp.sum(ll), correct, labels.size
-
-
-class SPStrategy:
+class SPStrategy(AxisShardedStrategy):
     """strategy='sp': activations sharded on the sequence axis, ring attention."""
 
-    def __init__(self, model: LayerModel, cfg: RunConfig,
-                 mesh: Optional[Mesh] = None,
-                 devices: Optional[Sequence[jax.Device]] = None):
-        self.model = model
-        self.cfg = cfg
-        devs = list(devices or jax.devices())[:cfg.num_devices]
-        if len(devs) < cfg.num_devices:
-            raise ValueError(f"need {cfg.num_devices} devices, have {len(devs)}")
-        self.mesh = mesh or Mesh(np.array(devs), axis_names=("seq",))
-        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
-        n = self.mesh.devices.size
-        T = model.in_shape[0]
+    axis_name = "seq"
+
+    def _check_divisibility(self, n: int) -> None:
+        T = self.model.in_shape[0]
         if T % n:
             raise ValueError(f"sequence length {T} not divisible by {n} devices")
 
-        self._replicated = NamedSharding(self.mesh, P())
-        self._batch_sharding = NamedSharding(self.mesh, P(None, "seq"))
-        cdtype = self.compute_dtype
+    def _trace_contexts(self):
+        return (sequence_parallel(self.axis_name),)
 
-        def fwd_local(params, state, xl, yl, train: bool):
-            from ddlbench_tpu.models.moe import collect_aux_losses
-
-            aux: list = []
-            with sequence_parallel("seq"), collect_aux_losses(aux):
-                logits, new_state = apply_model(
-                    model, cast_params(params, cdtype), state, xl, train
-                )
-            nll, correct, cnt = _local_ce_sums(logits, yl)
-            ce = lax.psum(nll, "seq") / lax.psum(jnp.float32(cnt), "seq")
-            # MoE router load-balance term, averaged over sequence shards
-            # (empty list for dense models).
-            aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), "seq") / n
-            loss = ce + cfg.moe_aux_weight * aux_loss
-            correct = lax.psum(correct, "seq")
-            return loss, ce, correct, new_state
-
-        def make_sharded(train: bool):
-            def inner(params, state, xl, yl):
-                return fwd_local(params, state, xl, yl, train)
-
-            return _shard_map(
-                inner,
-                mesh=self.mesh,
-                in_specs=(P(), P(), P(None, "seq"), P(None, "seq")),
-                out_specs=(P(), P(), P(), P()),
-            )
-
-        sp_train = make_sharded(True)
-        sp_eval = make_sharded(False)
-
-        def train_step(ts: TrainState, x, y, lr):
-            def loss_fn(params):
-                loss, ce, correct, new_state = sp_train(params, ts.model_state, x, y)
-                return loss, (ce, correct, new_state)
-
-            (_, (ce, correct, new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
-            metrics = {
-                "loss": ce,
-                "accuracy": correct.astype(jnp.float32) / y.size,
-            }
-            return TrainState(params, new_state, opt), metrics
-
-        def eval_step(ts: TrainState, x, y):
-            _, ce, correct, _ = sp_eval(ts.params, ts.model_state, x, y)
-            return {
-                "loss": ce,
-                "correct": correct,
-                "count": jnp.asarray(y.size, jnp.int32),
-            }
-
-        self.train_step = jax.jit(
-            train_step,
-            donate_argnums=(0,),
-            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
-        )
-        self.eval_step = jax.jit(
-            eval_step,
-            in_shardings=(None, self._batch_sharding, self._batch_sharding),
-        )
-
-    def init(self, key) -> TrainState:
-        params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, sgd_init(params))
-        return jax.device_put(ts, self._replicated)
-
-    def shard_batch(self, x, y):
-        return (
-            jax.device_put(x, self._batch_sharding),
-            jax.device_put(y, self._batch_sharding),
-        )
-
-    @property
-    def world_size(self) -> int:
-        return self.mesh.devices.size
+    def _batch_spec(self) -> P:
+        return P(None, self.axis_name)

@@ -19,6 +19,7 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.data.synthetic import make_synthetic
 from ddlbench_tpu.parallel.api import make_strategy
 from ddlbench_tpu.train.metrics import AverageMeter, MetricLogger
+from ddlbench_tpu.train.watchdog import HangWatchdog, check_finite
 from ddlbench_tpu.parallel.common import step_decay_lr
 
 
@@ -28,6 +29,21 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
     cfg.validate()
     strategy = strategy or make_strategy(cfg)
     logger = logger or MetricLogger(cfg.epochs, cfg.log_interval)
+
+    # Failure detection (SURVEY.md §5.3): the watchdog is kicked at every
+    # host sync point below; non-finite losses go through cfg.nan_policy.
+    # Started only after warmup so the first deadline excludes XLA compile
+    # (tens of seconds); with warmup_steps=0 the first step's compile counts.
+    wd = HangWatchdog(cfg.hang_timeout_s) if cfg.hang_timeout_s else None
+    try:
+        return _run_benchmark(cfg, strategy, logger, warmup_steps, wd)
+    finally:
+        if wd:
+            wd.stop()
+
+
+def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
+                   warmup_steps: int, wd: Optional[HangWatchdog]) -> Dict[str, Any]:
 
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
@@ -88,6 +104,10 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             start_epoch = ep + 1
             print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
 
+    if wd:
+        wd.kick()
+        wd.start()
+
     summary_acc = 0.0
     for epoch in range(start_epoch, cfg.epochs + 1):
         lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
@@ -99,8 +119,16 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             x, y = strategy.shard_batch(*data.batch(epoch, step))
             ts, metrics = strategy.train_step(ts, x, y, jnp.float32(lr))
             interval_samples += global_batch
+            # With the watchdog armed, sync every step so the deadline really
+            # is per-step (a small pipelining cost, only when opted in);
+            # otherwise the loop syncs only at log intervals.
+            if wd:
+                loss = float(metrics["loss"])  # transfer = sync
+                wd.kick()
+                check_finite(loss, epoch, step + 1, cfg.nan_policy)
             if (step + 1) % cfg.log_interval == 0 or step == steps - 1:
                 loss = float(metrics["loss"])  # transfer = sync
+                check_finite(loss, epoch, step + 1, cfg.nan_policy)
                 loss_meter.update(loss)
                 now = time.perf_counter()
                 logger.train_interval(
@@ -115,7 +143,7 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
         logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time)
 
         # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
-        val = evaluate(cfg, strategy, ts, data, epoch)
+        val = evaluate(cfg, strategy, ts, data, epoch, wd)
         logger.valid_epoch(epoch, val["loss"], val["accuracy"])
         summary_acc = val["accuracy"]
 
@@ -123,20 +151,27 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             from ddlbench_tpu.train.checkpoint import save_checkpoint
 
             save_checkpoint(cfg.checkpoint_dir, epoch, ts)
+            if wd:
+                wd.kick()
 
     result = logger.summary(summary_acc)
     result["train_state"] = ts
     return result
 
 
-def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int) -> Dict[str, float]:
+def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
+             wd: Optional[HangWatchdog] = None) -> Dict[str, float]:
     total_loss, total_correct, total_count = 0.0, 0, 0
     for step in range(data.steps_per_epoch(train=False)):
         x, y = strategy.shard_batch(*data.batch(epoch, step, train=False))
         m = strategy.eval_step(ts, x, y)
-        total_loss += float(m["loss"]) * int(m["count"])
+        loss = float(m["loss"])
+        check_finite(loss, epoch, step + 1, cfg.nan_policy)
+        total_loss += loss * int(m["count"])
         total_correct += int(m["correct"])
         total_count += int(m["count"])
+        if wd:
+            wd.kick()
     return {
         "loss": total_loss / max(1, total_count),
         "accuracy": total_correct / max(1, total_count),

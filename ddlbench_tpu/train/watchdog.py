@@ -1,0 +1,125 @@
+"""Failure detection: hang watchdog + non-finite-loss policy.
+
+The reference has essentially no failure detection (SURVEY.md §5.3): a
+120-minute process-group timeout so hangs eventually die (pipedream-fork/
+runtime/communication.py:43), a pkill-over-ssh cleanup script
+(runtime/scripts/terminate_runtime.py:29-30), and nothing that notices a
+diverged loss. This module is the TPU-native superset:
+
+* :class:`HangWatchdog` — a monitor thread armed with a deadline; while it is
+  armed the train loop syncs (and kicks) EVERY step, so the timeout really is
+  per-step — a hang dies in seconds-to-minutes instead of hours — at a small
+  pipelining cost paid only when the feature is enabled. On expiry it dumps
+  every Python thread's stack (so a stuck collective or host-transfer is
+  diagnosable — the reference's hang just times out silently after 2 hours)
+  and terminates the process. The loop starts the watchdog only after warmup,
+  so the first deadline excludes XLA compile time.
+* :func:`check_finite` — NaN/Inf loss policy (abort | warn | ignore). A
+  diverged run aborts with :class:`TrainingFailure` instead of burning the
+  rest of its allocation; combined with --checkpoint-dir/--resume the run can
+  be restarted from the last good epoch.
+
+Nothing here touches device code: detection lives entirely at the host sync
+points the benchmark loop already has (loss transfers), so it costs nothing
+on the hot path.
+"""
+
+from __future__ import annotations
+
+import faulthandler
+import math
+import os
+import sys
+import threading
+import time
+from typing import Callable, Optional
+
+NAN_POLICIES = ("abort", "warn", "ignore")
+
+
+class TrainingFailure(RuntimeError):
+    """Raised when the configured failure policy aborts the run."""
+
+
+def check_finite(loss: float, epoch: int, step: int, policy: str = "abort") -> bool:
+    """Apply the non-finite-loss policy; returns True if the loss is finite."""
+    if math.isfinite(loss):
+        return True
+    if policy == "abort":
+        raise TrainingFailure(
+            f"non-finite loss {loss!r} at epoch {epoch} step {step}"
+        )
+    if policy == "warn":
+        print(
+            f"WARNING: non-finite loss {loss!r} at epoch {epoch} step {step}",
+            file=sys.stderr,
+            flush=True,
+        )
+    return False
+
+
+def _default_on_timeout(timeout_s: float) -> None:
+    print(
+        f"HANG: no progress for {timeout_s:.0f}s — dumping stacks and aborting",
+        file=sys.stderr,
+        flush=True,
+    )
+    faulthandler.dump_traceback(file=sys.stderr)
+    # os._exit, not sys.exit: the hung thread holds the GIL-visible state we
+    # just dumped; exiting hard is the point (terminate_runtime.py parity).
+    os._exit(124)
+
+
+class HangWatchdog:
+    """Deadline monitor: ``kick()`` at every sync point or ``on_timeout`` fires.
+
+    Usable as a context manager; the monitor is a daemon thread so it can
+    never keep a finished process alive.
+    """
+
+    def __init__(self, timeout_s: float,
+                 on_timeout: Optional[Callable[[], None]] = None):
+        if timeout_s <= 0:
+            raise ValueError("timeout_s must be positive")
+        self.timeout_s = timeout_s
+        self._on_timeout = on_timeout or (
+            lambda: _default_on_timeout(self.timeout_s)
+        )
+        self._last = time.monotonic()
+        self._stop = threading.Event()
+        self._fired = False
+        self._thread = threading.Thread(
+            target=self._run, name="ddlbench-hang-watchdog", daemon=True
+        )
+
+    def start(self) -> "HangWatchdog":
+        self._thread.start()
+        return self
+
+    def kick(self) -> None:
+        """Record progress; postpones the deadline by ``timeout_s``."""
+        self._last = time.monotonic()
+
+    def stop(self) -> None:
+        self._stop.set()
+        if self._thread.is_alive():
+            self._thread.join(timeout=5)
+
+    @property
+    def fired(self) -> bool:
+        return self._fired
+
+    def _run(self) -> None:
+        poll = min(1.0, self.timeout_s / 4)
+        while not self._stop.wait(poll):
+            if time.monotonic() - self._last > self.timeout_s:
+                self._fired = True
+                self._on_timeout()
+                return
+
+    def __enter__(self) -> "HangWatchdog":
+        return self.start()
+
+    def __exit__(self, *exc) -> bool:
+        self.stop()
+        return False

@@ -1,0 +1,111 @@
+"""Failure detection: hang watchdog + non-finite-loss policy.
+
+The reference's only failure handling is a 120-minute process-group timeout
+(SURVEY.md §5.3); these tests pin down the framework's superset behavior.
+"""
+
+import time
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.train.loop import run_benchmark
+from ddlbench_tpu.train.watchdog import (
+    HangWatchdog,
+    TrainingFailure,
+    check_finite,
+)
+
+
+def test_check_finite_policies(capsys):
+    assert check_finite(1.25, 1, 1, "abort")
+    with pytest.raises(TrainingFailure, match="epoch 2 step 7"):
+        check_finite(float("nan"), 2, 7, "abort")
+    with pytest.raises(TrainingFailure):
+        check_finite(float("inf"), 1, 1, "abort")
+    assert not check_finite(float("nan"), 1, 1, "warn")
+    assert "WARNING" in capsys.readouterr().err
+    assert not check_finite(float("nan"), 1, 1, "ignore")
+    assert capsys.readouterr().err == ""
+
+
+def test_nan_policy_validated():
+    with pytest.raises(ValueError, match="nan_policy"):
+        RunConfig(nan_policy="explode").validate()
+
+
+def test_watchdog_fires_without_kicks():
+    fired = []
+    with HangWatchdog(0.15, on_timeout=lambda: fired.append(True)) as wd:
+        time.sleep(0.6)
+    assert wd.fired and fired == [True]
+
+
+def test_watchdog_survives_with_kicks():
+    fired = []
+    with HangWatchdog(0.4, on_timeout=lambda: fired.append(True)) as wd:
+        for _ in range(6):
+            time.sleep(0.1)
+            wd.kick()
+    assert not wd.fired and fired == []
+
+
+class _NaNStrategy:
+    """Minimal strategy double whose loss goes NaN on the second step."""
+
+    world_size = 1
+
+    def __init__(self):
+        self.steps = 0
+
+    def init(self, key):
+        return {"p": jnp.zeros(())}
+
+    def shard_batch(self, x, y):
+        return x, y
+
+    def train_step(self, ts, x, y, lr):
+        self.steps += 1
+        loss = jnp.float32(np.nan if self.steps > 1 else 1.0)
+        return ts, {"loss": loss, "accuracy": jnp.float32(0.0)}
+
+    def eval_step(self, ts, x, y):
+        return {
+            "loss": jnp.float32(0.0),
+            "correct": jnp.int32(0),
+            "count": jnp.int32(y.size),
+        }
+
+
+def test_loop_aborts_on_nan():
+    cfg = RunConfig(benchmark="mnist", strategy="single", epochs=1,
+                    steps_per_epoch=4, log_interval=1, batch_size=2,
+                    compute_dtype="float32", nan_policy="abort")
+    with pytest.raises(TrainingFailure, match="non-finite"):
+        run_benchmark(cfg, strategy=_NaNStrategy(), warmup_steps=0)
+
+
+def test_loop_warn_policy_completes():
+    cfg = RunConfig(benchmark="mnist", strategy="single", epochs=1,
+                    steps_per_epoch=3, log_interval=1, batch_size=2,
+                    compute_dtype="float32", nan_policy="warn")
+    result = run_benchmark(cfg, strategy=_NaNStrategy(), warmup_steps=0)
+    assert "samples_per_sec" in result
+
+
+def test_loop_with_watchdog_enabled():
+    """A healthy run with a generous watchdog completes and stops the thread."""
+    cfg = RunConfig(benchmark="mnist", strategy="single", epochs=1,
+                    steps_per_epoch=3, log_interval=1, batch_size=2,
+                    compute_dtype="float32", nan_policy="warn",
+                    hang_timeout_s=60.0)
+    result = run_benchmark(cfg, strategy=_NaNStrategy(), warmup_steps=0)
+    assert "samples_per_sec" in result
+    import threading
+
+    assert not any(
+        t.name == "ddlbench-hang-watchdog" and t.is_alive()
+        for t in threading.enumerate()
+    )
