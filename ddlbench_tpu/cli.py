@@ -45,6 +45,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="MoE expert capacity = ceil(cf * tokens / experts)")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--attention-backend", default="auto",
+                   choices=("auto", "flash", "xla"),
+                   help="auto = Pallas flash-attention kernel on TPU")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
     p.add_argument("--auto-partition", action="store_true",
@@ -89,6 +92,7 @@ def config_from_args(args) -> RunConfig:
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,
         compute_dtype=args.dtype,
+        attention_backend=args.attention_backend,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,

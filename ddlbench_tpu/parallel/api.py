@@ -23,6 +23,9 @@ from ddlbench_tpu.models.zoo import get_model
 
 def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None):
     cfg.validate()
+    from ddlbench_tpu.models.transformer import set_attention_backend
+
+    set_attention_backend(cfg.attention_backend)
     model = get_model(cfg.arch, cfg.benchmark,
                       moe_capacity_factor=cfg.moe_capacity_factor)
 

@@ -58,9 +58,12 @@ class EPStrategy(AxisShardedStrategy):
     axis_name = "expert"
 
     def _abstract_params(self):
-        return jax.eval_shape(
-            lambda k: init_model(self.model, k)[0], jax.random.key(0)
-        )
+        # cached: called from several hooks, each would re-trace the full init
+        if not hasattr(self, "_p_shapes"):
+            self._p_shapes = jax.eval_shape(
+                lambda k: init_model(self.model, k)[0], jax.random.key(0)
+            )
+        return self._p_shapes
 
     def _check_divisibility(self, n: int) -> None:
         p_shapes = self._abstract_params()
@@ -78,7 +81,11 @@ class EPStrategy(AxisShardedStrategy):
         return (expert_parallel(self.axis_name),)
 
     def _param_specs(self):
-        return expert_param_specs(self._abstract_params(), self.axis_name)
+        if not hasattr(self, "_specs"):
+            self._specs = expert_param_specs(
+                self._abstract_params(), self.axis_name
+            )
+        return self._specs
 
     def _batch_spec(self) -> P:
         return P(self.axis_name)

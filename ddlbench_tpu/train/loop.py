@@ -77,6 +77,10 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
         for _ in range(warmup_steps):
             ts_warm, m = strategy.train_step(ts_warm, x, y, jnp.float32(base_lr))
         float(m["loss"])  # device transfer = real sync (axon block_until_ready is lazy)
+        if wd:
+            # also compile eval_step now, so the watchdog deadline (armed
+            # below) never spans a first-eval XLA compile
+            float(strategy.eval_step(ts_warm, x, y)["loss"])
         del ts_warm
 
     ts = strategy.init(jax.random.key(cfg.seed))
@@ -122,13 +126,13 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
             # With the watchdog armed, sync every step so the deadline really
             # is per-step (a small pipelining cost, only when opted in);
             # otherwise the loop syncs only at log intervals.
-            if wd:
+            log_step = (step + 1) % cfg.log_interval == 0 or step == steps - 1
+            if wd or log_step:
                 loss = float(metrics["loss"])  # transfer = sync
-                wd.kick()
+                if wd:
+                    wd.kick()
                 check_finite(loss, epoch, step + 1, cfg.nan_policy)
-            if (step + 1) % cfg.log_interval == 0 or step == steps - 1:
-                loss = float(metrics["loss"])  # transfer = sync
-                check_finite(loss, epoch, step + 1, cfg.nan_policy)
+            if log_step:
                 loss_meter.update(loss)
                 now = time.perf_counter()
                 logger.train_interval(
@@ -150,6 +154,8 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
         if cfg.checkpoint_dir:
             from ddlbench_tpu.train.checkpoint import save_checkpoint
 
+            if wd:
+                wd.kick()  # the save itself gets a full deadline
             save_checkpoint(cfg.checkpoint_dir, epoch, ts)
             if wd:
                 wd.kick()

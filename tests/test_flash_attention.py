@@ -1,0 +1,148 @@
+"""Pallas flash-attention kernel vs the jnp reference (interpret mode on CPU).
+
+The XLA CPU backend runs f32 matmuls in reduced precision by default, so
+comparisons force highest matmul precision; tolerances then reflect only the
+kernel's own (f32-accumulated) arithmetic.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlbench_tpu.models.transformer import (
+    causal_attention,
+    set_attention_backend,
+)
+from ddlbench_tpu.ops.flash_attention import _pick_block, flash_attention
+
+
+def _rand(shape, key):
+    return jax.random.normal(key, shape, jnp.float32)
+
+
+@pytest.fixture(autouse=True)
+def _xla_reference_backend():
+    """Keep the module-global backend at its default around every test."""
+    set_attention_backend("xla")
+    yield
+    set_attention_backend("auto")
+
+
+def test_pick_block():
+    assert _pick_block(1024, 512) == 512
+    assert _pick_block(96, 128) == 96
+    assert _pick_block(96, 64) == 48  # largest divisor <= 64
+    assert _pick_block(7, 4) == 1
+
+
+def test_forward_matches_reference():
+    B, H, T, dh = 2, 3, 128, 32
+    ks = jax.random.split(jax.random.key(0), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        ref = causal_attention(q, k, v)
+        got = flash_attention(q, k, v, 0, 0, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_grads_match_reference():
+    B, H, T, dh = 1, 2, 64, 16
+    ks = jax.random.split(jax.random.key(1), 4)
+    q, k, v, g = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        ref_g = jax.grad(
+            lambda *a: jnp.sum(causal_attention(*a) * g), argnums=(0, 1, 2)
+        )(q, k, v)
+        fa_g = jax.grad(
+            lambda *a: jnp.sum(flash_attention(*a, 0, 0, 32, 32, True) * g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    for a, b in zip(ref_g, fa_g):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_offsets_match_reference():
+    """Ring-style blocks: queries at absolute position 500 over K/V block 0."""
+    B, H, dh = 1, 2, 16
+    ks = jax.random.split(jax.random.key(2), 3)
+    q = _rand((B, H, 64, dh), ks[0])
+    k = _rand((B, H, 128, dh), ks[1])
+    v = _rand((B, H, 128, dh), ks[2])
+    with jax.default_matmul_precision("highest"):
+        ref = causal_attention(q, k, v, q_offset=500, k_offset=0)
+        got = flash_attention(q, k, v, 500, 0, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_offset_grads_no_nan():
+    """Regression: rows fully masked by k_offset (lse ~ -1e30) must produce
+    zero — not NaN — gradients through the backward kernels."""
+    B, H, dh = 1, 2, 16
+    ks = jax.random.split(jax.random.key(6), 4)
+    q = _rand((B, H, 64, dh), ks[0])
+    k = _rand((B, H, 64, dh), ks[1])
+    v = _rand((B, H, 64, dh), ks[2])
+    g = _rand((B, H, 64, dh), ks[3])
+    with jax.default_matmul_precision("highest"):
+        # queries 0..63 vs keys at absolute 10..73: rows 0-9 fully masked
+        fa_g = jax.grad(
+            lambda *a: jnp.sum(flash_attention(*a, 0, 10, 32, 32, True) * g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        ref_g = jax.grad(
+            lambda *a: jnp.sum(causal_attention(*a, q_offset=0, k_offset=10) * g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    for a, b in zip(ref_g, fa_g):
+        assert np.all(np.isfinite(np.asarray(b)))
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-3, atol=1e-3)
+
+
+def test_fully_masked_is_zero():
+    B, H, T, dh = 1, 1, 32, 8
+    ks = jax.random.split(jax.random.key(3), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    out = flash_attention(q, k, v, 0, 1000, 16, 16, True)
+    assert np.all(np.asarray(out) == 0.0)
+
+
+def test_uneven_blocks():
+    """T not divisible by the preferred block: blocks shrink to a divisor."""
+    B, H, T, dh = 1, 2, 96, 16
+    ks = jax.random.split(jax.random.key(4), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        ref = causal_attention(q, k, v)
+        got = flash_attention(q, k, v, 0, 0, 64, 64, True)
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_backend_dispatch_forced_flash():
+    """set_attention_backend('flash') routes causal_attention through the
+    kernel (interpret mode off-TPU) with identical results."""
+    B, H, T, dh = 1, 2, 32, 8
+    ks = jax.random.split(jax.random.key(5), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        set_attention_backend("xla")
+        ref = causal_attention(q, k, v)
+        set_attention_backend("flash")
+        got = causal_attention(q, k, v)
+        set_attention_backend("xla")
+    np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
+                               rtol=1e-4, atol=1e-5)
+
+
+def test_backend_validation():
+    with pytest.raises(ValueError, match="backend"):
+        set_attention_backend("cuda")
+    from ddlbench_tpu.config import RunConfig
+
+    with pytest.raises(ValueError, match="attention_backend"):
+        RunConfig(attention_backend="cuda").validate()
