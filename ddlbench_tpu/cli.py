@@ -16,7 +16,12 @@ import argparse
 import json
 import sys
 
-from ddlbench_tpu.config import RunConfig, STRATEGIES, DATASETS
+from ddlbench_tpu.config import (
+    ATTENTION_BACKENDS,
+    DATASETS,
+    RunConfig,
+    STRATEGIES,
+)
 from ddlbench_tpu.models.zoo import MODEL_NAMES
 
 
@@ -46,7 +51,7 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE expert capacity = ceil(cf * tokens / experts)")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--attention-backend", default="auto",
-                   choices=("auto", "flash", "xla"),
+                   choices=ATTENTION_BACKENDS,
                    help="auto = Pallas flash-attention kernel on TPU")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")

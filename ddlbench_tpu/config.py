@@ -58,6 +58,10 @@ DATASETS: Mapping[str, DatasetSpec] = {
 
 STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp", "tp", "fsdp", "ep")
 
+# "auto" = Pallas flash-attention kernel on TPU, jnp elsewhere. Single source
+# for the CLI choices, validate(), and models.transformer.set_attention_backend.
+ATTENTION_BACKENDS = ("auto", "flash", "xla")
+
 # Per-framework default batch sizes from the reference harness
 # (run_template.sh:186-266,377-394; see BASELINE.md). For gpipe the tuple is
 # (micro_batch_size, num_microbatches) and the effective global batch is the
@@ -260,7 +264,7 @@ class RunConfig:
 
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
-        if self.attention_backend not in ("auto", "flash", "xla"):
+        if self.attention_backend not in ATTENTION_BACKENDS:
             raise ValueError(
                 f"unknown attention_backend {self.attention_backend!r}"
             )

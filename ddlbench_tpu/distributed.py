@@ -30,6 +30,23 @@ from jax.sharding import Mesh
 _initialized = False
 
 
+def force_host_mesh_platform() -> None:
+    """Honor an XLA_FLAGS virtual host mesh on images whose sitecustomize
+    imports jax at interpreter start.
+
+    There, env vars like JAX_PLATFORMS are read too late, so a requested
+    ``--xla_force_host_platform_device_count=N`` CPU mesh would silently lose
+    to the default accelerator platform (and entry points would then fail or
+    hang waiting on one real chip). Call this before the first backend touch
+    from any entry point that should respect the virtual mesh.
+    """
+    if "xla_force_host_platform_device_count" in os.environ.get("XLA_FLAGS", ""):
+        try:
+            jax.config.update("jax_platforms", "cpu")
+        except Exception:
+            pass  # backend already initialized; caller sees real devices
+
+
 def initialize() -> bool:
     """Join the jax.distributed world if configured; returns True if multi-host."""
     global _initialized

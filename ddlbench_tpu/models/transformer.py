@@ -94,7 +94,9 @@ _ATTENTION_BACKEND = ["auto"]
 
 
 def set_attention_backend(backend: str) -> None:
-    if backend not in ("auto", "flash", "xla"):
+    from ddlbench_tpu.config import ATTENTION_BACKENDS
+
+    if backend not in ATTENTION_BACKENDS:
         raise ValueError(f"unknown attention backend {backend!r}")
     _ATTENTION_BACKEND[0] = backend
 

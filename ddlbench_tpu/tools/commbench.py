@@ -1,0 +1,160 @@
+"""Collective bandwidth microbenchmark.
+
+Parity target: the reference's communication tests measure allreduce
+bandwidth over tensor sizes 10..1e8 as two localhost gloo ranks
+(pipedream-fork/runtime/tests/communication/all_to_all.py:42-59). Here the
+same sweep runs over a real device mesh with XLA collectives — psum
+(allreduce), all_gather, ppermute (the pipeline edge transfer), and
+all_to_all (the EP dispatch) — so the numbers are the actual ICI/DCN rates
+the strategies see.
+
+Each timing chains the collective output into the next iteration's input
+(out -> in dependency), which defeats dispatch caching/overlap and measures
+real sequential executions — necessary on the axon TPU tunnel, where timing
+repeated identical dispatches reports impossible (>peak) rates.
+
+Output: one JSON line per (collective, size) with seconds/op and the
+algorithmic bandwidth GB/s = payload_bytes / time (payload = the per-device
+shard). Usage:
+
+    python -m ddlbench_tpu.tools.commbench -g 8 [--platform cpu] \
+        [--sizes 1e4,1e6,1e8] [--collectives psum,all_gather,ppermute,all_to_all]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def _mesh_and_shardings(n, axis="x", devices=None):
+    # topology-aware ordering (ICI neighbor rings) via the shared constructor,
+    # so the reported bandwidth matches what the strategies' meshes see
+    from ddlbench_tpu.distributed import make_mesh
+
+    return make_mesh([(axis, n)], devices=devices)
+
+
+def _make_collective(name: str, mesh, n: int):
+    """Return (fn(local_array) -> local_array, payload_scale) shard_map'd over
+    the mesh. payload_scale converts the per-device shard bytes into the
+    bytes each device actually moves for the algorithmic-bandwidth figure."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from jax import shard_map
+
+    axis = mesh.axis_names[0]
+
+    if name == "psum":
+        def op(x):
+            return lax.psum(x, axis)
+        # ring allreduce moves 2*(n-1)/n of the buffer per device
+        scale = 2.0 * (n - 1) / n
+        in_spec, out_spec = P(axis), P(axis)
+    elif name == "all_gather":
+        def op(x):
+            return lax.all_gather(x, axis, tiled=True)
+        scale = (n - 1) / n
+        in_spec, out_spec = P(axis), P()
+    elif name == "ppermute":
+        def op(x):
+            return lax.ppermute(x, axis, [(i, (i + 1) % n) for i in range(n)])
+        scale = 1.0
+        in_spec, out_spec = P(axis), P(axis)
+    elif name == "all_to_all":
+        def op(x):
+            return lax.all_to_all(x, axis, split_axis=0, concat_axis=0,
+                                  tiled=True)
+        scale = (n - 1) / n
+        in_spec, out_spec = P(axis), P(axis)
+    else:
+        raise ValueError(f"unknown collective {name!r}")
+
+    # check_vma=False: all_gather's replicated output can't be statically
+    # inferred by the VMA checker; this tool only measures transfer time.
+    fn = shard_map(op, mesh=mesh, in_specs=(in_spec,), out_specs=out_spec,
+                   check_vma=False)
+    return fn, scale, in_spec
+
+
+def bench_collective(name: str, mesh, n: int, size_floats: int,
+                     iters: int = 10):
+    """Time one collective at the given GLOBAL element count; returns a dict."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    fn, scale, in_spec = _make_collective(name, mesh, n)
+    # round the per-device shard up to a multiple of n, so all_to_all can
+    # split the local shard n ways too (global size = multiple of n^2)
+    per_dev = max(1, (size_floats + n - 1) // n)
+    per_dev = ((per_dev + n - 1) // n) * n
+    global_n = per_dev * n
+    x = jax.device_put(
+        jax.numpy.ones((global_n,), jax.numpy.float32),
+        NamedSharding(mesh, in_spec),
+    )
+
+    def chained(x0):
+        def step(c, _):
+            # fold the output into the carry: every supported collective is
+            # global-shape-preserving, and the dependency defeats caching
+            return c + 0.0 * fn(c), None
+        return lax.scan(step, x0, None, length=iters)[0]
+
+    run = jax.jit(chained)
+    jax.block_until_ready(run(x))  # compile
+    t0 = time.perf_counter()
+    jax.block_until_ready(run(x))
+    dt = (time.perf_counter() - t0) / iters
+
+    shard_bytes = per_dev * 4
+    moved = shard_bytes * scale
+    return {
+        "collective": name,
+        "global_floats": global_n,
+        "shard_bytes": shard_bytes,
+        "sec_per_op": dt,
+        "algbw_gbps": moved / dt / 1e9,
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(prog="commbench", description=__doc__)
+    p.add_argument("-g", "--devices", type=int, default=None)
+    p.add_argument("--collectives",
+                   default="psum,all_gather,ppermute,all_to_all")
+    p.add_argument("--sizes", default="1e4,1e5,1e6,1e7,1e8",
+                   help="global float32 counts (reference sweep: 10..1e8)")
+    p.add_argument("--iters", type=int, default=10)
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    else:
+        from ddlbench_tpu.distributed import force_host_mesh_platform
+
+        force_host_mesh_platform()
+
+    n = args.devices or len(jax.devices())
+    mesh = _mesh_and_shardings(n)
+    for name in args.collectives.split(","):
+        for size in args.sizes.split(","):
+            r = bench_collective(name.strip(), mesh, n, int(float(size)),
+                                 args.iters)
+            print(json.dumps(r), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,22 @@
+"""Collective bandwidth microbenchmark on the virtual CPU mesh."""
+
+import jax
+import pytest
+
+from ddlbench_tpu.tools.commbench import _mesh_and_shardings, bench_collective
+
+
+@pytest.mark.parametrize("name", ["psum", "all_gather", "ppermute", "all_to_all"])
+def test_collectives_run_and_report(devices, name):
+    mesh = _mesh_and_shardings(8)
+    r = bench_collective(name, mesh, 8, 8_000, iters=3)
+    assert r["collective"] == name
+    assert r["global_floats"] >= 8_000 and r["global_floats"] % 8 == 0
+    assert r["sec_per_op"] > 0
+    assert r["algbw_gbps"] > 0
+
+
+def test_unknown_collective_rejected(devices):
+    mesh = _mesh_and_shardings(8)
+    with pytest.raises(ValueError, match="unknown collective"):
+        bench_collective("bcast", mesh, 8, 100)
