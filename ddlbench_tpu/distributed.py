@@ -121,6 +121,45 @@ def make_mesh(axis_sizes: Sequence[Tuple[str, int]],
     return Mesh(np.array(devs).reshape(sizes), axis_names=tuple(names))
 
 
+def put_global_batch(x, sharding):
+    """Place a host-materialized GLOBAL array onto a (possibly multi-host)
+    sharding.
+
+    Single-process: plain device_put. Multi-process: every host materializes
+    the same global array (synthetic data is deterministic in (epoch, step) —
+    data/synthetic.py), and each host hands jax.make_array_from_callback the
+    slices for its addressable shards. Works for any PartitionSpec — batch
+    rows for dp/fsdp/ep, sequence columns for sp, replicated for params —
+    which is what the reference needs DistributedSampler + broadcast for
+    (mnist_horovod.py:207-231).
+    """
+    if jax.process_count() == 1:
+        return jax.device_put(x, sharding)
+    arr = np.asarray(x)
+    return jax.make_array_from_callback(
+        arr.shape, sharding, lambda idx: arr[idx]
+    )
+
+
+def put_global_tree(tree, sharding):
+    """Multi-host-safe device_put over a pytree. ``sharding`` is one Sharding
+    applied to every leaf, or a prefix pytree of Shardings (jax.device_put's
+    prefix convention — each Sharding leaf covers its whole subtree)."""
+    from jax.sharding import Sharding
+
+    if jax.process_count() == 1:
+        return jax.device_put(tree, sharding)
+    if isinstance(sharding, Sharding):
+        return jax.tree.map(lambda leaf: put_global_batch(leaf, sharding), tree)
+    # prefix pytree: tree.map flattens by the sharding tree's structure and
+    # hands each Sharding leaf its corresponding subtree
+    return jax.tree.map(
+        lambda sh, sub: jax.tree.map(lambda l: put_global_batch(l, sh), sub),
+        sharding, tree,
+        is_leaf=lambda x: isinstance(x, Sharding),
+    )
+
+
 def local_batch_slice(global_batch: int) -> slice:
     """This process's slice of a host-generated global batch (data staging for
     multi-host: each host materializes only its shard)."""

@@ -103,15 +103,20 @@ class DPStrategy:
         )
 
     def init(self, key) -> TrainState:
+        from ddlbench_tpu.distributed import put_global_tree
+
         params, state, _ = init_model(self.model, key)
         ts = TrainState(params, state, sgd_init(params))
-        # Broadcast-init parity (mnist_horovod.py:230-231): replicate to mesh.
-        return jax.device_put(ts, self._replicated)
+        # Broadcast-init parity (mnist_horovod.py:230-231): replicate to the
+        # mesh — identical on every host since init is seed-deterministic.
+        return put_global_tree(ts, self._replicated)
 
     def shard_batch(self, x, y):
+        from ddlbench_tpu.distributed import put_global_batch
+
         return (
-            jax.device_put(x, self._batch_sharding),
-            jax.device_put(y, self._batch_sharding),
+            put_global_batch(x, self._batch_sharding),
+            put_global_batch(y, self._batch_sharding),
         )
 
     @property

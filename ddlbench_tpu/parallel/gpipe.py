@@ -135,9 +135,11 @@ class GPipeStrategy:
             self._act_size = max(interior) if interior else 1
             self._build_steps()
 
+        from ddlbench_tpu.distributed import put_global_batch
+
         sharding = NamedSharding(self.mesh, P("stage", None))
-        params_mat = jax.device_put(params_mat, sharding)
-        state_mat = jax.device_put(state_mat, sharding)
+        params_mat = put_global_batch(params_mat, sharding)
+        state_mat = put_global_batch(state_mat, sharding)
         momentum = jnp.zeros_like(params_mat)
         return PipeTrainState(params_mat, state_mat, momentum)
 
@@ -317,12 +319,14 @@ class GPipeStrategy:
 
     def shard_batch(self, x, y):
         """Global batch [M*mb*dp, ...] -> [M, mb*dp, ...] sharded over 'data'."""
+        from ddlbench_tpu.distributed import put_global_batch
+
         M, mb, dp = self.num_microbatches, self.mb, self.dp
         x = x.reshape(M, dp * mb, *x.shape[1:])
         y = y.reshape(M, dp * mb, *y.shape[1:])
         return (
-            jax.device_put(x, self._batch_sharding),
-            jax.device_put(y, self._batch_sharding),
+            put_global_batch(x, self._batch_sharding),
+            put_global_batch(y, self._batch_sharding),
         )
 
     @property

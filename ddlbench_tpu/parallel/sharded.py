@@ -139,14 +139,18 @@ class _ShardedParamStrategy:
         )
 
     def init(self, key) -> TrainState:
+        from ddlbench_tpu.distributed import put_global_tree
+
         params, state, _ = init_model(self.model, key)
         ts = TrainState(params, state, sgd_init(params))
-        return jax.device_put(ts, self._state_sharding(ts))
+        return put_global_tree(ts, self._state_sharding(ts))
 
     def shard_batch(self, x, y):
+        from ddlbench_tpu.distributed import put_global_batch
+
         return (
-            jax.device_put(x, self._batch_sharding),
-            jax.device_put(y, self._batch_sharding),
+            put_global_batch(x, self._batch_sharding),
+            put_global_batch(y, self._batch_sharding),
         )
 
     @property
