@@ -84,8 +84,11 @@ class LayerModel:
 
     name: str
     layers: List[Layer]
-    in_shape: Shape  # (H, W, C)
-    num_classes: int
+    in_shape: Shape  # (H, W, C) for images; (T,) for tokens
+    num_classes: int  # classes, or vocab size for token models
+    # "float" (images/features) or "tokens" (int32 ids into a vocab of
+    # num_classes) — tells the profiler and tools how to synthesize inputs.
+    input_kind: str = "float"
 
 
 def init_model(model: LayerModel, key: jax.Array):

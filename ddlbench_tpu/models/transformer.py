@@ -257,4 +257,4 @@ def build_transformer(arch: str, in_shape, vocab: int) -> LayerModel:
             transformer_block(f"block{i + 1}", cfgv["d_model"], cfgv["n_heads"])
         )
     layers.append(lm_head("lm_head", vocab))
-    return LayerModel(arch, layers, tuple(in_shape), vocab)
+    return LayerModel(arch, layers, tuple(in_shape), vocab, input_kind="tokens")

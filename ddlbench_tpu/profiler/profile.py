@@ -83,7 +83,14 @@ def profile_model(
         in_shape, out_shape = shapes[idx], shapes[idx + 1]
         p, s = params_list[idx], state_list[idx]
         key, sub = jax.random.split(key)
-        x = jax.random.normal(sub, (batch_size, *in_shape), dtype)
+        if idx == 0 and model.input_kind == "tokens":
+            # the first layer (embedding) takes int32 ids in [0, vocab);
+            # activations downstream are floats as usual
+            x = jax.random.randint(
+                sub, (batch_size, *in_shape), 0, model.num_classes, jnp.int32
+            )
+        else:
+            x = jax.random.normal(sub, (batch_size, *in_shape), dtype)
 
         def fwd(p, x, _layer=layer, _s=s):
             return _layer.apply(p, _s, x, True)[0]
@@ -92,8 +99,9 @@ def profile_model(
             def scalar(p, x):
                 return jnp.sum(_fwd(p, x).astype(jnp.float32))
 
-            gp, gx = jax.grad(scalar, argnums=(0, 1))(p, x)
-            return gp, gx
+            # token ids are not differentiable — only dL/dw for that layer
+            args = (0,) if jnp.issubdtype(x.dtype, jnp.integer) else (0, 1)
+            return jax.grad(scalar, argnums=args)(p, x)
 
         if mode == "time":
             f_ms = _time_callable(jax.jit(fwd), p, x, repeats=repeats)

@@ -138,3 +138,22 @@ def test_profiler_flops_mode():
     # text round-trip of a real profile
     g2 = Graph.from_str(str(g))
     assert len(g2.nodes) == len(g.nodes)
+
+
+def test_profiler_token_models():
+    """Token workloads profile too: int32 ids at the embedding, float
+    activations downstream, both flops and time modes, through to a plan."""
+    from ddlbench_tpu.profiler import profile_model
+    from ddlbench_tpu.profiler.profile import profile_and_partition
+    from tiny_models import tiny_moe, tiny_transformer
+
+    m = tiny_transformer()
+    g, plan = profile_and_partition(m, 2, 4, mode="flops")
+    assert len(g.nodes) == len(m.layers)
+    assert plan.stages[0].start == 0 and plan.stages[-1].end == len(m.layers)
+
+    gt = profile_model(m, 2, mode="time", repeats=1)
+    assert all(n.forward_compute_time >= 0 for n in gt.topological_sort())
+
+    g2 = profile_model(tiny_moe(), 2, mode="flops")
+    assert len(g2.nodes) == 4

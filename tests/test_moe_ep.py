@@ -12,33 +12,12 @@ import numpy as np
 import pytest
 from jax.flatten_util import ravel_pytree
 
-from ddlbench_tpu.config import DatasetSpec, RunConfig
+from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models import apply_model, init_model
-from ddlbench_tpu.models.moe import (
-    build_transformer_moe,
-    collect_aux_losses,
-    switch_route,
-)
+from ddlbench_tpu.models.moe import collect_aux_losses, switch_route
 from ddlbench_tpu.parallel.ep import EPStrategy, expert_param_specs
 from ddlbench_tpu.parallel.single import SingleStrategy
-
-TINY_LM = DatasetSpec("tinylm", (32,), 64, 1000, 100, kind="tokens")
-N_EXPERTS = 8
-
-# Registered once at import so test order can't matter.
-import ddlbench_tpu.models.moe as _moe_mod  # noqa: E402
-
-_moe_mod._VARIANTS.setdefault(
-    "transformer_moe_t", dict(d_model=32, n_layers=2, n_heads=4, n_experts=N_EXPERTS)
-)
-
-
-def tiny_moe(capacity_factor=float(N_EXPERTS)):
-    """2 blocks (1 dense + 1 MoE, 8 experts); default capacity never drops."""
-    return build_transformer_moe(
-        "transformer_moe_t", TINY_LM.image_size, TINY_LM.num_classes,
-        capacity_factor=capacity_factor,
-    )
+from tiny_models import tiny_moe
 
 
 def test_switch_route_capacity():
@@ -211,3 +190,23 @@ def test_sp_moe_matches_single(devices):
     a = ravel_pytree(jax.device_get(ts_sp2.params))[0]
     b = ravel_pytree(ts_12.params)[0]
     np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-4, atol=1e-6)
+
+
+def test_moe_under_gpipe(devices):
+    """MoE blocks are pipeline-atomic like any other layer: the dense expert
+    path must run inside the gpipe stage scan (aux regularizer documented as
+    absent under pipeline strategies)."""
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+
+    model = tiny_moe()  # 4 layers: embed, dense block, moe block, head
+    S, M, mb = 4, 4, 2
+    cfg = RunConfig(strategy="gpipe", benchmark="synthtext",
+                    arch="transformer_moe_t", num_devices=S, num_stages=S,
+                    micro_batch_size=mb, num_microbatches=M,
+                    compute_dtype="float32", momentum=0.0, weight_decay=0.0)
+    strat = GPipeStrategy(model, cfg, stage_bounds=[0, 1, 2, 3, 4])
+    ts = strat.init(jax.random.key(0))
+    x = jax.random.randint(jax.random.key(1), (M * mb, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (M * mb, 32), 0, 64)
+    ts2, metrics = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(metrics["loss"]))

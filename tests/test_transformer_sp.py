@@ -12,27 +12,13 @@ import pytest
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, PartitionSpec as P
 
-from ddlbench_tpu.config import DatasetSpec, RunConfig
-from ddlbench_tpu.models.transformer import (
-    build_transformer,
-    causal_attention,
-    ring_attention,
-)
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.transformer import causal_attention, ring_attention
 from ddlbench_tpu.models import init_model, apply_model
 from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.single import SingleStrategy
 from ddlbench_tpu.parallel.sp import SPStrategy
-
-TINY_LM = DatasetSpec("tinylm", (32,), 64, 1000, 100, kind="tokens")
-
-
-def tiny_transformer():
-    import ddlbench_tpu.models.transformer as tr
-
-    old = tr._VARIANTS.get("transformer_t")
-    tr._VARIANTS["transformer_t"] = dict(d_model=32, n_layers=2, n_heads=4)
-    model = build_transformer("transformer_t", TINY_LM.image_size, TINY_LM.num_classes)
-    return model
+from tiny_models import tiny_transformer
 
 
 def test_forward_and_causality():

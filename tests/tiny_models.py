@@ -1,0 +1,35 @@
+"""Shared tiny model variants for the test suite.
+
+One registration point (imported by any test that needs a small transformer
+or MoE model) so the variant configs can't drift between files and test
+execution order can't change which model a test profiles.
+"""
+
+from ddlbench_tpu.config import DatasetSpec
+import ddlbench_tpu.models.moe as _moe
+import ddlbench_tpu.models.transformer as _tr
+
+TINY_LM = DatasetSpec("tinylm", (32,), 64, 1000, 100, kind="tokens")
+
+TINY_TRANSFORMER = dict(d_model=32, n_layers=2, n_heads=4)
+TINY_MOE = dict(d_model=32, n_layers=2, n_heads=4, n_experts=8)
+N_EXPERTS = TINY_MOE["n_experts"]
+
+_tr._VARIANTS["transformer_t"] = TINY_TRANSFORMER
+_moe._VARIANTS["transformer_moe_t"] = TINY_MOE
+
+
+def tiny_transformer():
+    """4 layers: embed, 2 dense blocks, head."""
+    return _tr.build_transformer(
+        "transformer_t", TINY_LM.image_size, TINY_LM.num_classes
+    )
+
+
+def tiny_moe(capacity_factor=float(N_EXPERTS)):
+    """4 layers: embed, dense block, MoE block (8 experts), head; the default
+    capacity factor is large enough that no token is ever dropped."""
+    return _moe.build_transformer_moe(
+        "transformer_moe_t", TINY_LM.image_size, TINY_LM.num_classes,
+        capacity_factor=capacity_factor,
+    )
