@@ -79,7 +79,13 @@ def generate_dataset(data_dir: str, spec: DatasetSpec, split: str = "train",
     if lib is None:
         raise RuntimeError("native dataloader unavailable (no toolchain?)")
     count = count or (spec.train_size if split == "train" else spec.test_size)
-    h, w, c = spec.image_size
+    if spec.kind == "tokens":
+        # token sequences ride the same raw-uint8 store: one sample is T+1
+        # tokens x 4 little-endian bytes (viewed as int32 % vocab on read;
+        # the +1 gives the next-token label shift, data/synthetic.py:90-95)
+        h, w, c = spec.seq_len + 1, 4, 1
+    else:
+        h, w, c = spec.image_size
     out = os.path.join(data_dir, spec.name, split)
     os.makedirs(out, exist_ok=True)
     rc = lib.dataset_generate(out.encode(), h, w, c, spec.num_classes,
@@ -88,7 +94,7 @@ def generate_dataset(data_dir: str, spec: DatasetSpec, split: str = "train",
         raise RuntimeError(f"dataset_generate failed rc={rc}")
     with open(os.path.join(out, "meta.json"), "w") as f:
         json.dump({"h": h, "w": w, "c": c, "classes": spec.num_classes,
-                   "count": count, "seed": seed}, f)
+                   "count": count, "seed": seed, "kind": spec.kind}, f)
     return out
 
 

@@ -9,11 +9,13 @@ normalized inside jit — the reference's transforms.Normalize equivalent
 from __future__ import annotations
 
 import functools
+import json
 import os
 from typing import Tuple
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ddlbench_tpu.config import DatasetSpec
 from ddlbench_tpu.data.native_loader import NativeDataLoader, generate_dataset
@@ -36,10 +38,25 @@ class OnDiskData:
         self.batch_size = batch_size
         self.dtype_name = str(jnp.dtype(dtype))
         self._loaders = {}
+        if spec.kind == "tokens":
+            want_hwc = (spec.seq_len + 1, 4, 1)
+        else:
+            want_hwc = tuple(spec.image_size)
         for split, count in (("train", train_count), ("test", test_count)):
             split_dir = os.path.join(data_dir, spec.name, split)
-            if not os.path.exists(os.path.join(split_dir, "meta.json")):
+            meta_path = os.path.join(split_dir, "meta.json")
+            if not os.path.exists(meta_path):
                 generate_dataset(data_dir, spec, split, count=count, seed=seed)
+            with open(meta_path) as f:
+                meta = json.load(f)
+            got_hwc = (meta["h"], meta["w"], meta["c"])
+            if got_hwc != want_hwc or meta.get("kind", "image") != spec.kind:
+                raise ValueError(
+                    f"dataset at {split_dir} was generated for "
+                    f"kind={meta.get('kind', 'image')} shape={got_hwc}, but the "
+                    f"spec wants kind={spec.kind} shape={want_hwc}; delete the "
+                    f"directory or point --data-dir elsewhere"
+                )
             self._loaders[split] = NativeDataLoader(
                 split_dir, batch_size, seed=seed, shuffle=(split == "train")
             )
@@ -49,6 +66,14 @@ class OnDiskData:
 
     def batch(self, epoch: int, step: int, train: bool = True) -> Tuple[jax.Array, jax.Array]:
         imgs, labels = self._loaders["train" if train else "test"].next()
+        if self.spec.kind == "tokens":
+            # raw store holds (T+1) x 4 bytes per sample; view as int32 ids
+            # and return the two length-T next-token shifts (matching
+            # data/synthetic.py's convention)
+            flat = np.ascontiguousarray(imgs).reshape(imgs.shape[0], -1)
+            ids = flat.view("<i4") % self.spec.num_classes
+            ids = jnp.asarray(ids)
+            return ids[:, :-1], ids[:, 1:]
         return _normalize(jnp.asarray(imgs), jnp.asarray(labels), self.dtype_name)
 
     def close(self) -> None:

@@ -10,7 +10,8 @@ DDLB_* env contract (ddlbench_tpu/distributed.py initialize), build a global
 put_global_batch/put_global_tree (make_array_from_callback under the hood),
 cross-process collectives over gloo, replicated metrics. Covered placement
 paths: dp (dp.py), fsdp (sharded.py), ep (axis_sharded.py + expert-sharded
-param trees).
+param trees), gpipe hybrid PPxDP (stage-axis ppermute crossing the process
+boundary).
 """
 
 import os
@@ -37,12 +38,14 @@ assert jax.process_count() == 2 and len(jax.devices()) == 8
 strategy = sys.argv[1]
 from ddlbench_tpu.config import RunConfig
 
-if strategy in ("dp", "fsdp"):
+if strategy in ("dp", "fsdp", "gpipe"):
     from ddlbench_tpu.train.loop import run_benchmark
 
+    pipe = dict(num_stages=4, dp_replicas=2, micro_batch_size=2,
+                num_microbatches=4) if strategy == "gpipe" else dict(batch_size=2)
     cfg = RunConfig(benchmark="mnist", strategy=strategy, arch="resnet18",
-                    num_devices=8, batch_size=2, compute_dtype="float32",
-                    epochs=1, steps_per_epoch=2, log_interval=1)
+                    num_devices=8, compute_dtype="float32",
+                    epochs=1, steps_per_epoch=2, log_interval=1, **pipe)
     res = run_benchmark(cfg, warmup_steps=0)
     metric = res["valid_accuracy"]
 else:  # ep: expert-sharded param tree placement + all_to_all across hosts
@@ -105,7 +108,7 @@ def _launch_world(strategy: str):
     return metrics
 
 
-@pytest.mark.parametrize("strategy", ["dp", "fsdp", "ep"])
+@pytest.mark.parametrize("strategy", ["dp", "fsdp", "ep", "gpipe"])
 def test_two_process_training(strategy):
     metrics = _launch_world(strategy)
     # both processes computed over the same global mesh -> identical metrics

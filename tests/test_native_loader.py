@@ -71,3 +71,31 @@ def test_ondisk_end_to_end(dataset_dir, devices):
     cfg = cfg.replace(data_dir=str(dataset_dir))
     result = run_benchmark(cfg, warmup_steps=0)
     assert result["samples_per_sec"] > 0
+
+
+def test_ondisk_token_dataset(tmp_path):
+    """Token datasets ride the raw store as (T+1) x 4 bytes per sample and
+    come back as next-token (x, y) int32 shifts."""
+    from ddlbench_tpu.data.ondisk import OnDiskData
+
+    spec = DatasetSpec("tinytok", (16,), 64, 32, 8, kind="tokens")
+    data = OnDiskData(str(tmp_path), spec, batch_size=4, seed=3)
+    x, y = data.batch(0, 0)
+    assert x.shape == (4, 16) and y.shape == (4, 16)
+    assert x.dtype == np.int32 or str(x.dtype) == "int32"
+    xs, ys = np.asarray(x), np.asarray(y)
+    assert xs.min() >= 0 and xs.max() < 64
+    # y is x shifted by one position within the same underlying sequence
+    np.testing.assert_array_equal(xs[:, 1:], ys[:, :-1])
+    assert data.steps_per_epoch(train=True) == 8
+    data.close()
+
+
+def test_ondisk_mismatched_spec_rejected(tmp_path):
+    from ddlbench_tpu.data.ondisk import OnDiskData
+
+    spec = DatasetSpec("tinytok", (16,), 64, 32, 8, kind="tokens")
+    OnDiskData(str(tmp_path), spec, batch_size=4).close()
+    stale = DatasetSpec("tinytok", (24,), 64, 32, 8, kind="tokens")
+    with pytest.raises(ValueError, match="generated for"):
+        OnDiskData(str(tmp_path), stale, batch_size=4)
