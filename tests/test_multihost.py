@@ -6,12 +6,12 @@ cluster is launching rank 0 and rank 1 as two localhost gloo processes
 pattern validates the framework's actual multi-host path end to end: two
 processes x 4 virtual CPU devices join one jax.distributed world via the
 DDLB_* env contract (ddlbench_tpu/distributed.py initialize), build a global
-8-device mesh, and train — global batch/param placement via
-put_global_batch/put_global_tree (make_array_from_callback under the hood),
-cross-process collectives over gloo, replicated metrics. Covered placement
-paths: dp (dp.py), fsdp (sharded.py), ep (axis_sharded.py + expert-sharded
-param trees), gpipe hybrid PPxDP (stage-axis ppermute crossing the process
-boundary).
+8-device mesh, and train every multi-host placement path in sequence —
+global batch/param placement via put_global_batch/put_global_tree
+(make_array_from_callback under the hood), cross-process collectives over
+gloo, replicated metrics. Covered paths: dp (dp.py), fsdp (sharded.py),
+gpipe hybrid PPxDP (stage-axis ppermute crossing the process boundary), and
+ep (axis_sharded.py + expert-sharded param trees + cross-process all_to_all).
 """
 
 import os
@@ -19,9 +19,9 @@ import socket
 import subprocess
 import sys
 
-import pytest
-
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+STRATEGIES = ("dp", "fsdp", "gpipe", "ep")
 
 WORKER = r"""
 import os, sys
@@ -35,40 +35,40 @@ from ddlbench_tpu.distributed import initialize
 assert initialize(), "expected a multi-process world"
 assert jax.process_count() == 2 and len(jax.devices()) == 8
 
-strategy = sys.argv[1]
+import jax.numpy as jnp
 from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.train.loop import run_benchmark
 
-if strategy in ("dp", "fsdp", "gpipe"):
-    from ddlbench_tpu.train.loop import run_benchmark
+for strategy in sys.argv[1].split(","):
+    if strategy in ("dp", "fsdp", "gpipe"):
+        pipe = (dict(num_stages=4, dp_replicas=2, micro_batch_size=2,
+                     num_microbatches=4)
+                if strategy == "gpipe" else dict(batch_size=2))
+        cfg = RunConfig(benchmark="mnist", strategy=strategy, arch="resnet18",
+                        num_devices=8, compute_dtype="float32",
+                        epochs=1, steps_per_epoch=2, log_interval=1, **pipe)
+        res = run_benchmark(cfg, warmup_steps=0)
+        metric = res["valid_accuracy"]
+    else:  # ep: expert-sharded param trees + all_to_all across hosts
+        import ddlbench_tpu.models.moe as moe
+        from ddlbench_tpu.parallel.ep import EPStrategy
 
-    pipe = dict(num_stages=4, dp_replicas=2, micro_batch_size=2,
-                num_microbatches=4) if strategy == "gpipe" else dict(batch_size=2)
-    cfg = RunConfig(benchmark="mnist", strategy=strategy, arch="resnet18",
-                    num_devices=8, compute_dtype="float32",
-                    epochs=1, steps_per_epoch=2, log_interval=1, **pipe)
-    res = run_benchmark(cfg, warmup_steps=0)
-    metric = res["valid_accuracy"]
-else:  # ep: expert-sharded param tree placement + all_to_all across hosts
-    import jax.numpy as jnp
-    from ddlbench_tpu.config import DatasetSpec
-    import ddlbench_tpu.models.moe as moe
-    from ddlbench_tpu.parallel.ep import EPStrategy
-
-    moe._VARIANTS.setdefault(
-        "transformer_moe_t", dict(d_model=32, n_layers=2, n_heads=4, n_experts=8)
-    )
-    model = moe.build_transformer_moe("transformer_moe_t", (32,), 64)
-    cfg = RunConfig(strategy="ep", benchmark="synthtext",
-                    arch="transformer_moe_t", num_devices=8, batch_size=1,
-                    compute_dtype="float32")
-    ep = EPStrategy(model, cfg)
-    ts = ep.init(jax.random.key(0))
-    x = jax.random.randint(jax.random.key(1), (8, 32), 0, 64)
-    y = jax.random.randint(jax.random.key(2), (8, 32), 0, 64)
-    ts, m = ep.train_step(ts, *ep.shard_batch(x, y), jnp.float32(0.1))
-    metric = float(m["loss"])
-
-print(f"MPRESULT {jax.process_index()} metric={metric:.6f}", flush=True)
+        moe._VARIANTS.setdefault(
+            "transformer_moe_t",
+            dict(d_model=32, n_layers=2, n_heads=4, n_experts=8),
+        )
+        model = moe.build_transformer_moe("transformer_moe_t", (32,), 64)
+        cfg = RunConfig(strategy="ep", benchmark="synthtext",
+                        arch="transformer_moe_t", num_devices=8, batch_size=1,
+                        compute_dtype="float32")
+        ep = EPStrategy(model, cfg)
+        ts = ep.init(jax.random.key(0))
+        x = jax.random.randint(jax.random.key(1), (8, 32), 0, 64)
+        y = jax.random.randint(jax.random.key(2), (8, 32), 0, 64)
+        ts, m = ep.train_step(ts, *ep.shard_batch(x, y), jnp.float32(0.1))
+        metric = float(m["loss"])
+    print(f"MPRESULT {strategy} {jax.process_index()} metric={metric:.6f}",
+          flush=True)
 """
 
 
@@ -78,7 +78,7 @@ def _free_port() -> int:
         return s.getsockname()[1]
 
 
-def _launch_world(strategy: str):
+def test_two_process_training_all_strategies():
     port = _free_port()
     procs = []
     for pid in (0, 1):
@@ -92,24 +92,20 @@ def _launch_world(strategy: str):
         # a clean XLA_FLAGS: the worker adds its own device-count flag
         env.pop("XLA_FLAGS", None)
         procs.append(subprocess.Popen(
-            [sys.executable, "-c", WORKER, strategy], env=env, cwd=REPO,
+            [sys.executable, "-c", WORKER, ",".join(STRATEGIES)],
+            env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         ))
-    outs = [p.communicate(timeout=280)[0] for p in procs]
+    outs = [p.communicate(timeout=540)[0] for p in procs]
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
-    metrics = sorted(
-        line.split("metric=")[1]
-        for out in outs
-        for line in out.splitlines()
-        if line.startswith("MPRESULT")
-    )
-    assert len(metrics) == 2, outs
-    return metrics
-
-
-@pytest.mark.parametrize("strategy", ["dp", "fsdp", "ep", "gpipe"])
-def test_two_process_training(strategy):
-    metrics = _launch_world(strategy)
     # both processes computed over the same global mesh -> identical metrics
-    assert metrics[0] == metrics[1], metrics
+    for strategy in STRATEGIES:
+        metrics = sorted(
+            line.split("metric=")[1]
+            for out in outs
+            for line in out.splitlines()
+            if line.startswith(f"MPRESULT {strategy} ")
+        )
+        assert len(metrics) == 2, (strategy, outs)
+        assert metrics[0] == metrics[1], (strategy, metrics)
