@@ -71,6 +71,13 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--hang-timeout-s", type=float, default=None,
                    help="abort (with a stack dump) if any step takes longer "
                         "than this; forces a per-step host sync while armed")
+    p.add_argument("--log-activations-dir", default=None,
+                   help="dump per-layer activations + gradients as npz here "
+                        "(torchlogger analog)")
+    p.add_argument("--log-activations-freq", type=int, default=1,
+                   help="log every N epochs (with --log-activations-dir)")
+    p.add_argument("--log-activations-steps", type=int, default=1,
+                   help="minibatches to log per logged epoch")
     p.add_argument("--platform", default=None,
                    help="force a jax platform (e.g. 'cpu' with "
                         "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh)")
@@ -105,6 +112,9 @@ def config_from_args(args) -> RunConfig:
         hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
         profile_mode=args.profile_mode,
+        activation_log_dir=args.log_activations_dir,
+        activation_log_freq=args.log_activations_freq,
+        activation_log_steps=args.log_activations_steps,
     )
 
 

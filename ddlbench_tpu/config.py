@@ -191,6 +191,14 @@ class RunConfig:
     nan_policy: str = "abort"  # abort | warn | ignore
     hang_timeout_s: Optional[float] = None
 
+    # Activation/gradient deep-dive logging (torchlogger analog, SURVEY.md
+    # §5.5; reference profiler main.py:543-582): every activation_log_freq
+    # epochs, dump per-layer activations + dLoss/d(activation) for the first
+    # activation_log_steps minibatches as npz files under activation_log_dir.
+    activation_log_dir: Optional[str] = None
+    activation_log_freq: int = 1
+    activation_log_steps: int = 1
+
     hardware: HardwareModel = dataclasses.field(default_factory=HardwareModel)
 
     # ---- derived ----

@@ -240,6 +240,94 @@ class Graph:
                 g.add_node(Node.from_str(line))
         return g
 
+    # -- visualization -----------------------------------------------------
+    # Parity: reference graph.py:482-499 (to_dot via the graphviz package) and
+    # :501-615 (matplotlib CDF + bar plots). DOT source is emitted directly so
+    # no graphviz runtime is required; plots gate on matplotlib import.
+
+    def to_dot(self, path: Optional[str] = None) -> str:
+        """Render as Graphviz DOT source; node labels carry the profile fields.
+
+        Returns the DOT text; if ``path`` is given, also writes it there.
+        """
+
+        def esc(s: str) -> str:
+            return s.replace("\\", "\\\\").replace('"', '\\"')
+
+        lines = ["digraph {"]
+        for n in self.topological_sort():
+            label = (
+                f"{esc(n.node_desc)}\\n"
+                f"fwd={n.forward_compute_time:.3f}ms bwd={n.backward_compute_time:.3f}ms\\n"
+                f"act={n.activation_size / 1e6:.2f}MB params={n.parameter_size / 1e6:.2f}MB"
+            )
+            if n.stage_id is not None:
+                label += f"\\nstage={n.stage_id}"
+            lines.append(f'  "node{esc(n.node_id)}" [label="{label}"];')
+        for i in self.nodes:
+            for j in self.edges.get(i, []):
+                lines.append(f'  "node{esc(i)}" -> "node{esc(j)}";')
+        lines.append("}")
+        text = "\n".join(lines) + "\n"
+        if path:
+            with open(path, "w") as f:
+                f.write(text)
+        return text
+
+    def plot_cdfs(self, path: str) -> None:
+        """CDFs of per-node compute time, activation size, and parameter size
+        (reference graph.py:501-557 render_bar_graphs_and_cdfs)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        nodes = self.topological_sort()
+        series = [
+            ("compute time (ms)",
+             sorted(n.forward_compute_time + n.backward_compute_time for n in nodes)),
+            ("activation size (bytes)", sorted(n.activation_size for n in nodes)),
+            ("parameter size (bytes)", sorted(n.parameter_size for n in nodes)),
+        ]
+        fig, axes = plt.subplots(1, 3, figsize=(15, 4))
+        for ax, (label, xs) in zip(axes, series):
+            total = sum(xs) or 1.0
+            cum, ys = 0.0, []
+            for v in xs:
+                cum += v
+                ys.append(100.0 * cum / total)
+            ax.plot(range(len(xs)), ys)
+            ax.set_xlabel("node index (sorted)")
+            ax.set_ylabel("cumulative % of total")
+            ax.set_title(label)
+        fig.tight_layout()
+        fig.savefig(path)
+        plt.close(fig)
+
+    def plot_bars(self, path: str) -> None:
+        """Per-node bar charts in topological order (reference graph.py:559-615)."""
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        nodes = self.topological_sort()
+        idx = range(len(nodes))
+        fields = [
+            ("fwd+bwd time (ms)",
+             [n.forward_compute_time + n.backward_compute_time for n in nodes]),
+            ("activation size (MB)", [n.activation_size / 1e6 for n in nodes]),
+            ("parameter size (MB)", [n.parameter_size / 1e6 for n in nodes]),
+        ]
+        fig, axes = plt.subplots(3, 1, figsize=(max(8, len(nodes) * 0.25), 9))
+        for ax, (label, ys) in zip(axes, fields):
+            ax.bar(idx, ys)
+            ax.set_ylabel(label)
+        axes[-1].set_xlabel("node (topological order)")
+        fig.tight_layout()
+        fig.savefig(path)
+        plt.close(fig)
+
     # -- aggregates --------------------------------------------------------
 
     def total_compute(self) -> float:

@@ -1,0 +1,109 @@
+"""Activation/gradient logger — torchlogger analog (SURVEY.md §5.5).
+
+The reference's ActivationAndGradientLogger
+(pipedream-fork/profiler/torchmodules/torchlogger/activation_gradient_logger.py:24-60,
+driven by profiler main.py:543-582) registers forward/backward hooks on every
+module and pickles each layer's activation and gradient every
+``log_activations_freq`` epochs for ``log_activations_minibatches`` minibatches.
+
+TPU-native design: no hooks exist under jit, and none are needed — one jitted
+function returns every boundary activation and the loss-gradient with respect
+to each of them. Gradients come from the zero-tap trick: each layer output gets
+``+ tap_i`` with ``tap_i = 0``; ``jax.grad`` with respect to the taps is exactly
+dLoss/d(activation_i), with no change to the computed values. One capture costs
+one fwd+bwd of the model. Results are written as one ``.npz`` per (epoch, step)
+with ``act_{i:02d}_{name}`` / ``grad_{i:02d}_{name}`` arrays.
+
+Capture operates on the flat per-layer params/state structure shared by the
+non-packed strategies (single/dp/tp/fsdp/sp/ep). Pipeline strategies pack
+per-stage params into matrices; callers log from an unpacked replica instead.
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ddlbench_tpu.models.layers import LayerModel
+from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
+
+
+def _capture(model: LayerModel, compute_dtype, aux_weight, params, state, x, y):
+    from ddlbench_tpu.models.moe import collect_aux_losses
+
+    p = cast_params(params, compute_dtype)
+    xin = cast_input(x, compute_dtype)
+
+    def tapped_loss(taps):
+        # Same total loss the training step optimizes (ce + weighted MoE
+        # router aux, parallel/common.py loss_with_moe_aux) so the logged
+        # gradients match training gradients.
+        acts = []
+        aux: list = []
+        h = xin
+        with collect_aux_losses(aux):
+            for layer, lp, ls, tap in zip(model.layers, p, state, taps):
+                h, _ = layer.apply(lp, ls, h, True)
+                h = h + tap
+                acts.append(h)
+        loss = cross_entropy_loss(h, y) + aux_weight * sum(aux, jnp.float32(0.0))
+        return loss, acts
+
+    # One traced forward: tap shapes come from an abstract eval, the real
+    # values from the value_and_grad pass below.
+    shapes = jax.eval_shape(lambda: tapped_loss(
+        [0.0] * len(model.layers))[1])
+    taps = [jnp.zeros(s.shape, s.dtype) for s in shapes]
+    (loss, acts), grads = jax.value_and_grad(tapped_loss, has_aux=True)(taps)
+    return loss, acts, grads
+
+
+class ActivationLogger:
+    """Writes per-layer activations/gradients to ``dir/epoch{E}/step{S}.npz``."""
+
+    def __init__(self, log_dir: str, model: LayerModel, compute_dtype,
+                 freq_epochs: int = 1, steps_per_epoch: int = 1,
+                 moe_aux_weight: float = 0.0):
+        self.log_dir = log_dir
+        self.model = model
+        self.freq = max(1, freq_epochs)
+        self.steps = max(1, steps_per_epoch)
+        self._capture = jax.jit(
+            functools.partial(_capture, model, compute_dtype, moe_aux_weight)
+        )
+        self._names = [
+            f"{i:02d}_{re.sub(r'[^A-Za-z0-9_]+', '_', layer.name)}"
+            for i, layer in enumerate(model.layers)
+        ]
+
+    def should_log(self, epoch: int, step: int) -> bool:
+        # epochs are 1-based; "every freq epochs" starts at the first epoch
+        # (reference torchlogger semantics, profiler main.py:543-582).
+        return (epoch - 1) % self.freq == 0 and step < self.steps
+
+    def log(self, epoch: int, step: int, params, state, x, y) -> Optional[str]:
+        """Capture and write one minibatch; returns the npz path (or None).
+
+        Only process 0 writes (multihost runs share the filesystem path; the
+        capture itself is replicated work every process could do).
+        """
+        if not self.should_log(epoch, step):
+            return None
+        if jax.process_index() != 0:
+            return None
+        loss, acts, grads = self._capture(params, state, x, y)
+        out: Dict[str, Any] = {"loss": np.asarray(loss, np.float32)}
+        for name, a, g in zip(self._names, acts, grads):
+            out[f"act_{name}"] = np.asarray(a.astype(jnp.float32))
+            out[f"grad_{name}"] = np.asarray(g.astype(jnp.float32))
+        d = os.path.join(self.log_dir, f"epoch{epoch}")
+        os.makedirs(d, exist_ok=True)
+        path = os.path.join(d, f"step{step}.npz")
+        np.savez_compressed(path, **out)
+        return path

@@ -1,0 +1,120 @@
+"""Log scraper — process_output analog (SURVEY.md §2 C12).
+
+The reference validates runs post-hoc by scraping its print-based logs
+(pipedream-fork/runtime/scripts/process_output.py, process_output_gnmt.py):
+regexes over ``slurm.out`` pull per-epoch throughput/loss/accuracy into a
+summary. This framework emits structured JSONL directly (``--jsonl``), but the
+scraper exists anyway to prove the printed schema (train/metrics.py) really is
+machine-parseable and to process logs from runs where JSONL wasn't enabled.
+
+Usage:
+    python -m ddlbench_tpu.tools.process_output run.log [run2.log ...]
+
+Prints one JSON summary per input file:
+    {"file": ..., "epochs": N, "train_intervals": N,
+     "samples_per_sec_avg": X, "sec_per_epoch_avg": S,
+     "final_valid_accuracy": A, "per_epoch": [{...}, ...],
+     "comm_mb_per_step": M|null, "manifest": {...}|null}
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import sys
+from typing import Any, Dict, List
+
+TRAIN_RE = re.compile(
+    r"train \| (?P<epoch>\d+)/(?P<total>\d+) epoch \((?P<pct>[\d.]+)%\) \| "
+    r"(?P<sps>[\d.]+) samples/sec \| loss (?P<loss>[-\d.naife]+) \| "
+    r"mem (?P<mem>[\d.]+) GB in use, (?P<peak>[\d.]+) GB peak"
+)
+EPOCH_RE = re.compile(
+    r"epoch (?P<epoch>\d+)/(?P<total>\d+) done \| (?P<sps>[\d.]+) samples/sec \| "
+    r"(?P<sec>[\d.]+) sec"
+)
+VALID_RE = re.compile(
+    r"valid \| (?P<epoch>\d+)/(?P<total>\d+) epoch \| loss (?P<loss>[-\d.naife]+) \| "
+    r"accuracy (?P<acc>[\d.]+)"
+)
+SUMMARY_RE = re.compile(
+    r"valid accuracy: (?P<acc>[\d.]+) \| (?P<sps>[\d.]+) samples/sec, "
+    r"(?P<sec>[\d.]+) sec/epoch \(average\)"
+)
+COMM_RE = re.compile(r"comm volume/step: (?P<mb>[\d.]+) MB")
+MANIFEST_RE = re.compile(r"run manifest: (?P<json>\{.*\})")
+
+
+def scrape(text: str) -> Dict[str, Any]:
+    """Parse one run's log text into a summary dict."""
+    intervals: List[Dict[str, float]] = []
+    epochs: Dict[int, Dict[str, float]] = {}
+    # Present (as null) even when the run died before the summary line.
+    summary: Dict[str, Any] = {
+        "final_valid_accuracy": None,
+        "samples_per_sec_avg": None,
+        "sec_per_epoch_avg": None,
+    }
+    comm_mb = None
+    manifest = None
+    for line in text.splitlines():
+        if m := TRAIN_RE.search(line):
+            intervals.append(
+                {
+                    "epoch": int(m["epoch"]),
+                    "progress_pct": float(m["pct"]),
+                    "samples_per_sec": float(m["sps"]),
+                    "loss": float(m["loss"]),
+                    "mem_peak_gb": float(m["peak"]),
+                }
+            )
+        elif m := EPOCH_RE.search(line):
+            e = int(m["epoch"])
+            epochs.setdefault(e, {"epoch": e})
+            epochs[e]["samples_per_sec"] = float(m["sps"])
+            epochs[e]["epoch_seconds"] = float(m["sec"])
+        elif m := VALID_RE.search(line):
+            e = int(m["epoch"])
+            epochs.setdefault(e, {"epoch": e})
+            epochs[e]["valid_loss"] = float(m["loss"])
+            epochs[e]["valid_accuracy"] = float(m["acc"])
+        elif m := SUMMARY_RE.search(line):
+            summary = {
+                "final_valid_accuracy": float(m["acc"]),
+                "samples_per_sec_avg": float(m["sps"]),
+                "sec_per_epoch_avg": float(m["sec"]),
+            }
+        elif m := COMM_RE.search(line):
+            comm_mb = float(m["mb"])
+        elif m := MANIFEST_RE.search(line):
+            try:
+                manifest = json.loads(m["json"])
+            except json.JSONDecodeError:
+                pass
+    per_epoch = [epochs[e] for e in sorted(epochs)]
+    return {
+        "epochs": len(per_epoch),
+        "train_intervals": len(intervals),
+        "per_epoch": per_epoch,
+        "comm_mb_per_step": comm_mb,
+        "manifest": manifest,
+        **summary,
+    }
+
+
+def main(argv=None) -> int:
+    paths = (argv if argv is not None else sys.argv[1:]) or []
+    if not paths:
+        print("usage: python -m ddlbench_tpu.tools.process_output LOG [LOG...]",
+              file=sys.stderr)
+        return 2
+    for path in paths:
+        with open(path) as f:
+            out = scrape(f.read())
+        out["file"] = path
+        print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -108,6 +108,30 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
             start_epoch = ep + 1
             print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
 
+    # Activation/gradient deep-dive logging (torchlogger analog, §5.5).
+    # Works on the flat per-layer param structure; pipeline strategies pack
+    # params per stage, so those log from the model definition is not wired —
+    # documented in profiler/actlog.py.
+    actlog = None
+    if cfg.activation_log_dir:
+        from ddlbench_tpu.profiler.actlog import ActivationLogger
+
+        # Structure check once here: the logger needs the flat per-layer param
+        # list; pipeline strategies pack params per stage (ts structure is
+        # fixed by strategy.init, so this cannot change mid-run).
+        model = getattr(strategy, "model", None)
+        params = getattr(ts, "params", None)
+        if (model is not None and isinstance(params, list)
+                and len(params) == len(model.layers)):
+            actlog = ActivationLogger(
+                cfg.activation_log_dir, model, jnp.dtype(cfg.compute_dtype),
+                cfg.activation_log_freq, cfg.activation_log_steps,
+                moe_aux_weight=cfg.moe_aux_weight,
+            )
+        else:
+            print("activation logging unsupported for this strategy "
+                  "(packed or absent per-layer params); skipped", flush=True)
+
     if wd:
         wd.kick()
         wd.start()
@@ -120,7 +144,18 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
         tick = time.perf_counter()
         interval_tick, interval_samples = tick, 0
         for step in range(steps):
-            x, y = strategy.shard_batch(*data.batch(epoch, step))
+            bx, by = data.batch(epoch, step)
+            if actlog is not None and actlog.should_log(epoch, step):
+                try:
+                    path = actlog.log(epoch, step, ts.params, ts.model_state,
+                                      bx, by)
+                except RuntimeError as e:  # e.g. non-addressable sharded params
+                    print(f"activation logging failed ({e}); disabled",
+                          flush=True)
+                    actlog, path = None, None
+                if path:
+                    print(f"activations logged: {path}", flush=True)
+            x, y = strategy.shard_batch(bx, by)
             ts, metrics = strategy.train_step(ts, x, y, jnp.float32(lr))
             interval_samples += global_batch
             # With the watchdog armed, sync every step so the deadline really

@@ -1,0 +1,104 @@
+"""Activation/gradient logger (torchlogger analog, SURVEY.md §5.5).
+
+Checks the zero-tap capture against a hand-built closure: dLoss/d(activation_i)
+from ActivationLogger must equal jax.grad of the suffix of the network, and the
+last activation must match a plain forward.
+"""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+import pytest
+
+from ddlbench_tpu.models.zoo import get_model
+from ddlbench_tpu.models.layers import init_model, apply_model
+from ddlbench_tpu.parallel.common import cross_entropy_loss
+from ddlbench_tpu.profiler.actlog import ActivationLogger
+
+
+@pytest.fixture(scope="module")
+def small_model():
+    model = get_model("resnet18", "mnist")
+    params, state, _ = init_model(model, jax.random.key(0))
+    x = jax.random.normal(jax.random.key(1), (4, 28, 28, 1), jnp.float32)
+    y = jnp.array([0, 1, 2, 3], jnp.int32)
+    return model, params, state, x, y
+
+
+def test_npz_layout_and_forward_match(tmp_path, small_model):
+    model, params, state, x, y = small_model
+    logger = ActivationLogger(str(tmp_path), model, jnp.float32)
+    path = logger.log(1, 0, params, state, x, y)
+    assert path is not None
+    data = np.load(path)
+    act_keys = [k for k in data.files if k.startswith("act_")]
+    grad_keys = [k for k in data.files if k.startswith("grad_")]
+    assert len(act_keys) == len(model.layers)
+    assert len(grad_keys) == len(model.layers)
+
+    # final activation == plain forward logits
+    logits, _ = apply_model(model, params, state, x, True)
+    last = sorted(act_keys)[-1]
+    np.testing.assert_allclose(data[last], np.asarray(logits), rtol=1e-5, atol=1e-5)
+    assert np.isfinite(data["loss"])
+
+
+def test_gradient_matches_suffix_grad(tmp_path, small_model):
+    model, params, state, x, y = small_model
+    logger = ActivationLogger(str(tmp_path), model, jnp.float32)
+    path = logger.log(1, 0, params, state, x, y)
+    data = np.load(path)
+
+    # dLoss/d(logits) computed directly
+    logits, _ = apply_model(model, params, state, x, True)
+    g_direct = jax.grad(lambda z: cross_entropy_loss(z, y))(logits)
+    last_grad = sorted(k for k in data.files if k.startswith("grad_"))[-1]
+    np.testing.assert_allclose(data[last_grad], np.asarray(g_direct),
+                               rtol=1e-5, atol=1e-6)
+
+    # dLoss/d(act_k) for an interior k: rerun the suffix from act_k
+    k = len(model.layers) - 3
+    acts = [data[s] for s in sorted(a for a in data.files if a.startswith("act_"))]
+
+    def suffix_loss(h):
+        for layer, lp, ls in list(zip(model.layers, params, state))[k + 1:]:
+            h, _ = layer.apply(lp, ls, h, True)
+        return cross_entropy_loss(h, y)
+
+    g_suffix = jax.grad(suffix_loss)(jnp.asarray(acts[k]))
+    got = data[sorted(s for s in data.files if s.startswith("grad_"))[k]]
+    np.testing.assert_allclose(got, np.asarray(g_suffix), rtol=1e-4, atol=1e-5)
+
+
+def test_freq_and_steps_gating(tmp_path, small_model):
+    model, params, state, x, y = small_model
+    logger = ActivationLogger(str(tmp_path), model, jnp.float32,
+                              freq_epochs=2, steps_per_epoch=2)
+    # 1-based epochs, logging starts at epoch 1: freq=2 -> epochs 1, 3, 5...
+    assert logger.should_log(1, 0) and logger.should_log(1, 1)
+    assert logger.should_log(3, 0)
+    assert not logger.should_log(2, 0)
+    assert not logger.should_log(1, 2)
+    assert logger.log(2, 0, params, state, x, y) is None
+
+
+def test_moe_aux_loss_included(tmp_path):
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from tiny_models import tiny_moe
+    from ddlbench_tpu.parallel.common import loss_with_moe_aux
+
+    model = tiny_moe()
+    params, state, _ = init_model(model, jax.random.key(0))
+    x = jax.random.randint(jax.random.key(1), (4, 32), 0, 64, jnp.int32)
+    y = jax.random.randint(jax.random.key(2), (4, 32), 0, 64, jnp.int32)
+    w = 0.5
+    logger = ActivationLogger(str(tmp_path), model, jnp.float32,
+                              moe_aux_weight=w)
+    path = logger.log(1, 0, params, state, x, y)
+    data = np.load(path)
+    total, ce, _, _ = loss_with_moe_aux(model, params, state, x, y, True,
+                                        jnp.float32, w)
+    # logged loss is the full training loss (ce + w*aux), not bare ce
+    np.testing.assert_allclose(data["loss"], float(total), rtol=1e-5)
+    assert float(total) != pytest.approx(float(ce))

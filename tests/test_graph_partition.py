@@ -157,3 +157,17 @@ def test_profiler_token_models():
 
     g2 = profile_model(tiny_moe(), 2, mode="flops")
     assert len(g2.nodes) == 4
+
+
+def test_to_dot_and_plots(tmp_path):
+    g = chain_graph([1.0, 2.0], params=[4e6, 8e6], acts=[1e6, 2e6])
+    g.nodes["1"].stage_id = 0
+    dot = g.to_dot(str(tmp_path / "g.dot"))
+    assert dot.startswith("digraph {")
+    assert '"node0" -> "node1";' in dot
+    assert "stage=0" in dot
+    assert (tmp_path / "g.dot").read_text() == dot
+    g.plot_cdfs(str(tmp_path / "cdf.png"))
+    g.plot_bars(str(tmp_path / "bars.png"))
+    assert (tmp_path / "cdf.png").stat().st_size > 0
+    assert (tmp_path / "bars.png").stat().st_size > 0

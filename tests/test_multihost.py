@@ -96,7 +96,14 @@ def test_two_process_training_all_strategies():
             env=env, cwd=REPO,
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         ))
-    outs = [p.communicate(timeout=540)[0] for p in procs]
+    try:
+        outs = [p.communicate(timeout=540)[0] for p in procs]
+    except subprocess.TimeoutExpired:
+        for p in procs:  # no orphaned workers holding the coordinator port
+            p.kill()
+        for p in procs:
+            p.communicate()
+        raise
     for pid, (p, out) in enumerate(zip(procs, outs)):
         assert p.returncode == 0, f"proc {pid} failed:\n{out[-3000:]}"
     # both processes computed over the same global mesh -> identical metrics

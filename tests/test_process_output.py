@@ -1,0 +1,56 @@
+"""Scraper round-trip: the printed log schema must parse back losslessly
+(process_output analog, SURVEY.md §2 C12)."""
+
+import json
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.tools.process_output import scrape
+from ddlbench_tpu.train.loop import run_benchmark
+
+
+def test_scrape_synthetic_lines():
+    text = "\n".join(
+        [
+            'run manifest: {"benchmark": "mnist", "framework": "single"}',
+            "comm volume/step: 12.34 MB (boundaries 10.00 MB, allreduce 2.34 MB)",
+            "train | 1/3 epoch (50%) | 123.45 samples/sec | loss 2.1000 | "
+            "mem 0.50 GB in use, 0.75 GB peak",
+            "epoch 1/3 done | 120.00 samples/sec | 8.33 sec",
+            "valid | 1/3 epoch | loss 2.0000 | accuracy 0.1500",
+            "valid accuracy: 0.1500 | 120.00 samples/sec, 8.33 sec/epoch (average)",
+        ]
+    )
+    out = scrape(text)
+    assert out["manifest"]["benchmark"] == "mnist"
+    assert out["comm_mb_per_step"] == 12.34
+    assert out["train_intervals"] == 1
+    assert out["per_epoch"][0]["samples_per_sec"] == 120.0
+    assert out["per_epoch"][0]["valid_accuracy"] == 0.15
+    assert out["final_valid_accuracy"] == 0.15
+    assert out["sec_per_epoch_avg"] == 8.33
+
+
+def test_scrape_real_run_output(capsys):
+    cfg = RunConfig(
+        benchmark="mnist", strategy="single", arch="resnet18",
+        epochs=2, steps_per_epoch=2, batch_size=8, log_interval=1,
+        compute_dtype="float32",
+    )
+    result = run_benchmark(cfg)
+    text = capsys.readouterr().out
+    out = scrape(text)
+    assert out["epochs"] == 2
+    assert out["train_intervals"] == 4
+    assert abs(out["final_valid_accuracy"] - result["valid_accuracy"]) < 1e-4
+    # averaged throughput line matches the returned summary
+    assert abs(out["samples_per_sec_avg"] - result["samples_per_sec"]) < 0.01
+    # sanity: summary is JSON-serializable as the CLI prints it
+    json.dumps(out)
+
+
+def test_scrape_crashed_run_has_null_summary():
+    out = scrape("train | 1/3 epoch (50%) | 10.00 samples/sec | loss 2.0000 | "
+                 "mem 0.10 GB in use, 0.20 GB peak")
+    assert out["final_valid_accuracy"] is None
+    assert out["samples_per_sec_avg"] is None
+    assert out["train_intervals"] == 1
