@@ -49,6 +49,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="MoE router load-balance loss weight (MoE archs)")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
                    help="MoE expert capacity = ceil(cf * tokens / experts)")
+    p.add_argument("--label-smoothing", type=float, default=None,
+                   help="training-objective label smoothing (default: 0.1 for "
+                        "seq2seq benchmarks — GNMT parity — else 0)")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--attention-backend", default="auto",
                    choices=ATTENTION_BACKENDS,
@@ -103,6 +106,7 @@ def config_from_args(args) -> RunConfig:
         lr=args.lr,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,
+        label_smoothing=args.label_smoothing,
         compute_dtype=args.dtype,
         attention_backend=args.attention_backend,
         seed=args.seed,

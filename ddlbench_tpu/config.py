@@ -35,11 +35,14 @@ class DatasetSpec:
     num_classes: int  # classes, or vocab size for tokens
     train_size: int
     test_size: int
-    kind: str = "image"  # "image" | "tokens"
+    kind: str = "image"  # "image" | "tokens" | "seq2seq"
+    # seq2seq only: length of the source segment within the T-token stream
+    # (positions < src_len are the source; loss is masked there).
+    src_len: Optional[int] = None
 
     @property
     def seq_len(self) -> int:
-        assert self.kind == "tokens"
+        assert self.kind in ("tokens", "seq2seq")
         return self.image_size[0]
 
 
@@ -54,6 +57,12 @@ DATASETS: Mapping[str, DatasetSpec] = {
     # LM context and a long-context stressor for sequence parallelism.
     "synthtext": DatasetSpec("synthtext", (1024,), 32_768, 100_000, 10_000, kind="tokens"),
     "longctx": DatasetSpec("longctx", (8192,), 32_768, 20_000, 2_000, kind="tokens"),
+    # Synthetic translation: the seq2seq workload (reference GNMT analog,
+    # SURVEY.md §2 C13) as a prefix-LM stream — 128 source + 128 target tokens
+    # (reference GNMT trains at max seq length 50-75 per side; see
+    # models/seq2seq.py for the re-design rationale).
+    "synthmt": DatasetSpec("synthmt", (256,), 32_768, 200_000, 20_000,
+                           kind="seq2seq", src_len=128),
 }
 
 STRATEGIES = ("single", "dp", "gpipe", "pipedream", "sp", "tp", "fsdp", "ep")
@@ -69,9 +78,9 @@ ATTENTION_BACKENDS = ("auto", "flash", "xla")
 # the global batch.
 DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
     "single": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-               "synthtext": 16, "longctx": 2},
+               "synthtext": 16, "longctx": 2, "synthmt": 64},
     "dp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-           "synthtext": 16, "longctx": 2},
+           "synthtext": 16, "longctx": 2, "synthmt": 64},
     "gpipe": {
         "mnist": (128, 24),
         "cifar10": (64, 32),
@@ -79,9 +88,10 @@ DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
         "highres": (4, 12),
         "synthtext": (4, 8),
         "longctx": (1, 8),
+        "synthmt": (16, 8),
     },
     "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64,
-                  "synthtext": 64, "longctx": 8},
+                  "synthtext": 64, "longctx": 8, "synthmt": 128},
     "sp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
            "synthtext": 16, "longctx": 2},
     # ep: per-device batch (batch and experts both shard the one mesh axis)
@@ -170,6 +180,12 @@ class RunConfig:
     moe_aux_weight: float = 0.01
     moe_capacity_factor: float = 1.25
 
+    # Label smoothing for the training objective (GNMT parity: the reference
+    # translation workload trains with smoothing 0.1,
+    # runtime/translation seq2seq label-smoothing module). None = per-workload
+    # default (0.1 for seq2seq benchmarks, 0 otherwise).
+    label_smoothing: Optional[float] = None
+
     # Numerics.
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
     # "auto" = Pallas flash-attention kernel on TPU, jnp elsewhere.
@@ -209,9 +225,14 @@ class RunConfig:
     def resolved_lr(self) -> float:
         if self.lr is not None:
             return self.lr
-        if self.dataset().kind == "tokens":
+        if self.dataset().kind in ("tokens", "seq2seq"):
             return 0.01
         return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
+
+    def resolved_label_smoothing(self) -> float:
+        if self.label_smoothing is not None:
+            return self.label_smoothing
+        return 0.1 if self.dataset().kind == "seq2seq" else 0.0
 
     def resolved_momentum(self) -> float:
         if self.momentum is not None:
@@ -278,6 +299,8 @@ class RunConfig:
             )
         if self.hang_timeout_s is not None and self.hang_timeout_s <= 0:
             raise ValueError("hang_timeout_s must be positive")
+        if self.label_smoothing is not None and not 0.0 <= self.label_smoothing < 1.0:
+            raise ValueError("label_smoothing must be in [0, 1)")
         if self.strategy == "sp" and self.dataset().kind != "tokens":
             raise ValueError("sp (sequence parallelism) requires a token benchmark")
         if self.strategy == "ep":

@@ -79,10 +79,11 @@ def generate_dataset(data_dir: str, spec: DatasetSpec, split: str = "train",
     if lib is None:
         raise RuntimeError("native dataloader unavailable (no toolchain?)")
     count = count or (spec.train_size if split == "train" else spec.test_size)
-    if spec.kind == "tokens":
+    if spec.kind in ("tokens", "seq2seq"):
         # token sequences ride the same raw-uint8 store: one sample is T+1
         # tokens x 4 little-endian bytes (viewed as int32 % vocab on read;
-        # the +1 gives the next-token label shift, data/synthetic.py:90-95)
+        # the +1 gives the next-token label shift, data/synthetic.py:90-95;
+        # seq2seq's source-position masking happens at read time in ondisk.py)
         h, w, c = spec.seq_len + 1, 4, 1
     else:
         h, w, c = spec.image_size

@@ -38,7 +38,7 @@ class OnDiskData:
         self.batch_size = batch_size
         self.dtype_name = str(jnp.dtype(dtype))
         self._loaders = {}
-        if spec.kind == "tokens":
+        if spec.kind in ("tokens", "seq2seq"):
             want_hwc = (spec.seq_len + 1, 4, 1)
         else:
             want_hwc = tuple(spec.image_size)
@@ -66,14 +66,20 @@ class OnDiskData:
 
     def batch(self, epoch: int, step: int, train: bool = True) -> Tuple[jax.Array, jax.Array]:
         imgs, labels = self._loaders["train" if train else "test"].next()
-        if self.spec.kind == "tokens":
+        if self.spec.kind in ("tokens", "seq2seq"):
             # raw store holds (T+1) x 4 bytes per sample; view as int32 ids
             # and return the two length-T next-token shifts (matching
-            # data/synthetic.py's convention)
+            # data/synthetic.py's convention); seq2seq masks source-internal
+            # label positions
             flat = np.ascontiguousarray(imgs).reshape(imgs.shape[0], -1)
             ids = flat.view("<i4") % self.spec.num_classes
             ids = jnp.asarray(ids)
-            return ids[:, :-1], ids[:, 1:]
+            labels = ids[:, 1:]
+            if self.spec.kind == "seq2seq":
+                from ddlbench_tpu.data.synthetic import mask_source_labels
+
+                labels = mask_source_labels(labels, self.spec.src_len)
+            return ids[:, :-1], labels
         return _normalize(jnp.asarray(imgs), jnp.asarray(labels), self.dtype_name)
 
     def close(self) -> None:

@@ -67,11 +67,24 @@ class SyntheticData:
             self.spec.num_classes,
             self.dtype,
             self.spec.kind,
+            self.spec.src_len,
         )
 
     def epoch_iter(self, epoch: int, train: bool = True) -> Iterator[Tuple[jax.Array, jax.Array]]:
         for step in range(self.steps_per_epoch(train)):
             yield self.batch(epoch, step, train)
+
+
+def mask_source_labels(labels: jax.Array, src_len: int) -> jax.Array:
+    """Mask (-1) the source-internal label positions of a seq2seq stream.
+
+    Shared by the synthetic and on-disk data paths so the boundary convention
+    lives in exactly one place: position src_len-1 predicts the first target
+    token, so positions < src_len-1 are masked and loss covers exactly the
+    target segment (GNMT objective analog).
+    """
+    pos = jnp.arange(labels.shape[-1])
+    return jnp.where(pos >= src_len - 1, labels, -1)
 
 
 @functools.partial(jax.jit, static_argnums=(1, 2))
@@ -84,7 +97,7 @@ def _synthetic_images(key: jax.Array, shape: Tuple[int, ...], dtype) -> jax.Arra
 
 
 def _gen_batch(seed, epoch, step, batch, image_size, num_classes, dtype,
-               kind="image"):
+               kind="image", src_len=None):
     key = jax.random.fold_in(jax.random.fold_in(jax.random.key(seed), epoch), step)
     kx, ky = jax.random.split(key)
     if kind == "tokens":
@@ -93,6 +106,13 @@ def _gen_batch(seed, epoch, step, batch, image_size, num_classes, dtype,
         T = image_size[0]
         seq = jax.random.randint(kx, (batch, T + 1), 0, num_classes, jnp.int32)
         return seq[:, :-1], seq[:, 1:]
+    if kind == "seq2seq":
+        # Synthetic translation stream: [source | target] tokens; labels are
+        # the next-token shift with source positions masked (see
+        # mask_source_labels).
+        T = image_size[0]
+        seq = jax.random.randint(kx, (batch, T + 1), 0, num_classes, jnp.int32)
+        return seq[:, :-1], mask_source_labels(seq[:, 1:], src_len)
     x = _synthetic_images(kx, (batch, *image_size), dtype)
     y = jax.random.randint(ky, (batch,), 0, num_classes, dtype=jnp.int32)
     return x, y

@@ -89,6 +89,9 @@ class LayerModel:
     # "float" (images/features) or "tokens" (int32 ids into a vocab of
     # num_classes) — tells the profiler and tools how to synthesize inputs.
     input_kind: str = "float"
+    # seq2seq models only: the prefix-LM source-segment length baked into the
+    # attention masks (decode entry points validate against it).
+    src_len: int | None = None
 
 
 def init_model(model: LayerModel, key: jax.Array):

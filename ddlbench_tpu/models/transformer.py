@@ -112,17 +112,22 @@ def _flash_dispatch():
     return on_tpu, False
 
 
-def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0):
-    """Masked attention for blocks of a causal sequence.
+def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
+                     prefix_len: int = 0):
+    """Masked attention for blocks of a causal (or prefix-LM) sequence.
 
     q: [B, H, Tq, Dh]; k/v: [B, H, Tk, Dh]. Offsets give each block's absolute
     position so the same primitive serves full attention (offsets 0) and ring
-    attention over sequence shards (parallel/sp.py). On TPU this dispatches to
-    the fused Pallas flash-attention kernel (ops/flash_attention.py) unless
-    set_attention_backend("xla") was called.
+    attention over sequence shards (parallel/sp.py). ``prefix_len`` > 0 adds
+    the prefix-LM rule: key positions < prefix_len are visible to every query
+    (the seq2seq source segment, models/seq2seq.py). On TPU the pure-causal
+    case dispatches to the fused Pallas flash-attention kernel
+    (ops/flash_attention.py) unless set_attention_backend("xla") was called;
+    the prefix case runs the XLA path (prefix support in the kernel is a
+    planned optimization).
     """
     use_flash, interpret = _flash_dispatch()
-    if use_flash:
+    if use_flash and prefix_len == 0:
         from ddlbench_tpu.ops.flash_attention import flash_attention
 
         return flash_attention(q, k, v, q_offset, k_offset,
@@ -131,7 +136,10 @@ def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0):
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)
     q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
     k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
-    scores = jnp.where(q_pos >= k_pos, scores, -jnp.inf)
+    ok = q_pos >= k_pos
+    if prefix_len:
+        ok = ok | (k_pos < prefix_len)
+    scores = jnp.where(ok, scores, -jnp.inf)
     # numerically safe softmax that tolerates fully-masked rows
     m = jnp.max(scores, axis=-1, keepdims=True)
     m = jnp.where(jnp.isfinite(m), m, 0.0)
@@ -183,11 +191,12 @@ def ring_attention(q, k, v, axis: str):
     return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
 
 
-def attention_sublayer(p, x, n_heads: int):
-    """Pre-LN causal self-attention sublayer with residual: reads p["ln1"],
+def attention_sublayer(p, x, n_heads: int, prefix_len: int = 0):
+    """Pre-LN self-attention sublayer with residual: reads p["ln1"],
     p["wqkv"], p["wo"]. Dispatches to ring attention over the active
     sequence_parallel axis, so every block (dense and MoE) gets the
-    sequence-parallel path from one implementation."""
+    sequence-parallel path from one implementation. ``prefix_len`` selects the
+    prefix-LM mask (seq2seq; causal-only under sequence parallelism)."""
     B, T, d = x.shape
     dh = d // n_heads
     h = layer_norm(p["ln1"], x)
@@ -199,14 +208,22 @@ def attention_sublayer(p, x, n_heads: int):
 
     axis = _seq_axis()
     if axis is None:
-        o = causal_attention(heads(q), heads(k), heads(v))
+        o = causal_attention(heads(q), heads(k), heads(v),
+                             prefix_len=prefix_len)
     else:
+        if prefix_len:
+            raise NotImplementedError(
+                "prefix-LM attention has no ring implementation; the sp "
+                "strategy is causal-only (RunConfig.validate enforces this)")
         o = ring_attention(heads(q), heads(k), heads(v), axis)
     o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
     return x + o @ p["wo"].astype(x.dtype)
 
 
-def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4) -> Layer:
+def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
+                      prefix_len: int = 0) -> Layer:
+    """Pre-LN block; ``prefix_len`` > 0 switches the attention to the
+    prefix-LM mask (the seq2seq workload, models/seq2seq.py)."""
     dh = d_model // n_heads
 
     def init(key, in_shape):
@@ -226,7 +243,7 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4)
         return p, {}, (T, d)
 
     def apply(p, s, x, train):
-        x = attention_sublayer(p, x, n_heads)
+        x = attention_sublayer(p, x, n_heads, prefix_len)
         h = layer_norm(p["ln2"], x)
         h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
         x = x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))

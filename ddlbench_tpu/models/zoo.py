@@ -15,12 +15,19 @@ from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
                "mobilenetv2", "transformer_s", "transformer_m",
-               "transformer_moe_s")
+               "transformer_moe_s", "seq2seq_s", "seq2seq_m")
 
 
 def get_model(arch: str, dataset: str | DatasetSpec,
               moe_capacity_factor: float = 1.25) -> LayerModel:
     spec = dataset if isinstance(dataset, DatasetSpec) else DATASETS[dataset]
+    if arch.startswith("seq2seq"):
+        if spec.kind != "seq2seq":
+            raise ValueError(f"{arch} requires a seq2seq dataset, got {spec.name}")
+        from ddlbench_tpu.models.seq2seq import build_seq2seq
+
+        return build_seq2seq(arch, spec.image_size, spec.num_classes,
+                             spec.src_len)
     if arch.startswith("transformer"):
         if spec.kind != "tokens":
             raise ValueError(f"{arch} requires a token dataset, got {spec.name}")

@@ -28,13 +28,22 @@ from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.single import TrainState
 
 
-def _local_ce_sums(logits, labels):
-    """(sum of token NLL, sum of correct, count) over the local shard."""
+def _local_ce_sums(logits, labels, smoothing: float = 0.0):
+    """(sum of token NLL, sum of correct, valid count) over the local shard.
+
+    Positions with labels < 0 are ignored (seq2seq masking convention);
+    ``smoothing`` applies GNMT-style label smoothing to the NLL sum.
+    """
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    ll = jnp.take_along_axis(logp, labels[..., None], axis=-1)[..., 0]
-    correct = jnp.sum((jnp.argmax(logits, -1) == labels).astype(jnp.int32))
-    return -jnp.sum(ll), correct, labels.size
+    mask = (labels >= 0)
+    maskf = mask.astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    if smoothing:
+        nll = (1.0 - smoothing) * nll - smoothing * jnp.mean(logp, axis=-1)
+    correct = jnp.sum(((jnp.argmax(logits, -1) == labels) & mask).astype(jnp.int32))
+    return jnp.sum(nll * maskf), correct, jnp.sum(maskf)
 
 
 class AxisShardedStrategy:
@@ -63,6 +72,8 @@ class AxisShardedStrategy:
         self._batch_sharding = NamedSharding(self.mesh, self._batch_spec())
         cdtype = self.compute_dtype
 
+        smooth = cfg.resolved_label_smoothing()
+
         def fwd_local(params, state, xl, yl, train: bool):
             aux: list = []
             with contextlib.ExitStack() as stack:
@@ -72,14 +83,19 @@ class AxisShardedStrategy:
                 logits, new_state = apply_model(
                     model, cast_params(params, cdtype), state, xl, train
                 )
-            nll, correct, cnt = _local_ce_sums(logits, yl)
-            ce = lax.psum(nll, axis) / lax.psum(jnp.float32(cnt), axis)
+            # training objective may be label-smoothed; the reported ce is not
+            obj_nll, correct, cnt = _local_ce_sums(
+                logits, yl, smooth if train else 0.0)
+            ce_nll = _local_ce_sums(logits, yl)[0] if (train and smooth) else obj_nll
+            count = lax.psum(jnp.float32(cnt), axis)
+            obj = lax.psum(obj_nll, axis) / count
+            ce = lax.psum(ce_nll, axis) / count
             # MoE router load-balance term, averaged over the axis shards
             # (empty list for dense models).
             aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), axis) / n
-            loss = ce + aux_w * aux_loss
+            loss = obj + aux_w * aux_loss
             correct = lax.psum(correct, axis)
-            return loss, ce, correct, new_state
+            return loss, ce, correct, count, new_state
 
         def make_sharded(train: bool):
             def inner(params, state, xl, yl):
@@ -90,7 +106,7 @@ class AxisShardedStrategy:
                 mesh=self.mesh,
                 in_specs=(self._param_specs(), P(), self._batch_spec(),
                           self._batch_spec()),
-                out_specs=(P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P()),
             )
 
         fn_train = make_sharded(True)
@@ -98,25 +114,26 @@ class AxisShardedStrategy:
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
-                loss, ce, correct, new_state = fn_train(params, ts.model_state, x, y)
-                return loss, (ce, correct, new_state)
+                loss, ce, correct, count, new_state = fn_train(
+                    params, ts.model_state, x, y)
+                return loss, (ce, correct, count, new_state)
 
-            (_, (ce, correct, new_state)), grads = jax.value_and_grad(
+            (_, (ce, correct, count, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
             metrics = {
                 "loss": ce,  # headline metric stays comparable across strategies
-                "accuracy": correct.astype(jnp.float32) / y.size,
+                "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, count),
             }
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
-            _, ce, correct, _ = fn_eval(ts.params, ts.model_state, x, y)
+            _, ce, correct, count, _ = fn_eval(ts.params, ts.model_state, x, y)
             return {
                 "loss": ce,
                 "correct": correct,
-                "count": jnp.asarray(y.size, jnp.int32),
+                "count": count.astype(jnp.int32),
             }
 
         self.train_step = jax.jit(

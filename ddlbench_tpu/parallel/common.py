@@ -15,16 +15,40 @@ import jax
 import jax.numpy as jnp
 
 
-def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Mean CE over every label position; works for classification
-    (logits [B, C], labels [B]) and LM heads (logits [B, T, V], labels [B, T])."""
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
+                       smoothing: float = 0.0) -> jax.Array:
+    """Mean CE over valid label positions; works for classification
+    (logits [B, C], labels [B]) and LM heads (logits [B, T, V], labels [B, T]).
+
+    Positions with ``labels < 0`` are ignored (the seq2seq workload masks
+    source-segment positions this way). ``smoothing`` is GNMT-style label
+    smoothing (reference seq2seq/train/smoothing.py semantics: smoothed
+    target = (1-s) on the gold label, s spread uniformly): loss_tok =
+    (1-s)*NLL(gold) - s*mean_v(logp_v). For all-valid labels and s=0 this is
+    the plain mean CE.
+    """
     logits = logits.astype(jnp.float32)
     logp = jax.nn.log_softmax(logits, axis=-1)
-    return -jnp.mean(jnp.take_along_axis(logp, labels[..., None], axis=-1))
+    mask = (labels >= 0).astype(jnp.float32)
+    safe = jnp.maximum(labels, 0)
+    nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+    if smoothing:
+        nll = (1.0 - smoothing) * nll - smoothing * jnp.mean(logp, axis=-1)
+    return jnp.sum(nll * mask) / jnp.maximum(1.0, jnp.sum(mask))
 
 
 def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    return jnp.mean((jnp.argmax(logits, axis=-1) == labels).astype(jnp.float32))
+    """Top-1 accuracy over valid (label >= 0) positions."""
+    ok = (jnp.argmax(logits, axis=-1) == labels) & (labels >= 0)
+    valid = jnp.sum((labels >= 0).astype(jnp.float32))
+    return jnp.sum(ok.astype(jnp.float32)) / jnp.maximum(1.0, valid)
+
+
+def correct_and_count(logits: jax.Array, labels: jax.Array):
+    """(correct int32, valid-position count int32) for eval accumulation."""
+    ok = (jnp.argmax(logits, axis=-1) == labels) & (labels >= 0)
+    return (jnp.sum(ok.astype(jnp.int32)),
+            jnp.sum((labels >= 0).astype(jnp.int32)))
 
 
 class SGDState(NamedTuple):
@@ -93,14 +117,16 @@ def cast_input(x, dtype):
 
 
 def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
-                      aux_weight):
+                      aux_weight, smoothing: float = 0.0):
     """Apply the model and return (total_loss, ce, logits, new_state).
 
-    total_loss = cross-entropy + aux_weight * (MoE router load-balance losses
-    collected during the apply — zero for dense models). Shared by every
-    strategy whose loss is computed from one traced apply (single/dp/tp/fsdp);
-    sp/ep inline the same pattern because their aux terms need a psum over the
-    shard_map axis first.
+    total_loss = cross-entropy (optionally label-smoothed — the training
+    objective) + aux_weight * (MoE router load-balance losses collected during
+    the apply — zero for dense models). The returned ``ce`` is the *unsmoothed*
+    CE so the headline loss metric stays comparable across configurations.
+    Shared by every strategy whose loss is computed from one traced apply
+    (single/dp/tp/fsdp); sp/ep inline the same pattern because their aux terms
+    need a psum over the shard_map axis first.
     """
     from ddlbench_tpu.models.layers import apply_model
     from ddlbench_tpu.models.moe import collect_aux_losses
@@ -112,4 +138,5 @@ def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
             model, p, model_state, cast_input(x, compute_dtype), train
         )
     ce = cross_entropy_loss(logits, y)
-    return ce + aux_weight * sum(aux, jnp.float32(0.0)), ce, logits, new_state
+    obj = cross_entropy_loss(logits, y, smoothing) if smoothing else ce
+    return obj + aux_weight * sum(aux, jnp.float32(0.0)), ce, logits, new_state

@@ -45,7 +45,8 @@ except ImportError:  # pragma: no cover
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
-from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.common import (
+    cast_input, cast_params, correct_and_count, cross_entropy_loss)
 from ddlbench_tpu.parallel.packing import (
     balanced_stage_bounds,
     layer_flop_costs,
@@ -156,6 +157,8 @@ class GPipeStrategy:
         num_classes = self.model.num_classes
         last = s == S - 1
 
+        smooth = self.cfg.resolved_label_smoothing() if train else 0.0
+
         def branch(param_row, state_row, x_buf, xs, ys, t):
             m = jnp.clip(t - s, 0, M - 1)
             if s == 0:
@@ -168,13 +171,15 @@ class GPipeStrategy:
                                         cast_input(x, cdtype), train)
             if last:
                 labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
-                loss = cross_entropy_loss(y, labels)
-                correct = jnp.sum(
-                    (jnp.argmax(y, -1) == labels).astype(jnp.int32)
-                )
+                # loss (the grad path) may be label-smoothed; ce is the
+                # reported headline metric, comparable across strategies.
+                ce = cross_entropy_loss(y, labels)
+                loss = cross_entropy_loss(y, labels, smooth) if smooth else ce
+                correct = correct_and_count(y, labels)[0]
                 y_out = jnp.zeros((A,), cdtype)
             else:
                 loss = jnp.zeros((), jnp.float32)
+                ce = jnp.zeros((), jnp.float32)
                 correct = jnp.zeros((), jnp.int32)
                 y_out = pad_vec(y.astype(cdtype), A)
             new_state_row = pad_vec(
@@ -183,7 +188,8 @@ class GPipeStrategy:
             )
             # Constant-valued outputs (zeros) carry no varying-axes annotation;
             # normalize every output's VMA type so lax.switch branches agree.
-            return (_vary(y_out), _vary(new_state_row), _vary(loss), _vary(correct))
+            return (_vary(y_out), _vary(new_state_row), _vary(loss),
+                    _vary(ce), _vary(correct))
 
         if train and self.cfg.remat_stages:
             branch = jax.checkpoint(branch)
@@ -220,44 +226,46 @@ class GPipeStrategy:
             T = M + S - 1
 
             def body(carry, t):
-                x_buf, st_row, loss_acc, corr_acc = carry
-                y_buf, new_st, loss_mb, corr_mb = lax.switch(
+                x_buf, st_row, loss_acc, ce_acc, corr_acc = carry
+                y_buf, new_st, loss_mb, ce_mb, corr_mb = lax.switch(
                     s_idx, branches, param_row, st_row, x_buf, xs, ys, t
                 )
                 m_idx = t - s_idx
                 valid = (m_idx >= 0) & (m_idx < M)
                 st_row = jnp.where(valid, new_st, st_row)
                 loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
+                ce_acc = ce_acc + jnp.where(valid, ce_mb, 0.0)
                 corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
                 if perm:
                     x_next = lax.ppermute(y_buf, "stage", perm)
                 else:
                     x_next = y_buf
-                return (x_next, st_row, loss_acc, corr_acc), None
+                return (x_next, st_row, loss_acc, ce_acc, corr_acc), None
 
             init_carry = (
                 _vary(jnp.zeros((A,), self.compute_dtype)),
                 state_row,
                 _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
             )
-            (x_buf, st_row, loss_acc, corr_acc), _ = lax.scan(
+            (x_buf, st_row, loss_acc, ce_acc, corr_acc), _ = lax.scan(
                 body, init_carry, jnp.arange(T)
             )
             # Loss lives on the last stage only; make it global.
-            loss = lax.psum(loss_acc, "stage") / M
-            loss = lax.pmean(loss, "data")
+            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
+            ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
             # Sync BN running stats across data replicas (sync-BN choice,
             # documented deviation — SURVEY.md §7).
             st_row = lax.pmean(st_row, "data")
-            return loss, st_row[None], correct
+            return loss, ce, st_row[None], correct
 
         return _shard_map(
             inner,
             mesh=mesh,
             in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
-            out_specs=(P(), P("stage", None), P()),
+            out_specs=(P(), P(), P("stage", None), P()),
         )
 
     @property
@@ -274,20 +282,22 @@ class GPipeStrategy:
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
             def loss_fn(params_mat):
-                loss, new_state, correct = pipe_train(params_mat, ts.model_state, xs, ys)
-                return loss, (new_state, correct)
+                loss, ce, new_state, correct = pipe_train(
+                    params_mat, ts.model_state, xs, ys)
+                return loss, (ce, new_state, correct)
 
-            (loss, (new_state, correct)), grads = jax.value_and_grad(
+            (_, (ce, new_state, correct)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
             g = grads + wd * ts.params if wd else grads
             momentum = mom * ts.momentum + g
             params = ts.params - lr * momentum
+            # valid label positions (samples, or unmasked tokens for LM /
+            # seq2seq workloads)
+            valid = jnp.sum((ys >= 0).astype(jnp.float32))
             metrics = {
-                "loss": loss,
-                # ys.size counts every label position (samples, or tokens for
-                # LM workloads).
-                "accuracy": correct.astype(jnp.float32) / ys.size,
+                "loss": ce,
+                "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
             }
             return PipeTrainState(params, new_state, momentum), metrics
 
@@ -302,11 +312,11 @@ class GPipeStrategy:
         pipe_eval = self._make_pipe_fn(train=False)
 
         def eval_step(ts, xs, ys):
-            loss, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
+            loss, _, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
             return {
                 "loss": loss,
                 "correct": correct,
-                "count": jnp.asarray(ys.size, jnp.int32),
+                "count": jnp.sum((ys >= 0).astype(jnp.int32)),
             }
 
         return jax.jit(

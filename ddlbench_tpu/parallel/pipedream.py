@@ -50,7 +50,8 @@ from jax.flatten_util import ravel_pytree
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from ddlbench_tpu.models.layers import apply_slice
-from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
+from ddlbench_tpu.parallel.common import (
+    cast_input, cast_params, correct_and_count, cross_entropy_loss)
 from ddlbench_tpu.parallel.gpipe import GPipeStrategy, _shard_map, _vary
 from ddlbench_tpu.parallel.packing import pad_vec
 
@@ -127,6 +128,7 @@ class PipeDreamStrategy(GPipeStrategy):
         H = 2 * M + 2 * S - 2
         NSLOT = min(S, M)
         mom, wd = self._mom, self._wd
+        smooth = self.cfg.resolved_label_smoothing()
         mesh = self.mesh
         total = self._total_samples
         cdtype = self.compute_dtype
@@ -169,8 +171,10 @@ class PipeDreamStrategy(GPipeStrategy):
                     y, new_st = stage_fwd(params, st_row, x)
                     if last:
                         labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
+                        # metric only (the backward recomputes its own
+                        # objective): plain CE, masked-label aware
                         loss_mb = cross_entropy_loss(y, labels)
-                        corr_mb = jnp.sum((jnp.argmax(y, -1) == labels).astype(jnp.int32))
+                        corr_mb = correct_and_count(y, labels)[0]
                         y_out = jnp.zeros((A,), cdtype)
                     else:
                         loss_mb = jnp.zeros((), jnp.float32)
@@ -217,7 +221,8 @@ class PipeDreamStrategy(GPipeStrategy):
 
                         def loss_of(pv, xv):
                             y, _ = stage_fwd(pv, st_row, xv)
-                            return cross_entropy_loss(y, labels)
+                            # training objective (label-smoothed for seq2seq)
+                            return cross_entropy_loss(y, labels, smooth)
 
                         if s == 0:
                             gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
@@ -361,9 +366,10 @@ class PipeDreamStrategy(GPipeStrategy):
             params, st, momentum, loss, correct = pipe(
                 ts.params, ts.model_state, ts.momentum, xs, ys, lr
             )
+            valid = jnp.sum((ys >= 0).astype(jnp.float32))
             metrics = {
                 "loss": loss,
-                "accuracy": correct.astype(jnp.float32) / ys.size,
+                "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
             }
             return PDTrainState(params, st, momentum), metrics
 

@@ -20,6 +20,7 @@ from ddlbench_tpu.parallel.common import (
     accuracy,
     cast_input,
     cast_params,
+    correct_and_count,
     cross_entropy_loss,
     loss_with_moe_aux,
     sgd_init,
@@ -42,12 +43,13 @@ class SingleStrategy:
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         mom = cfg.resolved_momentum()
         wd = cfg.resolved_weight_decay()
+        smooth = cfg.resolved_label_smoothing()
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
                 loss, ce, logits, new_state = loss_with_moe_aux(
                     model, params, ts.model_state, x, y, True,
-                    self.compute_dtype, cfg.moe_aux_weight,
+                    self.compute_dtype, cfg.moe_aux_weight, smooth,
                 )
                 return loss, (ce, logits, new_state)
 
@@ -64,10 +66,11 @@ class SingleStrategy:
             logits, _ = apply_model(
                 model, p, ts.model_state, cast_input(x, self.compute_dtype), False
             )
+            correct, count = correct_and_count(logits, y)
             return {
                 "loss": cross_entropy_loss(logits, y),
-                "correct": jnp.sum(jnp.argmax(logits, -1) == y),
-                "count": jnp.asarray(y.size, jnp.int32),
+                "correct": correct,
+                "count": count,
             }
 
         self.train_step = jax.jit(train_step, donate_argnums=(0,))

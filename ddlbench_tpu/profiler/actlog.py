@@ -34,16 +34,17 @@ from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.parallel.common import cast_input, cast_params, cross_entropy_loss
 
 
-def _capture(model: LayerModel, compute_dtype, aux_weight, params, state, x, y):
+def _capture(model: LayerModel, compute_dtype, aux_weight, smoothing,
+             params, state, x, y):
     from ddlbench_tpu.models.moe import collect_aux_losses
 
     p = cast_params(params, compute_dtype)
     xin = cast_input(x, compute_dtype)
 
     def tapped_loss(taps):
-        # Same total loss the training step optimizes (ce + weighted MoE
-        # router aux, parallel/common.py loss_with_moe_aux) so the logged
-        # gradients match training gradients.
+        # Same total loss the training step optimizes (label-smoothed ce +
+        # weighted MoE router aux, parallel/common.py loss_with_moe_aux) so
+        # the logged gradients match training gradients.
         acts = []
         aux: list = []
         h = xin
@@ -52,7 +53,8 @@ def _capture(model: LayerModel, compute_dtype, aux_weight, params, state, x, y):
                 h, _ = layer.apply(lp, ls, h, True)
                 h = h + tap
                 acts.append(h)
-        loss = cross_entropy_loss(h, y) + aux_weight * sum(aux, jnp.float32(0.0))
+        loss = (cross_entropy_loss(h, y, smoothing)
+                + aux_weight * sum(aux, jnp.float32(0.0)))
         return loss, acts
 
     # One traced forward: tap shapes come from an abstract eval, the real
@@ -69,13 +71,14 @@ class ActivationLogger:
 
     def __init__(self, log_dir: str, model: LayerModel, compute_dtype,
                  freq_epochs: int = 1, steps_per_epoch: int = 1,
-                 moe_aux_weight: float = 0.0):
+                 moe_aux_weight: float = 0.0, label_smoothing: float = 0.0):
         self.log_dir = log_dir
         self.model = model
         self.freq = max(1, freq_epochs)
         self.steps = max(1, steps_per_epoch)
         self._capture = jax.jit(
-            functools.partial(_capture, model, compute_dtype, moe_aux_weight)
+            functools.partial(_capture, model, compute_dtype, moe_aux_weight,
+                              label_smoothing)
         )
         self._names = [
             f"{i:02d}_{re.sub(r'[^A-Za-z0-9_]+', '_', layer.name)}"

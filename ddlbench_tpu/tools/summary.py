@@ -48,9 +48,18 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     models = [args.model] if args.model else MODEL_NAMES
     benchmarks = [args.benchmark] if args.benchmark else sorted(DATASETS)
+    explicit = bool(args.model and args.benchmark)
     for arch in models:
         for b in benchmarks:
-            print(summarize(arch, b))
+            try:
+                out = summarize(arch, b)
+            except ValueError:
+                # incompatible pair (image arch x token dataset etc.): matrix
+                # mode skips it; an explicitly requested pair still errors
+                if explicit:
+                    raise
+                continue
+            print(out)
             print()
     return 0
 

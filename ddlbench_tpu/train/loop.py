@@ -127,6 +127,7 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
                 cfg.activation_log_dir, model, jnp.dtype(cfg.compute_dtype),
                 cfg.activation_log_freq, cfg.activation_log_steps,
                 moe_aux_weight=cfg.moe_aux_weight,
+                label_smoothing=cfg.resolved_label_smoothing(),
             )
         else:
             print("activation logging unsupported for this strategy "

@@ -82,3 +82,24 @@ def test_summary_tool():
     total_line = out.strip().splitlines()[-1]
     n = int(total_line.split()[-1].replace(",", ""))
     assert 10e6 < n < 13e6
+
+
+def test_summary_matrix_skips_incompatible_pairs(capsys, monkeypatch):
+    import ddlbench_tpu.tools.summary as summary
+
+    monkeypatch.setattr(summary, "MODEL_NAMES", ("resnet18", "seq2seq_s"))
+    monkeypatch.setattr(
+        summary, "DATASETS",
+        {k: v for k, v in summary.DATASETS.items() if k in ("mnist", "synthmt")},
+    )
+    assert summary.main([]) == 0
+    out = capsys.readouterr().out
+    assert "== resnet18 / mnist" in out
+    assert "== seq2seq_s / synthmt" in out
+    assert "resnet18 / synthmt" not in out
+    assert "seq2seq_s / mnist" not in out
+    # an explicitly requested incompatible pair still errors
+    import pytest
+
+    with pytest.raises(ValueError):
+        summary.main(["-m", "resnet18", "-b", "synthmt"])
