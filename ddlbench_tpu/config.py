@@ -40,6 +40,16 @@ class DatasetSpec:
     # (positions < src_len are the source; loss is masked there).
     src_len: Optional[int] = None
 
+    def __post_init__(self) -> None:
+        if self.kind == "seq2seq":
+            if self.src_len is None:
+                raise ValueError("kind='seq2seq' requires src_len")
+            if not 0 < self.src_len < self.image_size[0]:
+                raise ValueError(
+                    f"src_len {self.src_len} must be inside the "
+                    f"{self.image_size[0]}-token stream"
+                )
+
     @property
     def seq_len(self) -> int:
         assert self.kind in ("tokens", "seq2seq")

@@ -108,13 +108,19 @@ def build_seq2seq(arch: str, in_shape, vocab: int, src_len: int) -> LayerModel:
 # ---------------------------------------------------------------------------
 
 
-def _check_src(model: LayerModel, src) -> None:
+def _check_src(model: LayerModel, src, total_len: int) -> None:
     if model.src_len is None:
         raise ValueError(f"{model.name} is not a seq2seq model")
     if src.ndim != 2 or src.shape[1] != model.src_len:
         raise ValueError(
             f"src must be [B, {model.src_len}] (the src_len baked into "
             f"{model.name}'s attention masks), got {tuple(src.shape)}"
+        )
+    T = model.in_shape[0]
+    if not model.src_len < total_len <= T:
+        raise ValueError(
+            f"total_len must be in ({model.src_len}, {T}] (past the source, "
+            f"within {model.name}'s trained context), got {total_len}"
         )
 
 
@@ -130,7 +136,7 @@ def greedy_decode(model: LayerModel, params, state, src, total_len: int):
 
     Returns [B, total_len] where positions >= src_len are argmax continuations.
     """
-    _check_src(model, src)
+    _check_src(model, src, total_len)
     B, S = src.shape
     x0 = jnp.zeros((B, total_len), jnp.int32).at[:, :S].set(src)
 
@@ -152,7 +158,7 @@ def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
     length so no finished-hypothesis bookkeeping is needed.
     Returns (tokens [B, total_len], score [B]) for the best beam.
     """
-    _check_src(model, src)
+    _check_src(model, src, total_len)
     B, S = src.shape
     V = model.num_classes
     # [B*beam, total_len] hypothesis buffer; beams identical at start.

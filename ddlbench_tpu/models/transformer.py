@@ -120,17 +120,16 @@ def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
     position so the same primitive serves full attention (offsets 0) and ring
     attention over sequence shards (parallel/sp.py). ``prefix_len`` > 0 adds
     the prefix-LM rule: key positions < prefix_len are visible to every query
-    (the seq2seq source segment, models/seq2seq.py). On TPU the pure-causal
-    case dispatches to the fused Pallas flash-attention kernel
-    (ops/flash_attention.py) unless set_attention_backend("xla") was called;
-    the prefix case runs the XLA path (prefix support in the kernel is a
-    planned optimization).
+    (the seq2seq source segment, models/seq2seq.py). On TPU this dispatches
+    to the fused Pallas flash-attention kernel (ops/flash_attention.py) —
+    which implements the same prefix rule with block-level skipping — unless
+    set_attention_backend("xla") was called.
     """
     use_flash, interpret = _flash_dispatch()
-    if use_flash and prefix_len == 0:
+    if use_flash:
         from ddlbench_tpu.ops.flash_attention import flash_attention
 
-        return flash_attention(q, k, v, q_offset, k_offset,
+        return flash_attention(q, k, v, q_offset, k_offset, prefix_len,
                                interpret=interpret)
     dh = q.shape[-1]
     scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / math.sqrt(dh)

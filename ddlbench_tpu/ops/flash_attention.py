@@ -42,15 +42,22 @@ def _pick_block(t: int, preferred: int) -> int:
     return b
 
 
-def _causal_kv_bound(q_hi_pos, k_offset: int, block_k: int, num_k: int):
-    """Number of leading K blocks any query position <= q_hi_pos can see."""
+def _causal_kv_bound(q_hi_pos, k_offset: int, block_k: int, num_k: int,
+                     prefix_len: int = 0):
+    """Number of leading K blocks any query position <= q_hi_pos can see.
+
+    With a prefix (prefix-LM), K blocks overlapping [0, prefix_len) are
+    visible to every query, so the bound is at least the prefix block count.
+    """
     visible = q_hi_pos - k_offset + 1  # k positions strictly visible
+    if prefix_len:
+        visible = jnp.maximum(visible, prefix_len - k_offset)
     nb = (visible + block_k - 1) // block_k
     return jnp.clip(nb, 0, num_k)
 
 
 def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
-                q_offset, k_offset, num_k):
+                q_offset, k_offset, num_k, prefix_len):
     bq = q_ref.shape[1]
     dh = q_ref.shape[2]
     q = q_ref[0]  # [bq, dh] native dtype; MXU accumulates f32 below
@@ -60,7 +67,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
     m0 = jnp.full((bq, 1), NEG_INF, jnp.float32)
     l0 = jnp.zeros((bq, 1), jnp.float32)
     acc0 = jnp.zeros((bq, dh), jnp.float32)
-    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k, num_k)
+    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k,
+                             num_k, prefix_len)
 
     def body(j, carry):
         m, l, acc = carry
@@ -73,6 +81,8 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
         k_pos = (k_offset + j * block_k
                  + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
         mask = q_pos >= k_pos
+        if prefix_len:
+            mask = mask | (k_pos < prefix_len)
         s = jnp.where(mask, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new) * mask.astype(jnp.float32)
@@ -95,7 +105,7 @@ def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, *, scale, block_k,
 
 
 def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
-               scale, block_k, q_offset, k_offset, num_k):
+               scale, block_k, q_offset, k_offset, num_k, prefix_len):
     bq = q_ref.shape[1]
     q = q_ref[0]
     do = do_ref[0]
@@ -103,7 +113,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
     delta = delta_ref[0]  # [bq, 1]
     qi = pl.program_id(1)
     q_pos = q_offset + qi * bq + jax.lax.broadcasted_iota(jnp.int32, (bq, 1), 0)
-    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k, num_k)
+    bound = _causal_kv_bound(q_offset + (qi + 1) * bq - 1, k_offset, block_k,
+                             num_k, prefix_len)
 
     def body(j, dq):
         k_blk = k_ref[0, pl.ds(j * block_k, block_k), :]
@@ -115,6 +126,8 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
         k_pos = (k_offset + j * block_k
                  + jax.lax.broadcasted_iota(jnp.int32, (1, block_k), 1))
         mask = q_pos >= k_pos
+        if prefix_len:
+            mask = mask | (k_pos < prefix_len)
         # where() BEFORE the multiply: fully-masked rows have lse ~ -1e30 and
         # exp(s - lse) overflows to inf; inf * 0 would poison dq with NaN.
         p = jnp.where(mask, jnp.exp(s - lse), 0.0)
@@ -135,16 +148,20 @@ def _dq_kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, *,
 
 
 def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
-                dk_ref, dv_ref, *, scale, block_q, q_offset, k_offset, num_q):
+                dk_ref, dv_ref, *, scale, block_q, q_offset, k_offset, num_q,
+                prefix_len):
     bk = k_ref.shape[1]
     k = k_ref[0]
     v = v_ref[0]
     kj = pl.program_id(1)
     k_pos = (k_offset + kj * bk
              + jax.lax.broadcasted_iota(jnp.int32, (1, bk), 1))
-    # first q block whose last position can see this k block's first position
+    # first q block whose last position can see this k block's first position;
+    # a k block overlapping the prefix is visible to every q block
     k_lo = k_offset + kj * bk
     start = jnp.clip((k_lo - q_offset) // block_q, 0, num_q)
+    if prefix_len:
+        start = jnp.where(k_lo < prefix_len, 0, start)
 
     def body(i, carry):
         dk, dv = carry
@@ -159,6 +176,8 @@ def _dkv_kernel(k_ref, v_ref, q_ref, do_ref, lse_ref, delta_ref,
             preferred_element_type=jnp.float32,
         ) * scale
         mask = q_pos >= k_pos
+        if prefix_len:
+            mask = mask | (k_pos < prefix_len)
         # see _dq_kernel: mask inside where() to keep inf out of the matmuls
         p = jnp.where(mask, jnp.exp(s - lse_blk), 0.0)  # [bq, bk]
         dv = dv + jax.lax.dot_general(
@@ -190,24 +209,27 @@ def _bh(x):
 
 
 @functools.partial(
-    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7)
+    jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8)
 )
-def flash_attention(q, k, v, q_offset=0, k_offset=0, block_q=512,
-                    block_k=512, interpret=False):
-    """Causal attention, [B, H, T, dh] -> [B, H, Tq, dh], fused on TPU.
+def flash_attention(q, k, v, q_offset=0, k_offset=0, prefix_len=0,
+                    block_q=512, block_k=512, interpret=False):
+    """Causal / prefix-LM attention, [B, H, T, dh] -> [B, H, Tq, dh], fused.
 
     Semantics match models/transformer.py causal_attention (including the
-    q_offset/k_offset absolute-position convention); fully-masked rows
-    return 0. Block sizes shrink automatically to divide the sequence.
-    Default 512x512 blocks measured fastest on v5e (2.3-2.5x over the XLA
-    attention at T=1024-4096 forward, 1.2-1.9x forward+backward).
+    q_offset/k_offset absolute-position convention and the prefix-LM rule:
+    absolute key positions < prefix_len are visible to every query — the
+    seq2seq source segment); fully-masked rows return 0. Block sizes shrink
+    automatically to divide the sequence. Default 512x512 blocks measured
+    fastest on v5e (2.3-2.5x over the XLA attention at T=1024-4096 forward,
+    1.2-1.9x forward+backward).
     """
-    o, _ = _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k,
-                           interpret)
+    o, _ = _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q,
+                           block_k, interpret)
     return o
 
 
-def _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
+def _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
+                    interpret):
     B, H, Tq, dh = q.shape
     Tk = k.shape[2]
     bq = _pick_block(Tq, block_q)
@@ -220,6 +242,7 @@ def _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
     kern = functools.partial(
         _fwd_kernel, scale=scale, block_k=bk,
         q_offset=q_offset, k_offset=k_offset, num_k=num_k,
+        prefix_len=prefix_len,
     )
     o, lse = pl.pallas_call(
         kern,
@@ -242,13 +265,15 @@ def _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
     return o.reshape(B, H, Tq, dh), lse
 
 
-def _flash_fwd(q, k, v, q_offset, k_offset, block_q, block_k, interpret):
-    o, lse = _flash_fwd_impl(q, k, v, q_offset, k_offset, block_q, block_k,
-                             interpret)
+def _flash_fwd(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
+               interpret):
+    o, lse = _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q,
+                             block_k, interpret)
     return o, (q, k, v, o, lse)
 
 
-def _flash_bwd(q_offset, k_offset, block_q, block_k, interpret, res, g):
+def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
+               res, g):
     q, k, v, o, lse = res
     B, H, Tq, dh = q.shape
     Tk = k.shape[2]
@@ -267,6 +292,7 @@ def _flash_bwd(q_offset, k_offset, block_q, block_k, interpret, res, g):
         functools.partial(
             _dq_kernel, scale=scale, block_k=bk,
             q_offset=q_offset, k_offset=k_offset, num_k=num_k,
+            prefix_len=prefix_len,
         ),
         grid=(BH, num_q),
         in_specs=[
@@ -286,6 +312,7 @@ def _flash_bwd(q_offset, k_offset, block_q, block_k, interpret, res, g):
         functools.partial(
             _dkv_kernel, scale=scale, block_q=bq,
             q_offset=q_offset, k_offset=k_offset, num_q=num_q,
+            prefix_len=prefix_len,
         ),
         grid=(BH, num_k),
         in_specs=[

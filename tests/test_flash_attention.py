@@ -42,7 +42,7 @@ def test_forward_matches_reference():
     q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
     with jax.default_matmul_precision("highest"):
         ref = causal_attention(q, k, v)
-        got = flash_attention(q, k, v, 0, 0, 32, 32, True)
+        got = flash_attention(q, k, v, 0, 0, 0, 32, 32, True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                rtol=1e-4, atol=1e-5)
 
@@ -56,7 +56,7 @@ def test_grads_match_reference():
             lambda *a: jnp.sum(causal_attention(*a) * g), argnums=(0, 1, 2)
         )(q, k, v)
         fa_g = jax.grad(
-            lambda *a: jnp.sum(flash_attention(*a, 0, 0, 32, 32, True) * g),
+            lambda *a: jnp.sum(flash_attention(*a, 0, 0, 0, 32, 32, True) * g),
             argnums=(0, 1, 2),
         )(q, k, v)
     for a, b in zip(ref_g, fa_g):
@@ -73,7 +73,7 @@ def test_offsets_match_reference():
     v = _rand((B, H, 128, dh), ks[2])
     with jax.default_matmul_precision("highest"):
         ref = causal_attention(q, k, v, q_offset=500, k_offset=0)
-        got = flash_attention(q, k, v, 500, 0, 32, 32, True)
+        got = flash_attention(q, k, v, 500, 0, 0, 32, 32, True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                rtol=1e-4, atol=1e-5)
 
@@ -90,7 +90,7 @@ def test_offset_grads_no_nan():
     with jax.default_matmul_precision("highest"):
         # queries 0..63 vs keys at absolute 10..73: rows 0-9 fully masked
         fa_g = jax.grad(
-            lambda *a: jnp.sum(flash_attention(*a, 0, 10, 32, 32, True) * g),
+            lambda *a: jnp.sum(flash_attention(*a, 0, 10, 0, 32, 32, True) * g),
             argnums=(0, 1, 2),
         )(q, k, v)
         ref_g = jax.grad(
@@ -107,7 +107,7 @@ def test_fully_masked_is_zero():
     B, H, T, dh = 1, 1, 32, 8
     ks = jax.random.split(jax.random.key(3), 3)
     q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
-    out = flash_attention(q, k, v, 0, 1000, 16, 16, True)
+    out = flash_attention(q, k, v, 0, 1000, 0, 16, 16, True)
     assert np.all(np.asarray(out) == 0.0)
 
 
@@ -118,7 +118,7 @@ def test_uneven_blocks():
     q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
     with jax.default_matmul_precision("highest"):
         ref = causal_attention(q, k, v)
-        got = flash_attention(q, k, v, 0, 0, 64, 64, True)
+        got = flash_attention(q, k, v, 0, 0, 0, 64, 64, True)
     np.testing.assert_allclose(np.asarray(ref), np.asarray(got),
                                rtol=1e-4, atol=1e-5)
 
@@ -146,3 +146,38 @@ def test_backend_validation():
 
     with pytest.raises(ValueError, match="attention_backend"):
         RunConfig(attention_backend="cuda").validate()
+
+
+def test_prefix_forward_matches_reference():
+    B, H, T, dh = 2, 2, 96, 16
+    S = 40  # not block-aligned (blocks of 32): exercises the partial block
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        ref = causal_attention(q, k, v, prefix_len=S)
+        got = flash_attention(q, k, v, 0, 0, S, 32, 32, True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)
+    # sanity: the prefix result differs from pure-causal
+    causal = flash_attention(q, k, v, 0, 0, 0, 32, 32, True)
+    assert not np.allclose(np.asarray(got), np.asarray(causal))
+
+
+def test_prefix_grads_match_reference():
+    B, H, T, dh = 1, 2, 64, 16
+    S = 24
+    ks = jax.random.split(jax.random.key(8), 4)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks[:3])
+    g = _rand((B, H, T, dh), ks[3])
+    with jax.default_matmul_precision("highest"):
+        ref_grads = jax.grad(
+            lambda *a: jnp.sum(causal_attention(*a, prefix_len=S) * g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+        got_grads = jax.grad(
+            lambda *a: jnp.sum(flash_attention(*a, 0, 0, S, 16, 16, True) * g),
+            argnums=(0, 1, 2),
+        )(q, k, v)
+    for r, got in zip(ref_grads, got_grads):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(r),
+                                   rtol=5e-5, atol=5e-5)

@@ -190,3 +190,38 @@ def test_decode_rejects_wrong_src_width(model_and_params):
     p2, s2_, _ = im(lm, jax.random.key(0))
     with pytest.raises(ValueError, match="not a seq2seq"):
         s2s.greedy_decode(lm, p2, s2_, jnp.zeros((1, 8), jnp.int32), 16)
+
+
+def test_seq2seq_flash_backend_matches_xla(model_and_params):
+    from ddlbench_tpu.models.transformer import set_attention_backend
+
+    model, params, state = model_and_params
+    x = jax.random.randint(jax.random.key(9), (2, TINY_MT.image_size[0]),
+                           0, 64, jnp.int32)
+    with jax.default_matmul_precision("highest"):
+        set_attention_backend("xla")
+        try:
+            ref = _logits(model, params, state, x)
+        finally:
+            set_attention_backend("flash")  # interpret-mode kernel off-TPU
+        try:
+            got = _logits(model, params, state, x)
+        finally:
+            set_attention_backend("auto")
+    np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                               rtol=2e-4, atol=2e-4)
+
+
+def test_decode_rejects_bad_total_len(model_and_params):
+    model, params, state = model_and_params
+    src = jnp.zeros((1, TINY_MT.src_len), jnp.int32)
+    for bad in (TINY_MT.src_len, TINY_MT.image_size[0] + 1):
+        with pytest.raises(ValueError, match="total_len"):
+            s2s.greedy_decode(model, params, state, src, bad)
+
+
+def test_spec_requires_src_len():
+    with pytest.raises(ValueError, match="src_len"):
+        DatasetSpec("badmt", (16,), 64, 10, 10, kind="seq2seq")
+    with pytest.raises(ValueError, match="src_len"):
+        DatasetSpec("badmt", (16,), 64, 10, 10, kind="seq2seq", src_len=16)
