@@ -23,7 +23,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_model, init_model
 from ddlbench_tpu.models.moe import collect_aux_losses
-from ddlbench_tpu.parallel.common import cast_params, sgd_init, sgd_update
+from ddlbench_tpu.parallel.common import (cast_params, correct_topk,
+                                          sgd_init, sgd_update)
 from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.single import TrainState
 
@@ -95,7 +96,8 @@ class AxisShardedStrategy:
             aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), axis) / n
             loss = obj + aux_w * aux_loss
             correct = lax.psum(correct, axis)
-            return loss, ce, correct, count, new_state
+            correct5 = lax.psum(correct_topk(logits, yl), axis)
+            return loss, ce, correct, correct5, count, new_state
 
         def make_sharded(train: bool):
             def inner(params, state, xl, yl):
@@ -106,7 +108,7 @@ class AxisShardedStrategy:
                 mesh=self.mesh,
                 in_specs=(self._param_specs(), P(), self._batch_spec(),
                           self._batch_spec()),
-                out_specs=(P(), P(), P(), P(), P()),
+                out_specs=(P(), P(), P(), P(), P(), P()),
             )
 
         fn_train = make_sharded(True)
@@ -114,7 +116,7 @@ class AxisShardedStrategy:
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
-                loss, ce, correct, count, new_state = fn_train(
+                loss, ce, correct, _c5, count, new_state = fn_train(
                     params, ts.model_state, x, y)
                 return loss, (ce, correct, count, new_state)
 
@@ -129,10 +131,12 @@ class AxisShardedStrategy:
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
-            _, ce, correct, count, _ = fn_eval(ts.params, ts.model_state, x, y)
+            _, ce, correct, correct5, count, _ = fn_eval(
+                ts.params, ts.model_state, x, y)
             return {
                 "loss": ce,
                 "correct": correct,
+                "correct5": correct5,
                 "count": count.astype(jnp.int32),
             }
 

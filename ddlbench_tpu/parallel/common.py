@@ -51,6 +51,27 @@ def correct_and_count(logits: jax.Array, labels: jax.Array):
             jnp.sum((labels >= 0).astype(jnp.int32)))
 
 
+def correct_topk(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
+    """Count of valid positions whose label is in the top-k logits (prec@k,
+    PipeDream eval parity — main_with_runtime.py:639-653).
+
+    Tie handling matches torch.topk's selection order (value descending,
+    index ascending): the label ranks after every strictly-greater logit and
+    after equal logits at smaller class indices — so degenerate/constant
+    logits report ~k/V, not 1.0.
+    """
+    k = min(k, logits.shape[-1])
+    safe = jnp.maximum(labels, 0)
+    gold = jnp.take_along_axis(logits, safe[..., None], axis=-1)
+    higher = jnp.sum((logits > gold).astype(jnp.int32), axis=-1)
+    idx = jax.lax.broadcasted_iota(jnp.int32, logits.shape, logits.ndim - 1)
+    tie_before = jnp.sum(
+        ((logits == gold) & (idx < safe[..., None])).astype(jnp.int32), axis=-1
+    )
+    ok = (higher + tie_before < k) & (labels >= 0)
+    return jnp.sum(ok.astype(jnp.int32))
+
+
 class SGDState(NamedTuple):
     momentum: Any  # pytree matching params
 

@@ -35,6 +35,7 @@ from ddlbench_tpu.parallel.common import (
     cast_input,
     cast_params,
     correct_and_count,
+    correct_topk,
     cross_entropy_loss,
     loss_with_moe_aux,
     sgd_init,
@@ -91,6 +92,7 @@ class DPStrategy:
             return {
                 "loss": cross_entropy_loss(logits, y),
                 "correct": correct,
+                "correct5": correct_topk(logits, y),
                 "count": count,
             }
 

@@ -46,7 +46,8 @@ except ImportError:  # pragma: no cover
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
 from ddlbench_tpu.parallel.common import (
-    cast_input, cast_params, correct_and_count, cross_entropy_loss)
+    cast_input, cast_params, correct_and_count, correct_topk,
+    cross_entropy_loss)
 from ddlbench_tpu.parallel.packing import (
     balanced_stage_bounds,
     layer_flop_costs,
@@ -176,11 +177,13 @@ class GPipeStrategy:
                 ce = cross_entropy_loss(y, labels)
                 loss = cross_entropy_loss(y, labels, smooth) if smooth else ce
                 correct = correct_and_count(y, labels)[0]
+                correct5 = correct_topk(y, labels)
                 y_out = jnp.zeros((A,), cdtype)
             else:
                 loss = jnp.zeros((), jnp.float32)
                 ce = jnp.zeros((), jnp.float32)
                 correct = jnp.zeros((), jnp.int32)
+                correct5 = jnp.zeros((), jnp.int32)
                 y_out = pad_vec(y.astype(cdtype), A)
             new_state_row = pad_vec(
                 ravel_pytree(new_states)[0].astype(jnp.float32),
@@ -189,7 +192,7 @@ class GPipeStrategy:
             # Constant-valued outputs (zeros) carry no varying-axes annotation;
             # normalize every output's VMA type so lax.switch branches agree.
             return (_vary(y_out), _vary(new_state_row), _vary(loss),
-                    _vary(ce), _vary(correct))
+                    _vary(ce), _vary(correct), _vary(correct5))
 
         if train and self.cfg.remat_stages:
             branch = jax.checkpoint(branch)
@@ -226,8 +229,8 @@ class GPipeStrategy:
             T = M + S - 1
 
             def body(carry, t):
-                x_buf, st_row, loss_acc, ce_acc, corr_acc = carry
-                y_buf, new_st, loss_mb, ce_mb, corr_mb = lax.switch(
+                x_buf, st_row, loss_acc, ce_acc, corr_acc, corr5_acc = carry
+                y_buf, new_st, loss_mb, ce_mb, corr_mb, corr5_mb = lax.switch(
                     s_idx, branches, param_row, st_row, x_buf, xs, ys, t
                 )
                 m_idx = t - s_idx
@@ -236,11 +239,13 @@ class GPipeStrategy:
                 loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
                 ce_acc = ce_acc + jnp.where(valid, ce_mb, 0.0)
                 corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
+                corr5_acc = corr5_acc + jnp.where(valid, corr5_mb, 0)
                 if perm:
                     x_next = lax.ppermute(y_buf, "stage", perm)
                 else:
                     x_next = y_buf
-                return (x_next, st_row, loss_acc, ce_acc, corr_acc), None
+                return (x_next, st_row, loss_acc, ce_acc, corr_acc,
+                        corr5_acc), None
 
             init_carry = (
                 _vary(jnp.zeros((A,), self.compute_dtype)),
@@ -248,24 +253,26 @@ class GPipeStrategy:
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
+                _vary(jnp.zeros((), jnp.int32)),
             )
-            (x_buf, st_row, loss_acc, ce_acc, corr_acc), _ = lax.scan(
+            (x_buf, st_row, loss_acc, ce_acc, corr_acc, corr5_acc), _ = lax.scan(
                 body, init_carry, jnp.arange(T)
             )
             # Loss lives on the last stage only; make it global.
             loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
             ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+            correct5 = lax.psum(lax.psum(corr5_acc, "stage"), "data")
             # Sync BN running stats across data replicas (sync-BN choice,
             # documented deviation — SURVEY.md §7).
             st_row = lax.pmean(st_row, "data")
-            return loss, ce, st_row[None], correct
+            return loss, ce, st_row[None], correct, correct5
 
         return _shard_map(
             inner,
             mesh=mesh,
             in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
-            out_specs=(P(), P(), P("stage", None), P()),
+            out_specs=(P(), P(), P("stage", None), P(), P()),
         )
 
     @property
@@ -282,7 +289,7 @@ class GPipeStrategy:
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
             def loss_fn(params_mat):
-                loss, ce, new_state, correct = pipe_train(
+                loss, ce, new_state, correct, _c5 = pipe_train(
                     params_mat, ts.model_state, xs, ys)
                 return loss, (ce, new_state, correct)
 
@@ -312,10 +319,12 @@ class GPipeStrategy:
         pipe_eval = self._make_pipe_fn(train=False)
 
         def eval_step(ts, xs, ys):
-            loss, _, _, correct = pipe_eval(ts.params, ts.model_state, xs, ys)
+            loss, _, _, correct, correct5 = pipe_eval(
+                ts.params, ts.model_state, xs, ys)
             return {
                 "loss": loss,
                 "correct": correct,
+                "correct5": correct5,
                 "count": jnp.sum((ys >= 0).astype(jnp.int32)),
             }
 

@@ -35,7 +35,7 @@ EPOCH_RE = re.compile(
 )
 VALID_RE = re.compile(
     r"valid \| (?P<epoch>\d+)/(?P<total>\d+) epoch \| loss (?P<loss>[-\d.naife]+) \| "
-    r"accuracy (?P<acc>[\d.]+)"
+    r"accuracy (?P<acc>[\d.]+)(?: \| top5 (?P<top5>[\d.]+))?"
 )
 SUMMARY_RE = re.compile(
     r"valid accuracy: (?P<acc>[\d.]+) \| (?P<sps>[\d.]+) samples/sec, "
@@ -78,6 +78,8 @@ def scrape(text: str) -> Dict[str, Any]:
             epochs.setdefault(e, {"epoch": e})
             epochs[e]["valid_loss"] = float(m["loss"])
             epochs[e]["valid_accuracy"] = float(m["acc"])
+            if m["top5"]:
+                epochs[e]["valid_top5"] = float(m["top5"])
         elif m := SUMMARY_RE.search(line):
             summary = {
                 "final_valid_accuracy": float(m["acc"]),

@@ -184,7 +184,8 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
 
         # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
         val = evaluate(cfg, strategy, ts, data, epoch, wd)
-        logger.valid_epoch(epoch, val["loss"], val["accuracy"])
+        logger.valid_epoch(epoch, val["loss"], val["accuracy"],
+                           top5=val.get("top5"))
         summary_acc = val["accuracy"]
 
         if cfg.checkpoint_dir:
@@ -203,7 +204,7 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
 
 def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
              wd: Optional[HangWatchdog] = None) -> Dict[str, float]:
-    total_loss, total_correct, total_count = 0.0, 0, 0
+    total_loss, total_correct, total_correct5, total_count = 0.0, 0, 0, 0
     for step in range(data.steps_per_epoch(train=False)):
         x, y = strategy.shard_batch(*data.batch(epoch, step, train=False))
         m = strategy.eval_step(ts, x, y)
@@ -211,10 +212,13 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
         check_finite(loss, epoch, step + 1, cfg.nan_policy)
         total_loss += loss * int(m["count"])
         total_correct += int(m["correct"])
+        total_correct5 += int(m.get("correct5", 0))
         total_count += int(m["count"])
         if wd:
             wd.kick()
     return {
         "loss": total_loss / max(1, total_count),
         "accuracy": total_correct / max(1, total_count),
+        # prec@5 (PipeDream eval parity, main_with_runtime.py:639-653)
+        "top5": total_correct5 / max(1, total_count),
     }

@@ -111,11 +111,18 @@ class MetricLogger:
             },
         )
 
-    def valid_epoch(self, epoch: int, loss: float, accuracy: float) -> None:
-        self._emit(
-            f"valid | {epoch}/{self.total_epochs} epoch | loss {loss:.4f} | accuracy {accuracy:.4f}",
-            {"kind": "valid", "epoch": epoch, "loss": loss, "accuracy": accuracy},
-        )
+    def valid_epoch(self, epoch: int, loss: float, accuracy: float,
+                    top5: Optional[float] = None) -> None:
+        line = (f"valid | {epoch}/{self.total_epochs} epoch | "
+                f"loss {loss:.4f} | accuracy {accuracy:.4f}")
+        record = {"kind": "valid", "epoch": epoch, "loss": loss,
+                  "accuracy": accuracy}
+        if top5 is not None:
+            # prec@5 (PipeDream parity); appended so top-1-only scrapers
+            # keep matching the line prefix
+            line += f" | top5 {top5:.4f}"
+            record["top5"] = top5
+        self._emit(line, record)
 
     def summary(self, valid_accuracy: float) -> Dict[str, float]:
         """Final line matching mnist_pytorch.py:225-226's schema."""

@@ -1,0 +1,76 @@
+"""prec@5 eval metric (PipeDream parity, main_with_runtime.py:639-653)."""
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.parallel.common import correct_topk
+
+
+def test_correct_topk_math():
+    logits = jnp.array([
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # label 5 -> rank 6, not top-5
+        [9.0, 5.0, 4.0, 3.0, 2.0, 1.0, 0.0],   # label 4 -> rank 5, top-5
+        [0.0, 1.0, 2.0, 3.0, 4.0, 5.0, 9.0],   # label 6 -> rank 1
+    ])
+    labels = jnp.array([5, 4, 6])
+    assert int(correct_topk(logits, labels, k=5)) == 2
+    assert int(correct_topk(logits, labels, k=1)) == 1
+    # masked labels excluded
+    assert int(correct_topk(logits, labels.at[2].set(-1), k=5)) == 1
+    # k larger than the class count: every valid position counts
+    assert int(correct_topk(logits, labels, k=10)) == 3
+    # LM-shaped [B, T, V]: row 1 contributes 2 (labels 4, 6); row 2 masks
+    # position 0 (label 5 — already outside top-5) so it also contributes 2,
+    # and masking a top-5 label (position 2) drops the count
+    lm = jnp.stack([logits, logits])
+    ll = jnp.stack([labels, labels.at[0].set(-1)])
+    assert int(correct_topk(lm, ll, k=5)) == 4
+    assert int(correct_topk(lm, ll.at[1, 2].set(-1), k=5)) == 3
+
+
+def test_correct_topk_tie_semantics():
+    # constant logits: torch.topk picks the k smallest indices, so only
+    # labels < k count — a collapsed model must NOT report top5 = 1.0
+    logits = jnp.zeros((7, 7))
+    labels = jnp.arange(7)
+    assert int(correct_topk(logits, labels, k=5)) == 5
+    # partial tie: gold ties with classes 0 and 2; gold at index 2 ranks
+    # after the strictly-greater class 1 and the equal class 0 -> rank 3
+    row = jnp.array([[3.0, 5.0, 3.0, 1.0]])
+    lab = jnp.array([2])
+    assert int(correct_topk(row, lab, k=3)) == 1
+    assert int(correct_topk(row, lab, k=2)) == 0
+
+
+def test_evaluate_reports_top5():
+    from ddlbench_tpu.data.synthetic import make_synthetic
+    from ddlbench_tpu.parallel.single import SingleStrategy
+    from ddlbench_tpu.models.zoo import get_model
+    from ddlbench_tpu.train.loop import evaluate
+
+    cfg = RunConfig(benchmark="mnist", strategy="single", arch="resnet18",
+                    batch_size=8, steps_per_epoch=1, compute_dtype="float32")
+    st = SingleStrategy(get_model("resnet18", "mnist"), cfg)
+    ts = st.init(jax.random.key(0))
+    data = make_synthetic(cfg.dataset(), 8, steps_per_epoch=1)
+    val = evaluate(cfg, st, ts, data, 1)
+    assert 0.0 <= val["accuracy"] <= val["top5"] <= 1.0
+
+
+def test_valid_log_line_and_scrape(capsys):
+    from ddlbench_tpu.train.metrics import MetricLogger
+    from ddlbench_tpu.tools.process_output import scrape
+
+    lg = MetricLogger(total_epochs=1)
+    lg.valid_epoch(1, 2.0, 0.3, top5=0.7)
+    line = capsys.readouterr().out
+    assert "| top5 0.7000" in line
+    out = scrape(line)
+    assert out["per_epoch"][0]["valid_top5"] == 0.7
+    assert out["per_epoch"][0]["valid_accuracy"] == 0.3
+    # top-1-only line still parses (back-compat)
+    lg.valid_epoch(1, 2.0, 0.3)
+    out2 = scrape(capsys.readouterr().out)
+    assert "valid_top5" not in out2["per_epoch"][0]
