@@ -6,7 +6,9 @@ a uniform interface consumed by one train loop (ddlbench_tpu/train/loop.py):
 
 * ``init(key) -> train_state`` (device-placed/sharded)
 * ``train_step(train_state, x, y, lr) -> (train_state, metrics)`` (jitted)
-* ``eval_step(train_state, x, y) -> {loss, correct, count}`` (jitted)
+* ``eval_step(train_state, x, y) -> {loss, correct, count[, correct5]}``
+  (jitted; ``correct5`` is the optional prec@5 numerator — the loop reports
+  top5 only when a strategy provides it)
 * ``shard_batch(x, y)`` — place a global batch onto the strategy's mesh
 * ``world_size``
 """

@@ -96,7 +96,10 @@ class AxisShardedStrategy:
             aux_loss = lax.psum(sum(aux, jnp.float32(0.0)), axis) / n
             loss = obj + aux_w * aux_loss
             correct = lax.psum(correct, axis)
-            correct5 = lax.psum(correct_topk(logits, yl), axis)
+            # prec@5 is an eval-only metric; train_step discards it, so skip
+            # the top-k compute (and its psum) on the hot path
+            correct5 = (jnp.zeros((), jnp.int32) if train
+                        else lax.psum(correct_topk(logits, yl), axis))
             return loss, ce, correct, correct5, count, new_state
 
         def make_sharded(train: bool):

@@ -177,7 +177,10 @@ class GPipeStrategy:
                 ce = cross_entropy_loss(y, labels)
                 loss = cross_entropy_loss(y, labels, smooth) if smooth else ce
                 correct = correct_and_count(y, labels)[0]
-                correct5 = correct_topk(y, labels)
+                # prec@5 is eval-only; keep the (remat'd) train branch free of
+                # the top-k compute — train_step discards it anyway
+                correct5 = (jnp.zeros((), jnp.int32) if train
+                            else correct_topk(y, labels))
                 y_out = jnp.zeros((A,), cdtype)
             else:
                 loss = jnp.zeros((), jnp.float32)

@@ -205,6 +205,7 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
 def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
              wd: Optional[HangWatchdog] = None) -> Dict[str, float]:
     total_loss, total_correct, total_correct5, total_count = 0.0, 0, 0, 0
+    saw_correct5 = True
     for step in range(data.steps_per_epoch(train=False)):
         x, y = strategy.shard_batch(*data.batch(epoch, step, train=False))
         m = strategy.eval_step(ts, x, y)
@@ -212,7 +213,10 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
         check_finite(loss, epoch, step + 1, cfg.nan_policy)
         total_loss += loss * int(m["count"])
         total_correct += int(m["correct"])
-        total_correct5 += int(m.get("correct5", 0))
+        if "correct5" in m:
+            total_correct5 += int(m["correct5"])
+        else:  # strategy without prec@5 support: report None, never 0.0
+            saw_correct5 = False
         total_count += int(m["count"])
         if wd:
             wd.kick()
@@ -220,5 +224,5 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
         "loss": total_loss / max(1, total_count),
         "accuracy": total_correct / max(1, total_count),
         # prec@5 (PipeDream eval parity, main_with_runtime.py:639-653)
-        "top5": total_correct5 / max(1, total_count),
+        "top5": (total_correct5 / max(1, total_count)) if saw_correct5 else None,
     }

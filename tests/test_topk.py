@@ -74,3 +74,33 @@ def test_valid_log_line_and_scrape(capsys):
     lg.valid_epoch(1, 2.0, 0.3)
     out2 = scrape(capsys.readouterr().out)
     assert "valid_top5" not in out2["per_epoch"][0]
+
+
+def test_evaluate_without_correct5_reports_none():
+    """A contract-minimal strategy (no correct5) must yield top5=None, not 0.0."""
+    from ddlbench_tpu.train.loop import evaluate
+    from ddlbench_tpu.data.synthetic import make_synthetic
+
+    class MinimalStrategy:
+        def shard_batch(self, x, y):
+            return x, y
+
+        def eval_step(self, ts, x, y):
+            return {"loss": jnp.float32(1.0),
+                    "correct": jnp.int32(3),
+                    "count": jnp.int32(8)}
+
+    cfg = RunConfig(benchmark="mnist", strategy="single", arch="resnet18",
+                    batch_size=8, steps_per_epoch=1)
+    data = make_synthetic(cfg.dataset(), 8, steps_per_epoch=1)
+    val = evaluate(cfg, MinimalStrategy(), None, data, 1)
+    assert val["top5"] is None
+    assert val["accuracy"] == 3 / 8
+
+    # and the logger omits the top5 field for None
+    from ddlbench_tpu.train.metrics import MetricLogger
+    import io, contextlib
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        MetricLogger(1).valid_epoch(1, 1.0, 0.5, top5=None)
+    assert "top5" not in buf.getvalue()
