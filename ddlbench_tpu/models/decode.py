@@ -1,0 +1,187 @@
+"""KV-cached incremental decoding over the flat layer chain.
+
+The full-forward decoders in models/seq2seq.py re-run the entire model per
+emitted token (O(T) forwards of length T). This module is the TPU-native
+fast path: one **prefill** pass processes the prompt and populates per-block
+K/V caches, then each generated token runs a single-position **decode** pass
+against the caches — O(T) attention reads instead of a full forward. The
+protocol is three optional fields on ``Layer`` (models/layers.py): attention
+blocks provide ``init_cache``/``prefill``/``decode``; position-embedding
+layers provide ``decode``; position-independent layers (``pointwise=True``,
+e.g. the LM head) are decoded through their ordinary ``apply``.
+
+Reference context: GNMT's beam-search inference (SURVEY.md §2 C13) keeps
+LSTM hidden state between steps — the KV cache is the transformer analog of
+that recurrent state. Both decoders below produce bit-identical token
+streams to their full-forward counterparts (tests/test_decode.py).
+
+MoE blocks don't implement the protocol (token routing per position is
+future work); ``supports_cache`` reports whether a model can take this path.
+"""
+
+from __future__ import annotations
+
+from typing import Any, List, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ddlbench_tpu.models.layers import LayerModel
+
+
+def supports_cache(model: LayerModel) -> bool:
+    """True if every layer can participate in cached decoding."""
+    return all(
+        l.decode is not None or l.pointwise for l in model.layers
+    )
+
+
+def _require_cache_support(model: LayerModel) -> None:
+    if not supports_cache(model):
+        missing = [l.name for l in model.layers
+                   if l.decode is None and not l.pointwise]
+        raise NotImplementedError(
+            f"{model.name} has layers without cached-decode support: "
+            f"{missing}; use the full-forward decoders instead"
+        )
+
+
+def init_caches(model: LayerModel, params, batch: int, max_len: int,
+                dtype) -> List[Any]:
+    return [
+        l.init_cache(p, batch, max_len, dtype) if l.init_cache else None
+        for l, p in zip(model.layers, params)
+    ]
+
+
+def prefill(model: LayerModel, params, state, caches, tokens):
+    """Run the prompt [B, S] through the chain, populating caches from 0.
+
+    Returns (logits [B, S, V], caches).
+    """
+    h = tokens
+    out = []
+    for layer, p, s, c in zip(model.layers, params, state, caches):
+        if layer.prefill is not None:
+            h, c = layer.prefill(p, s, c, h, 0)
+        else:
+            h, _ = layer.apply(p, s, h, False)
+        out.append(c)
+    return h, out
+
+
+def decode_one(model: LayerModel, params, state, caches, tok, pos):
+    """Run ONE token [B, 1] at dynamic position pos. Returns (logits, caches)."""
+    h = tok
+    out = []
+    for layer, p, s, c in zip(model.layers, params, state, caches):
+        if layer.decode is not None:
+            h, c = layer.decode(p, s, c, h, pos)
+        else:
+            h, _ = layer.apply(p, s, h, False)
+        out.append(c)
+    return h, out
+
+
+def _start_len(model: LayerModel, src) -> int:
+    if model.src_len is not None and src.shape[1] != model.src_len:
+        raise ValueError(
+            f"src must be [B, {model.src_len}] for {model.name}, "
+            f"got {tuple(src.shape)}"
+        )
+    return src.shape[1]
+
+
+def greedy_decode(model: LayerModel, params, state, src, total_len: int,
+                  dtype=jnp.float32):
+    """KV-cached greedy continuation of `src` [B, S] to length `total_len`.
+
+    Token-identical to models/seq2seq.greedy_decode's full-forward loop.
+    """
+    _require_cache_support(model)
+    S = _start_len(model, src)
+    T = model.in_shape[0]
+    if not S < total_len <= T:
+        raise ValueError(f"total_len must be in ({S}, {T}], got {total_len}")
+    B = src.shape[0]
+
+    caches = init_caches(model, params, B, total_len, dtype)
+    logits, caches = prefill(model, params, state, caches, src)
+    first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
+    x0 = (jnp.zeros((B, total_len), jnp.int32)
+          .at[:, :S].set(src).at[:, S].set(first))
+
+    def body(t, carry):
+        x, caches = carry
+        tok = lax.dynamic_slice_in_dim(x, t, 1, axis=1)
+        logits, caches = decode_one(model, params, state, caches, tok, t)
+        nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
+        return lax.dynamic_update_slice_in_dim(
+            x, nxt[:, None], t + 1, axis=1), caches
+
+    x, _ = lax.fori_loop(S, total_len - 1, body, (x0, caches))
+    return x
+
+
+def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
+                       beam: int = 4, length_penalty: float = 0.6,
+                       dtype=jnp.float32):
+    """KV-cached beam search; same semantics/scores as
+    models/seq2seq.beam_search_decode (length-normalized, GNMT-style).
+
+    Caches are kept per hypothesis ([B*beam, ...]) and re-gathered to follow
+    the parent beam at every expansion — the transformer analog of reordering
+    GNMT's recurrent decoder state.
+    """
+    _require_cache_support(model)
+    S = _start_len(model, src)
+    T = model.in_shape[0]
+    if not S < total_len <= T:
+        raise ValueError(f"total_len must be in ({S}, {T}], got {total_len}")
+    B = src.shape[0]
+    V = model.num_classes
+
+    src_rep = jnp.repeat(src, beam, axis=0)
+    caches = init_caches(model, params, B * beam, total_len, dtype)
+    logits, caches = prefill(model, params, state, caches, src_rep)
+    logits_prev = logits[:, -1]  # [B*beam, V]
+
+    x0 = jnp.zeros((B * beam, total_len), jnp.int32).at[:, :S].set(src_rep)
+    score0 = jnp.where(
+        jnp.arange(B * beam) % beam == 0, 0.0, -jnp.inf
+    ).astype(jnp.float32)
+
+    def gather_caches(caches, idx):
+        return jax.tree.map(lambda a: a[idx], caches)
+
+    def expand(x, score, logits_prev, t):
+        """One beam expansion at position t; returns (x, score, flat_src)."""
+        logp = jax.nn.log_softmax(logits_prev.astype(jnp.float32), -1)
+        cand = (score[:, None] + logp).reshape(B, beam * V)
+        top_score, top_idx = lax.top_k(cand, beam)
+        beam_src = top_idx // V
+        token = (top_idx % V).astype(jnp.int32)
+        flat_src = (jnp.arange(B)[:, None] * beam + beam_src).reshape(-1)
+        x = lax.dynamic_update_slice_in_dim(
+            x[flat_src], token.reshape(-1)[:, None], t, axis=1)
+        return x, top_score.reshape(-1), flat_src
+
+    def body(t, carry):
+        x, score, caches, logits_prev = carry
+        x, score, flat_src = expand(x, score, logits_prev, t)
+        caches = gather_caches(caches, flat_src)
+        tok = lax.dynamic_slice_in_dim(x, t, 1, axis=1)
+        logits, caches = decode_one(model, params, state, caches, tok, t)
+        return x, score, caches, logits[:, 0]
+
+    # The last position needs only the expansion — no decode_one afterwards
+    # (its logits would be discarded), so the loop stops one early.
+    x, score, _, logits_prev = lax.fori_loop(
+        S, total_len - 1, body, (x0, score0, caches, logits_prev))
+    x, score, _ = expand(x, score, logits_prev, total_len - 1)
+    norm = ((5.0 + (total_len - S)) / 6.0) ** length_penalty
+    score = (score / norm).reshape(B, beam)
+    best = jnp.argmax(score, axis=-1)
+    x = x.reshape(B, beam, total_len)[jnp.arange(B), best]
+    return x, score[jnp.arange(B), best]

@@ -71,11 +71,35 @@ class axis_context:
 
 @dataclasses.dataclass(frozen=True)
 class Layer:
-    """One pipeline-atomic unit of a model."""
+    """One pipeline-atomic unit of a model.
+
+    The three optional fields support KV-cached incremental decoding
+    (models/decode.py) and default to None for layers that don't need them:
+
+    * ``init_cache(params, batch, max_len, dtype) -> cache`` — allocate the
+      layer's decode cache (e.g. K/V buffers for attention blocks).
+    * ``prefill(params, state, cache, x, start) -> (y, cache)`` — process the
+      whole decode prompt at once, populating the cache from position
+      ``start``. Current implementations require ``start == 0`` (the prompt
+      opens the stream); chunked prefill against an existing cache is future
+      work. Layers without one are prefilled via ``apply``.
+    * ``decode(params, state, cache, x, pos) -> (y, cache)`` — process ONE
+      token (x is [B, 1, ...]) at dynamic position ``pos`` against the cache.
+      Layers without one decode via ``apply`` (correct only for
+      position-independent layers; position-dependent layers like embeddings
+      must provide it).
+    """
 
     name: str
     init: Callable[[jax.Array, Shape], Tuple[Params, State, Shape]]
     apply: Callable[[Params, State, jax.Array, bool], Tuple[jax.Array, State]]
+    init_cache: Any = None
+    prefill: Any = None
+    decode: Any = None
+    # True if ``apply`` on a single position equals its full-sequence result
+    # (no position dependence, no cross-position mixing) — such layers can be
+    # decoded via apply without a cache (e.g. the LM head).
+    pointwise: bool = False
 
 
 @dataclasses.dataclass(frozen=True)

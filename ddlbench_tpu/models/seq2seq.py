@@ -79,7 +79,14 @@ def seq2seq_embed(name: str, vocab: int, d_model: int, max_len: int,
              + jnp.take(p["seg"], seg_ids, axis=0))
         return y, s
 
-    return Layer(name, init, apply)
+    def decode(p, s, cache, x, pos):
+        # x: [B, 1] at dynamic absolute position pos
+        pe = lax.dynamic_slice_in_dim(p["pos"], pos, 1, axis=0)
+        seg_id = (jnp.asarray(pos, jnp.int32) >= src_len).astype(jnp.int32)
+        seg = jnp.take(p["seg"], seg_id[None], axis=0)
+        return jnp.take(p["tok"], x, axis=0) + pe + seg, cache
+
+    return Layer(name, init, apply, decode=decode)
 
 
 def build_seq2seq(arch: str, in_shape, vocab: int, src_len: int) -> LayerModel:
@@ -104,7 +111,9 @@ def build_seq2seq(arch: str, in_shape, vocab: int, src_len: int) -> LayerModel:
 # Inference (GNMT beam-search parity, reference
 # runtime/translation seq2seq inference modules). Both decoders re-run the
 # full forward per emitted token — O(T^2) per sequence but fully static-shaped
-# and jittable; incremental KV caching is a planned optimization.
+# and jittable. By default both delegate to the KV-cached incremental
+# implementation (models/decode.py, O(T) per token); the full-forward loops
+# below are the reference semantics the cached path is tested against.
 # ---------------------------------------------------------------------------
 
 
@@ -131,12 +140,21 @@ def _forward_logits(model: LayerModel, params, state, tokens):
     return logits
 
 
-def greedy_decode(model: LayerModel, params, state, src, total_len: int):
+def greedy_decode(model: LayerModel, params, state, src, total_len: int,
+                  use_cache: bool = True):
     """Greedy continuation of `src` [B, src_len] to length `total_len`.
 
-    Returns [B, total_len] where positions >= src_len are argmax continuations.
+    Returns [B, total_len] where positions >= src_len are argmax
+    continuations. ``use_cache=True`` (default) takes the KV-cached
+    incremental path (models/decode.py, O(T) per token); ``use_cache=False``
+    is the full-forward reference implementation the cached path is tested
+    against.
     """
     _check_src(model, src, total_len)
+    if use_cache:
+        from ddlbench_tpu.models.decode import greedy_decode as cached
+
+        return cached(model, params, state, src, total_len)
     B, S = src.shape
     x0 = jnp.zeros((B, total_len), jnp.int32).at[:, :S].set(src)
 
@@ -149,16 +167,25 @@ def greedy_decode(model: LayerModel, params, state, src, total_len: int):
 
 
 def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
-                       beam: int = 4, length_penalty: float = 0.6):
+                       beam: int = 4, length_penalty: float = 0.6,
+                       use_cache: bool = True):
     """Beam-search continuation of `src` [B, src_len] to length `total_len`.
 
     Standard length-normalized beam search (GNMT inference semantics:
     score = logprob_sum / ((5+len)/6)^alpha) over a static position loop.
-    Every beam re-runs the forward; hypotheses all have the same (full)
-    length so no finished-hypothesis bookkeeping is needed.
-    Returns (tokens [B, total_len], score [B]) for the best beam.
+    ``use_cache=True`` (default) keeps per-hypothesis KV caches and regathers
+    them along the parent beam (models/decode.py); ``use_cache=False``
+    re-runs the full forward per step (the reference implementation).
+    Hypotheses all have the same (full) length so no finished-hypothesis
+    bookkeeping is needed. Returns (tokens [B, total_len], score [B]) for
+    the best beam.
     """
     _check_src(model, src, total_len)
+    if use_cache:
+        from ddlbench_tpu.models.decode import beam_search_decode as cached
+
+        return cached(model, params, state, src, total_len, beam,
+                      length_penalty)
     B, S = src.shape
     V = model.num_classes
     # [B*beam, total_len] hypothesis buffer; beams identical at start.

@@ -84,7 +84,12 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
         y = jnp.take(p["tok"], x, axis=0) + pos
         return y, s
 
-    return Layer(name, init, apply)
+    def decode(p, s, cache, x, pos):
+        # x: [B, 1] int32 at dynamic absolute position `pos`
+        pe = lax.dynamic_slice_in_dim(p["pos"], pos, 1, axis=0)
+        return jnp.take(p["tok"], x, axis=0) + pe, cache
+
+    return Layer(name, init, apply, decode=decode)
 
 
 # Attention backend: "auto" uses the Pallas flash kernel on TPU and the jnp
@@ -243,12 +248,67 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
 
     def apply(p, s, x, train):
         x = attention_sublayer(p, x, n_heads, prefix_len)
+        return mlp(p, x), s
+
+    def mlp(p, x):
         h = layer_norm(p["ln2"], x)
         h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
-        x = x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))
-        return x, s
+        return x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))
 
-    return Layer(name, init, apply)
+    def _qkv_heads(p, x):
+        B, T, d = x.shape
+        h = layer_norm(p["ln1"], x)
+        qkv = h @ p["wqkv"].astype(x.dtype)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        return [t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+                for t in (q, k, v)]
+
+    # ---- KV-cached incremental decoding (models/decode.py protocol) ----
+
+    def init_cache(p, batch, max_len, dtype):
+        shape = (batch, n_heads, max_len, dh)
+        return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+    def prefill(p, s, cache, x, start):
+        # Process the whole prompt like apply, recording K/V. Attention runs
+        # only within the segment, so the prompt must start the stream.
+        assert start == 0, "chunked prefill (start > 0) is not implemented"
+        B, T, d = x.shape
+        q, k, v = _qkv_heads(p, x)
+        cache = {
+            "k": lax.dynamic_update_slice_in_dim(
+                cache["k"], k.astype(cache["k"].dtype), start, axis=2),
+            "v": lax.dynamic_update_slice_in_dim(
+                cache["v"], v.astype(cache["v"].dtype), start, axis=2),
+        }
+        o = causal_attention(q, k, v, start, start, prefix_len=prefix_len)
+        x = x + o.transpose(0, 2, 1, 3).reshape(B, T, d) @ p["wo"].astype(x.dtype)
+        return mlp(p, x), cache
+
+    def decode(p, s, cache, x, pos):
+        # One token at dynamic position pos against the populated cache.
+        # Every cached position <= pos, so the prefix rule needs no extra
+        # term: the mask is just k_pos <= pos.
+        B, _, d = x.shape
+        q, k, v = _qkv_heads(p, x)  # [B, H, 1, dh]
+        cache = {
+            "k": lax.dynamic_update_slice_in_dim(
+                cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
+            "v": lax.dynamic_update_slice_in_dim(
+                cache["v"], v.astype(cache["v"].dtype), pos, axis=2),
+        }
+        kc, vc = cache["k"].astype(x.dtype), cache["v"].astype(x.dtype)
+        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) / math.sqrt(dh)
+        k_pos = jnp.arange(kc.shape[2])[None, None, None, :]
+        scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
+        o = jnp.einsum("bhqk,bhkd->bhqd",
+                       jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype),
+                       vc)
+        x = x + o.transpose(0, 2, 1, 3).reshape(B, 1, d) @ p["wo"].astype(x.dtype)
+        return mlp(p, x), cache
+
+    return Layer(name, init, apply, init_cache=init_cache, prefill=prefill,
+                 decode=decode)
 
 
 def lm_head(name: str, vocab: int) -> Layer:
@@ -261,7 +321,7 @@ def lm_head(name: str, vocab: int) -> Layer:
         h = layer_norm(p["ln_f"], x)
         return h @ p["head"].astype(x.dtype), s
 
-    return Layer(name, init, apply)
+    return Layer(name, init, apply, pointwise=True)
 
 
 def build_transformer(arch: str, in_shape, vocab: int) -> LayerModel:

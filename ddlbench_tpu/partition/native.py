@@ -28,13 +28,15 @@ def _load() -> Optional[ctypes.CDLL]:
     if _lib is not None or _lib_failed:
         return _lib
     try:
-        if not os.path.exists(_LIB_PATH):
-            subprocess.run(
-                ["make", "-C", _NATIVE_DIR, "-s"],
-                check=True,
-                capture_output=True,
-                timeout=120,
-            )
+        # Always run make (an incremental no-op when current): a stale .so
+        # from before an ABI change would otherwise be dlopen'd and called
+        # with the wrong argument layout.
+        subprocess.run(
+            ["make", "-C", _NATIVE_DIR, "-s"],
+            check=True,
+            capture_output=True,
+            timeout=120,
+        )
         lib = ctypes.CDLL(_LIB_PATH)
         lib.solve_level.argtypes = [
             ctypes.c_int, ctypes.c_int,
@@ -42,6 +44,7 @@ def _load() -> Optional[ctypes.CDLL]:
             np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
             ctypes.c_double, ctypes.c_double, ctypes.c_int, ctypes.c_int,
+            ctypes.c_int,  # sync_grads (0 = forward-only partitioning)
             ctypes.c_void_p,  # base_time or NULL
             np.ctypeslib.ndpointer(np.float64, flags="C_CONTIGUOUS"),
             np.ctypeslib.ndpointer(np.int32, flags="C_CONTIGUOUS"),
@@ -67,6 +70,7 @@ def solve_level_native(
     hbm_bytes: float,
     versions_bound: int,
     memory_check: bool,
+    sync_grads: bool = True,
     base_time: Optional[np.ndarray] = None,
 ) -> Tuple[np.ndarray, np.ndarray, np.ndarray]:
     """Run one DP level natively. Returns (A, choice_k, choice_m) with shapes
@@ -88,7 +92,7 @@ def solve_level_native(
         np.ascontiguousarray(params, np.float64),
         np.ascontiguousarray(acts, np.float64),
         float(bandwidth), float(hbm_bytes), int(versions_bound),
-        int(bool(memory_check)), bt_ptr, A, ck, cm,
+        int(bool(memory_check)), int(bool(sync_grads)), bt_ptr, A, ck, cm,
     )
     return A, ck, cm
 

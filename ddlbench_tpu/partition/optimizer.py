@@ -113,6 +113,7 @@ def partition_hierarchical(
     num_hosts: int = 1,
     memory_check: bool = True,
     use_native: bool = True,
+    forward_only: bool = False,
 ) -> PartitionResult:
     """Partition a (chain) profile graph over num_chips, optionally across hosts.
 
@@ -121,16 +122,26 @@ def partition_hierarchical(
     core (native/partitioner.cpp via ctypes) when it is buildable, falling
     back to this module's pure-Python DP otherwise; both implement the same
     recurrence and cost model.
+
+    ``forward_only=True`` is the inference variant (reference
+    optimizer/inference_optimizer_graph.py, SURVEY.md §2 C6): only forward
+    compute times count, replication pays no gradient allreduce, and memory
+    holds one weight copy (no stashing versions).
     """
     hw = hw or HardwareModel()
     if use_native:
         from ddlbench_tpu.partition import native
 
         if native.available():
-            return _partition_native(graph, num_chips, hw, num_hosts, memory_check)
+            return _partition_native(graph, num_chips, hw, num_hosts,
+                                     memory_check, forward_only)
     order = graph.topological_sort()
     n = len(order)
-    times = [nd.forward_compute_time + nd.backward_compute_time for nd in order]
+    times = [
+        nd.forward_compute_time
+        + (0.0 if forward_only else nd.backward_compute_time)
+        for nd in order
+    ]
     params = [nd.parameter_size for nd in order]
     acts = [nd.activation_size for nd in order]
     pre_t = [0.0]
@@ -160,11 +171,16 @@ def partition_hierarchical(
         need = (1 + versions_bound) * span_params(i, j)
         return need <= hw.hbm_bytes
 
+    versions0 = 0 if forward_only else chips_per_host
+
     # ---- level 0: chips over ICI ----
     def stage_cost0(i, j, r):
-        if not mem_ok(i, j, r, versions_bound=chips_per_host):
+        if not mem_ok(i, j, r, versions_bound=versions0):
             return INF
-        return span_time(i, j) / r + _allreduce_ms(span_params(i, j), r, hw.ici_bandwidth)
+        t = span_time(i, j) / r
+        if forward_only:
+            return t
+        return t + _allreduce_ms(span_params(i, j), r, hw.ici_bandwidth)
 
     def edge_cost0(k):
         return _ms(acts[k - 1], hw.ici_bandwidth)
@@ -183,7 +199,10 @@ def partition_hierarchical(
         base = dp0.A[(i, j, chips_per_host)][0]
         if base == INF:
             return INF
-        return base / r + _allreduce_ms(span_params(i, j), r, hw.dcn_bandwidth)
+        t = base / r
+        if forward_only:
+            return t
+        return t + _allreduce_ms(span_params(i, j), r, hw.dcn_bandwidth)
 
     def edge_cost1(k):
         return _ms(acts[k - 1], hw.dcn_bandwidth)
@@ -201,14 +220,19 @@ def partition_hierarchical(
 
 
 def _partition_native(graph: Graph, num_chips: int, hw: HardwareModel,
-                      num_hosts: int, memory_check: bool) -> PartitionResult:
+                      num_hosts: int, memory_check: bool,
+                      forward_only: bool = False) -> PartitionResult:
     import numpy as np
 
     from ddlbench_tpu.partition import native
 
     order = graph.topological_sort()
     n = len(order)
-    times = np.array([nd.forward_compute_time + nd.backward_compute_time for nd in order])
+    times = np.array([
+        nd.forward_compute_time
+        + (0.0 if forward_only else nd.backward_compute_time)
+        for nd in order
+    ])
     params = np.array([nd.parameter_size for nd in order])
     acts = np.array([nd.activation_size for nd in order])
     if num_hosts > 1:
@@ -220,7 +244,8 @@ def _partition_native(graph: Graph, num_chips: int, hw: HardwareModel,
 
     A0, ck0, cm0 = native.solve_level_native(
         times, params, acts, chips_per_host, hw.ici_bandwidth, hw.hbm_bytes,
-        versions_bound=chips_per_host, memory_check=memory_check,
+        versions_bound=0 if forward_only else chips_per_host,
+        memory_check=memory_check, sync_grads=not forward_only,
     )
     if num_hosts == 1:
         spans = native.backtrack(A0, ck0, cm0, 0, n, chips_per_host)
@@ -233,7 +258,8 @@ def _partition_native(graph: Graph, num_chips: int, hw: HardwareModel,
     base = A0[:, :, chips_per_host].copy()
     A1, ck1, cm1 = native.solve_level_native(
         times, params, acts, num_hosts, hw.dcn_bandwidth, hw.hbm_bytes,
-        versions_bound=num_hosts, memory_check=False, base_time=base,
+        versions_bound=num_hosts, memory_check=False,
+        sync_grads=not forward_only, base_time=base,
     )
     stages: List[StagePlan] = []
     for (i, j, r_hosts) in native.backtrack(A1, ck1, cm1, 0, n, num_hosts):

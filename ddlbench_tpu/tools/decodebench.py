@@ -1,0 +1,105 @@
+"""Inference (decode) throughput microbenchmark.
+
+GNMT-analog inference measurement (the reference benchmarks only training;
+its translation runtime ships beam-search inference without a throughput
+harness — SURVEY.md §2 C13). Measures tokens/sec for greedy and beam decode
+on a seq2seq model, KV-cached (models/decode.py) vs the full-forward
+reference path, printing one JSON line per configuration:
+
+    {"tool": "decodebench", "mode": "greedy", "cached": true,
+     "tokens_per_sec": N, "ms_per_token": M, ...}
+
+Usage:
+    python -m ddlbench_tpu.tools.decodebench [-m seq2seq_s] [-b synthmt]
+        [--batch 8] [--beam 4] [--repeats 3] [--skip-uncached] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _bench(fn, sync, repeats: int):
+    fn()  # compile
+    sync()
+    best = float("inf")
+    for _ in range(repeats):
+        t0 = time.perf_counter()
+        fn()
+        sync()
+        best = min(best, time.perf_counter() - t0)
+    return best
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("-m", "--model", default="seq2seq_s")
+    p.add_argument("-b", "--benchmark", default="synthmt")
+    p.add_argument("--batch", type=int, default=8)
+    p.add_argument("--beam", type=int, default=4)
+    p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--skip-uncached", action="store_true",
+                   help="skip the slow full-forward reference path")
+    p.add_argument("--platform", default=None)
+    args = p.parse_args(argv)
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    import jax.numpy as jnp
+
+    from ddlbench_tpu.config import DATASETS
+    from ddlbench_tpu.models import init_model
+    from ddlbench_tpu.models.zoo import get_model
+    import ddlbench_tpu.models.seq2seq as s2s
+
+    spec = DATASETS[args.benchmark]
+    model = get_model(args.model, spec)
+    params, state, _ = init_model(model, jax.random.key(0))
+    S, T = spec.src_len, spec.seq_len
+    src = jax.random.randint(jax.random.key(1), (args.batch, S), 0,
+                             spec.num_classes, jnp.int32)
+    new_tokens = (T - S) * args.batch
+
+    runs = [("greedy", True), ("beam", True)]
+    if not args.skip_uncached:
+        runs += [("greedy", False), ("beam", False)]
+
+    for mode, cached in runs:
+        if mode == "greedy":
+            fn = jax.jit(lambda: s2s.greedy_decode(
+                model, params, state, src, T, use_cache=cached))
+        else:
+            fn = jax.jit(lambda: s2s.beam_search_decode(
+                model, params, state, src, T, beam=args.beam,
+                use_cache=cached)[0])
+        out = [None]
+
+        def run():
+            out[0] = fn()
+
+        def sync():
+            jax.tree.map(lambda a: float(jnp.sum(a)), out[0])
+
+        dt = _bench(run, sync, args.repeats)
+        print(json.dumps({
+            "tool": "decodebench",
+            "model": args.model,
+            "benchmark": args.benchmark,
+            "mode": mode,
+            "cached": cached,
+            "batch": args.batch,
+            "beam": args.beam if mode == "beam" else 1,
+            "new_tokens": new_tokens,
+            "tokens_per_sec": round(new_tokens / dt, 2),
+            "ms_per_token": round(1000.0 * dt / max(1, T - S), 3),
+        }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

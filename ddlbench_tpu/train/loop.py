@@ -223,6 +223,8 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
     return {
         "loss": total_loss / max(1, total_count),
         "accuracy": total_correct / max(1, total_count),
-        # prec@5 (PipeDream eval parity, main_with_runtime.py:639-653)
-        "top5": (total_correct5 / max(1, total_count)) if saw_correct5 else None,
+        # prec@5 (PipeDream eval parity, main_with_runtime.py:639-653);
+        # None when unsupported by the strategy or when no eval step ran
+        "top5": (total_correct5 / total_count
+                 if saw_correct5 and total_count else None),
     }

@@ -52,13 +52,16 @@ extern "C" {
 //   lower level's best time for span (i, j]; kInf marks infeasible.
 // memory_check/versions_bound/hbm_bytes: weight-stashing HBM constraint
 //   (1 + versions_bound) * span_params <= hbm_bytes.
+// sync_grads: 1 for training (replicated stages pay a gradient ring
+//   allreduce); 0 for forward-only/inference partitioning (no gradients, so
+//   replication costs nothing but the batch split).
 // Outputs: A (times), choice_k / choice_m (backtrack tables; k = -1 for a
 //   single replicated stage).
 void solve_level(int n, int max_units, const double* node_times,
                  const double* node_params, const double* node_acts,
                  double bandwidth, double hbm_bytes, int versions_bound,
-                 int memory_check, const double* base_time, double* A_out,
-                 int32_t* choice_k, int32_t* choice_m) {
+                 int memory_check, int sync_grads, const double* base_time,
+                 double* A_out, int32_t* choice_k, int32_t* choice_m) {
   std::vector<double> pre_t(n + 1, 0.0), pre_p(n + 1, 0.0);
   for (int i = 0; i < n; ++i) {
     pre_t[i + 1] = pre_t[i] + node_times[i];
@@ -81,6 +84,7 @@ void solve_level(int n, int max_units, const double* node_times,
       if (base == kInf) return kInf;
       base /= r;
     }
+    if (!sync_grads) return base;
     return base + allreduce_ms(span_params(i, j), r, bandwidth);
   };
   auto edge_cost = [&](int k) { return ms(node_acts[k - 1], bandwidth); };

@@ -53,3 +53,43 @@ def test_native_memory_constraint():
     g = Graph.chain(nodes)
     res = partition_hierarchical(g, 2, hw, use_native=True)
     assert len(res.stages) == 2
+
+
+def test_forward_only_partitioning_native_and_python():
+    """Inference variant (C6 parity): fwd times only, no allreduce, no
+    stashing memory; native and Python paths must agree."""
+    from ddlbench_tpu.config import HardwareModel
+    from ddlbench_tpu.graph.graph import Graph, Node
+    from ddlbench_tpu.partition.optimizer import partition_hierarchical
+
+    # bwd times wildly unbalanced: training would split differently than
+    # inference, proving bwd is excluded in forward_only
+    nodes = [
+        Node(str(i), f"l{i}", forward_compute_time=1.0,
+             backward_compute_time=(100.0 if i == 0 else 0.0),
+             activation_size=1e3, parameter_size=1e6)
+        for i in range(6)
+    ]
+    g = Graph.chain(nodes)
+    hw = HardwareModel()
+    for use_native in (True, False):
+        res = partition_hierarchical(g, 2, hw, use_native=use_native,
+                                     forward_only=True)
+        # fwd-only costs are uniform: balanced two-way split (3 + 3 layers)
+        # or one fully-replicated stage; either way bottleneck = 3.0 ms
+        assert abs(res.pipeline_time_ms - 3.0) < 1e-6, (use_native, res)
+
+    # training partition of the same graph is dominated by node 0's bwd
+    res_t = partition_hierarchical(g, 2, hw, forward_only=False)
+    assert res_t.pipeline_time_ms > 50.0
+
+    # stashing-infeasible but inference-feasible memory: params near HBM
+    big = [
+        Node(str(i), f"b{i}", forward_compute_time=1.0,
+             backward_compute_time=1.0, activation_size=1e3,
+             parameter_size=hw.hbm_bytes * 0.4)
+        for i in range(4)
+    ]
+    gb = Graph.chain(big)
+    ok = partition_hierarchical(gb, 4, hw, forward_only=True)
+    assert ok.pipeline_time_ms != float("inf")
