@@ -38,16 +38,45 @@ from ddlbench_tpu.graph.graph import Graph, Node
 from ddlbench_tpu.models.layers import LayerModel, init_model, param_bytes
 
 
+def _sync(out) -> None:
+    """Real execution barrier: block_until_ready PLUS a tiny device->host
+    transfer. On the experimental axon TPU tunnel block_until_ready can
+    return before execution finishes (the same caveat bench.py documents);
+    fetching one element of the newest output forces completion of the whole
+    queued stream."""
+    leaf = jax.tree.leaves(out)[0]
+    jax.block_until_ready(leaf)
+    jax.device_get(leaf.ravel()[0:1])
+
+
 def _time_callable(fn, *args, repeats: int = 5, warmup: int = 2) -> float:
-    """Median wall-time of fn(*args) in ms, synchronized."""
-    for _ in range(warmup):
-        jax.block_until_ready(fn(*args))
+    """Median wall-time of fn(*args) in ms, synchronized.
+
+    Every execution is individually synced (no assumptions about the
+    tunnel's queue ordering), and the empty-queue sync latency — estimated
+    as the MIN of several baseline syncs so one RTT jitter spike can't zero
+    out fast layers — is subtracted from each sample."""
+    out = None
+    for _ in range(max(1, warmup)):
+        out = fn(*args)
+    _sync(out)
+    sync_ms = min(
+        _timed_ms(lambda: _sync(out)) for _ in range(5)  # empty queue
+    )
     samples = []
     for _ in range(repeats):
         t0 = time.perf_counter()
-        jax.block_until_ready(fn(*args))
-        samples.append((time.perf_counter() - t0) * 1000.0)
+        out = fn(*args)
+        _sync(out)
+        total = (time.perf_counter() - t0) * 1000.0
+        samples.append(max(total - sync_ms, 0.0))
     return statistics.median(samples)
+
+
+def _timed_ms(fn) -> float:
+    t0 = time.perf_counter()
+    fn()
+    return (time.perf_counter() - t0) * 1000.0
 
 
 def _flops_of(fn, *args) -> float:
