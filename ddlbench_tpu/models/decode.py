@@ -12,11 +12,18 @@ e.g. the LM head) are decoded through their ordinary ``apply``.
 
 Reference context: GNMT's beam-search inference (SURVEY.md §2 C13) keeps
 LSTM hidden state between steps — the KV cache is the transformer analog of
-that recurrent state. Both decoders below produce bit-identical token
-streams to their full-forward counterparts (tests/test_decode.py).
+that recurrent state. For dense models both decoders below produce
+token-identical streams to their full-forward counterparts
+(tests/test_decode.py).
 
-MoE blocks don't implement the protocol (token routing per position is
-future work); ``supports_cache`` reports whether a model can take this path.
+MoE blocks implement the protocol too (models/moe.py): decode runs each
+token's top-1 expert without a capacity limit (standard MoE inference),
+while prefill keeps the training-style capacity over the prompt tokens.
+This equals the full-forward path whenever routing capacity drops nothing
+(always true with a generous capacity_factor); with tight capacity the two
+paths can legitimately differ — the full-forward loop also pads the stream,
+which itself perturbs MoE routing. ``supports_cache`` reports whether a
+model can take the cached path.
 """
 
 from __future__ import annotations
@@ -97,7 +104,8 @@ def greedy_decode(model: LayerModel, params, state, src, total_len: int,
                   dtype=jnp.float32):
     """KV-cached greedy continuation of `src` [B, S] to length `total_len`.
 
-    Token-identical to models/seq2seq.greedy_decode's full-forward loop.
+    Token-identical to models/seq2seq.greedy_decode's full-forward loop for
+    dense models (MoE caveat: see module docstring).
     """
     _require_cache_support(model)
     S = _start_len(model, src)

@@ -39,6 +39,9 @@ from ddlbench_tpu.models.transformer import (
     _dense_init,
     _ln_init,
     attention_sublayer,
+    attn_cache_init,
+    attn_decode_op,
+    attn_prefill_op,
     embed,
     layer_norm,
     lm_head,
@@ -196,7 +199,54 @@ def moe_block(name: str, d_model: int, n_heads: int, n_experts: int,
         )
         return x, s
 
-    return Layer(name, init, apply)
+    # ---- KV-cached incremental decoding (models/decode.py protocol) ----
+
+    def _moe_ffn(p, x):
+        h = layer_norm(p["ln2"], x)
+        return x + moe_mlp(
+            {"gate": p["gate"], "experts": p["experts"]}, h, capacity_factor
+        )
+
+    def prefill(p, s, cache, x, start):
+        if _expert_axis() is not None:
+            raise NotImplementedError(
+                "cached decoding under expert_parallel is not supported; "
+                "decode outside the ep shard_map")
+        x, cache = attn_prefill_op(p, x, cache, n_heads, 0, start)
+        return _moe_ffn(p, x), cache
+
+    def decode(p, s, cache, x, pos):
+        """One token: attention against the cache, then per-token top-1
+        expert FFN. Decode routing has no capacity limit (each token simply
+        runs its chosen expert — standard MoE inference); this matches the
+        training semantics exactly whenever apply's capacity didn't drop the
+        token."""
+        if _expert_axis() is not None:
+            raise NotImplementedError(
+                "cached decoding under expert_parallel is not supported; "
+                "decode outside the ep shard_map")
+        x, cache = attn_decode_op(p, x, cache, n_heads, pos)
+        h = layer_norm(p["ln2"], x)  # [B, 1, d]
+        hf = h[:, 0]
+        probs = jax.nn.softmax(
+            (hf.astype(jnp.float32) @ p["gate"]), axis=-1)  # [B, E]
+        idx = jnp.argmax(probs, axis=-1)
+        onehot = jax.nn.one_hot(idx, probs.shape[-1], dtype=jnp.float32)
+        gate = jnp.sum(probs * onehot, axis=-1)  # chosen-expert probability
+        pe = p["experts"]
+        # all-expert compute for the single position (E small, B small at
+        # decode time), then gate-weighted top-1 combine
+        eh = jnp.einsum("bd,edf->bef", hf, pe["w1"].astype(hf.dtype))
+        eh = jax.nn.gelu(eh + pe["b1"][None].astype(hf.dtype))
+        ey = jnp.einsum("bef,efd->bed", eh, pe["w2"].astype(hf.dtype))
+        ey = ey + pe["b2"][None].astype(hf.dtype)
+        w = (onehot * gate[:, None]).astype(hf.dtype)
+        y = jnp.einsum("be,bed->bd", w, ey)
+        return x + y[:, None, :], cache
+
+    dh = d_model // n_heads
+    return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
+                 prefill=prefill, decode=decode)
 
 
 def build_transformer_moe(arch: str, in_shape, vocab: int,

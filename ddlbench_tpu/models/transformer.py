@@ -255,60 +255,84 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
         h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
         return x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))
 
-    def _qkv_heads(p, x):
-        B, T, d = x.shape
-        h = layer_norm(p["ln1"], x)
-        qkv = h @ p["wqkv"].astype(x.dtype)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        return [t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
-                for t in (q, k, v)]
+    def prefill(p, s, cache, x, start):
+        x, cache = attn_prefill_op(p, x, cache, n_heads, prefix_len, start)
+        return mlp(p, x), cache
 
-    # ---- KV-cached incremental decoding (models/decode.py protocol) ----
+    def decode(p, s, cache, x, pos):
+        x, cache = attn_decode_op(p, x, cache, n_heads, pos)
+        return mlp(p, x), cache
 
+    return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
+                 prefill=prefill, decode=decode)
+
+
+# ---------------------------------------------------------------------------
+# Shared attention-sublayer cache ops (models/decode.py protocol), used by the
+# dense transformer block above and the MoE block (models/moe.py).
+# ---------------------------------------------------------------------------
+
+
+def attn_cache_init(n_heads: int, dh: int):
     def init_cache(p, batch, max_len, dtype):
         shape = (batch, n_heads, max_len, dh)
         return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
-    def prefill(p, s, cache, x, start):
-        # Process the whole prompt like apply, recording K/V. Attention runs
-        # only within the segment, so the prompt must start the stream.
-        assert start == 0, "chunked prefill (start > 0) is not implemented"
-        B, T, d = x.shape
-        q, k, v = _qkv_heads(p, x)
-        cache = {
-            "k": lax.dynamic_update_slice_in_dim(
-                cache["k"], k.astype(cache["k"].dtype), start, axis=2),
-            "v": lax.dynamic_update_slice_in_dim(
-                cache["v"], v.astype(cache["v"].dtype), start, axis=2),
-        }
-        o = causal_attention(q, k, v, start, start, prefix_len=prefix_len)
-        x = x + o.transpose(0, 2, 1, 3).reshape(B, T, d) @ p["wo"].astype(x.dtype)
-        return mlp(p, x), cache
+    return init_cache
 
-    def decode(p, s, cache, x, pos):
-        # One token at dynamic position pos against the populated cache.
-        # Every cached position <= pos, so the prefix rule needs no extra
-        # term: the mask is just k_pos <= pos.
-        B, _, d = x.shape
-        q, k, v = _qkv_heads(p, x)  # [B, H, 1, dh]
-        cache = {
-            "k": lax.dynamic_update_slice_in_dim(
-                cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
-            "v": lax.dynamic_update_slice_in_dim(
-                cache["v"], v.astype(cache["v"].dtype), pos, axis=2),
-        }
-        kc, vc = cache["k"].astype(x.dtype), cache["v"].astype(x.dtype)
-        scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) / math.sqrt(dh)
-        k_pos = jnp.arange(kc.shape[2])[None, None, None, :]
-        scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
-        o = jnp.einsum("bhqk,bhkd->bhqd",
-                       jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype),
-                       vc)
-        x = x + o.transpose(0, 2, 1, 3).reshape(B, 1, d) @ p["wo"].astype(x.dtype)
-        return mlp(p, x), cache
 
-    return Layer(name, init, apply, init_cache=init_cache, prefill=prefill,
-                 decode=decode)
+def _qkv_heads(p, x, n_heads: int):
+    B, T, d = x.shape
+    dh = d // n_heads
+    h = layer_norm(p["ln1"], x)
+    qkv = h @ p["wqkv"].astype(x.dtype)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    return [t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+            for t in (q, k, v)]
+
+
+def attn_prefill_op(p, x, cache, n_heads: int, prefix_len: int, start: int):
+    """Attention sublayer (incl. residual) over a whole prompt, recording K/V.
+
+    Attention runs only within the segment, so the prompt must start the
+    stream (chunked prefill against existing cache entries is future work).
+    """
+    assert start == 0, "chunked prefill (start > 0) is not implemented"
+    B, T, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)
+    cache = {
+        "k": lax.dynamic_update_slice_in_dim(
+            cache["k"], k.astype(cache["k"].dtype), start, axis=2),
+        "v": lax.dynamic_update_slice_in_dim(
+            cache["v"], v.astype(cache["v"].dtype), start, axis=2),
+    }
+    o = causal_attention(q, k, v, start, start, prefix_len=prefix_len)
+    x = x + o.transpose(0, 2, 1, 3).reshape(B, T, d) @ p["wo"].astype(x.dtype)
+    return x, cache
+
+
+def attn_decode_op(p, x, cache, n_heads: int, pos):
+    """Attention sublayer for ONE token at dynamic position pos against the
+    populated cache. Every cached position <= pos, so the prefix rule needs
+    no extra term: the mask is just k_pos <= pos."""
+    B, _, d = x.shape
+    dh = d // n_heads
+    q, k, v = _qkv_heads(p, x, n_heads)  # [B, H, 1, dh]
+    cache = {
+        "k": lax.dynamic_update_slice_in_dim(
+            cache["k"], k.astype(cache["k"].dtype), pos, axis=2),
+        "v": lax.dynamic_update_slice_in_dim(
+            cache["v"], v.astype(cache["v"].dtype), pos, axis=2),
+    }
+    kc, vc = cache["k"].astype(x.dtype), cache["v"].astype(x.dtype)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, kc) / math.sqrt(dh)
+    k_pos = jnp.arange(kc.shape[2])[None, None, None, :]
+    scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
+    o = jnp.einsum("bhqk,bhkd->bhqd",
+                   jax.nn.softmax(scores.astype(jnp.float32), -1).astype(x.dtype),
+                   vc)
+    x = x + o.transpose(0, 2, 1, 3).reshape(B, 1, d) @ p["wo"].astype(x.dtype)
+    return x, cache
 
 
 def lm_head(name: str, vocab: int) -> Layer:

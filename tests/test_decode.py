@@ -153,3 +153,28 @@ def test_decodebench_tool(capsys):
     assert modes == {("greedy", True), ("beam", True),
                      ("greedy", False), ("beam", False)}
     assert all(l["tokens_per_sec"] > 0 for l in lines)
+
+
+def test_moe_cached_decode_matches_full_forward():
+    """MoE cached decode: per-token top-1 expert FFN equals the training
+    apply whenever capacity doesn't drop tokens (ample capacity_factor)."""
+    import sys, os
+    sys.path.insert(0, os.path.dirname(__file__))
+    from tiny_models import tiny_moe, TINY_LM
+
+    model = tiny_moe()  # capacity_factor = n_experts: nothing ever drops
+    assert dec.supports_cache(model)
+    params, state, _ = init_model(model, jax.random.key(0))
+    prompt = jax.random.randint(jax.random.key(7), (2, 6), 0,
+                                TINY_LM.num_classes, jnp.int32)
+
+    out = dec.greedy_decode(model, params, state, prompt, 12)
+    assert out.shape == (2, 12)
+    # reference: full-forward greedy over the UNPADDED prefix each step
+    # (padding would perturb MoE routing/capacity, unlike dense models)
+    x = prompt
+    for t in range(6, 12):
+        logits, _ = apply_model(model, params, state, x, False)
+        nxt = jnp.argmax(logits[:, -1], -1).astype(jnp.int32)
+        x = jnp.concatenate([x, nxt[:, None]], axis=1)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(x))
