@@ -17,11 +17,12 @@ this is a new first-class capability, designed TPU-first rather than ported:
 One model definition serves dense (single/dp/sp/tp/fsdp) and expert-parallel
 (ep) execution: parallel/ep.py enters :class:`expert_parallel` inside its
 shard_map, exactly the pattern models/transformer.py uses for sequence
-parallelism. single/dp/tp/fsdp/sp/ep all add the collected aux loss to their
-objective (weight cfg.moe_aux_weight); the pipeline strategies
-(gpipe/pipedream) train MoE models WITHOUT the balance regularizer — a
-documented deviation, since their per-stage scans don't thread the
-collector.
+parallelism. EVERY strategy adds the collected aux loss to its training
+objective (weight cfg.moe_aux_weight): single/dp/tp/fsdp through
+loss_with_moe_aux, sp/ep with a psum over their shard axis, gpipe by
+accumulating per-stage aux through its scan, and pipedream by adding each
+stage's aux term to the per-microbatch objective in its recompute-based
+backward.
 """
 
 from __future__ import annotations
@@ -84,6 +85,17 @@ def _record_aux(v):
         _AUX_SINK[-1].append(v)
 
 
+def _top1_gate(gate_logits: jax.Array):
+    """Shared top-1 routing core: (probs f32, one-hot choice, chosen-expert
+    probability). Used by training routing (switch_route) AND the cached
+    decode path so the two can never diverge."""
+    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
+    idx = jnp.argmax(probs, axis=-1)
+    onehot = jax.nn.one_hot(idx, probs.shape[-1], dtype=jnp.float32)
+    gate = jnp.sum(probs * onehot, axis=-1)
+    return probs, onehot, gate
+
+
 def switch_route(gate_logits: jax.Array, capacity: int):
     """Top-1 switch routing over [S, E] router logits.
 
@@ -93,9 +105,7 @@ def switch_route(gate_logits: jax.Array, capacity: int):
     Switch semantics, static shapes throughout.
     """
     S, E = gate_logits.shape
-    probs = jax.nn.softmax(gate_logits.astype(jnp.float32), axis=-1)
-    idx = jnp.argmax(probs, axis=-1)
-    onehot = jax.nn.one_hot(idx, E, dtype=jnp.float32)  # [S, E]
+    probs, onehot, gate = _top1_gate(gate_logits)
     # load-balance aux (Switch eq. 4): E * sum_e fraction_e * mean_prob_e
     aux = E * jnp.sum(jnp.mean(onehot, axis=0) * jnp.mean(probs, axis=0))
     _record_aux(aux)
@@ -106,7 +116,6 @@ def switch_route(gate_logits: jax.Array, capacity: int):
     dispatch = jax.nn.one_hot(
         (pos1 - 1.0).astype(jnp.int32), capacity, dtype=jnp.float32
     ) * within[..., None]
-    gate = jnp.sum(probs * onehot, axis=-1)  # chosen-expert probability
     combine = dispatch * gate[:, None, None]
     return dispatch, combine, aux
 
@@ -228,11 +237,7 @@ def moe_block(name: str, d_model: int, n_heads: int, n_experts: int,
         x, cache = attn_decode_op(p, x, cache, n_heads, pos)
         h = layer_norm(p["ln2"], x)  # [B, 1, d]
         hf = h[:, 0]
-        probs = jax.nn.softmax(
-            (hf.astype(jnp.float32) @ p["gate"]), axis=-1)  # [B, E]
-        idx = jnp.argmax(probs, axis=-1)
-        onehot = jax.nn.one_hot(idx, probs.shape[-1], dtype=jnp.float32)
-        gate = jnp.sum(probs * onehot, axis=-1)  # chosen-expert probability
+        _, onehot, gate = _top1_gate(hf.astype(jnp.float32) @ p["gate"])
         pe = p["experts"]
         # all-expert compute for the single position (E small, B small at
         # decode time), then gate-weighted top-1 combine

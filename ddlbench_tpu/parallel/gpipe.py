@@ -159,6 +159,7 @@ class GPipeStrategy:
         last = s == S - 1
 
         smooth = self.cfg.resolved_label_smoothing() if train else 0.0
+        from ddlbench_tpu.models.moe import collect_aux_losses
 
         def branch(param_row, state_row, x_buf, xs, ys, t):
             m = jnp.clip(t - s, 0, M - 1)
@@ -168,8 +169,14 @@ class GPipeStrategy:
                 x = x_buf[: mb * math.prod(in_shape)].reshape(mb, *in_shape)
             params = cast_params(p_unravel(param_row[:p_len]), cdtype)
             states = s_unravel(state_row[:s_len])
-            y, new_states = apply_slice(layers, params, states,
-                                        cast_input(x, cdtype), train)
+            # MoE router load-balance terms of THIS stage's layers are traced
+            # into the branch, accumulated in the scan, and added to the
+            # objective in _make_pipe_fn (empty for dense models).
+            aux: list = []
+            with collect_aux_losses(aux):
+                y, new_states = apply_slice(layers, params, states,
+                                            cast_input(x, cdtype), train)
+            aux_mb = sum(aux, jnp.float32(0.0))
             if last:
                 labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
                 # loss (the grad path) may be label-smoothed; ce is the
@@ -195,7 +202,7 @@ class GPipeStrategy:
             # Constant-valued outputs (zeros) carry no varying-axes annotation;
             # normalize every output's VMA type so lax.switch branches agree.
             return (_vary(y_out), _vary(new_state_row), _vary(loss),
-                    _vary(ce), _vary(correct), _vary(correct5))
+                    _vary(ce), _vary(aux_mb), _vary(correct), _vary(correct5))
 
         if train and self.cfg.remat_stages:
             branch = jax.checkpoint(branch)
@@ -215,6 +222,7 @@ class GPipeStrategy:
         """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval)."""
         S, M, A = self.num_stages, self.num_microbatches, self._act_size
         mesh = self.mesh
+        aux_w = self.cfg.moe_aux_weight if train else 0.0
         branches = [self._make_branch(s, train) for s in range(S)]
         perm = [(i, i + 1) for i in range(S - 1)]
 
@@ -232,8 +240,10 @@ class GPipeStrategy:
             T = M + S - 1
 
             def body(carry, t):
-                x_buf, st_row, loss_acc, ce_acc, corr_acc, corr5_acc = carry
-                y_buf, new_st, loss_mb, ce_mb, corr_mb, corr5_mb = lax.switch(
+                (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+                 corr5_acc) = carry
+                (y_buf, new_st, loss_mb, ce_mb, aux_mb, corr_mb,
+                 corr5_mb) = lax.switch(
                     s_idx, branches, param_row, st_row, x_buf, xs, ys, t
                 )
                 m_idx = t - s_idx
@@ -241,13 +251,14 @@ class GPipeStrategy:
                 st_row = jnp.where(valid, new_st, st_row)
                 loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
                 ce_acc = ce_acc + jnp.where(valid, ce_mb, 0.0)
+                aux_acc = aux_acc + jnp.where(valid, aux_mb, 0.0)
                 corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
                 corr5_acc = corr5_acc + jnp.where(valid, corr5_mb, 0)
                 if perm:
                     x_next = lax.ppermute(y_buf, "stage", perm)
                 else:
                     x_next = y_buf
-                return (x_next, st_row, loss_acc, ce_acc, corr_acc,
+                return (x_next, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
                         corr5_acc), None
 
             init_carry = (
@@ -255,15 +266,20 @@ class GPipeStrategy:
                 state_row,
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
                 _vary(jnp.zeros((), jnp.int32)),
             )
-            (x_buf, st_row, loss_acc, ce_acc, corr_acc, corr5_acc), _ = lax.scan(
-                body, init_carry, jnp.arange(T)
-            )
-            # Loss lives on the last stage only; make it global.
-            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
+            (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+             corr5_acc), _ = lax.scan(body, init_carry, jnp.arange(T))
+            # Loss lives on the last stage only; the MoE router aux terms live
+            # on whichever stages hold MoE layers — psum both and fold the
+            # weighted aux into the training objective (dp-strategy parity;
+            # the reported ce stays the bare metric).
             ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
+            aux = lax.pmean(lax.psum(aux_acc, "stage") / M, "data")
+            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
+            loss = loss + aux_w * aux
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
             correct5 = lax.psum(lax.psum(corr5_acc, "stage"), "data")
             # Sync BN running stats across data replicas (sync-BN choice,

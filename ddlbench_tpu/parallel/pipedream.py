@@ -101,11 +101,17 @@ class PipeDreamStrategy(GPipeStrategy):
         return PDTrainState(ts.params, ts.model_state, ts.momentum)
 
     def _make_stage_fwd(self, s: int):
-        """Pure stage forward: (param_row, state_row, x) -> (y, new_state_row).
+        """Pure stage forward:
+        (param_row, state_row, x) -> (y, new_state_row, aux).
 
         Unlike the gpipe branch this is vjp-friendly: no input unpacking from a
-        shared buffer, no loss; shapes are the stage's true shapes.
+        shared buffer, no loss; shapes are the stage's true shapes. ``aux`` is
+        the sum of this stage's MoE router load-balance terms (zero for dense
+        stages); the backward adds cfg.moe_aux_weight * aux to the
+        per-microbatch objective.
         """
+        from ddlbench_tpu.models.moe import collect_aux_losses
+
         layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
         p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
         s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
@@ -114,12 +120,14 @@ class PipeDreamStrategy(GPipeStrategy):
         def stage_fwd(param_row, state_row, x):
             params = cast_params(p_unravel(param_row[:p_len]), cdtype)
             states = s_unravel(state_row[:s_len])
-            y, new_states = apply_slice(layers, params, states,
-                                        cast_input(x, cdtype), True)
+            aux: list = []
+            with collect_aux_losses(aux):
+                y, new_states = apply_slice(layers, params, states,
+                                            cast_input(x, cdtype), True)
             new_state_row = pad_vec(
                 ravel_pytree(new_states)[0].astype(jnp.float32), state_row.shape[0]
             )
-            return y, new_state_row
+            return y, new_state_row, sum(aux, jnp.float32(0.0))
 
         return stage_fwd
 
@@ -129,6 +137,7 @@ class PipeDreamStrategy(GPipeStrategy):
         NSLOT = min(S, M)
         mom, wd = self._mom, self._wd
         smooth = self.cfg.resolved_label_smoothing()
+        aux_w = self.cfg.moe_aux_weight
         mesh = self.mesh
         total = self._total_samples
         cdtype = self.compute_dtype
@@ -168,7 +177,7 @@ class PipeDreamStrategy(GPipeStrategy):
                     else:
                         x = unpack_x(lax.dynamic_index_in_dim(
                             fwd_q, f % 2, keepdims=False))
-                    y, new_st = stage_fwd(params, st_row, x)
+                    y, new_st, _aux = stage_fwd(params, st_row, x)
                     if last:
                         labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
                         # metric only (the backward recomputes its own
@@ -220,9 +229,11 @@ class PipeDreamStrategy(GPipeStrategy):
                         labels = lax.dynamic_index_in_dim(ys, b, keepdims=False)
 
                         def loss_of(pv, xv):
-                            y, _ = stage_fwd(pv, st_row, xv)
-                            # training objective (label-smoothed for seq2seq)
-                            return cross_entropy_loss(y, labels, smooth)
+                            y, _, aux = stage_fwd(pv, st_row, xv)
+                            # training objective: (label-smoothed) CE plus
+                            # this stage's weighted MoE router aux terms
+                            return (cross_entropy_loss(y, labels, smooth)
+                                    + aux_w * aux)
 
                         if s == 0:
                             gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
@@ -231,17 +242,22 @@ class PipeDreamStrategy(GPipeStrategy):
                             gp, gx = jax.grad(loss_of, argnums=(0, 1))(p_st, x_st)
                     else:
                         def fwd_of(pv, xv):
-                            y, _ = stage_fwd(pv, st_row, xv)
-                            return y
+                            y, _, aux = stage_fwd(pv, st_row, xv)
+                            return y, aux
 
+                        # cotangents: upstream activation grad for y, and the
+                        # objective weight for this stage's MoE aux term
                         g_in = unpack_g(g_buf)
                         if s == 0:
-                            y, vjp_fn = jax.vjp(lambda pv: fwd_of(pv, x_st), p_st)
-                            (gp,) = vjp_fn(g_in.astype(y.dtype))
+                            (y, aux), vjp_fn = jax.vjp(
+                                lambda pv: fwd_of(pv, x_st), p_st)
+                            (gp,) = vjp_fn((g_in.astype(y.dtype),
+                                            jnp.float32(aux_w)))
                             gx = None
                         else:
-                            y, vjp_fn = jax.vjp(fwd_of, p_st, x_st)
-                            gp, gx = vjp_fn(g_in.astype(y.dtype))
+                            (y, aux), vjp_fn = jax.vjp(fwd_of, p_st, x_st)
+                            gp, gx = vjp_fn((g_in.astype(y.dtype),
+                                             jnp.float32(aux_w)))
                     # DDP-per-stage parity: sync grads across stage replicas.
                     gp = lax.psum(gp, "data")
                     gx_out = (jnp.zeros((A,), cdtype) if gx is None

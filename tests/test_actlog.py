@@ -83,8 +83,6 @@ def test_freq_and_steps_gating(tmp_path, small_model):
 
 
 def test_moe_aux_loss_included(tmp_path):
-    import sys, os
-    sys.path.insert(0, os.path.dirname(__file__))
     from tiny_models import tiny_moe
     from ddlbench_tpu.parallel.common import loss_with_moe_aux
 

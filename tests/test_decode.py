@@ -158,8 +158,6 @@ def test_decodebench_tool(capsys):
 def test_moe_cached_decode_matches_full_forward():
     """MoE cached decode: per-token top-1 expert FFN equals the training
     apply whenever capacity doesn't drop tokens (ample capacity_factor)."""
-    import sys, os
-    sys.path.insert(0, os.path.dirname(__file__))
     from tiny_models import tiny_moe, TINY_LM
 
     model = tiny_moe()  # capacity_factor = n_experts: nothing ever drops

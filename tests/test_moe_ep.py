@@ -194,8 +194,8 @@ def test_sp_moe_matches_single(devices):
 
 def test_moe_under_gpipe(devices):
     """MoE blocks are pipeline-atomic like any other layer: the dense expert
-    path must run inside the gpipe stage scan (aux regularizer documented as
-    absent under pipeline strategies)."""
+    path must run inside the gpipe stage scan, INCLUDING the router
+    load-balance aux term in the objective."""
     from ddlbench_tpu.parallel.gpipe import GPipeStrategy
 
     model = tiny_moe()  # 4 layers: embed, dense block, moe block, head
@@ -210,3 +210,48 @@ def test_moe_under_gpipe(devices):
     y = jax.random.randint(jax.random.key(2), (M * mb, 32), 0, 64)
     ts2, metrics = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(metrics["loss"]))
+
+
+def _moe_pipeline_vs_single(pipeline_cls, strategy_name):
+    """S=1, M=1 pipeline step == single-strategy step: proves the MoE aux
+    loss is part of the pipeline training objective (single includes it via
+    loss_with_moe_aux; any omission would diverge the updates)."""
+    from jax.flatten_util import ravel_pytree
+    from ddlbench_tpu.parallel.single import SingleStrategy
+
+    model = tiny_moe()
+    B = 4
+    kw = dict(benchmark="synthtext", arch="transformer_moe_t",
+              compute_dtype="float32", momentum=0.0, weight_decay=0.0,
+              moe_aux_weight=0.7)
+    cfg_p = RunConfig(strategy=strategy_name, num_devices=1, num_stages=1,
+                      micro_batch_size=B, num_microbatches=1, **kw)
+    cfg_s = RunConfig(strategy="single", num_devices=1, batch_size=B, **kw)
+    x = jax.random.randint(jax.random.key(1), (B, 32), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (B, 32), 0, 64)
+    lr = jnp.float32(0.1)
+
+    pipe = pipeline_cls(model, cfg_p)
+    tp = pipe.init(jax.random.key(0))
+    tp2, _ = pipe.train_step(tp, *pipe.shard_batch(x, y), lr)
+
+    single = SingleStrategy(model, cfg_s)
+    tss = single.init(jax.random.key(0))
+    tss2, _ = single.train_step(tss, x, y, lr)
+
+    got = np.asarray(tp2.params[0])
+    want = ravel_pytree(tss2.params)[0]
+    np.testing.assert_allclose(got[: want.size], np.asarray(want),
+                               rtol=2e-5, atol=2e-6)
+
+
+def test_gpipe_moe_objective_includes_aux(devices):
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+
+    _moe_pipeline_vs_single(GPipeStrategy, "gpipe")
+
+
+def test_pipedream_moe_objective_includes_aux(devices):
+    from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
+
+    _moe_pipeline_vs_single(PipeDreamStrategy, "pipedream")

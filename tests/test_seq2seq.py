@@ -182,8 +182,6 @@ def test_decode_rejects_wrong_src_width(model_and_params):
     with pytest.raises(ValueError, match="src_len"):
         s2s.beam_search_decode(model, params, state, bad, TINY_MT.image_size[0])
     # non-seq2seq model rejected too
-    import sys, os
-    sys.path.insert(0, os.path.dirname(__file__))
     from tiny_models import tiny_transformer
     lm = tiny_transformer()
     from ddlbench_tpu.models.layers import init_model as im
