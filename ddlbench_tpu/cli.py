@@ -81,9 +81,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="log every N epochs (with --log-activations-dir)")
     p.add_argument("--log-activations-steps", type=int, default=1,
                    help="minibatches to log per logged epoch")
-    p.add_argument("--platform", default=None,
-                   help="force a jax platform (e.g. 'cpu' with "
-                        "XLA_FLAGS=--xla_force_host_platform_device_count=N for a virtual mesh)")
+    from ddlbench_tpu.distributed import add_platform_arg
+
+    add_platform_arg(p)
     return p
 
 
@@ -124,12 +124,9 @@ def config_from_args(args) -> RunConfig:
 
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
-    if args.platform:
-        import jax
+    from ddlbench_tpu.distributed import apply_platform, initialize
 
-        jax.config.update("jax_platforms", args.platform)
-
-    from ddlbench_tpu.distributed import initialize
+    apply_platform(args.platform)
 
     initialize()  # no-op unless DDLB_* multi-host env is set
     cfg = config_from_args(args)

@@ -30,6 +30,26 @@ from jax.sharding import Mesh
 _initialized = False
 
 
+def add_platform_arg(parser) -> None:
+    """Attach the shared --platform flag (one help string for every entry
+    point; see apply_platform)."""
+    parser.add_argument(
+        "--platform", default=None,
+        help="force a jax platform (e.g. 'cpu'; combine with "
+             "XLA_FLAGS=--xla_force_host_platform_device_count=N for a "
+             "virtual mesh)")
+
+
+def apply_platform(platform) -> None:
+    """Apply a --platform override before the first backend touch. Safe on
+    images whose sitecustomize imports jax early: jax.config works until a
+    backend is initialized, unlike the JAX_PLATFORMS env var."""
+    if platform:
+        import jax
+
+        jax.config.update("jax_platforms", platform)
+
+
 def force_host_mesh_platform() -> None:
     """Honor an XLA_FLAGS virtual host mesh on images whose sitecustomize
     imports jax at interpreter start.

@@ -138,16 +138,18 @@ def main(argv=None) -> int:
     p.add_argument("--sizes", default="1e4,1e5,1e6,1e7,1e8",
                    help="global float32 counts (reference sweep: 10..1e8)")
     p.add_argument("--iters", type=int, default=10)
-    p.add_argument("--platform", default=None)
+    from ddlbench_tpu.distributed import add_platform_arg
+
+    add_platform_arg(p)
     args = p.parse_args(argv)
 
     import jax
 
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
-    else:
-        from ddlbench_tpu.distributed import force_host_mesh_platform
+    from ddlbench_tpu.distributed import apply_platform, force_host_mesh_platform
 
+    if args.platform:
+        apply_platform(args.platform)
+    else:
         force_host_mesh_platform()
 
     n = args.devices or len(jax.devices())

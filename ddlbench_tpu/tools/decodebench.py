@@ -43,13 +43,13 @@ def main(argv=None) -> int:
     p.add_argument("--repeats", type=int, default=3)
     p.add_argument("--skip-uncached", action="store_true",
                    help="skip the slow full-forward reference path")
-    p.add_argument("--platform", default=None)
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
     args = p.parse_args(argv)
+    apply_platform(args.platform)
 
     import jax
-
-    if args.platform:
-        jax.config.update("jax_platforms", args.platform)
     import jax.numpy as jnp
 
     from ddlbench_tpu.config import DATASETS

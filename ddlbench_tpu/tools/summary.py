@@ -45,7 +45,11 @@ def main(argv=None) -> int:
     p = argparse.ArgumentParser()
     p.add_argument("-m", "--model", default=None, choices=MODEL_NAMES)
     p.add_argument("-b", "--benchmark", default=None, choices=sorted(DATASETS))
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
     args = p.parse_args(argv)
+    apply_platform(args.platform)
     models = [args.model] if args.model else MODEL_NAMES
     benchmarks = [args.benchmark] if args.benchmark else sorted(DATASETS)
     explicit = bool(args.model and args.benchmark)
