@@ -103,7 +103,7 @@ DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
     "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64,
                   "synthtext": 64, "longctx": 8, "synthmt": 128},
     "sp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-           "synthtext": 16, "longctx": 2},
+           "synthtext": 16, "longctx": 2, "synthmt": 32},
     # ep: per-device batch (batch and experts both shard the one mesh axis)
     "ep": {"synthtext": 8, "longctx": 1},
 }
@@ -311,8 +311,9 @@ class RunConfig:
             raise ValueError("hang_timeout_s must be positive")
         if self.label_smoothing is not None and not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError("label_smoothing must be in [0, 1)")
-        if self.strategy == "sp" and self.dataset().kind != "tokens":
-            raise ValueError("sp (sequence parallelism) requires a token benchmark")
+        if self.strategy == "sp" and self.dataset().kind not in ("tokens", "seq2seq"):
+            raise ValueError(
+                "sp (sequence parallelism) requires a token or seq2seq benchmark")
         if self.strategy == "ep":
             if self.dataset().kind != "tokens":
                 raise ValueError("ep (expert parallelism) requires a token benchmark")

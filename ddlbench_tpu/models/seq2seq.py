@@ -12,10 +12,11 @@ beam-search inference. None of that machinery survives a TPU-first redesign:
   positions attend causally to targets and fully to the source (the
   "decoder" + cross-attention), all in the same block. One activation stream
   means the model is a flat layer chain like every other model here, so it
-  runs unchanged under single/dp/tp/fsdp/gpipe/pipedream (sp/ep are
-  causal-LM-only: ring attention has no prefix mode) — where the reference
-  needed a separate model family and runtime driver
-  (runtime/translation/main_with_runtime.py) for GNMT.
+  runs unchanged under single/dp/tp/fsdp/gpipe/pipedream AND sequence
+  parallelism (ring attention applies the prefix rule on absolute key
+  positions, so the source may span shards; ep stays causal-LM-only since
+  MoE archs are LMs) — where the reference needed a separate model family
+  and runtime driver (runtime/translation/main_with_runtime.py) for GNMT.
 * The blocks ARE models/transformer.py's blocks: transformer_block takes a
   ``prefix_len`` that generalizes the causal mask, so seq2seq adds only the
   segment-aware embedding and the decode entry points below.
@@ -48,6 +49,7 @@ from ddlbench_tpu.models.layers import Layer, LayerModel
 from ddlbench_tpu.models.transformer import (
     _dense_init,
     lm_head,
+    shard_positions,
     transformer_block,
 )
 
@@ -72,10 +74,12 @@ def seq2seq_embed(name: str, vocab: int, d_model: int, max_len: int,
         return p, {}, (T, d_model)
 
     def apply(p, s, x, train):
-        T = x.shape[1]
-        seg_ids = (jnp.arange(T) >= src_len).astype(jnp.int32)
+        # x: [B, T] int32 (T = local shard length under sequence parallelism;
+        # position/segment embeddings use absolute positions either way)
+        pos_emb, abs_pos = shard_positions(p["pos"], x.shape[1])
+        seg_ids = (abs_pos >= src_len).astype(jnp.int32)
         y = (jnp.take(p["tok"], x, axis=0)
-             + p["pos"][:T]
+             + pos_emb
              + jnp.take(p["seg"], seg_ids, axis=0))
         return y, s
 

@@ -62,6 +62,19 @@ def _dense_init(key, din, dout, std=0.02):
     return jax.random.normal(key, (din, dout), jnp.float32) * std
 
 
+def shard_positions(pos_table: jax.Array, T: int):
+    """(position embeddings [T, d], absolute positions [T]) for the local
+    sequence shard: rows [0, T) outside sequence parallelism, this shard's
+    contiguous slice (axis_index * T offset) inside it. Single home for the
+    shard layout, shared by every embedding (transformer + seq2seq)."""
+    axis = _seq_axis()
+    if axis is None:
+        return pos_table[:T], jnp.arange(T)
+    offset = lax.axis_index(axis) * T
+    return (lax.dynamic_slice_in_dim(pos_table, offset, T, axis=0),
+            offset + jnp.arange(T))
+
+
 def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
     def init(key, in_shape):
         (T,) = in_shape
@@ -74,13 +87,7 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
 
     def apply(p, s, x, train):
         # x: [B, T] int32 (T = local shard length under sequence parallelism)
-        T = x.shape[1]
-        axis = _seq_axis()
-        if axis is None:
-            pos = p["pos"][:T]
-        else:
-            offset = lax.axis_index(axis) * T
-            pos = lax.dynamic_slice_in_dim(p["pos"], offset, T, axis=0)
+        pos, _ = shard_positions(p["pos"], x.shape[1])
         y = jnp.take(p["tok"], x, axis=0) + pos
         return y, s
 
@@ -152,15 +159,19 @@ def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
     return jnp.einsum("bhqk,bhkd->bhqd", e / jnp.maximum(z, 1e-20), v)
 
 
-def ring_attention(q, k, v, axis: str):
-    """Causal attention over a sequence sharded on mesh axis `axis`.
+def ring_attention(q, k, v, axis: str, prefix_len: int = 0):
+    """Causal (or prefix-LM) attention over a sequence sharded on mesh axis
+    `axis`.
 
     Each device holds the Q/K/V block for its sequence shard; K/V blocks rotate
     around the ring with `lax.ppermute` while a streaming (online-softmax)
     accumulator — running max m, normalizer l, weighted sum acc — combines the
     partial attention of the local queries against each visiting block. This is
     blockwise/ring attention: peak memory is O(T_local^2) instead of O(T^2),
-    and the ring transfers ride ICI neighbor links.
+    and the ring transfers ride ICI neighbor links. ``prefix_len`` > 0 adds
+    the prefix-LM rule on ABSOLUTE key positions (the seq2seq source segment
+    is globally visible), so sequence-parallel translation works even when
+    the source spans multiple shards.
     """
     n = lax.psum(1, axis)
     idx = lax.axis_index(axis)
@@ -174,7 +185,10 @@ def ring_attention(q, k, v, axis: str):
         k_pos = src * Tl + jnp.arange(Tl)[None, :]
         s = jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
         s = s / math.sqrt(dh)
-        s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+        ok = q_pos >= k_pos
+        if prefix_len:
+            ok = ok | (k_pos < prefix_len)
+        s = jnp.where(ok, s, -jnp.inf)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         safe_m = jnp.where(jnp.isfinite(m_new), m_new, 0.0)
         p = jnp.exp(s - safe_m)
@@ -199,8 +213,8 @@ def attention_sublayer(p, x, n_heads: int, prefix_len: int = 0):
     """Pre-LN self-attention sublayer with residual: reads p["ln1"],
     p["wqkv"], p["wo"]. Dispatches to ring attention over the active
     sequence_parallel axis, so every block (dense and MoE) gets the
-    sequence-parallel path from one implementation. ``prefix_len`` selects the
-    prefix-LM mask (seq2seq; causal-only under sequence parallelism)."""
+    sequence-parallel path from one implementation. ``prefix_len`` selects
+    the prefix-LM mask (seq2seq) on both paths."""
     B, T, d = x.shape
     dh = d // n_heads
     h = layer_norm(p["ln1"], x)
@@ -215,11 +229,8 @@ def attention_sublayer(p, x, n_heads: int, prefix_len: int = 0):
         o = causal_attention(heads(q), heads(k), heads(v),
                              prefix_len=prefix_len)
     else:
-        if prefix_len:
-            raise NotImplementedError(
-                "prefix-LM attention has no ring implementation; the sp "
-                "strategy is causal-only (RunConfig.validate enforces this)")
-        o = ring_attention(heads(q), heads(k), heads(v), axis)
+        o = ring_attention(heads(q), heads(k), heads(v), axis,
+                           prefix_len=prefix_len)
     o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
     return x + o @ p["wo"].astype(x.dtype)
 
