@@ -11,10 +11,11 @@ needs THREE hook mechanisms plus a C++ autograd patch:
 On TPU none of that machinery exists or is needed:
 * shapes/params come from init_model's shape chain (the model IS a chain),
 * per-layer times come from jitting each layer's forward and forward+backward
-  separately and timing with block_until_ready ("time" mode) — accepting that
-  XLA fusion makes per-layer attribution approximate (documented deviation,
-  SURVEY.md §7 "hard parts"), or from XLA HLO cost analysis divided by peak
-  FLOP/s ("flops" mode: deterministic, device-free, used in tests),
+  separately and timing against a tunnel-safe completion barrier (_sync;
+  "time" mode) — accepting that XLA fusion makes per-layer attribution
+  approximate (documented deviation, SURVEY.md §7 "hard parts"), or from XLA
+  HLO cost analysis divided by peak FLOP/s ("flops" mode: deterministic,
+  device-free, used in tests),
 * dataflow is the layer chain itself; jaxpr capture is available via
   jax.make_jaxpr for diagnostics.
 
