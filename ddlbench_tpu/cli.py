@@ -56,6 +56,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--attention-backend", default="auto",
                    choices=ATTENTION_BACKENDS,
                    help="auto = Pallas flash-attention kernel on TPU")
+    p.add_argument("--no-fused-head-loss", action="store_true",
+                   help="disable the fused LM-head projection+cross-entropy "
+                        "(materialize full logits instead)")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
     p.add_argument("--auto-partition", action="store_true",
@@ -109,6 +112,7 @@ def config_from_args(args) -> RunConfig:
         label_smoothing=args.label_smoothing,
         compute_dtype=args.dtype,
         attention_backend=args.attention_backend,
+        fused_head_loss=not args.no_fused_head_loss,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,

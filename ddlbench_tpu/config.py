@@ -200,6 +200,10 @@ class RunConfig:
     compute_dtype: str = "bfloat16"  # MXU-native; tests use float32
     # "auto" = Pallas flash-attention kernel on TPU, jnp elsewhere.
     attention_backend: str = "auto"  # auto | flash | xla
+    # Fused LM-head projection+cross-entropy on the training path
+    # (ops/fused_xent.py): the [tokens, vocab] logits never hit HBM. Applies
+    # to models whose head supports it (the token/seq2seq workloads).
+    fused_head_loss: bool = True
     param_dtype: str = "float32"
     # jax.checkpoint each (microbatch, stage) in pipeline modes — parity with
     # torchgpipe's default activation checkpointing.

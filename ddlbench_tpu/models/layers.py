@@ -100,6 +100,11 @@ class Layer:
     # (no position dependence, no cross-position mixing) — such layers can be
     # decoded via apply without a cache (e.g. the LM head).
     pointwise: bool = False
+    # Output-head layers may provide a fused projection+loss path
+    # ``fused_loss(params, x, labels, smoothing) -> (obj_sum, ce_sum, correct)``
+    # that never materializes the [N, num_classes] logits (ops/fused_xent.py);
+    # strategies use it on the training path when cfg.fused_head_loss is set.
+    fused_loss: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

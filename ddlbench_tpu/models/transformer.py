@@ -356,7 +356,18 @@ def lm_head(name: str, vocab: int) -> Layer:
         h = layer_norm(p["ln_f"], x)
         return h @ p["head"].astype(x.dtype), s
 
-    return Layer(name, init, apply, pointwise=True)
+    def fused_loss(p, x, labels, smoothing):
+        # Projection + CE fused per row chunk: the [B*T, vocab] logits never
+        # hit HBM (ops/fused_xent.py) — at vocab 32k this is the largest
+        # tensor a token workload would otherwise materialize.
+        from ddlbench_tpu.ops.fused_xent import fused_linear_xent
+
+        d = x.shape[-1]
+        h = layer_norm(p["ln_f"], x).reshape(-1, d)
+        return fused_linear_xent(h, p["head"].astype(x.dtype),
+                                 labels.reshape(-1), smoothing)
+
+    return Layer(name, init, apply, pointwise=True, fused_loss=fused_loss)
 
 
 def build_transformer(arch: str, in_shape, vocab: int) -> LayerModel:

@@ -76,18 +76,32 @@ class AxisShardedStrategy:
         smooth = cfg.resolved_label_smoothing()
 
         def fwd_local(params, state, xl, yl, train: bool):
+            from ddlbench_tpu.parallel.common import (fused_head_loss_sums,
+                                                      head_fusable)
+
             aux: list = []
+            use_fused = train and cfg.fused_head_loss and head_fusable(model)
             with contextlib.ExitStack() as stack:
                 for ctx in self._trace_contexts():
                     stack.enter_context(ctx)
                 stack.enter_context(collect_aux_losses(aux))
-                logits, new_state = apply_model(
-                    model, cast_params(params, cdtype), state, xl, train
-                )
-            # training objective may be label-smoothed; the reported ce is not
-            obj_nll, correct, cnt = _local_ce_sums(
-                logits, yl, smooth if train else 0.0)
-            ce_nll = _local_ce_sums(logits, yl)[0] if (train and smooth) else obj_nll
+                if use_fused:
+                    # fused projection+CE per shard: local SUMS, psum'd below
+                    # exactly like the unfused path's
+                    obj_nll, ce_nll, correct, cnt, new_state = (
+                        fused_head_loss_sums(
+                            model, cast_params(params, cdtype), state, xl, yl,
+                            smooth))
+                    cnt = cnt.astype(jnp.float32)
+                else:
+                    logits, new_state = apply_model(
+                        model, cast_params(params, cdtype), state, xl, train
+                    )
+            if not use_fused:
+                # training objective may be label-smoothed; the reported ce is not
+                obj_nll, correct, cnt = _local_ce_sums(
+                    logits, yl, smooth if train else 0.0)
+                ce_nll = _local_ce_sums(logits, yl)[0] if (train and smooth) else obj_nll
             count = lax.psum(jnp.float32(cnt), axis)
             obj = lax.psum(obj_nll, axis) / count
             ce = lax.psum(ce_nll, axis) / count

@@ -137,14 +137,55 @@ def cast_input(x, dtype):
     return x.astype(dtype)
 
 
+def head_fusable(model) -> bool:
+    """True when the model's last layer offers the fused projection+loss path
+    (ops/fused_xent.py) — the LM heads of the token/seq2seq workloads."""
+    return model.layers[-1].fused_loss is not None
+
+
+def fused_slice_loss_sums(layers, params_cast, states, x_cast, labels,
+                          smoothing: float):
+    """Apply layers[:-1], then layers[-1].fused_loss (the fused projection+CE).
+
+    The single home for the fused-head calling convention (also used by the
+    pipeline strategies on their loss stage): the head layer must be
+    stateless (true for lm_head) and its state entry is passed through
+    unchanged. Returns (obj_sum, ce_sum, correct, new_states) — sums over
+    valid label positions; callers normalize (and psum first under
+    shard_map). Inputs must already be in the compute dtype.
+    """
+    from ddlbench_tpu.models.layers import apply_slice
+
+    h, new_states = apply_slice(layers[:-1], params_cast[:-1], states[:-1],
+                                x_cast, True)
+    obj_sum, ce_sum, correct = layers[-1].fused_loss(
+        params_cast[-1], h, labels, smoothing)
+    return obj_sum, ce_sum, correct, new_states + [states[-1]]
+
+
+def fused_head_loss_sums(model, params_cast, model_state, x_cast, y,
+                         smoothing: float):
+    """Model-level wrapper of fused_slice_loss_sums; adds the valid count.
+
+    Returns (obj_sum, ce_sum, correct, valid, new_state).
+    """
+    obj_sum, ce_sum, correct, new_state = fused_slice_loss_sums(
+        model.layers, params_cast, model_state, x_cast, y, smoothing)
+    valid = jnp.sum((y >= 0).astype(jnp.int32))
+    return obj_sum, ce_sum, correct, valid, new_state
+
+
 def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
-                      aux_weight, smoothing: float = 0.0):
-    """Apply the model and return (total_loss, ce, logits, new_state).
+                      aux_weight, smoothing: float = 0.0, fused: bool = False):
+    """Apply the model and return (total_loss, ce, (correct, valid), new_state).
 
     total_loss = cross-entropy (optionally label-smoothed — the training
     objective) + aux_weight * (MoE router load-balance losses collected during
     the apply — zero for dense models). The returned ``ce`` is the *unsmoothed*
-    CE so the headline loss metric stays comparable across configurations.
+    CE so the headline loss metric stays comparable across configurations;
+    (correct, valid) are the top-1 metric counts. With ``fused`` (and a model
+    whose head supports it — see head_fusable) the projection+loss runs the
+    chunked fused path and the full logits are never materialized.
     Shared by every strategy whose loss is computed from one traced apply
     (single/dp/tp/fsdp); sp/ep inline the same pattern because their aux terms
     need a psum over the shard_map axis first.
@@ -153,11 +194,19 @@ def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
     from ddlbench_tpu.models.moe import collect_aux_losses
 
     p = cast_params(params, compute_dtype)
+    xc = cast_input(x, compute_dtype)
     aux: list = []
-    with collect_aux_losses(aux):
-        logits, new_state = apply_model(
-            model, p, model_state, cast_input(x, compute_dtype), train
-        )
-    ce = cross_entropy_loss(logits, y)
-    obj = cross_entropy_loss(logits, y, smoothing) if smoothing else ce
-    return obj + aux_weight * sum(aux, jnp.float32(0.0)), ce, logits, new_state
+    if fused and train and head_fusable(model):
+        with collect_aux_losses(aux):
+            obj_sum, ce_sum, correct, valid, new_state = fused_head_loss_sums(
+                model, p, model_state, xc, y, smoothing)
+        denom = jnp.maximum(1.0, valid.astype(jnp.float32))
+        obj, ce = obj_sum / denom, ce_sum / denom
+    else:
+        with collect_aux_losses(aux):
+            logits, new_state = apply_model(model, p, model_state, xc, train)
+        ce = cross_entropy_loss(logits, y)
+        obj = cross_entropy_loss(logits, y, smoothing) if smoothing else ce
+        correct, valid = correct_and_count(logits, y)
+    return (obj + aux_weight * sum(aux, jnp.float32(0.0)), ce,
+            (correct, valid), new_state)

@@ -161,6 +161,11 @@ class GPipeStrategy:
         smooth = self.cfg.resolved_label_smoothing() if train else 0.0
         from ddlbench_tpu.models.moe import collect_aux_losses
 
+        # Fused projection+CE on the training path of the loss stage: the
+        # [mb*T, vocab] logits never materialize (ops/fused_xent.py).
+        use_fused = (train and last and self.cfg.fused_head_loss
+                     and self.model.layers[-1].fused_loss is not None)
+
         def branch(param_row, state_row, x_buf, xs, ys, t):
             m = jnp.clip(t - s, 0, M - 1)
             if s == 0:
@@ -173,6 +178,29 @@ class GPipeStrategy:
             # into the branch, accumulated in the scan, and added to the
             # objective in _make_pipe_fn (empty for dense models).
             aux: list = []
+            if use_fused:
+                from ddlbench_tpu.parallel.common import fused_slice_loss_sums
+
+                labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                with collect_aux_losses(aux):
+                    obj_sum, ce_sum, correct, new_states = (
+                        fused_slice_loss_sums(layers, params, states,
+                                              cast_input(x, cdtype), labels,
+                                              smooth))
+                aux_mb = sum(aux, jnp.float32(0.0))
+                denom = jnp.maximum(
+                    1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+                ce = ce_sum / denom
+                loss = obj_sum / denom
+                correct5 = jnp.zeros((), jnp.int32)  # train path: discarded
+                y_out = jnp.zeros((A,), cdtype)
+                new_state_row = pad_vec(
+                    ravel_pytree(new_states)[0].astype(jnp.float32),
+                    state_row.shape[0],
+                )
+                return (_vary(y_out), _vary(new_state_row), _vary(loss),
+                        _vary(ce), _vary(aux_mb), _vary(correct),
+                        _vary(correct5))
             with collect_aux_losses(aux):
                 y, new_states = apply_slice(layers, params, states,
                                             cast_input(x, cdtype), train)

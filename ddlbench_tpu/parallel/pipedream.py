@@ -131,6 +131,45 @@ class PipeDreamStrategy(GPipeStrategy):
 
         return stage_fwd
 
+    def _make_stage_fwd_fused(self, s: int):
+        """Fused-head variant for the LAST stage (ops/fused_xent.py): applies
+        the stage body, then the head's fused projection+CE — the
+        [mb*T, vocab] logits never materialize. Returns None when the model's
+        head has no fused path or cfg disables it.
+
+        Signature: (param_row, state_row, x, labels)
+                   -> (obj_sum, ce_sum, correct, new_state_row, aux).
+        """
+        from ddlbench_tpu.models.moe import collect_aux_losses
+
+        head = self.model.layers[-1]
+        if not (self.cfg.fused_head_loss and head.fused_loss is not None):
+            return None
+        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
+        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
+        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
+        cdtype = self.compute_dtype
+        smooth = self.cfg.resolved_label_smoothing()
+
+        def stage_fwd_fused(param_row, state_row, x, labels):
+            from ddlbench_tpu.parallel.common import fused_slice_loss_sums
+
+            params = cast_params(p_unravel(param_row[:p_len]), cdtype)
+            states = s_unravel(state_row[:s_len])
+            aux: list = []
+            with collect_aux_losses(aux):
+                obj_sum, ce_sum, correct, new_states = fused_slice_loss_sums(
+                    layers, params, states, cast_input(x, cdtype), labels,
+                    smooth)
+            new_state_row = pad_vec(
+                ravel_pytree(new_states)[0].astype(jnp.float32),
+                state_row.shape[0]
+            )
+            return (obj_sum, ce_sum, correct, new_state_row,
+                    sum(aux, jnp.float32(0.0)))
+
+        return stage_fwd_fused
+
     def _make_train_step(self):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb
         H = 2 * M + 2 * S - 2
@@ -150,10 +189,15 @@ class PipeDreamStrategy(GPipeStrategy):
         # 0's input (for recompute), so size over ALL stage inputs.
         A = max(in_sizes)
 
+        fused_last = self._make_stage_fwd_fused(S - 1)
+
         def make_branch(s: int):
             stage_fwd = stage_fwds[s]
+            fused_fwd = fused_last if s == S - 1 else None
             if self.cfg.remat_stages:
                 stage_fwd = jax.checkpoint(stage_fwd)
+                if fused_fwd is not None:
+                    fused_fwd = jax.checkpoint(fused_fwd)
             in_shape, in_size = in_shapes[s], in_sizes[s]
             last = s == S - 1
             W = S - 1 - s
@@ -177,6 +221,24 @@ class PipeDreamStrategy(GPipeStrategy):
                     else:
                         x = unpack_x(lax.dynamic_index_in_dim(
                             fwd_q, f % 2, keepdims=False))
+                    if last and fused_fwd is not None:
+                        labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
+                        # metric only (the backward recomputes its own
+                        # objective): plain CE, masked-label aware
+                        _obj, ce_sum, corr_mb, new_st, _aux = fused_fwd(
+                            params, st_row, x, labels)
+                        loss_mb = ce_sum / jnp.maximum(
+                            1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+                        y_out = jnp.zeros((A,), cdtype)
+                        slot = f % NSLOT
+                        stash_p = lax.dynamic_update_index_in_dim(
+                            stash_p, params, slot, 0)
+                        if s != 0:
+                            stash_x = lax.dynamic_update_index_in_dim(
+                                stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
+                        return jax.tree.map(
+                            _vary,
+                            (new_st, stash_p, stash_x, y_out, loss_mb, corr_mb))
                     y, new_st, _aux = stage_fwd(params, st_row, x)
                     if last:
                         labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
@@ -228,12 +290,23 @@ class PipeDreamStrategy(GPipeStrategy):
                     if last:
                         labels = lax.dynamic_index_in_dim(ys, b, keepdims=False)
 
-                        def loss_of(pv, xv):
-                            y, _, aux = stage_fwd(pv, st_row, xv)
-                            # training objective: (label-smoothed) CE plus
-                            # this stage's weighted MoE router aux terms
-                            return (cross_entropy_loss(y, labels, smooth)
-                                    + aux_w * aux)
+                        if fused_fwd is not None:
+                            denom = jnp.maximum(
+                                1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+
+                            def loss_of(pv, xv):
+                                obj_sum, _, _, _, aux = fused_fwd(
+                                    pv, st_row, xv, labels)
+                                # training objective: (label-smoothed) CE plus
+                                # this stage's weighted MoE router aux terms
+                                return obj_sum / denom + aux_w * aux
+                        else:
+                            def loss_of(pv, xv):
+                                y, _, aux = stage_fwd(pv, st_row, xv)
+                                # training objective: (label-smoothed) CE plus
+                                # this stage's weighted MoE router aux terms
+                                return (cross_entropy_loss(y, labels, smooth)
+                                        + aux_w * aux)
 
                         if s == 0:
                             gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)

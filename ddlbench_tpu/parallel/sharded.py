@@ -28,7 +28,6 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
 from ddlbench_tpu.parallel.common import (
-    accuracy,
     cast_input,
     cast_params,
     correct_and_count,
@@ -91,17 +90,22 @@ class _ShardedParamStrategy:
 
         def train_step(ts: TrainState, x, y, lr):
             def loss_fn(params):
-                loss, ce, logits, new_state = loss_with_moe_aux(
+                loss, ce, stats, new_state = loss_with_moe_aux(
                     model, params, ts.model_state, x, y, True,
                     self.compute_dtype, cfg.moe_aux_weight, smooth,
+                    fused=cfg.fused_head_loss,
                 )
-                return loss, (ce, logits, new_state)
+                return loss, (ce, stats, new_state)
 
-            (_, (ce, logits, new_state)), grads = jax.value_and_grad(
+            (_, (ce, (correct, valid), new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
-            metrics = {"loss": ce, "accuracy": accuracy(logits, y)}
+            metrics = {
+                "loss": ce,
+                "accuracy": correct.astype(jnp.float32)
+                / jnp.maximum(1.0, valid.astype(jnp.float32)),
+            }
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):

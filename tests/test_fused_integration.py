@@ -1,0 +1,96 @@
+"""Fused LM-head loss == unfused across strategies (f32, CPU mesh).
+
+The fused path (ops/fused_xent.py, cfg.fused_head_loss) must be a pure
+optimization: identical losses, metrics, and parameter trajectories as the
+logits-materializing path, on single/dp/sp and the pipeline strategies.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.parallel.single import SingleStrategy
+from tiny_models import TINY_LM, tiny_transformer
+
+TOL = dict(rtol=2e-4, atol=2e-5)
+
+
+def _batch(B=4, T=32, key=0):
+    kx, ky = jax.random.split(jax.random.key(key))
+    x = jax.random.randint(kx, (B, T), 0, 64)
+    y = jax.random.randint(ky, (B, T), 0, 64)
+    return x, y
+
+
+def _run_steps(strategy, x, y, steps=3, lr=0.05):
+    ts = strategy.init(jax.random.key(0))
+    metrics = None
+    for _ in range(steps):
+        ts, metrics = strategy.train_step(
+            ts, *strategy.shard_batch(x, y), jnp.float32(lr))
+    return ts, metrics
+
+
+def _cfg(**kw):
+    base = dict(benchmark="synthtext", strategy="single", arch="transformer_t",
+                compute_dtype="float32", steps_per_epoch=2)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+@pytest.mark.parametrize("smoothing", [0.0, 0.1])
+def test_single_fused_matches_unfused(smoothing):
+    model = tiny_transformer()
+    x, y = _batch()
+    tss, mets = [], []
+    for fused in (True, False):
+        cfg = _cfg(fused_head_loss=fused, label_smoothing=smoothing)
+        ts, m = _run_steps(SingleStrategy(model, cfg), x, y)
+        tss.append(ts)
+        mets.append(m)
+    np.testing.assert_allclose(mets[0]["loss"], mets[1]["loss"], **TOL)
+    np.testing.assert_allclose(mets[0]["accuracy"], mets[1]["accuracy"], **TOL)
+    pa, _ = ravel_pytree(tss[0].params)
+    pb, _ = ravel_pytree(tss[1].params)
+    np.testing.assert_allclose(np.asarray(pa), np.asarray(pb), **TOL)
+
+
+def test_sp_fused_matches_unfused(devices):
+    from ddlbench_tpu.parallel.sp import SPStrategy
+
+    model = tiny_transformer()
+    x, y = _batch()
+    results = []
+    for fused in (True, False):
+        cfg = _cfg(strategy="sp", num_devices=4, fused_head_loss=fused)
+        strat = SPStrategy(model, cfg, devices=devices[:4])
+        ts, m = _run_steps(strat, x, y)
+        p, _ = ravel_pytree(ts.params)
+        results.append((np.asarray(p), float(m["loss"])))
+    np.testing.assert_allclose(results[0][0], results[1][0], **TOL)
+    assert abs(results[0][1] - results[1][1]) < 1e-4
+
+
+@pytest.mark.parametrize("strat_name", ["gpipe", "pipedream"])
+def test_pipeline_fused_matches_unfused(devices, strat_name):
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+    from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
+
+    cls = {"gpipe": GPipeStrategy, "pipedream": PipeDreamStrategy}[strat_name]
+    model = tiny_transformer()
+    x, y = _batch(B=8)
+    results = []
+    for fused in (True, False):
+        cfg = _cfg(strategy=strat_name, num_devices=4, num_stages=4,
+                   micro_batch_size=2, num_microbatches=4,
+                   fused_head_loss=fused)
+        strat = cls(model, cfg, devices=devices[:4])
+        ts, m = _run_steps(strat, x, y, steps=2)
+        results.append((np.asarray(ts.params), float(m["loss"]),
+                        float(m["accuracy"])))
+    np.testing.assert_allclose(results[0][0], results[1][0], **TOL)
+    assert abs(results[0][1] - results[1][1]) < 1e-4
+    assert abs(results[0][2] - results[1][2]) < 1e-6
