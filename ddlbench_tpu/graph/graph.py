@@ -215,6 +215,171 @@ class Graph:
             out.append(sub)
         return out
 
+    # -- branch compression (reference graph.py:139-228 + aggregate/fidelity
+    # :255-275; used by optimizer/scripts/compress_graph_branches.py to shrink
+    # the antichain state space of branchy graphs before the partitioning DP).
+
+    def aggregate(self, sum_activations: bool = False) -> List[float]:
+        """[fwd_time, bwd_time, parameter_size, activation_size] totals.
+
+        activation_size counts only source nodes unless ``sum_activations``
+        (reference semantics: interior activations are transfer sizes, not
+        resident memory).
+        """
+        f = sum(n.forward_compute_time for n in self.nodes.values())
+        b = sum(n.backward_compute_time for n in self.nodes.values())
+        p = sum(n.parameter_size for n in self.nodes.values())
+        if sum_activations:
+            a = sum(n.activation_size for n in self.nodes.values())
+        else:
+            a = sum(n.activation_size for n in self.sources())
+        return [f, b, p, a]
+
+    def check_fidelity(self, other: "Graph", tol: float = 1e-4) -> None:
+        """Assert aggregate totals match ``other`` within ``tol`` (the
+        compression-preserves-cost invariant)."""
+        for mine, theirs in zip(self.aggregate(), other.aggregate()):
+            if mine == theirs:
+                continue
+            assert theirs and abs(mine / theirs - 1.0) <= tol, (
+                f"aggregate mismatch: {self.aggregate()} vs {other.aggregate()}"
+            )
+
+    def compress_branches(self) -> "Graph":
+        """Merge each linear branch body hanging off a fork node into one
+        aggregate node (summed compute times and parameter sizes; the last
+        member's activation_size), shrinking the antichain-DAG state space of
+        branchy graphs while preserving aggregate cost (check_fidelity).
+        Join nodes (in-degree > 1) and pure-chain graphs come back unchanged.
+        """
+        new = Graph()
+        mapping: Dict[str, str] = {}  # old id -> new (possibly merged) id
+        counter = [0]
+
+        def ensure(nid: str) -> str:
+            if nid not in mapping:
+                new.add_node(dataclasses.replace(self.nodes[nid]))
+                mapping[nid] = nid
+            return mapping[nid]
+
+        def compress_from(nid: str):
+            """Merge the maximal run starting at nid (1-in/1-out interior; a
+            trailing sink/fork is folded in; a join ends the run before it).
+            Returns (merged_new_id or None, last old id of the run)."""
+            if len(self.in_edges.get(nid, [])) > 1:
+                return None, nid  # join node: never merged
+            run = []
+            cur = nid
+            while True:
+                run.append(cur)
+                outs = self.edges.get(cur, [])
+                if len(outs) != 1:
+                    break  # sink or fork terminates the run (folded in)
+                if len(self.in_edges.get(outs[0], [])) > 1:
+                    break  # next node is a join: run ends before it
+                cur = outs[0]
+            if len(run) == 1:
+                return None, nid
+            merged = Node(f"compressed_node{counter[0]}",
+                          node_desc=f"Branch {counter[0]}")
+            counter[0] += 1
+            for rid in run:
+                n = self.nodes[rid]
+                merged.forward_compute_time += n.forward_compute_time
+                merged.backward_compute_time += n.backward_compute_time
+                merged.parameter_size += n.parameter_size
+                merged.activation_size = n.activation_size
+            if len(run) == 2:
+                merged.node_desc = self.nodes[run[-1]].node_desc
+            new.add_node(merged)
+            for rid in run:
+                mapping[rid] = merged.node_id
+            return merged.node_id, run[-1]
+
+        seen: Set[str] = set()
+        queue = [n.node_id for n in self.sources()]
+        while queue:
+            nid = queue.pop(0)
+            if nid in seen:
+                continue
+            seen.add(nid)
+            outs = list(self.edges.get(nid, []))
+            if len(outs) > 1:
+                src = ensure(nid)
+                for o in outs:
+                    cid, last = compress_from(o)
+                    if cid is None:
+                        new.add_edge(src, ensure(o))
+                        queue.append(o)
+                    else:
+                        new.add_edge(src, cid)
+                        queue.append(last)
+            else:
+                src = ensure(nid)
+                for o in outs:
+                    dst = ensure(o)
+                    if dst != src:
+                        new.add_edge(src, dst)
+                    queue.append(o)
+        return new
+
+    @classmethod
+    def from_profile_csv(cls, path: str) -> "Graph":
+        """Build a chain graph from a per-layer profile CSV (the import path
+        of optimizer/scripts/convert_profiles_to_graphs.py + utils.py
+        parse_profile_file_to_graph).
+
+        Expected columns: "Layer Type", "Total time" (summed over the N
+        minibatches named by a "Forward pass time (N)" column), "Output Size"
+        and "Parameter Size (floats)" (floats, 4 bytes each). The upstream
+        script passes a ``compute_time`` kwarg its own Node no longer accepts
+        (py2-era bit rot); here the per-layer time lands as a 1/3 : 2/3
+        forward/backward split (the standard train-step ratio), documented
+        deviation.
+        """
+        import csv as _csv
+
+        g = cls()
+        prev: Optional[str] = None
+        with open(path) as f:
+            rows = list(_csv.reader(f))
+        if not rows:
+            raise ValueError(f"{path}: empty profile CSV (expected a header "
+                             "row with 'Total time' etc.)")
+        header = rows[0]
+        num_minibatches = 1
+        for cell in header:
+            if "Forward pass time" in cell:
+                if "(" not in cell:
+                    raise ValueError(
+                        f"{path}: 'Forward pass time' header cell must name "
+                        f"the minibatch count, e.g. 'Forward pass time (100)';"
+                        f" got {cell!r}")
+                num_minibatches = int(cell.split("(")[1].rstrip(")"))
+        def col(row, name, default=0.0):
+            for i, cell in enumerate(header):
+                if name in cell:
+                    return float(row[i].replace(",", "")) if row[i] else default
+            return default
+        for k, row in enumerate(rows[1:]):
+            if not row:
+                continue
+            total_ms = col(row, "Total time") / num_minibatches * 1000.0
+            node = Node(
+                node_id=str(k),
+                node_desc=row[header.index("Layer Type")]
+                if "Layer Type" in header else f"layer{k}",
+                forward_compute_time=total_ms / 3.0,
+                backward_compute_time=total_ms * 2.0 / 3.0,
+                activation_size=col(row, "Output Size") * 4.0,
+                parameter_size=col(row, "Parameter Size (floats)") * 4.0,
+            )
+            g.add_node(node)
+            if prev is not None:
+                g.add_edge(prev, node.node_id)
+            prev = node.node_id
+        return g
+
     # -- serialization -----------------------------------------------------
 
     def __str__(self) -> str:

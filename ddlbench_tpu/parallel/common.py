@@ -37,13 +37,6 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array,
     return jnp.sum(nll * mask) / jnp.maximum(1.0, jnp.sum(mask))
 
 
-def accuracy(logits: jax.Array, labels: jax.Array) -> jax.Array:
-    """Top-1 accuracy over valid (label >= 0) positions."""
-    ok = (jnp.argmax(logits, axis=-1) == labels) & (labels >= 0)
-    valid = jnp.sum((labels >= 0).astype(jnp.float32))
-    return jnp.sum(ok.astype(jnp.float32)) / jnp.maximum(1.0, valid)
-
-
 def correct_and_count(logits: jax.Array, labels: jax.Array):
     """(correct int32, valid-position count int32) for eval accumulation."""
     ok = (jnp.argmax(logits, axis=-1) == labels) & (labels >= 0)

@@ -230,27 +230,20 @@ class PipeDreamStrategy(GPipeStrategy):
                         loss_mb = ce_sum / jnp.maximum(
                             1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
                         y_out = jnp.zeros((A,), cdtype)
-                        slot = f % NSLOT
-                        stash_p = lax.dynamic_update_index_in_dim(
-                            stash_p, params, slot, 0)
-                        if s != 0:
-                            stash_x = lax.dynamic_update_index_in_dim(
-                                stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
-                        return jax.tree.map(
-                            _vary,
-                            (new_st, stash_p, stash_x, y_out, loss_mb, corr_mb))
-                    y, new_st, _aux = stage_fwd(params, st_row, x)
-                    if last:
-                        labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
-                        # metric only (the backward recomputes its own
-                        # objective): plain CE, masked-label aware
-                        loss_mb = cross_entropy_loss(y, labels)
-                        corr_mb = correct_and_count(y, labels)[0]
-                        y_out = jnp.zeros((A,), cdtype)
                     else:
-                        loss_mb = jnp.zeros((), jnp.float32)
-                        corr_mb = jnp.zeros((), jnp.int32)
-                        y_out = pad_vec(y.astype(cdtype), A)
+                        y, new_st, _aux = stage_fwd(params, st_row, x)
+                        if last:
+                            labels = lax.dynamic_index_in_dim(
+                                ys, f, keepdims=False)
+                            # metric only (the backward recomputes its own
+                            # objective): plain CE, masked-label aware
+                            loss_mb = cross_entropy_loss(y, labels)
+                            corr_mb = correct_and_count(y, labels)[0]
+                            y_out = jnp.zeros((A,), cdtype)
+                        else:
+                            loss_mb = jnp.zeros((), jnp.float32)
+                            corr_mb = jnp.zeros((), jnp.int32)
+                            y_out = pad_vec(y.astype(cdtype), A)
                     slot = f % NSLOT
                     stash_p = lax.dynamic_update_index_in_dim(stash_p, params, slot, 0)
                     if s != 0:

@@ -159,6 +159,84 @@ def test_profiler_token_models():
     assert len(g2.nodes) == 4
 
 
+def _branchy_graph():
+    """source -> fork -> (branch A: 3-node chain | branch B: 2-node chain)
+    -> join -> tail."""
+    g = Graph()
+    spec = {
+        "0": ("input", 1.0, 10.0),
+        "1": ("fork", 2.0, 20.0),
+        "a1": ("convA1", 3.0, 30.0), "a2": ("convA2", 4.0, 40.0),
+        "a3": ("convA3", 5.0, 50.0),
+        "b1": ("convB1", 6.0, 60.0), "b2": ("convB2", 7.0, 70.0),
+        "j": ("join", 8.0, 80.0),
+        "t": ("tail", 9.0, 90.0),
+    }
+    for nid, (desc, t, p) in spec.items():
+        g.add_node(Node(nid, desc, forward_compute_time=t,
+                        backward_compute_time=2 * t, activation_size=t,
+                        parameter_size=p))
+    for a, b in [("0", "1"), ("1", "a1"), ("a1", "a2"), ("a2", "a3"),
+                 ("1", "b1"), ("b1", "b2"), ("a3", "j"), ("b2", "j"),
+                 ("j", "t")]:
+        g.add_edge(a, b)
+    return g
+
+
+def test_compress_branches_merges_branch_bodies():
+    g = _branchy_graph()
+    c = g.compress_branches()
+    # each branch body collapses to one node: 0, 1, A, B, j, t
+    assert len(c.nodes) == 6
+    g.check_fidelity(c)
+    # the merged branch nodes carry summed times/params
+    merged = [n for n in c.nodes.values() if n.node_id.startswith("compressed")]
+    assert sorted(n.forward_compute_time for n in merged) == [3 + 4 + 5, 6 + 7]
+    # still a valid DAG ending in the tail
+    order = [n.node_id for n in c.topological_sort()]
+    assert order[0] == "0" and order[-1] == "t"
+    # antichain state space shrank
+    assert len(c.antichain_dag()[0]) < len(g.antichain_dag()[0])
+
+
+def test_compress_branches_chain_unchanged():
+    g = chain_graph([1.0, 2.0, 3.0], params=[1.0, 1.0, 1.0])
+    c = g.compress_branches()
+    assert sorted(c.nodes) == sorted(g.nodes)
+    assert c.edges == g.edges
+    g.check_fidelity(c)
+
+
+def test_fidelity_detects_mismatch():
+    g = chain_graph([1.0, 2.0], params=[1.0, 1.0])
+    h = chain_graph([1.0, 5.0], params=[1.0, 1.0])
+    with pytest.raises(AssertionError):
+        g.check_fidelity(h)
+
+
+def test_from_profile_csv(tmp_path):
+    csv_text = (
+        "Layer Type,Forward pass time (10),Total time,Output Size,"
+        "Parameter Size (floats)\n"
+        "Conv2d,1.0,20.0,\"1,000\",\"2,000\"\n"
+        "Linear,1.0,10.0,500,1000\n"
+    )
+    p = tmp_path / "profile.csv"
+    p.write_text(csv_text)
+    g = Graph.from_profile_csv(str(p))
+    order = [n.node_id for n in g.topological_sort()]
+    assert order == ["0", "1"]
+    n0 = g.nodes["0"]
+    # 20 s total / 10 minibatches = 2 s = 2000 ms, split 1/3 : 2/3
+    assert math.isclose(n0.forward_compute_time + n0.backward_compute_time, 2000.0)
+    assert math.isclose(n0.backward_compute_time, 2 * n0.forward_compute_time)
+    assert n0.activation_size == 4000.0 and n0.parameter_size == 8000.0
+    assert g.nodes["1"].node_desc == "Linear"
+    # round-trips through the reference text format
+    g2 = Graph.from_str(str(g))
+    g.check_fidelity(g2)
+
+
 def test_to_dot_and_plots(tmp_path):
     g = chain_graph([1.0, 2.0], params=[4e6, 8e6], acts=[1e6, 2e6])
     g.nodes["1"].stage_id = 0
