@@ -42,8 +42,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--micro-batch-size", type=int, default=None)
     p.add_argument("--num-microbatches", type=int, default=None)
     p.add_argument("--stages", type=int, default=None)
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="interleaved gpipe schedule: model chunks per device "
+                        "(cuts the pipeline bubble by this factor)")
     p.add_argument("--dp-replicas", type=int, default=1)
     p.add_argument("--steps-per-epoch", type=int, default=None)
+    p.add_argument("--grad-accum-steps", type=int, default=1,
+                   help="gradient-accumulation micro-steps per update "
+                        "(Horovod backward_passes_per_step parity)")
     p.add_argument("--lr", type=float, default=None)
     p.add_argument("--moe-aux-weight", type=float, default=0.01,
                    help="MoE router load-balance loss weight (MoE archs)")
@@ -104,8 +110,10 @@ def config_from_args(args) -> RunConfig:
         micro_batch_size=args.micro_batch_size,
         num_microbatches=args.num_microbatches,
         num_stages=args.stages,
+        virtual_stages=args.virtual_stages,
         dp_replicas=args.dp_replicas,
         steps_per_epoch=args.steps_per_epoch,
+        grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,

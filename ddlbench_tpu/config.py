@@ -174,10 +174,20 @@ class RunConfig:
     lr_step_epochs: int = 30
     lr_step_gamma: float = 0.1
     scale_lr_by_world: bool = True  # Horovod parity: lr x world (mnist_horovod.py:226)
+    # Gradient accumulation: K micro-steps between optimizer updates, grads
+    # averaged (Horovod backward_passes_per_step / batches_per_allreduce
+    # parity, imagenet_horovod.py:131-139; dp also scales lr by K). The
+    # per-step batch becomes K x the configured batch. single/dp/tp/fsdp.
+    grad_accum_steps: int = 1
 
     # Pipeline topology.
     num_stages: Optional[int] = None  # defaults to num_devices // dp_replicas
     dp_replicas: int = 1  # hybrid PPxDP: replicas per stage
+    # Interleaved schedule (gpipe only): each device owns this many model
+    # chunks, cutting the synchronous-pipeline bubble by the same factor at
+    # the cost of more (cheap, ICI-neighbor) rotations. Requires
+    # num_microbatches % stages == 0 when > 1.
+    virtual_stages: int = 1
 
     # Auto-parallelism: profile the model and choose stage bounds with the
     # hierarchical partitioner before building the pipeline strategies
@@ -290,10 +300,12 @@ class RunConfig:
 
     def global_batch(self) -> int:
         mb, chunks = self.resolved_batches()
+        accum = self.grad_accum_steps if self.strategy in (
+            "single", "dp", "tp", "fsdp") else 1
         if self.strategy in ("single", "sp", "tp"):
-            return mb  # sp/tp shard sequence/features, not the batch
+            return mb * accum  # sp/tp shard sequence/features, not the batch
         if self.strategy in ("dp", "fsdp", "ep"):
-            return mb * self.num_devices
+            return mb * self.num_devices * accum
         return mb * chunks * max(1, self.dp_replicas)
 
     def validate(self) -> None:
@@ -330,6 +342,26 @@ class RunConfig:
                     f"stages ({s}) x dp_replicas ({self.dp_replicas}) must equal "
                     f"num_devices ({self.num_devices})"
                 )
+        if self.virtual_stages < 1:
+            raise ValueError("virtual_stages must be >= 1")
+        if self.grad_accum_steps < 1:
+            raise ValueError("grad_accum_steps must be >= 1")
+        if self.grad_accum_steps > 1 and self.strategy not in (
+                "single", "dp", "tp", "fsdp"):
+            raise ValueError(
+                "grad_accum_steps > 1 is supported on single/dp/tp/fsdp "
+                "(pipeline strategies already micro-batch)")
+        if self.virtual_stages > 1:
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    "virtual_stages (interleaved schedule) requires the "
+                    "gpipe strategy")
+            s = self.resolved_stages()
+            _, chunks = self.resolved_batches()
+            if chunks % s:
+                raise ValueError(
+                    f"interleaved schedule needs num_microbatches ({chunks}) "
+                    f"divisible by stages ({s})")
 
     def replace(self, **kw: Any) -> "RunConfig":
         return dataclasses.replace(self, **kw)

@@ -44,7 +44,9 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
         mb, _ = cfg.resolved_batches()
         graph = profile_model(model, mb, mode=cfg.profile_mode, hw=cfg.hardware)
-        stage_bounds = stage_bounds_from_graph(graph, cfg.resolved_stages())
+        # interleaved gpipe partitions into S*V chunks, not S stages
+        num_parts = cfg.resolved_stages() * max(1, cfg.virtual_stages)
+        stage_bounds = stage_bounds_from_graph(graph, num_parts)
         plan = partition_hierarchical(
             graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
         )

@@ -65,6 +65,70 @@ def correct_topk(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
     return jnp.sum(ok.astype(jnp.int32))
 
 
+def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
+                         aux_weight, smoothing, fused, accum_steps: int):
+    """K-way gradient accumulation: split the leading batch axis into K
+    micro-steps, scan value_and_grad over them, and AVERAGE the gradients
+    (Horovod ``DistributedOptimizer(op=hvd.Average,
+    backward_passes_per_step=K)`` semantics, imagenet_horovod.py:131-139; the
+    matching lr x K scaling lives in train/loop.py). BatchNorm state threads
+    sequentially through the micro-steps, exactly as K separate batches
+    would. Returns (loss, ce, (correct, valid), new_state, grads).
+    """
+    K = accum_steps
+    B = x.shape[0]
+    assert B % K == 0, f"batch {B} not divisible by grad_accum_steps {K}"
+    xs = x.reshape(K, B // K, *x.shape[1:])
+    ys = y.reshape(K, B // K, *y.shape[1:])
+
+    def step(carry, xy):
+        st, gsum = carry
+        xk, yk = xy
+
+        def f(p):
+            obj, ce, stats, new_st = loss_with_moe_aux(
+                model, p, st, xk, yk, True, compute_dtype, aux_weight,
+                smoothing, fused)
+            return obj, (ce, stats, new_st)
+
+        (obj, (ce, (corr, valid), new_st)), g = jax.value_and_grad(
+            f, has_aux=True)(params)
+        gsum = jax.tree.map(jnp.add, gsum, g)
+        return (new_st, gsum), (obj, ce, corr, valid)
+
+    from jax import lax
+
+    init = (model_state, jax.tree.map(jnp.zeros_like, params))
+    (new_state, gsum), (objs, ces, corrs, valids) = lax.scan(
+        step, init, (xs, ys))
+    grads = jax.tree.map(lambda g: g / K, gsum)
+    return (jnp.mean(objs), jnp.mean(ces),
+            (jnp.sum(corrs), jnp.sum(valids)), new_state, grads)
+
+
+def loss_and_grads(model, cfg, params, model_state, x, y, compute_dtype,
+                   smoothing):
+    """One-apply training loss + gradients, dispatching on
+    cfg.grad_accum_steps (the shared core of the single/dp/tp/fsdp train
+    steps). Returns (ce, (correct, valid), new_state, grads)."""
+    if cfg.grad_accum_steps > 1:
+        _, ce, stats, new_state, grads = accum_loss_and_grads(
+            model, params, model_state, x, y, compute_dtype,
+            cfg.moe_aux_weight, smoothing, cfg.fused_head_loss,
+            cfg.grad_accum_steps)
+        return ce, stats, new_state, grads
+
+    def loss_fn(p):
+        loss, ce, stats, new_state = loss_with_moe_aux(
+            model, p, model_state, x, y, True, compute_dtype,
+            cfg.moe_aux_weight, smoothing, fused=cfg.fused_head_loss)
+        return loss, (ce, stats, new_state)
+
+    (_, (ce, stats, new_state)), grads = jax.value_and_grad(
+        loss_fn, has_aux=True)(params)
+    return ce, stats, new_state, grads
+
+
 class SGDState(NamedTuple):
     momentum: Any  # pytree matching params
 

@@ -36,7 +36,6 @@ from ddlbench_tpu.parallel.common import (
     correct_and_count,
     correct_topk,
     cross_entropy_loss,
-    loss_with_moe_aux,
     sgd_init,
     sgd_update,
 )
@@ -66,19 +65,15 @@ class DPStrategy:
         self._batch_sharding = NamedSharding(self.mesh, P("data"))
 
         def train_step(ts: TrainState, x, y, lr):
-            def loss_fn(params):
-                # MoE routing statistics here are global-batch (dense
-                # semantics: the batch axis is sharded under one jit).
-                loss, ce, stats, new_state = loss_with_moe_aux(
-                    model, params, ts.model_state, x, y, True,
-                    self.compute_dtype, cfg.moe_aux_weight, smooth,
-                    fused=cfg.fused_head_loss,
-                )
-                return loss, (ce, stats, new_state)
+            # MoE routing statistics are global-batch (dense semantics: the
+            # batch axis is sharded under one jit). With grad_accum_steps > 1
+            # this is Horovod backward_passes_per_step parity: K micro-steps,
+            # one allreduce on the averaged gradient.
+            from ddlbench_tpu.parallel.common import loss_and_grads
 
-            (_, (ce, (correct, valid), new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params)
+            ce, (correct, valid), new_state, grads = loss_and_grads(
+                model, cfg, ts.params, ts.model_state, x, y,
+                self.compute_dtype, smooth)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
             metrics = {
                 "loss": ce,

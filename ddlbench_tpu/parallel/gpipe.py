@@ -66,9 +66,12 @@ def _vary(v, axes=_PIPE_AXES):
 
 
 class PipeTrainState(NamedTuple):
-    params: jax.Array  # [S, L] f32, P('stage', None)
-    model_state: jax.Array  # [S, Ls] f32, P('stage', None)
-    momentum: jax.Array  # [S, L] f32, P('stage', None)
+    # V = cfg.virtual_stages model chunks per device; layouts:
+    #   V=1: [S, L] f32, P('stage', None)        (row s = stage s)
+    #   V>1: [V, S, L] f32, P(None, 'stage', None) (row [v, s] = chunk v*S+s)
+    params: jax.Array
+    model_state: jax.Array  # [S, Ls] / [V, S, Ls], same sharding as params
+    momentum: jax.Array  # [S, L] / [V, S, L], same sharding as params
 
 
 def make_pipe_mesh(num_stages: int, dp_replicas: int,
@@ -95,6 +98,13 @@ class GPipeStrategy:
         self.cfg = cfg
         self.num_stages = cfg.resolved_stages()
         self.dp = max(1, cfg.dp_replicas)
+        # Interleaved schedule (Megatron-style virtual stages): each device
+        # owns V model chunks, chunk c = v*S + s living on device s. The
+        # synchronous-pipeline bubble shrinks from (S-1) stage-times to
+        # (S-1)/V; chunk handoffs become a ring rotation (every boundary is a
+        # device boundary). V=1 is the classic schedule.
+        self.vstages = max(1, getattr(cfg, "virtual_stages", 1))
+        self.num_chunks = self.num_stages * self.vstages
         self.mesh = mesh or make_pipe_mesh(self.num_stages, self.dp, devices)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         self.mb, self.num_microbatches = cfg.resolved_batches()
@@ -105,41 +115,50 @@ class GPipeStrategy:
 
     # -- initialization ----------------------------------------------------
 
+    def _chunk_sharding_spec(self) -> P:
+        # V=1: [S, L] rows over 'stage'; V>1: [V, S, L] middle axis over it.
+        return P("stage", None) if self.vstages == 1 else P(None, "stage", None)
+
     def init(self, key) -> PipeTrainState:
         params_list, state_list, shapes = init_model(self.model, key)
-        S = self.num_stages
+        S, V, C = self.num_stages, self.vstages, self.num_chunks
         bounds = getattr(self, "bounds", None)
         if bounds is None:
             if self._stage_bounds_override is not None:
                 bounds = list(self._stage_bounds_override)
             else:
                 costs = layer_flop_costs(params_list, shapes)
-                bounds = balanced_stage_bounds(costs, S)
-            assert len(bounds) == S + 1 and bounds[0] == 0 and bounds[-1] == len(self.model.layers)
+                bounds = balanced_stage_bounds(costs, C)
+            assert len(bounds) == C + 1 and bounds[0] == 0 and bounds[-1] == len(self.model.layers)
             self.bounds = bounds
             self.shapes = shapes
 
         params_mat, p_unravels, p_lens = pack_stages(
-            [params_list[bounds[s]:bounds[s + 1]] for s in range(S)]
+            [params_list[bounds[c]:bounds[c + 1]] for c in range(C)]
         )
         state_mat, s_unravels, s_lens = pack_stages(
-            [state_list[bounds[s]:bounds[s + 1]] for s in range(S)]
+            [state_list[bounds[c]:bounds[c + 1]] for c in range(C)]
         )
+        if V > 1:
+            # row c = v*S + s -> [v, s] (device s holds its V chunk rows)
+            params_mat = params_mat.reshape(V, S, -1)
+            state_mat = state_mat.reshape(V, S, -1)
 
         if not self._built:
             self._p_unravels, self._p_lens = p_unravels, p_lens
             self._s_unravels, self._s_lens = s_unravels, s_lens
             # Per-device activation buffer: the largest activation crossing a
-            # stage boundary for one microbatch (per data replica).
+            # chunk boundary for one microbatch (per data replica). With V>1
+            # every chunk boundary is a device boundary.
             interior = [
-                self.mb * math.prod(shapes[bounds[s]]) for s in range(1, S)
+                self.mb * math.prod(shapes[bounds[c]]) for c in range(1, C)
             ]
             self._act_size = max(interior) if interior else 1
             self._build_steps()
 
         from ddlbench_tpu.distributed import put_global_batch
 
-        sharding = NamedSharding(self.mesh, P("stage", None))
+        sharding = NamedSharding(self.mesh, self._chunk_sharding_spec())
         params_mat = put_global_batch(params_mat, sharding)
         state_mat = put_global_batch(state_mat, sharding)
         momentum = jnp.zeros_like(params_mat)
@@ -147,16 +166,21 @@ class GPipeStrategy:
 
     # -- stage branch construction ----------------------------------------
 
-    def _make_branch(self, s: int, train: bool):
-        """Branch for lax.switch: identical signature across stages."""
-        S, M, mb, A = self.num_stages, self.num_microbatches, self.mb, self._act_size
-        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
-        in_shape = self.shapes[self.bounds[s]]
-        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
-        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
+    def _make_branch(self, c: int, train: bool):
+        """Branch for lax.switch: identical signature across chunks.
+
+        ``c`` is the model-chunk index (= stage for V=1; c = v*S + s on
+        device s for the interleaved schedule). ``m`` — the microbatch this
+        chunk processes this tick — is computed by the caller's timetable.
+        """
+        C, M, mb, A = self.num_chunks, self.num_microbatches, self.mb, self._act_size
+        layers = self.model.layers[self.bounds[c]:self.bounds[c + 1]]
+        in_shape = self.shapes[self.bounds[c]]
+        p_unravel, p_len = self._p_unravels[c], self._p_lens[c]
+        s_unravel, s_len = self._s_unravels[c], self._s_lens[c]
         cdtype = self.compute_dtype
         num_classes = self.model.num_classes
-        last = s == S - 1
+        last = c == C - 1
 
         smooth = self.cfg.resolved_label_smoothing() if train else 0.0
         from ddlbench_tpu.models.moe import collect_aux_losses
@@ -166,9 +190,8 @@ class GPipeStrategy:
         use_fused = (train and last and self.cfg.fused_head_loss
                      and self.model.layers[-1].fused_loss is not None)
 
-        def branch(param_row, state_row, x_buf, xs, ys, t):
-            m = jnp.clip(t - s, 0, M - 1)
-            if s == 0:
+        def branch(param_row, state_row, x_buf, xs, ys, m):
+            if c == 0:
                 x = lax.dynamic_index_in_dim(xs, m, keepdims=False)
             else:
                 x = x_buf[: mb * math.prod(in_shape)].reshape(mb, *in_shape)
@@ -239,44 +262,75 @@ class GPipeStrategy:
     # -- compiled steps ----------------------------------------------------
 
     def _build_steps(self):
-        stage_sh = NamedSharding(self.mesh, P("stage", None))
-        self._stage_sharding = stage_sh
+        self._stage_sharding = NamedSharding(self.mesh, self._chunk_sharding_spec())
         self._batch_sharding = NamedSharding(self.mesh, P(None, "data"))
         self.train_step = self._make_train_step()
         self.eval_step = self._make_eval_step()
         self._built = True
 
     def _make_pipe_fn(self, train: bool):
-        """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval)."""
+        """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval).
+
+        Timetable: chunk c = v*S + s (on device s) runs microbatch
+        m = g*S + r at tick t = g*S*V + v*S + s + r — conflict-free (for a
+        fixed device the (g, v, r) triple is a mixed-radix decomposition of
+        t - s) and dependency-correct (chunk c+1 runs exactly one tick after
+        chunk c, so the handoff is always a one-step ring rotation, wrapping
+        S-1 -> 0 between chunk groups). Fill/drain cost is S-1 CHUNK times
+        instead of the classic (S-1) stage times — the interleaved-schedule
+        bubble reduction — at the price of C-1 rotations per microbatch.
+        For V = 1 this degenerates to the classic t = m + s timetable
+        (non-wrapping permute kept for that case). The backward pipeline is
+        jax.grad through this scan, inheriting the same schedule reversed.
+        Requires M % S == 0 when V > 1 (microbatch groups of S).
+        """
         S, M, A = self.num_stages, self.num_microbatches, self._act_size
+        V, C = self.vstages, self.num_chunks
         mesh = self.mesh
         aux_w = self.cfg.moe_aux_weight if train else 0.0
-        branches = [self._make_branch(s, train) for s in range(S)]
-        perm = [(i, i + 1) for i in range(S - 1)]
+        branches = [self._make_branch(c, train) for c in range(C)]
+        if V == 1:
+            perm = [(i, i + 1) for i in range(S - 1)]
+        else:
+            perm = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
 
         def inner(params_rows, state_rows, xs, ys):
-            # params_rows [1, L]; state_rows [1, Ls]; xs [M, mb, ...]; ys [M, mb]
+            # params_rows local: [1, L] (V=1) or [V, 1, L]; xs [M, mb, ...]
             # Mark everything varying over both mesh axes up front so all
             # switch branches produce identical VMA types; the pcast on
             # params transposes to the gradient psum over 'data' (the DP
             # all-reduce) in the backward pass.
-            param_row = _vary(params_rows[0])
-            state_row = _vary(state_rows[0])
+            if V == 1:
+                param_rows = _vary(params_rows)  # [1, L]
+                state_rows = _vary(state_rows)
+            else:
+                param_rows = _vary(params_rows[:, 0])  # [V, L]
+                state_rows = _vary(state_rows[:, 0])
             xs = _vary(xs)
             ys = _vary(ys)
             s_idx = lax.axis_index("stage")
-            T = M + S - 1
+            T = M * V + S - 1
 
             def body(carry, t):
-                (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+                (x_buf, st_rows, loss_acc, ce_acc, aux_acc, corr_acc,
                  corr5_acc) = carry
+                u = t - s_idx
+                g = u // (S * V)
+                rem = u % (S * V)  # jnp mod: non-negative for positive divisor
+                v = jnp.clip(rem // S, 0, V - 1)
+                r = rem % S
+                valid = (u >= 0) & (u < M * V)
+                m = jnp.clip(g * S + r, 0, M - 1)
+                chunk = v * S + s_idx
+                param_row = lax.dynamic_index_in_dim(param_rows, v,
+                                                     keepdims=False)
+                st_row = lax.dynamic_index_in_dim(st_rows, v, keepdims=False)
                 (y_buf, new_st, loss_mb, ce_mb, aux_mb, corr_mb,
                  corr5_mb) = lax.switch(
-                    s_idx, branches, param_row, st_row, x_buf, xs, ys, t
+                    chunk, branches, param_row, st_row, x_buf, xs, ys, m
                 )
-                m_idx = t - s_idx
-                valid = (m_idx >= 0) & (m_idx < M)
-                st_row = jnp.where(valid, new_st, st_row)
+                st_upd = lax.dynamic_update_index_in_dim(st_rows, new_st, v, 0)
+                st_rows = jnp.where(valid, st_upd, st_rows)
                 loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
                 ce_acc = ce_acc + jnp.where(valid, ce_mb, 0.0)
                 aux_acc = aux_acc + jnp.where(valid, aux_mb, 0.0)
@@ -286,22 +340,22 @@ class GPipeStrategy:
                     x_next = lax.ppermute(y_buf, "stage", perm)
                 else:
                     x_next = y_buf
-                return (x_next, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+                return (x_next, st_rows, loss_acc, ce_acc, aux_acc, corr_acc,
                         corr5_acc), None
 
             init_carry = (
                 _vary(jnp.zeros((A,), self.compute_dtype)),
-                state_row,
+                state_rows,
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
                 _vary(jnp.zeros((), jnp.int32)),
             )
-            (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+            (x_buf, st_rows, loss_acc, ce_acc, aux_acc, corr_acc,
              corr5_acc), _ = lax.scan(body, init_carry, jnp.arange(T))
-            # Loss lives on the last stage only; the MoE router aux terms live
-            # on whichever stages hold MoE layers — psum both and fold the
+            # Loss lives on the last chunk only; the MoE router aux terms live
+            # on whichever chunks hold MoE layers — psum both and fold the
             # weighted aux into the training objective (dp-strategy parity;
             # the reported ce stays the bare metric).
             ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
@@ -312,14 +366,16 @@ class GPipeStrategy:
             correct5 = lax.psum(lax.psum(corr5_acc, "stage"), "data")
             # Sync BN running stats across data replicas (sync-BN choice,
             # documented deviation — SURVEY.md §7).
-            st_row = lax.pmean(st_row, "data")
-            return loss, ce, st_row[None], correct, correct5
+            st_rows = lax.pmean(st_rows, "data")
+            st_out = st_rows if V == 1 else st_rows[:, None]
+            return loss, ce, st_out, correct, correct5
 
+        spec = self._chunk_sharding_spec()
         return _shard_map(
             inner,
             mesh=mesh,
-            in_specs=(P("stage", None), P("stage", None), P(None, "data"), P(None, "data")),
-            out_specs=(P(), P(), P("stage", None), P(), P()),
+            in_specs=(spec, spec, P(None, "data"), P(None, "data")),
+            out_specs=(P(), P(), spec, P(), P()),
         )
 
     @property

@@ -90,6 +90,13 @@ def bwd_mb_at(s: int, S: int, M: int, h):
 class PipeDreamStrategy(GPipeStrategy):
     """strategy='pipedream': async 1F1B + weight stashing over the stage mesh."""
 
+    def __init__(self, *args, **kwargs):
+        super().__init__(*args, **kwargs)
+        if self.vstages != 1:
+            raise ValueError(
+                "virtual_stages > 1 (interleaved schedule) is a gpipe "
+                "feature; the async 1F1B timetable is single-chunk")
+
     # -- train step --------------------------------------------------------
 
     def _ts_sharding(self):

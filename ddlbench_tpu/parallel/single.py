@@ -22,7 +22,6 @@ from ddlbench_tpu.parallel.common import (
     correct_and_count,
     correct_topk,
     cross_entropy_loss,
-    loss_with_moe_aux,
     sgd_init,
     sgd_update,
 )
@@ -46,17 +45,11 @@ class SingleStrategy:
         smooth = cfg.resolved_label_smoothing()
 
         def train_step(ts: TrainState, x, y, lr):
-            def loss_fn(params):
-                loss, ce, stats, new_state = loss_with_moe_aux(
-                    model, params, ts.model_state, x, y, True,
-                    self.compute_dtype, cfg.moe_aux_weight, smooth,
-                    fused=cfg.fused_head_loss,
-                )
-                return loss, (ce, stats, new_state)
+            from ddlbench_tpu.parallel.common import loss_and_grads
 
-            (_, (ce, (correct, valid), new_state)), grads = jax.value_and_grad(
-                loss_fn, has_aux=True
-            )(ts.params)
+            ce, (correct, valid), new_state, grads = loss_and_grads(
+                model, cfg, ts.params, ts.model_state, x, y,
+                self.compute_dtype, smooth)
             params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
             # headline loss stays the CE term, comparable across strategies
             metrics = {

@@ -64,8 +64,10 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
 
     base_lr = cfg.resolved_lr()
     if cfg.strategy == "dp" and cfg.scale_lr_by_world:
-        # Horovod parity: lr scaled by world size (mnist_horovod.py:226).
-        base_lr = base_lr * strategy.world_size
+        # Horovod parity: lr scaled by world size (mnist_horovod.py:226) and
+        # by the accumulation count (lr * batches_per_allreduce * hvd.size(),
+        # imagenet_horovod.py:131).
+        base_lr = base_lr * strategy.world_size * cfg.grad_accum_steps
 
     # Warmup: trigger compilation outside the timed region (first XLA compile is
     # tens of seconds; the reference's closest analog is cudnn.benchmark=True,

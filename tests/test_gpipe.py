@@ -88,6 +88,74 @@ def test_gpipe_matches_sequential(devices, dp):
         np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("dp", [1, 2])
+def test_interleaved_matches_sequential(devices, dp):
+    """virtual_stages=2: 2 devices x 2 chunks each == the 4-chunk model run
+    sequentially. Same equivalence bar as the classic schedule."""
+    model = tiny_model()
+    S, V, M, mb = 2, 2, 4, 4
+    cfg = RunConfig(
+        strategy="gpipe",
+        num_devices=S * dp,
+        num_stages=S,
+        virtual_stages=V,
+        dp_replicas=dp,
+        micro_batch_size=mb,
+        num_microbatches=M,
+        compute_dtype="float32",
+        momentum=0.0,
+        weight_decay=0.0,
+    )
+    cfg.validate()
+    strat = GPipeStrategy(model, cfg, stage_bounds=[0, 2, 3, 4, 5])
+    assert strat.num_chunks == S * V
+    ts = strat.init(jax.random.key(0))
+    assert ts.params.shape[:2] == (V, S)
+
+    B = M * mb * dp
+    x = jax.random.normal(jax.random.key(1), (B, 8, 8, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    lr = 0.1
+    xs, ys = strat.shard_batch(x, y)
+    ts2, metrics = strat.train_step(ts, xs, ys, jnp.float32(lr))
+
+    params_list, state_list, _ = init_model(model, jax.random.key(0))
+    ref_loss, ref_params = manual_step(
+        model, params_list, state_list, x, y, lr, momentum=0.0
+    )
+    np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss), rtol=1e-5)
+
+    bounds = strat.bounds
+    for c in range(S * V):
+        v, s = c // S, c % S
+        got = ts2.params[v, s][: strat._p_lens[c]]
+        want = ravel_pytree(ref_params[bounds[c]:bounds[c + 1]])[0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-4, atol=1e-6)
+
+    # eval path shares the interleaved pipe
+    ev = strat.eval_step(ts2, xs, ys)
+    assert np.isfinite(float(ev["loss"]))
+    assert int(ev["count"]) == B
+
+
+def test_interleaved_validation():
+    from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
+
+    with pytest.raises(ValueError, match="gpipe"):
+        RunConfig(strategy="pipedream", num_devices=2, num_stages=2,
+                  virtual_stages=2).validate()
+    with pytest.raises(ValueError, match="divisible"):
+        RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                  virtual_stages=2, micro_batch_size=2,
+                  num_microbatches=3).validate()
+    with pytest.raises(ValueError, match="1F1B"):
+        PipeDreamStrategy(tiny_model(),
+                          RunConfig(strategy="pipedream", num_devices=2,
+                                    num_stages=2, virtual_stages=2,
+                                    micro_batch_size=2, num_microbatches=4))
+
+
 def test_gpipe_bn_model_runs(devices):
     # BN model: check execution + finite loss + state change (not equality).
     from ddlbench_tpu.models.layers import conv_bn, global_avg_pool

@@ -1,0 +1,57 @@
+"""Gradient accumulation (Horovod backward_passes_per_step parity).
+
+With a BN-free model in f32, K accumulation micro-steps over a batch of
+K x mb must produce exactly the K=1 full-batch update: the average of K
+equal-size micro-batch mean-gradients equals the full-batch mean gradient.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.flatten_util import ravel_pytree
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.parallel.single import SingleStrategy
+from tiny_models import tiny_transformer
+
+
+def _run(cfg, model, x, y, steps=2, lr=0.05):
+    strat = SingleStrategy(model, cfg)
+    ts = strat.init(jax.random.key(0))
+    m = None
+    for _ in range(steps):
+        ts, m = strat.train_step(ts, x, y, jnp.float32(lr))
+    return ts, m
+
+
+@pytest.mark.parametrize("fused", [True, False])
+def test_accum_matches_full_batch(fused):
+    model = tiny_transformer()  # LN-normalized, BN-free
+    B, T = 8, 32
+    x = jax.random.randint(jax.random.key(1), (B, T), 0, 64)
+    y = jax.random.randint(jax.random.key(2), (B, T), 0, 64)
+    base = dict(benchmark="synthtext", strategy="single",
+                arch="transformer_t", compute_dtype="float32",
+                fused_head_loss=fused)
+    ts1, m1 = _run(RunConfig(**base), model, x, y)
+    tsk, mk = _run(RunConfig(grad_accum_steps=4, **base), model, x, y)
+    np.testing.assert_allclose(float(m1["loss"]), float(mk["loss"]), rtol=1e-5)
+    np.testing.assert_allclose(float(m1["accuracy"]), float(mk["accuracy"]),
+                               atol=1e-6)
+    p1, _ = ravel_pytree(ts1.params)
+    pk, _ = ravel_pytree(tsk.params)
+    np.testing.assert_allclose(np.asarray(p1), np.asarray(pk),
+                               rtol=2e-4, atol=2e-6)
+
+
+def test_accum_validation_and_batch():
+    cfg = RunConfig(strategy="dp", benchmark="mnist", num_devices=2,
+                    batch_size=8, grad_accum_steps=3)
+    cfg.validate()
+    assert cfg.global_batch() == 8 * 2 * 3
+    with pytest.raises(ValueError, match="single/dp/tp/fsdp"):
+        RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                  grad_accum_steps=2).validate()
+    with pytest.raises(ValueError, match=">= 1"):
+        RunConfig(grad_accum_steps=0).validate()
