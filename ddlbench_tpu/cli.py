@@ -51,6 +51,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient-accumulation micro-steps per update "
                         "(Horovod backward_passes_per_step parity)")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--warmup-epochs", type=int, default=0,
+                   help="gradual lr warmup epochs (Horovod ImageNet parity: "
+                        "base lr -> base*world over this many epochs)")
     p.add_argument("--moe-aux-weight", type=float, default=0.01,
                    help="MoE router load-balance loss weight (MoE archs)")
     p.add_argument("--moe-capacity-factor", type=float, default=1.25,
@@ -115,6 +118,7 @@ def config_from_args(args) -> RunConfig:
         steps_per_epoch=args.steps_per_epoch,
         grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,
+        warmup_epochs=args.warmup_epochs,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,
         label_smoothing=args.label_smoothing,

@@ -173,6 +173,11 @@ class RunConfig:
     weight_decay: Optional[float] = None
     lr_step_epochs: int = 30
     lr_step_gamma: float = 0.1
+    # Goyal-et-al gradual warmup (imagenet_horovod.py:258-275): ramp lr from
+    # base to base*world over this many leading epochs, per-batch
+    # granularity. 0 disables (the reference enables it only in the Horovod
+    # ImageNet driver, warmup_epochs=5).
+    warmup_epochs: int = 0
     scale_lr_by_world: bool = True  # Horovod parity: lr x world (mnist_horovod.py:226)
     # Gradient accumulation: K micro-steps between optimizer updates, grads
     # averaged (Horovod backward_passes_per_step / batches_per_allreduce

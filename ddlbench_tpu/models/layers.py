@@ -105,6 +105,10 @@ class Layer:
     # that never materializes the [N, num_classes] logits (ops/fused_xent.py);
     # strategies use it on the training path when cfg.fused_head_loss is set.
     fused_loss: Any = None
+    # Eval-side sibling: ``fused_eval(params, x, labels) ->
+    # (ce_sum, correct, correct_top5, valid)`` — same fusion for the
+    # validation metrics (incl. prec@5 with torch.topk tie order).
+    fused_eval: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

@@ -367,7 +367,16 @@ def lm_head(name: str, vocab: int) -> Layer:
         return fused_linear_xent(h, p["head"].astype(x.dtype),
                                  labels.reshape(-1), smoothing)
 
-    return Layer(name, init, apply, pointwise=True, fused_loss=fused_loss)
+    def fused_eval(p, x, labels):
+        from ddlbench_tpu.ops.fused_xent import fused_linear_xent_eval
+
+        d = x.shape[-1]
+        h = layer_norm(p["ln_f"], x).reshape(-1, d)
+        return fused_linear_xent_eval(h, p["head"].astype(x.dtype),
+                                      labels.reshape(-1))
+
+    return Layer(name, init, apply, pointwise=True, fused_loss=fused_loss,
+                 fused_eval=fused_eval)
 
 
 def build_transformer(arch: str, in_shape, vocab: int) -> LayerModel:

@@ -191,6 +191,55 @@ def _fxent_bwd_xla(h, w, labels, lses, go, gce, smoothing: float,
 fused_linear_xent.defvjp(_fxent_fwd, _fxent_bwd)
 
 
+def fused_linear_xent_eval(h, w, labels, k: int = 5, row_chunk: int = 512):
+    """Eval-side fusion: (ce_sum, correct, correct_topk, valid) over valid
+    rows, materializing only one [chunk, V] logit block at a time instead of
+    the full [N, V] (at longctx shapes the full eval logits would be
+    gigabytes).
+
+    Top-k tie handling matches parallel/common.py correct_topk (torch.topk
+    order: value descending, index ascending): the label ranks after every
+    strictly-greater logit and after equal logits at smaller class indices.
+    No gradients (plain function — eval only).
+    """
+    N, D = h.shape
+    V = w.shape[1]
+    k = min(k, V)
+    chunk = min(row_chunk, N)
+    hp, lp, nc = _pad_rows(h, labels, chunk)
+    hcs = hp.reshape(nc, chunk, D)
+    lcs = lp.reshape(nc, chunk)
+
+    def body(carry, xs):
+        ce_s, corr, corrk, cnt = carry
+        h_c, l_c = xs
+        z = jnp.dot(h_c, w, preferred_element_type=jnp.float32)
+        nll, _, correct, mask, _ = _row_stats(z, l_c, 0.0)
+        # top-k rank: strictly-greater logits plus equal logits at smaller
+        # class indices (torch.topk order)
+        safe = jnp.maximum(l_c, 0)
+        gold = jnp.take_along_axis(z, safe[:, None], axis=-1)
+        idx = jax.lax.broadcasted_iota(jnp.int32, z.shape, 1)
+        higher = jnp.sum((z > gold).astype(jnp.int32), axis=-1)
+        tie_before = jnp.sum(
+            ((z == gold) & (idx < safe[:, None])).astype(jnp.int32), axis=-1)
+        ce_s = ce_s + jnp.sum(jnp.where(mask, nll, 0.0))
+        corr = corr + jnp.sum(correct.astype(jnp.int32))
+        corrk = corrk + jnp.sum(
+            ((higher + tie_before < k) & mask).astype(jnp.int32))
+        cnt = cnt + jnp.sum(mask.astype(jnp.int32))
+        return (ce_s, corr, corrk, cnt), None
+
+    axes = set(_vma(h)) | set(_vma(w)) | set(_vma(labels))
+    init = tuple(
+        _pcast_to(z, axes)
+        for z in (jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32),
+                  jnp.zeros((), jnp.int32), jnp.zeros((), jnp.int32))
+    )
+    (ce_s, corr, corrk, cnt), _ = lax.scan(body, init, (hcs, lcs))
+    return ce_s, corr, corrk, cnt
+
+
 # ---------------------------------------------------------------------------
 # Pallas TPU kernels — same math, zero logits traffic to HBM.
 #

@@ -76,11 +76,16 @@ class AxisShardedStrategy:
         smooth = cfg.resolved_label_smoothing()
 
         def fwd_local(params, state, xl, yl, train: bool):
-            from ddlbench_tpu.parallel.common import (fused_head_loss_sums,
+            from ddlbench_tpu.parallel.common import (fused_head_eval_sums,
+                                                      fused_head_loss_sums,
                                                       head_fusable)
 
             aux: list = []
-            use_fused = train and cfg.fused_head_loss and head_fusable(model)
+            fusable = cfg.fused_head_loss and head_fusable(model)
+            use_fused = train and fusable
+            use_fused_eval = ((not train) and fusable
+                              and model.layers[-1].fused_eval is not None)
+            correct5_local = jnp.zeros((), jnp.int32)
             with contextlib.ExitStack() as stack:
                 for ctx in self._trace_contexts():
                     stack.enter_context(ctx)
@@ -93,15 +98,24 @@ class AxisShardedStrategy:
                             model, cast_params(params, cdtype), state, xl, yl,
                             smooth))
                     cnt = cnt.astype(jnp.float32)
+                elif use_fused_eval:
+                    ce_nll, correct, correct5_local, cnt = (
+                        fused_head_eval_sums(
+                            model, cast_params(params, cdtype), state, xl, yl))
+                    obj_nll = ce_nll
+                    cnt = cnt.astype(jnp.float32)
+                    new_state = state
                 else:
                     logits, new_state = apply_model(
                         model, cast_params(params, cdtype), state, xl, train
                     )
-            if not use_fused:
+            if not (use_fused or use_fused_eval):
                 # training objective may be label-smoothed; the reported ce is not
                 obj_nll, correct, cnt = _local_ce_sums(
                     logits, yl, smooth if train else 0.0)
                 ce_nll = _local_ce_sums(logits, yl)[0] if (train and smooth) else obj_nll
+                if not train:
+                    correct5_local = correct_topk(logits, yl)
             count = lax.psum(jnp.float32(cnt), axis)
             obj = lax.psum(obj_nll, axis) / count
             ce = lax.psum(ce_nll, axis) / count
@@ -113,7 +127,7 @@ class AxisShardedStrategy:
             # prec@5 is an eval-only metric; train_step discards it, so skip
             # the top-k compute (and its psum) on the hot path
             correct5 = (jnp.zeros((), jnp.int32) if train
-                        else lax.psum(correct_topk(logits, yl), axis))
+                        else lax.psum(correct5_local, axis))
             return loss, ce, correct, correct5, count, new_state
 
         def make_sharded(train: bool):

@@ -78,12 +78,20 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
     K = accum_steps
     B = x.shape[0]
     assert B % K == 0, f"batch {B} not divisible by grad_accum_steps {K}"
-    xs = x.reshape(K, B // K, *x.shape[1:])
-    ys = y.reshape(K, B // K, *y.shape[1:])
+    # Micro-step k takes every K-th row (reshape [B//K, K, ...], index axis
+    # 1): with the batch sharded on axis 0 this keeps each micro-batch's rows
+    # local to their device — Horovod's per-worker accumulation — whereas a
+    # [K, B//K] leading split would put each micro-step on a fraction of the
+    # devices and force a resharding collective per micro-step.
+    xs = x.reshape(B // K, K, *x.shape[1:])
+    ys = y.reshape(B // K, K, *y.shape[1:])
 
-    def step(carry, xy):
+    from jax import lax
+
+    def step(carry, k):
         st, gsum = carry
-        xk, yk = xy
+        xk = lax.dynamic_index_in_dim(xs, k, axis=1, keepdims=False)
+        yk = lax.dynamic_index_in_dim(ys, k, axis=1, keepdims=False)
 
         def f(p):
             obj, ce, stats, new_st = loss_with_moe_aux(
@@ -96,11 +104,9 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
         gsum = jax.tree.map(jnp.add, gsum, g)
         return (new_st, gsum), (obj, ce, corr, valid)
 
-    from jax import lax
-
     init = (model_state, jax.tree.map(jnp.zeros_like, params))
     (new_state, gsum), (objs, ces, corrs, valids) = lax.scan(
-        step, init, (xs, ys))
+        step, init, jnp.arange(K))
     grads = jax.tree.map(lambda g: g / K, gsum)
     return (jnp.mean(objs), jnp.mean(ces),
             (jnp.sum(corrs), jnp.sum(valids)), new_state, grads)
@@ -161,6 +167,20 @@ def sgd_update(params, grads, opt_state: SGDState, lr, momentum: float,
 def step_decay_lr(base_lr: float, epoch, step_epochs: int, gamma: float):
     """Step decay /gamma every step_epochs (imagenet_pytorch.py:225-229)."""
     return base_lr * (gamma ** (epoch // step_epochs))
+
+
+def gradual_warmup_lr(scaled_lr: float, world: int, epoch0: int, step: int,
+                      steps_per_epoch: int, warmup_epochs: int) -> float:
+    """Goyal-et-al gradual warmup (imagenet_horovod.py:258-275): during the
+    first ``warmup_epochs`` the lr ramps linearly, at per-batch granularity,
+    from base_lr to the full world-scaled ``scaled_lr`` (= base_lr * world).
+    ``epoch0`` is 0-based. Returns scaled_lr untouched past the warmup.
+    """
+    if epoch0 >= warmup_epochs or world <= 1:
+        return scaled_lr
+    frac = epoch0 + (step + 1) / max(1, steps_per_epoch)
+    lr_adj = (1.0 / world) * (frac * (world - 1) / warmup_epochs + 1.0)
+    return scaled_lr * lr_adj
 
 
 def cast_params(params, dtype):
@@ -230,6 +250,47 @@ def fused_head_loss_sums(model, params_cast, model_state, x_cast, y,
         model.layers, params_cast, model_state, x_cast, y, smoothing)
     valid = jnp.sum((y >= 0).astype(jnp.int32))
     return obj_sum, ce_sum, correct, valid, new_state
+
+
+def fused_slice_eval_sums(layers, params_cast, states, x_cast, labels):
+    """Eval twin of fused_slice_loss_sums: apply layers[:-1] (eval mode),
+    then layers[-1].fused_eval. Returns (ce_sum, correct, correct5, valid).
+    """
+    from ddlbench_tpu.models.layers import apply_slice
+
+    h, _ = apply_slice(layers[:-1], params_cast[:-1], states[:-1], x_cast,
+                       False)
+    return layers[-1].fused_eval(params_cast[-1], h, labels)
+
+
+def fused_head_eval_sums(model, params_cast, model_state, x_cast, y):
+    """Model-level wrapper of fused_slice_eval_sums."""
+    return fused_slice_eval_sums(model.layers, params_cast, model_state,
+                                 x_cast, y)
+
+
+def eval_metrics(model, cfg, params, model_state, x, y, compute_dtype):
+    """Shared eval step core for single/dp/tp/fsdp: returns the metric dict
+    {loss, correct, correct5, count}. Uses the fused head path (no [N, V]
+    logits) when available and enabled."""
+    p = cast_params(params, compute_dtype)
+    xc = cast_input(x, compute_dtype)
+    if cfg.fused_head_loss and model.layers[-1].fused_eval is not None:
+        ce_sum, correct, correct5, count = fused_head_eval_sums(
+            model, p, model_state, xc, y)
+        loss = ce_sum / jnp.maximum(1.0, count.astype(jnp.float32))
+        return {"loss": loss, "correct": correct, "correct5": correct5,
+                "count": count}
+    from ddlbench_tpu.models.layers import apply_model
+
+    logits, _ = apply_model(model, p, model_state, xc, False)
+    correct, count = correct_and_count(logits, y)
+    return {
+        "loss": cross_entropy_loss(logits, y),
+        "correct": correct,
+        "correct5": correct_topk(logits, y),
+        "count": count,
+    }
 
 
 def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,

@@ -185,10 +185,14 @@ class GPipeStrategy:
         smooth = self.cfg.resolved_label_smoothing() if train else 0.0
         from ddlbench_tpu.models.moe import collect_aux_losses
 
-        # Fused projection+CE on the training path of the loss stage: the
-        # [mb*T, vocab] logits never materialize (ops/fused_xent.py).
+        # Fused projection+CE on the loss stage: the [mb*T, vocab] logits
+        # never materialize (ops/fused_xent.py); the eval twin also covers
+        # the prec@5 metric.
+        head = self.model.layers[-1]
         use_fused = (train and last and self.cfg.fused_head_loss
-                     and self.model.layers[-1].fused_loss is not None)
+                     and head.fused_loss is not None)
+        use_fused_eval = ((not train) and last and self.cfg.fused_head_loss
+                          and head.fused_eval is not None)
 
         def branch(param_row, state_row, x_buf, xs, ys, m):
             if c == 0:
@@ -223,6 +227,19 @@ class GPipeStrategy:
                 )
                 return (_vary(y_out), _vary(new_state_row), _vary(loss),
                         _vary(ce), _vary(aux_mb), _vary(correct),
+                        _vary(correct5))
+            if use_fused_eval:
+                from ddlbench_tpu.parallel.common import fused_slice_eval_sums
+
+                labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                with collect_aux_losses(aux):
+                    ce_sum, correct, correct5, valid = fused_slice_eval_sums(
+                        layers, params, states, cast_input(x, cdtype), labels)
+                aux_mb = sum(aux, jnp.float32(0.0))
+                denom = jnp.maximum(1.0, valid.astype(jnp.float32))
+                ce = loss = ce_sum / denom
+                return (_vary(jnp.zeros((A,), cdtype)), _vary(state_row),
+                        _vary(loss), _vary(ce), _vary(aux_mb), _vary(correct),
                         _vary(correct5))
             with collect_aux_losses(aux):
                 y, new_states = apply_slice(layers, params, states,

@@ -26,16 +26,8 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlbench_tpu.config import RunConfig
-from ddlbench_tpu.models.layers import LayerModel, init_model, apply_model
-from ddlbench_tpu.parallel.common import (
-    cast_input,
-    cast_params,
-    correct_and_count,
-    correct_topk,
-    cross_entropy_loss,
-    sgd_init,
-    sgd_update,
-)
+from ddlbench_tpu.models.layers import LayerModel, init_model
+from ddlbench_tpu.parallel.common import sgd_init, sgd_update
 from ddlbench_tpu.parallel.single import TrainState
 
 
@@ -102,17 +94,10 @@ class _ShardedParamStrategy:
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
-            p = cast_params(ts.params, self.compute_dtype)
-            logits, _ = apply_model(
-                model, p, ts.model_state, cast_input(x, self.compute_dtype), False
-            )
-            correct, count = correct_and_count(logits, y)
-            return {
-                "loss": cross_entropy_loss(logits, y),
-                "correct": correct,
-                "correct5": correct_topk(logits, y),
-                "count": count,
-            }
+            from ddlbench_tpu.parallel.common import eval_metrics
+
+            return eval_metrics(model, cfg, ts.params, ts.model_state, x, y,
+                                self.compute_dtype)
 
         self.train_step = jax.jit(
             train_step,

@@ -63,11 +63,17 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
         )
 
     base_lr = cfg.resolved_lr()
+    # The gradual warmup ramps away exactly the world-scaling factor
+    # (imagenet_horovod.py:258-275), so it only does something where that
+    # scaling is applied — warmup_world stays 1 elsewhere and
+    # gradual_warmup_lr is then the identity.
+    warmup_world = 1
     if cfg.strategy == "dp" and cfg.scale_lr_by_world:
         # Horovod parity: lr scaled by world size (mnist_horovod.py:226) and
         # by the accumulation count (lr * batches_per_allreduce * hvd.size(),
         # imagenet_horovod.py:131).
         base_lr = base_lr * strategy.world_size * cfg.grad_accum_steps
+        warmup_world = strategy.world_size
 
     # Warmup: trigger compilation outside the timed region (first XLA compile is
     # tens of seconds; the reference's closest analog is cudnn.benchmark=True,
@@ -159,7 +165,14 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
                 if path:
                     print(f"activations logged: {path}", flush=True)
             x, y = strategy.shard_batch(bx, by)
-            ts, metrics = strategy.train_step(ts, x, y, jnp.float32(lr))
+            step_lr = lr
+            if cfg.warmup_epochs and epoch - 1 < cfg.warmup_epochs:
+                from ddlbench_tpu.parallel.common import gradual_warmup_lr
+
+                step_lr = gradual_warmup_lr(
+                    lr, warmup_world, epoch - 1, step, steps,
+                    cfg.warmup_epochs)
+            ts, metrics = strategy.train_step(ts, x, y, jnp.float32(step_lr))
             interval_samples += global_batch
             # With the watchdog armed, sync every step so the deadline really
             # is per-step (a small pipelining cost, only when opted in);

@@ -74,6 +74,42 @@ def test_sp_fused_matches_unfused(devices):
     assert abs(results[0][1] - results[1][1]) < 1e-4
 
 
+def test_eval_fused_matches_unfused(devices):
+    """Eval metrics (loss, top-1, top-5, count) identical with and without
+    the fused eval path, across single, sp and gpipe."""
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+    from ddlbench_tpu.parallel.sp import SPStrategy
+
+    model = tiny_transformer()
+    x, y = _batch(B=8)
+
+    def metrics_for(make):
+        out = []
+        for fused in (True, False):
+            strat = make(fused)
+            ts = strat.init(jax.random.key(0))
+            ev = strat.eval_step(ts, *strat.shard_batch(x, y))
+            out.append({k: float(ev[k]) for k in
+                        ("loss", "correct", "correct5", "count")})
+        return out
+
+    makers = [
+        lambda fused: SingleStrategy(model, _cfg(fused_head_loss=fused)),
+        lambda fused: SPStrategy(
+            model, _cfg(strategy="sp", num_devices=4, fused_head_loss=fused),
+            devices=devices[:4]),
+        lambda fused: GPipeStrategy(
+            model, _cfg(strategy="gpipe", num_devices=4, num_stages=4,
+                        micro_batch_size=2, num_microbatches=4,
+                        fused_head_loss=fused), devices=devices[:4]),
+    ]
+    for make in makers:
+        a, b = metrics_for(make)
+        assert abs(a["loss"] - b["loss"]) < 1e-4, (a, b)
+        for key in ("correct", "correct5", "count"):
+            assert a[key] == b[key], (key, a, b)
+
+
 @pytest.mark.parametrize("strat_name", ["gpipe", "pipedream"])
 def test_pipeline_fused_matches_unfused(devices, strat_name):
     from ddlbench_tpu.parallel.gpipe import GPipeStrategy

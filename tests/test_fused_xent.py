@@ -142,6 +142,31 @@ def test_pallas_multiblock_v():
         fx.V_BLOCK, fx.ROW_BLOCK = old
 
 
+def test_eval_fusion_matches_reference():
+    from ddlbench_tpu.ops.fused_xent import fused_linear_xent_eval
+    from ddlbench_tpu.parallel.common import correct_topk
+
+    k = jax.random.key(5)
+    kh, kw, kl = jax.random.split(k, 3)
+    n, D, V = 37, 12, 50
+    h = jax.random.normal(kh, (n, D), jnp.float32)
+    w = jax.random.normal(kw, (D, V), jnp.float32) * 0.3
+    labels = jax.random.randint(kl, (n,), 0, V).at[::6].set(-1)
+
+    ce_s, corr, corr5, cnt = fused_linear_xent_eval(h, w, labels, 5, 8)
+    obj_r, ce_r, corr_r = _ref(h, w, labels, 0.0)
+    logits = h @ w
+    np.testing.assert_allclose(ce_s, ce_r, rtol=1e-5)
+    assert int(corr) == int(corr_r)
+    assert int(corr5) == int(correct_topk(logits, labels, 5))
+    assert int(cnt) == int(jnp.sum(labels >= 0))
+
+    # degenerate constant logits: tie order must match correct_topk
+    wz = jnp.zeros((D, V), jnp.float32)
+    _, _, corr5z, _ = fused_linear_xent_eval(h, wz, labels, 5, 8)
+    assert int(corr5z) == int(correct_topk(h @ wz, labels, 5))
+
+
 def test_all_masked_rows():
     h = jnp.ones((8, 4), jnp.float32)
     w = jnp.ones((4, 10), jnp.float32)

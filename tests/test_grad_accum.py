@@ -55,3 +55,21 @@ def test_accum_validation_and_batch():
                   grad_accum_steps=2).validate()
     with pytest.raises(ValueError, match=">= 1"):
         RunConfig(grad_accum_steps=0).validate()
+
+
+def test_gradual_warmup_lr():
+    from ddlbench_tpu.parallel.common import gradual_warmup_lr
+
+    world, warm, spe = 8, 5, 100
+    scaled = 0.1 * world
+    # first batch of epoch 0: lr ~ base_lr
+    lr0 = gradual_warmup_lr(scaled, world, 0, 0, spe, warm)
+    assert abs(lr0 - 0.1 * (1 + (world - 1) / (warm * spe))) < 1e-9
+    # monotone ramp within and across warmup epochs
+    assert gradual_warmup_lr(scaled, world, 2, 50, spe, warm) > lr0
+    # end of warmup: full scaled lr
+    end = gradual_warmup_lr(scaled, world, warm - 1, spe - 1, spe, warm)
+    assert abs(end - scaled) < 1e-9
+    # past warmup / single device: untouched
+    assert gradual_warmup_lr(scaled, world, warm, 0, spe, warm) == scaled
+    assert gradual_warmup_lr(0.1, 1, 0, 0, spe, warm) == 0.1
