@@ -56,6 +56,14 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
             f"bottleneck {plan.pipeline_time_ms:.3f} ms",
             flush=True,
         )
+        if cfg.strategy == "gpipe":
+            from ddlbench_tpu.partition.schedule import recommend_virtual_stages
+
+            _, chunks = cfg.resolved_batches()
+            table = recommend_virtual_stages(
+                cfg.resolved_stages(), chunks, len(model.layers))
+            print(f"schedule advisor (S={cfg.resolved_stages()}, M={chunks}): "
+                  f"{table}", flush=True)
     if cfg.strategy == "single":
         from ddlbench_tpu.parallel.single import SingleStrategy
 

@@ -1,0 +1,50 @@
+"""Pipeline-schedule math: bubble fractions and virtual-stage advice.
+
+The synchronous pipeline (parallel/gpipe.py) runs T = M*V + S - 1 chunk-ticks
+per device for M*V useful ones, so the idle (bubble) fraction is
+(S-1)/(M*V + S-1); interleaving (V chunks per device, cfg.virtual_stages)
+divides the fill/drain cost by V at the price of (S*V - 1) ring rotations per
+microbatch instead of S - 1. These helpers quantify that tradeoff so
+--auto-partition can report it alongside the stage bounds.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+
+def pipeline_bubble_fraction(num_stages: int, num_microbatches: int,
+                             virtual_stages: int = 1) -> float:
+    """Idle fraction of the synchronous (fill-drain) schedule."""
+    S, M, V = num_stages, num_microbatches, virtual_stages
+    if S <= 1:
+        return 0.0
+    return (S - 1) / (M * V + S - 1)
+
+
+def recommend_virtual_stages(num_stages: int, num_microbatches: int,
+                             num_layers: int,
+                             candidates: Tuple[int, ...] = (1, 2, 3, 4, 6, 8),
+                             ) -> List[dict]:
+    """Feasible interleaving factors with their bubble fractions, best first.
+
+    Feasibility: V=1 always; V>1 needs num_microbatches % num_stages == 0
+    (the interleaved timetable groups microbatches in rounds of S) and
+    enough layers for S*V chunks. Rows carry the transfer count per
+    microbatch so callers can weigh bubble savings against rotation cost
+    (the bubble always shrinks with V; communication always grows).
+    """
+    S, M = num_stages, num_microbatches
+    rows = []
+    for v in candidates:
+        if v > 1 and (M % S or S * v > num_layers or S <= 1):
+            continue
+        if v == 1 and S * v > num_layers:
+            continue
+        rows.append({
+            "virtual_stages": v,
+            "bubble": round(pipeline_bubble_fraction(S, M, v), 4),
+            "transfers_per_microbatch": max(0, S * v - 1),
+        })
+    rows.sort(key=lambda r: (r["bubble"], r["virtual_stages"]))
+    return rows

@@ -1,0 +1,123 @@
+"""LM-workload single-chip microbenchmark: tokens/sec across optimization
+knobs.
+
+Sweeps the training step of a token workload over the framework's two kernel
+knobs — attention backend (Pallas flash vs XLA) and the fused LM-head loss
+(ops/fused_xent.py) vs full-logits — so the kernel wins can be quantified on
+real hardware in one command. The CNN analog is bench.py (the headline
+driver-recorded number); this is the transformer-side companion used for
+PERF.md measurements.
+
+Each configuration prints one JSON line:
+
+    {"config": "flash+fused", "tokens_per_sec": N, "ms_per_step": N, ...}
+
+Sync discipline follows bench.py: chain the train state through all steps and
+sync via float(metric) (device transfer), which is reliable on the axon TPU
+tunnel where block_until_ready can return early.
+
+Usage:
+    python -m ddlbench_tpu.tools.lmbench [-m transformer_s] [-b synthtext]
+        [--batch-size 16] [--steps 20] [--dtype bfloat16] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", default="transformer_s")
+    p.add_argument("-b", "--benchmark", default="synthtext")
+    p.add_argument("--batch-size", type=int, default=None)
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--warmup", type=int, default=3)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--label-smoothing", type=float, default=None)
+    p.add_argument("--configs", default=None,
+                   help="comma list among flash+fused,flash+logits,"
+                        "xla+fused,xla+logits (default: all)")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    import jax
+    import jax.numpy as jnp
+
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.data.synthetic import make_synthetic
+    from ddlbench_tpu.models.transformer import set_attention_backend
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    all_configs = {
+        "flash+fused": ("flash", True),
+        "flash+logits": ("flash", False),
+        "xla+fused": ("xla", True),
+        "xla+logits": ("xla", False),
+    }
+    on_tpu = jax.default_backend() in ("tpu", "axon")
+    if args.configs:
+        names = [c.strip() for c in args.configs.split(",") if c.strip()]
+        unknown = [c for c in names if c not in all_configs]
+        if unknown:
+            p.error(f"unknown --configs {unknown}; choose from "
+                    f"{sorted(all_configs)}")
+    else:
+        # flash off-TPU means interpret mode (minutes per step) — skip it
+        names = list(all_configs) if on_tpu else ["xla+fused", "xla+logits"]
+
+    for name in names:
+        attn, fused = all_configs[name]
+        cfg = RunConfig(
+            benchmark=args.benchmark,
+            strategy="single",
+            arch=args.model,
+            batch_size=args.batch_size,
+            compute_dtype=args.dtype,
+            attention_backend=attn,
+            fused_head_loss=fused,
+            label_smoothing=args.label_smoothing,
+            steps_per_epoch=args.steps,
+        )
+        strategy = make_strategy(cfg)
+        spec = cfg.dataset()
+        B = cfg.global_batch()
+        data = make_synthetic(spec, B, steps_per_epoch=args.steps)
+        ts = strategy.init(jax.random.key(cfg.seed))
+        lr = jnp.float32(cfg.resolved_lr())
+
+        x, y = data.batch(0, 0)
+        for _ in range(max(1, args.warmup)):  # >=1: compile outside the timing
+            ts, m = strategy.train_step(ts, x, y, lr)
+        float(m["loss"])
+
+        t0 = time.perf_counter()
+        for step in range(args.steps):
+            x, y = data.batch(1, step)
+            ts, m = strategy.train_step(ts, x, y, lr)
+        float(m["loss"])  # ts chain + transfer = full sync
+        dt = time.perf_counter() - t0
+
+        tokens = args.steps * B * spec.seq_len
+        print(json.dumps({
+            "config": name,
+            "model": args.model,
+            "benchmark": args.benchmark,
+            "batch": B,
+            "seq_len": spec.seq_len,
+            "tokens_per_sec": round(tokens / dt, 1),
+            "ms_per_step": round(1000 * dt / args.steps, 2),
+        }), flush=True)
+        # reset the backend override for the next config
+        set_attention_backend("auto")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

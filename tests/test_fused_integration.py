@@ -93,6 +93,9 @@ def test_eval_fused_matches_unfused(devices):
                         ("loss", "correct", "correct5", "count")})
         return out
 
+    from ddlbench_tpu.parallel.dp import DPStrategy, make_data_mesh
+    from ddlbench_tpu.parallel.sharded import FSDPStrategy, TPStrategy
+
     makers = [
         lambda fused: SingleStrategy(model, _cfg(fused_head_loss=fused)),
         lambda fused: SPStrategy(
@@ -101,6 +104,16 @@ def test_eval_fused_matches_unfused(devices):
         lambda fused: GPipeStrategy(
             model, _cfg(strategy="gpipe", num_devices=4, num_stages=4,
                         micro_batch_size=2, num_microbatches=4,
+                        fused_head_loss=fused), devices=devices[:4]),
+        lambda fused: DPStrategy(
+            model, _cfg(strategy="dp", num_devices=4, batch_size=2,
+                        fused_head_loss=fused),
+            mesh=make_data_mesh(4, devices[:4])),
+        lambda fused: TPStrategy(
+            model, _cfg(strategy="tp", num_devices=4, batch_size=8,
+                        fused_head_loss=fused), devices=devices[:4]),
+        lambda fused: FSDPStrategy(
+            model, _cfg(strategy="fsdp", num_devices=4, batch_size=2,
                         fused_head_loss=fused), devices=devices[:4]),
     ]
     for make in makers:

@@ -249,3 +249,22 @@ def test_to_dot_and_plots(tmp_path):
     g.plot_bars(str(tmp_path / "bars.png"))
     assert (tmp_path / "cdf.png").stat().st_size > 0
     assert (tmp_path / "bars.png").stat().st_size > 0
+
+
+def test_schedule_advisor():
+    from ddlbench_tpu.partition.schedule import (
+        pipeline_bubble_fraction, recommend_virtual_stages)
+
+    assert pipeline_bubble_fraction(1, 8) == 0.0
+    assert math.isclose(pipeline_bubble_fraction(4, 4), 3 / 7)
+    assert math.isclose(pipeline_bubble_fraction(4, 4, 2), 3 / 11)
+    rows = recommend_virtual_stages(4, 8, num_layers=20)
+    # bubble strictly shrinks with V; best row has the largest feasible V
+    assert rows[0]["virtual_stages"] == max(r["virtual_stages"] for r in rows)
+    bubbles = [r["bubble"] for r in sorted(rows, key=lambda r: r["virtual_stages"])]
+    assert bubbles == sorted(bubbles, reverse=True)
+    # V>1 infeasible when M % S != 0 (only V=1 remains)
+    assert [r["virtual_stages"] for r in recommend_virtual_stages(4, 6, 20)] == [1]
+    # layer count caps the chunk count
+    assert all(r["virtual_stages"] * 4 <= 9
+               for r in recommend_virtual_stages(4, 8, num_layers=9))

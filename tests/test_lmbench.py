@@ -1,0 +1,29 @@
+"""LM microbenchmark tool: runs end-to-end on CPU and reports both configs."""
+
+import json
+
+from tiny_models import TINY_LM  # registers transformer_t
+
+
+def test_lmbench_runs(capsys):
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools.lmbench import main
+
+    # register a tiny benchmark spec so the sweep is CPU-fast (mutate the
+    # shared dicts in place — other modules hold references to them)
+    config.DATASETS["tinylm"] = TINY_LM
+    config.DEFAULT_BATCH["single"]["tinylm"] = 2
+    try:
+        rc = main(["-m", "transformer_t", "-b", "tinylm", "--steps", "2",
+                   "--warmup", "1", "--dtype", "float32"])
+    finally:
+        del config.DATASETS["tinylm"]
+        del config.DEFAULT_BATCH["single"]["tinylm"]
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    configs = {l["config"] for l in lines}
+    assert configs == {"xla+fused", "xla+logits"}  # flash skipped off-TPU
+    for l in lines:
+        assert l["tokens_per_sec"] > 0 and l["ms_per_step"] > 0
+        assert l["seq_len"] == TINY_LM.seq_len
