@@ -37,6 +37,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-s", "--real-data", action="store_true",
                    help="use on-disk data via the native loader (reference -s flag, inverted)")
     p.add_argument("--data-dir", default=None, help="on-disk dataset root (-s mode)")
+    p.add_argument("--no-augment", action="store_true",
+                   help="disable train-time augmentation in -s mode "
+                        "(crop/flip per the reference transforms)")
     p.add_argument("-e", "--epochs", type=int, default=3)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--micro-batch-size", type=int, default=None)
@@ -107,6 +110,7 @@ def config_from_args(args) -> RunConfig:
         num_devices=args.devices,
         synthetic=not args.real_data,
         data_dir=args.data_dir,
+        augment=not args.no_augment,
         epochs=args.epochs,
         log_interval=args.log_interval,
         batch_size=args.batch_size,

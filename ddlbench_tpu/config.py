@@ -155,6 +155,9 @@ class RunConfig:
     num_hosts: int = 1
     synthetic: bool = True
     data_dir: Optional[str] = None
+    # Train-time augmentation for the on-disk (-s) image path, mirroring the
+    # reference drivers' torchvision transforms (see data/ondisk.py).
+    augment: bool = True
 
     # Training protocol (reference: EPOCHS=3, LOGINTER=25;
     # run_template.sh:71, run.sh:6).

@@ -2,8 +2,20 @@
 
 Backs the real-data path (CLI ``-s``): raw uint8 batches come from the native
 prefetching loader (data/native_loader.py), are uploaded to device, and are
-normalized inside jit — the reference's transforms.Normalize equivalent
-(benchmark/mnist/mnist_pytorch.py:172-216) without a JPEG decode.
+normalized — and, for training image batches, augmented — inside jit. The
+per-dataset train transforms mirror the reference drivers:
+
+* mnist: normalize only (mnist_pytorch.py:176-178)
+* cifar10: RandomCrop(32, padding=4) + RandomHorizontalFlip
+  (cifar10_pytorch.py:164-168)
+* imagenet/highres: RandomHorizontalFlip (imagenet_pytorch.py:73-74).
+  Documented deviation: the reference's RandomResizedCrop re-scales from
+  larger source photos; the on-disk store holds target-size images, so the
+  scale-jitter part has no source pixels to act on (and per-sample resize is
+  XLA-hostile anyway) — the flip is the remaining stochastic transform.
+
+Augmentation runs on device as one jitted map (pad + per-sample
+dynamic_slice gather + flip), deterministic per (seed, epoch, step).
 """
 
 from __future__ import annotations
@@ -20,6 +32,13 @@ import numpy as np
 from ddlbench_tpu.config import DatasetSpec
 from ddlbench_tpu.data.native_loader import NativeDataLoader, generate_dataset
 
+# dataset -> train-time augmentation policy (see module docstring)
+_AUGMENT = {
+    "cifar10": dict(pad=4, flip=True),
+    "imagenet": dict(pad=0, flip=True),
+    "highres": dict(pad=0, flip=True),
+}
+
 
 @functools.partial(jax.jit, static_argnums=(2,))
 def _normalize(imgs_u8, labels, dtype_name: str):
@@ -28,15 +47,37 @@ def _normalize(imgs_u8, labels, dtype_name: str):
     return x.astype(jnp.dtype(dtype_name)), labels
 
 
+@functools.partial(jax.jit, static_argnums=(2, 3))
+def _augment_u8(imgs, key, pad: int, flip: bool):
+    """Random pad-crop + horizontal flip on a uint8 batch [B, H, W, C]."""
+    B, H, W, C = imgs.shape
+    kc, kf = jax.random.split(key)
+    if pad:
+        padded = jnp.pad(imgs, ((0, 0), (pad, pad), (pad, pad), (0, 0)))
+        offs = jax.random.randint(kc, (B, 2), 0, 2 * pad + 1)
+
+        def crop(img, off):
+            return jax.lax.dynamic_slice(img, (off[0], off[1], 0), (H, W, C))
+
+        imgs = jax.vmap(crop)(padded, offs)
+    if flip:
+        m = jax.random.bernoulli(kf, 0.5, (B,))
+        imgs = jnp.where(m[:, None, None, None], imgs[:, :, ::-1, :], imgs)
+    return imgs
+
+
 class OnDiskData:
     """Mirrors SyntheticData's interface over generated raw datasets."""
 
     def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
                  seed: int = 1, dtype=jnp.float32,
-                 train_count: int | None = None, test_count: int | None = None):
+                 train_count: int | None = None, test_count: int | None = None,
+                 augment: bool = True):
         self.spec = spec
         self.batch_size = batch_size
         self.dtype_name = str(jnp.dtype(dtype))
+        self.seed = seed
+        self.augment_policy = _AUGMENT.get(spec.name) if augment else None
         self._loaders = {}
         if spec.kind in ("tokens", "seq2seq"):
             want_hwc = (spec.seq_len + 1, 4, 1)
@@ -80,7 +121,14 @@ class OnDiskData:
 
                 labels = mask_source_labels(labels, self.spec.src_len)
             return ids[:, :-1], labels
-        return _normalize(jnp.asarray(imgs), jnp.asarray(labels), self.dtype_name)
+        imgs = jnp.asarray(imgs)
+        if train and self.augment_policy:
+            steps = self.steps_per_epoch(train=True)
+            key = jax.random.fold_in(jax.random.key(self.seed),
+                                     epoch * steps + step)
+            imgs = _augment_u8(imgs, key, self.augment_policy["pad"],
+                               self.augment_policy["flip"])
+        return _normalize(imgs, jnp.asarray(labels), self.dtype_name)
 
     def close(self) -> None:
         for l in self._loaders.values():

@@ -30,6 +30,13 @@ from jax.sharding import Mesh
 _initialized = False
 
 
+def is_tpu_backend() -> bool:
+    """True when the default jax backend is real TPU hardware ('tpu', or
+    'axon' — the tunneled-TPU platform). The single home for this check:
+    kernel dispatch (flash attention, fused xent) and tools key off it."""
+    return jax.default_backend() in ("tpu", "axon")
+
+
 def add_platform_arg(parser) -> None:
     """Attach the shared --platform flag (one help string for every entry
     point; see apply_platform)."""

@@ -115,10 +115,12 @@ def set_attention_backend(backend: str) -> None:
 
 def _flash_dispatch():
     """Return (use_flash, interpret) for the current backend setting."""
+    from ddlbench_tpu.distributed import is_tpu_backend
+
     mode = _ATTENTION_BACKEND[0]
     if mode == "xla":
         return False, False
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    on_tpu = is_tpu_backend()
     if mode == "flash":
         return True, not on_tpu
     return on_tpu, False

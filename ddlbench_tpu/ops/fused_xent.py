@@ -81,7 +81,9 @@ def _use_pallas(backend: str) -> bool:
         return False
     if backend == "pallas":
         return True
-    return jax.default_backend() in ("tpu", "axon")
+    from ddlbench_tpu.distributed import is_tpu_backend
+
+    return is_tpu_backend()
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))

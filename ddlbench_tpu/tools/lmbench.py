@@ -50,10 +50,16 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
-    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.config import DATASETS, RunConfig
     from ddlbench_tpu.data.synthetic import make_synthetic
+    from ddlbench_tpu.distributed import is_tpu_backend
     from ddlbench_tpu.models.transformer import set_attention_backend
     from ddlbench_tpu.parallel.api import make_strategy
+
+    if DATASETS[args.benchmark].kind not in ("tokens", "seq2seq"):
+        p.error(f"-b {args.benchmark} is an image benchmark; lmbench sweeps "
+                f"token workloads (pick one of "
+                f"{sorted(n for n, s in DATASETS.items() if s.kind != 'image')})")
 
     all_configs = {
         "flash+fused": ("flash", True),
@@ -61,7 +67,7 @@ def main(argv=None) -> int:
         "xla+fused": ("xla", True),
         "xla+logits": ("xla", False),
     }
-    on_tpu = jax.default_backend() in ("tpu", "axon")
+    on_tpu = is_tpu_backend()
     if args.configs:
         names = [c.strip() for c in args.configs.split(",") if c.strip()]
         unknown = [c for c in names if c not in all_configs]

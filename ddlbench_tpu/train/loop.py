@@ -60,6 +60,7 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
         data = OnDiskData(
             cfg.data_dir or "./data", spec, global_batch, seed=cfg.seed,
             train_count=train_count, test_count=test_count,
+            augment=cfg.augment,
         )
 
     base_lr = cfg.resolved_lr()
