@@ -56,10 +56,12 @@ def main(argv=None) -> int:
     from ddlbench_tpu.models.transformer import set_attention_backend
     from ddlbench_tpu.parallel.api import make_strategy
 
-    if DATASETS[args.benchmark].kind not in ("tokens", "seq2seq"):
-        p.error(f"-b {args.benchmark} is an image benchmark; lmbench sweeps "
-                f"token workloads (pick one of "
-                f"{sorted(n for n, s in DATASETS.items() if s.kind != 'image')})")
+    token_benchmarks = sorted(
+        n for n, s in DATASETS.items() if s.kind in ("tokens", "seq2seq"))
+    if (args.benchmark not in DATASETS
+            or DATASETS[args.benchmark].kind not in ("tokens", "seq2seq")):
+        p.error(f"-b {args.benchmark!r} is not a token workload; lmbench "
+                f"sweeps token workloads (pick one of {token_benchmarks})")
 
     all_configs = {
         "flash+fused": ("flash", True),
