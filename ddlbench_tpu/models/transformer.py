@@ -174,7 +174,16 @@ def ring_attention(q, k, v, axis: str, prefix_len: int = 0):
     the prefix-LM rule on ABSOLUTE key positions (the seq2seq source segment
     is globally visible), so sequence-parallel translation works even when
     the source spans multiple shards.
+
+    On TPU the causal (prefix_len == 0) path runs each visiting block through
+    the fused Pallas kernel (_ring_attention_flash) instead of the einsum
+    below; the prefix-LM path keeps the einsum (its visible-key count per
+    block is data-dependent on the shard index, which the kernel's static
+    offsets can't express).
     """
+    use_flash, interpret = _flash_dispatch()
+    if use_flash and prefix_len == 0:
+        return _ring_attention_flash(q, k, v, axis, interpret)
     n = lax.psum(1, axis)
     idx = lax.axis_index(axis)
     B, H, Tl, dh = q.shape
@@ -209,6 +218,57 @@ def ring_attention(q, k, v, axis: str, prefix_len: int = 0):
     acc0 = vary(jnp.zeros((B, H, Tl, dh), jnp.float32), (axis,))
     (k, v, m, l, acc), _ = lax.scan(step, (k, v, m0, l0, acc0), jnp.arange(n))
     return (acc / jnp.maximum(l, 1e-20)).astype(q.dtype)
+
+
+def _ring_attention_flash(q, k, v, axis: str, interpret: bool):
+    """Ring attention with the fused kernel per visiting block.
+
+    Each ring step classifies the visiting K/V block against the local shard
+    index — fully visible (src < idx), causal-diagonal (src == idx), or
+    invisible (src > idx) — so the kernel's STATIC offsets suffice: the
+    "full" case fakes q_offset=Tl to open the whole block. Partial results
+    combine exactly through their logsumexps:
+        lse' = logaddexp(lse, lse_i);  o' = e^{lse-lse'} o + e^{lse_i-lse'} o_i
+    (the associative flash combination), and the kernel's custom VJP carries
+    gradients through both o_i and lse_i, so jax.grad of the scan yields the
+    reverse ring schedule.
+    """
+    from ddlbench_tpu.ops.flash_attention import NEG_INF, flash_attention_lse
+    from ddlbench_tpu.parallel.common import vary
+
+    n = lax.psum(1, axis)
+    idx = lax.axis_index(axis)
+    B, H, Tl, dh = q.shape
+
+    def full_blk(q, kb, vb):
+        return flash_attention_lse(q, kb, vb, Tl, 0, 0, interpret=interpret)
+
+    def diag_blk(q, kb, vb):
+        return flash_attention_lse(q, kb, vb, 0, 0, 0, interpret=interpret)
+
+    def skip_blk(q, kb, vb):
+        return (vary(jnp.zeros_like(q), (axis,)),
+                vary(jnp.full((B, H, Tl), NEG_INF, jnp.float32), (axis,)))
+
+    def step(carry, i):
+        k_blk, v_blk, o, lse = carry
+        src = (idx - i) % n  # which shard's K/V we hold this round
+        case = jnp.where(src < idx, 0, jnp.where(src == idx, 1, 2))
+        o_i, lse_i = lax.switch(case, [full_blk, diag_blk, skip_blk],
+                                q, k_blk, v_blk)
+        new_lse = jnp.logaddexp(lse, lse_i)
+        safe = jnp.maximum(new_lse, NEG_INF)
+        o = (o * jnp.exp(lse - safe)[..., None]
+             + o_i.astype(jnp.float32) * jnp.exp(lse_i - safe)[..., None])
+        perm = [(j, (j + 1) % n) for j in range(n)]
+        k_blk = lax.ppermute(k_blk, axis, perm)
+        v_blk = lax.ppermute(v_blk, axis, perm)
+        return (k_blk, v_blk, o, new_lse), None
+
+    o0 = vary(jnp.zeros((B, H, Tl, dh), jnp.float32), (axis,))
+    lse0 = vary(jnp.full((B, H, Tl), NEG_INF, jnp.float32), (axis,))
+    (k, v, o, lse), _ = lax.scan(step, (k, v, o0, lse0), jnp.arange(n))
+    return o.astype(q.dtype)
 
 
 def attention_sublayer(p, x, n_heads: int, prefix_len: int = 0):

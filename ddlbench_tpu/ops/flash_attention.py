@@ -42,6 +42,19 @@ def _pick_block(t: int, preferred: int) -> int:
     return b
 
 
+def _out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct for a pallas output, carrying the union of the
+    operands' varying-axes types — required when the kernel runs inside a
+    shard_map (e.g. per-block calls from ring attention, or any strategy
+    whose model apply is shard_mapped)."""
+    vma = set()
+    for a in operands:
+        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)
+
+
 def _causal_kv_bound(q_hi_pos, k_offset: int, block_k: int, num_k: int,
                      prefix_len: int = 0):
     """Number of leading K blocks any query position <= q_hi_pos can see.
@@ -257,8 +270,8 @@ def _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tq, dh), q.dtype),
-            jax.ShapeDtypeStruct((BH, Tq, 1), jnp.float32),
+            _out_struct((BH, Tq, dh), q.dtype, q, k, v),
+            _out_struct((BH, Tq, 1), jnp.float32, q, k, v),
         ],
         interpret=interpret,
     )(qr, kr, vr)
@@ -274,6 +287,12 @@ def _flash_fwd(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
 
 def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
                res, g):
+    return _flash_bwd_core(q_offset, k_offset, prefix_len, block_q, block_k,
+                           interpret, res, g, None)
+
+
+def _flash_bwd_core(q_offset, k_offset, prefix_len, block_q, block_k,
+                    interpret, res, g, g_lse):
     q, k, v, o, lse = res
     B, H, Tq, dh = q.shape
     Tk = k.shape[2]
@@ -283,8 +302,13 @@ def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
     scale = 1.0 / math.sqrt(dh)
     BH = B * H
 
-    # delta = rowsum(dO * O) — cheap elementwise+reduce, XLA fuses it.
+    # delta = rowsum(dO * O) — cheap elementwise+reduce, XLA fuses it. The
+    # lse cotangent (flash_attention_lse) enters every ds exactly like -delta
+    # (both multiply p rowwise: ds = p∘(dp - delta + lse_bar)), so it is a
+    # delta shift and the kernels are shared.
     delta = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32), axis=-1)
+    if g_lse is not None:
+        delta = delta - g_lse.astype(jnp.float32)
     qr, kr, vr, gr = _bh(q), _bh(k), _bh(v), _bh(g)
     delta_r = delta.reshape(BH, Tq, 1)
 
@@ -304,7 +328,7 @@ def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
             pl.BlockSpec((1, bq, 1), lambda b, i: (b, i, 0)),
         ],
         out_specs=pl.BlockSpec((1, bq, dh), lambda b, i: (b, i, 0)),
-        out_shape=jax.ShapeDtypeStruct((BH, Tq, dh), q.dtype),
+        out_shape=_out_struct((BH, Tq, dh), q.dtype, qr, kr, vr, gr),
         interpret=interpret,
     )(qr, kr, vr, gr, lse, delta_r)
 
@@ -328,8 +352,8 @@ def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
             pl.BlockSpec((1, bk, dh), lambda b, j: (b, j, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((BH, Tk, dh), k.dtype),
-            jax.ShapeDtypeStruct((BH, Tk, dh), v.dtype),
+            _out_struct((BH, Tk, dh), k.dtype, qr, kr, vr, gr),
+            _out_struct((BH, Tk, dh), v.dtype, qr, kr, vr, gr),
         ],
         interpret=interpret,
     )(kr, vr, qr, gr, lse, delta_r)
@@ -339,3 +363,40 @@ def _flash_bwd(q_offset, k_offset, prefix_len, block_q, block_k, interpret,
 
 
 flash_attention.defvjp(_flash_fwd, _flash_bwd)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
+def flash_attention_lse(q, k, v, q_offset=0, k_offset=0, prefix_len=0,
+                        block_q=512, block_k=512, interpret=False):
+    """flash_attention that ALSO returns the per-row logsumexp: (o, lse) with
+    lse [B, H, Tq] f32.
+
+    This is the building block for blockwise/ring attention over a
+    distributed sequence: partial results (o_i, lse_i) against different K/V
+    blocks combine exactly as o = Σ_i exp(lse_i - lse_tot) o_i with
+    lse_tot = logaddexp_i(lse_i) (models/transformer.py ring_attention).
+    Both outputs are differentiable: d lse/d scores = p, which folds into the
+    existing backward kernels as a delta shift (ds = p∘(dp - (delta - lse_bar))),
+    so the dq/dkv kernels are reused unchanged.
+    """
+    out, _ = _flash_lse_fwd(q, k, v, q_offset, k_offset, prefix_len, block_q,
+                            block_k, interpret)
+    return out
+
+
+def _flash_lse_fwd(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
+                   interpret):
+    o, lse = _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q,
+                             block_k, interpret)
+    B, H, Tq, _ = q.shape
+    return (o, lse.reshape(B, H, Tq)), (q, k, v, o, lse)
+
+
+def _flash_lse_bwd(q_offset, k_offset, prefix_len, block_q, block_k,
+                   interpret, res, cots):
+    g_o, g_lse = cots
+    return _flash_bwd_core(q_offset, k_offset, prefix_len, block_q, block_k,
+                           interpret, res, g_o, g_lse)
+
+
+flash_attention_lse.defvjp(_flash_lse_fwd, _flash_lse_bwd)

@@ -76,6 +76,11 @@ def _row_stats(z, labels, smoothing: float):
     return nll, obj, correct, mask, lse
 
 
+# Pallas output struct carrying the operands' union VMA type (needed when
+# the kernels run inside a shard_map); one shared implementation.
+from ddlbench_tpu.ops.flash_attention import _out_struct as _pl_out
+
+
 def _use_pallas(backend: str) -> bool:
     if backend == "xla":
         return False
@@ -336,10 +341,10 @@ def _fxent_fwd_pallas(h, w, labels, smoothing: float, interpret: bool):
         ],
         out_specs=[pl.BlockSpec((br, 1), lambda i, j: (i, 0))] * 4,
         out_shape=[
-            jax.ShapeDtypeStruct((Np, 1), f32),
-            jax.ShapeDtypeStruct((Np, 1), f32),
-            jax.ShapeDtypeStruct((Np, 1), f32),
-            jax.ShapeDtypeStruct((Np, 1), jnp.int32),
+            _pl_out((Np, 1), f32, hp, w, lab2),
+            _pl_out((Np, 1), f32, hp, w, lab2),
+            _pl_out((Np, 1), f32, hp, w, lab2),
+            _pl_out((Np, 1), jnp.int32, hp, w, lab2),
         ],
         scratch_shapes=[pltpu.VMEM((br, 1), f32)] * 5
         + [pltpu.VMEM((br, 1), jnp.int32)],
@@ -451,7 +456,7 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
             pl.BlockSpec((1, 4), lambda i, j: (0, 0)),
         ],
         out_specs=pl.BlockSpec((br, D), lambda i, j: (i, 0)),
-        out_shape=jax.ShapeDtypeStruct((Np, D), h.dtype),
+        out_shape=_pl_out((Np, D), h.dtype, hp, w, lab2, lse2, coef),
         scratch_shapes=[pltpu.VMEM((br, D), f32)],
         interpret=interpret,
     )(hp, w, lab2, lse2, coef)
@@ -467,7 +472,7 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
             pl.BlockSpec((1, 4), lambda j, i: (0, 0)),
         ],
         out_specs=pl.BlockSpec((D, bv), lambda j, i: (0, j)),
-        out_shape=jax.ShapeDtypeStruct((D, V), f32),
+        out_shape=_pl_out((D, V), f32, hp, w, lab2, lse2, coef),
         scratch_shapes=[pltpu.VMEM((D, bv), f32)],
         interpret=interpret,
     )(hp, w, lab2, lse2, coef)

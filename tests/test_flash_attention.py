@@ -181,3 +181,60 @@ def test_prefix_grads_match_reference():
     for r, got in zip(ref_grads, got_grads):
         np.testing.assert_allclose(np.asarray(got), np.asarray(r),
                                    rtol=5e-5, atol=5e-5)
+
+
+def _ref_with_lse(q, k, v, q_offset=0, k_offset=0):
+    """(o, lse) from the plain jnp path, matching flash_attention_lse."""
+    import math as _math
+
+    dh = q.shape[-1]
+    s = jnp.einsum("bhqd,bhkd->bhqk", q, k) / _math.sqrt(dh)
+    q_pos = q_offset + jnp.arange(q.shape[2])[:, None]
+    k_pos = k_offset + jnp.arange(k.shape[2])[None, :]
+    s = jnp.where(q_pos >= k_pos, s, -jnp.inf)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    msafe = jnp.where(jnp.isfinite(m), m, 0.0)
+    e = jnp.exp(s - msafe)
+    z = jnp.sum(e, axis=-1, keepdims=True)
+    o = jnp.einsum("bhqk,bhkd->bhqd", e / jnp.maximum(z, 1e-20), v)
+    lse = (msafe + jnp.log(jnp.maximum(z, 1e-20)))[..., 0]
+    return o, lse
+
+
+def test_lse_output_matches_reference():
+    from ddlbench_tpu.ops.flash_attention import flash_attention_lse
+
+    B, H, T, dh = 2, 2, 64, 16
+    ks = jax.random.split(jax.random.key(7), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+    with jax.default_matmul_precision("highest"):
+        o, lse = flash_attention_lse(q, k, v, 0, 0, 0, 16, 16, True)
+        o_r, lse_r = _ref_with_lse(q, k, v)
+    np.testing.assert_allclose(np.asarray(o), np.asarray(o_r),
+                               rtol=1e-4, atol=1e-5)
+    np.testing.assert_allclose(np.asarray(lse), np.asarray(lse_r),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_lse_cotangent_flows():
+    """Gradients through BOTH outputs (the ring-combination use case)."""
+    from ddlbench_tpu.ops.flash_attention import flash_attention_lse
+
+    B, H, T, dh = 1, 2, 32, 8
+    ks = jax.random.split(jax.random.key(8), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+
+    def f_flash(q, k, v):
+        o, lse = flash_attention_lse(q, k, v, 0, 0, 0, 8, 8, True)
+        return jnp.sum(o * 0.3) + jnp.sum(jnp.sin(lse))
+
+    def f_ref(q, k, v):
+        o, lse = _ref_with_lse(q, k, v)
+        return jnp.sum(o * 0.3) + jnp.sum(jnp.sin(lse))
+
+    with jax.default_matmul_precision("highest"):
+        gf = jax.grad(f_flash, argnums=(0, 1, 2))(q, k, v)
+        gr = jax.grad(f_ref, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gr):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
