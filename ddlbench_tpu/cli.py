@@ -54,6 +54,9 @@ def build_parser() -> argparse.ArgumentParser:
                    help="gradient-accumulation micro-steps per update "
                         "(Horovod backward_passes_per_step parity)")
     p.add_argument("--lr", type=float, default=None)
+    p.add_argument("--optimizer", default=None, choices=("sgd", "adam"),
+                   help="default: adam for seq2seq benchmarks (reference "
+                        "translation parity), sgd otherwise")
     p.add_argument("--warmup-epochs", type=int, default=0,
                    help="gradual lr warmup epochs (Horovod ImageNet parity: "
                         "base lr -> base*world over this many epochs)")
@@ -122,6 +125,7 @@ def config_from_args(args) -> RunConfig:
         steps_per_epoch=args.steps_per_epoch,
         grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,
+        optimizer=args.optimizer,
         warmup_epochs=args.warmup_epochs,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,

@@ -171,6 +171,13 @@ class RunConfig:
     # Optimizer (reference defaults: mnist/cifar lr .01 momentum .5;
     # imagenet .1/.9 + wd 1e-4, step decay /10 every 30 epochs —
     # mnist_pytorch.py:153-156, imagenet_pytorch.py:44-50,225-229).
+    # None = per-workload default: "adam" for seq2seq benchmarks (the
+    # reference translation runtime trains with AdamWithWeightStashing,
+    # runtime/adam.py + translation/main_with_runtime.py:251-256), else "sgd".
+    optimizer: Optional[str] = None  # sgd | adam
+    adam_beta1: float = 0.9  # reference betas=(0.9, 0.999)
+    adam_beta2: float = 0.999
+    adam_eps: float = 1e-8
     lr: Optional[float] = None
     momentum: Optional[float] = None
     weight_decay: Optional[float] = None
@@ -254,9 +261,16 @@ class RunConfig:
     def dataset(self) -> DatasetSpec:
         return DATASETS[self.benchmark]
 
+    def resolved_optimizer(self) -> str:
+        if self.optimizer is not None:
+            return self.optimizer
+        return "adam" if self.dataset().kind == "seq2seq" else "sgd"
+
     def resolved_lr(self) -> float:
         if self.lr is not None:
             return self.lr
+        if self.resolved_optimizer() == "adam":
+            return 1e-3  # typical Adam scale (reference passes lr via flag)
         if self.dataset().kind in ("tokens", "seq2seq"):
             return 0.01
         return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
@@ -354,6 +368,8 @@ class RunConfig:
             raise ValueError("virtual_stages must be >= 1")
         if self.grad_accum_steps < 1:
             raise ValueError("grad_accum_steps must be >= 1")
+        if self.optimizer is not None and self.optimizer not in ("sgd", "adam"):
+            raise ValueError(f"unknown optimizer {self.optimizer!r}")
         if self.grad_accum_steps > 1 and self.strategy not in (
                 "single", "dp", "tp", "fsdp"):
             raise ValueError(

@@ -31,6 +31,8 @@ import jax
 import jax.numpy as jnp
 from jax.experimental import pallas as pl
 
+from ddlbench_tpu.ops.util import pallas_out_struct as _out_struct
+
 NEG_INF = -1e30
 
 
@@ -42,17 +44,6 @@ def _pick_block(t: int, preferred: int) -> int:
     return b
 
 
-def _out_struct(shape, dtype, *operands):
-    """ShapeDtypeStruct for a pallas output, carrying the union of the
-    operands' varying-axes types — required when the kernel runs inside a
-    shard_map (e.g. per-block calls from ring attention, or any strategy
-    whose model apply is shard_mapped)."""
-    vma = set()
-    for a in operands:
-        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
-    if vma:
-        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
-    return jax.ShapeDtypeStruct(shape, dtype)
 
 
 def _causal_kv_bound(q_hi_pos, k_offset: int, block_k: int, num_k: int,

@@ -36,6 +36,8 @@ import jax.numpy as jnp
 from jax import lax
 from jax.experimental import pallas as pl
 
+from ddlbench_tpu.ops.util import pallas_out_struct as _pl_out
+
 
 def _vma(x):
     """Varying-axes set of x (shard_map manual-mode type); () outside."""
@@ -76,9 +78,6 @@ def _row_stats(z, labels, smoothing: float):
     return nll, obj, correct, mask, lse
 
 
-# Pallas output struct carrying the operands' union VMA type (needed when
-# the kernels run inside a shard_map); one shared implementation.
-from ddlbench_tpu.ops.flash_attention import _out_struct as _pl_out
 
 
 def _use_pallas(backend: str) -> bool:

@@ -1,0 +1,18 @@
+"""Shared Pallas plumbing for the ops kernels."""
+
+from __future__ import annotations
+
+import jax
+
+
+def pallas_out_struct(shape, dtype, *operands):
+    """ShapeDtypeStruct for a pallas_call output, carrying the union of the
+    operands' varying-axes (VMA) types — required when a kernel runs inside a
+    shard_map (e.g. per-block calls from ring attention, or any strategy
+    whose model apply is shard_mapped)."""
+    vma = set()
+    for a in operands:
+        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+    if vma:
+        return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
+    return jax.ShapeDtypeStruct(shape, dtype)

@@ -24,7 +24,7 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_model, init_model
 from ddlbench_tpu.models.moe import collect_aux_losses
 from ddlbench_tpu.parallel.common import (cast_params, correct_topk,
-                                          sgd_init, sgd_update)
+                                          make_optimizer)
 from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.single import TrainState
 
@@ -62,8 +62,7 @@ class AxisShardedStrategy:
             raise ValueError(f"need {cfg.num_devices} devices, have {len(devs)}")
         self.mesh = mesh or Mesh(np.array(devs), axis_names=(self.axis_name,))
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
+        self._opt_init, opt_update = make_optimizer(cfg)
         aux_w = cfg.moe_aux_weight
         n = self.mesh.devices.size
         axis = self.axis_name
@@ -154,7 +153,7 @@ class AxisShardedStrategy:
             (_, (ce, correct, count, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            params, opt = opt_update(ts.params, grads, ts.opt, lr)
             metrics = {
                 "loss": ce,  # headline metric stays comparable across strategies
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, count),
@@ -209,7 +208,7 @@ class AxisShardedStrategy:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, sgd_init(params))
+        ts = TrainState(params, state, self._opt_init(params))
         return put_global_tree(ts, self._initial_state_sharding(ts))
 
     def shard_batch(self, x, y):

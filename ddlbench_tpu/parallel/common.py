@@ -135,33 +135,88 @@ def loss_and_grads(model, cfg, params, model_state, x, y, compute_dtype,
     return ce, stats, new_state, grads
 
 
-class SGDState(NamedTuple):
-    momentum: Any  # pytree matching params
+def make_optimizer(cfg):
+    """(init, update) for cfg.resolved_optimizer(), torch semantics.
+
+    * "sgd": torch.optim.SGD — buf = mu*buf + (grad + wd*p); p -= lr*buf
+      (the reference's image drivers, mnist_pytorch.py:153-156).
+    * "adam": torch.optim.Adam — the reference's translation runtime trains
+      with AdamWithWeightStashing (runtime/adam.py,
+      translation/main_with_runtime.py:251-256); weight decay is the L2 form
+      (added to the gradient), betas/eps from cfg.
+
+    State is a dict pytree ({"m"} or {"m", "v", "step"}) whose m/v leaves
+    mirror params — so the same update serves per-layer pytrees AND the
+    pipeline strategies' packed row vectors. ``init(params, step_like=None)``
+    lets pipelines shape the step counter per stage row (e.g. [S, 1]) so
+    every optimizer-state leaf shares the params' stage sharding; the update
+    broadcasts it.
+    """
+    name = cfg.resolved_optimizer()
+    mom = cfg.resolved_momentum()
+    wd = cfg.resolved_weight_decay()
+    b1, b2, eps = cfg.adam_beta1, cfg.adam_beta2, cfg.adam_eps
+
+    zeros = lambda params: jax.tree.map(jnp.zeros_like, params)
+
+    if name == "sgd":
+
+        def init(params, step_like=None):
+            return {"m": zeros(params)}
+
+        def update(params, grads, state, lr):
+            def upd(p, g, m):
+                g = g.astype(p.dtype)
+                if wd:
+                    g = g + wd * p
+                m2 = mom * m + g
+                return p - lr * m2, m2
+
+            out = jax.tree.map(upd, params, grads, state["m"])
+            new_p = jax.tree.map(lambda o: o[0], out,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+            new_m = jax.tree.map(lambda o: o[1], out,
+                                 is_leaf=lambda x: isinstance(x, tuple))
+            return new_p, {"m": new_m}
+
+        return init, update
+
+    def init(params, step_like=None):
+        step = (jnp.zeros((), jnp.int32) if step_like is None
+                else jnp.zeros(step_like, jnp.int32))
+        return {"m": zeros(params), "v": zeros(params), "step": step}
+
+    def update(params, grads, state, lr):
+        step = state["step"] + 1
+        stepf = step.astype(jnp.float32)
+        bc1 = 1.0 - b1 ** stepf
+        bc2 = 1.0 - b2 ** stepf
+
+        def upd(p, g, m, v):
+            g = g.astype(jnp.float32)
+            if wd:
+                g = g + wd * p
+            m2 = b1 * m + (1.0 - b1) * g
+            v2 = b2 * v + (1.0 - b2) * jnp.square(g)
+            denom = jnp.sqrt(v2) / jnp.sqrt(bc2) + eps
+            return p - (lr / bc1) * m2 / denom, m2, v2
+
+        out = jax.tree.map(upd, params, grads, state["m"], state["v"])
+        pick = lambda i: jax.tree.map(lambda o: o[i], out,
+                                      is_leaf=lambda x: isinstance(x, tuple))
+        return pick(0), {"m": pick(1), "v": pick(2), "step": step}
+
+    return init, update
 
 
-def sgd_init(params) -> SGDState:
-    return SGDState(momentum=jax.tree.map(jnp.zeros_like, params))
-
-
-def sgd_update(params, grads, opt_state: SGDState, lr, momentum: float,
-               weight_decay: float):
-    """torch.optim.SGD semantics: buf = mu*buf + (grad + wd*p); p -= lr*buf."""
-
-    def upd(p, g, m):
-        g = g.astype(p.dtype)
-        if weight_decay:
-            g = g + weight_decay * p
-        m2 = momentum * m + g
-        return p - lr * m2, m2
-
-    flat_p = jax.tree.leaves(params)
-    flat_g = jax.tree.leaves(grads)
-    flat_m = jax.tree.leaves(opt_state.momentum)
-    out = [upd(p, g, m) for p, g, m in zip(flat_p, flat_g, flat_m)]
-    treedef = jax.tree.structure(params)
-    new_p = jax.tree.unflatten(treedef, [o[0] for o in out])
-    new_m = jax.tree.unflatten(treedef, [o[1] for o in out])
-    return new_p, SGDState(momentum=new_m)
+def opt_state_sharding(cfg, param_sharding, scalar_sharding):
+    """Sharding pytree matching make_optimizer's state: m/v follow the
+    params' sharding (which may itself be a pytree), step is scalar-like."""
+    sh = {"m": param_sharding}
+    if cfg.resolved_optimizer() == "adam":
+        sh["v"] = param_sharding
+        sh["step"] = scalar_sharding
+    return sh
 
 
 def step_decay_lr(base_lr: float, epoch, step_epochs: int, gamma: float):

@@ -30,7 +30,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, init_model
-from ddlbench_tpu.parallel.common import sgd_init, sgd_update
+from ddlbench_tpu.parallel.common import make_optimizer
 from ddlbench_tpu.parallel.single import TrainState
 
 
@@ -49,8 +49,7 @@ class DPStrategy:
         self.cfg = cfg
         self.mesh = mesh or make_data_mesh(cfg.num_devices)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
+        self._opt_init, opt_update = make_optimizer(cfg)
         smooth = cfg.resolved_label_smoothing()
 
         self._replicated = NamedSharding(self.mesh, P())
@@ -66,7 +65,7 @@ class DPStrategy:
             ce, (correct, valid), new_state, grads = loss_and_grads(
                 model, cfg, ts.params, ts.model_state, x, y,
                 self.compute_dtype, smooth)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            params, opt = opt_update(ts.params, grads, ts.opt, lr)
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
@@ -95,7 +94,7 @@ class DPStrategy:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, sgd_init(params))
+        ts = TrainState(params, state, self._opt_init(params))
         # Broadcast-init parity (mnist_horovod.py:230-231): replicate to the
         # mesh — identical on every host since init is seed-deterministic.
         return put_global_tree(ts, self._replicated)

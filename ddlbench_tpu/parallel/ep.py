@@ -33,7 +33,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from ddlbench_tpu.models.layers import init_model
 from ddlbench_tpu.models.moe import expert_parallel
 from ddlbench_tpu.parallel.axis_sharded import AxisShardedStrategy
-from ddlbench_tpu.parallel.common import SGDState
+from ddlbench_tpu.parallel.common import opt_state_sharding
 from ddlbench_tpu.parallel.single import TrainState
 
 
@@ -99,5 +99,6 @@ class EPStrategy(AxisShardedStrategy):
         return TrainState(
             params=param_sh,
             model_state=self._replicated,
-            opt=SGDState(momentum=param_sh),
+            opt=opt_state_sharding(self.cfg, param_sh,
+                                   self._replicated),
         )

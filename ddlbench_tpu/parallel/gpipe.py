@@ -71,7 +71,10 @@ class PipeTrainState(NamedTuple):
     #   V>1: [V, S, L] f32, P(None, 'stage', None) (row [v, s] = chunk v*S+s)
     params: jax.Array
     model_state: jax.Array  # [S, Ls] / [V, S, Ls], same sharding as params
-    momentum: jax.Array  # [S, L] / [V, S, L], same sharding as params
+    # optimizer-state dict pytree (common.make_optimizer): m/v leaves mirror
+    # params; the adam step counter is shaped [..., 1] per stage row so every
+    # leaf shares the params' stage sharding
+    opt: Any
 
 
 def make_pipe_mesh(num_stages: int, dp_replicas: int,
@@ -110,8 +113,9 @@ class GPipeStrategy:
         self.mb, self.num_microbatches = cfg.resolved_batches()
         self._stage_bounds_override = stage_bounds
         self._built = False
-        self._mom = cfg.resolved_momentum()
-        self._wd = cfg.resolved_weight_decay()
+        from ddlbench_tpu.parallel.common import make_optimizer
+
+        self._opt_init, self._opt_update = make_optimizer(cfg)
 
     # -- initialization ----------------------------------------------------
 
@@ -161,8 +165,11 @@ class GPipeStrategy:
         sharding = NamedSharding(self.mesh, self._chunk_sharding_spec())
         params_mat = put_global_batch(params_mat, sharding)
         state_mat = put_global_batch(state_mat, sharding)
-        momentum = jnp.zeros_like(params_mat)
-        return PipeTrainState(params_mat, state_mat, momentum)
+        opt = self._opt_init(params_mat,
+                             step_like=params_mat.shape[:-1] + (1,))
+        if "step" in opt:
+            opt = {**opt, "step": put_global_batch(opt["step"], sharding)}
+        return PipeTrainState(params_mat, state_mat, opt)
 
     # -- stage branch construction ----------------------------------------
 
@@ -405,7 +412,6 @@ class GPipeStrategy:
 
     def _make_train_step(self):
         pipe_train = self._make_pipe_fn(train=True)
-        mom, wd = self._mom, self._wd
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
             def loss_fn(params_mat):
@@ -416,9 +422,7 @@ class GPipeStrategy:
             (_, (ce, new_state, correct)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
-            g = grads + wd * ts.params if wd else grads
-            momentum = mom * ts.momentum + g
-            params = ts.params - lr * momentum
+            params, opt = self._opt_update(ts.params, grads, ts.opt, lr)
             # valid label positions (samples, or unmasked tokens for LM /
             # seq2seq workloads)
             valid = jnp.sum((ys >= 0).astype(jnp.float32))
@@ -426,7 +430,7 @@ class GPipeStrategy:
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
             }
-            return PipeTrainState(params, new_state, momentum), metrics
+            return PipeTrainState(params, new_state, opt), metrics
 
         return jax.jit(
             train_step,

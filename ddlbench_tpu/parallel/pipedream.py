@@ -59,7 +59,8 @@ from ddlbench_tpu.parallel.packing import pad_vec
 class PDTrainState(NamedTuple):
     params: jax.Array  # [S, L] newest weights per stage
     model_state: jax.Array  # [S, Ls] BN running stats
-    momentum: jax.Array  # [S, L]
+    # optimizer-state dict pytree (common.make_optimizer), leaves [S, X]
+    opt: Any
 
 
 def fwd_mb_at(s: int, S: int, M: int, h):
@@ -105,7 +106,7 @@ class PipeDreamStrategy(GPipeStrategy):
 
     def init(self, key) -> PDTrainState:
         ts = super().init(key)
-        return PDTrainState(ts.params, ts.model_state, ts.momentum)
+        return PDTrainState(ts.params, ts.model_state, ts.opt)
 
     def _make_stage_fwd(self, s: int):
         """Pure stage forward:
@@ -181,7 +182,7 @@ class PipeDreamStrategy(GPipeStrategy):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb
         H = 2 * M + 2 * S - 2
         NSLOT = min(S, M)
-        mom, wd = self._mom, self._wd
+        opt_update = self._opt_update
         smooth = self.cfg.resolved_label_smoothing()
         aux_w = self.cfg.moe_aux_weight
         mesh = self.mesh
@@ -213,7 +214,7 @@ class PipeDreamStrategy(GPipeStrategy):
                 return buf[:in_size].reshape(mb, *in_shape)
 
             def branch(carry, xs, ys, h, lr):
-                (params, momentum, st_row, stash_p, stash_x,
+                (params, opt_row, st_row, stash_p, stash_x,
                  fwd_q, g_buf, loss_acc, corr_acc) = carry
 
                 f, valid_f = fwd_mb_at(s, S, M, h)
@@ -277,7 +278,7 @@ class PipeDreamStrategy(GPipeStrategy):
 
                 # ---- backward path (stashed weights + stashed input) ----
                 def do_bwd(op):
-                    params, momentum, st_row, stash_p, stash_x, g_buf = op
+                    params, opt_row, st_row, stash_p, stash_x, g_buf = op
                     slot = b % NSLOT
                     p_st = lax.dynamic_index_in_dim(stash_p, slot, keepdims=False)
                     if s == 0:
@@ -335,17 +336,14 @@ class PipeDreamStrategy(GPipeStrategy):
                     gp = lax.psum(gp, "data")
                     gx_out = (jnp.zeros((A,), cdtype) if gx is None
                               else pad_vec(gx.astype(cdtype), A))
-                    g = gp.astype(jnp.float32)
-                    if wd:
-                        g = g + wd * params
-                    momentum = mom * momentum + g
-                    params = params - lr * momentum
-                    return jax.tree.map(_vary, (params, momentum, gx_out))
+                    params, opt_row = opt_update(
+                        params, gp.astype(jnp.float32), opt_row, lr)
+                    return jax.tree.map(_vary, (params, opt_row, gx_out))
 
                 def skip_bwd(op):
-                    params, momentum, st_row, stash_p, stash_x, g_buf = op
+                    params, opt_row, st_row, stash_p, stash_x, g_buf = op
                     return jax.tree.map(
-                        _vary, (params, momentum, jnp.zeros((A,), cdtype)))
+                        _vary, (params, opt_row, jnp.zeros((A,), cdtype)))
 
                 # grad w.r.t. THIS stage's input; next tick it is consumed by
                 # stage s-1, whose output shape equals this stage's input.
@@ -356,12 +354,12 @@ class PipeDreamStrategy(GPipeStrategy):
                     out_size = mb * math.prod(out_shape)
                     return buf[:out_size].reshape(mb, *out_shape)
 
-                params, momentum, gx_out = lax.cond(
+                params, opt_row, gx_out = lax.cond(
                     valid_b, do_bwd, skip_bwd,
-                    (params, momentum, st_row, stash_p, stash_x, g_buf),
+                    (params, opt_row, st_row, stash_p, stash_x, g_buf),
                 )
 
-                out = (params, momentum, st_row, stash_p, stash_x,
+                out = (params, opt_row, st_row, stash_p, stash_x,
                        fwd_q, y_out, gx_out, loss_acc, corr_acc)
                 return jax.tree.map(_vary, out)
 
@@ -369,10 +367,10 @@ class PipeDreamStrategy(GPipeStrategy):
 
         branches = [make_branch(s) for s in range(S)]
 
-        def inner(params_rows, state_rows, mom_rows, xs, ys, lr):
+        def inner(params_rows, state_rows, opt_rows, xs, ys, lr):
             params = _vary(params_rows[0])
             st_row = _vary(state_rows[0])
-            momentum = _vary(mom_rows[0])
+            opt_row = jax.tree.map(lambda a: _vary(a[0]), opt_rows)
             xs = _vary(xs)
             ys = _vary(ys)
             s_idx = lax.axis_index("stage")
@@ -380,7 +378,7 @@ class PipeDreamStrategy(GPipeStrategy):
             Ls = st_row.shape[0]
 
             def body(carry, h):
-                (params, momentum, st_row, stash_p, stash_x,
+                (params, opt_row, st_row, stash_p, stash_x,
                  fwd_q, x_in, g_buf, loss_acc, corr_acc) = carry
 
                 # Absorb the activation that arrived this half-tick into the
@@ -404,9 +402,9 @@ class PipeDreamStrategy(GPipeStrategy):
                     fwd_q,
                 )
 
-                carry2 = (params, momentum, st_row, stash_p, stash_x,
+                carry2 = (params, opt_row, st_row, stash_p, stash_x,
                           fwd_q, g_buf, loss_acc, corr_acc)
-                (params, momentum, st_row, stash_p, stash_x, fwd_q,
+                (params, opt_row, st_row, stash_p, stash_x, fwd_q,
                  y_out, gx_out, loss_acc, corr_acc) = lax.switch(
                     s_idx, branches, carry2, xs, ys, h, lr
                 )
@@ -417,12 +415,12 @@ class PipeDreamStrategy(GPipeStrategy):
                 else:
                     x_in = y_out
                     g_buf = gx_out
-                return (params, momentum, st_row, stash_p, stash_x,
+                return (params, opt_row, st_row, stash_p, stash_x,
                         fwd_q, x_in, g_buf, loss_acc, corr_acc), None
 
             zeros_A = _vary(jnp.zeros((A,), cdtype))
             init_carry = (
-                params, momentum, st_row,
+                params, opt_row, st_row,
                 _vary(jnp.zeros((NSLOT, L), jnp.float32)),
                 _vary(jnp.zeros((NSLOT, A), cdtype)),
                 _vary(jnp.zeros((2, A), cdtype)),
@@ -431,16 +429,22 @@ class PipeDreamStrategy(GPipeStrategy):
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
             )
-            (params, momentum, st_row, *_rest, loss_acc, corr_acc) = lax.scan(
+            (params, opt_row, st_row, *_rest, loss_acc, corr_acc) = lax.scan(
                 body, init_carry, jnp.arange(H)
             )[0]
             loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
             st_row = lax.pmean(st_row, "data")
-            # params/momentum identical across 'data' (grads psum'd pre-update).
+            # params/opt state identical across 'data' (grads psum'd
+            # pre-update); pmean for float leaves, pmax for the int step.
             params = lax.pmean(params, "data")
-            momentum = lax.pmean(momentum, "data")
-            return (params[None], st_row[None], momentum[None], loss, correct)
+            opt_row = jax.tree.map(
+                lambda a: (lax.pmax(a, "data")
+                           if jnp.issubdtype(a.dtype, jnp.integer)
+                           else lax.pmean(a, "data")),
+                opt_row)
+            return (params[None], st_row[None],
+                    jax.tree.map(lambda a: a[None], opt_row), loss, correct)
 
         pipe = _shard_map(
             inner,
@@ -452,15 +456,15 @@ class PipeDreamStrategy(GPipeStrategy):
         )
 
         def train_step(ts: PDTrainState, xs, ys, lr):
-            params, st, momentum, loss, correct = pipe(
-                ts.params, ts.model_state, ts.momentum, xs, ys, lr
+            params, st, opt, loss, correct = pipe(
+                ts.params, ts.model_state, ts.opt, xs, ys, lr
             )
             valid = jnp.sum((ys >= 0).astype(jnp.float32))
             metrics = {
                 "loss": loss,
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
             }
-            return PDTrainState(params, st, momentum), metrics
+            return PDTrainState(params, st, opt), metrics
 
         return jax.jit(
             train_step,

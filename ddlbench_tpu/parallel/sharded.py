@@ -27,7 +27,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, init_model
-from ddlbench_tpu.parallel.common import sgd_init, sgd_update
+from ddlbench_tpu.parallel.common import make_optimizer, opt_state_sharding
 from ddlbench_tpu.parallel.single import TrainState
 
 
@@ -68,8 +68,7 @@ class _ShardedParamStrategy:
                               devices=devices,
                               dcn_axis=self.axis_name if self.batch_sharded else None)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
+        self._opt_init, opt_update = make_optimizer(cfg)
         n = self.mesh.devices.size
 
         if self.batch_sharded:
@@ -85,7 +84,7 @@ class _ShardedParamStrategy:
             ce, (correct, valid), new_state, grads = loss_and_grads(
                 model, cfg, ts.params, ts.model_state, x, y,
                 self.compute_dtype, smooth)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            params, opt = opt_update(ts.params, grads, ts.opt, lr)
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
@@ -123,14 +122,15 @@ class _ShardedParamStrategy:
             model_state=jax.tree.map(
                 lambda x: NamedSharding(self.mesh, P()), ts.model_state
             ),
-            opt=type(ts.opt)(momentum=param_sh),
+            opt=opt_state_sharding(self.cfg, param_sh,
+                                   NamedSharding(self.mesh, P())),
         )
 
     def init(self, key) -> TrainState:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, sgd_init(params))
+        ts = TrainState(params, state, self._opt_init(params))
         return put_global_tree(ts, self._state_sharding(ts))
 
     def shard_batch(self, x, y):

@@ -15,17 +15,13 @@ import jax.numpy as jnp
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, init_model
-from ddlbench_tpu.parallel.common import (
-    SGDState,
-    sgd_init,
-    sgd_update,
-)
+from ddlbench_tpu.parallel.common import make_optimizer
 
 
 class TrainState(NamedTuple):
     params: Any
     model_state: Any  # BN running stats
-    opt: SGDState
+    opt: Any  # optimizer-state dict pytree (common.make_optimizer)
 
 
 class SingleStrategy:
@@ -35,8 +31,7 @@ class SingleStrategy:
         self.model = model
         self.cfg = cfg
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
-        mom = cfg.resolved_momentum()
-        wd = cfg.resolved_weight_decay()
+        self._opt_init, opt_update = make_optimizer(cfg)
         smooth = cfg.resolved_label_smoothing()
 
         def train_step(ts: TrainState, x, y, lr):
@@ -45,7 +40,7 @@ class SingleStrategy:
             ce, (correct, valid), new_state, grads = loss_and_grads(
                 model, cfg, ts.params, ts.model_state, x, y,
                 self.compute_dtype, smooth)
-            params, opt = sgd_update(ts.params, grads, ts.opt, lr, mom, wd)
+            params, opt = opt_update(ts.params, grads, ts.opt, lr)
             # headline loss stays the CE term, comparable across strategies
             metrics = {
                 "loss": ce,
@@ -65,7 +60,7 @@ class SingleStrategy:
 
     def init(self, key) -> TrainState:
         params, state, _ = init_model(self.model, key)
-        return TrainState(params, state, sgd_init(params))
+        return TrainState(params, state, self._opt_init(params))
 
     def shard_batch(self, x, y):
         return x, y

@@ -69,10 +69,13 @@ def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
     # scaling is applied — warmup_world stays 1 elsewhere and
     # gradual_warmup_lr is then the identity.
     warmup_world = 1
-    if cfg.strategy == "dp" and cfg.scale_lr_by_world:
+    if (cfg.strategy == "dp" and cfg.scale_lr_by_world
+            and cfg.resolved_optimizer() == "sgd"):
         # Horovod parity: lr scaled by world size (mnist_horovod.py:226) and
         # by the accumulation count (lr * batches_per_allreduce * hvd.size(),
-        # imagenet_horovod.py:131).
+        # imagenet_horovod.py:131). SGD only — linear scaling is the SGD
+        # heuristic; the reference never scales its Adam (translation) lr by
+        # replica count.
         base_lr = base_lr * strategy.world_size * cfg.grad_accum_steps
         warmup_world = strategy.world_size
 

@@ -93,7 +93,7 @@ def test_ep_matches_dense_single(devices):
     # expert leaves actually sharded, momentum buffers too
     specs = {str(l.sharding.spec) for l in jax.tree.leaves(ts_ep.params)}
     assert any("expert" in s for s in specs), specs
-    specs_m = {str(l.sharding.spec) for l in jax.tree.leaves(ts_ep.opt.momentum)}
+    specs_m = {str(l.sharding.spec) for l in jax.tree.leaves(ts_ep.opt["m"])}
     assert any("expert" in s for s in specs_m), specs_m
 
     ts_1 = single.init(jax.random.key(0))

@@ -132,10 +132,12 @@ def test_greedy_and_beam_decode(model_and_params):
 @pytest.mark.parametrize("strategy,devices", [("single", 1), ("dp", 8),
                                               ("gpipe", 4)])
 def test_training_strategies(strategy, devices):
+    # pin sgd: these assert strategy equivalence / lr-specific descent,
+    # written against SGD math (synthmt now defaults to adam)
     cfg = RunConfig(
         benchmark="synthmt", strategy=strategy, arch="seq2seq_t",
         num_devices=devices, epochs=1, steps_per_epoch=2, log_interval=1,
-        compute_dtype="float32",
+        compute_dtype="float32", optimizer="sgd",
         batch_size=8 if strategy != "gpipe" else None,
         micro_batch_size=2 if strategy == "gpipe" else None,
         num_microbatches=4 if strategy == "gpipe" else None,
@@ -236,7 +238,7 @@ def test_sp_seq2seq_matches_single(devices):
     model = tiny_seq2seq()  # T=16, src_len=8: 4 shards of 4 -> source spans 2
     B = 2
     cfg = RunConfig(strategy="sp", benchmark="synthmt", arch="seq2seq_t",
-                    num_devices=4, compute_dtype="float32",
+                    num_devices=4, compute_dtype="float32", optimizer="sgd",
                     momentum=0.5, weight_decay=0.0)
     sp = SPStrategy(model, cfg)
     single = SingleStrategy(model, cfg.replace(strategy="single", num_devices=1))
