@@ -57,6 +57,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--optimizer", default=None, choices=("sgd", "adam"),
                    help="default: adam for seq2seq benchmarks (reference "
                         "translation parity), sgd otherwise")
+    p.add_argument("--shard-opt-state", action="store_true",
+                   help="ZeRO-1 on dp: shard optimizer state over the data "
+                        "axis (params stay replicated)")
     p.add_argument("--warmup-epochs", type=int, default=0,
                    help="gradual lr warmup epochs (Horovod ImageNet parity: "
                         "base lr -> base*world over this many epochs)")
@@ -126,6 +129,7 @@ def config_from_args(args) -> RunConfig:
         grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,
         optimizer=args.optimizer,
+        shard_opt_state=args.shard_opt_state,
         warmup_epochs=args.warmup_epochs,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,

@@ -189,9 +189,15 @@ class RunConfig:
     # ImageNet driver, warmup_epochs=5).
     warmup_epochs: int = 0
     scale_lr_by_world: bool = True  # Horovod parity: lr x world (mnist_horovod.py:226)
+    # ZeRO-1 for dp: shard the optimizer state (momentum, adam m/v) over the
+    # 'data' axis while params stay replicated — placement-only, XLA shards
+    # the update and all-gathers the delta. No reference analog (its DP
+    # replicates everything).
+    shard_opt_state: bool = False
     # Gradient accumulation: K micro-steps between optimizer updates, grads
     # averaged (Horovod backward_passes_per_step / batches_per_allreduce
-    # parity, imagenet_horovod.py:131-139; dp also scales lr by K). The
+    # parity, imagenet_horovod.py:131-139; dp with SGD also scales lr by K —
+    # the linear-scaling heuristic is gated to SGD in train/loop.py). The
     # per-step batch becomes K x the configured batch. single/dp/tp/fsdp.
     grad_accum_steps: int = 1
 
@@ -375,6 +381,10 @@ class RunConfig:
             raise ValueError(
                 "grad_accum_steps > 1 is supported on single/dp/tp/fsdp "
                 "(pipeline strategies already micro-batch)")
+        if self.shard_opt_state and self.strategy != "dp":
+            raise ValueError(
+                "shard_opt_state (ZeRO-1) applies to the dp strategy "
+                "(fsdp already shards everything)")
         if self.virtual_stages > 1:
             if self.strategy != "gpipe":
                 raise ValueError(

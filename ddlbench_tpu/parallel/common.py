@@ -9,8 +9,6 @@ unchanged to packed flat-vector stage parameters in the pipeline strategies.
 
 from __future__ import annotations
 
-from typing import Any, NamedTuple
-
 import jax
 import jax.numpy as jnp
 

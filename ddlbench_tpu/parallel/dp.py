@@ -97,7 +97,25 @@ class DPStrategy:
         ts = TrainState(params, state, self._opt_init(params))
         # Broadcast-init parity (mnist_horovod.py:230-231): replicate to the
         # mesh — identical on every host since init is seed-deterministic.
-        return put_global_tree(ts, self._replicated)
+        shardings = TrainState(self._replicated, self._replicated,
+                               self._replicated)
+        if self.cfg.shard_opt_state:
+            # ZeRO-1: optimizer state sharded over 'data' (largest divisible
+            # dim per leaf), params replicated. Pure placement — XLA shards
+            # the update math and all-gathers only the parameter delta. With
+            # adam this drops the optimizer memory 2x*params -> 2x/world.
+            from ddlbench_tpu.parallel.sharded import _leaf_spec
+
+            n = self.mesh.devices.size
+
+            def leaf_sh(x):
+                return NamedSharding(
+                    self.mesh, _leaf_spec(x, "data", n, prefer_last=False))
+
+            shardings = TrainState(
+                self._replicated, self._replicated,
+                jax.tree.map(leaf_sh, ts.opt))
+        return put_global_tree(ts, shardings)
 
     def shard_batch(self, x, y):
         from ddlbench_tpu.distributed import put_global_batch

@@ -139,3 +139,45 @@ def test_adam_single_matches_gpipe(devices):
                 [ts_s.params[i] for i in range(bounds[c], bounds[c + 1])])
         )[0]
         np.testing.assert_allclose(row, np.asarray(want), rtol=2e-4, atol=2e-6)
+
+
+def test_dp_zero1_sharded_opt_state(devices):
+    """--shard-opt-state: dp trajectories identical, optimizer-state leaves
+    sharded over 'data' (and still sharded after a step)."""
+    from ddlbench_tpu.parallel.dp import DPStrategy, make_data_mesh
+
+    model = tiny_transformer()
+    base = dict(strategy="dp", benchmark="synthtext", arch="transformer_t",
+                compute_dtype="float32", optimizer="adam", batch_size=2,
+                num_devices=4)
+    kx, ky = jax.random.split(jax.random.key(2))
+    x = jax.random.randint(kx, (8, 32), 0, 64)
+    y = jax.random.randint(ky, (8, 32), 0, 64)
+
+    results = []
+    for zero1 in (False, True):
+        cfg = RunConfig(shard_opt_state=zero1, **base)
+        strat = DPStrategy(model, cfg, mesh=make_data_mesh(4, devices[:4]))
+        ts = strat.init(jax.random.key(0))
+        if zero1:
+            specs = {str(l.sharding.spec)
+                     for l in jax.tree.leaves(ts.opt["m"])}
+            assert any("data" in s for s in specs), specs
+        for _ in range(3):
+            ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                     jnp.float32(1e-3))
+        if zero1:
+            # sharding survives the jitted update (no silent replication)
+            specs = {str(l.sharding.spec)
+                     for l in jax.tree.leaves(ts.opt["m"])}
+            assert any("data" in s for s in specs), specs
+        results.append((ravel_pytree(ts.params)[0], float(m["loss"])))
+    np.testing.assert_allclose(np.asarray(results[0][0]),
+                               np.asarray(results[1][0]),
+                               rtol=2e-5, atol=2e-7)
+    assert abs(results[0][1] - results[1][1]) < 1e-5
+
+
+def test_zero1_rejected_off_dp():
+    with pytest.raises(ValueError, match="ZeRO-1"):
+        RunConfig(strategy="fsdp", num_devices=2, shard_opt_state=True).validate()
