@@ -192,3 +192,25 @@ def test_gpipe_bn_model_runs(devices):
     ev = strat.eval_step(ts2, xs, ys)
     assert np.isfinite(float(ev["loss"]))
     assert int(ev["count"]) == B
+
+
+def test_auto_partition_with_virtual_stages(devices):
+    """--auto-partition must split into S*V chunks for the interleaved
+    schedule (api.py) and produce a runnable strategy."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(
+        strategy="gpipe", benchmark="mnist", arch="resnet18",
+        num_devices=2, num_stages=2, virtual_stages=2,
+        micro_batch_size=2, num_microbatches=4,
+        compute_dtype="float32", auto_partition=True,
+    )
+    strat = make_strategy(cfg, devices=jax.devices()[:2])
+    assert strat.num_chunks == 4
+    ts = strat.init(jax.random.key(0))
+    assert len(strat.bounds) == 5  # S*V + 1 bounds
+    B = cfg.global_batch()
+    x = jax.random.normal(jax.random.key(1), (B, 28, 28, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.01))
+    assert np.isfinite(float(m["loss"]))
