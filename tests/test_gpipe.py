@@ -42,6 +42,20 @@ def manual_step(model, params, states, x, y, lr, momentum):
     return loss, new_params
 
 
+
+def assert_chunk_params_match(strat, ts, ref_params, S, V=1, rtol=1e-4,
+                              atol=1e-6):
+    """Every packed chunk row must equal the sequential reference's slice
+    (one home for the [S, L] / [V, S, L] layout knowledge)."""
+    bounds = strat.bounds
+    for c in range(S * V):
+        row = ts.params[c] if V == 1 else ts.params[c // S, c % S]
+        got = row[: strat._p_lens[c]]
+        want = ravel_pytree(ref_params[bounds[c]:bounds[c + 1]])[0]
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=rtol, atol=atol)
+
+
 @pytest.mark.parametrize("dp", [1, 2])
 def test_gpipe_matches_sequential(devices, dp):
     model = tiny_model()
@@ -80,12 +94,7 @@ def test_gpipe_matches_sequential(devices, dp):
     np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss), rtol=1e-5)
 
     # Compare updated parameters stage by stage.
-    bounds = strat.bounds
-    for s in range(S):
-        row = ts2.params[s]
-        got = row[: strat._p_lens[s]]
-        want = ravel_pytree(ref_params[bounds[s]:bounds[s + 1]])[0]
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-4, atol=1e-6)
+    assert_chunk_params_match(strat, ts2, ref_params, S)
 
 
 @pytest.mark.parametrize("dp", [1, 2])
@@ -125,13 +134,7 @@ def test_interleaved_matches_sequential(devices, dp):
     )
     np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss), rtol=1e-5)
 
-    bounds = strat.bounds
-    for c in range(S * V):
-        v, s = c // S, c % S
-        got = ts2.params[v, s][: strat._p_lens[c]]
-        want = ravel_pytree(ref_params[bounds[c]:bounds[c + 1]])[0]
-        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
-                                   rtol=1e-4, atol=1e-6)
+    assert_chunk_params_match(strat, ts2, ref_params, S, V)
 
     # eval path shares the interleaved pipe
     ev = strat.eval_step(ts2, xs, ys)
@@ -214,3 +217,35 @@ def test_auto_partition_with_virtual_stages(devices):
     y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
     ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.01))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_interleaved_v3_matches_sequential(devices):
+    """Deeper interleaving (V=3, S=2 -> 6 chunks): the mixed-radix timetable
+    must stay conflict-free and exact beyond the V=2 case."""
+    layers = [flatten()] + [
+        dense(f"fc{i}", 24, relu=True) for i in range(5)
+    ] + [dense("out", 10)]
+    model = LayerModel("tiny7", layers, (8, 8, 1), 10)
+    S, V, M, mb = 2, 3, 4, 3
+    cfg = RunConfig(
+        strategy="gpipe", num_devices=S, num_stages=S, virtual_stages=V,
+        micro_batch_size=mb, num_microbatches=M, compute_dtype="float32",
+        momentum=0.0, weight_decay=0.0,
+    )
+    cfg.validate()
+    strat = GPipeStrategy(model, cfg, stage_bounds=[0, 1, 2, 3, 4, 5, 7])
+    ts = strat.init(jax.random.key(0))
+    assert ts.params.shape[:2] == (V, S)
+
+    B = M * mb
+    x = jax.random.normal(jax.random.key(3), (B, 8, 8, 1))
+    y = jax.random.randint(jax.random.key(4), (B,), 0, 10)
+    xs, ys = strat.shard_batch(x, y)
+    ts2, metrics = strat.train_step(ts, xs, ys, jnp.float32(0.1))
+
+    params_list, state_list, _ = init_model(model, jax.random.key(0))
+    ref_loss, ref_params = manual_step(
+        model, params_list, state_list, x, y, 0.1, momentum=0.0)
+    np.testing.assert_allclose(float(metrics["loss"]), float(ref_loss),
+                               rtol=1e-5)
+    assert_chunk_params_match(strat, ts2, ref_params, S, V)

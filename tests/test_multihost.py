@@ -10,8 +10,9 @@ DDLB_* env contract (ddlbench_tpu/distributed.py initialize), build a global
 global batch/param placement via put_global_batch/put_global_tree
 (make_array_from_callback under the hood), cross-process collectives over
 gloo, replicated metrics. Covered paths: dp (dp.py), fsdp (sharded.py),
-gpipe hybrid PPxDP (stage-axis ppermute crossing the process boundary), and
-ep (axis_sharded.py + expert-sharded param trees + cross-process all_to_all).
+gpipe hybrid PPxDP (stage-axis ppermute crossing the process boundary),
+ep (axis_sharded.py + expert-sharded param trees + cross-process all_to_all),
+and sp (the ring-attention K/V rotation crossing the process boundary).
 """
 
 import os
@@ -21,7 +22,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-STRATEGIES = ("dp", "fsdp", "gpipe", "ep")
+STRATEGIES = ("dp", "fsdp", "gpipe", "ep", "sp")
 
 WORKER = r"""
 import os, sys
@@ -49,6 +50,23 @@ for strategy in sys.argv[1].split(","):
                         epochs=1, steps_per_epoch=2, log_interval=1, **pipe)
         res = run_benchmark(cfg, warmup_steps=0)
         metric = res["valid_accuracy"]
+    elif strategy == "sp":
+        # ring attention with its ppermute ring crossing the process boundary
+        import ddlbench_tpu.models.transformer as tr
+        from ddlbench_tpu.parallel.sp import SPStrategy
+
+        tr._VARIANTS.setdefault("transformer_t",
+                                dict(d_model=32, n_layers=2, n_heads=4))
+        lm = tr.build_transformer("transformer_t", (64,), 64)
+        cfg = RunConfig(strategy="sp", benchmark="synthtext",
+                        arch="transformer_t", num_devices=8, batch_size=2,
+                        compute_dtype="float32")
+        sp = SPStrategy(lm, cfg)
+        ts = sp.init(jax.random.key(0))
+        x = jax.random.randint(jax.random.key(1), (2, 64), 0, 64)
+        y = jax.random.randint(jax.random.key(2), (2, 64), 0, 64)
+        ts, m = sp.train_step(ts, *sp.shard_batch(x, y), jnp.float32(0.1))
+        metric = float(m["loss"])
     else:  # ep: expert-sharded param trees + all_to_all across hosts
         import ddlbench_tpu.models.moe as moe
         from ddlbench_tpu.parallel.ep import EPStrategy
