@@ -17,6 +17,8 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
+import subprocess
 import sys
 import time
 
@@ -24,6 +26,24 @@ import jax
 import jax.numpy as jnp
 
 REFERENCE_1080TI_RESNET50_IPS = 200.0
+
+
+def _device_probe(timeout_s: float) -> tuple[bool, str]:
+    """(ok, reason): whether jax.devices() returns within timeout_s, probed
+    in a child process. The axon TPU tunnel can go down in a mode where
+    device init HANGS (no error) — without this guard the whole bench hangs
+    with it."""
+    try:
+        r = subprocess.run(
+            [sys.executable, "-c", "import jax; jax.devices()"],
+            timeout=timeout_s, capture_output=True, text=True,
+        )
+    except subprocess.TimeoutExpired:
+        return False, f"device init hung > {timeout_s:.0f}s (tunnel down?)"
+    if r.returncode != 0:
+        tail = (r.stderr or "").strip().splitlines()[-3:]
+        return False, "device init failed: " + " | ".join(tail)
+    return True, ""
 
 
 def main() -> int:
@@ -35,10 +55,27 @@ def main() -> int:
     p.add_argument("--warmup", type=int, default=5)
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--quick", action="store_true", help="tiny run for smoke testing")
+    p.add_argument("--probe-timeout-s", type=float, default=180.0)
     args = p.parse_args()
 
     if args.quick:
         args.batch_size, args.steps, args.warmup = 32, 5, 2
+
+    if args.probe_timeout_s <= 0:
+        p.error("--probe-timeout-s must be positive")
+    platform_note = None
+    # Probe only when an accelerator is expected (the probe costs a child
+    # backend init); plain-CPU runs skip it.
+    if os.environ.get("JAX_PLATFORMS", "") not in ("", "cpu"):
+        ok, reason = _device_probe(args.probe_timeout_s)
+        if not ok:
+            # Labeled CPU fallback: a tiny measured number with the reason
+            # beats a hung driver and an empty BENCH_r{N}.json.
+            print(f"device probe: {reason}; falling back to cpu",
+                  file=sys.stderr)
+            jax.config.update("jax_platforms", "cpu")
+            args.batch_size, args.steps, args.warmup = 4, 2, 1
+            platform_note = f"cpu-fallback ({reason})"
 
     from ddlbench_tpu.config import RunConfig
     from ddlbench_tpu.data.synthetic import make_synthetic
@@ -73,16 +110,15 @@ def main() -> int:
     dt = time.perf_counter() - t0
 
     ips = args.steps * args.batch_size / dt
-    print(
-        json.dumps(
-            {
-                "metric": f"{args.arch}_{args.benchmark}_images_per_sec_per_chip",
-                "value": round(ips, 2),
-                "unit": "images/sec",
-                "vs_baseline": round(ips / REFERENCE_1080TI_RESNET50_IPS, 3),
-            }
-        )
-    )
+    record = {
+        "metric": f"{args.arch}_{args.benchmark}_images_per_sec_per_chip",
+        "value": round(ips, 2),
+        "unit": "images/sec",
+        "vs_baseline": round(ips / REFERENCE_1080TI_RESNET50_IPS, 3),
+    }
+    if platform_note:
+        record["platform"] = platform_note
+    print(json.dumps(record))
     return 0
 
 
