@@ -126,6 +126,8 @@ class HardwareModel:
     hbm_bytes: float = 16 * 1024**3
     # Peak bf16 matmul throughput per chip (FLOP/s). v5e: ~197 TFLOP/s.
     peak_flops: float = 1.97e14
+    # Peak HBM bandwidth per chip (bytes/s). v5e: ~819 GB/s.
+    hbm_bandwidth: float = 8.19e11
 
     def levels(self, num_hosts: int, chips_per_host: int):
         """Hierarchical (bandwidth, machines-per-group) levels, fastest first.

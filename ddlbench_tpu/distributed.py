@@ -47,6 +47,21 @@ def add_platform_arg(parser) -> None:
              "virtual mesh)")
 
 
+def enable_compilation_cache(path: str = "/tmp/ddlbench_xla_cache") -> None:
+    """Persistent XLA compilation cache: repeat benchmark invocations reuse
+    compiled executables keyed by HLO hash, so a retried run (e.g. after the
+    flaky axon tunnel drops mid-bench) skips the multi-minute compile. Safe
+    no-op if the running jax lacks the config knobs."""
+    import jax
+
+    try:
+        jax.config.update("jax_compilation_cache_dir", path)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+    except Exception:
+        pass
+
+
 def apply_platform(platform) -> None:
     """Apply a --platform override before the first backend touch. Safe on
     images whose sitecustomize imports jax early: jax.config works until a

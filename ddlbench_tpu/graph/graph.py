@@ -37,6 +37,9 @@ class Node:
     activation_size: float = 0.0  # bytes (output activation)
     parameter_size: float = 0.0  # bytes
     stage_id: Optional[int] = None
+    # longest-path annotations, filled by populate_depths/populate_heights
+    depth: Optional[int] = None
+    height: Optional[int] = None
 
     def __str__(self) -> str:
         s = (
@@ -50,8 +53,10 @@ class Node:
             s += f" -- stage_id={self.stage_id}"
         return s
 
+    # the reference's small test fixtures (graph/test_graphs/test*.txt) omit
+    # the "node" id prefix; accept both spellings
     _LINE_RE = re.compile(
-        r"node(?P<id>\S+) -- (?P<desc>.*) -- "
+        r"(?:node)?(?P<id>\S+) -- (?P<desc>.*) -- "
         r"forward_compute_time=(?P<f>[-\d.e]+), "
         r"backward_compute_time=(?P<b>[-\d.e]+), "
         r"activation_size=(?P<a>[-\d.e+]+), "
@@ -150,6 +155,111 @@ class Graph:
         return all(len(v) <= 1 for v in self.edges.values()) and all(
             len(v) <= 1 for v in self.in_edges.values()
         )
+
+    # -- structure annotations / analyses ----------------------------------
+    # Parity: reference graph.py populate_depths/populate_heights (:87-115),
+    # is_series_parallel (:229-243), check_isomorphism (:275-289) — all
+    # exercised by the reference's own graph/test.py:58-91. Re-derived here
+    # over the topological order (one linear pass each) instead of the
+    # reference's worklist propagation.
+
+    def populate_depths(self) -> None:
+        """node.depth = longest path length (in nodes) from a source; 1 at
+        sources."""
+        for n in self.topological_sort():
+            preds = self.in_edges.get(n.node_id, [])
+            n.depth = 1 + max(
+                (self.nodes[p].depth for p in preds), default=0)
+
+    def populate_heights(self) -> None:
+        """node.height = longest path length (in nodes) to a sink; 1 at
+        sinks."""
+        for n in reversed(self.topological_sort()):
+            succs = self.edges.get(n.node_id, [])
+            n.height = 1 + max(
+                (self.nodes[s].height for s in succs), default=0)
+
+    def is_series_parallel(self) -> bool:
+        """True iff the DAG reduces to a single source->sink edge under
+        series-parallel reduction: repeatedly contract interior nodes with
+        in-degree 1 and out-degree 1 (series step), merging the parallel
+        edges that contraction creates (parallel step). Two-terminal SP
+        graphs — and therefore any chain-of-blocks model profile — reduce to
+        exactly 2 nodes; branchy non-SP graphs (e.g. NASNet cells) get stuck
+        earlier."""
+        out = {i: set(v) for i, v in self.edges.items()}
+        inn = {i: set(v) for i, v in self.in_edges.items()}
+        alive = set(self.nodes)
+        changed = True
+        while changed:
+            changed = False
+            for i in list(alive):
+                if len(out.get(i, ())) == 1 and len(inn.get(i, ())) == 1:
+                    (p,), (s,) = inn[i], out[i]
+                    if p == s:  # would be a cycle; never true in a DAG
+                        continue
+                    alive.discard(i)
+                    out[p].discard(i)
+                    inn[s].discard(i)
+                    out[p].add(s)  # set => duplicate edges merge
+                    inn[s].add(p)
+                    del out[i], inn[i]
+                    changed = True
+        if len(alive) != 2:
+            return False
+        a, b = alive
+        return b in out.get(a, ()) or a in out.get(b, ())
+
+    def _canonical_order(self) -> List[Node]:
+        """Deterministic topological order keyed on (node_desc, height,
+        degrees) — the alignment used by check_isomorphism. Ties among
+        structurally identical nodes are harmless: any alignment of them
+        satisfies the checked invariants."""
+        self.populate_heights()
+        import heapq
+
+        indeg = {i: len(self.in_edges.get(i, [])) for i in self.nodes}
+        key = {
+            i: (n.node_desc, -(n.height or 0),
+                len(self.edges.get(i, [])), len(self.in_edges.get(i, [])))
+            for i, n in self.nodes.items()
+        }
+        heap = [(key[i], i) for i in self.nodes if indeg[i] == 0]
+        heapq.heapify(heap)
+        order: List[Node] = []
+        while heap:
+            _, i = heapq.heappop(heap)
+            order.append(self.nodes[i])
+            for j in self.edges.get(i, []):
+                indeg[j] -= 1
+                if indeg[j] == 0:
+                    heapq.heappush(heap, (key[j], j))
+        return order
+
+    def check_isomorphism(self, other: "Graph") -> None:
+        """Raise ValueError unless ``other`` aligns with this graph under the
+        canonical order: same node count, and pairwise identical node_desc,
+        out-degree and in-degree. Like the reference's check this is a
+        canonical-ordering approximation (sound for profile graphs whose
+        descs/heights discriminate), not a general isomorphism decision."""
+        a = self._canonical_order()
+        b = other._canonical_order()
+        if len(a) != len(b):
+            raise ValueError(
+                f"node counts differ: {len(a)} vs {len(b)}")
+        for na, nb in zip(a, b):
+            if na.node_desc != nb.node_desc:
+                raise ValueError(
+                    f"desc mismatch: {na.node_id}:{na.node_desc!r} vs "
+                    f"{nb.node_id}:{nb.node_desc!r}")
+            da = (len(self.edges.get(na.node_id, [])),
+                  len(self.in_edges.get(na.node_id, [])))
+            db = (len(other.edges.get(nb.node_id, [])),
+                  len(other.in_edges.get(nb.node_id, [])))
+            if da != db:
+                raise ValueError(
+                    f"degree mismatch at {na.node_id} vs {nb.node_id}: "
+                    f"{da} vs {db}")
 
     # -- antichain DAG (partitioner state space) ---------------------------
 
@@ -392,7 +502,7 @@ class Graph:
     @classmethod
     def from_str(cls, text: str) -> "Graph":
         g = cls()
-        edge_re = re.compile(r"\tnode(\S+) -- node(\S+)")
+        edge_re = re.compile(r"\s+(?:node)?(\S+) -- (?:node)?(\S+)")
         for line in text.splitlines():
             if not line.strip():
                 continue

@@ -113,8 +113,14 @@ def set_attention_backend(backend: str) -> None:
     _ATTENTION_BACKEND[0] = backend
 
 
-def _flash_dispatch():
-    """Return (use_flash, interpret) for the current backend setting."""
+def _flash_dispatch(*operands):
+    """Return (use_flash, interpret) for the current backend setting.
+
+    "auto" picks the Pallas kernel only where it partitions correctly:
+    pallas_call has no GSPMD partitioning rule, so under a multi-device jit
+    with sharded operands XLA would gather them to every device (ADVICE r1).
+    Inside shard_map (nonempty varying-manual-axes type on an operand) and on
+    a single device the kernel shapes are already local — flash is safe."""
     from ddlbench_tpu.distributed import is_tpu_backend
 
     mode = _ATTENTION_BACKEND[0]
@@ -123,7 +129,15 @@ def _flash_dispatch():
     on_tpu = is_tpu_backend()
     if mode == "flash":
         return True, not on_tpu
-    return on_tpu, False
+    if not on_tpu:
+        return False, False
+    from ddlbench_tpu.ops.util import pallas_partitions_safely
+
+    # compiled kernels need 8-aligned sequence blocks (flash_attention.py
+    # _pick_block); odd sequence lengths take the XLA einsum path
+    if any(o.ndim >= 3 and o.shape[2] % 8 for o in operands):
+        return False, False
+    return pallas_partitions_safely(*operands), False
 
 
 def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
@@ -139,7 +153,7 @@ def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
     which implements the same prefix rule with block-level skipping — unless
     set_attention_backend("xla") was called.
     """
-    use_flash, interpret = _flash_dispatch()
+    use_flash, interpret = _flash_dispatch(q, k, v)
     if use_flash:
         from ddlbench_tpu.ops.flash_attention import flash_attention
 
@@ -181,7 +195,7 @@ def ring_attention(q, k, v, axis: str, prefix_len: int = 0):
     block is data-dependent on the shard index, which the kernel's static
     offsets can't express).
     """
-    use_flash, interpret = _flash_dispatch()
+    use_flash, interpret = _flash_dispatch(q, k, v)
     if use_flash and prefix_len == 0:
         return _ring_attention_flash(q, k, v, axis, interpret)
     n = lax.psum(1, axis)

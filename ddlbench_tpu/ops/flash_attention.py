@@ -36,11 +36,20 @@ from ddlbench_tpu.ops.util import pallas_out_struct as _out_struct
 NEG_INF = -1e30
 
 
-def _pick_block(t: int, preferred: int) -> int:
-    """Largest divisor of t that is <= preferred (block shapes must tile T)."""
-    b = min(preferred, t)
-    while t % b:
-        b -= 1
+def _pick_block(t: int, preferred: int, interpret: bool = False) -> int:
+    """Largest divisor of t <= preferred tiling the sequence dimension; on
+    real TPU it must also be a multiple of 8 (Mosaic sublane tile —
+    ops/util.py:pick_block). Sequence lengths with no aligned divisor get a
+    clear error instead of a raw Mosaic one; the attention dispatch
+    (models/transformer.py:_flash_dispatch) avoids flash for such shapes."""
+    from ddlbench_tpu.ops.util import pick_block
+
+    b = pick_block(t, preferred, 1 if interpret else 8)
+    if b is None:
+        raise ValueError(
+            f"flash_attention: sequence length {t} has no divisor that is a "
+            f"multiple of 8; pad the sequence or use the XLA attention "
+            f"backend")
     return b
 
 
@@ -236,8 +245,8 @@ def _flash_fwd_impl(q, k, v, q_offset, k_offset, prefix_len, block_q, block_k,
                     interpret):
     B, H, Tq, dh = q.shape
     Tk = k.shape[2]
-    bq = _pick_block(Tq, block_q)
-    bk = _pick_block(Tk, block_k)
+    bq = _pick_block(Tq, block_q, interpret)
+    bk = _pick_block(Tk, block_k, interpret)
     num_k = Tk // bk
     scale = 1.0 / math.sqrt(dh)
     qr, kr, vr = _bh(q), _bh(k), _bh(v)
@@ -287,8 +296,8 @@ def _flash_bwd_core(q_offset, k_offset, prefix_len, block_q, block_k,
     q, k, v, o, lse = res
     B, H, Tq, dh = q.shape
     Tk = k.shape[2]
-    bq = _pick_block(Tq, block_q)
-    bk = _pick_block(Tk, block_k)
+    bq = _pick_block(Tq, block_q, interpret)
+    bk = _pick_block(Tk, block_k, interpret)
     num_q, num_k = Tq // bq, Tk // bk
     scale = 1.0 / math.sqrt(dh)
     BH = B * H

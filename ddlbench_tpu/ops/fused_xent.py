@@ -30,6 +30,7 @@ Both obj_sum and ce_sum are differentiable (they coincide when smoothing=0).
 from __future__ import annotations
 
 import functools
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -80,14 +81,40 @@ def _row_stats(z, labels, smoothing: float):
 
 
 
-def _use_pallas(backend: str) -> bool:
+def _use_pallas(backend: str, *operands) -> bool:
+    """Kernel dispatch. "auto" picks the Pallas kernels only where they
+    partition correctly: pallas_call has no GSPMD partitioning rule, so under
+    a plain multi-device jit with sharded operands XLA would gather/replicate
+    them (inverting the fusion's memory win for dp/tp/fsdp). Inside shard_map
+    the operands are per-shard (nonempty varying-manual-axes type) and on a
+    single device there is nothing to partition — Pallas is safe in both.
+    jit-based multi-device strategies get the chunked-XLA scan, which GSPMD
+    partitions natively."""
     if backend == "xla":
         return False
     if backend == "pallas":
         return True
     from ddlbench_tpu.distributed import is_tpu_backend
 
-    return is_tpu_backend()
+    if not is_tpu_backend():
+        return False
+    from ddlbench_tpu.ops.util import pallas_partitions_safely
+
+    return pallas_partitions_safely(*operands)
+
+
+def _pallas_feasible(w, backend: str, interpret: bool) -> bool:
+    """Mosaic wants lane-dim blocks in multiples of 128: a vocab with no such
+    divisor can't run the compiled kernels. auto falls back to chunked-XLA;
+    a forced "pallas" backend gets a clear error instead of a Mosaic one."""
+    if interpret or _pick_block(w.shape[1], V_BLOCK, 128) is not None:
+        return True
+    if backend == "pallas":
+        raise ValueError(
+            f"fused_linear_xent: vocab {w.shape[1]} has no 128-multiple "
+            f"block divisor; pad the vocab to a multiple of 128 or use "
+            f"backend='xla'")
+    return False
 
 
 @functools.partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
@@ -108,7 +135,8 @@ def fused_linear_xent(h, w, labels, smoothing: float = 0.0,
 
 def _fxent_fwd(h, w, labels, smoothing: float, row_chunk: int, backend: str,
                interpret: bool):
-    if _use_pallas(backend):
+    if (_use_pallas(backend, h, w, labels)
+            and _pallas_feasible(w, backend, interpret)):
         return _fxent_fwd_pallas(h, w, labels, smoothing, interpret)
     N = h.shape[0]
     chunk = min(row_chunk, N)
@@ -142,7 +170,8 @@ def _fxent_bwd(smoothing: float, row_chunk: int, backend: str,
     go, gce, _ = cots  # correct-count cotangent is float0 — ignored
     go = go.astype(jnp.float32)
     gce = gce.astype(jnp.float32)
-    if _use_pallas(backend):
+    if (_use_pallas(backend, h, w, labels)
+            and _pallas_feasible(w, backend, interpret)):
         dh, dw = _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing,
                                    interpret)
     else:
@@ -262,11 +291,20 @@ ROW_BLOCK = 256
 V_BLOCK = 2048
 
 
-def _pick_block(t: int, preferred: int) -> int:
-    b = min(preferred, t)
-    while t % b:
-        b -= 1
-    return b
+def _pick_block(t: int, preferred: int, unit: int = 1) -> Optional[int]:
+    """Tile-aligned block divisor (ops/util.py:pick_block); ``unit`` is 128
+    for the lane (vocab) dimension on real TPU."""
+    from ddlbench_tpu.ops.util import pick_block
+
+    return pick_block(t, preferred, unit)
+
+
+def _row_block(n: int, interpret: bool) -> int:
+    """Row (sublane) block: ROW_BLOCK, shrunk for small n but kept a multiple
+    of 8 on real TPU (rows are padded up to a block multiple either way)."""
+    if n >= ROW_BLOCK:
+        return ROW_BLOCK
+    return n if interpret else -(-n // 8) * 8
 
 
 def _fx_fwd_kernel(h_ref, w_ref, lab_ref, lse_ref, gold_ref, zsum_ref,
@@ -320,12 +358,12 @@ def _fxent_fwd_pallas(h, w, labels, smoothing: float, interpret: bool):
 
     N, D = h.shape
     V = w.shape[1]
-    br = min(ROW_BLOCK, N)
+    br = _row_block(N, interpret)
     # pad rows to a block multiple with masked labels
     hp, lp, _ = _pad_rows(h, labels, br)
     Np = hp.shape[0]
     nr = Np // br
-    bv = _pick_block(V, V_BLOCK)
+    bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
     nv = V // bv
     lab2 = lp[:, None].astype(jnp.int32)
 
@@ -430,11 +468,11 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
 
     N, D = h.shape
     V = w.shape[1]
-    br = min(ROW_BLOCK, N)
+    br = _row_block(N, interpret)
     hp, lp, _ = _pad_rows(h, labels, br)
     Np = hp.shape[0]
     nr = Np // br
-    bv = _pick_block(V, V_BLOCK)
+    bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
     nv = V // bv
     lab2 = lp[:, None].astype(jnp.int32)
     # padded rows: lse=0 with z=0 gives p=1 — masked to 0 by the label test

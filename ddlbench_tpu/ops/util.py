@@ -2,7 +2,54 @@
 
 from __future__ import annotations
 
+import contextlib
+
 import jax
+
+_IN_SHARDED_JIT = [False]
+
+
+@contextlib.contextmanager
+def sharded_jit_tracing():
+    """Mark the enclosed trace as a plain multi-device jit over GSPMD-sharded
+    operands (dp/tp/fsdp strategies wrap their step bodies in this). Pallas
+    dispatch happens at trace time, so the flag is captured into the traced
+    program."""
+    _IN_SHARDED_JIT[0] = True
+    try:
+        yield
+    finally:
+        _IN_SHARDED_JIT[0] = False
+
+
+def pallas_partitions_safely(*operands) -> bool:
+    """Whether a Pallas kernel over ``operands`` runs where it was placed
+    instead of being gathered: pallas_call has no GSPMD partitioning rule, so
+    under a plain multi-device jit with sharded operands XLA replicates them
+    onto every device (ADVICE r1). Inside shard_map the operands are already
+    per-shard (nonempty varying-manual-axes type), and outside a sharded jit
+    (single-device programs, whatever the host's chip count) there is nothing
+    to partition — both are safe. The shared policy behind the "auto"
+    backends of ops/fused_xent.py and the flash-attention dispatch
+    (models/transformer.py)."""
+    if any(getattr(jax.typeof(o), "vma", None) for o in operands):
+        return True
+    return not _IN_SHARDED_JIT[0]
+
+
+def pick_block(t: int, preferred: int, unit: int = 1):
+    """Largest divisor of ``t`` that is <= preferred and a multiple of
+    ``unit`` (block shapes must tile the dimension). Returns None when t is
+    not a multiple of unit — on real TPU, Mosaic rejects blocks that are not
+    tile-aligned (8 sublanes / 128 lanes), so compiled kernels pass the
+    hardware unit and fall back (or error clearly) on a None instead of
+    handing Mosaic an arbitrary divisor (ADVICE r1)."""
+    if t % unit:
+        return None
+    b = max(unit, min(preferred - preferred % unit, t))
+    while t % b:
+        b -= unit
+    return b
 
 
 def pallas_out_struct(shape, dtype, *operands):

@@ -66,9 +66,11 @@ def correct_topk(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
 def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
                          aux_weight, smoothing, fused, accum_steps: int):
     """K-way gradient accumulation: split the leading batch axis into K
-    micro-steps, scan value_and_grad over them, and AVERAGE the gradients
-    (Horovod ``DistributedOptimizer(op=hvd.Average,
-    backward_passes_per_step=K)`` semantics, imagenet_horovod.py:131-139; the
+    micro-steps, scan value_and_grad over them, and average the gradients
+    weighted by each micro-step's valid-label count (exact K=1 equivalence;
+    uniform weights — Horovod ``DistributedOptimizer(op=hvd.Average,
+    backward_passes_per_step=K)`` semantics, imagenet_horovod.py:131-139 —
+    whenever all labels are valid, which is every reference workload; the
     matching lr x K scaling lives in train/loop.py). BatchNorm state threads
     sequentially through the micro-steps, exactly as K separate batches
     would. Returns (loss, ce, (correct, valid), new_state, grads).
@@ -76,6 +78,13 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
     K = accum_steps
     B = x.shape[0]
     assert B % K == 0, f"batch {B} not divisible by grad_accum_steps {K}"
+    # Micro-step losses are means over that step's VALID label positions, so
+    # the K-step average only equals the K=1 full-batch gradient when every
+    # micro-step has the same valid count. Weighting each micro-gradient by
+    # its valid count restores exact K=1 equivalence for masked token/seq2seq
+    # workloads; for image workloads (all labels valid — the only case the
+    # reference's backward_passes_per_step ever sees) the weights are uniform
+    # and this IS Horovod's equal-weight average.
     # Micro-step k takes every K-th row (reshape [B//K, K, ...], index axis
     # 1): with the batch sharded on axis 0 this keeps each micro-batch's rows
     # local to their device — Horovod's per-worker accumulation — whereas a
@@ -99,14 +108,17 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
 
         (obj, (ce, (corr, valid), new_st)), g = jax.value_and_grad(
             f, has_aux=True)(params)
-        gsum = jax.tree.map(jnp.add, gsum, g)
+        wk = valid.astype(jnp.float32)
+        gsum = jax.tree.map(lambda a, b: a + wk * b, gsum, g)
         return (new_st, gsum), (obj, ce, corr, valid)
 
     init = (model_state, jax.tree.map(jnp.zeros_like, params))
     (new_state, gsum), (objs, ces, corrs, valids) = lax.scan(
         step, init, jnp.arange(K))
-    grads = jax.tree.map(lambda g: g / K, gsum)
-    return (jnp.mean(objs), jnp.mean(ces),
+    wks = valids.astype(jnp.float32)
+    total = jnp.maximum(1.0, jnp.sum(wks))
+    grads = jax.tree.map(lambda g: g / total, gsum)
+    return (jnp.sum(objs * wks) / total, jnp.sum(ces * wks) / total,
             (jnp.sum(corrs), jnp.sum(valids)), new_state, grads)
 
 

@@ -60,11 +60,13 @@ class DPStrategy:
             # batch axis is sharded under one jit). With grad_accum_steps > 1
             # this is Horovod backward_passes_per_step parity: K micro-steps,
             # one allreduce on the averaged gradient.
+            from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import loss_and_grads
 
-            ce, (correct, valid), new_state, grads = loss_and_grads(
-                model, cfg, ts.params, ts.model_state, x, y,
-                self.compute_dtype, smooth)
+            with sharded_jit_tracing():  # auto-Pallas unsafe under GSPMD
+                ce, (correct, valid), new_state, grads = loss_and_grads(
+                    model, cfg, ts.params, ts.model_state, x, y,
+                    self.compute_dtype, smooth)
             params, opt = opt_update(ts.params, grads, ts.opt, lr)
             metrics = {
                 "loss": ce,
@@ -74,10 +76,12 @@ class DPStrategy:
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
+            from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import eval_metrics
 
-            return eval_metrics(model, cfg, ts.params, ts.model_state, x, y,
-                                self.compute_dtype)
+            with sharded_jit_tracing():
+                return eval_metrics(model, cfg, ts.params, ts.model_state,
+                                    x, y, self.compute_dtype)
 
         self.train_step = jax.jit(
             train_step,

@@ -79,11 +79,13 @@ class _ShardedParamStrategy:
         smooth = cfg.resolved_label_smoothing()
 
         def train_step(ts: TrainState, x, y, lr):
+            from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import loss_and_grads
 
-            ce, (correct, valid), new_state, grads = loss_and_grads(
-                model, cfg, ts.params, ts.model_state, x, y,
-                self.compute_dtype, smooth)
+            with sharded_jit_tracing():  # auto-Pallas unsafe under GSPMD
+                ce, (correct, valid), new_state, grads = loss_and_grads(
+                    model, cfg, ts.params, ts.model_state, x, y,
+                    self.compute_dtype, smooth)
             params, opt = opt_update(ts.params, grads, ts.opt, lr)
             metrics = {
                 "loss": ce,
@@ -93,10 +95,12 @@ class _ShardedParamStrategy:
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
+            from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import eval_metrics
 
-            return eval_metrics(model, cfg, ts.params, ts.model_state, x, y,
-                                self.compute_dtype)
+            with sharded_jit_tracing():
+                return eval_metrics(model, cfg, ts.params, ts.model_state,
+                                    x, y, self.compute_dtype)
 
         self.train_step = jax.jit(
             train_step,

@@ -52,6 +52,10 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    from ddlbench_tpu.distributed import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from ddlbench_tpu.config import DATASETS
     from ddlbench_tpu.models import init_model
     from ddlbench_tpu.models.zoo import get_model

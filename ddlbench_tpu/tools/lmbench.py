@@ -50,6 +50,10 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
+    from ddlbench_tpu.distributed import enable_compilation_cache
+
+    enable_compilation_cache()
+
     from ddlbench_tpu.config import DATASETS, RunConfig
     from ddlbench_tpu.data.synthetic import make_synthetic
     from ddlbench_tpu.distributed import is_tpu_backend

@@ -30,10 +30,18 @@ def _xla_reference_backend():
 
 
 def test_pick_block():
+    import pytest
+
     assert _pick_block(1024, 512) == 512
     assert _pick_block(96, 128) == 96
-    assert _pick_block(96, 64) == 48  # largest divisor <= 64
-    assert _pick_block(7, 4) == 1
+    # interpret mode: any divisor tiles
+    assert _pick_block(96, 64, interpret=True) == 48
+    assert _pick_block(7, 4, interpret=True) == 1
+    # compiled: blocks must be 8-aligned (Mosaic sublane tile)
+    assert _pick_block(96, 64) == 48  # 48 = 6*8, largest 8-multiple divisor
+    assert _pick_block(1024, 500) == 256
+    with pytest.raises(ValueError, match="multiple of 8"):
+        _pick_block(7, 4)
 
 
 def test_forward_matches_reference():

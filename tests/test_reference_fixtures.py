@@ -52,6 +52,59 @@ def test_parse_branchy_resnext50_and_compress():
     assert len(c.antichain_dag()[0]) <= len(g.antichain_dag()[0])
 
 
+def test_small_fixtures_parse_without_node_prefix():
+    """The reference's graph/test.py fixtures (test.txt, test2.txt) spell
+    nodes without the ``node`` id prefix; the parser accepts both."""
+    g1 = _load("test.txt")
+    assert set(g1.nodes) == {"0", "1", "2", "3", "4", "5"}
+    assert {n.node_id for n in g1.sources()} == {"4", "5"}
+    assert g1.predecessors("0") == {"4", "5"}
+    g2 = _load("test2.txt")
+    assert g2.predecessors("3") == {"0", "1", "2", "4"}
+
+
+def test_depths_heights_golden():
+    """populate_depths/populate_heights longest-path semantics (reference
+    graph.py:87-115) on the hand-checkable test2.txt diamond:
+    0 -> {1,2,4} -> 3."""
+    g = _load("test2.txt")
+    g.populate_depths()
+    g.populate_heights()
+    assert {i: n.depth for i, n in g.nodes.items()} == {
+        "0": 1, "1": 2, "2": 2, "4": 2, "3": 3}
+    assert {i: n.height for i, n in g.nodes.items()} == {
+        "0": 3, "1": 2, "2": 2, "4": 2, "3": 1}
+
+
+def test_is_series_parallel_golden():
+    """SP reduction (reference graph.py:229-243, test.py:83-86): the
+    two-terminal diamond test2.txt and the residual-branch model profiles
+    are SP; the two-source crosshatch test.txt is not."""
+    assert _load("test2.txt").is_series_parallel()
+    assert not _load("test.txt").is_series_parallel()
+    assert _load("vgg16_partitioned.txt").is_series_parallel()
+    assert _load("resnet50_partitioned.txt").is_series_parallel()
+    assert _load("resnext50_generated.txt").is_series_parallel()
+
+
+def test_check_isomorphism_golden():
+    """check_isomorphism (reference graph.py:275-289, test.py:88-90): a
+    reserialized copy passes; a graph with one edited desc fails; the
+    resnet50 vs resnext50 profiles (same shape, different conv descs)
+    fail on desc."""
+    g = _load("resnext50_generated.txt")
+    g.check_isomorphism(_load("resnext50_generated.txt"))
+    g2 = Graph.from_str(str(g))
+    g.check_isomorphism(g2)
+    bad = Graph.from_str(str(g))
+    some = next(iter(bad.nodes.values()))
+    some.node_desc = some.node_desc + " (edited)"
+    with pytest.raises(ValueError):
+        g.check_isomorphism(bad)
+    with pytest.raises(ValueError):
+        g.check_isomorphism(_load("resnet50_partitioned.txt"))
+
+
 def test_partitioner_runs_on_reference_profile():
     """The hierarchical DP consumes a real reference profile end-to-end."""
     from ddlbench_tpu.config import HardwareModel
