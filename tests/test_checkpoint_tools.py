@@ -6,6 +6,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models import get_model
 from ddlbench_tpu.models.layers import LayerModel, dense, flatten

@@ -3,6 +3,8 @@
 import jax
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.tools.commbench import _mesh_and_shardings, bench_collective
 
 

@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 import ddlbench_tpu.models.seq2seq as s2s
 import ddlbench_tpu.models.decode as dec
 from ddlbench_tpu.models.layers import apply_model, init_model

@@ -12,6 +12,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, conv_bn, dense, flatten, global_avg_pool
 

@@ -10,6 +10,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.models.transformer import (
     causal_attention,
     set_attention_backend,

@@ -5,6 +5,8 @@ import jax.numpy as jnp
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.ops.fused_xent import fused_linear_xent
 from ddlbench_tpu.parallel.common import cross_entropy_loss
 

@@ -11,6 +11,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
 from jax.flatten_util import ravel_pytree
 
 from ddlbench_tpu.config import RunConfig

@@ -1,5 +1,9 @@
 """LM microbenchmark tool: runs end-to-end on CPU and reports both configs."""
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 import json
 
 from tiny_models import TINY_LM  # registers transformer_t

@@ -15,6 +15,10 @@ ep (axis_sharded.py + expert-sharded param trees + cross-process all_to_all),
 and sp (the ring-attention K/V rotation crossing the process boundary).
 """
 
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 import os
 import socket
 import subprocess

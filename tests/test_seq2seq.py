@@ -10,6 +10,8 @@ import jax
 import jax.numpy as jnp
 import pytest
 
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
 from ddlbench_tpu.config import DatasetSpec, RunConfig
 import ddlbench_tpu.models.seq2seq as s2s
 from ddlbench_tpu.models.layers import init_model, apply_model
