@@ -49,6 +49,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="interleaved gpipe schedule: model chunks per device "
                         "(cuts the pipeline bubble by this factor)")
     p.add_argument("--dp-replicas", type=int, default=1)
+    p.add_argument("--stage-replication", default=None,
+                   help="uneven hybrid PPxDP: comma list of per-stage "
+                        "replication factors summing to -g, e.g. 1,3 "
+                        "(parallel/hetero.py; the reference optimizer's "
+                        "heterogeneous plans)")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="gradient-accumulation micro-steps per update "
@@ -125,6 +130,9 @@ def config_from_args(args) -> RunConfig:
         num_stages=args.stages,
         virtual_stages=args.virtual_stages,
         dp_replicas=args.dp_replicas,
+        stage_replication=(tuple(int(r) for r in
+                                 args.stage_replication.split(","))
+                           if args.stage_replication else None),
         steps_per_epoch=args.steps_per_epoch,
         grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,

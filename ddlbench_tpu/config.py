@@ -205,7 +205,13 @@ class RunConfig:
 
     # Pipeline topology.
     num_stages: Optional[int] = None  # defaults to num_devices // dp_replicas
-    dp_replicas: int = 1  # hybrid PPxDP: replicas per stage
+    dp_replicas: int = 1  # hybrid PPxDP: replicas per stage (uniform)
+    # Uneven hybrid PPxDP: per-stage replication factors, e.g. (1, 3) — the
+    # reference optimizer's heterogeneous plans (run_template.sh:436-498).
+    # Executed by parallel/hetero.py over a flat 'pipe' mesh axis; mutually
+    # exclusive with dp_replicas > 1. Uniform tuples route to the regular
+    # 2-D-mesh strategies.
+    stage_replication: Optional[Tuple[int, ...]] = None
     # Interleaved schedule (gpipe only): each device owns this many model
     # chunks, cutting the synchronous-pipeline bubble by the same factor at
     # the cost of more (cheap, ICI-neighbor) rotations. Requires
@@ -299,6 +305,8 @@ class RunConfig:
         return 1e-4 if self.benchmark in ("imagenet", "highres") else 0.0
 
     def resolved_stages(self) -> int:
+        if self.stage_replication:
+            return len(self.stage_replication)
         if self.num_stages is not None:
             return self.num_stages
         return max(1, self.num_devices // max(1, self.dp_replicas))
@@ -336,6 +344,10 @@ class RunConfig:
             return mb * accum  # sp/tp shard sequence/features, not the batch
         if self.strategy in ("dp", "fsdp", "ep"):
             return mb * self.num_devices * accum
+        if self.stage_replication:
+            # hetero pipeline: replicas split each microbatch's rows, so the
+            # global batch carries no replication factor
+            return mb * chunks
         return mb * chunks * max(1, self.dp_replicas)
 
     def validate(self) -> None:
@@ -365,7 +377,36 @@ class RunConfig:
                 raise ValueError("ep (expert parallelism) requires a token benchmark")
             if "moe" not in self.arch:
                 raise ValueError("ep (expert parallelism) requires an MoE arch")
-        if self.strategy in ("gpipe", "pipedream"):
+        if self.stage_replication is not None:
+            repl = tuple(self.stage_replication)
+            if self.strategy not in ("gpipe", "pipedream"):
+                raise ValueError(
+                    "stage_replication applies to the pipeline strategies")
+            if not repl or any(r < 1 for r in repl):
+                raise ValueError("stage_replication factors must be >= 1")
+            if self.dp_replicas > 1:
+                raise ValueError(
+                    "stage_replication and dp_replicas are mutually "
+                    "exclusive (the tuple already encodes replication)")
+            if sum(repl) != self.num_devices:
+                raise ValueError(
+                    f"stage_replication {repl} sums to {sum(repl)}; "
+                    f"num_devices is {self.num_devices}")
+            if self.num_stages is not None and self.num_stages != len(repl):
+                raise ValueError(
+                    f"num_stages ({self.num_stages}) != "
+                    f"len(stage_replication) ({len(repl)})")
+            mb, _ = self.resolved_batches()
+            bad = [s for s, r in enumerate(repl) if mb % r]
+            if bad:
+                raise ValueError(
+                    f"micro-batch {mb} must be divisible by every "
+                    f"replication factor; stages {bad} of {repl} are not")
+            if self.virtual_stages > 1:
+                raise ValueError(
+                    "stage_replication and virtual_stages (interleaved "
+                    "schedule) are mutually exclusive")
+        elif self.strategy in ("gpipe", "pipedream"):
             s = self.resolved_stages()
             if s * max(1, self.dp_replicas) != self.num_devices:
                 raise ValueError(

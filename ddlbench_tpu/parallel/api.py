@@ -33,9 +33,13 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
     stage_bounds = None
     if cfg.auto_partition and cfg.strategy in ("gpipe", "pipedream"):
-        # profile -> partition: the reference's PipeDream phases 1-3
-        # (profiler main.py -> optimizer_graph_hierarchical.py ->
-        # convert_graph_to_model.py) collapsed into two calls.
+        # profile -> partition -> EXECUTE the plan: the reference's PipeDream
+        # phases 1-3 (profiler main.py -> optimizer_graph_hierarchical.py ->
+        # convert_graph_to_model.py), whose output actually configures its
+        # runtime (run_template.sh:436-498). The plan's stage bounds and
+        # per-stage replication factors drive the mesh: uniform plans run on
+        # the 2-D ('data','stage') mesh, uneven plans on parallel/hetero.py's
+        # flat 'pipe' axis.
         from ddlbench_tpu.partition.optimizer import (
             partition_hierarchical,
             stage_bounds_from_graph,
@@ -44,18 +48,46 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
         mb, _ = cfg.resolved_batches()
         graph = profile_model(model, mb, mode=cfg.profile_mode, hw=cfg.hardware)
-        # interleaved gpipe partitions into S*V chunks, not S stages
-        num_parts = cfg.resolved_stages() * max(1, cfg.virtual_stages)
-        stage_bounds = stage_bounds_from_graph(graph, num_parts)
         plan = partition_hierarchical(
             graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
         )
-        print(
-            f"auto-partition: bounds={stage_bounds}; unconstrained plan: "
-            f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
-            f"bottleneck {plan.pipeline_time_ms:.3f} ms",
-            flush=True,
-        )
+        repl = tuple(s.replication for s in plan.stages)
+        if cfg.virtual_stages > 1:
+            # interleaved gpipe partitions into S*V chunks, not S stages; the
+            # replication plan stays advisory here
+            num_parts = cfg.resolved_stages() * cfg.virtual_stages
+            stage_bounds = stage_bounds_from_graph(graph, num_parts)
+            print(
+                f"auto-partition (interleaved, advisory): "
+                f"bounds={stage_bounds}; plan "
+                f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
+                f"bottleneck {plan.pipeline_time_ms:.3f} ms",
+                flush=True,
+            )
+        else:
+            cfg_planned = cfg.replace(
+                num_stages=None, dp_replicas=1, stage_replication=repl)
+            try:
+                cfg_planned.validate()
+                stage_bounds = plan.stage_bounds()
+                cfg = cfg_planned
+                print(
+                    f"auto-partition: executing plan "
+                    f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
+                    f"(bounds={stage_bounds}, replication={repl}, "
+                    f"bottleneck {plan.pipeline_time_ms:.3f} ms)",
+                    flush=True,
+                )
+            except ValueError as e:
+                # e.g. micro-batch not divisible by a replication factor:
+                # keep the profiled balanced split rather than fail the run
+                stage_bounds = stage_bounds_from_graph(
+                    graph, cfg.resolved_stages())
+                print(
+                    f"auto-partition: plan {repl} not executable ({e}); "
+                    f"falling back to balanced bounds {stage_bounds}",
+                    flush=True,
+                )
         if cfg.strategy == "gpipe":
             from ddlbench_tpu.partition.schedule import recommend_virtual_stages
 
@@ -73,11 +105,34 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
         mesh = make_data_mesh(cfg.num_devices, devices)
         return DPStrategy(model, cfg, mesh)
+    repl = tuple(cfg.stage_replication or ())
+    if repl and len(set(repl)) == 1:
+        # Uniform plan: the regular 2-D ('data','stage') mesh executes it
+        # (cheaper than the flat-axis conveyor). stage_replication semantics
+        # are "replicas split each microbatch's rows", so the per-replica
+        # micro-batch becomes mb/r — the global batch stays M*mb, matching
+        # cfg.global_batch()'s stage_replication accounting for the caller.
+        mb_, chunks_ = cfg.resolved_batches()
+        cfg = cfg.replace(stage_replication=None, dp_replicas=repl[0],
+                          num_stages=len(repl),
+                          micro_batch_size=mb_ // repl[0],
+                          num_microbatches=chunks_)
+        repl = ()
     if cfg.strategy == "gpipe":
+        if repl:
+            from ddlbench_tpu.parallel.hetero import HeteroGPipeStrategy
+
+            return HeteroGPipeStrategy(model, cfg, devices=devices,
+                                       stage_bounds=stage_bounds)
         from ddlbench_tpu.parallel.gpipe import GPipeStrategy
 
         return GPipeStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)
     if cfg.strategy == "pipedream":
+        if repl:
+            from ddlbench_tpu.parallel.hetero import HeteroPipeDreamStrategy
+
+            return HeteroPipeDreamStrategy(model, cfg, devices=devices,
+                                           stage_bounds=stage_bounds)
         from ddlbench_tpu.parallel.pipedream import PipeDreamStrategy
 
         return PipeDreamStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)

@@ -1,0 +1,54 @@
+"""Explicit real-dataset import CLI.
+
+Converts a standard on-disk dataset (ImageFolder JPEG tree, MNIST IDX
+archives, or CIFAR-10 python batches) into the native raw store the -s data
+path serves from (data/imagefolder.py does the same lazily on first use).
+
+Usage:
+    python -m ddlbench_tpu.tools.import_data -b mnist --src /path/to/MNIST \\
+        --dest /path/to/datadir
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-b", "--benchmark", required=True)
+    p.add_argument("--src", required=True, help="real dataset root")
+    p.add_argument("--dest", required=True,
+                   help="data_dir the benchmarks will use (--data-dir)")
+    p.add_argument("--splits", default="train,test")
+    args = p.parse_args(argv)
+
+    from ddlbench_tpu.config import DATASETS
+    from ddlbench_tpu.data import imagefolder as imf
+
+    if args.benchmark not in DATASETS:
+        p.error(f"unknown benchmark {args.benchmark!r}")
+    spec = DATASETS[args.benchmark]
+    if spec.kind != "image":
+        p.error("import supports image benchmarks (token workloads are "
+                "synthetic streams)")
+    import os
+
+    for raw_split in args.splits.split(","):
+        try:
+            split = imf.normalize_split(raw_split)
+        except ValueError as e:
+            p.error(str(e))
+        out = os.path.join(args.dest, spec.name, split)
+        done = imf.detect_and_import(args.src, spec, split, out)
+        if not done:
+            print(f"error: no recognizable {split} data under {args.src}",
+                  file=sys.stderr)
+            return 1
+        print(f"imported {split} -> {done}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -268,3 +268,127 @@ def test_schedule_advisor():
     # layer count caps the chunk count
     assert all(r["virtual_stages"] * 4 <= 9
                for r in recommend_virtual_stages(4, 8, num_layers=9))
+
+
+def test_plan_beats_balanced_split_on_heterogeneous_profile():
+    """VERDICT r1 #3: a profile where the hierarchical DP's choice beats the
+    naive balanced min-max split on simulated pipeline time. Heavy-parameter
+    light-compute head + light-parameter heavy-compute tail: the balanced
+    2-stage split bottlenecks on the tail; the DP replicates it (or goes
+    pure-DP) and wins under its own cost model."""
+    from ddlbench_tpu.parallel.packing import balanced_stage_bounds
+    from ddlbench_tpu.partition.optimizer import (
+        _allreduce_ms, _ms, partition_hierarchical)
+
+    hw = HardwareModel()
+    times = [6.0, 6.0, 36.0]
+    params = [45e6, 45e6, 1e4]
+    acts = [1e5, 1e5, 1e5]
+    g = chain_graph(times, params=params, acts=acts)
+    plan = partition_hierarchical(g, 4, hw)
+
+    def simulated_time(bounds, repl):
+        worst = 0.0
+        for s in range(len(repl)):
+            i, j = bounds[s], bounds[s + 1]
+            t = sum(times[i:j]) / repl[s]
+            t += _allreduce_ms(sum(params[i:j]), repl[s], hw.ici_bandwidth)
+            worst = max(worst, t)
+            if j < len(times):
+                worst = max(worst, _ms(acts[j - 1], hw.ici_bandwidth))
+        return worst
+
+    naive_bounds = balanced_stage_bounds(times, 4)
+    naive = simulated_time(naive_bounds, [1, 1, 1, 1])
+    planned = simulated_time(plan.stage_bounds(),
+                             [s.replication for s in plan.stages])
+    assert planned < naive
+    assert abs(planned - plan.pipeline_time_ms) < 1e-6
+    # this profile's optimum replicates the heavy tail: an UNEVEN plan
+    repl = [s.replication for s in plan.stages]
+    assert len(set(repl)) > 1, repl
+
+
+def test_auto_partition_is_load_bearing(devices, monkeypatch):
+    """make_strategy must EXECUTE the hierarchical plan (reference parity:
+    run_template.sh:436-498 wires the optimizer output into the runtime):
+    an uneven plan routes to the hetero engine with the plan's bounds and
+    replication, not the balanced split."""
+    import ddlbench_tpu.parallel.api as api
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+    from ddlbench_tpu.parallel.hetero import HeteroGPipeStrategy
+
+    model = LayerModel(
+        "tiny3", [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)],
+        (4, 4, 1), 10)
+    times = [6.0, 6.0, 36.0]
+    params = [45e6, 45e6, 1e4]
+    g = chain_graph(times, params=params, acts=[1e5] * 3)
+
+    monkeypatch.setattr(api, "get_model", lambda *a, **k: model)
+    import ddlbench_tpu.profiler.profile as prof
+
+    monkeypatch.setattr(prof, "profile_model", lambda *a, **k: g)
+
+    cfg = RunConfig(strategy="gpipe", benchmark="mnist", num_devices=4,
+                    auto_partition=True, micro_batch_size=6,
+                    num_microbatches=2, compute_dtype="float32")
+    strat = api.make_strategy(cfg)
+    assert isinstance(strat, HeteroGPipeStrategy)
+    assert strat.repl == (1, 3)
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ts = strat.init(jax.random.key(0))
+    assert strat.bounds == [0, 2, 3]
+    # and it trains
+    x = jax.random.normal(jax.random.key(1), (12, 4, 4, 1))
+    y = jax.random.randint(jax.random.key(2), (12,), 0, 10)
+    xs, ys = strat.shard_batch(x, y)
+    ts2, m = strat.train_step(ts, xs, ys, jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_auto_partition_uniform_plan_routes_to_regular_mesh(devices,
+                                                            monkeypatch):
+    """A pure-DP plan (single stage, full replication) normalizes to the
+    regular 2-D mesh gpipe (S=1, dp=N)."""
+    import ddlbench_tpu.parallel.api as api
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+
+    model = LayerModel(
+        "tiny3", [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)],
+        (4, 4, 1), 10)
+    # light params, flat compute: replicating everything wins
+    g = chain_graph([4.0, 4.0, 4.0], params=[1e4] * 3, acts=[1e5] * 3)
+
+    monkeypatch.setattr(api, "get_model", lambda *a, **k: model)
+    import ddlbench_tpu.profiler.profile as prof
+
+    monkeypatch.setattr(prof, "profile_model", lambda *a, **k: g)
+
+    cfg = RunConfig(strategy="gpipe", benchmark="mnist", num_devices=2,
+                    auto_partition=True, micro_batch_size=4,
+                    num_microbatches=2, compute_dtype="float32")
+    strat = api.make_strategy(cfg)
+    assert isinstance(strat, GPipeStrategy)
+    assert strat.num_stages == 1 and strat.dp == 2
+    # stage_replication semantics: replicas split the microbatch, so the
+    # per-replica micro-batch is mb/r and the caller's global_batch (M*mb)
+    # feeds shard_batch exactly
+    assert strat.mb == 2
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    ts = strat.init(jax.random.key(0))
+    B = cfg.global_batch()
+    assert B == 4 * 2
+    x = jax.random.normal(jax.random.key(1), (B, 4, 4, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    ts2, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))

@@ -1,0 +1,168 @@
+"""Real-data ingest (data/imagefolder.py): ImageFolder / MNIST IDX / CIFAR
+pickles -> native raw store -> OnDiskData batches (VERDICT r1 #4).
+
+Fixtures are tiny synthetic archives in the exact on-disk formats the real
+datasets ship in (the reference consumes the ImageFolder layout its factory
+writes, generate_synthetic_data.py:21-46).
+"""
+
+import gzip
+import json
+import os
+import pickle
+import struct
+
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import DATASETS
+from ddlbench_tpu.data import imagefolder as imf
+
+PIL = pytest.importorskip("PIL")
+from PIL import Image  # noqa: E402
+
+
+def _make_imagefolder(root, n_classes=3, per_class=4, size=(28, 28),
+                      mode="L", split="train"):
+    rng = np.random.default_rng(0)
+    for c in range(n_classes):
+        d = os.path.join(root, split, f"class_{c}")
+        os.makedirs(d, exist_ok=True)
+        for i in range(per_class):
+            arr = rng.integers(0, 255, (*size, 1 if mode == "L" else 3),
+                               dtype=np.uint8)
+            Image.fromarray(arr.squeeze(), mode).save(
+                os.path.join(d, f"img_{i}.JPEG"))
+
+
+def test_import_imagefolder_roundtrip(tmp_path):
+    src = tmp_path / "src"
+    _make_imagefolder(str(src), split="train")
+    out = imf.import_imagefolder(str(src / "train"), str(tmp_path / "out"),
+                                 (28, 28, 1), 10)
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["count"] == 12 and (meta["h"], meta["w"], meta["c"]) == (28, 28, 1)
+    imgs = np.fromfile(os.path.join(out, "images.bin"), np.uint8)
+    assert imgs.size == 12 * 28 * 28
+    lbls = np.fromfile(os.path.join(out, "labels.bin"), np.int32)
+    # sorted class dirs -> 4 samples per class id
+    assert lbls.tolist() == sorted([0, 1, 2] * 4)
+
+
+def test_import_resizes_and_converts(tmp_path):
+    src = tmp_path / "src"
+    _make_imagefolder(str(src), n_classes=2, per_class=2, size=(40, 40),
+                      mode="RGB", split="train")
+    out = imf.import_imagefolder(str(src / "train"), str(tmp_path / "out"),
+                                 (28, 28, 1), 10)
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["count"] == 4
+    imgs = np.fromfile(os.path.join(out, "images.bin"), np.uint8)
+    assert imgs.size == 4 * 28 * 28  # RGB 40x40 -> L 28x28
+
+
+def test_mnist_idx_import(tmp_path):
+    raw = tmp_path / "MNIST" / "raw"
+    os.makedirs(raw)
+    rng = np.random.default_rng(1)
+    imgs = rng.integers(0, 255, (10, 28, 28), dtype=np.uint8)
+    lbls = rng.integers(0, 10, (10,), dtype=np.uint8)
+    with gzip.open(raw / "train-images-idx3-ubyte.gz", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 3) + struct.pack(">3I", 10, 28, 28)
+                + imgs.tobytes())
+    with open(raw / "train-labels-idx1-ubyte", "wb") as f:
+        f.write(struct.pack(">HBB", 0, 8, 1) + struct.pack(">I", 10)
+                + lbls.tobytes())
+    out = imf.import_mnist_idx(str(tmp_path), str(tmp_path / "out"), "train",
+                               (28, 28, 1))
+    got = np.fromfile(os.path.join(out, "images.bin"), np.uint8)
+    np.testing.assert_array_equal(got, imgs.reshape(-1))
+    got_l = np.fromfile(os.path.join(out, "labels.bin"), np.int32)
+    np.testing.assert_array_equal(got_l, lbls.astype(np.int32))
+
+
+def test_cifar10_pickle_import(tmp_path):
+    src = tmp_path / "cifar-10-batches-py"
+    os.makedirs(src)
+    rng = np.random.default_rng(2)
+    for name, n in [("data_batch_1", 6), ("test_batch", 4)]:
+        data = rng.integers(0, 255, (n, 3072), dtype=np.uint8)
+        with open(src / name, "wb") as f:
+            pickle.dump({b"data": data,
+                         b"labels": rng.integers(0, 10, n).tolist()}, f)
+    for i in range(2, 6):
+        with open(src / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": rng.integers(0, 255, (2, 3072),
+                                               dtype=np.uint8),
+                         b"labels": rng.integers(0, 10, 2).tolist()}, f)
+    out = imf.import_cifar10(str(tmp_path), str(tmp_path / "out"), "train",
+                             (32, 32, 3))
+    meta = json.load(open(os.path.join(out, "meta.json")))
+    assert meta["count"] == 6 + 4 * 2
+    out_t = imf.import_cifar10(str(tmp_path), str(tmp_path / "outt"), "test",
+                               (32, 32, 3))
+    assert json.load(open(os.path.join(out_t, "meta.json")))["count"] == 4
+
+
+def test_resolve_split_reference_layout_end_to_end(tmp_path):
+    """The reference's generated layout (<root>/mnist/{train,val}/class_n/)
+    feeds OnDiskData batches through the native loader — i.e.
+    ``-s --data-dir <reference layout>`` works."""
+    pytest.importorskip("ddlbench_tpu.data.native_loader")
+    from ddlbench_tpu.data.native_loader import available
+
+    if not available():
+        pytest.skip("native loader not buildable")
+    root = tmp_path / "data"
+    _make_imagefolder(str(root / "mnist"), n_classes=2, per_class=4,
+                      split="train")
+    _make_imagefolder(str(root / "mnist"), n_classes=2, per_class=4,
+                      split="val")
+
+    from ddlbench_tpu.data.ondisk import OnDiskData
+
+    data = OnDiskData(str(root), DATASETS["mnist"], batch_size=4,
+                      augment=False)
+    x, y = data.batch(0, 0)
+    assert x.shape == (4, 28, 28, 1)
+    assert y.shape == (4,)
+    assert float(abs(x).max()) < 10.0  # normalized
+    xt, yt = data.batch(0, 0, train=False)
+    assert xt.shape == (4, 28, 28, 1)
+    data.close()
+    # second open reuses the imported cache (no re-import)
+    cache = root / "_imported" / "mnist" / "train" / "meta.json"
+    assert cache.exists()
+    mtime = cache.stat().st_mtime
+    data2 = OnDiskData(str(root), DATASETS["mnist"], batch_size=4,
+                       augment=False)
+    data2.close()
+    assert cache.stat().st_mtime == mtime
+
+
+def test_resolve_split_returns_none_for_empty(tmp_path):
+    assert imf.resolve_split(str(tmp_path), DATASETS["mnist"], "train") is None
+    # and it leaves no _imported litter behind (detection-first)
+    assert not (tmp_path / "_imported").exists()
+
+
+def test_too_many_class_dirs_rejected(tmp_path):
+    src = tmp_path / "src"
+    _make_imagefolder(str(src), n_classes=12, per_class=1, split="train")
+    with pytest.raises(ValueError, match="12 class directories"):
+        imf.import_imagefolder(str(src / "train"), str(tmp_path / "out"),
+                               (28, 28, 1), 10)
+
+
+def test_import_data_cli_val_alias(tmp_path):
+    """tools/import_data accepts the reference's 'val' spelling."""
+    from ddlbench_tpu.tools.import_data import main
+
+    src = tmp_path / "src"
+    _make_imagefolder(str(src / "mnist"), n_classes=2, per_class=2,
+                      split="val")
+    dest = tmp_path / "dest"
+    rc = main(["-b", "mnist", "--src", str(src), "--dest", str(dest),
+               "--splits", "val"])
+    assert rc == 0
+    assert (dest / "mnist" / "test" / "meta.json").exists()
