@@ -54,6 +54,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "replication factors summing to -g, e.g. 1,3 "
                         "(parallel/hetero.py; the reference optimizer's "
                         "heterogeneous plans)")
+    p.add_argument("--update-interval", type=int, default=1,
+                   help="pipedream macrobatch: accumulate grads over K "
+                        "microbatches per optimizer step (reference "
+                        "runtime/optimizer.py update_interval)")
     p.add_argument("--steps-per-epoch", type=int, default=None)
     p.add_argument("--grad-accum-steps", type=int, default=1,
                    help="gradient-accumulation micro-steps per update "
@@ -133,6 +137,7 @@ def config_from_args(args) -> RunConfig:
         stage_replication=(tuple(int(r) for r in
                                  args.stage_replication.split(","))
                            if args.stage_replication else None),
+        update_interval=args.update_interval,
         steps_per_epoch=args.steps_per_epoch,
         grad_accum_steps=args.grad_accum_steps,
         lr=args.lr,

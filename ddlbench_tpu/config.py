@@ -217,6 +217,13 @@ class RunConfig:
     # the cost of more (cheap, ICI-neighbor) rotations. Requires
     # num_microbatches % stages == 0 when > 1.
     virtual_stages: int = 1
+    # PipeDream macrobatch mode (runtime/optimizer.py:36-52,119-164):
+    # accumulate gradients across update_interval microbatches inside the
+    # 1F1B schedule and step once per interval (grads averaged /K). The
+    # reference caps weight stashing at 2 versions here and accepts version
+    # staleness; our stash ring keeps exact per-microbatch forward weights
+    # (documented deviation in parallel/pipedream.py).
+    update_interval: int = 1
 
     # Auto-parallelism: profile the model and choose stage bounds with the
     # hierarchical partitioner before building the pipeline strategies
@@ -415,6 +422,23 @@ class RunConfig:
                 )
         if self.virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
+        if self.update_interval < 1:
+            raise ValueError("update_interval must be >= 1")
+        if self.update_interval > 1:
+            # uniform stage_replication tuples normalize to dp_replicas in
+            # make_strategy and ARE macrobatch-compatible; only genuinely
+            # uneven plans conflict
+            uneven = (self.stage_replication
+                      and len(set(self.stage_replication)) > 1)
+            if self.strategy != "pipedream" or uneven:
+                raise ValueError(
+                    "update_interval > 1 (PipeDream macrobatch) requires the "
+                    "uniform pipedream strategy")
+            _, chunks = self.resolved_batches()
+            if chunks % self.update_interval:
+                raise ValueError(
+                    f"num_microbatches ({chunks}) must be divisible by "
+                    f"update_interval ({self.update_interval})")
         if self.grad_accum_steps < 1:
             raise ValueError("grad_accum_steps must be >= 1")
         if self.optimizer is not None and self.optimizer not in ("sgd", "adam"):

@@ -182,6 +182,15 @@ class PipeDreamStrategy(GPipeStrategy):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb
         H = 2 * M + 2 * S - 2
         NSLOT = min(S, M)
+        # Macrobatch mode (reference runtime/optimizer.py:36-52,119-164):
+        # gradients accumulate across K consecutive microbatches' backwards
+        # and the optimizer steps once per interval with the /K average.
+        # Deviation (documented): the reference caps its version queue at 2
+        # and its backward may read a version one commit staler than the
+        # forward actually used; our stash ring keeps the exact forward
+        # weights per in-flight microbatch either way (no extra memory — the
+        # ring is bounded by min(S, M) regardless).
+        K = max(1, self.cfg.update_interval)
         opt_update = self._opt_update
         smooth = self.cfg.resolved_label_smoothing()
         aux_w = self.cfg.moe_aux_weight
@@ -214,7 +223,7 @@ class PipeDreamStrategy(GPipeStrategy):
                 return buf[:in_size].reshape(mb, *in_shape)
 
             def branch(carry, xs, ys, h, lr):
-                (params, opt_row, st_row, stash_p, stash_x,
+                (params, opt_row, g_acc, st_row, stash_p, stash_x,
                  fwd_q, g_buf, loss_acc, corr_acc) = carry
 
                 f, valid_f = fwd_mb_at(s, S, M, h)
@@ -278,7 +287,7 @@ class PipeDreamStrategy(GPipeStrategy):
 
                 # ---- backward path (stashed weights + stashed input) ----
                 def do_bwd(op):
-                    params, opt_row, st_row, stash_p, stash_x, g_buf = op
+                    params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf = op
                     slot = b % NSLOT
                     p_st = lax.dynamic_index_in_dim(stash_p, slot, keepdims=False)
                     if s == 0:
@@ -336,14 +345,39 @@ class PipeDreamStrategy(GPipeStrategy):
                     gp = lax.psum(gp, "data")
                     gx_out = (jnp.zeros((A,), cdtype) if gx is None
                               else pad_vec(gx.astype(cdtype), A))
-                    params, opt_row = opt_update(
-                        params, gp.astype(jnp.float32), opt_row, lr)
-                    return jax.tree.map(_vary, (params, opt_row, gx_out))
+                    if K == 1:
+                        # per-microbatch update; g_acc is a 1-element dummy
+                        new_params, new_opt = opt_update(
+                            params, gp.astype(jnp.float32), opt_row, lr)
+                        return jax.tree.map(
+                            _vary, (new_params, new_opt, g_acc, gx_out))
+                    # macrobatch: accumulate; step (a real optimizer pass)
+                    # only on every K-th backward — nested cond so the K-1
+                    # skipped ticks pay no optimizer compute
+                    g_acc = g_acc + gp.astype(jnp.float32)
+
+                    def step(op):
+                        params, opt_row, g_acc = op
+                        new_params, new_opt = opt_update(
+                            params, g_acc / K, opt_row, lr)
+                        return jax.tree.map(
+                            _vary,
+                            (new_params, new_opt, jnp.zeros_like(g_acc)))
+
+                    def hold(op):
+                        return jax.tree.map(_vary, op)
+
+                    params, opt_row, g_acc = lax.cond(
+                        (b + 1) % K == 0, step, hold,
+                        (params, opt_row, g_acc))
+                    return jax.tree.map(
+                        _vary, (params, opt_row, g_acc, gx_out))
 
                 def skip_bwd(op):
-                    params, opt_row, st_row, stash_p, stash_x, g_buf = op
+                    params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf = op
                     return jax.tree.map(
-                        _vary, (params, opt_row, jnp.zeros((A,), cdtype)))
+                        _vary, (params, opt_row, g_acc,
+                                jnp.zeros((A,), cdtype)))
 
                 # grad w.r.t. THIS stage's input; next tick it is consumed by
                 # stage s-1, whose output shape equals this stage's input.
@@ -354,12 +388,12 @@ class PipeDreamStrategy(GPipeStrategy):
                     out_size = mb * math.prod(out_shape)
                     return buf[:out_size].reshape(mb, *out_shape)
 
-                params, opt_row, gx_out = lax.cond(
+                params, opt_row, g_acc, gx_out = lax.cond(
                     valid_b, do_bwd, skip_bwd,
-                    (params, opt_row, st_row, stash_p, stash_x, g_buf),
+                    (params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf),
                 )
 
-                out = (params, opt_row, st_row, stash_p, stash_x,
+                out = (params, opt_row, g_acc, st_row, stash_p, stash_x,
                        fwd_q, y_out, gx_out, loss_acc, corr_acc)
                 return jax.tree.map(_vary, out)
 
@@ -378,7 +412,7 @@ class PipeDreamStrategy(GPipeStrategy):
             Ls = st_row.shape[0]
 
             def body(carry, h):
-                (params, opt_row, st_row, stash_p, stash_x,
+                (params, opt_row, g_acc, st_row, stash_p, stash_x,
                  fwd_q, x_in, g_buf, loss_acc, corr_acc) = carry
 
                 # Absorb the activation that arrived this half-tick into the
@@ -402,9 +436,9 @@ class PipeDreamStrategy(GPipeStrategy):
                     fwd_q,
                 )
 
-                carry2 = (params, opt_row, st_row, stash_p, stash_x,
+                carry2 = (params, opt_row, g_acc, st_row, stash_p, stash_x,
                           fwd_q, g_buf, loss_acc, corr_acc)
-                (params, opt_row, st_row, stash_p, stash_x, fwd_q,
+                (params, opt_row, g_acc, st_row, stash_p, stash_x, fwd_q,
                  y_out, gx_out, loss_acc, corr_acc) = lax.switch(
                     s_idx, branches, carry2, xs, ys, h, lr
                 )
@@ -415,12 +449,17 @@ class PipeDreamStrategy(GPipeStrategy):
                 else:
                     x_in = y_out
                     g_buf = gx_out
-                return (params, opt_row, st_row, stash_p, stash_x,
+                return (params, opt_row, g_acc, st_row, stash_p, stash_x,
                         fwd_q, x_in, g_buf, loss_acc, corr_acc), None
 
             zeros_A = _vary(jnp.zeros((A,), cdtype))
+            # macrobatch grad accumulator; 1-element dummy when K == 1 (no
+            # carry cost for the default per-microbatch mode)
+            g_acc0 = _vary(jnp.zeros((L if K > 1 else 1,), jnp.float32))
             init_carry = (
-                params, opt_row, st_row,
+                params, opt_row,
+                g_acc0,
+                st_row,
                 _vary(jnp.zeros((NSLOT, L), jnp.float32)),
                 _vary(jnp.zeros((NSLOT, A), cdtype)),
                 _vary(jnp.zeros((2, A), cdtype)),
@@ -429,9 +468,8 @@ class PipeDreamStrategy(GPipeStrategy):
                 _vary(jnp.zeros((), jnp.float32)),
                 _vary(jnp.zeros((), jnp.int32)),
             )
-            (params, opt_row, st_row, *_rest, loss_acc, corr_acc) = lax.scan(
-                body, init_carry, jnp.arange(H)
-            )[0]
+            (params, opt_row, _g_acc, st_row, *_rest, loss_acc,
+             corr_acc) = lax.scan(body, init_carry, jnp.arange(H))[0]
             loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
             st_row = lax.pmean(st_row, "data")

@@ -38,3 +38,19 @@ def test_config_validation():
     with pytest.raises(ValueError):
         RunConfig(benchmark="nope").validate()
     RunConfig(strategy="dp", num_devices=8).validate()
+
+
+def test_update_interval_validation():
+    import pytest
+
+    from ddlbench_tpu.config import RunConfig
+
+    with pytest.raises(ValueError, match="macrobatch"):
+        RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                  update_interval=2).validate()
+    with pytest.raises(ValueError, match="divisible"):
+        RunConfig(strategy="pipedream", num_devices=2, num_stages=2,
+                  micro_batch_size=4, num_microbatches=3,
+                  update_interval=2).validate()
+    with pytest.raises(ValueError, match=">= 1"):
+        RunConfig(update_interval=0).validate()

@@ -35,15 +35,21 @@ def tiny_model(num_classes=10):
     return LayerModel("tiny", layers, (6, 6, 1), num_classes)
 
 
-def simulate_pipedream(model, bounds, params_list, states_list, xs, ys, lr, momentum_c):
+def simulate_pipedream(model, bounds, params_list, states_list, xs, ys, lr,
+                       momentum_c, update_interval=1):
     """Sequential replay of PipeDream semantics: per-half-tick F/B events,
-    weight stashing, per-microbatch SGD updates."""
+    weight stashing, per-microbatch SGD updates — or, with
+    ``update_interval`` K > 1, the macrobatch protocol (reference
+    runtime/optimizer.py:119-164): gradients accumulate across K consecutive
+    backwards and the step applies their /K average once per interval."""
     S = len(bounds) - 1
     M = xs.shape[0]
     H = 2 * M + 2 * S - 2
+    K = update_interval
 
     cur = [params_list[bounds[s]:bounds[s + 1]] for s in range(S)]
     mom = [jax.tree.map(jnp.zeros_like, p) for p in cur]
+    gacc = [jax.tree.map(jnp.zeros_like, p) for p in cur]
     states = [states_list[bounds[s]:bounds[s + 1]] for s in range(S)]
     stash_p, stash_x, acts, grads = {}, {}, {}, {}
     losses = []
@@ -84,8 +90,13 @@ def simulate_pipedream(model, bounds, params_list, states_list, xs, ys, lr, mome
                     _, vjp_fn = jax.vjp(fwd_of, p_st, x_st)
                     gp, gx = vjp_fn(grads[(s + 1, b)])
                 grads[(s, b)] = gx
-                mom[s] = jax.tree.map(lambda m, g: momentum_c * m + g, mom[s], gp)
-                cur[s] = jax.tree.map(lambda p, m: p - lr * m, cur[s], mom[s])
+                gacc[s] = jax.tree.map(jnp.add, gacc[s], gp)
+                if (b + 1) % K == 0:
+                    mom[s] = jax.tree.map(
+                        lambda m, g: momentum_c * m + g / K, mom[s], gacc[s])
+                    cur[s] = jax.tree.map(lambda p, m: p - lr * m, cur[s],
+                                          mom[s])
+                    gacc[s] = jax.tree.map(jnp.zeros_like, gacc[s])
 
     return cur, float(np.mean(losses))
 
@@ -125,6 +136,49 @@ def test_pipedream_matches_simulator(devices, S, M):
     ref_params, ref_loss = simulate_pipedream(
         model, bounds, params_list, state_list, xs_ref, ys_ref, lr, momentum_c=0.5
     )
+
+    np.testing.assert_allclose(float(metrics["loss"]), ref_loss, rtol=1e-5)
+    for s in range(S):
+        got = np.asarray(ts2.params[s][: strat._p_lens[s]])
+        want = np.asarray(ravel_pytree(ref_params[s])[0])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
+@pytest.mark.parametrize("S,M,K", [(2, 4, 2), (2, 4, 4), (4, 6, 3)])
+def test_pipedream_macrobatch_matches_simulator(devices, S, M, K):
+    """update_interval K > 1 (reference macrobatch,
+    runtime/optimizer.py:36-52,119-164): grads accumulate over K microbatches
+    inside the 1F1B scan and step once per interval with the /K average."""
+    mb = 4
+    model = tiny_model()
+    bounds = {2: [0, 2, 5], 4: [0, 2, 3, 4, 5]}[S]
+    cfg = RunConfig(
+        strategy="pipedream",
+        num_devices=S,
+        num_stages=S,
+        micro_batch_size=mb,
+        num_microbatches=M,
+        update_interval=K,
+        compute_dtype="float32",
+        momentum=0.5,
+        weight_decay=0.0,
+        remat_stages=False,
+    )
+    cfg.validate()
+    strat = PipeDreamStrategy(model, cfg, stage_bounds=bounds)
+    ts = strat.init(jax.random.key(0))
+
+    B = M * mb
+    x = jax.random.normal(jax.random.key(1), (B, 6, 6, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    lr = 0.05
+    xs, ys = strat.shard_batch(x, y)
+    ts2, metrics = strat.train_step(ts, xs, ys, jnp.float32(lr))
+
+    params_list, state_list, _ = init_model(model, jax.random.key(0))
+    ref_params, ref_loss = simulate_pipedream(
+        model, bounds, params_list, state_list, x.reshape(M, mb, 6, 6, 1),
+        y.reshape(M, mb), lr, momentum_c=0.5, update_interval=K)
 
     np.testing.assert_allclose(float(metrics["loss"]), ref_loss, rtol=1e-5)
     for s in range(S):
