@@ -46,8 +46,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--num-microbatches", type=int, default=None)
     p.add_argument("--stages", type=int, default=None)
     p.add_argument("--virtual-stages", type=int, default=1,
-                   help="interleaved gpipe schedule: model chunks per device "
-                        "(cuts the pipeline bubble by this factor)")
+                   help="interleaved schedule (gpipe or pipedream): model "
+                        "chunks per device (cuts the pipeline bubble by "
+                        "this factor)")
     p.add_argument("--dp-replicas", type=int, default=1)
     p.add_argument("--stage-replication", default=None,
                    help="uneven hybrid PPxDP: comma list of per-stage "

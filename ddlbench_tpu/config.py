@@ -453,13 +453,16 @@ class RunConfig:
                 "shard_opt_state (ZeRO-1) applies to the dp strategy "
                 "(fsdp already shards everything)")
         if self.virtual_stages > 1:
-            if self.strategy != "gpipe":
+            if self.strategy not in ("gpipe", "pipedream"):
                 raise ValueError(
-                    "virtual_stages (interleaved schedule) requires the "
-                    "gpipe strategy")
+                    "virtual_stages (interleaved schedule) requires a "
+                    "pipeline strategy (gpipe or pipedream)")
             s = self.resolved_stages()
             _, chunks = self.resolved_batches()
             if chunks % s:
+                # gpipe's interleaved timetable groups microbatches by S;
+                # pipedream's async variant inherits the constraint through
+                # its synchronous interleaved eval pipeline
                 raise ValueError(
                     f"interleaved schedule needs num_microbatches ({chunks}) "
                     f"divisible by stages ({s})")

@@ -89,14 +89,22 @@ def bwd_mb_at(s: int, S: int, M: int, h):
 
 
 class PipeDreamStrategy(GPipeStrategy):
-    """strategy='pipedream': async 1F1B + weight stashing over the stage mesh."""
+    """strategy='pipedream': async 1F1B + weight stashing over the stage mesh.
+
+    With ``virtual_stages`` V > 1 (interleaved 1F1B — the flagship schedule
+    of modern pipeline systems, beyond the reference): each device owns V
+    model chunks (chunk c = v*S + s on device s, the gpipe interleaved
+    layout) and runs the C = S*V-chunk uniform 1F1B timetable, executing its
+    V chunk-events sequentially within each half-tick. Because the C-chunk
+    timetable never consumes a same-tick output, co-locating chunks preserves
+    the event semantics EXACTLY — the compiled interleaved program matches
+    the sequential event-replay simulator run with C stages. Every chunk
+    boundary is a device boundary (+1 ring shift); wrap transfers
+    (device S-1 -> 0 forward, 0 -> S-1 backward) roll the chunk-slot axis.
+    """
 
     def __init__(self, *args, **kwargs):
         super().__init__(*args, **kwargs)
-        if self.vstages != 1:
-            raise ValueError(
-                "virtual_stages > 1 (interleaved schedule) is a gpipe "
-                "feature; the async 1F1B timetable is single-chunk")
 
     # -- train step --------------------------------------------------------
 
@@ -179,6 +187,11 @@ class PipeDreamStrategy(GPipeStrategy):
         return stage_fwd_fused
 
     def _make_train_step(self):
+        if self.vstages > 1:
+            return self._make_train_step_interleaved()
+        return self._make_train_step_v1()
+
+    def _make_train_step_v1(self):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb
         H = 2 * M + 2 * S - 2
         NSLOT = min(S, M)
@@ -501,6 +514,336 @@ class PipeDreamStrategy(GPipeStrategy):
             metrics = {
                 "loss": loss,
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
+            }
+            return PDTrainState(params, st, opt), metrics
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding, None),
+        )
+
+    # -- interleaved (V > 1) ----------------------------------------------
+
+    def _make_train_step_interleaved(self):
+        """Async 1F1B over C = S*V chunks, V per device (class docstring).
+
+        Per half-tick every device runs its V chunk-events sequentially
+        (fwd and/or bwd per chunk, per the C-chunk closed-form timetable),
+        then one ring ppermute each way moves the [V, A] activation /
+        gradient slot buffers; wrap transfers roll the slot axis on the
+        receiving edge device. Stash rings, the absorb queue, the optimizer
+        state and the macrobatch accumulator all gain a leading V axis.
+        """
+        S, M, mb = self.num_stages, self.num_microbatches, self.mb
+        V = self.vstages
+        C = S * V
+        H = 2 * M + 2 * C - 2
+        NSLOT = min(C, M)
+        K = max(1, self.cfg.update_interval)
+        opt_update = self._opt_update
+        smooth = self.cfg.resolved_label_smoothing()
+        aux_w = self.cfg.moe_aux_weight
+        mesh = self.mesh
+        cdtype = self.compute_dtype
+        ring_f = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
+        ring_b = [((i + 1) % S, i) for i in range(S)] if S > 1 else []
+        stage_fwds = [self._make_stage_fwd(c) for c in range(C)]
+        in_shapes = [self.shapes[self.bounds[c]] for c in range(C)]
+        in_sizes = [mb * math.prod(sh) for sh in in_shapes]
+        A = max(in_sizes)
+        fused_last = self._make_stage_fwd_fused(C - 1)
+
+        def make_branch(c: int):
+            """Chunk-c event body; same shape-contract as the V=1 branches
+            but operating on row v = c // S of the [V, ...] carries."""
+            stage_fwd = stage_fwds[c]
+            fused_fwd = fused_last if c == C - 1 else None
+            if self.cfg.remat_stages:
+                stage_fwd = jax.checkpoint(stage_fwd)
+                if fused_fwd is not None:
+                    fused_fwd = jax.checkpoint(fused_fwd)
+            in_shape, in_size = in_shapes[c], in_sizes[c]
+            last = c == C - 1
+
+            def unpack_x(buf):
+                return buf[:in_size].reshape(mb, *in_shape)
+
+            def branch(carry, xs, ys, h, lr):
+                (params, opt_row, g_acc, st_row, stash_p, stash_x,
+                 fwd_q, g_in, y_out, gx_out, loss_acc, corr_acc) = carry
+
+                f, valid_f = fwd_mb_at(c, C, M, h)
+                b, valid_b = bwd_mb_at(c, C, M, h)
+
+                def do_fwd(op):
+                    params, st_row, stash_p, stash_x = op
+                    if c == 0:
+                        x = lax.dynamic_index_in_dim(xs, f, keepdims=False)
+                    else:
+                        x = unpack_x(lax.dynamic_index_in_dim(
+                            fwd_q, f % 2, keepdims=False))
+                    if last and fused_fwd is not None:
+                        labels = lax.dynamic_index_in_dim(ys, f,
+                                                          keepdims=False)
+                        _obj, ce_sum, corr_mb, new_st, _aux = fused_fwd(
+                            params, st_row, x, labels)
+                        loss_mb = ce_sum / jnp.maximum(
+                            1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+                        y_new = jnp.zeros((A,), cdtype)
+                    else:
+                        y, new_st, _aux = stage_fwd(params, st_row, x)
+                        if last:
+                            labels = lax.dynamic_index_in_dim(
+                                ys, f, keepdims=False)
+                            loss_mb = cross_entropy_loss(y, labels)
+                            corr_mb = correct_and_count(y, labels)[0]
+                            y_new = jnp.zeros((A,), cdtype)
+                        else:
+                            loss_mb = jnp.zeros((), jnp.float32)
+                            corr_mb = jnp.zeros((), jnp.int32)
+                            y_new = pad_vec(y.astype(cdtype), A)
+                    slot = f % NSLOT
+                    stash_p = lax.dynamic_update_index_in_dim(
+                        stash_p, params, slot, 0)
+                    if c != 0:
+                        stash_x = lax.dynamic_update_index_in_dim(
+                            stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
+                    return jax.tree.map(
+                        _vary,
+                        (new_st, stash_p, stash_x, y_new, loss_mb, corr_mb))
+
+                def skip_fwd(op):
+                    params, st_row, stash_p, stash_x = op
+                    return jax.tree.map(
+                        _vary,
+                        (st_row, stash_p, stash_x, jnp.zeros((A,), cdtype),
+                         jnp.zeros((), jnp.float32),
+                         jnp.zeros((), jnp.int32)))
+
+                st_row, stash_p, stash_x, y_new, loss_mb, corr_mb = lax.cond(
+                    valid_f, do_fwd, skip_fwd,
+                    (params, st_row, stash_p, stash_x))
+                loss_acc = loss_acc + loss_mb
+                corr_acc = corr_acc + corr_mb
+
+                def do_bwd(op):
+                    params, opt_row, g_acc, st_row, stash_p, stash_x = op
+                    slot = b % NSLOT
+                    p_st = lax.dynamic_index_in_dim(stash_p, slot,
+                                                    keepdims=False)
+                    if c == 0:
+                        x_st = lax.dynamic_index_in_dim(xs, b, keepdims=False)
+                    else:
+                        x_st = unpack_x(lax.dynamic_index_in_dim(
+                            stash_x, slot, keepdims=False))
+                    if last:
+                        labels = lax.dynamic_index_in_dim(ys, b,
+                                                          keepdims=False)
+                        if fused_fwd is not None:
+                            denom = jnp.maximum(
+                                1.0,
+                                jnp.sum((labels >= 0).astype(jnp.float32)))
+
+                            def loss_of(pv, xv):
+                                obj_sum, _, _, _, aux = fused_fwd(
+                                    pv, st_row, xv, labels)
+                                return obj_sum / denom + aux_w * aux
+                        else:
+                            def loss_of(pv, xv):
+                                y, _, aux = stage_fwd(pv, st_row, xv)
+                                return (cross_entropy_loss(y, labels, smooth)
+                                        + aux_w * aux)
+
+                        if c == 0:
+                            gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
+                            gx = None
+                        else:
+                            gp, gx = jax.grad(loss_of, argnums=(0, 1))(
+                                p_st, x_st)
+                    else:
+                        def fwd_of(pv, xv):
+                            y, _, aux = stage_fwd(pv, st_row, xv)
+                            return y, aux
+
+                        out_shape = self.shapes[self.bounds[c + 1]]
+                        out_size = mb * math.prod(out_shape)
+                        g_cot = g_in[:out_size].reshape(mb, *out_shape)
+                        if c == 0:
+                            (y, aux), vjp_fn = jax.vjp(
+                                lambda pv: fwd_of(pv, x_st), p_st)
+                            (gp,) = vjp_fn((g_cot.astype(y.dtype),
+                                            jnp.float32(aux_w)))
+                            gx = None
+                        else:
+                            (y, aux), vjp_fn = jax.vjp(fwd_of, p_st, x_st)
+                            gp, gx = vjp_fn((g_cot.astype(y.dtype),
+                                             jnp.float32(aux_w)))
+                    gp = lax.psum(gp, "data")
+                    gx_new = (jnp.zeros((A,), cdtype) if gx is None
+                              else pad_vec(gx.astype(cdtype), A))
+                    if K == 1:
+                        new_params, new_opt = opt_update(
+                            params, gp.astype(jnp.float32), opt_row, lr)
+                        return jax.tree.map(
+                            _vary, (new_params, new_opt, g_acc, gx_new))
+                    g_acc = g_acc + gp.astype(jnp.float32)
+
+                    def step(op):
+                        params, opt_row, g_acc = op
+                        new_params, new_opt = opt_update(
+                            params, g_acc / K, opt_row, lr)
+                        return jax.tree.map(
+                            _vary,
+                            (new_params, new_opt, jnp.zeros_like(g_acc)))
+
+                    def hold(op):
+                        return jax.tree.map(_vary, op)
+
+                    params, opt_row, g_acc = lax.cond(
+                        (b + 1) % K == 0, step, hold,
+                        (params, opt_row, g_acc))
+                    return jax.tree.map(
+                        _vary, (params, opt_row, g_acc, gx_new))
+
+                def skip_bwd(op):
+                    params, opt_row, g_acc, st_row, stash_p, stash_x = op
+                    return jax.tree.map(
+                        _vary, (params, opt_row, g_acc,
+                                jnp.zeros((A,), cdtype)))
+
+                params, opt_row, g_acc, gx_new = lax.cond(
+                    valid_b, do_bwd, skip_bwd,
+                    (params, opt_row, g_acc, st_row, stash_p, stash_x))
+
+                out = (params, opt_row, g_acc, st_row, stash_p, stash_x,
+                       fwd_q, g_in, y_new, gx_new, loss_acc, corr_acc)
+                return jax.tree.map(_vary, out)
+
+            return branch
+
+        # branches grouped per chunk-row: branches_v[v][s] is chunk v*S+s
+        branches_v = [[make_branch(v * S + s) for s in range(S)]
+                      for v in range(V)]
+
+        def inner(params_rows, state_rows, opt_rows, xs, ys, lr):
+            # local: params_rows [V, 1, L]
+            params = _vary(params_rows[:, 0])  # [V, L]
+            st = _vary(state_rows[:, 0])
+            opt = jax.tree.map(lambda a: _vary(a[:, 0]), opt_rows)
+            xs = _vary(xs)
+            ys = _vary(ys)
+            s_idx = lax.axis_index("stage")
+            L = params.shape[1]
+            Ls = st.shape[1]
+            GL = L if K > 1 else 1
+
+            def body(carry, h):
+                (params, opt, g_acc, st, stash_p, stash_x, fwd_q,
+                 x_in, g_in, loss_acc, corr_acc) = carry
+
+                # absorb arrivals into each chunk-row's 2-slot queue, keyed
+                # by the producing chunk's schedule at h-1
+                for v in range(V):
+                    def absorb(s, v=v):
+                        cprev = v * S + s - 1
+                        if cprev < 0:
+                            return (jnp.zeros((), jnp.int32),
+                                    jnp.zeros((), jnp.bool_))
+                        return fwd_mb_at(cprev, C, M, h - 1)
+
+                    f_in, valid_in = lax.switch(
+                        s_idx,
+                        [(lambda s=s, v=v: jax.tree.map(_vary, absorb(s, v)))
+                         for s in range(S)])
+                    q_upd = lax.dynamic_update_index_in_dim(
+                        fwd_q[v], x_in[v], f_in % 2, 0)
+                    fwd_q = fwd_q.at[v].set(
+                        jnp.where(valid_in, q_upd, fwd_q[v]))
+
+                y_out = _vary(jnp.zeros((V, A), cdtype))
+                gx_out = _vary(jnp.zeros((V, A), cdtype))
+                for v in range(V):
+                    carry_v = (params[v],
+                               jax.tree.map(lambda a: a[v], opt),
+                               g_acc[v], st[v], stash_p[v], stash_x[v],
+                               fwd_q[v], g_in[v],
+                               _vary(jnp.zeros((A,), cdtype)),
+                               _vary(jnp.zeros((A,), cdtype)),
+                               loss_acc, corr_acc)
+                    (p_v, o_v, ga_v, st_v, sp_v, sx_v, _q, _gi, y_v, gx_v,
+                     loss_acc, corr_acc) = lax.switch(
+                        s_idx, branches_v[v], carry_v, xs, ys, h, lr)
+                    params = params.at[v].set(p_v)
+                    opt = jax.tree.map(lambda a, n, v=v: a.at[v].set(n),
+                                       opt, o_v)
+                    g_acc = g_acc.at[v].set(ga_v)
+                    st = st.at[v].set(st_v)
+                    stash_p = stash_p.at[v].set(sp_v)
+                    stash_x = stash_x.at[v].set(sx_v)
+                    y_out = y_out.at[v].set(y_v)
+                    gx_out = gx_out.at[v].set(gx_v)
+
+                if ring_f:
+                    x_in = lax.ppermute(y_out, "stage", ring_f)
+                    g_next = lax.ppermute(gx_out, "stage", ring_b)
+                else:
+                    x_in, g_next = y_out, gx_out
+                # wrap transfers change the chunk-row: device 0's arrivals
+                # from S-1 serve chunk (v+1)*S, i.e. slot v+1 (roll +1, the
+                # rolled-in slot 0 is last-chunk zeros); device S-1's
+                # gradient arrivals from 0 serve chunk v*S + S-1, slot v-1
+                x_in = jnp.where(s_idx == 0, jnp.roll(x_in, 1, axis=0), x_in)
+                g_next = jnp.where(s_idx == S - 1,
+                                   jnp.roll(g_next, -1, axis=0), g_next)
+                out = (params, opt, g_acc, st, stash_p, stash_x, fwd_q,
+                       x_in, g_next, loss_acc, corr_acc)
+                return jax.tree.map(_vary, out), None
+
+            init_carry = jax.tree.map(_vary, (
+                params, opt,
+                jnp.zeros((V, GL), jnp.float32),
+                st,
+                jnp.zeros((V, NSLOT, L), jnp.float32),
+                jnp.zeros((V, NSLOT, A), cdtype),
+                jnp.zeros((V, 2, A), cdtype),
+                jnp.zeros((V, A), cdtype),
+                jnp.zeros((V, A), cdtype),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.int32),
+            ))
+            (params, opt, _ga, st, *_rest, loss_acc, corr_acc) = lax.scan(
+                body, init_carry, jnp.arange(H))[0]
+            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
+            correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+            st = lax.pmean(st, "data")
+            params = lax.pmean(params, "data")
+            opt = jax.tree.map(
+                lambda a: (lax.pmax(a, "data")
+                           if jnp.issubdtype(a.dtype, jnp.integer)
+                           else lax.pmean(a, "data")),
+                opt)
+            return (params[:, None], st[:, None],
+                    jax.tree.map(lambda a: a[:, None], opt), loss, correct)
+
+        spec = self._chunk_sharding_spec()  # P(None, 'stage', None)
+        pipe = _shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=(spec, spec, spec, P(None, "data"), P(None, "data"),
+                      P()),
+            out_specs=(spec, spec, spec, P(), P()),
+        )
+
+        def train_step(ts: PDTrainState, xs, ys, lr):
+            params, st, opt, loss, correct = pipe(
+                ts.params, ts.model_state, ts.opt, xs, ys, lr)
+            valid = jnp.sum((ys >= 0).astype(jnp.float32))
+            metrics = {
+                "loss": loss,
+                "accuracy": correct.astype(jnp.float32)
+                / jnp.maximum(1.0, valid),
             }
             return PDTrainState(params, st, opt), metrics
 

@@ -187,6 +187,55 @@ def test_pipedream_macrobatch_matches_simulator(devices, S, M, K):
         np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
 
 
+@pytest.mark.parametrize("S,V,M,K", [(2, 2, 4, 1), (2, 2, 4, 2)])
+def test_pipedream_interleaved_matches_simulator(devices, S, V, M, K):
+    """Interleaved async 1F1B (virtual_stages V > 1): chunk c = v*S + s on
+    device s runs the C = S*V-chunk uniform 1F1B timetable, so the compiled
+    program must match the event-replay simulator run with C stages — same
+    stashing, same per-microbatch (or macrobatch-K) updates."""
+    mb = 4
+    model = tiny_model()
+    C = S * V
+    bounds = [0, 2, 3, 4, 5]  # C = 4 chunks over the 5 layers
+    assert len(bounds) == C + 1
+    cfg = RunConfig(
+        strategy="pipedream",
+        num_devices=S,
+        num_stages=S,
+        virtual_stages=V,
+        micro_batch_size=mb,
+        num_microbatches=M,
+        update_interval=K,
+        compute_dtype="float32",
+        momentum=0.5,
+        weight_decay=0.0,
+        remat_stages=False,
+    )
+    cfg.validate()
+    strat = PipeDreamStrategy(model, cfg, stage_bounds=bounds)
+    ts = strat.init(jax.random.key(0))
+
+    B = M * mb
+    x = jax.random.normal(jax.random.key(1), (B, 6, 6, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    lr = 0.05
+    xs, ys = strat.shard_batch(x, y)
+    ts2, metrics = strat.train_step(ts, xs, ys, jnp.float32(lr))
+    ev = strat.eval_step(ts2, xs, ys)
+    assert np.isfinite(float(ev["loss"]))
+
+    params_list, state_list, _ = init_model(model, jax.random.key(0))
+    ref_params, ref_loss = simulate_pipedream(
+        model, bounds, params_list, state_list, x.reshape(M, mb, 6, 6, 1),
+        y.reshape(M, mb), lr, momentum_c=0.5, update_interval=K)
+
+    np.testing.assert_allclose(float(metrics["loss"]), ref_loss, rtol=1e-5)
+    for c in range(C):
+        got = np.asarray(ts2.params[c // S, c % S][: strat._p_lens[c]])
+        want = np.asarray(ravel_pytree(ref_params[c])[0])
+        np.testing.assert_allclose(got, want, rtol=1e-4, atol=1e-6)
+
+
 def test_pipedream_s1_is_sequential_sgd(devices):
     """S=1 anchor, schedule-independent: per-microbatch SGD in order."""
     model = tiny_model()
