@@ -103,9 +103,6 @@ class PipeDreamStrategy(GPipeStrategy):
     (device S-1 -> 0 forward, 0 -> S-1 backward) roll the chunk-slot axis.
     """
 
-    def __init__(self, *args, **kwargs):
-        super().__init__(*args, **kwargs)
-
     # -- train step --------------------------------------------------------
 
     def _ts_sharding(self):
@@ -215,9 +212,10 @@ class PipeDreamStrategy(GPipeStrategy):
         stage_fwds = [self._make_stage_fwd(s) for s in range(S)]
         in_shapes = [self.shapes[self.bounds[s]] for s in range(S)]
         in_sizes = [mb * math.prod(sh) for sh in in_shapes]
-        # Unlike gpipe's interior-only buffer, the stash must also hold stage
-        # 0's input (for recompute), so size over ALL stage inputs.
-        A = max(in_sizes)
+        # Interior boundary activations only: stage 0's raw input is re-read
+        # from xs at backward time (never stashed or ring-transferred), so it
+        # does not size the buffers.
+        A = max(in_sizes[1:]) if S > 1 else 1
 
         fused_last = self._make_stage_fwd_fused(S - 1)
 
@@ -552,7 +550,9 @@ class PipeDreamStrategy(GPipeStrategy):
         stage_fwds = [self._make_stage_fwd(c) for c in range(C)]
         in_shapes = [self.shapes[self.bounds[c]] for c in range(C)]
         in_sizes = [mb * math.prod(sh) for sh in in_shapes]
-        A = max(in_sizes)
+        # interior chunk boundaries only (chunk 0's raw input is re-read
+        # from xs, never stashed or ring-transferred)
+        A = max(in_sizes[1:]) if C > 1 else 1
         fused_last = self._make_stage_fwd_fused(C - 1)
 
         def make_branch(c: int):
@@ -736,7 +736,6 @@ class PipeDreamStrategy(GPipeStrategy):
             ys = _vary(ys)
             s_idx = lax.axis_index("stage")
             L = params.shape[1]
-            Ls = st.shape[1]
             GL = L if K > 1 else 1
 
             def body(carry, h):
