@@ -23,7 +23,13 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.zoo import get_model
 
 
-def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None):
+def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None,
+                  input_time_ms: float = 0.0):
+    """Build the configured strategy. ``input_time_ms``: measured
+    per-MICROBATCH data-loading cost (profiler.measure_input_ms scaled by
+    the caller) — with --auto-partition it becomes the profile graph's Input
+    node, folded into layer 0's stage for the partitioning DP
+    (profiler.fold_input_node; train/loop.py supplies it for the -s path)."""
     cfg.validate()
     from ddlbench_tpu.models.transformer import set_attention_backend
 
@@ -47,7 +53,15 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         from ddlbench_tpu.profiler.profile import profile_model
 
         mb, _ = cfg.resolved_batches()
-        graph = profile_model(model, mb, mode=cfg.profile_mode, hw=cfg.hardware)
+        graph = profile_model(model, mb, mode=cfg.profile_mode,
+                              hw=cfg.hardware, input_time_ms=input_time_ms)
+        # DP view: the Input node folds into layer 0's stage — the reference
+        # co-locates its DataLoader with stage 0's ranks, and a chip cannot
+        # run "just data loading", so Input must never form its own stage.
+        from ddlbench_tpu.profiler.profile import fold_input_node
+
+        graph = fold_input_node(graph)
+
         plan = partition_hierarchical(
             graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
         )

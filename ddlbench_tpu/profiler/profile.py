@@ -100,9 +100,16 @@ def profile_model(
     hw: Optional[HardwareModel] = None,
     repeats: int = 5,
     seed: int = 0,
+    input_time_ms: float = 0.0,
 ) -> Graph:
     """Profile every layer; returns a chain Graph with per-node
     forward/backward times (ms), activation sizes and parameter sizes (bytes).
+
+    ``input_time_ms`` > 0 prepends a synthetic "input" source node carrying
+    the measured per-batch data-loading cost (reference parity:
+    profiler/image_classification/main.py:388-407 appends an Input node so
+    the partitioner prices host-side loading into stage 0). Layer node ids
+    stay the layer indices; the input node id is "input".
     """
     hw = hw or HardwareModel()
     params_list, state_list, shapes = init_model(model, jax.random.key(seed))
@@ -156,7 +163,49 @@ def profile_model(
                 parameter_size=float(param_bytes(p)),
             )
         )
+    if input_time_ms > 0.0:
+        in_bytes = float(batch_size) * _prod(shapes[0]) * itemsize
+        nodes.insert(0, Node(
+            node_id="input",
+            node_desc="Input",
+            forward_compute_time=float(input_time_ms),
+            backward_compute_time=0.0,
+            activation_size=in_bytes,
+            parameter_size=0.0,
+        ))
     return Graph.chain(nodes)
+
+
+def measure_input_ms(data, batches: int = 3) -> float:
+    """Average wall-clock cost of fetching one training batch from a data
+    source with the SyntheticData/OnDiskData ``batch`` interface (host read +
+    device upload + normalize). The profiler's Input-node weight for the -s
+    on-disk path. Callers should pass a throwaway data instance: sequential
+    on-disk streams advance with every fetch."""
+    import time as _time
+
+    _sync(data.batch(0, 0))  # warm: page cache, jit of the normalize step
+    t0 = _time.perf_counter()
+    for i in range(batches):
+        out = data.batch(0, i)
+    _sync(out)  # axon-safe barrier (block_until_ready alone is not)
+    return 1000.0 * (_time.perf_counter() - t0) / batches
+
+
+def fold_input_node(graph: Graph) -> Graph:
+    """Collapse the synthetic Input source node into its successor: the
+    partitioner prices data loading into the stage hosting layer 0 (a chip
+    cannot run "just data loading", so Input must never form its own stage).
+    Returns a new chain graph of the layer nodes; graphs without an input
+    node pass through unchanged."""
+    order = graph.topological_sort()
+    if not order or order[0].node_id != "input":
+        return graph
+    import dataclasses
+
+    rest = [dataclasses.replace(n) for n in order[1:]]
+    rest[0].forward_compute_time += order[0].forward_compute_time
+    return Graph.chain(rest)
 
 
 def _prod(shape: Sequence[int]) -> float:

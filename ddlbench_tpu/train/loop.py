@@ -27,7 +27,30 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
                   warmup_steps: int = 1) -> Dict[str, Any]:
     """Run the full 3-epoch benchmark protocol; returns the summary dict."""
     cfg.validate()
-    strategy = strategy or make_strategy(cfg)
+    data = _make_data(cfg)
+    if strategy is None:
+        input_ms = 0.0
+        if (cfg.auto_partition and not cfg.synthetic
+                and cfg.strategy in ("gpipe", "pipedream")):
+            # Input-node cost for the partitioner (reference parity:
+            # profiler main.py:388-407): measure the on-disk loader's fetch
+            # cost so --auto-partition prices host-side data loading into
+            # stage 0. A throwaway loader instance keeps the real training
+            # stream unconsumed, and the per-GLOBAL-batch measurement is
+            # scaled to the per-MICROBATCH units of the profile graph.
+            from ddlbench_tpu.profiler.profile import measure_input_ms
+
+            probe = _make_data(cfg)
+            try:
+                global_ms = measure_input_ms(probe)
+            finally:
+                probe.close()
+            mb_, _ = cfg.resolved_batches()
+            input_ms = global_ms * mb_ / cfg.global_batch()
+            print(f"auto-partition: measured input cost "
+                  f"{global_ms:.2f} ms/global-batch "
+                  f"({input_ms:.3f} ms/microbatch)", flush=True)
+        strategy = make_strategy(cfg, input_time_ms=input_ms)
     logger = logger or MetricLogger(cfg.epochs, cfg.log_interval)
 
     # Failure detection (SURVEY.md §5.3): the watchdog is kicked at every
@@ -36,32 +59,35 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
     # (tens of seconds); with warmup_steps=0 the first step's compile counts.
     wd = HangWatchdog(cfg.hang_timeout_s) if cfg.hang_timeout_s else None
     try:
-        return _run_benchmark(cfg, strategy, logger, warmup_steps, wd)
+        return _run_benchmark(cfg, strategy, data, logger, warmup_steps, wd)
     finally:
         if wd:
             wd.stop()
 
 
-def _run_benchmark(cfg: RunConfig, strategy, logger: MetricLogger,
+def _make_data(cfg: RunConfig):
+    global_batch = cfg.global_batch()
+    spec = cfg.dataset()
+    if cfg.synthetic:
+        return make_synthetic(
+            spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
+        )
+    from ddlbench_tpu.data.ondisk import OnDiskData
+
+    train_count = (cfg.steps_per_epoch or 0) * global_batch or None
+    test_count = max(global_batch, (train_count or 0) // 5) if train_count else None
+    return OnDiskData(
+        cfg.data_dir or "./data", spec, global_batch, seed=cfg.seed,
+        train_count=train_count, test_count=test_count,
+        augment=cfg.augment,
+    )
+
+
+def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                    warmup_steps: int, wd: Optional[HangWatchdog]) -> Dict[str, Any]:
 
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
-    spec = cfg.dataset()
-    if cfg.synthetic:
-        data = make_synthetic(
-            spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
-        )
-    else:
-        from ddlbench_tpu.data.ondisk import OnDiskData
-
-        train_count = (cfg.steps_per_epoch or 0) * global_batch or None
-        test_count = max(global_batch, (train_count or 0) // 5) if train_count else None
-        data = OnDiskData(
-            cfg.data_dir or "./data", spec, global_batch, seed=cfg.seed,
-            train_count=train_count, test_count=test_count,
-            augment=cfg.augment,
-        )
 
     base_lr = cfg.resolved_lr()
     # The gradual warmup ramps away exactly the world-scaling factor

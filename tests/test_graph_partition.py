@@ -392,3 +392,88 @@ def test_auto_partition_uniform_plan_routes_to_regular_mesh(devices,
     y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
     ts2, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
+
+
+def test_profile_model_input_node():
+    """profile_model(input_time_ms=...) prepends the Input source node
+    (reference profiler main.py:388-407) and measure_input_ms times a data
+    source."""
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+    from ddlbench_tpu.profiler import profile_model
+    from ddlbench_tpu.profiler.profile import measure_input_ms
+
+    model = LayerModel(
+        "tiny3", [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)],
+        (4, 4, 1), 10)
+    g = profile_model(model, 8, mode="flops", input_time_ms=7.5)
+    order = g.topological_sort()
+    assert len(order) == len(model.layers) + 1
+    assert order[0].node_id == "input" and order[0].node_desc == "Input"
+    assert order[0].forward_compute_time == 7.5
+    assert order[0].backward_compute_time == 0.0
+    assert order[0].activation_size == 8 * 16 * 4  # batch * input elems * f32
+    # without the flag the graph is unchanged
+    assert len(profile_model(model, 8, mode="flops").nodes) == len(model.layers)
+
+    from ddlbench_tpu.config import DATASETS
+    from ddlbench_tpu.data.synthetic import make_synthetic
+
+    data = make_synthetic(DATASETS["mnist"], 4, steps_per_epoch=2)
+    ms = measure_input_ms(data, batches=2)
+    assert ms >= 0.0
+
+
+def test_auto_partition_prices_input_node(devices, monkeypatch):
+    """A heavy Input node shifts the executed plan's stage bounds: the stage
+    that co-hosts data loading gets fewer layers (VERDICT r1 #9)."""
+    import ddlbench_tpu.parallel.api as api
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+
+    model = LayerModel(
+        "tiny3", [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)],
+        (4, 4, 1), 10)
+    times = [2.0, 6.0, 4.0]
+    params = [3e8, 3e8, 4e8]  # big: allreduce forbids pure-DP plans
+
+    def fake_profile(model_, mb, mode="flops", hw=None, input_time_ms=0.0,
+                     **kw):
+        g = chain_graph(list(times), params=params, acts=[1e5] * 3)
+        if input_time_ms:  # mirror profile_model's Input-node insertion
+            nodes = [Node("input", "Input",
+                          forward_compute_time=input_time_ms)]
+            nodes += [g.nodes[str(i)] for i in range(3)]
+            g = Graph.chain(nodes)
+        return g
+
+    monkeypatch.setattr(api, "get_model", lambda *a, **k: model)
+    import ddlbench_tpu.profiler.profile as prof
+
+    monkeypatch.setattr(prof, "profile_model", fake_profile)
+
+    base = dict(strategy="gpipe", benchmark="mnist", num_devices=2,
+                auto_partition=True, micro_batch_size=4, num_microbatches=2,
+                compute_dtype="float32")
+    # without input cost, balanced-by-compute bounds: [0, 2, 3]
+    s0 = api.make_strategy(RunConfig(**base))
+    s0.init(__import__("jax").random.key(0))
+    assert s0.bounds == [0, 2, 3]
+    # with a heavy input, stage 0 sheds a layer: [0, 1, 3]
+    s1 = api.make_strategy(RunConfig(**base), input_time_ms=7.0)
+    s1.init(__import__("jax").random.key(0))
+    assert s1.bounds == [0, 1, 3]
+
+
+def test_fold_input_node():
+    from ddlbench_tpu.profiler.profile import fold_input_node
+
+    g = chain_graph([2.0, 6.0], params=[1.0, 1.0])
+    assert fold_input_node(g) is g  # no input node: pass-through
+    nodes = [Node("input", "Input", forward_compute_time=5.0)]
+    nodes += [Node(str(i), f"l{i}", forward_compute_time=t)
+              for i, t in enumerate([2.0, 6.0])]
+    g2 = fold_input_node(Graph.chain(nodes))
+    order = g2.topological_sort()
+    assert len(order) == 2
+    assert order[0].forward_compute_time == 7.0  # 5 folded into layer 0
+    assert order[1].forward_compute_time == 6.0
