@@ -69,6 +69,11 @@ def _augment_u8(imgs, key, pad: int, flip: bool):
 class OnDiskData:
     """Mirrors SyntheticData's interface over generated raw datasets."""
 
+    # batch() advances the native loader's sequential stream (unlike the
+    # random-access synthetic/translation sources) — probes must use a
+    # throwaway instance (train/loop.py input-cost measurement)
+    stateful_stream = True
+
     def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
                  seed: int = 1, dtype=jnp.float32,
                  train_count: int | None = None, test_count: int | None = None,

@@ -40,11 +40,17 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             # scaled to the per-MICROBATCH units of the profile graph.
             from ddlbench_tpu.profiler.profile import measure_input_ms
 
-            probe = _make_data(cfg)
-            try:
-                global_ms = measure_input_ms(probe)
-            finally:
-                probe.close()
+            # sequential streams (the native on-disk loader) need a
+            # throwaway instance so the training stream stays unconsumed;
+            # random-access sources (translation corpus) are probed directly
+            if getattr(data, "stateful_stream", False):
+                probe = _make_data(cfg)
+                try:
+                    global_ms = measure_input_ms(probe)
+                finally:
+                    probe.close()
+            else:
+                global_ms = measure_input_ms(data)
             mb_, _ = cfg.resolved_batches()
             input_ms = global_ms * mb_ / cfg.global_batch()
             print(f"auto-partition: measured input cost "
@@ -72,6 +78,26 @@ def _make_data(cfg: RunConfig):
         return make_synthetic(
             spec, global_batch, seed=cfg.seed, steps_per_epoch=cfg.steps_per_epoch
         )
+    if spec.kind == "seq2seq" and cfg.data_dir:
+        # Real translation corpus (train.src/train.tgt parallel line files):
+        # BPE-tokenized fixed-shape prefix-LM streams with padding-efficiency
+        # accounting (data/translation.py).
+        from ddlbench_tpu.data.translation import (
+            TranslationData, find_parallel_corpus)
+
+        if find_parallel_corpus(cfg.data_dir, "train"):
+            data = TranslationData(cfg.data_dir, spec, global_batch,
+                                   seed=cfg.seed,
+                                   steps_per_epoch=cfg.steps_per_epoch)
+            rep = data.bucketing_report()
+            print(
+                f"translation data: vocab {data.tokenizer.vocab_size}, "
+                f"padding efficiency {rep['fixed_efficiency']:.3f} fixed vs "
+                f"{rep['bucketed_efficiency']:.3f} bucketed "
+                f"({rep['num_compiles_bucketed']} bucket compiles)",
+                flush=True,
+            )
+            return data
     from ddlbench_tpu.data.ondisk import OnDiskData
 
     train_count = (cfg.steps_per_epoch or 0) * global_batch or None

@@ -1,0 +1,142 @@
+"""Translation data machinery: BPE tokenizer + parallel-corpus streams
+(VERDICT r1 #6; reference: pipedream-fork/profiler/translation/seq2seq/data/
+{tokenizer,dataset,sampler}.py)."""
+
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import DATASETS, DatasetSpec
+from ddlbench_tpu.data.bpe import BOS, EOS, PAD, UNK, BpeTokenizer
+from ddlbench_tpu.data.translation import TranslationData, find_parallel_corpus
+
+CORPUS = [
+    "the cat sat on the mat",
+    "the dog sat on the log",
+    "a cat and a dog",
+    "the mat and the log",
+    "cats and dogs sit",
+    "der hund sitzt auf dem baumstamm",
+    "die katze sitzt auf der matte",
+]
+
+
+def test_bpe_roundtrip_and_merges():
+    tok = BpeTokenizer.train(CORPUS, num_merges=64)
+    assert tok.vocab_size > 4
+    ids = tok.encode("the cat sat")
+    assert ids[-1] == EOS
+    assert tok.decode(ids) == "the cat sat"
+    # frequent words compress below character length
+    assert len(tok.encode("the", add_eos=False)) < len("the")
+    # unseen characters fall back to UNK, decode still works
+    ids2 = tok.encode("the zebraé")
+    assert UNK in ids2
+    assert tok.decode(tok.encode("der hund")) == "der hund"
+
+
+def test_bpe_save_load(tmp_path):
+    tok = BpeTokenizer.train(CORPUS, num_merges=32)
+    p = str(tmp_path / "vocab.json")
+    tok.save(p)
+    tok2 = BpeTokenizer.load(p)
+    text = "the dog and the cat"
+    assert tok.encode(text) == tok2.encode(text)
+    assert tok2.decode(tok2.encode(text)) == text
+
+
+def _write_corpus(d, n_train=12, n_test=4):
+    src = [CORPUS[i % len(CORPUS)] for i in range(n_train)]
+    tgt = [CORPUS[(i + 3) % len(CORPUS)] for i in range(n_train)]
+    (d / "train.src").write_text("\n".join(src) + "\n")
+    (d / "train.tgt").write_text("\n".join(tgt) + "\n")
+    (d / "val.src").write_text("\n".join(src[:n_test]) + "\n")
+    (d / "val.tgt").write_text("\n".join(tgt[:n_test]) + "\n")
+
+
+def _tiny_spec():
+    return DatasetSpec("synthmt", (32,), 32_768, 100, 10, kind="seq2seq",
+                       src_len=16)
+
+
+def test_translation_data_batches(tmp_path):
+    _write_corpus(tmp_path)
+    spec = _tiny_spec()
+    data = TranslationData(str(tmp_path), spec, batch_size=4, seed=1)
+    x, y = data.batch(0, 0)
+    assert x.shape == (4, 32) and y.shape == (4, 32)
+    x = np.asarray(x)
+    y = np.asarray(y)
+    # source-internal labels masked; pads masked; some target labels valid
+    assert np.all(y[:, : spec.src_len - 1] == -1)
+    assert (y >= 0).sum() > 0
+    # pad-input positions never carry loss
+    assert np.all(y[x == PAD] == -1)
+    # every row's target segment starts with BOS at src_len
+    assert np.all(x[:, spec.src_len] == BOS)
+    # deterministic: same (seed, epoch, step) -> same batch
+    x2, y2 = data.batch(0, 0)
+    np.testing.assert_array_equal(np.asarray(x2), x)
+    # different epochs shuffle differently
+    x3, _ = data.batch(1, 0)
+    assert not np.array_equal(np.asarray(x3), x)
+    # eval split served unshuffled from val.*
+    xe, ye = data.batch(0, 0, train=False)
+    assert xe.shape == (4, 32)
+    # vocab persisted for reuse
+    assert (tmp_path / "bpe_vocab.json").exists()
+    d2 = TranslationData(str(tmp_path), spec, batch_size=4, seed=1)
+    np.testing.assert_array_equal(np.asarray(d2.batch(0, 0)[0]), x)
+
+
+def test_padding_efficiency_accounting(tmp_path):
+    _write_corpus(tmp_path)
+    data = TranslationData(str(tmp_path), _tiny_spec(), batch_size=4)
+    eff = data.padding_efficiency()
+    assert 0.0 < eff <= 1.0
+    rep = data.bucketing_report()
+    assert rep["fixed_efficiency"] == pytest.approx(eff)
+    # bucketing can only improve token efficiency, at the price of compiles
+    assert rep["bucketed_efficiency"] >= rep["fixed_efficiency"]
+    assert rep["num_compiles_bucketed"] >= 1
+    assert sum(b["count"] for b in rep["buckets"]) == 12
+
+
+def test_translation_end_to_end_training(tmp_path):
+    """A seq2seq model trains on the real-corpus stream (the -s path)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.models.seq2seq import build_seq2seq
+    from ddlbench_tpu.parallel.single import SingleStrategy
+
+    _write_corpus(tmp_path)
+    spec = _tiny_spec()
+    data = TranslationData(str(tmp_path), spec, batch_size=4, num_merges=32)
+    from ddlbench_tpu.models.seq2seq import _VARIANTS
+
+    _VARIANTS.setdefault("seq2seq_t", dict(d_model=32, n_layers=2, n_heads=4))
+    model = build_seq2seq("seq2seq_t", spec.image_size, spec.num_classes,
+                          spec.src_len)
+    cfg = RunConfig(benchmark="synthmt", strategy="single", arch="seq2seq_s",
+                    compute_dtype="float32", batch_size=4)
+    strat = SingleStrategy(model, cfg)
+    ts = strat.init(jax.random.key(0))
+    losses = []
+    for step in range(3):
+        x, y = data.batch(0, step)
+        ts, m = strat.train_step(ts, x, y, jnp.float32(0.05))
+        losses.append(float(m["loss"]))
+    assert all(np.isfinite(l) for l in losses)
+    assert losses[-1] < losses[0]  # tiny corpus: loss drops fast
+
+
+def test_find_parallel_corpus(tmp_path):
+    assert find_parallel_corpus(str(tmp_path), "train") is None
+    (tmp_path / "train.src").write_text("a\n")
+    (tmp_path / "train.tgt").write_text("b\n")
+    assert find_parallel_corpus(str(tmp_path), "train") is not None
+    assert find_parallel_corpus(str(tmp_path), "test") is None
+    (tmp_path / "val.src").write_text("a\n")
+    (tmp_path / "val.tgt").write_text("b\n")
+    assert find_parallel_corpus(str(tmp_path), "test") is not None
