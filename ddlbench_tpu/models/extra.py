@@ -1,0 +1,307 @@
+"""Extended CNN families from the reference profiler's model directory.
+
+The reference's PipeDream profiler tree carries torchvision-style models
+beyond the benchmarked trio — alexnet, lenet, squeezenet, resnext, densenet
+(pipedream-fork/profiler/image_classification/models/, SURVEY.md §2 B7
+"+ unused ...") — kept so any of them can be profiled and partitioned. This
+module provides the same family as flat layer chains: every block is one
+pipeline-atomic Layer, so each model runs under every strategy and profiles
+into the partitioner like the core zoo. (inception/nasnet are omitted: like
+the reference, nothing benchmarks them, and their cell graphs add no new
+capability over the families here.)
+
+Builders follow the torchvision architectures; small inputs (MNIST/CIFAR)
+get resolution-preserving stems like models/resnet.py.
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.models.layers import (
+    Layer, LayerModel, _conv_kernel_init, _conv_out_hw, bn_init, batchnorm,
+    conv2d, conv_bn, dense, flatten, global_avg_pool, max_pool)
+
+
+def _conv_relu(name: str, out_ch: int, kernel: int, stride: int = 1,
+               padding: str = "SAME", relu: bool = True) -> Layer:
+    """Plain conv (+bias) without BatchNorm — LeNet/AlexNet/SqueezeNet
+    fidelity (those architectures predate BN)."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k = _conv_kernel_init(key, kernel, kernel, c, out_ch)
+        b = jnp.zeros((out_ch,), jnp.float32)
+        oh, ow = _conv_out_hw(h, w, kernel, kernel, stride, padding)
+        return {"kernel": k, "b": b}, {}, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        y = conv2d(x, p["kernel"], stride, padding) + p["b"].astype(x.dtype)
+        if relu:
+            y = jax.nn.relu(y)
+        return y, s
+
+    return Layer(name, init, apply)
+
+
+# ---------------------------------------------------------------------------
+# LeNet-5 / AlexNet
+# ---------------------------------------------------------------------------
+
+def build_lenet(in_shape, num_classes: int) -> LayerModel:
+    layers = [
+        _conv_relu("conv1", 6, kernel=5),
+        max_pool("pool1", window=2),
+        _conv_relu("conv2", 16, kernel=5),
+        max_pool("pool2", window=2),
+        flatten(),
+        dense("fc1", 120, relu=True),
+        dense("fc2", 84, relu=True),
+        dense("fc3", num_classes),
+    ]
+    return LayerModel("lenet", layers, tuple(in_shape), num_classes)
+
+
+def build_alexnet(in_shape, num_classes: int) -> LayerModel:
+    small = in_shape[0] <= 64
+    layers: List[Layer] = [
+        _conv_relu("conv1", 64, kernel=11 if not small else 3,
+                   stride=4 if not small else 1),
+        max_pool("pool1", window=3, stride=2, padding="SAME" if small else "VALID"),
+        _conv_relu("conv2", 192, kernel=5),
+        max_pool("pool2", window=3, stride=2, padding="SAME" if small else "VALID"),
+        _conv_relu("conv3", 384, kernel=3),
+        _conv_relu("conv4", 256, kernel=3),
+        _conv_relu("conv5", 256, kernel=3),
+        max_pool("pool5", window=3, stride=2, padding="SAME" if small else "VALID"),
+        flatten(),
+        dense("fc1", 4096, relu=True, dropout=0.5),
+        dense("fc2", 4096, relu=True, dropout=0.5),
+        dense("fc3", num_classes),
+    ]
+    return LayerModel("alexnet", layers, tuple(in_shape), num_classes)
+
+
+# ---------------------------------------------------------------------------
+# SqueezeNet (fire modules)
+# ---------------------------------------------------------------------------
+
+def _fire(name: str, squeeze: int, expand: int) -> Layer:
+    """Fire module: 1x1 squeeze -> concat(1x1 expand, 3x3 expand)."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k1, k2, k3 = jax.random.split(key, 3)
+        p = {
+            "sq": _conv_kernel_init(k1, 1, 1, c, squeeze),
+            "e1": _conv_kernel_init(k2, 1, 1, squeeze, expand),
+            "e3": _conv_kernel_init(k3, 3, 3, squeeze, expand),
+        }
+        return p, {}, (h, w, 2 * expand)
+
+    def apply(p, s, x, train):
+        sq = jax.nn.relu(conv2d(x, p["sq"], 1, "SAME"))
+        e1 = jax.nn.relu(conv2d(sq, p["e1"], 1, "SAME"))
+        e3 = jax.nn.relu(conv2d(sq, p["e3"], 1, "SAME"))
+        return jnp.concatenate([e1, e3], axis=-1), s
+
+    return Layer(name, init, apply)
+
+
+def build_squeezenet(in_shape, num_classes: int) -> LayerModel:
+    small = in_shape[0] <= 64
+    layers: List[Layer] = [
+        _conv_relu("conv1", 64, kernel=3, stride=1 if small else 2),
+        max_pool("pool1", window=3, stride=2, padding="SAME"),
+        _fire("fire2", 16, 64),
+        _fire("fire3", 16, 64),
+        max_pool("pool3", window=3, stride=2, padding="SAME"),
+        _fire("fire4", 32, 128),
+        _fire("fire5", 32, 128),
+        max_pool("pool5", window=3, stride=2, padding="SAME"),
+        _fire("fire6", 48, 192),
+        _fire("fire7", 48, 192),
+        _fire("fire8", 64, 256),
+        _fire("fire9", 64, 256),
+        _conv_relu("conv10", num_classes, kernel=1),
+        global_avg_pool(),
+    ]
+    return LayerModel("squeezenet", layers, tuple(in_shape), num_classes)
+
+
+# ---------------------------------------------------------------------------
+# ResNeXt-50 32x4d (grouped bottlenecks)
+# ---------------------------------------------------------------------------
+
+def _resnext_block(name: str, width: int, stride: int, groups: int = 32,
+                   expansion: int = 2) -> Layer:
+    """Grouped bottleneck: 1x1 -> grouped 3x3 -> 1x1, residual add."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        out_ch = width * expansion
+        ks = jax.random.split(key, 4)
+        p = {
+            "c1": _conv_kernel_init(ks[0], 1, 1, c, width),
+            "c2": _conv_kernel_init(ks[1], 3, 3, width // groups, width),
+            "c3": _conv_kernel_init(ks[2], 1, 1, width, out_ch),
+        }
+        s = {}
+        p["bn1"], s["bn1"] = bn_init(width)
+        p["bn2"], s["bn2"] = bn_init(width)
+        p["bn3"], s["bn3"] = bn_init(out_ch)
+        if stride != 1 or c != out_ch:
+            p["proj"] = _conv_kernel_init(ks[3], 1, 1, c, out_ch)
+            p["bnp"], s["bnp"] = bn_init(out_ch)
+        oh, ow = (h + stride - 1) // stride, (w + stride - 1) // stride
+        return p, s, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        y = conv2d(x, p["c1"], 1, "SAME")
+        y, bn1 = batchnorm(p["bn1"], s["bn1"], y, train)
+        y = jax.nn.relu(y)
+        y = conv2d(y, p["c2"], stride, "SAME", groups=groups)
+        y, bn2 = batchnorm(p["bn2"], s["bn2"], y, train)
+        y = jax.nn.relu(y)
+        y = conv2d(y, p["c3"], 1, "SAME")
+        y, bn3 = batchnorm(p["bn3"], s["bn3"], y, train)
+        ns = {"bn1": bn1, "bn2": bn2, "bn3": bn3}
+        if "proj" in p:
+            sc = conv2d(x, p["proj"], stride, "SAME")
+            sc, bnp = batchnorm(p["bnp"], s["bnp"], sc, train)
+            ns["bnp"] = bnp
+        else:
+            sc = x
+        return jax.nn.relu(y + sc), ns
+
+    return Layer(name, init, apply)
+
+
+def build_resnext50(in_shape, num_classes: int) -> LayerModel:
+    small = in_shape[0] <= 64
+    layers: List[Layer] = []
+    if small:
+        layers.append(conv_bn("stem", 64, kernel=3, stride=1))
+    else:
+        layers.append(conv_bn("stem", 64, kernel=7, stride=2))
+        layers.append(max_pool("stem_pool", window=3, stride=2,
+                               padding="SAME"))
+    counts = [3, 4, 6, 3]
+    widths = [128, 256, 512, 1024]  # 32 groups x 4d base
+    for g, (width, n) in enumerate(zip(widths, counts)):
+        for b in range(n):
+            stride = 2 if (b == 0 and g > 0) else 1
+            layers.append(_resnext_block(f"group{g + 1}_block{b + 1}",
+                                         width, stride))
+    layers.append(global_avg_pool())
+    layers.append(dense("fc", num_classes))
+    return LayerModel("resnext50", layers, tuple(in_shape), num_classes)
+
+
+# ---------------------------------------------------------------------------
+# DenseNet-121 (dense blocks + transitions; each dense block is one Layer)
+# ---------------------------------------------------------------------------
+
+def _dense_block(name: str, n_layers: int, growth: int = 32,
+                 bn_size: int = 4) -> Layer:
+    """DenseNet block: n_layers of BN-ReLU-1x1 -> BN-ReLU-3x3, each
+    concatenating its growth-channel output onto the running feature map."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        p, s = {}, {}
+        ch = c
+        for i in range(n_layers):
+            k1, k2, key = jax.random.split(key, 3)
+            p[f"l{i}_bn1"], s[f"l{i}_bn1"] = bn_init(ch)
+            p[f"l{i}_c1"] = _conv_kernel_init(k1, 1, 1, ch, bn_size * growth)
+            p[f"l{i}_bn2"], s[f"l{i}_bn2"] = bn_init(bn_size * growth)
+            p[f"l{i}_c2"] = _conv_kernel_init(k2, 3, 3, bn_size * growth,
+                                              growth)
+            ch += growth
+        return p, s, (h, w, ch)
+
+    def apply(p, s, x, train):
+        ns = {}
+        feats = x
+        for i in range(n_layers):
+            y, ns[f"l{i}_bn1"] = batchnorm(p[f"l{i}_bn1"], s[f"l{i}_bn1"],
+                                           feats, train)
+            y = conv2d(jax.nn.relu(y), p[f"l{i}_c1"], 1, "SAME")
+            y, ns[f"l{i}_bn2"] = batchnorm(p[f"l{i}_bn2"], s[f"l{i}_bn2"],
+                                           y, train)
+            y = conv2d(jax.nn.relu(y), p[f"l{i}_c2"], 1, "SAME")
+            feats = jnp.concatenate([feats, y.astype(feats.dtype)], axis=-1)
+        return feats, ns
+
+    return Layer(name, init, apply)
+
+
+def _bn_relu(name: str) -> Layer:
+    """Final features BatchNorm + ReLU (torchvision DenseNet's norm5)."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        p, s = {}, {}
+        p["bn"], s["bn"] = bn_init(c)
+        return p, s, (h, w, c)
+
+    def apply(p, s, x, train):
+        y, bn = batchnorm(p["bn"], s["bn"], x, train)
+        return jax.nn.relu(y), {"bn": bn}
+
+    return Layer(name, init, apply)
+
+
+def _transition(name: str, out_ch: int) -> Layer:
+    def init(key, in_shape):
+        h, w, c = in_shape
+        p = {"conv": _conv_kernel_init(key, 1, 1, c, out_ch)}
+        s = {}
+        p["bn"], s["bn"] = bn_init(c)
+        return p, s, (h // 2, w // 2, out_ch)
+
+    def apply(p, s, x, train):
+        y, bn = batchnorm(p["bn"], s["bn"], x, train)
+        y = conv2d(jax.nn.relu(y), p["conv"], 1, "SAME")
+        # torch AvgPool2d(2, 2): floor output, no padding, true mean
+        y = jax.lax.reduce_window(
+            y, 0.0, jax.lax.add, (1, 2, 2, 1), (1, 2, 2, 1), "VALID") / 4.0
+        return y, {"bn": bn}
+
+    return Layer(name, init, apply)
+
+
+def build_densenet121(in_shape, num_classes: int) -> LayerModel:
+    small = in_shape[0] <= 64
+    growth = 32
+    layers: List[Layer] = []
+    if small:
+        layers.append(conv_bn("stem", 2 * growth, kernel=3, stride=1))
+    else:
+        layers.append(conv_bn("stem", 2 * growth, kernel=7, stride=2))
+        layers.append(max_pool("stem_pool", window=3, stride=2,
+                               padding="SAME"))
+    ch = 2 * growth
+    for i, n in enumerate([6, 12, 24, 16]):
+        layers.append(_dense_block(f"dense{i + 1}", n, growth))
+        ch += n * growth
+        if i < 3:
+            ch = ch // 2
+            layers.append(_transition(f"trans{i + 1}", ch))
+    layers.append(_bn_relu("norm5"))  # torchvision's final features norm
+    layers.append(global_avg_pool())
+    layers.append(dense("fc", num_classes))
+    return LayerModel("densenet121", layers, tuple(in_shape), num_classes)
+
+
+BUILDERS = {
+    "lenet": build_lenet,
+    "alexnet": build_alexnet,
+    "squeezenet": build_squeezenet,
+    "resnext50": build_resnext50,
+    "densenet121": build_densenet121,
+}

@@ -14,7 +14,8 @@ from ddlbench_tpu.models.resnet import build_resnet
 from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
-               "mobilenetv2", "transformer_s", "transformer_m",
+               "mobilenetv2", "lenet", "alexnet", "squeezenet", "resnext50",
+               "densenet121", "transformer_s", "transformer_m",
                "transformer_moe_s", "seq2seq_s", "seq2seq_m")
 
 
@@ -49,4 +50,8 @@ def get_model(arch: str, dataset: str | DatasetSpec,
         return build_vgg(arch, spec.image_size, spec.num_classes)
     if arch == "mobilenetv2":
         return build_mobilenetv2(arch, spec.image_size, spec.num_classes)
+    from ddlbench_tpu.models.extra import BUILDERS as _EXTRA
+
+    if arch in _EXTRA:
+        return _EXTRA[arch](spec.image_size, spec.num_classes)
     raise ValueError(f"unknown arch {arch!r}; known: {MODEL_NAMES}")

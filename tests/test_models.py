@@ -19,6 +19,14 @@ CASES = [
     ("vgg11", "mnist"),
     ("vgg16", "cifar10"),
     ("mobilenetv2", "cifar10"),
+    # extended profiler family (models/extra.py; reference profiler
+    # models dir "+ unused alexnet/.../resnext/lenet", SURVEY.md §2 B7);
+    # the big ones compile slowly on the 1-core CPU mesh -> slow marker
+    ("lenet", "mnist"),
+    ("squeezenet", "cifar10"),
+    pytest.param("alexnet", "cifar10", marks=pytest.mark.slow),
+    pytest.param("resnext50", "cifar10", marks=pytest.mark.slow),
+    pytest.param("densenet121", "mnist", marks=pytest.mark.slow),
 ]
 
 
@@ -71,3 +79,30 @@ def test_bn_state_updates_in_train_only():
         for a, b in zip(jax.tree.leaves(st_train), jax.tree.leaves(state))
     ]
     assert any(changed)
+
+
+def test_extra_family_trains_and_profiles():
+    """The extended family members train (one SGD step) and produce profile
+    graphs the partitioner consumes — the profile->partition path the
+    reference keeps these models around for."""
+    from ddlbench_tpu.config import HardwareModel, RunConfig
+    from ddlbench_tpu.parallel.single import SingleStrategy
+    from ddlbench_tpu.partition.optimizer import partition_hierarchical
+    from ddlbench_tpu.profiler import profile_model
+
+    for arch in ("lenet", "squeezenet"):
+        model = get_model(arch, "mnist")
+        cfg = RunConfig(benchmark="mnist", strategy="single", arch=arch,
+                        batch_size=4, compute_dtype="float32")
+        strat = SingleStrategy(model, cfg)
+        ts = strat.init(jax.random.key(0))
+        x = jnp.zeros((4, 28, 28, 1), jnp.float32)
+        y = jnp.zeros((4,), jnp.int32)
+        ts, m = strat.train_step(ts, x, y, jnp.float32(0.01))
+        import math
+
+        assert math.isfinite(float(m["loss"]))
+        g = profile_model(model, 2, mode="flops")
+        assert len(g.nodes) == len(model.layers)
+        plan = partition_hierarchical(g, 2, HardwareModel())
+        assert plan.stages[-1].end == len(model.layers)
