@@ -1,0 +1,135 @@
+"""Scaling-curve harness: strategy throughput vs chip count.
+
+The north-star measurement (BASELINE.md): ResNet-50/ImageNet images/sec/chip
+and DP-vs-pipeline scaling efficiency from 1 to N chips. This tool sweeps
+strategies over growing device counts on whatever mesh exists — the real TPU
+slice when one is attached, or the virtual CPU mesh
+(XLA_FLAGS=--xla_force_host_platform_device_count=N) for harness validation —
+and prints one JSON line per (strategy, n_devices) point:
+
+    {"strategy": "dp", "devices": 4, "samples_per_sec": N,
+     "per_chip": N, "efficiency": N}
+
+``efficiency`` is per-chip throughput relative to the 1-chip single-strategy
+anchor (the reference's scaling-efficiency definition; weak scaling — the
+global batch grows with the chip count for dp/fsdp, stays per-pipeline for
+gpipe/pipedream).
+
+Usage:
+    python -m ddlbench_tpu.tools.scalebench [-b imagenet] [-m resnet50]
+        [--devices 1,2,4,8] [--strategies dp,gpipe,pipedream]
+        [--steps 10] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _run_point(cfg, steps: int, warmup: int):
+    import jax
+    import jax.numpy as jnp
+
+    from ddlbench_tpu.data.synthetic import make_synthetic
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    strategy = make_strategy(cfg)
+    data = make_synthetic(cfg.dataset(), cfg.global_batch(),
+                          steps_per_epoch=steps)
+    ts = strategy.init(jax.random.key(cfg.seed))
+    lr = jnp.float32(cfg.resolved_lr())
+    x, y = data.batch(0, 0)
+    xs, ys = strategy.shard_batch(x, y)
+    for _ in range(warmup):
+        ts, m = strategy.train_step(ts, xs, ys, lr)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for step in range(steps):
+        x, y = data.batch(1, step)
+        xs, ys = strategy.shard_batch(x, y)
+        ts, m = strategy.train_step(ts, xs, ys, lr)
+    float(m["loss"])  # chained ts => full sync (axon-safe)
+    dt = time.perf_counter() - t0
+    return steps * cfg.global_batch() / dt
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-b", "--benchmark", default="imagenet")
+    p.add_argument("-m", "--model", default="resnet50")
+    p.add_argument("--devices", default=None,
+                   help="comma list of chip counts (default: 1,2,4,... up to "
+                        "the attached device count)")
+    p.add_argument("--strategies", default="dp,gpipe,pipedream")
+    p.add_argument("--batch-size", type=int, default=None,
+                   help="per-device batch for dp; global for pipelines")
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--warmup", type=int, default=2)
+    p.add_argument("--dtype", default="bfloat16")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    import jax
+
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.distributed import enable_compilation_cache
+
+    enable_compilation_cache()
+    avail = len(jax.devices())
+    if args.devices:
+        counts = [int(c) for c in args.devices.split(",")]
+    else:
+        counts = [c for c in (1, 2, 4, 8, 16, 32) if c <= avail]
+    bad = [c for c in counts if c > avail]
+    if bad:
+        p.error(f"device counts {bad} exceed the {avail} attached devices")
+
+    # 1-chip anchor: the single strategy (the reference's baseline driver)
+    anchor_cfg = RunConfig(
+        benchmark=args.benchmark, strategy="single", arch=args.model,
+        batch_size=args.batch_size, compute_dtype=args.dtype,
+        steps_per_epoch=args.steps)
+    anchor = _run_point(anchor_cfg, args.steps, args.warmup)
+    print(json.dumps({"strategy": "single", "devices": 1,
+                      "samples_per_sec": round(anchor, 2),
+                      "per_chip": round(anchor, 2), "efficiency": 1.0}),
+          flush=True)
+
+    for strat in args.strategies.split(","):
+        strat = strat.strip()
+        for n in counts:
+            if n == 1:
+                continue
+            kw = dict(benchmark=args.benchmark, strategy=strat,
+                      arch=args.model, num_devices=n,
+                      compute_dtype=args.dtype, steps_per_epoch=args.steps)
+            if strat in ("dp", "fsdp"):
+                kw["batch_size"] = args.batch_size
+            else:
+                kw["num_stages"] = n
+            cfg = RunConfig(**kw)
+            try:
+                cfg.validate()
+                ips = _run_point(cfg, args.steps, args.warmup)
+            except Exception as e:  # point failures shouldn't kill the sweep
+                print(json.dumps({"strategy": strat, "devices": n,
+                                  "error": str(e)[:200]}), flush=True)
+                continue
+            print(json.dumps({
+                "strategy": strat,
+                "devices": n,
+                "samples_per_sec": round(ips, 2),
+                "per_chip": round(ips / n, 2),
+                "efficiency": round(ips / n / anchor, 4),
+            }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

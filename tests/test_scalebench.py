@@ -1,0 +1,26 @@
+"""Scaling-curve harness smoke (tools/scalebench.py)."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
+
+def test_scalebench_emits_curve(devices, capsys):
+    from ddlbench_tpu.tools.scalebench import main
+
+    rc = main(["-b", "mnist", "-m", "lenet", "--devices", "2",
+               "--strategies", "dp,gpipe", "--steps", "2", "--warmup", "1",
+               "--dtype", "float32", "--batch-size", "4"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    strategies = {(d["strategy"], d["devices"]) for d in lines}
+    assert ("single", 1) in strategies
+    assert ("dp", 2) in strategies and ("gpipe", 2) in strategies
+    for d in lines:
+        assert "error" not in d, d
+        assert d["samples_per_sec"] > 0
+        assert d["per_chip"] == pytest.approx(
+            d["samples_per_sec"] / d["devices"], rel=1e-3)
