@@ -20,7 +20,6 @@ import json
 import os
 import subprocess
 import sys
-import time
 
 import jax
 import jax.numpy as jnp
@@ -105,22 +104,20 @@ def main() -> int:
     lr = jnp.float32(cfg.resolved_lr())
 
     # AOT-compile once: the same executable serves warmup, the timed loop,
-    # and the roofline cost analysis (no second compile). NOTE: sync via
-    # float() (device transfer) rather than block_until_ready — on the
-    # experimental axon TPU tunnel the latter can return before execution
-    # finishes, inflating throughput ~100x.
+    # and the roofline cost analysis (no second compile). Measurement
+    # discipline (warmup >= 1, chained train state, float(loss) sync — the
+    # axon tunnel's block_until_ready is unreliable) lives in tools/timing.
+    from ddlbench_tpu.tools.timing import timed_steps
+
     x, y = data.batch(0, 0)
     step_fn = strategy.train_step.lower(ts, x, y, lr).compile()
-    for _ in range(args.warmup):
-        ts, m = step_fn(ts, x, y, lr)
-    float(m["loss"])
 
-    t0 = time.perf_counter()
-    for step in range(args.steps):
-        x, y = data.batch(1, step)
-        ts, m = step_fn(ts, x, y, lr)
-    float(m["loss"])  # sequential ts dependency forces the whole chain
-    dt = time.perf_counter() - t0
+    def run_step(bx, by):
+        nonlocal ts
+        ts, m = step_fn(ts, bx, by, lr)
+        return m
+
+    dt = timed_steps(run_step, data.batch, args.steps, args.warmup)
 
     ips = args.steps * args.batch_size / dt
     record = {

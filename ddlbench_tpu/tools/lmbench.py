@@ -26,7 +26,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 
 def main(argv=None) -> int:
@@ -104,17 +103,14 @@ def main(argv=None) -> int:
         ts = strategy.init(jax.random.key(cfg.seed))
         lr = jnp.float32(cfg.resolved_lr())
 
-        x, y = data.batch(0, 0)
-        for _ in range(max(1, args.warmup)):  # >=1: compile outside the timing
-            ts, m = strategy.train_step(ts, x, y, lr)
-        float(m["loss"])
+        from ddlbench_tpu.tools.timing import timed_steps
 
-        t0 = time.perf_counter()
-        for step in range(args.steps):
-            x, y = data.batch(1, step)
-            ts, m = strategy.train_step(ts, x, y, lr)
-        float(m["loss"])  # ts chain + transfer = full sync
-        dt = time.perf_counter() - t0
+        def run_step(x, y, _s=strategy):
+            nonlocal ts
+            ts, m = _s.train_step(ts, x, y, lr)
+            return m
+
+        dt = timed_steps(run_step, data.batch, args.steps, args.warmup)
 
         tokens = args.steps * B * spec.seq_len
         print(json.dumps({

@@ -26,7 +26,6 @@ from __future__ import annotations
 import argparse
 import json
 import sys
-import time
 
 
 def _run_point(cfg, steps: int, warmup: int):
@@ -35,24 +34,20 @@ def _run_point(cfg, steps: int, warmup: int):
 
     from ddlbench_tpu.data.synthetic import make_synthetic
     from ddlbench_tpu.parallel.api import make_strategy
+    from ddlbench_tpu.tools.timing import timed_steps
 
     strategy = make_strategy(cfg)
     data = make_synthetic(cfg.dataset(), cfg.global_batch(),
                           steps_per_epoch=steps)
     ts = strategy.init(jax.random.key(cfg.seed))
     lr = jnp.float32(cfg.resolved_lr())
-    x, y = data.batch(0, 0)
-    xs, ys = strategy.shard_batch(x, y)
-    for _ in range(warmup):
-        ts, m = strategy.train_step(ts, xs, ys, lr)
-    float(m["loss"])
-    t0 = time.perf_counter()
-    for step in range(steps):
-        x, y = data.batch(1, step)
-        xs, ys = strategy.shard_batch(x, y)
-        ts, m = strategy.train_step(ts, xs, ys, lr)
-    float(m["loss"])  # chained ts => full sync (axon-safe)
-    dt = time.perf_counter() - t0
+
+    def run_step(x, y):
+        nonlocal ts
+        ts, m = strategy.train_step(ts, *strategy.shard_batch(x, y), lr)
+        return m
+
+    dt = timed_steps(run_step, data.batch, steps, warmup)
     return steps * cfg.global_batch() / dt
 
 
@@ -104,14 +99,13 @@ def main(argv=None) -> int:
     for strat in args.strategies.split(","):
         strat = strat.strip()
         for n in counts:
-            if n == 1:
-                continue
+            # n == 1 is a legitimate point too (1-stage pipelines measure
+            # the microbatching overhead vs the single anchor)
             kw = dict(benchmark=args.benchmark, strategy=strat,
                       arch=args.model, num_devices=n,
-                      compute_dtype=args.dtype, steps_per_epoch=args.steps)
-            if strat in ("dp", "fsdp"):
-                kw["batch_size"] = args.batch_size
-            else:
+                      compute_dtype=args.dtype, steps_per_epoch=args.steps,
+                      batch_size=args.batch_size)
+            if strat not in ("dp", "fsdp"):
                 kw["num_stages"] = n
             cfg = RunConfig(**kw)
             try:

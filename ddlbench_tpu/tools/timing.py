@@ -1,0 +1,36 @@
+"""Shared benchmark timing ritual for bench.py / lmbench / scalebench.
+
+One home for the measurement discipline so the copies cannot drift:
+* warmup at least once (compilation stays out of the timed loop),
+* time a loop whose train state chains step-to-step (so nothing overlaps
+  past the measured region),
+* sync via float(metrics["loss"]) — a device->host transfer — because on
+  the experimental axon TPU tunnel block_until_ready can return before
+  execution finishes, inflating throughput ~100x.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Callable, Tuple
+
+
+def timed_steps(run_step: Callable[[object, object], dict],
+                get_batch: Callable[[int, int], Tuple[object, object]],
+                steps: int, warmup: int) -> float:
+    """Return the wall-clock seconds for ``steps`` chained train steps.
+
+    ``run_step(x, y) -> metrics`` must thread its own train state (the chain
+    is what makes float(loss) a full barrier); ``get_batch(epoch, step)``
+    supplies batches (epoch 0 = warmup, 1 = timed)."""
+    m = None
+    x, y = get_batch(0, 0)
+    for _ in range(max(1, warmup)):
+        m = run_step(x, y)
+    float(m["loss"])
+    t0 = time.perf_counter()
+    for step in range(steps):
+        x, y = get_batch(1, step)
+        m = run_step(x, y)
+    float(m["loss"])
+    return time.perf_counter() - t0
