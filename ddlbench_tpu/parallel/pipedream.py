@@ -10,19 +10,26 @@ num_versions = warmup+1 clones so backward uses the same weights as that
 minibatch's forward (runtime/optimizer.py:58-116); replicated stages are
 DDP-wrapped per stage (runtime.py:232-263).
 
-TPU-native design — the whole async schedule is ONE compiled XLA program:
+TPU-native design — the whole async schedule is ONE compiled XLA program,
+written once over C = S*V model chunks (V = cfg.virtual_stages; the classic
+schedule is the V = 1 degenerate case):
 
-* Global clock of H = 2M + 2S - 2 half-ticks; at each half-tick a stage does
-  one forward, one backward, or idles, per the closed-form 1F1B timetable
-      F(s, f) = s + f + max(0, f - W_s)         W_s = S - 1 - s warmup count
-      B(s, b) = 2b + 2S - 1 - s
-  (derived from the reference's warmup/steady/drain loop). Forward activations
-  ppermute right; gradients ppermute left; a 2-slot queue absorbs the one
-  half-tick of skew between activation arrival and use.
-* Weight stashing: each stage carries its packed parameter vector plus a
-  [S, L] stash ring; forward f writes the vector it used into slot f mod S,
-  backward b reads slot b mod S — so backward grads are taken at exactly the
-  forward-time weights (OptimizerWithWeightStashing parity, but functional).
+* Global clock of H = 2M + 2C - 2 half-ticks; at each half-tick every chunk
+  does one forward, one backward, or idles, per the closed-form 1F1B
+  timetable
+      F(c, f) = c + f + max(0, f - W_c)         W_c = C - 1 - c warmup count
+      B(c, b) = 2b + 2C - 1 - c
+  (derived from the reference's warmup/steady/drain loop). Chunk c = v*S + s
+  lives on device s; a device executes its V chunk-events sequentially
+  within the tick. Forward activations ring-ppermute right; gradients left;
+  wrap transfers (device S-1 -> 0 fwd, 0 -> S-1 bwd) roll the chunk-slot
+  axis; a per-chunk 2-slot queue absorbs the one half-tick of skew between
+  activation arrival and use.
+* Weight stashing: each chunk carries its packed parameter vector plus a
+  [min(C,M), L] stash ring; forward f writes the vector it used into slot
+  f mod NSLOT, backward b reads slot b mod NSLOT — so backward grads are
+  taken at exactly the forward-time weights (OptimizerWithWeightStashing
+  parity, but functional).
 * Backward is recompute-based: we stash the stage *input* (not the autograd
   graph) and take jax.vjp of the stage at the stashed (weights, input). This
   trades the reference's activation-stash memory for recompute FLOPs — the
@@ -184,347 +191,6 @@ class PipeDreamStrategy(GPipeStrategy):
         return stage_fwd_fused
 
     def _make_train_step(self):
-        if self.vstages > 1:
-            return self._make_train_step_interleaved()
-        return self._make_train_step_v1()
-
-    def _make_train_step_v1(self):
-        S, M, mb = self.num_stages, self.num_microbatches, self.mb
-        H = 2 * M + 2 * S - 2
-        NSLOT = min(S, M)
-        # Macrobatch mode (reference runtime/optimizer.py:36-52,119-164):
-        # gradients accumulate across K consecutive microbatches' backwards
-        # and the optimizer steps once per interval with the /K average.
-        # Deviation (documented): the reference caps its version queue at 2
-        # and its backward may read a version one commit staler than the
-        # forward actually used; our stash ring keeps the exact forward
-        # weights per in-flight microbatch either way (no extra memory — the
-        # ring is bounded by min(S, M) regardless).
-        K = max(1, self.cfg.update_interval)
-        opt_update = self._opt_update
-        smooth = self.cfg.resolved_label_smoothing()
-        aux_w = self.cfg.moe_aux_weight
-        mesh = self.mesh
-        total = self._total_samples
-        cdtype = self.compute_dtype
-        fwd_perm = [(i, i + 1) for i in range(S - 1)]
-        bwd_perm = [(i + 1, i) for i in range(S - 1)]
-        stage_fwds = [self._make_stage_fwd(s) for s in range(S)]
-        in_shapes = [self.shapes[self.bounds[s]] for s in range(S)]
-        in_sizes = [mb * math.prod(sh) for sh in in_shapes]
-        # Interior boundary activations only: stage 0's raw input is re-read
-        # from xs at backward time (never stashed or ring-transferred), so it
-        # does not size the buffers.
-        A = max(in_sizes[1:]) if S > 1 else 1
-
-        fused_last = self._make_stage_fwd_fused(S - 1)
-
-        def make_branch(s: int):
-            stage_fwd = stage_fwds[s]
-            fused_fwd = fused_last if s == S - 1 else None
-            if self.cfg.remat_stages:
-                stage_fwd = jax.checkpoint(stage_fwd)
-                if fused_fwd is not None:
-                    fused_fwd = jax.checkpoint(fused_fwd)
-            in_shape, in_size = in_shapes[s], in_sizes[s]
-            last = s == S - 1
-            W = S - 1 - s
-
-            def unpack_x(buf):
-                return buf[:in_size].reshape(mb, *in_shape)
-
-            def branch(carry, xs, ys, h, lr):
-                (params, opt_row, g_acc, st_row, stash_p, stash_x,
-                 fwd_q, g_buf, loss_acc, corr_acc) = carry
-
-                f, valid_f = fwd_mb_at(s, S, M, h)
-                b, valid_b = bwd_mb_at(s, S, M, h)
-
-                # ---- forward path (uses newest params; stashes them) ----
-                def do_fwd(op):
-                    params, st_row, stash_p, stash_x, fwd_q = op
-                    if s == 0:
-                        # raw batch input (float images or int tokens)
-                        x = lax.dynamic_index_in_dim(xs, f, keepdims=False)
-                    else:
-                        x = unpack_x(lax.dynamic_index_in_dim(
-                            fwd_q, f % 2, keepdims=False))
-                    if last and fused_fwd is not None:
-                        labels = lax.dynamic_index_in_dim(ys, f, keepdims=False)
-                        # metric only (the backward recomputes its own
-                        # objective): plain CE, masked-label aware
-                        _obj, ce_sum, corr_mb, new_st, _aux = fused_fwd(
-                            params, st_row, x, labels)
-                        loss_mb = ce_sum / jnp.maximum(
-                            1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
-                        y_out = jnp.zeros((A,), cdtype)
-                    else:
-                        y, new_st, _aux = stage_fwd(params, st_row, x)
-                        if last:
-                            labels = lax.dynamic_index_in_dim(
-                                ys, f, keepdims=False)
-                            # metric only (the backward recomputes its own
-                            # objective): plain CE, masked-label aware
-                            loss_mb = cross_entropy_loss(y, labels)
-                            corr_mb = correct_and_count(y, labels)[0]
-                            y_out = jnp.zeros((A,), cdtype)
-                        else:
-                            loss_mb = jnp.zeros((), jnp.float32)
-                            corr_mb = jnp.zeros((), jnp.int32)
-                            y_out = pad_vec(y.astype(cdtype), A)
-                    slot = f % NSLOT
-                    stash_p = lax.dynamic_update_index_in_dim(stash_p, params, slot, 0)
-                    if s != 0:
-                        # stage 0's input is re-read from xs at backward time
-                        # (exact for int tokens, saves a stash write).
-                        stash_x = lax.dynamic_update_index_in_dim(
-                            stash_x, pad_vec(x.astype(cdtype), A), slot, 0)
-                    return jax.tree.map(
-                        _vary, (new_st, stash_p, stash_x, y_out, loss_mb, corr_mb))
-
-                def skip_fwd(op):
-                    params, st_row, stash_p, stash_x, fwd_q = op
-                    return jax.tree.map(
-                        _vary,
-                        (st_row, stash_p, stash_x, jnp.zeros((A,), cdtype),
-                         jnp.zeros((), jnp.float32), jnp.zeros((), jnp.int32)))
-
-                st_row, stash_p, stash_x, y_out, loss_mb, corr_mb = lax.cond(
-                    valid_f, do_fwd, skip_fwd,
-                    (params, st_row, stash_p, stash_x, fwd_q),
-                )
-                loss_acc = loss_acc + loss_mb
-                corr_acc = corr_acc + corr_mb
-
-                # ---- backward path (stashed weights + stashed input) ----
-                def do_bwd(op):
-                    params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf = op
-                    slot = b % NSLOT
-                    p_st = lax.dynamic_index_in_dim(stash_p, slot, keepdims=False)
-                    if s == 0:
-                        x_st = lax.dynamic_index_in_dim(xs, b, keepdims=False)
-                    else:
-                        x_st = unpack_x(
-                            lax.dynamic_index_in_dim(stash_x, slot, keepdims=False))
-                    # Stage 0 never sends an input gradient left (and its
-                    # input may be integer tokens, which have no tangent).
-                    if last:
-                        labels = lax.dynamic_index_in_dim(ys, b, keepdims=False)
-
-                        if fused_fwd is not None:
-                            denom = jnp.maximum(
-                                1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
-
-                            def loss_of(pv, xv):
-                                obj_sum, _, _, _, aux = fused_fwd(
-                                    pv, st_row, xv, labels)
-                                # training objective: (label-smoothed) CE plus
-                                # this stage's weighted MoE router aux terms
-                                return obj_sum / denom + aux_w * aux
-                        else:
-                            def loss_of(pv, xv):
-                                y, _, aux = stage_fwd(pv, st_row, xv)
-                                # training objective: (label-smoothed) CE plus
-                                # this stage's weighted MoE router aux terms
-                                return (cross_entropy_loss(y, labels, smooth)
-                                        + aux_w * aux)
-
-                        if s == 0:
-                            gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
-                            gx = None
-                        else:
-                            gp, gx = jax.grad(loss_of, argnums=(0, 1))(p_st, x_st)
-                    else:
-                        def fwd_of(pv, xv):
-                            y, _, aux = stage_fwd(pv, st_row, xv)
-                            return y, aux
-
-                        # cotangents: upstream activation grad for y, and the
-                        # objective weight for this stage's MoE aux term
-                        g_in = unpack_g(g_buf)
-                        if s == 0:
-                            (y, aux), vjp_fn = jax.vjp(
-                                lambda pv: fwd_of(pv, x_st), p_st)
-                            (gp,) = vjp_fn((g_in.astype(y.dtype),
-                                            jnp.float32(aux_w)))
-                            gx = None
-                        else:
-                            (y, aux), vjp_fn = jax.vjp(fwd_of, p_st, x_st)
-                            gp, gx = vjp_fn((g_in.astype(y.dtype),
-                                             jnp.float32(aux_w)))
-                    # DDP-per-stage parity: sync grads across stage replicas.
-                    gp = lax.psum(gp, "data")
-                    gx_out = (jnp.zeros((A,), cdtype) if gx is None
-                              else pad_vec(gx.astype(cdtype), A))
-                    if K == 1:
-                        # per-microbatch update; g_acc is a 1-element dummy
-                        new_params, new_opt = opt_update(
-                            params, gp.astype(jnp.float32), opt_row, lr)
-                        return jax.tree.map(
-                            _vary, (new_params, new_opt, g_acc, gx_out))
-                    # macrobatch: accumulate; step (a real optimizer pass)
-                    # only on every K-th backward — nested cond so the K-1
-                    # skipped ticks pay no optimizer compute
-                    g_acc = g_acc + gp.astype(jnp.float32)
-
-                    def step(op):
-                        params, opt_row, g_acc = op
-                        new_params, new_opt = opt_update(
-                            params, g_acc / K, opt_row, lr)
-                        return jax.tree.map(
-                            _vary,
-                            (new_params, new_opt, jnp.zeros_like(g_acc)))
-
-                    def hold(op):
-                        return jax.tree.map(_vary, op)
-
-                    params, opt_row, g_acc = lax.cond(
-                        (b + 1) % K == 0, step, hold,
-                        (params, opt_row, g_acc))
-                    return jax.tree.map(
-                        _vary, (params, opt_row, g_acc, gx_out))
-
-                def skip_bwd(op):
-                    params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf = op
-                    return jax.tree.map(
-                        _vary, (params, opt_row, g_acc,
-                                jnp.zeros((A,), cdtype)))
-
-                # grad w.r.t. THIS stage's input; next tick it is consumed by
-                # stage s-1, whose output shape equals this stage's input.
-                def unpack_g(buf):
-                    if last:
-                        return None
-                    out_shape = self.shapes[self.bounds[s + 1]]
-                    out_size = mb * math.prod(out_shape)
-                    return buf[:out_size].reshape(mb, *out_shape)
-
-                params, opt_row, g_acc, gx_out = lax.cond(
-                    valid_b, do_bwd, skip_bwd,
-                    (params, opt_row, g_acc, st_row, stash_p, stash_x, g_buf),
-                )
-
-                out = (params, opt_row, g_acc, st_row, stash_p, stash_x,
-                       fwd_q, y_out, gx_out, loss_acc, corr_acc)
-                return jax.tree.map(_vary, out)
-
-            return branch
-
-        branches = [make_branch(s) for s in range(S)]
-
-        def inner(params_rows, state_rows, opt_rows, xs, ys, lr):
-            params = _vary(params_rows[0])
-            st_row = _vary(state_rows[0])
-            opt_row = jax.tree.map(lambda a: _vary(a[0]), opt_rows)
-            xs = _vary(xs)
-            ys = _vary(ys)
-            s_idx = lax.axis_index("stage")
-            L = params.shape[0]
-            Ls = st_row.shape[0]
-
-            def body(carry, h):
-                (params, opt_row, g_acc, st_row, stash_p, stash_x,
-                 fwd_q, x_in, g_buf, loss_acc, corr_acc) = carry
-
-                # Absorb the activation that arrived this half-tick into the
-                # 2-slot queue, keyed by the producing stage's (s-1) schedule.
-                def absorb(s):
-                    fi, vi = fwd_mb_at(s - 1, S, M, h - 1) if s > 0 else (
-                        jnp.zeros((), jnp.int32), jnp.zeros((), jnp.bool_))
-                    return fi, vi
-
-                # switch over stages for the absorb indices
-                fi_vi = lax.switch(
-                    s_idx,
-                    [(lambda s=s: (
-                        jax.tree.map(_vary, absorb(s))
-                    )) for s in range(S)],
-                )
-                f_in, valid_in = fi_vi
-                fwd_q = jnp.where(
-                    valid_in,
-                    lax.dynamic_update_index_in_dim(fwd_q, x_in, f_in % 2, 0),
-                    fwd_q,
-                )
-
-                carry2 = (params, opt_row, g_acc, st_row, stash_p, stash_x,
-                          fwd_q, g_buf, loss_acc, corr_acc)
-                (params, opt_row, g_acc, st_row, stash_p, stash_x, fwd_q,
-                 y_out, gx_out, loss_acc, corr_acc) = lax.switch(
-                    s_idx, branches, carry2, xs, ys, h, lr
-                )
-
-                if fwd_perm:
-                    x_in = lax.ppermute(y_out, "stage", fwd_perm)
-                    g_buf = lax.ppermute(gx_out, "stage", bwd_perm)
-                else:
-                    x_in = y_out
-                    g_buf = gx_out
-                return (params, opt_row, g_acc, st_row, stash_p, stash_x,
-                        fwd_q, x_in, g_buf, loss_acc, corr_acc), None
-
-            zeros_A = _vary(jnp.zeros((A,), cdtype))
-            # macrobatch grad accumulator; 1-element dummy when K == 1 (no
-            # carry cost for the default per-microbatch mode)
-            g_acc0 = _vary(jnp.zeros((L if K > 1 else 1,), jnp.float32))
-            init_carry = (
-                params, opt_row,
-                g_acc0,
-                st_row,
-                _vary(jnp.zeros((NSLOT, L), jnp.float32)),
-                _vary(jnp.zeros((NSLOT, A), cdtype)),
-                _vary(jnp.zeros((2, A), cdtype)),
-                zeros_A,
-                zeros_A,
-                _vary(jnp.zeros((), jnp.float32)),
-                _vary(jnp.zeros((), jnp.int32)),
-            )
-            (params, opt_row, _g_acc, st_row, *_rest, loss_acc,
-             corr_acc) = lax.scan(body, init_carry, jnp.arange(H))[0]
-            loss = lax.pmean(lax.psum(loss_acc, "stage") / M, "data")
-            correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
-            st_row = lax.pmean(st_row, "data")
-            # params/opt state identical across 'data' (grads psum'd
-            # pre-update); pmean for float leaves, pmax for the int step.
-            params = lax.pmean(params, "data")
-            opt_row = jax.tree.map(
-                lambda a: (lax.pmax(a, "data")
-                           if jnp.issubdtype(a.dtype, jnp.integer)
-                           else lax.pmean(a, "data")),
-                opt_row)
-            return (params[None], st_row[None],
-                    jax.tree.map(lambda a: a[None], opt_row), loss, correct)
-
-        pipe = _shard_map(
-            inner,
-            mesh=mesh,
-            in_specs=(P("stage", None), P("stage", None), P("stage", None),
-                      P(None, "data"), P(None, "data"), P()),
-            out_specs=(P("stage", None), P("stage", None), P("stage", None),
-                       P(), P()),
-        )
-
-        def train_step(ts: PDTrainState, xs, ys, lr):
-            params, st, opt, loss, correct = pipe(
-                ts.params, ts.model_state, ts.opt, xs, ys, lr
-            )
-            valid = jnp.sum((ys >= 0).astype(jnp.float32))
-            metrics = {
-                "loss": loss,
-                "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
-            }
-            return PDTrainState(params, st, opt), metrics
-
-        return jax.jit(
-            train_step,
-            donate_argnums=(0,),
-            in_shardings=(self._ts_sharding(), self._batch_sharding,
-                          self._batch_sharding, None),
-        )
-
-    # -- interleaved (V > 1) ----------------------------------------------
-
-    def _make_train_step_interleaved(self):
         """Async 1F1B over C = S*V chunks, V per device (class docstring).
 
         Per half-tick every device runs its V chunk-events sequentially
@@ -728,10 +394,17 @@ class PipeDreamStrategy(GPipeStrategy):
                       for v in range(V)]
 
         def inner(params_rows, state_rows, opt_rows, xs, ys, lr):
-            # local: params_rows [V, 1, L]
-            params = _vary(params_rows[:, 0])  # [V, L]
-            st = _vary(state_rows[:, 0])
-            opt = jax.tree.map(lambda a: _vary(a[:, 0]), opt_rows)
+            # local views -> [V, X] chunk rows: V=1 state is [1, L]
+            # (P('stage', None), already the [V, L] layout); V>1 is
+            # [V, 1, L] (P(None, 'stage', None))
+            if V == 1:
+                params = _vary(params_rows)
+                st = _vary(state_rows)
+                opt = jax.tree.map(_vary, opt_rows)
+            else:
+                params = _vary(params_rows[:, 0])
+                st = _vary(state_rows[:, 0])
+                opt = jax.tree.map(lambda a: _vary(a[:, 0]), opt_rows)
             xs = _vary(xs)
             ys = _vary(ys)
             s_idx = lax.axis_index("stage")
@@ -823,10 +496,12 @@ class PipeDreamStrategy(GPipeStrategy):
                            if jnp.issubdtype(a.dtype, jnp.integer)
                            else lax.pmean(a, "data")),
                 opt)
+            if V == 1:
+                return params, st, opt, loss, correct
             return (params[:, None], st[:, None],
                     jax.tree.map(lambda a: a[:, None], opt), loss, correct)
 
-        spec = self._chunk_sharding_spec()  # P(None, 'stage', None)
+        spec = self._chunk_sharding_spec()  # stage rows (V=1) / chunk rows
         pipe = _shard_map(
             inner,
             mesh=mesh,
