@@ -43,6 +43,26 @@ def comm_stats(strategy) -> Dict[str, float]:
         params, _, _ = _model_params(strategy)
         r = strategy.world_size
         out["allreduce_bytes"] = _ring_allreduce_bytes(float(pb(params)), r)
+    elif name in ("HeteroGPipeStrategy", "HeteroPipeDreamStrategy"):
+        # Uneven hybrid PPxDP (parallel/hetero.py): every microbatch's full
+        # activation crosses each interior boundary once forward + once
+        # backward (split across the consumer replicas' row shards), and
+        # each stage's replica group ring-reduces its packed f32 gradient —
+        # once per step (sync) or per microbatch backward (async 1F1B).
+        itemsize = strategy.compute_dtype.itemsize
+        M, mb = strategy.num_microbatches, strategy.mb
+        bounds, shapes = strategy.bounds, strategy.shapes
+        S = strategy.num_stages
+        boundary = 0.0
+        for s in range(1, S):
+            act = mb * math.prod(shapes[bounds[s]]) * itemsize
+            boundary += 2.0 * M * act
+        out["boundary_bytes"] = boundary
+        per_sync = sum(
+            _ring_allreduce_bytes(4.0 * strategy._p_lens[s], r)
+            for s, r in enumerate(strategy.repl))
+        syncs = M if name == "HeteroPipeDreamStrategy" else 1
+        out["allreduce_bytes"] = per_sync * syncs
     else:  # pipeline strategies (gpipe / pipedream)
         itemsize = strategy.compute_dtype.itemsize
         M, mb, dp = strategy.num_microbatches, strategy.mb, strategy.dp

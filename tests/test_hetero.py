@@ -245,3 +245,22 @@ def test_uniform_tuple_routes_to_regular_gpipe(devices):
     strat = make_strategy(cfg)
     assert isinstance(strat, GPipeStrategy)
     assert strat.num_stages == 2 and strat.dp == 2
+
+
+def test_hetero_comm_stats(devices):
+    """RuntimeStats-parity accounting covers the hetero engines (no silent
+    skip in the run loop's comm-volume line)."""
+    from ddlbench_tpu.parallel.hetero import HeteroPipeDreamStrategy
+    from ddlbench_tpu.train.comm_stats import comm_stats
+
+    model = tiny_model()
+    cfg = RunConfig(strategy="pipedream", num_devices=4,
+                    stage_replication=(1, 3), micro_batch_size=6,
+                    num_microbatches=2, compute_dtype="float32")
+    s = HeteroPipeDreamStrategy(model, cfg, stage_bounds=[0, 2, 5])
+    s.init(jax.random.key(0))
+    cs = comm_stats(s)
+    # interior boundary act: mb x 32 features x f32, twice per microbatch
+    assert cs["boundary_bytes"] == 2 * 2 * 6 * 32 * 4
+    assert cs["allreduce_bytes"] > 0  # stage-1 ring among its 3 replicas
+    assert cs["total_bytes"] == cs["boundary_bytes"] + cs["allreduce_bytes"]
