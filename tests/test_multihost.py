@@ -11,8 +11,10 @@ global batch/param placement via put_global_batch/put_global_tree
 (make_array_from_callback under the hood), cross-process collectives over
 gloo, replicated metrics. Covered paths: dp (dp.py), fsdp (sharded.py),
 gpipe hybrid PPxDP (stage-axis ppermute crossing the process boundary),
-ep (axis_sharded.py + expert-sharded param trees + cross-process all_to_all),
-and sp (the ring-attention K/V rotation crossing the process boundary).
+hetero uneven PPxDP (the flat 'pipe' axis conveyor + replica rings crossing
+it), ep (axis_sharded.py + expert-sharded param trees + cross-process
+all_to_all), and sp (the ring-attention K/V rotation crossing the process
+boundary).
 """
 
 import pytest
@@ -26,7 +28,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-STRATEGIES = ("dp", "fsdp", "gpipe", "ep", "sp")
+STRATEGIES = ("dp", "fsdp", "gpipe", "hetero", "ep", "sp")
 
 WORKER = r"""
 import os, sys
@@ -45,10 +47,19 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.train.loop import run_benchmark
 
 for strategy in sys.argv[1].split(","):
-    if strategy in ("dp", "fsdp", "gpipe"):
-        pipe = (dict(num_stages=4, dp_replicas=2, micro_batch_size=2,
-                     num_microbatches=4)
-                if strategy == "gpipe" else dict(batch_size=2))
+    label = strategy
+    if strategy in ("dp", "fsdp", "gpipe", "hetero"):
+        if strategy == "gpipe":
+            pipe = dict(num_stages=4, dp_replicas=2, micro_batch_size=2,
+                        num_microbatches=4)
+        elif strategy == "hetero":
+            # uneven plan whose flat 'pipe' axis (conveyor + replica rings)
+            # crosses the process boundary
+            strategy = "gpipe"
+            pipe = dict(stage_replication=(2, 2, 4), micro_batch_size=4,
+                        num_microbatches=2)
+        else:
+            pipe = dict(batch_size=2)
         cfg = RunConfig(benchmark="mnist", strategy=strategy, arch="resnet18",
                         num_devices=8, compute_dtype="float32",
                         epochs=1, steps_per_epoch=2, log_interval=1, **pipe)
@@ -89,7 +100,7 @@ for strategy in sys.argv[1].split(","):
         y = jax.random.randint(jax.random.key(2), (8, 32), 0, 64)
         ts, m = ep.train_step(ts, *ep.shard_batch(x, y), jnp.float32(0.1))
         metric = float(m["loss"])
-    print(f"MPRESULT {strategy} {jax.process_index()} metric={metric:.6f}",
+    print(f"MPRESULT {label} {jax.process_index()} metric={metric:.6f}",
           flush=True)
 """
 
