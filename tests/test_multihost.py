@@ -130,7 +130,9 @@ def test_two_process_training_all_strategies():
             stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
         ))
     try:
-        outs = [p.communicate(timeout=540)[0] for p in procs]
+        # generous: six strategy compiles x two processes on one CPU core,
+        # often contended by a concurrently compiling suite
+        outs = [p.communicate(timeout=720)[0] for p in procs]
     except subprocess.TimeoutExpired:
         for p in procs:  # no orphaned workers holding the coordinator port
             p.kill()
