@@ -52,7 +52,7 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         )
         from ddlbench_tpu.profiler.profile import profile_model
 
-        mb, _ = cfg.resolved_batches()
+        mb, chunks = cfg.resolved_batches()
         graph = profile_model(model, mb, mode=cfg.profile_mode,
                               hw=cfg.hardware, input_time_ms=input_time_ms)
         # DP view: the Input node folds into layer 0's stage — the reference
@@ -62,23 +62,40 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
         graph = fold_input_node(graph)
 
-        plan = partition_hierarchical(
-            graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
-        )
-        repl = tuple(s.replication for s in plan.stages)
         if cfg.virtual_stages > 1:
-            # interleaved gpipe partitions into S*V chunks, not S stages; the
-            # replication plan stays advisory here
-            num_parts = cfg.resolved_stages() * cfg.virtual_stages
-            stage_bounds = stage_bounds_from_graph(graph, num_parts)
+            # interleaved runtimes live on the 2-D grid, whose plans are
+            # uniform by construction — search ONLY that executable family
+            # (partition_interleaved) and execute the winner, rather than
+            # emitting a hetero plan the V>1 runtime would have to drop
+            from ddlbench_tpu.partition.optimizer import partition_interleaved
+
+            iplan = partition_interleaved(
+                graph, cfg.num_devices, cfg.virtual_stages, cfg.hardware,
+                num_hosts=cfg.num_hosts, num_microbatches=chunks,
+                micro_batch=mb)
+            stage_bounds = list(iplan.bounds)
+            # replicas split each microbatch's rows — the caller's global
+            # batch M*mb is unchanged (same convention as the uniform-plan
+            # rewrite below)
+            cfg = cfg.replace(
+                num_stages=iplan.num_stages, dp_replicas=iplan.replication,
+                stage_replication=None,
+                micro_batch_size=mb // iplan.replication,
+                num_microbatches=chunks)
             print(
-                f"auto-partition (interleaved, advisory): "
-                f"bounds={stage_bounds}; plan "
-                f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
-                f"bottleneck {plan.pipeline_time_ms:.3f} ms",
+                f"auto-partition (interleaved): executing "
+                f"S={iplan.num_stages} x V={iplan.virtual_stages} "
+                f"(replication={iplan.replication}, bounds={stage_bounds}, "
+                f"bottleneck {iplan.pipeline_time_ms:.3f} ms)",
                 flush=True,
             )
+            plan = None
         else:
+            plan = partition_hierarchical(
+                graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
+            )
+            repl = tuple(s.replication for s in plan.stages)
+        if plan is not None:
             cfg_planned = cfg.replace(
                 num_stages=None, dp_replicas=1, stage_replication=repl)
             try:

@@ -29,9 +29,12 @@ replica counts, so the whole pipeline lives on ONE flat mesh axis:
 * Per-stage gradient sync / BN-state sync = subgroup ring allreduce over each
   stage's contiguous replica range (carry/total scheme, add-rounds gated by
   the group size so small groups stop before recycling).
-
-The fused-head loss (ops/fused_xent.py) is not wired here: hetero plans come
-from CNN profiles; token models run it via the uniform strategies.
+* Token models compose: the last-stage branch runs the fused projection+loss
+  (ops/fused_xent.py via parallel/common.fused_slice_* — no [rows, V] logits
+  materialized) when cfg.fused_head_loss and the model's head supports it,
+  exactly like the uniform pipelines. MoE aux losses are averaged over a
+  stage's replica group (each replica sees 1/r of the rows), so the 'pipe'
+  psum recovers the per-stage mean instead of r-times it.
 """
 
 from __future__ import annotations
@@ -50,7 +53,8 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
 from ddlbench_tpu.parallel.common import (
     cast_input, cast_params, correct_and_count, correct_topk,
-    cross_entropy_loss, make_optimizer, vary as _vary_axes)
+    cross_entropy_loss, fused_slice_eval_sums, fused_slice_loss_sums,
+    head_fusable, make_optimizer, vary as _vary_axes)
 from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.packing import (
     balanced_stage_bounds, layer_flop_costs, pack_stages, pad_vec)
@@ -133,6 +137,7 @@ class HeteroGPipeStrategy:
         from ddlbench_tpu.distributed import make_mesh
 
         self.mesh = make_mesh([("pipe", self.N)], devices=devices)
+        self._fused = bool(cfg.fused_head_loss) and head_fusable(model)
         (self._stage_of, self._rep_of, self._offsets, self._accept,
          self._R) = _plan_tables(repl)
         self._stage_bounds_override = stage_bounds
@@ -205,6 +210,7 @@ class HeteroGPipeStrategy:
         rows = mb // r
         in_elem = math.prod(in_shape)
         last = s == S - 1
+        fused = last and self._fused
         if not last:
             out_shape = self.shapes[self.bounds[s + 1]]
             out_elem = math.prod(out_shape)
@@ -221,40 +227,63 @@ class HeteroGPipeStrategy:
                 x = flat.reshape(rows, *in_shape)
             params = cast_params(p_unravel(param_row[:p_len]), cdtype)
             states = s_unravel(state_row[:s_len])
-            aux: list = []
-            with collect_aux_losses(aux):
-                y, new_states = apply_slice(layers, params, states,
-                                            cast_input(x, cdtype), train)
-            aux_sum = sum(aux, jnp.float32(0.0))
             zero_f = jnp.zeros((), jnp.float32)
             zero_i = jnp.zeros((), jnp.int32)
+            aux: list = []
             if last:
                 labels_full = lax.dynamic_index_in_dim(ys, m, keepdims=False)
                 labels = lax.dynamic_slice_in_dim(labels_full, rep * rows,
                                                   rows, axis=0)
-                logits = y.astype(jnp.float32)
-                logp = jax.nn.log_softmax(logits, axis=-1)
-                mask = (labels >= 0)
-                safe = jnp.maximum(labels, 0)
-                nll = -jnp.take_along_axis(logp, safe[..., None],
-                                           axis=-1)[..., 0]
-                obj_tok = ((1.0 - smooth) * nll
-                           - smooth * jnp.mean(logp, axis=-1)
-                           if smooth else nll)
-                fmask = mask.astype(jnp.float32)
-                ce_sum = jnp.sum(nll * fmask)
-                obj_sum = jnp.sum(obj_tok * fmask)
-                correct = correct_and_count(logits, labels)[0]
-                correct5 = (zero_i if train else correct_topk(logits, labels))
-                valid = jnp.sum(mask.astype(jnp.int32))
                 contrib = jnp.zeros((A,), cdtype)
+                if fused:
+                    xc = cast_input(x, cdtype)
+                    if train:
+                        with collect_aux_losses(aux):
+                            (obj_sum, ce_sum, correct,
+                             new_states) = fused_slice_loss_sums(
+                                layers, params, states, xc, labels, smooth)
+                        correct5 = zero_i
+                        valid = jnp.sum((labels >= 0).astype(jnp.int32))
+                    else:
+                        ce_sum, correct, correct5, valid = (
+                            fused_slice_eval_sums(layers, params, states, xc,
+                                                  labels))
+                        obj_sum = ce_sum
+                        new_states = states
+                else:
+                    with collect_aux_losses(aux):
+                        y, new_states = apply_slice(
+                            layers, params, states, cast_input(x, cdtype),
+                            train)
+                    logits = y.astype(jnp.float32)
+                    logp = jax.nn.log_softmax(logits, axis=-1)
+                    mask = (labels >= 0)
+                    safe = jnp.maximum(labels, 0)
+                    nll = -jnp.take_along_axis(logp, safe[..., None],
+                                               axis=-1)[..., 0]
+                    obj_tok = ((1.0 - smooth) * nll
+                               - smooth * jnp.mean(logp, axis=-1)
+                               if smooth else nll)
+                    fmask = mask.astype(jnp.float32)
+                    ce_sum = jnp.sum(nll * fmask)
+                    obj_sum = jnp.sum(obj_tok * fmask)
+                    correct = correct_and_count(logits, labels)[0]
+                    correct5 = (zero_i if train
+                                else correct_topk(logits, labels))
+                    valid = jnp.sum(mask.astype(jnp.int32))
             else:
+                with collect_aux_losses(aux):
+                    y, new_states = apply_slice(layers, params, states,
+                                                cast_input(x, cdtype), train)
                 obj_sum = ce_sum = zero_f
                 correct = correct5 = valid = zero_i
                 contrib = jnp.zeros((A,), cdtype)
                 yflat = y.astype(cdtype).reshape(-1)
                 contrib = lax.dynamic_update_slice(
                     contrib, yflat, (rep * rows * out_elem,))
+            # replica k saw 1/r of the rows: average mean-style MoE aux over
+            # the replica group so the 'pipe' psum yields the stage mean
+            aux_sum = sum(aux, jnp.float32(0.0)) / r
             new_state_row = pad_vec(
                 ravel_pytree(new_states)[0].astype(jnp.float32),
                 state_row.shape[0])
@@ -537,6 +566,46 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
 
         return stage_fwd
 
+    def _make_head_fns(self, s: int):
+        """Fused projection+loss twins of _make_stage_fwd for the last stage
+        (parallel/common.fused_slice_loss_sums calling convention — no
+        [rows, V] logits materialize)."""
+        from ddlbench_tpu.models.moe import collect_aux_losses
+
+        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
+        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
+        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
+        cdtype = self.compute_dtype
+        smooth = self.cfg.resolved_label_smoothing()
+
+        def unpack(param_row, state_row):
+            return (cast_params(p_unravel(param_row[:p_len]), cdtype),
+                    s_unravel(state_row[:s_len]))
+
+        def fused_metrics(param_row, state_row, x, labels):
+            """Forward-side metrics: (ce_sum, correct, valid, new_state_row)."""
+            params, states = unpack(param_row, state_row)
+            _, ce_sum, correct, new_states = fused_slice_loss_sums(
+                layers, params, states, cast_input(x, cdtype), labels, smooth)
+            new_state_row = pad_vec(
+                ravel_pytree(new_states)[0].astype(jnp.float32),
+                state_row.shape[0])
+            valid = jnp.sum((labels >= 0).astype(jnp.int32))
+            return ce_sum, correct, valid, new_state_row
+
+        def fused_obj(param_row, state_row, x, labels):
+            """Backward-side objective: (obj_sum, aux_sum) — differentiable
+            in param_row and x."""
+            params, states = unpack(param_row, state_row)
+            aux: list = []
+            with collect_aux_losses(aux):
+                obj_sum, _, _, _ = fused_slice_loss_sums(
+                    layers, params, states, cast_input(x, cdtype), labels,
+                    smooth)
+            return obj_sum, sum(aux, jnp.float32(0.0))
+
+        return fused_metrics, fused_obj
+
     def _make_train_step(self):
         from ddlbench_tpu.parallel.pipedream import bwd_mb_at, fwd_mb_at
 
@@ -564,6 +633,13 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
         gsize_tbl = jnp.asarray(
             np.array([repl[self._stage_of[d]] for d in range(N)], np.int32))
         stage_fwds = [self._make_stage_fwd(s) for s in range(S)]
+        head_fns = self._make_head_fns(S - 1) if self._fused else None
+        if head_fns is not None and self.cfg.remat_stages:
+            # the backward-side objective is the one jax.grad traces: remat
+            # it like stage_fwd so the last stage's layers[:-1] activations
+            # are recomputed, not stored (the metrics twin is never
+            # differentiated)
+            head_fns = (head_fns[0], jax.checkpoint(head_fns[1]))
         in_shapes = [self.shapes[bounds[s]] for s in range(S)]
         in_elems = [math.prod(sh) for sh in in_shapes]
         rows_of = [mb // r for r in repl]
@@ -576,6 +652,10 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
             in_elem = in_elems[s]
             in_shape = in_shapes[s]
             last = s == S - 1
+            fused = last and self._fused
+            # replica s sees 1/r of the rows: scale mean-style MoE aux so the
+            # replica-group gradient sum recovers the stage mean
+            aux_w_s = aux_w / repl[s]
             if not last:
                 out_elem = in_elems[s + 1]
 
@@ -604,8 +684,16 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
                             lax.dynamic_index_in_dim(fwd_q, f % 2,
                                                      keepdims=False),
                             rep, in_elem, rows, in_shape)
-                    y, new_st, _aux = stage_fwd(params, st_row, x)
-                    if last:
+                    if fused:
+                        labels_full = lax.dynamic_index_in_dim(
+                            ys, f, keepdims=False)
+                        labels = lax.dynamic_slice_in_dim(
+                            labels_full, rep * rows, rows, axis=0)
+                        ce_sum, corr, val, new_st = head_fns[0](
+                            params, st_row, x, labels)
+                        y_out = jnp.zeros((A,), cdtype)
+                    elif last:
+                        y, new_st, _aux = stage_fwd(params, st_row, x)
                         labels_full = lax.dynamic_index_in_dim(
                             ys, f, keepdims=False)
                         labels = lax.dynamic_slice_in_dim(
@@ -621,6 +709,7 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
                         val = jnp.sum(mask.astype(jnp.int32))
                         y_out = jnp.zeros((A,), cdtype)
                     else:
+                        y, new_st, _aux = stage_fwd(params, st_row, x)
                         ce_sum = jnp.zeros((), jnp.float32)
                         corr = jnp.zeros((), jnp.int32)
                         val = jnp.zeros((), jnp.int32)
@@ -685,18 +774,25 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
                         denom = jnp.maximum(1.0, jnp.sum(
                             (labels_full >= 0).astype(jnp.float32)))
 
-                        def loss_of(pv, xv):
-                            y, _, aux = stage_fwd(pv, st_row, xv)
-                            logits = y.astype(jnp.float32)
-                            logp = jax.nn.log_softmax(logits, axis=-1)
-                            mask = (labels >= 0).astype(jnp.float32)
-                            safe = jnp.maximum(labels, 0)
-                            nll = -jnp.take_along_axis(
-                                logp, safe[..., None], axis=-1)[..., 0]
-                            if smooth:
-                                nll = ((1.0 - smooth) * nll - smooth
-                                       * jnp.mean(logp, axis=-1))
-                            return jnp.sum(nll * mask) / denom + aux_w * aux
+                        if fused:
+                            def loss_of(pv, xv):
+                                obj_sum, aux = head_fns[1](pv, st_row, xv,
+                                                           labels)
+                                return obj_sum / denom + aux_w_s * aux
+                        else:
+                            def loss_of(pv, xv):
+                                y, _, aux = stage_fwd(pv, st_row, xv)
+                                logits = y.astype(jnp.float32)
+                                logp = jax.nn.log_softmax(logits, axis=-1)
+                                mask = (labels >= 0).astype(jnp.float32)
+                                safe = jnp.maximum(labels, 0)
+                                nll = -jnp.take_along_axis(
+                                    logp, safe[..., None], axis=-1)[..., 0]
+                                if smooth:
+                                    nll = ((1.0 - smooth) * nll - smooth
+                                           * jnp.mean(logp, axis=-1))
+                                return (jnp.sum(nll * mask) / denom
+                                        + aux_w_s * aux)
                         if s == 0:
                             gp = jax.grad(lambda pv: loss_of(pv, x_st))(p_st)
                             gx = None
@@ -714,12 +810,12 @@ class HeteroPipeDreamStrategy(HeteroGPipeStrategy):
                             (y, aux), vjp_fn = jax.vjp(
                                 lambda pv: fwd_of(pv, x_st), p_st)
                             (gp,) = vjp_fn((g_rows.astype(y.dtype),
-                                            jnp.float32(aux_w)))
+                                            jnp.float32(aux_w_s)))
                             gx = None
                         else:
                             (y, aux), vjp_fn = jax.vjp(fwd_of, p_st, x_st)
                             gp, gx = vjp_fn((g_rows.astype(y.dtype),
-                                             jnp.float32(aux_w)))
+                                             jnp.float32(aux_w_s)))
                     gx_out = (jnp.zeros((A,), cdtype) if gx is None else
                               lax.dynamic_update_slice(
                                   jnp.zeros((A,), cdtype),

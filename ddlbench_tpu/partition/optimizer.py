@@ -270,6 +270,110 @@ def _partition_native(graph: Graph, num_chips: int, hw: HardwareModel,
     )
 
 
+@dataclasses.dataclass
+class InterleavedPlan:
+    """An interleaved (virtual-stage) plan — always executable by the grid
+    runtime: C = num_stages * virtual_stages balanced chunks, device-stage s
+    owning chunks {s, s+S, ...}, with UNIFORM replication.
+
+    The flat-axis conveyor engine has no interleaved timetable, so for V > 1
+    the search space is restricted to what the 2-D ('data','stage') mesh can
+    run — the reference's bar is that the optimizer's output always executes
+    (run_template.sh:436-498), which this guarantees by construction instead
+    of by downgrade.
+    """
+
+    bounds: List[int]  # C+1 chunk bounds
+    num_stages: int
+    replication: int
+    virtual_stages: int
+    pipeline_time_ms: float
+
+
+def partition_interleaved(
+    graph: Graph,
+    num_chips: int,
+    virtual_stages: int,
+    hw: Optional[HardwareModel] = None,
+    num_hosts: int = 1,
+    memory_check: bool = True,
+    num_microbatches: Optional[int] = None,
+    micro_batch: Optional[int] = None,
+) -> InterleavedPlan:
+    """Best executable interleaved plan: search uniform replication factors
+    r | num_chips (S = num_chips/r device stages, C = S*V chunks), score each
+    with the same cost model as partition_hierarchical (bottleneck of
+    per-stage compute + DP allreduce vs chunk-boundary transfer), return the
+    minimum. Chunk bounds are the balanced min-max split of profiled times.
+    ``num_microbatches`` (when known) filters out stage counts the
+    interleaved timetable cannot schedule (it groups microbatches by S);
+    ``micro_batch`` filters out replication factors that cannot split the
+    microbatch's rows evenly (replication = intra-microbatch row splitting,
+    keeping the caller's global batch unchanged — the same convention as the
+    uniform-plan rewrite in parallel/api.py).
+    """
+    hw = hw or HardwareModel()
+    from ddlbench_tpu.parallel.packing import balanced_stage_bounds
+
+    order = graph.topological_sort()
+    n = len(order)
+    times = [nd.forward_compute_time + nd.backward_compute_time
+             for nd in order]
+    params = [nd.parameter_size for nd in order]
+    acts = [nd.activation_size for nd in order]
+    if num_hosts > 1 and num_chips % num_hosts:
+        raise ValueError("num_chips must divide evenly across hosts")
+    chips_per_host = (num_chips // num_hosts if num_hosts > 1 else num_chips)
+
+    best: Optional[InterleavedPlan] = None
+    for r in range(1, num_chips + 1):
+        if num_chips % r:
+            continue
+        S = num_chips // r
+        C = S * virtual_stages
+        if C > n:
+            continue
+        if num_microbatches is not None and num_microbatches % S:
+            continue
+        if micro_batch is not None and micro_batch % r:
+            continue
+        bounds = balanced_stage_bounds(times, C)
+        # replicas within a host sync over ICI; wider groups pay DCN. When
+        # replication spans whole hosts (r >= chips/host) every pipeline
+        # fits inside one host and boundaries ride ICI; otherwise the
+        # pipeline itself crosses hosts and boundary transfers pay DCN
+        # (partition_hierarchical's edge_cost1, conservatively applied to
+        # every boundary)
+        bw = hw.ici_bandwidth if r <= chips_per_host else hw.dcn_bandwidth
+        edge_bw = (hw.ici_bandwidth
+                   if num_hosts == 1 or r >= chips_per_host
+                   else hw.dcn_bandwidth)
+        stage_ok = True
+        bottleneck = 0.0
+        for s in range(S):
+            t = p = 0.0
+            for c in range(s, C, S):
+                t += sum(times[bounds[c]:bounds[c + 1]])
+                p += sum(params[bounds[c]:bounds[c + 1]])
+            if memory_check and (1 + S) * p > hw.hbm_bytes:
+                stage_ok = False
+                break
+            bottleneck = max(bottleneck, t / r + _allreduce_ms(p, r, bw))
+        if not stage_ok:
+            continue
+        for c in range(C - 1):
+            bottleneck = max(bottleneck, _ms(acts[bounds[c + 1] - 1],
+                                             edge_bw))
+        plan = InterleavedPlan(bounds, S, r, virtual_stages, bottleneck)
+        if best is None or plan.pipeline_time_ms < best.pipeline_time_ms:
+            best = plan
+    if best is None:
+        raise ValueError(
+            f"no executable interleaved plan: {num_chips} chips x "
+            f"{virtual_stages} virtual stages needs some S*V <= {n} layers")
+    return best
+
+
 def stage_bounds_from_graph(graph: Graph, num_stages: int) -> List[int]:
     """Uniform-mesh helper: contiguous min-max split of measured per-node
     times into num_stages (the profiled replacement for torchgpipe's

@@ -39,7 +39,8 @@ run_one() {  # name cmd...
 }
 
 all_done() {
-  for n in bench lmbench_synthtext lmbench_longctx lmbench_synthmt decodebench; do
+  for n in bench lmbench_synthtext lmbench_longctx lmbench_synthmt \
+           decodebench scalebench_tpu heterobench_tpu; do
     [ -e "$OUT/$n.ok" ] || return 1
   done
   return 0
@@ -56,6 +57,15 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     run_one lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
     run_one lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s
     run_one decodebench        python -m ddlbench_tpu.tools.decodebench
+    # scaling-curve anchor: the on-chip points scalebench can measure on the
+    # attached slice (1 chip -> the per-chip single/dp anchors; a larger
+    # slice sweeps further automatically)
+    run_one scalebench_tpu     python -m ddlbench_tpu.tools.scalebench \
+                                 -b imagenet -m resnet50 --devices 1 \
+                                 --strategies dp --steps 20
+    # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
+    run_one heterobench_tpu    python -m ddlbench_tpu.tools.heterobench \
+                                 -b mnist -m resnet18 --plan 2,2 --uneven 1,3
   else
     echo "[tpu_grab $(date +%H:%M:%S)] tunnel down; sleeping" >&2
     sleep 540
