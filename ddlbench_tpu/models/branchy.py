@@ -1,0 +1,272 @@
+"""DAG-of-layers models: native multi-branch profiling and execution.
+
+The reference's tracer produces real DAGs from arbitrary models —
+TensorWrapper threads dataflow through overloaded ops
+(pipedream-fork/profiler/torchmodules/torchgraph/graph_creator.py:55-195) —
+which is how branchy profiles like resnext50_generated.txt exist, and its
+inception family (profiler/image_classification/models/inception.py:1) is
+the canonical branchy workload. Here the dataflow is DECLARED, not traced:
+a ``DagModel`` lists each layer's predecessor indices and join rule, the
+profiler (profiler/profile.profile_dag) emits the real branchy Graph from
+it, and the graph machinery (is_series_parallel, compress_branches,
+antichain partitioning) runs on native profiles instead of only imported
+fixtures.
+
+Execution stays engine-compatible: ``to_chain`` cuts the DAG at its
+articulation positions (cuts crossed by exactly ONE tensor) and wraps each
+span into a composite Layer — the pipeline engines see a flat chain whose
+boundaries are single activations, so every strategy (single/dp/gpipe/
+pipedream/hetero) runs branchy models unchanged, and partition bounds over
+the coarse block chain map 1:1 onto the chain model's layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from ddlbench_tpu.models.layers import (
+    Layer, LayerModel, Shape, conv_bn, dense, flatten, global_avg_pool,
+    max_pool)
+
+
+@dataclasses.dataclass(frozen=True)
+class DagModel:
+    """A model as a DAG of layers in topological (list) order.
+
+    ``inputs[i]`` are the predecessor layer indices feeding layer i (-1 is
+    the model input); multi-input nodes combine predecessor outputs with
+    ``combine[i]`` ("concat" over channels, or "add") before apply.
+    """
+
+    name: str
+    layers: List[Layer]
+    inputs: List[Tuple[int, ...]]
+    combine: List[str]
+    in_shape: Shape
+    num_classes: int
+    input_kind: str = "float"
+
+    def __post_init__(self):
+        for i, preds in enumerate(self.inputs):
+            assert all(p < i for p in preds), (
+                f"node {i} has a non-topological input {preds}")
+            assert len(preds) == 1 or self.combine[i] in ("concat", "add")
+
+
+def _combined_shape(shapes: Sequence[Shape], how: str) -> Shape:
+    if len(shapes) == 1:
+        return shapes[0]
+    if how == "concat":
+        base = shapes[0][:-1]
+        assert all(s[:-1] == base for s in shapes), shapes
+        return (*base, sum(s[-1] for s in shapes))
+    assert all(s == shapes[0] for s in shapes), shapes
+    return shapes[0]
+
+
+def _combine(vals, how: str):
+    if len(vals) == 1:
+        return vals[0]
+    if how == "concat":
+        return jnp.concatenate(vals, axis=-1)
+    total = vals[0]
+    for v in vals[1:]:
+        total = total + v
+    return total
+
+
+def init_dag(model: DagModel, key: jax.Array):
+    """Initialize every node. Returns (params_list, state_list, out_shapes)
+    where out_shapes[i] is node i's per-example output shape."""
+    params_list, state_list, out_shapes = [], [], []
+    for i, layer in enumerate(model.layers):
+        in_sh = _combined_shape(
+            [model.in_shape if p < 0 else out_shapes[p]
+             for p in model.inputs[i]], model.combine[i])
+        key, sub = jax.random.split(key)
+        p, s, out_sh = layer.init(sub, in_sh)
+        params_list.append(p)
+        state_list.append(s)
+        out_shapes.append(out_sh)
+    return params_list, state_list, out_shapes
+
+
+def apply_dag(model: DagModel, params, states, x, train: bool):
+    """Topological fold; returns (last node's output, new_states)."""
+    outs, new_states = [], []
+    for i, layer in enumerate(model.layers):
+        xin = _combine([x if p < 0 else outs[p] for p in model.inputs[i]],
+                       model.combine[i])
+        y, ns = layer.apply(params[i], states[i], xin, train)
+        outs.append(y)
+        new_states.append(ns)
+    return outs[-1], new_states
+
+
+def cut_positions(model: DagModel) -> List[int]:
+    """Positions p (0 < p < n) where the DAG can be cut into [0,p) | [p,n)
+    with exactly ONE tensor crossing — i.e. all edges from {<p} (or the
+    model input) into {>=p} share a single source. These are the boundaries
+    every chain pipeline engine can host."""
+    n = len(model.layers)
+    cuts = []
+    for p in range(1, n):
+        sources = set()
+        for d in range(p, n):
+            for s in model.inputs[d]:
+                if s < p:
+                    sources.add(s)
+        if len(sources) == 1:
+            cuts.append(p)
+    return cuts
+
+
+def block_spans(model: DagModel) -> List[Tuple[int, int]]:
+    """Contiguous node spans between consecutive articulation cuts — the
+    atomic pipeline blocks of the DAG."""
+    bounds = [0] + cut_positions(model) + [len(model.layers)]
+    return list(zip(bounds[:-1], bounds[1:]))
+
+
+def _composite_layer(model: DagModel, start: int, end: int) -> Layer:
+    """Wrap DAG span [start, end) into one chain Layer. Valid only when the
+    span's external inputs all come from one source (guaranteed when start
+    is an articulation cut): that source's tensor IS the layer input."""
+    span = list(range(start, end))
+    name = f"{model.layers[start].name}..{model.layers[end - 1].name}" \
+        if end - start > 1 else model.layers[start].name
+
+    def init(key, in_shape):
+        params, states, shapes = [], [], {}
+
+        def shape_of(p):
+            return in_shape if p < start else shapes[p]
+
+        for i in span:
+            in_sh = _combined_shape([shape_of(p) for p in model.inputs[i]],
+                                    model.combine[i])
+            key, sub = jax.random.split(key)
+            pp, ss, out_sh = model.layers[i].init(sub, in_sh)
+            params.append(pp)
+            states.append(ss)
+            shapes[i] = out_sh
+        return params, states, shapes[end - 1]
+
+    def apply(params, states, x, train):
+        outs, new_states = {}, []
+        for k, i in enumerate(span):
+            xin = _combine([x if p < start else outs[p]
+                            for p in model.inputs[i]], model.combine[i])
+            y, ns = model.layers[i].apply(params[k], states[k], xin, train)
+            outs[i] = y
+            new_states.append(ns)
+        return outs[end - 1], new_states
+
+    return Layer(name, init, apply)
+
+
+def to_chain(model: DagModel) -> LayerModel:
+    """DAG -> flat LayerModel of composite block layers (one per span
+    between articulation cuts) — runnable by every strategy unchanged.
+    Chain layer k corresponds exactly to block k of the profiled coarse
+    chain (partition bounds transfer 1:1)."""
+    layers = [_composite_layer(model, a, b) for a, b in block_spans(model)]
+    return LayerModel(model.name, layers, model.in_shape, model.num_classes,
+                      input_kind=model.input_kind)
+
+
+# ---- inception family ------------------------------------------------------
+
+
+def _identity(name: str) -> Layer:
+    def init(key, in_shape):
+        return {}, {}, in_shape
+
+    def apply(params, state, x, train):
+        return x, state
+
+    return Layer(name, init, apply)
+
+
+def _append(layers, inputs, combine, layer, preds, how="") -> int:
+    """Add one DAG node; returns its index."""
+    layers.append(layer)
+    inputs.append(tuple(preds))
+    combine.append(how)
+    return len(layers) - 1
+
+
+def _add_inception_block(layers, inputs, combine, pred: int, name: str,
+                         ch1: int, ch3r: int, ch3: int, ch5r: int, ch5: int,
+                         pool_proj: int) -> int:
+    """Append one GoogLeNet inception module (4 parallel branches joined by
+    channel concat — reference inception.py's InceptionModule) reading from
+    node ``pred``. Returns the join node's index."""
+
+    def add(layer, preds, how=""):
+        return _append(layers, inputs, combine, layer, preds, how)
+
+    b1 = add(conv_bn(f"{name}_1x1", ch1, kernel=1), [pred])
+    b3a = add(conv_bn(f"{name}_3x3r", ch3r, kernel=1), [pred])
+    b3 = add(conv_bn(f"{name}_3x3", ch3, kernel=3), [b3a])
+    b5a = add(conv_bn(f"{name}_5x5r", ch5r, kernel=1), [pred])
+    b5 = add(conv_bn(f"{name}_5x5", ch5, kernel=5), [b5a])
+    bp = add(max_pool(f"{name}_pool", window=3, stride=1, padding="SAME"),
+             [pred])
+    bpp = add(conv_bn(f"{name}_poolproj", pool_proj, kernel=1), [bp])
+    return add(_identity(f"{name}_concat"), [b1, b3, b5, bpp], "concat")
+
+
+_INCEPTION_BLOCKS = {
+    # (ch1, ch3r, ch3, ch5r, ch5, pool_proj) — GoogLeNet table 1 widths
+    "inception": [(64, 96, 128, 16, 32, 32), (128, 128, 192, 32, 96, 64),
+                  (192, 96, 208, 16, 48, 64), (160, 112, 224, 24, 64, 64)],
+    # tiny test variant
+    "inception_t": [(8, 8, 8, 4, 4, 4), (8, 8, 8, 4, 4, 4)],
+}
+
+
+def build_inception(arch: str, in_shape, num_classes: int) -> DagModel:
+    """Mini GoogLeNet as a declared DAG (stem -> inception modules with a
+    mid maxpool -> gap/fc). Branch widths follow the reference's inception
+    family (profiler/image_classification/models/inception.py:1); depth is
+    reduced to 4 modules (documented mini — the benchmark exercises branchy
+    structure, not ILSVRC accuracy)."""
+    layers: List[Layer] = []
+    inputs: List[Tuple[int, ...]] = []
+    combine: List[str] = []
+
+    def add(layer, preds, how=""):
+        return _append(layers, inputs, combine, layer, preds, how)
+
+    small = in_shape[0] <= 64
+    stem_ch = 16 if arch == "inception_t" else 64
+    cur = add(conv_bn("stem", stem_ch, kernel=3 if small else 7,
+                      stride=1 if small else 2), [-1])
+    if not small:
+        cur = add(max_pool("stem_pool", window=3, stride=2, padding="SAME"),
+                  [cur])
+    blocks = _INCEPTION_BLOCKS[arch]
+    for i, spec in enumerate(blocks):
+        cur = _add_inception_block(layers, inputs, combine, cur,
+                                   f"inc{i}", *spec)
+        if i == len(blocks) // 2 - 1:
+            cur = add(max_pool(f"mid_pool{i}", window=3, stride=2,
+                               padding="SAME"), [cur])
+    cur = add(global_avg_pool(), [cur])
+    cur = add(flatten(), [cur])
+    add(dense("fc", num_classes), [cur])
+    return DagModel(arch, layers, inputs, combine, tuple(in_shape),
+                    num_classes)
+
+
+def get_dag(arch: str, in_shape, num_classes: int):
+    """The DAG form of a branchy zoo arch (None for chain archs) — used by
+    the auto-partition path to profile the real dataflow graph."""
+    if arch in _INCEPTION_BLOCKS:
+        return build_inception(arch, in_shape, num_classes)
+    return None

@@ -15,7 +15,7 @@ from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
                "mobilenetv2", "lenet", "alexnet", "squeezenet", "resnext50",
-               "densenet121", "transformer_s", "transformer_m",
+               "densenet121", "inception", "transformer_s", "transformer_m",
                "transformer_moe_s", "seq2seq_s", "seq2seq_m")
 
 
@@ -44,6 +44,13 @@ def get_model(arch: str, dataset: str | DatasetSpec,
         return build_transformer(arch, spec.image_size, spec.num_classes)
     if spec.kind != "image":
         raise ValueError(f"{arch} requires an image dataset, got {spec.name}")
+    if arch.startswith("inception"):
+        # branchy DAG arch: strategies run the articulation-block chain form;
+        # the auto-partition path profiles the real DAG (models/branchy.py)
+        from ddlbench_tpu.models.branchy import build_inception, to_chain
+
+        return to_chain(build_inception(arch, spec.image_size,
+                                        spec.num_classes))
     if arch.startswith("resnet"):
         return build_resnet(arch, spec.image_size, spec.num_classes)
     if arch.startswith("vgg"):

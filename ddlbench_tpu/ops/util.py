@@ -44,7 +44,10 @@ def pick_block(t: int, preferred: int, unit: int = 1):
     tile-aligned (8 sublanes / 128 lanes), so compiled kernels pass the
     hardware unit and fall back (or error clearly) on a None instead of
     handing Mosaic an arbitrary divisor (ADVICE r1)."""
-    if t % unit:
+    if t % unit or preferred < unit:
+        # no divisor <= preferred can be a multiple of unit (ADVICE r2:
+        # returning unit here would silently exceed the caller's block/VMEM
+        # budget)
         return None
     b = max(unit, min(preferred - preferred % unit, t))
     while t % b:

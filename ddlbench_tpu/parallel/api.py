@@ -53,14 +53,37 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         from ddlbench_tpu.profiler.profile import profile_model
 
         mb, chunks = cfg.resolved_batches()
-        graph = profile_model(model, mb, mode=cfg.profile_mode,
-                              hw=cfg.hardware, input_time_ms=input_time_ms)
-        # DP view: the Input node folds into layer 0's stage — the reference
-        # co-locates its DataLoader with stage 0's ranks, and a chip cannot
-        # run "just data loading", so Input must never form its own stage.
-        from ddlbench_tpu.profiler.profile import fold_input_node
+        from ddlbench_tpu.models.branchy import get_dag
 
-        graph = fold_input_node(graph)
+        spec = cfg.dataset()
+        dag = get_dag(cfg.arch, spec.image_size, spec.num_classes)
+        if dag is not None:
+            # branchy arch: profile the REAL dataflow DAG (the reference
+            # traces these with TensorWrapper, graph_creator.py:55-195),
+            # then aggregate to the articulation-block chain the engines
+            # execute — partition bounds land 1:1 on the chain model's
+            # layers (models/branchy.py)
+            from ddlbench_tpu.profiler.profile import coarse_chain, profile_dag
+
+            dag_graph = profile_dag(dag, mb, mode=cfg.profile_mode,
+                                    hw=cfg.hardware)
+            graph = coarse_chain(dag_graph, dag)
+            if input_time_ms > 0.0:
+                # fold_input_node semantics: data loading prices into the
+                # stage hosting block 0
+                graph.topological_sort()[0].forward_compute_time += (
+                    input_time_ms)
+        else:
+            graph = profile_model(model, mb, mode=cfg.profile_mode,
+                                  hw=cfg.hardware,
+                                  input_time_ms=input_time_ms)
+            # DP view: the Input node folds into layer 0's stage — the
+            # reference co-locates its DataLoader with stage 0's ranks, and
+            # a chip cannot run "just data loading", so Input must never
+            # form its own stage.
+            from ddlbench_tpu.profiler.profile import fold_input_node
+
+            graph = fold_input_node(graph)
 
         if cfg.virtual_stages > 1:
             # interleaved runtimes live on the 2-D grid, whose plans are

@@ -92,6 +92,37 @@ def _flops_of(fn, *args) -> float:
         return 0.0
 
 
+def _profile_node(layer, state, params, xs, how, mode, hw, repeats):
+    """The shared per-node measurement core of profile_model/profile_dag:
+    (fwd_ms, bwd_ms) for one layer, its inputs pre-combined with ``how``
+    ("" / "concat" / "add") as the node's own cost. One home so the timing
+    protocol, the bwd = 2x fwd FLOPs heuristic, and token handling cannot
+    drift between the chain and DAG profilers."""
+    from ddlbench_tpu.models.branchy import _combine
+
+    def fwd(p, *xin, _layer=layer, _s=state, _how=how):
+        return _layer.apply(p, _s, _combine(list(xin), _how), True)[0]
+
+    def fwd_bwd(p, *xin, _fwd=fwd):
+        def scalar(p, *xin):
+            return jnp.sum(_fwd(p, *xin).astype(jnp.float32))
+
+        # token ids are not differentiable — only dL/dw for that layer
+        args = ((0,) if jnp.issubdtype(xin[0].dtype, jnp.integer)
+                else tuple(range(1 + len(xin))))
+        return jax.grad(scalar, argnums=args)(p, *xin)
+
+    if mode == "time":
+        f_ms = _time_callable(jax.jit(fwd), params, *xs, repeats=repeats)
+        fb_ms = _time_callable(jax.jit(fwd_bwd), params, *xs, repeats=repeats)
+        return f_ms, max(fb_ms - f_ms, 0.0)
+    if mode == "flops":
+        f_flops = _flops_of(fwd, params, *xs)
+        b_flops = 2.0 * f_flops  # dL/dw + dL/dx each cost ~one forward
+        return 1000.0 * f_flops / hw.peak_flops, 1000.0 * b_flops / hw.peak_flops
+    raise ValueError(f"unknown profile mode {mode!r}")
+
+
 def profile_model(
     model: LayerModel,
     batch_size: int,
@@ -129,29 +160,7 @@ def profile_model(
         else:
             x = jax.random.normal(sub, (batch_size, *in_shape), dtype)
 
-        def fwd(p, x, _layer=layer, _s=s):
-            return _layer.apply(p, _s, x, True)[0]
-
-        def fwd_bwd(p, x, _fwd=fwd):
-            def scalar(p, x):
-                return jnp.sum(_fwd(p, x).astype(jnp.float32))
-
-            # token ids are not differentiable — only dL/dw for that layer
-            args = (0,) if jnp.issubdtype(x.dtype, jnp.integer) else (0, 1)
-            return jax.grad(scalar, argnums=args)(p, x)
-
-        if mode == "time":
-            f_ms = _time_callable(jax.jit(fwd), p, x, repeats=repeats)
-            fb_ms = _time_callable(jax.jit(fwd_bwd), p, x, repeats=repeats)
-            b_ms = max(fb_ms - f_ms, 0.0)
-        elif mode == "flops":
-            f_flops = _flops_of(fwd, p, x)
-            b_flops = 2.0 * f_flops  # dL/dw + dL/dx each cost ~one forward
-            f_ms = 1000.0 * f_flops / hw.peak_flops
-            b_ms = 1000.0 * b_flops / hw.peak_flops
-        else:
-            raise ValueError(f"unknown profile mode {mode!r}")
-
+        f_ms, b_ms = _profile_node(layer, s, p, [x], "", mode, hw, repeats)
         act_bytes = float(batch_size) * _prod(out_shape) * itemsize
         nodes.append(
             Node(
@@ -213,6 +222,93 @@ def _prod(shape: Sequence[int]) -> float:
     for d in shape:
         out *= d
     return out
+
+
+def profile_dag(
+    model,
+    batch_size: int,
+    mode: str = "time",
+    dtype=jnp.float32,
+    hw: Optional[HardwareModel] = None,
+    repeats: int = 5,
+    seed: int = 0,
+) -> Graph:
+    """Profile a DagModel (models/branchy.py) node by node; returns the REAL
+    branchy Graph — node ids are layer indices, edges are the declared
+    dataflow. The native analog of the reference's TensorWrapper tracer
+    (graph_creator.py:55-195), which is how its branchy profiles
+    (resnext50_generated.txt, the inception family) come to exist. Each
+    node's cost includes its input-combine (concat/add) op."""
+    from ddlbench_tpu.models.branchy import init_dag
+
+    hw = hw or HardwareModel()
+    params_list, state_list, out_shapes = init_dag(
+        model, jax.random.key(seed))
+    itemsize = jnp.dtype(dtype).itemsize
+    g = Graph()
+    key = jax.random.key(seed + 1)
+    nodes = []
+    for idx, layer in enumerate(model.layers):
+        preds = model.inputs[idx]
+        in_shapes = [model.in_shape if p < 0 else out_shapes[p]
+                     for p in preds]
+        p, s = params_list[idx], state_list[idx]
+        xs = []
+        for sh in in_shapes:
+            key, sub = jax.random.split(key)
+            if idx == 0 and model.input_kind == "tokens":
+                xs.append(jax.random.randint(
+                    sub, (batch_size, *sh), 0, model.num_classes, jnp.int32))
+            else:
+                xs.append(jax.random.normal(sub, (batch_size, *sh), dtype))
+
+        f_ms, b_ms = _profile_node(layer, s, p, xs, model.combine[idx],
+                                   mode, hw, repeats)
+        nodes.append(Node(
+            node_id=str(idx),
+            node_desc=layer.name,
+            forward_compute_time=f_ms,
+            backward_compute_time=b_ms,
+            activation_size=float(batch_size) * _prod(out_shapes[idx])
+            * itemsize,
+            parameter_size=float(param_bytes(p)),
+        ))
+    for n in nodes:
+        g.add_node(n)
+    for idx in range(len(model.layers)):
+        for pr in model.inputs[idx]:
+            if pr >= 0:
+                g.add_edge(str(pr), str(idx))
+    return g
+
+
+def coarse_chain(graph: Graph, model) -> Graph:
+    """Aggregate a DAG profile into the chain of its articulation blocks
+    (models/branchy.block_spans): summed compute/params per block, boundary
+    activation = the single tensor crossing each cut. The chain the
+    partitioner runs on; its node index k IS layer k of
+    branchy.to_chain(model), so stage bounds transfer 1:1."""
+    from ddlbench_tpu.models.branchy import block_spans
+
+    spans = block_spans(model)
+    chain_nodes = []
+    for k, (a, b) in enumerate(spans):
+        nd = Node(str(k), node_desc=f"block{k}")
+        for i in range(a, b):
+            n = graph.nodes[str(i)]
+            nd.forward_compute_time += n.forward_compute_time
+            nd.backward_compute_time += n.backward_compute_time
+            nd.parameter_size += n.parameter_size
+        if b < len(model.layers):
+            # the cut at b crosses exactly one source (articulation
+            # property): its output is the boundary tensor
+            (src,) = {s for d in range(b, len(model.layers))
+                      for s in model.inputs[d] if 0 <= s < b}
+            nd.activation_size = graph.nodes[str(src)].activation_size
+        else:
+            nd.activation_size = graph.nodes[str(b - 1)].activation_size
+        chain_nodes.append(nd)
+    return Graph.chain(chain_nodes)
 
 
 def profile_and_partition(

@@ -264,3 +264,8 @@ def test_hetero_comm_stats(devices):
     assert cs["boundary_bytes"] == 2 * 2 * 6 * 32 * 4
     assert cs["allreduce_bytes"] > 0  # stage-1 ring among its 3 replicas
     assert cs["total_bytes"] == cs["boundary_bytes"] + cs["allreduce_bytes"]
+    # the flat-axis implementation's wire traffic is a strict multiple of
+    # the logical payload (R rounds x N-1 links of a max-activation buffer
+    # per tick; gradient ring every tick in the async engine — ADVICE r2)
+    assert cs["physical_conveyor_bytes"] > cs["boundary_bytes"]
+    assert cs["physical_allreduce_bytes"] > cs["allreduce_bytes"]
