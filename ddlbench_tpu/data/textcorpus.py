@@ -1,0 +1,135 @@
+"""Plain-text LM ingest: text file(s) -> BPE -> packed causal-LM windows.
+
+Closes the tokens-kind real-data gap (VERDICT r2 #5): synthtext/longctx with
+``--data-dir`` previously reinterpreted raw random bytes as token ids
+(data/ondisk.py); now a directory holding ``train.txt`` (+ optional
+``test.txt``/``val.txt``) is tokenized with the self-contained BPE
+(data/bpe.py — trained on the corpus itself on first use and cached next to
+it), document-packed into one id stream with EOS separators, and served as
+fixed-shape [B, T+1] windows with the synthetic path's (inputs, labels) =
+(row[:-1], row[1:]) convention. Reference analog: the lazily loaded corpus
+machinery of GNMT (pipedream-fork/runtime/translation/seq2seq/data/
+dataset.py:1-60), redesigned as packed fixed shapes for XLA (one compile,
+no ragged batches).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Iterator, Optional
+
+import jax.numpy as jnp
+import numpy as np
+
+from ddlbench_tpu.config import DatasetSpec
+from ddlbench_tpu.data.bpe import BpeTokenizer
+
+_SPLIT_FILES = {"train": ("train",), "test": ("test", "val", "valid")}
+
+
+def find_text_corpus(data_dir: str, split: str) -> Optional[str]:
+    """Path of the split's text file under data_dir, or None."""
+    for base in _SPLIT_FILES[split]:
+        path = os.path.join(data_dir, f"{base}.txt")
+        if os.path.exists(path):
+            return path
+    return None
+
+
+class TextCorpusData:
+    """SyntheticData-interface batches from a plain text corpus.
+
+    Windows are contiguous [T+1] slices of the EOS-joined token stream
+    (document packing — no padding, every label position valid), shuffled
+    per epoch with a seeded permutation.
+    """
+
+    def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
+                 seed: int = 1, num_merges: int = 512,
+                 tokenizer: Optional[BpeTokenizer] = None,
+                 steps_per_epoch: Optional[int] = None):
+        assert spec.kind == "tokens", spec
+        self.spec = spec
+        self.batch_size = batch_size
+        self.seed = seed
+        self._steps_override = steps_per_epoch
+        self._perm_cache: dict = {}
+        T = spec.image_size[0]
+        train_path = find_text_corpus(data_dir, "train")
+        if train_path is None:
+            raise FileNotFoundError(
+                f"no text corpus (train.txt) under {data_dir}")
+        test_path = find_text_corpus(data_dir, "test") or train_path
+
+        vocab_path = os.path.join(data_dir, "bpe_vocab.json")
+        if tokenizer is not None:
+            self.tokenizer = tokenizer
+        elif os.path.exists(vocab_path):
+            self.tokenizer = BpeTokenizer.load(vocab_path)
+        else:
+            with open(train_path) as f:
+                self.tokenizer = BpeTokenizer.train(list(f),
+                                                    num_merges=num_merges)
+            try:
+                self.tokenizer.save(vocab_path)
+            except OSError:
+                pass
+        if self.tokenizer.vocab_size > spec.num_classes:
+            raise ValueError(
+                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds the "
+                f"spec's {spec.num_classes}; lower num_merges")
+
+        self._windows = {}
+        for split, path in (("train", train_path), ("test", test_path)):
+            stream = []
+            with open(path) as f:
+                for line in f:
+                    line = line.strip()
+                    if line:
+                        stream.extend(self.tokenizer.encode(line,
+                                                            add_eos=True))
+            W = T + 1
+            if len(stream) < W:
+                reps = -(-W // max(1, len(stream)))
+                stream = stream * (reps + 1)
+            n = len(stream) // W
+            rows = np.asarray(stream[:n * W], np.int32).reshape(n, W)
+            if n < batch_size:  # tile tiny corpora up to one batch
+                rows = np.tile(rows, (-(-batch_size // n), 1))
+            self._windows[split] = rows
+        self.num_tokens = int(self._windows["train"].size)
+
+    def steps_per_epoch(self, train: bool = True) -> int:
+        n = max(1, len(self._windows["train" if train else "test"])
+                // self.batch_size)
+        if self._steps_override:
+            n = min(n, self._steps_override)
+        return n
+
+    def _order(self, epoch: int, train: bool) -> np.ndarray:
+        if not train:
+            return np.arange(len(self._windows["test"]))
+        order = self._perm_cache.get(epoch)
+        if order is None:
+            order = np.random.default_rng(
+                (self.seed, epoch, 2)).permutation(len(self._windows["train"]))
+            self._perm_cache = {epoch: order}  # keep only the current epoch
+        return order
+
+    def batch(self, epoch: int, step: int, train: bool = True):
+        split = "train" if train else "test"
+        rows = self._windows[split]
+        n = len(rows)
+        order = self._order(epoch, train)
+        idx = order[(step * self.batch_size) % n:][:self.batch_size]
+        if len(idx) < self.batch_size:  # wrap the tail
+            idx = np.concatenate([idx, order[:self.batch_size - len(idx)]])
+        ids = jnp.asarray(rows[idx])
+        return ids[:, :-1], ids[:, 1:]
+
+    def epoch_iter(self, epoch: int, train: bool = True) -> Iterator:
+        for step in range(self.steps_per_epoch(train)):
+            yield self.batch(epoch, step, train)
+
+    def close(self) -> None:
+        pass

@@ -98,6 +98,22 @@ def _make_data(cfg: RunConfig):
                 flush=True,
             )
             return data
+    if spec.kind == "tokens" and cfg.data_dir:
+        # Real text corpus (train.txt): BPE-tokenized document-packed causal
+        # LM windows (data/textcorpus.py) — the raw-bytes placeholder below
+        # stays synthetic-only.
+        from ddlbench_tpu.data.textcorpus import (
+            TextCorpusData, find_text_corpus)
+
+        if find_text_corpus(cfg.data_dir, "train"):
+            data = TextCorpusData(cfg.data_dir, spec, global_batch,
+                                  seed=cfg.seed,
+                                  steps_per_epoch=cfg.steps_per_epoch)
+            print(
+                f"text corpus: {data.num_tokens} tokens, vocab "
+                f"{data.tokenizer.vocab_size}, "
+                f"{data.steps_per_epoch()} steps/epoch", flush=True)
+            return data
     from ddlbench_tpu.data.ondisk import OnDiskData
 
     train_count = (cfg.steps_per_epoch or 0) * global_batch or None
@@ -137,14 +153,15 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     # starts from pristine params/momentum/BN stats.
     if warmup_steps > 0:
         ts_warm = strategy.init(jax.random.key(cfg.seed))
-        x, y = strategy.shard_batch(*data.batch(epoch=0, step=0))
+        batch = strategy.shard_batch(*data.batch(epoch=0, step=0))
         for _ in range(warmup_steps):
-            ts_warm, m = strategy.train_step(ts_warm, x, y, jnp.float32(base_lr))
+            ts_warm, m = strategy.train_step(ts_warm, *batch,
+                                             jnp.float32(base_lr))
         float(m["loss"])  # device transfer = real sync (axon block_until_ready is lazy)
         if wd:
             # also compile eval_step now, so the watchdog deadline (armed
             # below) never spans a first-eval XLA compile
-            float(strategy.eval_step(ts_warm, x, y)["loss"])
+            float(strategy.eval_step(ts_warm, *batch)["loss"])
         del ts_warm
 
     ts = strategy.init(jax.random.key(cfg.seed))
@@ -220,7 +237,7 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     actlog, path = None, None
                 if path:
                     print(f"activations logged: {path}", flush=True)
-            x, y = strategy.shard_batch(bx, by)
+            batch = strategy.shard_batch(bx, by)
             step_lr = lr
             if cfg.warmup_epochs and epoch - 1 < cfg.warmup_epochs:
                 from ddlbench_tpu.parallel.common import gradual_warmup_lr
@@ -228,7 +245,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 step_lr = gradual_warmup_lr(
                     lr, warmup_world, epoch - 1, step, steps,
                     cfg.warmup_epochs)
-            ts, metrics = strategy.train_step(ts, x, y, jnp.float32(step_lr))
+            ts, metrics = strategy.train_step(ts, *batch,
+                                              jnp.float32(step_lr))
             interval_samples += global_batch
             # With the watchdog armed, sync every step so the deadline really
             # is per-step (a small pipelining cost, only when opted in);
@@ -278,8 +296,8 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
     total_loss, total_correct, total_correct5, total_count = 0.0, 0, 0, 0
     saw_correct5 = True
     for step in range(data.steps_per_epoch(train=False)):
-        x, y = strategy.shard_batch(*data.batch(epoch, step, train=False))
-        m = strategy.eval_step(ts, x, y)
+        m = strategy.eval_step(
+            ts, *strategy.shard_batch(*data.batch(epoch, step, train=False)))
         loss = float(m["loss"])
         check_finite(loss, epoch, step + 1, cfg.nan_policy)
         total_loss += loss * int(m["count"])

@@ -346,8 +346,8 @@ def test_auto_partition_is_load_bearing(devices, monkeypatch):
     # and it trains
     x = jax.random.normal(jax.random.key(1), (12, 4, 4, 1))
     y = jax.random.randint(jax.random.key(2), (12,), 0, 10)
-    xs, ys = strat.shard_batch(x, y)
-    ts2, m = strat.train_step(ts, xs, ys, jnp.float32(0.1))
+    ts2, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                              jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
 
 

@@ -87,12 +87,12 @@ def _parity_case(repl, bounds, mb, M, seed=0, lr=0.1, steps=2):
     B = M * mb
     x = jax.random.normal(jax.random.key(1), (B, 8, 8, 1))
     y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
-    xs, ys = strat.shard_batch(x, y)
+    batch = strat.shard_batch(x, y)
 
     params_list, state_list, _ = init_model(model, jax.random.key(seed))
     loss = ref_loss = None
     for _ in range(steps):
-        ts, metrics = strat.train_step(ts, xs, ys, jnp.float32(lr))
+        ts, metrics = strat.train_step(ts, *batch, jnp.float32(lr))
         loss = float(metrics["loss"])
         ref_loss, params_list = manual_step(
             model, params_list, state_list, x, y, lr)
@@ -107,7 +107,7 @@ def _parity_case(repl, bounds, mb, M, seed=0, lr=0.1, steps=2):
         want = ravel_pytree(params_list[bounds[s]:bounds[s + 1]])[0]
         np.testing.assert_allclose(np.asarray(got), np.asarray(want),
                                    rtol=1e-4, atol=1e-6)
-    return strat, ts, x, y, xs, ys, params_list, state_list
+    return strat, ts, x, y, batch, params_list, state_list
 
 
 def test_hetero_1_3_matches_sequential(devices):
@@ -121,9 +121,9 @@ def test_hetero_2_2_4_matches_sequential(devices):
 
 
 def test_hetero_eval_metrics(devices):
-    strat, ts, x, y, xs, ys, ref_params, ref_states = _parity_case(
+    strat, ts, x, y, batch, ref_params, ref_states = _parity_case(
         (1, 3), bounds=[0, 2, 5], mb=6, M=3, steps=1)
-    m = strat.eval_step(ts, xs, ys)
+    m = strat.eval_step(ts, *batch)
     logits, _ = apply_slice(strat.model.layers, ref_params, ref_states,
                             x, False)
     want_correct = int(jnp.sum(jnp.argmax(logits, -1) == y))
@@ -180,9 +180,9 @@ def test_hetero_pipedream_matches_simulator(devices, repl, bounds, mb, M):
     B = M * mb
     x = jax.random.normal(jax.random.key(1), (B, 8, 8, 1))
     y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
-    xs_h, ys_h = strat.shard_batch(x, y)
+    batch_h = strat.shard_batch(x, y)
     lr = 0.05
-    ts2, metrics = strat.train_step(ts, xs_h, ys_h, jnp.float32(lr))
+    ts2, metrics = strat.train_step(ts, *batch_h, jnp.float32(lr))
 
     params_list, state_list, _ = init_model(model, jax.random.key(0))
     xs_sim = x.reshape(M, mb, 8, 8, 1)
@@ -216,9 +216,9 @@ def test_hetero_pipedream_s1_anchor(devices):
     B = M * mb
     x = jax.random.normal(jax.random.key(1), (B, 8, 8, 1))
     y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
-    xs, ys = strat.shard_batch(x, y)
+    batch = strat.shard_batch(x, y)
     lr = 0.1
-    ts2, _ = strat.train_step(ts, xs, ys, jnp.float32(lr))
+    ts2, _ = strat.train_step(ts, *batch, jnp.float32(lr))
 
     params_list, state_list, _ = init_model(model, jax.random.key(0))
     for m in range(M):

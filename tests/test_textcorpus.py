@@ -1,0 +1,88 @@
+"""Real-text LM ingest (data/textcorpus.py) — VERDICT r2 #5.
+
+Plain text -> BPE -> packed [B, T+1] windows for tokens-kind benchmarks,
+replacing the raw-bytes placeholder (data/ondisk.py view('<i4')) for real
+data. Reference analog: seq2seq/data/dataset.py:1-60 lazy corpus machinery.
+"""
+
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import DatasetSpec
+from ddlbench_tpu.data.textcorpus import TextCorpusData, find_text_corpus
+
+SPEC = DatasetSpec("tinytext", (16,), 256, 1000, 100, kind="tokens")
+
+CORPUS = """the quick brown fox jumps over the lazy dog
+pack my box with five dozen liquor jugs
+how vexingly quick daft zebras jump
+sphinx of black quartz judge my vow
+"""
+
+
+@pytest.fixture()
+def corpus_dir(tmp_path):
+    (tmp_path / "train.txt").write_text(CORPUS * 8)
+    (tmp_path / "test.txt").write_text(CORPUS)
+    return str(tmp_path)
+
+
+def test_find_text_corpus(corpus_dir, tmp_path):
+    assert find_text_corpus(corpus_dir, "train").endswith("train.txt")
+    assert find_text_corpus(corpus_dir, "test").endswith("test.txt")
+    assert find_text_corpus(str(tmp_path / "nope"), "train") is None
+
+
+def test_batches_and_shapes(corpus_dir):
+    data = TextCorpusData(corpus_dir, SPEC, batch_size=4, num_merges=32)
+    x, y = data.batch(epoch=0, step=0)
+    assert x.shape == (4, 16) and y.shape == (4, 16)
+    # next-token shift: labels are inputs advanced by one
+    np.testing.assert_array_equal(np.asarray(x)[:, 1:], np.asarray(y)[:, :-1])
+    assert int(np.asarray(x).max()) < data.tokenizer.vocab_size
+    assert data.steps_per_epoch() >= 1
+    # the tokenizer vocab respects the spec budget
+    assert data.tokenizer.vocab_size <= SPEC.num_classes
+
+
+def test_round_trip_text(corpus_dir):
+    """Windows decode back to real corpus text (not byte noise — the whole
+    point vs the placeholder)."""
+    data = TextCorpusData(corpus_dir, SPEC, batch_size=2, num_merges=32)
+    x, _ = data.batch(0, 0)
+    text = data.tokenizer.decode([t for t in np.asarray(x)[0].tolist()])
+    assert any(w in text for w in ("quick", "fox", "quartz", "jugs"))
+
+
+def test_deterministic_and_shuffled(corpus_dir):
+    a = TextCorpusData(corpus_dir, SPEC, batch_size=4, num_merges=32, seed=7)
+    b = TextCorpusData(corpus_dir, SPEC, batch_size=4, num_merges=32, seed=7)
+    xa, ya = a.batch(1, 0)
+    xb, yb = b.batch(1, 0)
+    np.testing.assert_array_equal(np.asarray(xa), np.asarray(xb))
+    # different epochs see a different window order
+    xa2, _ = a.batch(2, 0)
+    assert not np.array_equal(np.asarray(xa), np.asarray(xa2))
+
+
+def test_tokenizer_cached(corpus_dir):
+    import os
+
+    TextCorpusData(corpus_dir, SPEC, batch_size=2, num_merges=32)
+    assert os.path.exists(os.path.join(corpus_dir, "bpe_vocab.json"))
+    # a second instance loads the cached vocab (same ids)
+    d2 = TextCorpusData(corpus_dir, SPEC, batch_size=2, num_merges=32)
+    assert d2.tokenizer.vocab_size <= SPEC.num_classes
+
+
+def test_loop_selects_text_corpus(corpus_dir):
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.train.loop import _make_data
+
+    cfg = RunConfig(benchmark="synthtext", strategy="single",
+                    arch="transformer_s", synthetic=False,
+                    data_dir=corpus_dir, batch_size=2, steps_per_epoch=2)
+    data = _make_data(cfg)
+    assert type(data).__name__ == "TextCorpusData"
+    x, y = data.batch(0, 0)
+    assert x.shape[0] == 2 and x.shape[1] == cfg.dataset().image_size[0]
