@@ -16,13 +16,14 @@ no ragged batches).
 from __future__ import annotations
 
 import os
-from typing import Iterator, Optional
+from typing import Optional
 
 import jax.numpy as jnp
 import numpy as np
 
 from ddlbench_tpu.config import DatasetSpec
 from ddlbench_tpu.data.bpe import BpeTokenizer
+from ddlbench_tpu.data.corpus import RowStreamData, bootstrap_tokenizer
 
 _SPLIT_FILES = {"train": ("train",), "test": ("test", "val", "valid")}
 
@@ -36,7 +37,7 @@ def find_text_corpus(data_dir: str, split: str) -> Optional[str]:
     return None
 
 
-class TextCorpusData:
+class TextCorpusData(RowStreamData):
     """SyntheticData-interface batches from a plain text corpus.
 
     Windows are contiguous [T+1] slices of the EOS-joined token stream
@@ -49,87 +50,46 @@ class TextCorpusData:
                  tokenizer: Optional[BpeTokenizer] = None,
                  steps_per_epoch: Optional[int] = None):
         assert spec.kind == "tokens", spec
+        super().__init__(batch_size, seed, salt=2,
+                         steps_per_epoch=steps_per_epoch)
         self.spec = spec
-        self.batch_size = batch_size
-        self.seed = seed
-        self._steps_override = steps_per_epoch
-        self._perm_cache: dict = {}
         T = spec.image_size[0]
         train_path = find_text_corpus(data_dir, "train")
         if train_path is None:
             raise FileNotFoundError(
                 f"no text corpus (train.txt) under {data_dir}")
-        test_path = find_text_corpus(data_dir, "test") or train_path
+        test_path = find_text_corpus(data_dir, "test")
 
-        vocab_path = os.path.join(data_dir, "bpe_vocab.json")
-        if tokenizer is not None:
-            self.tokenizer = tokenizer
-        elif os.path.exists(vocab_path):
-            self.tokenizer = BpeTokenizer.load(vocab_path)
-        else:
+        def train_lines():
             with open(train_path) as f:
-                self.tokenizer = BpeTokenizer.train(list(f),
-                                                    num_merges=num_merges)
-            try:
-                self.tokenizer.save(vocab_path)
-            except OSError:
-                pass
-        if self.tokenizer.vocab_size > spec.num_classes:
-            raise ValueError(
-                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds the "
-                f"spec's {spec.num_classes}; lower num_merges")
+                return list(f)
 
-        self._windows = {}
-        for split, path in (("train", train_path), ("test", test_path)):
-            stream = []
-            with open(path) as f:
-                for line in f:
-                    line = line.strip()
-                    if line:
-                        stream.extend(self.tokenizer.encode(line,
-                                                            add_eos=True))
-            W = T + 1
-            if len(stream) < W:
-                reps = -(-W // max(1, len(stream)))
-                stream = stream * (reps + 1)
-            n = len(stream) // W
-            rows = np.asarray(stream[:n * W], np.int32).reshape(n, W)
-            if n < batch_size:  # tile tiny corpora up to one batch
-                rows = np.tile(rows, (-(-batch_size // n), 1))
-            self._windows[split] = rows
-        self.num_tokens = int(self._windows["train"].size)
+        self.tokenizer = bootstrap_tokenizer(
+            data_dir, train_lines, spec.num_classes, num_merges, tokenizer)
 
-    def steps_per_epoch(self, train: bool = True) -> int:
-        n = max(1, len(self._windows["train" if train else "test"])
-                // self.batch_size)
-        if self._steps_override:
-            n = min(n, self._steps_override)
-        return n
+        self._store_rows("train", self._windows_of(train_path, T))
+        if test_path is None:
+            self._rows["test"] = self._rows["train"]  # no re-tokenize
+        else:
+            self._store_rows("test", self._windows_of(test_path, T))
+        self.num_tokens = int(self._rows["train"].size)
 
-    def _order(self, epoch: int, train: bool) -> np.ndarray:
-        if not train:
-            return np.arange(len(self._windows["test"]))
-        order = self._perm_cache.get(epoch)
-        if order is None:
-            order = np.random.default_rng(
-                (self.seed, epoch, 2)).permutation(len(self._windows["train"]))
-            self._perm_cache = {epoch: order}  # keep only the current epoch
-        return order
+    def _windows_of(self, path: str, T: int) -> np.ndarray:
+        stream = []
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if line:
+                    stream.extend(self.tokenizer.encode(line, add_eos=True))
+        if not stream:
+            raise ValueError(f"text corpus {path} is empty")
+        W = T + 1
+        if len(stream) < W:
+            reps = -(-W // len(stream))
+            stream = stream * (reps + 1)
+        n = len(stream) // W
+        return np.asarray(stream[:n * W], np.int32).reshape(n, W)
 
     def batch(self, epoch: int, step: int, train: bool = True):
-        split = "train" if train else "test"
-        rows = self._windows[split]
-        n = len(rows)
-        order = self._order(epoch, train)
-        idx = order[(step * self.batch_size) % n:][:self.batch_size]
-        if len(idx) < self.batch_size:  # wrap the tail
-            idx = np.concatenate([idx, order[:self.batch_size - len(idx)]])
-        ids = jnp.asarray(rows[idx])
+        ids = jnp.asarray(self.take_rows(epoch, step, train))
         return ids[:, :-1], ids[:, 1:]
-
-    def epoch_iter(self, epoch: int, train: bool = True) -> Iterator:
-        for step in range(self.steps_per_epoch(train)):
-            yield self.batch(epoch, step, train)
-
-    def close(self) -> None:
-        pass

@@ -29,14 +29,14 @@ sampler}.py, csrc/pack_utils*). The TPU-native pipeline here:
 from __future__ import annotations
 
 import os
-from typing import Iterator, List, Optional, Tuple
+from typing import List, Optional, Tuple
 
-import jax
 import jax.numpy as jnp
 import numpy as np
 
 from ddlbench_tpu.config import DatasetSpec
 from ddlbench_tpu.data.bpe import BOS, EOS, PAD, BpeTokenizer
+from ddlbench_tpu.data.corpus import RowStreamData, bootstrap_tokenizer
 from ddlbench_tpu.data.synthetic import mask_source_labels
 
 _SPLIT_FILES = {"train": ("train",), "test": ("test", "val", "valid")}
@@ -85,13 +85,14 @@ def _pack(tok: BpeTokenizer, pairs: List[Tuple[str, str]], S: int, T: int):
             np.asarray(lens, np.int32))
 
 
-class TranslationData:
+class TranslationData(RowStreamData):
     """SyntheticData-interface batches from a real parallel corpus.
 
     The stream layout matches the seq2seq spec: total length spec.seq_len =
     S + T with S = spec.src_len; inputs are stream[:, :-1], labels are
     stream[:, 1:] with source-internal (mask_source_labels) AND pad
-    positions masked -1.
+    positions masked -1. Tokenizer bootstrap and the shuffled fixed-shape
+    batcher live in data/corpus.py (shared with the plain-text LM ingest).
     """
 
     def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
@@ -99,88 +100,42 @@ class TranslationData:
                  tokenizer: Optional[BpeTokenizer] = None,
                  steps_per_epoch: Optional[int] = None):
         assert spec.kind == "seq2seq" and spec.src_len
+        super().__init__(batch_size, seed, salt=1,
+                         steps_per_epoch=steps_per_epoch)
         self.spec = spec
-        self.batch_size = batch_size
-        self.seed = seed
-        self._steps_override = steps_per_epoch
-        self._perm_cache: dict = {}
         S = spec.src_len
         T = spec.seq_len - S
         train_files = find_parallel_corpus(data_dir, "train")
         if train_files is None:
             raise FileNotFoundError(
                 f"no parallel corpus (train.src/train.tgt) under {data_dir}")
-        test_files = find_parallel_corpus(data_dir, "test") or train_files
+        test_files = find_parallel_corpus(data_dir, "test")
 
-        vocab_path = os.path.join(data_dir, "bpe_vocab.json")
-        if tokenizer is not None:
-            self.tokenizer = tokenizer
-        elif os.path.exists(vocab_path):
-            self.tokenizer = BpeTokenizer.load(vocab_path)
-        else:
+        def train_lines():
             with open(train_files[0]) as fs, open(train_files[1]) as ft:
-                self.tokenizer = BpeTokenizer.train(
-                    list(fs) + list(ft), num_merges=num_merges)
-            try:
-                self.tokenizer.save(vocab_path)
-            except OSError:
-                pass
-        if self.tokenizer.vocab_size > spec.num_classes:
-            raise ValueError(
-                f"tokenizer vocab {self.tokenizer.vocab_size} exceeds the "
-                f"spec's {spec.num_classes}; lower num_merges")
+                return list(fs) + list(ft)
 
-        self._streams = {}
+        self.tokenizer = bootstrap_tokenizer(
+            data_dir, train_lines, spec.num_classes, num_merges, tokenizer)
+
         self._lens = {}
         for split, files in (("train", train_files), ("test", test_files)):
+            if files is None:  # no test split: reuse train (no re-tokenize)
+                self._rows["test"] = self._rows["train"]
+                self._lens["test"] = self._lens["train"]
+                continue
             rows, lens = _pack(self.tokenizer, _read_pairs(*files), S, T)
-            if len(rows) < batch_size:
-                reps = -(-batch_size // len(rows))
-                rows = np.tile(rows, (reps, 1))
-                lens = np.tile(lens, (reps, 1))
-            self._streams[split] = rows
+            self._store_rows(split, rows)
             self._lens[split] = lens
 
-    def steps_per_epoch(self, train: bool = True) -> int:
-        n = max(1, len(self._streams["train" if train else "test"])
-                // self.batch_size)
-        if self._steps_override:
-            n = min(n, self._steps_override)
-        return n
-
-    def _order(self, epoch: int, train: bool) -> np.ndarray:
-        if not train:
-            return np.arange(len(self._streams["test"]))
-        key = epoch
-        order = self._perm_cache.get(key)
-        if order is None:
-            order = np.random.default_rng(
-                (self.seed, epoch, 1)).permutation(len(self._streams["train"]))
-            self._perm_cache = {key: order}  # keep only the current epoch
-        return order
-
     def batch(self, epoch: int, step: int, train: bool = True):
-        split = "train" if train else "test"
-        rows = self._streams[split]
-        n = len(rows)
-        order = self._order(epoch, train)
-        idx = order[(step * self.batch_size) % n:][:self.batch_size]
-        if len(idx) < self.batch_size:  # wrap the tail
-            idx = np.concatenate([idx, order[:self.batch_size - len(idx)]])
-        ids = jnp.asarray(rows[idx])
+        ids = jnp.asarray(self.take_rows(epoch, step, train))
         x, labels = ids[:, :-1], ids[:, 1:]
         labels = mask_source_labels(labels, self.spec.src_len)
         # pad positions carry no loss: neither predicting a pad nor
         # predicting FROM a pad input position
         labels = jnp.where((labels == PAD) | (x == PAD), -1, labels)
         return x, labels
-
-    def epoch_iter(self, epoch: int, train: bool = True) -> Iterator:
-        for step in range(self.steps_per_epoch(train)):
-            yield self.batch(epoch, step, train)
-
-    def close(self) -> None:
-        pass
 
     # -- padded-efficiency accounting (the priced fixed-shape choice) ------
 

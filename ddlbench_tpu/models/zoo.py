@@ -16,7 +16,8 @@ from ddlbench_tpu.models.vgg import build_vgg
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
                "mobilenetv2", "lenet", "alexnet", "squeezenet", "resnext50",
                "densenet121", "inception", "transformer_s", "transformer_m",
-               "transformer_moe_s", "seq2seq_s", "seq2seq_m")
+               "transformer_moe_s", "seq2seq_s", "seq2seq_m",
+               "seq2seq_lstm_s")
 
 
 def get_model(arch: str, dataset: str | DatasetSpec,
@@ -25,6 +26,12 @@ def get_model(arch: str, dataset: str | DatasetSpec,
     if arch.startswith("seq2seq"):
         if spec.kind != "seq2seq":
             raise ValueError(f"{arch} requires a seq2seq dataset, got {spec.name}")
+        if "lstm" in arch:
+            # recurrent (GNMT-class) variant, scan-based (models/lstm.py)
+            from ddlbench_tpu.models.lstm import build_lstm_seq2seq
+
+            return build_lstm_seq2seq(arch, spec.image_size,
+                                      spec.num_classes, spec.src_len)
         from ddlbench_tpu.models.seq2seq import build_seq2seq
 
         return build_seq2seq(arch, spec.image_size, spec.num_classes,

@@ -5,11 +5,17 @@ scripts (SURVEY.md §1 L4); here ``make_strategy(cfg)`` returns an object with
 a uniform interface consumed by one train loop (ddlbench_tpu/train/loop.py):
 
 * ``init(key) -> train_state`` (device-placed/sharded)
-* ``train_step(train_state, x, y, lr) -> (train_state, metrics)`` (jitted)
-* ``eval_step(train_state, x, y) -> {loss, correct, count[, correct5]}``
-  (jitted; ``correct5`` is the optional prec@5 numerator — the loop reports
-  top5 only when a strategy provides it)
-* ``shard_batch(x, y)`` — place a global batch onto the strategy's mesh
+* ``shard_batch(x, y) -> batch_args`` — place a global batch onto the
+  strategy's mesh. The result is an OPAQUE tuple of the data arguments the
+  step functions expect; callers always splat it
+  (``train_step(ts, *batch_args, lr)``). Most strategies return (x, y); the
+  hetero engines return per-device row shards plus a per-microbatch
+  valid-count vector.
+* ``train_step(train_state, *batch_args, lr) -> (train_state, metrics)``
+  (jitted)
+* ``eval_step(train_state, *batch_args) -> {loss, correct, count[,
+  correct5]}`` (jitted; ``correct5`` is the optional prec@5 numerator — the
+  loop reports top5 only when a strategy provides it)
 * ``world_size``
 """
 

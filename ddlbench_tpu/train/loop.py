@@ -188,6 +188,13 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             ep, ts = restore_checkpoint(cfg.checkpoint_dir, ts)
             start_epoch = ep + 1
             print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
+            # post-resume validation BEFORE training continues (reference
+            # semantics: main_with_runtime.py:374-376 re-runs validate()
+            # right after restoring) — confirms the restored state is the
+            # one that was saved, not merely loadable
+            ev = evaluate(cfg, strategy, ts, data, ep, wd)
+            logger.valid_epoch(ep, ev["loss"], ev["accuracy"],
+                               top5=ev.get("top5"))
 
     # Activation/gradient deep-dive logging (torchlogger analog, §5.5).
     # Works on the flat per-layer param structure; pipeline strategies pack

@@ -1,0 +1,65 @@
+"""End-to-end checkpoint/resume through the real train loop (VERDICT r2 #7).
+
+Round 2 only round-tripped checkpoint state; nothing drove
+``--checkpoint-dir --resume`` through train/loop.py and checked the benchmark
+CONTINUES correctly. Here: train 1 epoch + save, resume for epoch 2, and
+match an uninterrupted 2-epoch run bit-for-bit (synthetic data is
+deterministic in (epoch, step), so the only way the trajectories agree is if
+params/optimizer state — hetero's packed [N, L] rows included — survived the
+round trip). Post-resume validation runs BEFORE training continues
+(reference semantics, main_with_runtime.py:374-376).
+"""
+
+import jax
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.train.loop import run_benchmark
+
+
+def _cfg(tmp, strategy, **kw):
+    base = dict(benchmark="mnist", strategy=strategy, arch="lenet",
+                compute_dtype="float32", steps_per_epoch=2, log_interval=1,
+                batch_size=8, checkpoint_dir=tmp)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _params_vec(ts):
+    return np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree.leaves(ts.params)])
+
+
+@pytest.mark.parametrize("strategy,extra", [
+    ("single", {}),
+    ("pipedream", dict(num_devices=3, stage_replication=(1, 2),
+                       micro_batch_size=4, num_microbatches=2,
+                       batch_size=None)),
+])
+def test_resume_matches_uninterrupted(tmp_path, capsys, strategy, extra):
+    ck_a = str(tmp_path / "interrupted")
+    ck_b = str(tmp_path / "straight")
+
+    # phase 1: one epoch, checkpointed, then "killed"
+    run_benchmark(_cfg(ck_a, strategy, epochs=1, **extra), warmup_steps=0)
+    # phase 2: resume and finish epoch 2
+    res = run_benchmark(_cfg(ck_a, strategy, epochs=2, resume=True, **extra),
+                        warmup_steps=0)
+    out = capsys.readouterr().out
+    assert "resumed from" in out and "epoch 1" in out
+    # post-resume validation line appears BEFORE epoch 2's training output
+    resumed_at = out.index("resumed from")
+    post_val = out.index("valid | 1/2 epoch", resumed_at)
+    assert post_val < out.index("train | 2/2 epoch")
+
+    # control: uninterrupted 2 epochs
+    res_u = run_benchmark(_cfg(ck_b, strategy, epochs=2, **extra),
+                          warmup_steps=0)
+    np.testing.assert_allclose(
+        _params_vec(res["train_state"]), _params_vec(res_u["train_state"]),
+        rtol=1e-6, atol=1e-7)
+    np.testing.assert_allclose(res["valid_accuracy"], res_u["valid_accuracy"],
+                               rtol=1e-6)
