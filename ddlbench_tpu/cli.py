@@ -87,6 +87,10 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-fused-head-loss", action="store_true",
                    help="disable the fused LM-head projection+cross-entropy "
                         "(materialize full logits instead)")
+    p.add_argument("--remat-layers", action="store_true",
+                   help="jax.checkpoint every layer in the one-apply "
+                        "strategies (recompute activations in the backward; "
+                        "fits XLA-attention long-context on one chip)")
     p.add_argument("--seed", type=int, default=1)
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
     p.add_argument("--auto-partition", action="store_true",
@@ -151,6 +155,7 @@ def config_from_args(args) -> RunConfig:
         compute_dtype=args.dtype,
         attention_backend=args.attention_backend,
         fused_head_loss=not args.no_fused_head_loss,
+        remat_layers=args.remat_layers,
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,

@@ -254,6 +254,16 @@ class RunConfig:
     # jax.checkpoint each (microbatch, stage) in pipeline modes — parity with
     # torchgpipe's default activation checkpointing.
     remat_stages: bool = True
+    # jax.checkpoint each LAYER in the one-apply strategies (single/dp/tp/
+    # fsdp): the backward recomputes layers instead of saving interiors,
+    # capping live activations at one layer's working set. Off by default
+    # (XLA's fusion usually wins); required for XLA-attention long-context
+    # training on one chip, where each layer otherwise keeps a [B, H, T, T]
+    # score matrix alive into the backward. Incompatible with MoE archs: the
+    # router aux losses are collected through a trace-time side channel
+    # (models/moe.py collect_aux_losses) that cannot escape a checkpointed
+    # trace.
+    remat_layers: bool = False
     seed: int = 1  # reference seeds torch.manual_seed(1) (imagenet_pytorch.py:58-66)
 
     # Checkpoint/resume (reference: per-stage checkpoint.{stage}.pth.tar per
@@ -384,6 +394,19 @@ class RunConfig:
                 raise ValueError("ep (expert parallelism) requires a token benchmark")
             if "moe" not in self.arch:
                 raise ValueError("ep (expert parallelism) requires an MoE arch")
+        if self.remat_layers and "moe" in self.arch:
+            raise ValueError(
+                "remat_layers is incompatible with MoE archs (router aux "
+                "losses cannot escape a checkpointed trace); use "
+                "remat_stages via a pipeline strategy instead")
+        if self.remat_layers and self.strategy not in ("single", "dp", "tp",
+                                                       "fsdp"):
+            raise ValueError(
+                f"remat_layers applies to the one-apply strategies "
+                f"(single/dp/tp/fsdp), not {self.strategy!r} — the pipeline "
+                f"strategies checkpoint per (microbatch, stage) via "
+                f"remat_stages, and sp/ep bound activation memory by "
+                f"sharding the sequence/experts instead")
         if self.stage_replication is not None:
             repl = tuple(self.stage_replication)
             if self.strategy not in ("gpipe", "pipedream"):

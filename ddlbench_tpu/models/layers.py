@@ -25,6 +25,7 @@ Everything is NHWC with HWIO kernels — the TPU-native convolution layout.
 from __future__ import annotations
 
 import dataclasses
+import functools
 import math
 from typing import Any, Callable, List, Sequence, Tuple
 
@@ -145,16 +146,29 @@ def init_model(model: LayerModel, key: jax.Array):
     return params, states, shapes
 
 
-def apply_slice(layers: Sequence[Layer], params, states, x, train: bool):
+def apply_slice(layers: Sequence[Layer], params, states, x, train: bool,
+                remat: bool = False):
+    """Run ``layers`` in order. With ``remat`` each layer is wrapped in
+    jax.checkpoint: the backward recomputes the layer instead of saving its
+    interior activations, capping live memory at one layer's working set —
+    at 8k context the XLA-attention score matrix is 2 GB/layer, so without
+    this every layer's matrix is resident at once and a single v5e chip
+    OOMs (perf_runs, round 3). FLOPs-for-HBM, the jax.checkpoint analog of
+    the pipeline strategies' per-(microbatch, stage) cfg.remat_stages."""
     new_states = []
     for layer, p, s in zip(layers, params, states):
-        x, s2 = layer.apply(p, s, x, train)
+        if remat:
+            x, s2 = jax.checkpoint(
+                functools.partial(layer.apply, train=train))(p, s, x)
+        else:
+            x, s2 = layer.apply(p, s, x, train)
         new_states.append(s2)
     return x, new_states
 
 
-def apply_model(model: LayerModel, params, states, x, train: bool):
-    return apply_slice(model.layers, params, states, x, train)
+def apply_model(model: LayerModel, params, states, x, train: bool,
+                remat: bool = False):
+    return apply_slice(model.layers, params, states, x, train, remat)
 
 
 # ---------------------------------------------------------------------------

@@ -27,6 +27,9 @@ from ddlbench_tpu.models.layers import Layer, LayerModel, axis_context
 LN_EPS = 1e-5
 
 _VARIANTS = {
+    # _t is the test/smoke size: big enough to exercise every code path
+    # (attention, MLP, fused head), small enough for 1-core CPU compiles.
+    "transformer_t": dict(d_model=32, n_layers=2, n_heads=4),
     "transformer_s": dict(d_model=512, n_layers=8, n_heads=8),
     "transformer_m": dict(d_model=768, n_layers=12, n_heads=12),
 }

@@ -15,8 +15,8 @@ from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
                "mobilenetv2", "lenet", "alexnet", "squeezenet", "resnext50",
-               "densenet121", "inception", "transformer_s", "transformer_m",
-               "transformer_moe_s", "seq2seq_s", "seq2seq_m",
+               "densenet121", "inception", "transformer_t", "transformer_s",
+               "transformer_m", "transformer_moe_s", "seq2seq_s", "seq2seq_m",
                "seq2seq_lstm_s")
 
 

@@ -289,6 +289,11 @@ def fused_linear_xent_eval(h, w, labels, k: int = 5, row_chunk: int = 512):
 
 ROW_BLOCK = 256
 V_BLOCK = 2048
+# Per-kernel working-set ceiling. v5e gives ~16 MiB of scoped VMEM per core;
+# stay well under it so double-buffering + compiler temporaries fit (the dW
+# kernel at (br=256, bv=2048, D=512) measures 18.2 MiB on-chip and is
+# rejected by Mosaic, hence the budget-aware block choice below).
+VMEM_BUDGET = 12 * 1024 * 1024
 
 
 def _pick_block(t: int, preferred: int, unit: int = 1) -> Optional[int]:
@@ -297,6 +302,33 @@ def _pick_block(t: int, preferred: int, unit: int = 1) -> Optional[int]:
     from ddlbench_tpu.ops.util import pick_block
 
     return pick_block(t, preferred, unit)
+
+
+def _budget_v_block(V: int, D: int, br: int, in_size: int, interpret: bool,
+                    per_bv: int = 0, fixed: int = 0) -> int:
+    """Largest 128-multiple vocab-block divisor of ``V`` whose kernel
+    working set fits ``VMEM_BUDGET``.
+
+    Shared terms for all three kernels: double-buffered input blocks
+    (h [br, D], w [D, bv]) plus the recomputed f32 logit block [br, bv].
+    ``per_bv`` prices kernel-specific bytes per vocab lane (dz blocks, the
+    dW kernel's f32 [D, bv] scratch + double-buffered f32 out block);
+    ``fixed`` prices bv-independent extras (the dh kernel's [br, D] f32
+    accumulator and double-buffered out block)."""
+    bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
+    if interpret or bv is None:
+        return bv
+
+    def footprint(b: int) -> int:
+        ins = 2 * (br * D + D * b) * in_size
+        return ins + br * b * 4 + per_bv * b + fixed
+
+    while bv > 128 and footprint(bv) > VMEM_BUDGET:
+        smaller = _pick_block(V, bv // 2, 128)
+        if smaller is None or smaller == bv:
+            break
+        bv = smaller
+    return bv
 
 
 def _row_block(n: int, interpret: bool) -> int:
@@ -363,7 +395,7 @@ def _fxent_fwd_pallas(h, w, labels, smoothing: float, interpret: bool):
     hp, lp, _ = _pad_rows(h, labels, br)
     Np = hp.shape[0]
     nr = Np // br
-    bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
+    bv = _budget_v_block(V, D, br, h.dtype.itemsize, interpret)
     nv = V // bv
     lab2 = lp[:, None].astype(jnp.int32)
 
@@ -472,8 +504,18 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
     hp, lp, _ = _pad_rows(h, labels, br)
     Np = hp.shape[0]
     nr = Np // br
-    bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
+    # dh's accumulator + double-buffered out block are [br, D]
+    # (bv-independent, charged as ``fixed``); dW carries an f32 [D, bv]
+    # scratch plus a double-buffered f32 [D, bv] out block, so its lane
+    # block must shrink when D is wide (VMEM_BUDGET note above). Both
+    # recompute a dz block [br, bv] in the compute dtype.
+    isz = h.dtype.itemsize
+    bv = _budget_v_block(V, D, br, isz, interpret,
+                         per_bv=br * isz, fixed=br * D * (4 + 2 * isz))
     nv = V // bv
+    bv_dw = _budget_v_block(V, D, br, isz, interpret,
+                            per_bv=br * isz + 3 * D * 4)
+    nv_dw = V // bv_dw
     lab2 = lp[:, None].astype(jnp.int32)
     # padded rows: lse=0 with z=0 gives p=1 — masked to 0 by the label test
     lse2 = jnp.pad(lses, (0, Np - N))[:, None]
@@ -499,18 +541,18 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
     )(hp, w, lab2, lse2, coef)
 
     dw = pl.pallas_call(
-        functools.partial(_fx_dw_kernel, bv=bv, nr=nr),
-        grid=(nv, nr),
+        functools.partial(_fx_dw_kernel, bv=bv_dw, nr=nr),
+        grid=(nv_dw, nr),
         in_specs=[
             pl.BlockSpec((br, D), lambda j, i: (i, 0)),
-            pl.BlockSpec((D, bv), lambda j, i: (0, j)),
+            pl.BlockSpec((D, bv_dw), lambda j, i: (0, j)),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((br, 1), lambda j, i: (i, 0)),
             pl.BlockSpec((1, 4), lambda j, i: (0, 0)),
         ],
-        out_specs=pl.BlockSpec((D, bv), lambda j, i: (0, j)),
+        out_specs=pl.BlockSpec((D, bv_dw), lambda j, i: (0, j)),
         out_shape=_pl_out((D, V), f32, hp, w, lab2, lse2, coef),
-        scratch_shapes=[pltpu.VMEM((D, bv), f32)],
+        scratch_shapes=[pltpu.VMEM((D, bv_dw), f32)],
         interpret=interpret,
     )(hp, w, lab2, lse2, coef)
 

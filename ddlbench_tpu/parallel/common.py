@@ -64,7 +64,8 @@ def correct_topk(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
 
 
 def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
-                         aux_weight, smoothing, fused, accum_steps: int):
+                         aux_weight, smoothing, fused, accum_steps: int,
+                         remat: bool = False):
     """K-way gradient accumulation: split the leading batch axis into K
     micro-steps, scan value_and_grad over them, and average the gradients
     weighted by each micro-step's valid-label count (exact K=1 equivalence;
@@ -103,7 +104,7 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
         def f(p):
             obj, ce, stats, new_st = loss_with_moe_aux(
                 model, p, st, xk, yk, True, compute_dtype, aux_weight,
-                smoothing, fused)
+                smoothing, fused, remat)
             return obj, (ce, stats, new_st)
 
         (obj, (ce, (corr, valid), new_st)), g = jax.value_and_grad(
@@ -131,13 +132,14 @@ def loss_and_grads(model, cfg, params, model_state, x, y, compute_dtype,
         _, ce, stats, new_state, grads = accum_loss_and_grads(
             model, params, model_state, x, y, compute_dtype,
             cfg.moe_aux_weight, smoothing, cfg.fused_head_loss,
-            cfg.grad_accum_steps)
+            cfg.grad_accum_steps, cfg.remat_layers)
         return ce, stats, new_state, grads
 
     def loss_fn(p):
         loss, ce, stats, new_state = loss_with_moe_aux(
             model, p, model_state, x, y, True, compute_dtype,
-            cfg.moe_aux_weight, smoothing, fused=cfg.fused_head_loss)
+            cfg.moe_aux_weight, smoothing, fused=cfg.fused_head_loss,
+            remat=cfg.remat_layers)
         return loss, (ce, stats, new_state)
 
     (_, (ce, stats, new_state)), grads = jax.value_and_grad(
@@ -286,7 +288,7 @@ def head_fusable(model) -> bool:
 
 
 def fused_slice_loss_sums(layers, params_cast, states, x_cast, labels,
-                          smoothing: float):
+                          smoothing: float, remat: bool = False):
     """Apply layers[:-1], then layers[-1].fused_loss (the fused projection+CE).
 
     The single home for the fused-head calling convention (also used by the
@@ -299,20 +301,20 @@ def fused_slice_loss_sums(layers, params_cast, states, x_cast, labels,
     from ddlbench_tpu.models.layers import apply_slice
 
     h, new_states = apply_slice(layers[:-1], params_cast[:-1], states[:-1],
-                                x_cast, True)
+                                x_cast, True, remat)
     obj_sum, ce_sum, correct = layers[-1].fused_loss(
         params_cast[-1], h, labels, smoothing)
     return obj_sum, ce_sum, correct, new_states + [states[-1]]
 
 
 def fused_head_loss_sums(model, params_cast, model_state, x_cast, y,
-                         smoothing: float):
+                         smoothing: float, remat: bool = False):
     """Model-level wrapper of fused_slice_loss_sums; adds the valid count.
 
     Returns (obj_sum, ce_sum, correct, valid, new_state).
     """
     obj_sum, ce_sum, correct, new_state = fused_slice_loss_sums(
-        model.layers, params_cast, model_state, x_cast, y, smoothing)
+        model.layers, params_cast, model_state, x_cast, y, smoothing, remat)
     valid = jnp.sum((y >= 0).astype(jnp.int32))
     return obj_sum, ce_sum, correct, valid, new_state
 
@@ -359,7 +361,8 @@ def eval_metrics(model, cfg, params, model_state, x, y, compute_dtype):
 
 
 def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
-                      aux_weight, smoothing: float = 0.0, fused: bool = False):
+                      aux_weight, smoothing: float = 0.0, fused: bool = False,
+                      remat: bool = False):
     """Apply the model and return (total_loss, ce, (correct, valid), new_state).
 
     total_loss = cross-entropy (optionally label-smoothed — the training
@@ -382,12 +385,13 @@ def loss_with_moe_aux(model, params, model_state, x, y, train, compute_dtype,
     if fused and train and head_fusable(model):
         with collect_aux_losses(aux):
             obj_sum, ce_sum, correct, valid, new_state = fused_head_loss_sums(
-                model, p, model_state, xc, y, smoothing)
+                model, p, model_state, xc, y, smoothing, remat)
         denom = jnp.maximum(1.0, valid.astype(jnp.float32))
         obj, ce = obj_sum / denom, ce_sum / denom
     else:
         with collect_aux_losses(aux):
-            logits, new_state = apply_model(model, p, model_state, xc, train)
+            logits, new_state = apply_model(model, p, model_state, xc, train,
+                                            remat)
         ce = cross_entropy_loss(logits, y)
         obj = cross_entropy_loss(logits, y, smoothing) if smoothing else ce
         correct, valid = correct_and_count(logits, y)

@@ -1,0 +1,69 @@
+"""VMEM-budget-aware vocab-block selection for the fused-xent kernels.
+
+Pure-Python and fast (in the default commit gate): the shrink loop only
+matters on real TPU hardware — interpret-mode kernel tests never reach it —
+and its first regression surfaced only as an on-chip Mosaic scoped-VMEM
+rejection (perf_runs, round 3: 18.2 MiB > 16 MiB for the dW kernel at
+br=256, bv=2048, D=512). These tests pin the arithmetic off-chip.
+"""
+
+from ddlbench_tpu.ops.fused_xent import VMEM_BUDGET, _budget_v_block
+
+
+def _dh_args(D, br, isz):
+    return dict(per_bv=br * isz, fixed=br * D * (4 + 2 * isz))
+
+
+def _dw_args(D, br, isz):
+    return dict(per_bv=br * isz + 3 * D * 4)
+
+
+def _footprint(V, D, br, isz, bv, per_bv=0, fixed=0):
+    return 2 * (br * D + D * bv) * isz + br * bv * 4 + per_bv * bv + fixed
+
+
+def test_synthtext_dw_shrinks_under_budget():
+    # The exact on-chip failure case: transformer_s head, bf16, vocab 32k.
+    V, D, br, isz = 32768, 512, 256, 2
+    bv = _budget_v_block(V, D, br, isz, False, **_dw_args(D, br, isz))
+    assert bv == 1024
+    assert _footprint(V, D, br, isz, bv, **_dw_args(D, br, isz)) <= VMEM_BUDGET
+
+
+def test_synthtext_fwd_and_dh_keep_full_block():
+    V, D, br, isz = 32768, 512, 256, 2
+    assert _budget_v_block(V, D, br, isz, False) == 2048
+    assert _budget_v_block(V, D, br, isz, False, **_dh_args(D, br, isz)) == 2048
+
+
+def test_f32_forward_not_overcharged():
+    # f32 forward at bv=2048 is ~11.4 MiB — fits; a dz charge the forward
+    # never allocates must not shrink it.
+    assert _budget_v_block(32768, 512, 256, 4, False) == 2048
+
+
+def test_wide_model_dh_fixed_costs_counted():
+    # D=2048 bf16: dh's [br, D] accumulator + double-buffered out add 4 MiB
+    # of bv-independent cost; the pick must land under budget WITH them.
+    V, D, br, isz = 32768, 2048, 256, 2
+    args = _dh_args(D, br, isz)
+    bv = _budget_v_block(V, D, br, isz, False, **args)
+    assert bv is not None
+    assert _footprint(V, D, br, isz, bv, **args) <= VMEM_BUDGET
+
+
+def test_every_pick_divides_v_and_is_lane_aligned():
+    for V in (32768, 50304, 1024, 384):
+        for D in (128, 512, 1024, 4096):
+            for maker in (lambda D, br, i: {}, _dh_args, _dw_args):
+                bv = _budget_v_block(V, D, 256, 2, False,
+                                     **maker(D, 256, 2))
+                if bv is not None:
+                    assert V % bv == 0 and bv % 128 == 0
+
+
+def test_interpret_and_odd_vocab_paths():
+    # interpret: no lane constraint, no shrinking (CPU has no VMEM).
+    assert _budget_v_block(40, 16, 8, 4, True) == 40
+    # vocab with no 128-multiple divisor: None (caller falls back to XLA).
+    assert _budget_v_block(32770, 512, 256, 2, False) is None
