@@ -107,6 +107,17 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
 # interpret mode — tests only, it is slow).
 _ATTENTION_BACKEND = ["auto"]
 
+# "auto" takes the flash kernel only past this (local) sequence length.
+# Measured on v5e (bf16, H=8, dh=64, fwd+bwd, 50-step avg): XLA's fused
+# attention wins short sequences — flash/XLA ratio 0.64x at B=64 T=256
+# (prefix-LM), 0.94-0.97x at T=128-512 — and flash wins past the crossover:
+# 1.24x at T=768, 1.55x at T=1024, 2.06x at T=2048, 3.4x end-to-end at
+# T=8192 (where un-remat'd XLA attention cannot fit one chip at all). At
+# short T the kernel's grid/stream overhead exceeds its HBM savings; the
+# quadratic score tensor is small enough for XLA to keep in registers/VMEM
+# through its own fusions. (perf_runs + PERF.md "auto dispatch", round 3.)
+FLASH_AUTO_MIN_SEQ = 640
+
 
 def set_attention_backend(backend: str) -> None:
     from ddlbench_tpu.config import ATTENTION_BACKENDS
@@ -139,6 +150,11 @@ def _flash_dispatch(*operands):
     # compiled kernels need 8-aligned sequence blocks (flash_attention.py
     # _pick_block); odd sequence lengths take the XLA einsum path
     if any(o.ndim >= 3 and o.shape[2] % 8 for o in operands):
+        return False, False
+    # short (local) sequences: XLA's own fused attention is faster than the
+    # kernel (FLASH_AUTO_MIN_SEQ note above); ring attention shares the rule
+    # on its per-shard block length
+    if max(o.shape[2] for o in operands if o.ndim >= 3) < FLASH_AUTO_MIN_SEQ:
         return False, False
     return pallas_partitions_safely(*operands), False
 

@@ -104,16 +104,32 @@ def _use_pallas(backend: str, *operands) -> bool:
 
 
 def _pallas_feasible(w, backend: str, interpret: bool) -> bool:
-    """Mosaic wants lane-dim blocks in multiples of 128: a vocab with no such
-    divisor can't run the compiled kernels. auto falls back to chunked-XLA;
-    a forced "pallas" backend gets a clear error instead of a Mosaic one."""
-    if interpret or _pick_block(w.shape[1], V_BLOCK, 128) is not None:
+    """Mosaic wants lane-dim blocks in multiples of 128 (a vocab with no
+    such divisor can't run the compiled kernels), and every kernel's block
+    working set must fit scoped VMEM even at the 128-lane floor — a very
+    wide D blows the dW accumulator alone (_budget_v_block -> None). auto
+    falls back to chunked-XLA; a forced "pallas" backend gets a clear error
+    instead of a Mosaic one."""
+    if interpret:
+        return True
+    D, V = w.shape
+    isz = w.dtype.itemsize
+    br = ROW_BLOCK  # conservative: actual br <= ROW_BLOCK, footprint grows with br
+    ok = (
+        _budget_v_block(V, D, br, isz, False) is not None  # fwd
+        and _budget_v_block(V, D, br, isz, False, per_bv=br * isz,
+                            fixed=br * D * (4 + 2 * isz)) is not None  # dh
+        and _budget_v_block(V, D, br, isz, False,
+                            per_bv=br * isz + 3 * D * 4) is not None  # dW
+    )
+    if ok:
         return True
     if backend == "pallas":
         raise ValueError(
-            f"fused_linear_xent: vocab {w.shape[1]} has no 128-multiple "
-            f"block divisor; pad the vocab to a multiple of 128 or use "
-            f"backend='xla'")
+            f"fused_linear_xent: no feasible Pallas blocking for head "
+            f"[D={D}, V={V}] — the vocab needs a 128-multiple block divisor "
+            f"and every kernel's block working set must fit scoped VMEM "
+            f"({VMEM_HARD >> 20} MiB); pad the vocab or use backend='xla'")
     return False
 
 
@@ -289,11 +305,15 @@ def fused_linear_xent_eval(h, w, labels, k: int = 5, row_chunk: int = 512):
 
 ROW_BLOCK = 256
 V_BLOCK = 2048
-# Per-kernel working-set ceiling. v5e gives ~16 MiB of scoped VMEM per core;
-# stay well under it so double-buffering + compiler temporaries fit (the dW
-# kernel at (br=256, bv=2048, D=512) measures 18.2 MiB on-chip and is
-# rejected by Mosaic, hence the budget-aware block choice below).
+# Per-kernel working-set target and hard ceiling. v5e gives ~16 MiB of
+# scoped VMEM per core; target well under it so double-buffering + compiler
+# temporaries fit (the dW kernel at (br=256, bv=2048, D=512) measures
+# 18.2 MiB on-chip and is rejected by Mosaic, hence the budget-aware block
+# choice below). A block between target and hard limit is best-effort
+# (returned, may still compile); past VMEM_HARD even the 128-lane floor
+# cannot fit and the caller must take the chunked-XLA path instead.
 VMEM_BUDGET = 12 * 1024 * 1024
+VMEM_HARD = 16 * 1024 * 1024
 
 
 def _pick_block(t: int, preferred: int, unit: int = 1) -> Optional[int]:
@@ -314,7 +334,13 @@ def _budget_v_block(V: int, D: int, br: int, in_size: int, interpret: bool,
     ``per_bv`` prices kernel-specific bytes per vocab lane (dz blocks, the
     dW kernel's f32 [D, bv] scratch + double-buffered f32 out block);
     ``fixed`` prices bv-independent extras (the dh kernel's [br, D] f32
-    accumulator and double-buffered out block)."""
+    accumulator and double-buffered out block).
+
+    Returns None when even the smallest lane-aligned block exceeds
+    VMEM_HARD (a very wide D — the bv-independent terms alone blow the
+    scoped-VMEM limit); the caller falls back to the chunked-XLA path via
+    _pallas_feasible. A pick between VMEM_BUDGET and VMEM_HARD is returned
+    best-effort."""
     bv = _pick_block(V, V_BLOCK, 1 if interpret else 128)
     if interpret or bv is None:
         return bv
@@ -328,6 +354,8 @@ def _budget_v_block(V: int, D: int, br: int, in_size: int, interpret: bool,
         if smaller is None or smaller == bv:
             break
         bv = smaller
+    if footprint(bv) > VMEM_HARD:
+        return None
     return bv
 
 

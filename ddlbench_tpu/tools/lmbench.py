@@ -39,7 +39,9 @@ def main(argv=None) -> int:
     p.add_argument("--label-smoothing", type=float, default=None)
     p.add_argument("--configs", default=None,
                    help="comma list among flash+fused,flash+logits,"
-                        "xla+fused,xla+logits (default: all)")
+                        "xla+fused,xla+logits,auto (default: the four "
+                        "forced cells; 'auto' measures the length-based "
+                        "dispatch a default run gets)")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -71,6 +73,11 @@ def main(argv=None) -> int:
         "flash+logits": ("flash", False),
         "xla+fused": ("xla", True),
         "xla+logits": ("xla", False),
+        # what a default run actually gets: the length-based dispatch
+        # (models/transformer.py FLASH_AUTO_MIN_SEQ) + fused head. Not in
+        # the default sweep (it duplicates one of the forced cells); use
+        # --configs auto to check the dispatch picks the winning backend.
+        "auto": ("auto", True),
     }
     on_tpu = is_tpu_backend()
     if args.configs:
@@ -81,7 +88,8 @@ def main(argv=None) -> int:
                     f"{sorted(all_configs)}")
     else:
         # flash off-TPU means interpret mode (minutes per step) — skip it
-        names = list(all_configs) if on_tpu else ["xla+fused", "xla+logits"]
+        sweep = [n for n in all_configs if n != "auto"]
+        names = sweep if on_tpu else ["xla+fused", "xla+logits"]
 
     def run_config(name: str, remat: bool):
         attn, fused = all_configs[name]

@@ -62,7 +62,7 @@ while [ "$(date +%s)" -lt "$DEADLINE" ]; do
     # slice sweeps further automatically)
     run_one scalebench_tpu     python -m ddlbench_tpu.tools.scalebench \
                                  -b imagenet -m resnet50 --devices 1 \
-                                 --strategies dp --steps 20
+                                 --strategies dp --steps 20 --repeats 3
     # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
     run_one heterobench_tpu    python -m ddlbench_tpu.tools.heterobench \
                                  -b mnist -m resnet18 --plan 2,2 --uneven 1,3

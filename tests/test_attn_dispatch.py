@@ -1,0 +1,84 @@
+"""The "auto" attention backend's dispatch policy (models/transformer.py).
+
+Pure shape/flag logic — testable off-TPU by monkeypatching the backend
+probe. Pins the round-3 measured rule: on TPU, auto takes the Pallas flash
+kernel only for 8-aligned local sequences past FLASH_AUTO_MIN_SEQ (XLA's
+fused attention wins shorter ones; see PERF.md "auto dispatch"), and the
+explicit "flash"/"xla" overrides bypass the heuristics entirely.
+"""
+
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlbench_tpu.models import transformer as tfm
+
+
+@pytest.fixture
+def on_tpu(monkeypatch):
+    import ddlbench_tpu.distributed as dist
+
+    monkeypatch.setattr(dist, "is_tpu_backend", lambda: True)
+
+
+def _qkv(T, B=2, H=4, dh=8):
+    x = jnp.zeros((B, H, T, dh), jnp.bfloat16)
+    return x, x, x
+
+
+def test_auto_short_seq_takes_xla(on_tpu):
+    use_flash, _ = tfm._flash_dispatch(*_qkv(256))
+    assert not use_flash
+
+
+def test_auto_long_seq_takes_flash(on_tpu):
+    use_flash, interpret = tfm._flash_dispatch(*_qkv(1024))
+    assert use_flash and not interpret
+
+
+def test_auto_threshold_boundary(on_tpu):
+    T = tfm.FLASH_AUTO_MIN_SEQ
+    assert tfm._flash_dispatch(*_qkv(T))[0]
+    assert not tfm._flash_dispatch(*_qkv(T - 8))[0]
+
+
+def test_auto_unaligned_seq_takes_xla(on_tpu):
+    use_flash, _ = tfm._flash_dispatch(*_qkv(1027))
+    assert not use_flash
+
+
+def test_forced_flash_ignores_threshold(on_tpu):
+    tfm.set_attention_backend("flash")
+    try:
+        use_flash, interpret = tfm._flash_dispatch(*_qkv(256))
+        assert use_flash and not interpret
+    finally:
+        tfm.set_attention_backend("auto")
+
+
+def test_forced_xla_ignores_length(on_tpu):
+    tfm.set_attention_backend("xla")
+    try:
+        assert not tfm._flash_dispatch(*_qkv(4096))[0]
+    finally:
+        tfm.set_attention_backend("auto")
+
+
+def test_off_tpu_auto_never_flash():
+    assert not tfm._flash_dispatch(*_qkv(4096))[0]
+
+
+def test_values_match_across_backends():
+    # policy change must not change numerics: xla vs flash-interpret on CPU
+    import jax
+
+    k1, k2, k3 = jax.random.split(jax.random.key(0), 3)
+    q = jax.random.normal(k1, (1, 2, 16, 8), jnp.float32)
+    k = jax.random.normal(k2, (1, 2, 16, 8), jnp.float32)
+    v = jax.random.normal(k3, (1, 2, 16, 8), jnp.float32)
+    ref = tfm.causal_attention(q, k, v)
+    from ddlbench_tpu.ops.flash_attention import flash_attention
+
+    out = flash_attention(q, k, v, interpret=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=2e-5, atol=2e-5)

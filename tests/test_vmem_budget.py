@@ -67,3 +67,23 @@ def test_interpret_and_odd_vocab_paths():
     assert _budget_v_block(40, 16, 8, 4, True) == 40
     # vocab with no 128-multiple divisor: None (caller falls back to XLA).
     assert _budget_v_block(32770, 512, 256, 2, False) is None
+
+
+def test_very_wide_d_returns_none():
+    # D=8192 bf16: the dW kernel's f32 accumulator + out block at the
+    # 128-lane floor alone exceed the 16 MiB hardware limit.
+    assert _budget_v_block(32768, 8192, 256, 2,
+                           False, **_dw_args(8192, 256, 2)) is None
+
+
+def test_feasibility_gate_falls_back_for_wide_d():
+    import jax.numpy as jnp
+    from ddlbench_tpu.ops.fused_xent import _pallas_feasible
+
+    ok = jnp.zeros((512, 32768), jnp.bfloat16)
+    wide = jnp.zeros((8192, 32768), jnp.bfloat16)
+    assert _pallas_feasible(ok, "auto", False)
+    assert not _pallas_feasible(wide, "auto", False)  # chunked-XLA fallback
+    import pytest as _pytest
+    with _pytest.raises(ValueError, match="no feasible Pallas blocking"):
+        _pallas_feasible(wide, "pallas", False)
