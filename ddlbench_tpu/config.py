@@ -67,6 +67,12 @@ DATASETS: Mapping[str, DatasetSpec] = {
     # LM context and a long-context stressor for sequence parallelism.
     "synthtext": DatasetSpec("synthtext", (1024,), 32_768, 100_000, 10_000, kind="tokens"),
     "longctx": DatasetSpec("longctx", (8192,), 32_768, 20_000, 2_000, kind="tokens"),
+    # 32k context: single-chip-trainable ONLY via the streaming flash
+    # kernels (ops/flash_attention.py round 3) + fused head — XLA attention
+    # would need a 2 GB score matrix per layer per 8k, and at 32k a single
+    # layer's matrix alone exceeds one chip's HBM even under remat.
+    "longctx32k": DatasetSpec("longctx32k", (32_768,), 32_768, 5_000, 500,
+                              kind="tokens"),
     # Synthetic translation: the seq2seq workload (reference GNMT analog,
     # SURVEY.md §2 C13) as a prefix-LM stream — 128 source + 128 target tokens
     # (reference GNMT trains at max seq length 50-75 per side; see
@@ -88,9 +94,9 @@ ATTENTION_BACKENDS = ("auto", "flash", "xla")
 # the global batch.
 DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
     "single": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-               "synthtext": 16, "longctx": 2, "synthmt": 64},
+               "synthtext": 16, "longctx": 2, "longctx32k": 1, "synthmt": 64},
     "dp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-           "synthtext": 16, "longctx": 2, "synthmt": 64},
+           "synthtext": 16, "longctx": 2, "longctx32k": 1, "synthmt": 64},
     "gpipe": {
         "mnist": (128, 24),
         "cifar10": (64, 32),
@@ -98,14 +104,15 @@ DEFAULT_BATCH: Mapping[str, Mapping[str, Any]] = {
         "highres": (4, 12),
         "synthtext": (4, 8),
         "longctx": (1, 8),
+        "longctx32k": (1, 4),
         "synthmt": (16, 8),
     },
     "pipedream": {"mnist": 512, "cifar10": 256, "imagenet": 128, "highres": 64,
-                  "synthtext": 64, "longctx": 8, "synthmt": 128},
+                  "synthtext": 64, "longctx": 8, "longctx32k": 4, "synthmt": 128},
     "sp": {"mnist": 128, "cifar10": 64, "imagenet": 32, "highres": 32,
-           "synthtext": 16, "longctx": 2, "synthmt": 32},
+           "synthtext": 16, "longctx": 2, "longctx32k": 1, "synthmt": 32},
     # ep: per-device batch (batch and experts both shard the one mesh axis)
-    "ep": {"synthtext": 8, "longctx": 1},
+    "ep": {"synthtext": 8, "longctx": 1, "longctx32k": 1},
 }
 
 

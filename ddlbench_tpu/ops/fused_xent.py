@@ -103,24 +103,26 @@ def _use_pallas(backend: str, *operands) -> bool:
     return pallas_partitions_safely(*operands)
 
 
-def _pallas_feasible(w, backend: str, interpret: bool) -> bool:
+def _pallas_feasible(h, w, backend: str, interpret: bool) -> bool:
     """Mosaic wants lane-dim blocks in multiples of 128 (a vocab with no
     such divisor can't run the compiled kernels), and every kernel's block
     working set must fit scoped VMEM even at the 128-lane floor — a very
-    wide D blows the dW accumulator alone (_budget_v_block -> None). auto
-    falls back to chunked-XLA; a forced "pallas" backend gets a clear error
+    wide D blows the dW accumulator alone (_budget_v_block -> None). The
+    budget is evaluated at the row block the kernels will actually use
+    (small row counts shrink it, and the dh fixed cost with it). auto falls
+    back to chunked-XLA; a forced "pallas" backend gets a clear error
     instead of a Mosaic one."""
     if interpret:
         return True
     D, V = w.shape
     isz = w.dtype.itemsize
-    br = ROW_BLOCK  # conservative: actual br <= ROW_BLOCK, footprint grows with br
+    br = _row_block(h.shape[0], interpret)
     ok = (
         _budget_v_block(V, D, br, isz, False) is not None  # fwd
-        and _budget_v_block(V, D, br, isz, False, per_bv=br * isz,
-                            fixed=br * D * (4 + 2 * isz)) is not None  # dh
         and _budget_v_block(V, D, br, isz, False,
-                            per_bv=br * isz + 3 * D * 4) is not None  # dW
+                            **_dh_price(D, br, isz)) is not None
+        and _budget_v_block(V, D, br, isz, False,
+                            **_dw_price(D, br, isz)) is not None
     )
     if ok:
         return True
@@ -152,7 +154,7 @@ def fused_linear_xent(h, w, labels, smoothing: float = 0.0,
 def _fxent_fwd(h, w, labels, smoothing: float, row_chunk: int, backend: str,
                interpret: bool):
     if (_use_pallas(backend, h, w, labels)
-            and _pallas_feasible(w, backend, interpret)):
+            and _pallas_feasible(h, w, backend, interpret)):
         return _fxent_fwd_pallas(h, w, labels, smoothing, interpret)
     N = h.shape[0]
     chunk = min(row_chunk, N)
@@ -187,7 +189,7 @@ def _fxent_bwd(smoothing: float, row_chunk: int, backend: str,
     go = go.astype(jnp.float32)
     gce = gce.astype(jnp.float32)
     if (_use_pallas(backend, h, w, labels)
-            and _pallas_feasible(w, backend, interpret)):
+            and _pallas_feasible(h, w, backend, interpret)):
         dh, dw = _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing,
                                    interpret)
     else:
@@ -357,6 +359,20 @@ def _budget_v_block(V: int, D: int, br: int, in_size: int, interpret: bool,
     if footprint(bv) > VMEM_HARD:
         return None
     return bv
+
+
+def _dh_price(D: int, br: int, in_size: int) -> dict:
+    """dh-kernel _budget_v_block terms: a dz block [br, bv] in the compute
+    dtype per lane, plus the bv-independent f32 [br, D] accumulator and
+    double-buffered [br, D] out block. One home for the formulas shared by
+    the feasibility gate, the kernel launch, and tests/test_vmem_budget.py."""
+    return dict(per_bv=br * in_size, fixed=br * D * (4 + 2 * in_size))
+
+
+def _dw_price(D: int, br: int, in_size: int) -> dict:
+    """dW-kernel terms: the dz block plus an f32 [D, bv] scratch accumulator
+    and a double-buffered f32 [D, bv] out block (3 * D * 4 bytes per lane)."""
+    return dict(per_bv=br * in_size + 3 * D * 4)
 
 
 def _row_block(n: int, interpret: bool) -> int:
@@ -533,16 +549,14 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
     Np = hp.shape[0]
     nr = Np // br
     # dh's accumulator + double-buffered out block are [br, D]
-    # (bv-independent, charged as ``fixed``); dW carries an f32 [D, bv]
-    # scratch plus a double-buffered f32 [D, bv] out block, so its lane
-    # block must shrink when D is wide (VMEM_BUDGET note above). Both
-    # recompute a dz block [br, bv] in the compute dtype.
+    # (bv-independent); dW carries an f32 [D, bv] scratch plus a
+    # double-buffered f32 [D, bv] out block, so its lane block must shrink
+    # when D is wide (VMEM_BUDGET note above; formulas in _dh/_dw_price).
     isz = h.dtype.itemsize
-    bv = _budget_v_block(V, D, br, isz, interpret,
-                         per_bv=br * isz, fixed=br * D * (4 + 2 * isz))
+    bv = _budget_v_block(V, D, br, isz, interpret, **_dh_price(D, br, isz))
     nv = V // bv
     bv_dw = _budget_v_block(V, D, br, isz, interpret,
-                            per_bv=br * isz + 3 * D * 4)
+                            **_dw_price(D, br, isz))
     nv_dw = V // bv_dw
     lab2 = lp[:, None].astype(jnp.int32)
     # padded rows: lse=0 with z=0 gives p=1 — masked to 0 by the label test

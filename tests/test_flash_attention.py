@@ -248,3 +248,49 @@ def test_lse_cotangent_flows():
     for a, b in zip(gf, gr):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b),
                                    rtol=2e-4, atol=2e-5)
+
+
+def test_streaming_design_matches_resident():
+    """The two grid designs (resident fori vs streaming 3D scratch) share
+    their block math and must agree bit-for-bit-close; the hybrid picks per
+    shape on TPU (flash_attention.py _use_streaming), so both paths need
+    coverage off-chip. Covers causal, offsets, and prefix-LM."""
+    B, H, T, dh = 1, 2, 48, 8
+    ks = jax.random.split(jax.random.key(11), 3)
+    q, k, v = (_rand((B, H, T, dh), kk) for kk in ks)
+
+    for pfx, qoff in ((0, 0), (16, 0), (0, 8)):
+        def f(q, k, v, stream):
+            o = flash_attention(q, k, v, qoff, 0, pfx, 16, 16, True, stream)
+            return jnp.sum(o ** 2)
+
+        with jax.default_matmul_precision("highest"):
+            vr, gr = jax.value_and_grad(
+                lambda *xs: f(*xs, False), argnums=(0, 1, 2))(q, k, v)
+            vs, gs = jax.value_and_grad(
+                lambda *xs: f(*xs, True), argnums=(0, 1, 2))(q, k, v)
+        np.testing.assert_allclose(float(vs), float(vr), rtol=1e-6)
+        for a, b in zip(gs, gr):
+            np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                       rtol=1e-5, atol=1e-6)
+
+
+def test_use_streaming_rule():
+    from ddlbench_tpu.ops.flash_attention import (RESIDENT_MAX_BYTES,
+                                                  _use_streaming)
+
+    # benchmarked shapes stay resident: T=8192, dh=64, bf16 = 2 MiB
+    assert not _use_streaming(8192, 64, 2, 512, 512, None)
+    assert not _use_streaming(1024, 64, 2, 512, 512, None)
+    # long context streams: T=16384, dh=64, bf16 = 4 MiB > 3 MiB
+    assert _use_streaming(16384, 64, 2, 512, 512, None)
+    # wide heads / f32 stream at 8k
+    assert _use_streaming(8192, 128, 2, 512, 512, None)
+    assert _use_streaming(8192, 64, 4, 512, 512, None)
+    # oversized blocks stream once the inner side is nontrivial (the
+    # measured 16.8 MiB Mosaic rejection at (256, 1024, T=8192))
+    assert _use_streaming(8192, 64, 2, 256, 1024, None)
+    assert not _use_streaming(1024, 64, 2, 1024, 1024, None)  # small T fine
+    # explicit override wins both ways
+    assert _use_streaming(64, 8, 2, 8, 8, True)
+    assert not _use_streaming(1 << 20, 64, 2, 512, 512, False)

@@ -7,15 +7,13 @@ rejection (perf_runs, round 3: 18.2 MiB > 16 MiB for the dW kernel at
 br=256, bv=2048, D=512). These tests pin the arithmetic off-chip.
 """
 
-from ddlbench_tpu.ops.fused_xent import VMEM_BUDGET, _budget_v_block
+from ddlbench_tpu.ops.fused_xent import (VMEM_BUDGET, _budget_v_block,
+                                         _dh_price, _dw_price)
 
-
-def _dh_args(D, br, isz):
-    return dict(per_bv=br * isz, fixed=br * D * (4 + 2 * isz))
-
-
-def _dw_args(D, br, isz):
-    return dict(per_bv=br * isz + 3 * D * 4)
+# the one set of pricing formulas, shared with the feasibility gate and the
+# kernel launch sites (ops/fused_xent.py)
+_dh_args = _dh_price
+_dw_args = _dw_price
 
 
 def _footprint(V, D, br, isz, bv, per_bv=0, fixed=0):
@@ -80,10 +78,29 @@ def test_feasibility_gate_falls_back_for_wide_d():
     import jax.numpy as jnp
     from ddlbench_tpu.ops.fused_xent import _pallas_feasible
 
+    rows = jnp.zeros((16384, 1), jnp.bfloat16)  # only shape[0] is read
     ok = jnp.zeros((512, 32768), jnp.bfloat16)
     wide = jnp.zeros((8192, 32768), jnp.bfloat16)
-    assert _pallas_feasible(ok, "auto", False)
-    assert not _pallas_feasible(wide, "auto", False)  # chunked-XLA fallback
+    assert _pallas_feasible(rows, ok, "auto", False)
+    assert not _pallas_feasible(rows, wide, "auto", False)  # chunked-XLA
     import pytest as _pytest
     with _pytest.raises(ValueError, match="no feasible Pallas blocking"):
-        _pallas_feasible(wide, "pallas", False)
+        _pallas_feasible(rows, wide, "pallas", False)
+
+
+def test_feasibility_gate_uses_actual_row_block():
+    """A wide head that only fits at a small row block must not be rejected
+    when the row count actually IS small (the gate prices the real br, not
+    the ROW_BLOCK ceiling)."""
+    import jax.numpy as jnp
+    from ddlbench_tpu.ops.fused_xent import _pallas_feasible
+
+    # D=6144 sits in the window where feasibility depends on br: the dW
+    # kernel's row-dependent input term pushes it past VMEM_HARD at br=256
+    # but not at br=64 (D=8192+ is infeasible at ANY br — the lane-
+    # independent f32 accumulator alone exceeds the limit).
+    wide = jnp.zeros((6144, 32768), jnp.bfloat16)
+    few_rows = jnp.zeros((64, 6144), jnp.bfloat16)
+    many_rows = jnp.zeros((16384, 6144), jnp.bfloat16)
+    assert _pallas_feasible(few_rows, wide, "auto", False)
+    assert not _pallas_feasible(many_rows, wide, "auto", False)
