@@ -29,8 +29,8 @@ import jax
 import jax.numpy as jnp
 
 from ddlbench_tpu.models.layers import (
-    Layer, LayerModel, Shape, conv_bn, dense, flatten, global_avg_pool,
-    max_pool)
+    Layer, LayerModel, Shape, avg_pool, conv_bn, dense, flatten,
+    global_avg_pool, max_pool, sep_conv_bn)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -264,9 +264,122 @@ def build_inception(arch: str, in_shape, num_classes: int) -> DagModel:
                     num_classes)
 
 
+# ---- nasnet family ---------------------------------------------------------
+#
+# NASNet-A-style cells (reference family: profiler/image_classification/
+# models/nasnet.py:1). The structural property that matters for the
+# partitioner is that every cell reads the previous TWO cell outputs — the
+# skip-over-a-cell edges make the graph NOT series-parallel (inception's
+# fan-out/fan-in modules are SP), so antichain partitioning and
+# is_series_parallel get a genuinely harder native workload. Depth/width are
+# reduced (documented mini, like build_inception); block wiring follows the
+# NASNet-A normal/reduction cells with the paired sep-conv applied once.
+
+
+def _add_nasnet_normal(layers, inputs, combine, prev: int, cur: int,
+                       name: str, ch: int) -> int:
+    """One normal cell reading (h_{i-2}=prev, h_{i-1}=cur); returns the
+    5-block concat node (5*ch channels)."""
+
+    def add(layer, preds, how=""):
+        return _append(layers, inputs, combine, layer, preds, how)
+
+    def pair(tag, left, right):
+        return add(_identity(f"{name}_{tag}"), [left, right], "add")
+
+    p = add(conv_bn(f"{name}_adjP", ch, kernel=1), [prev])
+    c = add(conv_bn(f"{name}_adjC", ch, kernel=1), [cur])
+    b1 = pair("b1", add(sep_conv_bn(f"{name}_b1_sep3", ch, 3), [c]), c)
+    b2 = pair("b2", add(sep_conv_bn(f"{name}_b2_sep3", ch, 3), [p]),
+              add(sep_conv_bn(f"{name}_b2_sep5", ch, 5), [c]))
+    b3 = pair("b3", add(avg_pool(f"{name}_b3_avg"), [c]), p)
+    b4 = pair("b4", add(avg_pool(f"{name}_b4_avgA"), [p]),
+              add(avg_pool(f"{name}_b4_avgB"), [p]))
+    b5 = pair("b5", add(sep_conv_bn(f"{name}_b5_sep5", ch, 5), [p]),
+              add(sep_conv_bn(f"{name}_b5_sep3", ch, 3), [p]))
+    return add(_identity(f"{name}_concat"), [b1, b2, b3, b4, b5], "concat")
+
+
+def _add_nasnet_reduction(layers, inputs, combine, prev: int, cur: int,
+                          name: str, ch: int) -> int:
+    """One reduction cell (spatial /2); returns the 4-block concat node
+    (4*ch channels)."""
+
+    def add(layer, preds, how=""):
+        return _append(layers, inputs, combine, layer, preds, how)
+
+    def pair(tag, left, right):
+        return add(_identity(f"{name}_{tag}"), [left, right], "add")
+
+    p = add(conv_bn(f"{name}_adjP", ch, kernel=1), [prev])
+    c = add(conv_bn(f"{name}_adjC", ch, kernel=1), [cur])
+    b1 = pair("b1", add(sep_conv_bn(f"{name}_b1_sep5", ch, 5, 2), [c]),
+              add(sep_conv_bn(f"{name}_b1_sep7", ch, 7, 2), [p]))
+    b2 = pair("b2", add(max_pool(f"{name}_b2_max", 3, 2, "SAME"), [c]),
+              add(sep_conv_bn(f"{name}_b2_sep7", ch, 7, 2), [p]))
+    b3 = pair("b3", add(avg_pool(f"{name}_b3_avg", 3, 2), [c]),
+              add(sep_conv_bn(f"{name}_b3_sep5", ch, 5, 2), [p]))
+    b4 = pair("b4", add(max_pool(f"{name}_b4_max", 3, 2, "SAME"), [c]),
+              add(sep_conv_bn(f"{name}_b4_sep3", ch, 3), [b1]))
+    return add(_identity(f"{name}_concat"), [b1, b2, b3, b4], "concat")
+
+
+_NASNET_SPECS = {
+    # (stem channels, cell filter count, cell sequence: N=normal, R=reduce;
+    # filters double at each reduction — NASNet-A scheme, reduced depth)
+    "nasnet": (32, 44, "NNRNNRNN"),
+    # tiny test variant
+    "nasnet_t": (8, 8, "NRN"),
+}
+
+
+def build_nasnet(arch: str, in_shape, num_classes: int) -> DagModel:
+    """NASNet-A-style mini as a declared DAG: stem, then cells over the
+    previous two cell outputs; prev is spatially adjusted with a strided
+    1x1 after each reduction (the paper's factorized reduction,
+    simplified)."""
+    stem_ch, ch, cells = _NASNET_SPECS[arch]
+    layers: List[Layer] = []
+    inputs: List[Tuple[int, ...]] = []
+    combine: List[str] = []
+
+    def add(layer, preds, how=""):
+        return _append(layers, inputs, combine, layer, preds, how)
+
+    small = in_shape[0] <= 64
+    stem = add(conv_bn("stem", stem_ch, kernel=3, stride=1 if small else 2),
+               [-1])
+    prev = cur = stem
+    prev_lags = False  # prev has 2x the spatial extent of cur
+    for i, kind in enumerate(cells):
+        if prev_lags:
+            prev = add(conv_bn(f"cell{i}_redP", ch, kernel=1, stride=2),
+                       [prev])
+            prev_lags = False
+        if kind == "R":
+            ch *= 2
+            out = _add_nasnet_reduction(layers, inputs, combine, prev, cur,
+                                        f"cell{i}", ch)
+            prev_lags = True
+        else:
+            out = _add_nasnet_normal(layers, inputs, combine, prev, cur,
+                                     f"cell{i}", ch)
+        prev, cur = cur, out
+    if prev_lags:
+        # the classifier only reads `cur`; nothing to adjust
+        pass
+    cur = add(global_avg_pool(), [cur])
+    cur = add(flatten(), [cur])
+    add(dense("fc", num_classes), [cur])
+    return DagModel(arch, layers, inputs, combine, tuple(in_shape),
+                    num_classes)
+
+
 def get_dag(arch: str, in_shape, num_classes: int):
     """The DAG form of a branchy zoo arch (None for chain archs) — used by
     the auto-partition path to profile the real dataflow graph."""
     if arch in _INCEPTION_BLOCKS:
         return build_inception(arch, in_shape, num_classes)
+    if arch in _NASNET_SPECS:
+        return build_nasnet(arch, in_shape, num_classes)
     return None

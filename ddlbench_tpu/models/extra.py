@@ -6,9 +6,10 @@ beyond the benchmarked trio — alexnet, lenet, squeezenet, resnext, densenet
 "+ unused ...") — kept so any of them can be profiled and partitioned. This
 module provides the same family as flat layer chains: every block is one
 pipeline-atomic Layer, so each model runs under every strategy and profiles
-into the partitioner like the core zoo. (inception/nasnet are omitted: like
-the reference, nothing benchmarks them, and their cell graphs add no new
-capability over the families here.)
+into the partitioner like the core zoo. (inception and nasnet live in
+models/branchy.py instead: their cell graphs ARE the new capability —
+declared DAGs profiled as real branchy graphs, series-parallel and
+non-series-parallel respectively.)
 
 Builders follow the torchvision architectures; small inputs (MNIST/CIFAR)
 get resolution-preserving stems like models/resnet.py.

@@ -289,6 +289,53 @@ def max_pool(name: str, window: int = 2, stride: int | None = None, padding: str
     return Layer(name, init, apply)
 
 
+def avg_pool(name: str, window: int = 3, stride: int = 1,
+             padding: str = "SAME") -> Layer:
+    """Average pooling (count includes SAME padding — torch
+    count_include_pad=True, the AvgPool2d default the reference's models
+    rely on)."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        oh, ow = _conv_out_hw(h, w, window, window, stride, padding)
+        return {}, {}, (oh, ow, c)
+
+    def apply(p, s, x, train):
+        y = lax.reduce_window(
+            x, 0.0, lax.add,
+            (1, window, window, 1), (1, stride, stride, 1), padding,
+        ) / float(window * window)
+        return y, s
+
+    return Layer(name, init, apply)
+
+
+def sep_conv_bn(name: str, out_ch: int, kernel: int = 3,
+                stride: int = 1) -> Layer:
+    """Depthwise-separable conv: relu -> depthwise kxk (stride) ->
+    pointwise 1x1 -> BN — the NASNet cell operation (one pass of the
+    paper's relu-sepconv-bn pair; the mini family applies it once)."""
+
+    def init(key, in_shape):
+        h, w, c = in_shape
+        k1, k2 = jax.random.split(key)
+        p = {"dw": _conv_kernel_init(k1, kernel, kernel, 1, c),
+             "pw": _conv_kernel_init(k2, 1, 1, c, out_ch)}
+        bn_p, bn_s = bn_init(out_ch)
+        p["bn"] = bn_p
+        oh, ow = _conv_out_hw(h, w, kernel, kernel, stride, "SAME")
+        return p, {"bn": bn_s}, (oh, ow, out_ch)
+
+    def apply(p, s, x, train):
+        y = jax.nn.relu(x)
+        y = conv2d(y, p["dw"], stride, groups=p["dw"].shape[-1])
+        y = conv2d(y, p["pw"], 1)
+        y, bn_s = batchnorm(p["bn"], s["bn"], y, train)
+        return y, {"bn": bn_s}
+
+    return Layer(name, init, apply)
+
+
 def global_avg_pool(name: str = "gap") -> Layer:
     def init(key, in_shape):
         h, w, c = in_shape

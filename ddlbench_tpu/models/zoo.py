@@ -15,7 +15,8 @@ from ddlbench_tpu.models.vgg import build_vgg
 
 MODEL_NAMES = ("resnet18", "resnet50", "resnet152", "vgg11", "vgg16",
                "mobilenetv2", "lenet", "alexnet", "squeezenet", "resnext50",
-               "densenet121", "inception", "transformer_t", "transformer_s",
+               "densenet121", "inception", "nasnet", "transformer_t",
+               "transformer_s",
                "transformer_m", "transformer_moe_s", "seq2seq_s", "seq2seq_m",
                "seq2seq_lstm_s")
 
@@ -51,13 +52,17 @@ def get_model(arch: str, dataset: str | DatasetSpec,
         return build_transformer(arch, spec.image_size, spec.num_classes)
     if spec.kind != "image":
         raise ValueError(f"{arch} requires an image dataset, got {spec.name}")
-    if arch.startswith("inception"):
-        # branchy DAG arch: strategies run the articulation-block chain form;
-        # the auto-partition path profiles the real DAG (models/branchy.py)
-        from ddlbench_tpu.models.branchy import build_inception, to_chain
+    if arch.startswith(("inception", "nasnet")):
+        # branchy DAG archs: strategies run the articulation-block chain
+        # form; the auto-partition path profiles the real DAG
+        # (models/branchy.py). nasnet's two-input cells make its DAG
+        # non-series-parallel, unlike inception's SP modules.
+        from ddlbench_tpu.models.branchy import get_dag, to_chain
 
-        return to_chain(build_inception(arch, spec.image_size,
-                                        spec.num_classes))
+        dag = get_dag(arch, spec.image_size, spec.num_classes)
+        if dag is None:
+            raise ValueError(f"unknown branchy arch {arch!r}")
+        return to_chain(dag)
     if arch.startswith("resnet"):
         return build_resnet(arch, spec.image_size, spec.num_classes)
     if arch.startswith("vgg"):

@@ -41,9 +41,10 @@ def test_dag_structure():
     assert cuts == [s for s, _ in spans[1:]]
 
 
-def test_dag_apply_matches_chain_form():
+@pytest.mark.parametrize("builder", ["inception", "nasnet"])
+def test_dag_apply_matches_chain_form(builder):
     """to_chain is a pure re-packaging: identical outputs."""
-    dag = _dag()
+    dag = _dag() if builder == "inception" else _nas_dag()
     chain = to_chain(dag)
     assert len(chain.layers) == len(block_spans(dag))
     x = jax.random.normal(jax.random.key(1), (2, *IN_SHAPE))
@@ -157,5 +158,64 @@ def test_auto_partition_branchy_cli(devices, capsys):
     ts = strat.init(jax.random.key(0))
     x = jax.random.normal(jax.random.key(4), (8, 32, 32, 3))
     y = jax.random.randint(jax.random.key(5), (8,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
+
+
+# ---- nasnet: the NON-series-parallel native workload -----------------------
+
+
+def _nas_dag():
+    from ddlbench_tpu.models.branchy import build_nasnet
+
+    return build_nasnet("nasnet_t", IN_SHAPE, NUM_CLASSES)
+
+
+def test_nasnet_profile_is_not_series_parallel():
+    """NASNet cells read the previous TWO cell outputs; the skip-over-a-cell
+    edges break series-parallelism — the antichain machinery's general-DAG
+    path is now load-bearing on a native profile (inception is SP)."""
+    dag = _nas_dag()
+    g = profile_dag(dag, batch_size=2, mode="flops")
+    assert not g.is_chain()
+    assert not g.is_series_parallel()
+    # the antichain DAG still builds for non-SP graphs (the partitioner's
+    # state space is antichains, not SP decompositions)
+    states, _ = g.antichain_dag()
+    assert len(states) > len(block_spans(dag))
+    # coarse articulation-block chain still covers all cost
+    chain = coarse_chain(g, dag)
+    assert chain.is_chain()
+    tot = sum(n.forward_compute_time for n in g.nodes.values())
+    tot_c = sum(n.forward_compute_time for n in chain.nodes.values())
+    assert abs(tot - tot_c) < 1e-9
+    # reference-text-format round-trip
+    from ddlbench_tpu.graph.graph import Graph
+
+    Graph.from_str(str(g)).check_isomorphism(g)
+
+
+@pytest.mark.slow
+def test_nasnet_partition_and_execute(devices):
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+    from ddlbench_tpu.partition.optimizer import partition_hierarchical
+
+    dag = _nas_dag()
+    g = profile_dag(dag, batch_size=4, mode="flops")
+    chain_graph = coarse_chain(g, dag)
+    plan = partition_hierarchical(chain_graph, 2, memory_check=False)
+    bounds = plan.stage_bounds()
+    assert bounds[0] == 0 and bounds[-1] == len(chain_graph.nodes)
+
+    model = to_chain(dag)
+    cfg = RunConfig(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                    num_devices=2, num_stages=2, micro_batch_size=2,
+                    num_microbatches=2, compute_dtype="float32",
+                    momentum=0.0, weight_decay=0.0)
+    x = jax.random.normal(jax.random.key(2), (4, *IN_SHAPE))
+    y = jax.random.randint(jax.random.key(3), (4,), 0, NUM_CLASSES)
+    strat = GPipeStrategy(model, cfg, devices=devices[:2],
+                          stage_bounds=bounds)
+    ts = strat.init(jax.random.key(0))
     ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
