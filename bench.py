@@ -52,6 +52,10 @@ def main() -> int:
     p.add_argument("--batch-size", type=int, default=128)
     p.add_argument("--steps", type=int, default=30)
     p.add_argument("--warmup", type=int, default=5)
+    p.add_argument("--repeats", type=int, default=3,
+                   help="timed loops; the reported value is the median (the "
+                        "shared TPU tunnel's throughput swings +-20-45%% run "
+                        "to run — PERF.md, scalebench item 6)")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--quick", action="store_true", help="tiny run for smoke testing")
     p.add_argument("--probe-timeout-s", type=float, default=180.0)
@@ -117,7 +121,11 @@ def main() -> int:
         ts, m = step_fn(ts, bx, by, lr)
         return m
 
-    dt = timed_steps(run_step, data.batch, args.steps, args.warmup)
+    import statistics
+
+    dt = statistics.median(
+        timed_steps(run_step, data.batch, args.steps, args.warmup)
+        for _ in range(max(1, args.repeats)))
 
     ips = args.steps * args.batch_size / dt
     record = {

@@ -277,9 +277,10 @@ def build_inception(arch: str, in_shape, num_classes: int) -> DagModel:
 
 
 def _add_nasnet_normal(layers, inputs, combine, prev: int, cur: int,
-                       name: str, ch: int) -> int:
+                       name: str, ch: int, adj_stride: int = 1) -> int:
     """One normal cell reading (h_{i-2}=prev, h_{i-1}=cur); returns the
-    5-block concat node (5*ch channels)."""
+    5-block concat node (5*ch channels). ``adj_stride=2`` folds the
+    factorized reduction of a lagging prev into its 1x1 adjust."""
 
     def add(layer, preds, how=""):
         return _append(layers, inputs, combine, layer, preds, how)
@@ -287,7 +288,7 @@ def _add_nasnet_normal(layers, inputs, combine, prev: int, cur: int,
     def pair(tag, left, right):
         return add(_identity(f"{name}_{tag}"), [left, right], "add")
 
-    p = add(conv_bn(f"{name}_adjP", ch, kernel=1), [prev])
+    p = add(conv_bn(f"{name}_adjP", ch, kernel=1, stride=adj_stride), [prev])
     c = add(conv_bn(f"{name}_adjC", ch, kernel=1), [cur])
     b1 = pair("b1", add(sep_conv_bn(f"{name}_b1_sep3", ch, 3), [c]), c)
     b2 = pair("b2", add(sep_conv_bn(f"{name}_b2_sep3", ch, 3), [p]),
@@ -301,9 +302,9 @@ def _add_nasnet_normal(layers, inputs, combine, prev: int, cur: int,
 
 
 def _add_nasnet_reduction(layers, inputs, combine, prev: int, cur: int,
-                          name: str, ch: int) -> int:
+                          name: str, ch: int, adj_stride: int = 1) -> int:
     """One reduction cell (spatial /2); returns the 4-block concat node
-    (4*ch channels)."""
+    (4*ch channels). ``adj_stride`` as in _add_nasnet_normal."""
 
     def add(layer, preds, how=""):
         return _append(layers, inputs, combine, layer, preds, how)
@@ -311,7 +312,7 @@ def _add_nasnet_reduction(layers, inputs, combine, prev: int, cur: int,
     def pair(tag, left, right):
         return add(_identity(f"{name}_{tag}"), [left, right], "add")
 
-    p = add(conv_bn(f"{name}_adjP", ch, kernel=1), [prev])
+    p = add(conv_bn(f"{name}_adjP", ch, kernel=1, stride=adj_stride), [prev])
     c = add(conv_bn(f"{name}_adjC", ch, kernel=1), [cur])
     b1 = pair("b1", add(sep_conv_bn(f"{name}_b1_sep5", ch, 5, 2), [c]),
               add(sep_conv_bn(f"{name}_b1_sep7", ch, 7, 2), [p]))
@@ -352,22 +353,21 @@ def build_nasnet(arch: str, in_shape, num_classes: int) -> DagModel:
     prev = cur = stem
     prev_lags = False  # prev has 2x the spatial extent of cur
     for i, kind in enumerate(cells):
-        if prev_lags:
-            prev = add(conv_bn(f"cell{i}_redP", ch, kernel=1, stride=2),
-                       [prev])
-            prev_lags = False
+        # a lagging prev (last cell was a reduction) is spatially adjusted
+        # by striding its own 1x1 adjust — the paper's factorized
+        # reduction, folded into the cell
+        adj = 2 if prev_lags else 1
         if kind == "R":
             ch *= 2
             out = _add_nasnet_reduction(layers, inputs, combine, prev, cur,
-                                        f"cell{i}", ch)
-            prev_lags = True
+                                        f"cell{i}", ch, adj_stride=adj)
         else:
             out = _add_nasnet_normal(layers, inputs, combine, prev, cur,
-                                     f"cell{i}", ch)
+                                     f"cell{i}", ch, adj_stride=adj)
+        prev_lags = kind == "R"
         prev, cur = cur, out
-    if prev_lags:
-        # the classifier only reads `cur`; nothing to adjust
-        pass
+    # after a final reduction the classifier only reads `cur`; a lagging
+    # prev needs no adjustment
     cur = add(global_avg_pool(), [cur])
     cur = add(flatten(), [cur])
     add(dense("fc", num_classes), [cur])

@@ -1,0 +1,23 @@
+"""attnbench tool: the flash/XLA crossover sweep runs end-to-end on CPU."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
+
+def test_attnbench_runs(capsys):
+    from ddlbench_tpu.tools.attnbench import main
+
+    rc = main(["--seq-lens", "16,32", "--batch", "1", "--heads", "2",
+               "--head-dim", "8", "--steps", "2", "--dtype", "float32"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    assert [l["T"] for l in lines] == [16, 32]
+    for l in lines:
+        # off-TPU only the XLA cell runs (flash would be interpret-slow)
+        assert "xla_ms" in l and l["xla_ms"] > 0
+        assert "flash_ms" not in l and "flash_speedup" not in l
+        assert l["prefix"] == 0 and l["B"] == 1
