@@ -23,7 +23,7 @@ the coarse block chain map 1:1 onto the chain model's layers.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Sequence, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -262,6 +262,120 @@ def build_inception(arch: str, in_shape, num_classes: int) -> DagModel:
     add(dense("fc", num_classes), [cur])
     return DagModel(arch, layers, inputs, combine, tuple(in_shape),
                     num_classes)
+
+
+# ---- packed chain form: multi-tensor pipeline boundaries -------------------
+
+
+def crossing_ids(model: DagModel, p: int) -> List[int]:
+    """Ids whose output crosses the cut before node ``p`` (consumed by some
+    node >= p); -1 is the model input. Sorted ascending."""
+    n = len(model.layers)
+    return sorted({pid for j in range(p, n) for pid in model.inputs[j]
+                   if pid < p})
+
+
+def _flat_size(shape: Shape) -> int:
+    out = 1
+    for d in shape:
+        out *= int(d)
+    return out
+
+
+def to_packed_chain(model: DagModel, cuts: Sequence[int],
+                    out_shapes: Optional[Sequence[Shape]] = None
+                    ) -> LayerModel:
+    """Chain form with ARBITRARY cut positions: every tensor crossing a cut
+    is flattened and concatenated into ONE [B, N] boundary buffer, which the
+    next span unpacks. This is the TPU-native answer to the reference
+    runtime's multi-tensor stage edges (StageRuntime sends each crossing
+    tensor separately, runtime.py:193-223): the engines' single-activation
+    pipeline machinery (buffers, ppermute, conveyor) runs unchanged, and a
+    cut no longer needs to be an articulation position — nasnet's cell
+    stack, where two tensors cross every cell boundary, partitions at cell
+    (or any) granularity instead of packing into one block (to_chain).
+
+    ``cuts`` are node positions strictly inside (0, n); the result has
+    len(cuts)+1 composite layers, one per span, with stage_bounds
+    [0, 1, ..., len(cuts)+1] mapping spans to stages 1:1. ``out_shapes``
+    (per-node output shapes) skips the shape-inference init when the
+    caller already has them (profile_dag(return_shapes=True)).
+    """
+    n = len(model.layers)
+    cuts = sorted(set(int(c) for c in cuts))
+    assert all(0 < c < n for c in cuts), f"cuts {cuts} outside (0, {n})"
+    assert model.input_kind == "float", (
+        "packed boundaries concatenate in the compute dtype; token inputs "
+        "(int ids) would need a cast-free side channel")
+    if out_shapes is None:
+        # one shape-inference pass; shapes are key-independent
+        _, _, out_shapes = init_dag(model, jax.random.key(0))
+
+    def shape_of(pid: int) -> Shape:
+        return model.in_shape if pid < 0 else tuple(out_shapes[pid])
+
+    bounds = [0, *cuts, n]
+    span_layers: List[Layer] = []
+    for k in range(len(bounds) - 1):
+        a, b = bounds[k], bounds[k + 1]
+        in_ids = crossing_ids(model, a) if a > 0 else [-1]
+        out_ids = crossing_ids(model, b) if b < n else None
+        span_layers.append(
+            _packed_span(model, a, b, in_ids, out_ids, shape_of))
+    return LayerModel(f"{model.name}_packed", span_layers, model.in_shape,
+                      model.num_classes, input_kind=model.input_kind)
+
+
+def _packed_span(model: DagModel, a: int, b: int, in_ids: List[int],
+                 out_ids, shape_of) -> Layer:
+    """Composite Layer for DAG span [a, b): unpack crossing inputs, run the
+    span's nodes, pack crossing outputs (final span returns raw output)."""
+    in_shapes = [shape_of(i) for i in in_ids]
+    in_sizes = [_flat_size(s) for s in in_shapes]
+
+    def init(key, in_shape):
+        if a > 0:
+            assert tuple(in_shape) == (sum(in_sizes),), (in_shape, in_sizes)
+        ps, ss = [], []
+        for i in range(a, b):
+            key, sub = jax.random.split(key)
+            node_in = _combined_shape(
+                [shape_of(p) for p in model.inputs[i]], model.combine[i])
+            p_, s_, o_ = model.layers[i].init(sub, node_in)
+            assert tuple(o_) == shape_of(i), (i, o_, shape_of(i))
+            ps.append(p_)
+            ss.append(s_)
+        if out_ids is None:
+            out_sh = shape_of(b - 1)
+        else:
+            out_sh = (sum(_flat_size(shape_of(i)) for i in out_ids),)
+        return ps, ss, out_sh
+
+    def apply(params, states, x, train):
+        B = x.shape[0]
+        env = {}
+        if a == 0:
+            env[-1] = x
+        else:
+            off = 0
+            for pid, sh, sz in zip(in_ids, in_shapes, in_sizes):
+                env[pid] = x[:, off:off + sz].reshape(B, *sh)
+                off += sz
+        new_states = []
+        for idx, i in enumerate(range(a, b)):
+            xin = _combine([env[p] for p in model.inputs[i]],
+                           model.combine[i])
+            y, ns = model.layers[i].apply(params[idx], states[idx], xin,
+                                          train)
+            env[i] = y
+            new_states.append(ns)
+        if out_ids is None:
+            return env[b - 1], new_states
+        packed = jnp.concatenate(
+            [env[i].reshape(B, -1) for i in out_ids], axis=1)
+        return packed, new_states
+
+    return Layer(f"{model.name}_span{a}_{b}", init, apply)
 
 
 # ---- nasnet family ---------------------------------------------------------

@@ -66,14 +66,23 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         if dag is not None:
             # branchy arch: profile the REAL dataflow DAG (the reference
             # traces these with TensorWrapper, graph_creator.py:55-195),
-            # then aggregate to the articulation-block chain the engines
-            # execute — partition bounds land 1:1 on the chain model's
-            # layers (models/branchy.py)
-            from ddlbench_tpu.profiler.profile import coarse_chain, profile_dag
+            # then chainize it at NODE granularity with packed-crossing
+            # boundary sizes — the partitioner may cut at any position
+            # (incl. non-articulation cuts where several tensors cross,
+            # e.g. between nasnet cells) and the chosen cuts are executed
+            # via branchy.to_packed_chain below
+            from ddlbench_tpu.profiler.profile import (packed_chain_graph,
+                                                       profile_dag)
 
-            dag_graph = profile_dag(dag, mb, mode=cfg.profile_mode,
-                                    hw=cfg.hardware)
-            graph = coarse_chain(dag_graph, dag)
+            cdtype = jax.numpy.dtype(cfg.compute_dtype)
+            dag_graph, dag_shapes = profile_dag(
+                dag, mb, mode=cfg.profile_mode, dtype=cdtype,
+                hw=cfg.hardware, return_shapes=True)
+            # one itemsize everywhere: the profile's activation sizes and
+            # the input-crossing bytes below must share units for the DP's
+            # cut comparison to be meaningful
+            graph = packed_chain_graph(dag_graph, dag, mb,
+                                       itemsize=cdtype.itemsize)
             if input_time_ms > 0.0:
                 # fold_input_node semantics: data loading prices into the
                 # stage hosting block 0
@@ -148,6 +157,17 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                     f"falling back to balanced bounds {stage_bounds}",
                     flush=True,
                 )
+        if dag is not None:
+            # execute the chosen node-position cuts: one packed composite
+            # span per chunk, boundaries carry every crossing tensor in one
+            # flat buffer (branchy.to_packed_chain docstring)
+            from ddlbench_tpu.models.branchy import to_packed_chain
+
+            model = to_packed_chain(dag, stage_bounds[1:-1],
+                                    out_shapes=dag_shapes)
+            stage_bounds = list(range(len(model.layers) + 1))
+            print(f"auto-partition: packed-boundary chain, "
+                  f"{len(model.layers)} spans", flush=True)
         if cfg.strategy == "gpipe":
             from ddlbench_tpu.partition.schedule import recommend_virtual_stages
 

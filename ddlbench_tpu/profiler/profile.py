@@ -232,13 +232,17 @@ def profile_dag(
     hw: Optional[HardwareModel] = None,
     repeats: int = 5,
     seed: int = 0,
+    return_shapes: bool = False,
 ) -> Graph:
     """Profile a DagModel (models/branchy.py) node by node; returns the REAL
     branchy Graph — node ids are layer indices, edges are the declared
     dataflow. The native analog of the reference's TensorWrapper tracer
     (graph_creator.py:55-195), which is how its branchy profiles
     (resnext50_generated.txt, the inception family) come to exist. Each
-    node's cost includes its input-combine (concat/add) op."""
+    node's cost includes its input-combine (concat/add) op. With
+    ``return_shapes`` also returns the per-node output shapes (so callers
+    like the auto-partition path can build to_packed_chain without
+    re-initializing the model)."""
     from ddlbench_tpu.models.branchy import init_dag
 
     hw = hw or HardwareModel()
@@ -279,15 +283,20 @@ def profile_dag(
         for pr in model.inputs[idx]:
             if pr >= 0:
                 g.add_edge(str(pr), str(idx))
+    if return_shapes:
+        return g, [tuple(s) for s in out_shapes]
     return g
 
 
 def coarse_chain(graph: Graph, model) -> Graph:
     """Aggregate a DAG profile into the chain of its articulation blocks
     (models/branchy.block_spans): summed compute/params per block, boundary
-    activation = the single tensor crossing each cut. The chain the
-    partitioner runs on; its node index k IS layer k of
-    branchy.to_chain(model), so stage bounds transfer 1:1."""
+    activation = the single tensor crossing each cut. Its node index k IS
+    layer k of branchy.to_chain(model), so stage bounds transfer 1:1.
+    Library/reporting view: the auto-partition path uses the finer
+    packed_chain_graph below instead (cuts anywhere, packed boundaries);
+    this is the profile view matching the default (to_chain) execution
+    form that non-auto runs use."""
     from ddlbench_tpu.models.branchy import block_spans
 
     spans = block_spans(model)
@@ -307,6 +316,40 @@ def coarse_chain(graph: Graph, model) -> Graph:
             nd.activation_size = graph.nodes[str(src)].activation_size
         else:
             nd.activation_size = graph.nodes[str(b - 1)].activation_size
+        chain_nodes.append(nd)
+    return Graph.chain(chain_nodes)
+
+
+def packed_chain_graph(graph: Graph, model, batch_size: int,
+                       itemsize: int = 4) -> Graph:
+    """Node-granular chainized view of a DAG profile for topo-prefix cuts.
+
+    Node i keeps its measured cost/params; its activation_size becomes the
+    PACKED bytes crossing the cut after it — the sum of every tensor (incl.
+    the model input, when consumed later) flowing from [0, i] to [i+1, n).
+    A cut at any position is then executable via branchy.to_packed_chain
+    (one flat boundary buffer per cut), so the partitioner prices and the
+    runtime executes the same boundaries — the reference's multi-tensor
+    stage edges (StageRuntime, runtime.py:193-223), TPU-form. The chain
+    shape also keeps the native C++ DP applicable."""
+    from ddlbench_tpu.models.branchy import crossing_ids
+
+    n = len(model.layers)
+    in_bytes = float(batch_size) * _prod(model.in_shape) * itemsize
+    chain_nodes = []
+    for i in range(n):
+        src = graph.nodes[str(i)]
+        nd = Node(str(i), node_desc=src.node_desc,
+                  forward_compute_time=src.forward_compute_time,
+                  backward_compute_time=src.backward_compute_time,
+                  parameter_size=src.parameter_size)
+        if i < n - 1:
+            nd.activation_size = sum(
+                in_bytes if pid < 0
+                else graph.nodes[str(pid)].activation_size
+                for pid in crossing_ids(model, i + 1))
+        else:
+            nd.activation_size = src.activation_size
         chain_nodes.append(nd)
     return Graph.chain(chain_nodes)
 

@@ -219,3 +219,85 @@ def test_nasnet_partition_and_execute(devices):
     ts = strat.init(jax.random.key(0))
     ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
+
+
+# ---- packed boundaries: cuts anywhere, multi-tensor edges ------------------
+
+
+@pytest.mark.parametrize("builder,cuts", [
+    ("inception", [3, 9, 10, 17]),   # cuts inside a module (non-articulation)
+    ("nasnet", [1, 14, 27, 40]),     # cuts between/inside two-input cells
+])
+def test_packed_chain_matches_dag(builder, cuts):
+    """to_packed_chain executes ANY cut: every crossing tensor rides one
+    flat boundary buffer (the reference's multi-tensor stage edges,
+    runtime.py:193-223, TPU-form)."""
+    from ddlbench_tpu.models.branchy import crossing_ids, to_packed_chain
+
+    dag = _dag() if builder == "inception" else _nas_dag()
+    n = len(dag.layers)
+    chain = to_packed_chain(dag, cuts)
+    assert len(chain.layers) == len(cuts) + 1
+    # at least one chosen cut is NOT an articulation position
+    assert any(len(crossing_ids(dag, c)) > 1 for c in cuts)
+
+    x = jax.random.normal(jax.random.key(1), (2, *IN_SHAPE))
+    pd, sd, _ = init_dag(dag, jax.random.key(0))
+    bounds = [0, *cuts, n]
+    pc = [[pd[i] for i in range(bounds[k], bounds[k + 1])]
+          for k in range(len(bounds) - 1)]
+    sc = [[sd[i] for i in range(bounds[k], bounds[k + 1])]
+          for k in range(len(bounds) - 1)]
+    yd, _ = apply_dag(dag, pd, sd, x, False)
+    yc, _ = apply_model(chain, pc, sc, x, False)
+    np.testing.assert_allclose(np.asarray(yd), np.asarray(yc),
+                               rtol=1e-5, atol=1e-5)
+    # chain-form init agrees on boundary and output shapes
+    _, _, shapes = init_model(chain, jax.random.key(0))
+    assert shapes[-1] == (NUM_CLASSES,)
+
+
+def test_packed_chain_graph_prices_crossing_bytes():
+    """The chainized profile's activation_size at each cut equals the
+    packed bytes to_packed_chain would ship there."""
+    from ddlbench_tpu.models.branchy import crossing_ids
+    from ddlbench_tpu.profiler.profile import packed_chain_graph
+
+    dag = _nas_dag()
+    g = profile_dag(dag, batch_size=2, mode="flops")
+    pc = packed_chain_graph(g, dag, 2, itemsize=4)
+    assert pc.is_chain()
+    assert len(pc.nodes) == len(dag.layers)
+    # spot-check one interior cut
+    p = len(dag.layers) // 2
+    expect = sum(
+        2 * 4 * int(np.prod(dag.in_shape)) if pid < 0
+        else g.nodes[str(pid)].activation_size
+        for pid in crossing_ids(dag, p))
+    assert pc.nodes[str(p - 1)].activation_size == pytest.approx(expect)
+    # compute/params conserved
+    for field in ("forward_compute_time", "parameter_size"):
+        assert (sum(getattr(n, field) for n in g.nodes.values())
+                == pytest.approx(sum(getattr(n, field)
+                                     for n in pc.nodes.values())))
+
+
+@pytest.mark.slow
+def test_nasnet_auto_partition_packed_execute(devices, capsys):
+    """make_strategy on a branchy arch: node-granular partition over packed
+    boundaries, executed — cuts may land inside the cell stack, which the
+    articulation chain could never split."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                    num_devices=2, auto_partition=True,
+                    micro_batch_size=4, num_microbatches=2,
+                    compute_dtype="float32", profile_mode="flops")
+    strat = make_strategy(cfg)
+    out = capsys.readouterr().out
+    assert "packed-boundary chain" in out
+    ts = strat.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(4), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(5), (8,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
