@@ -1,41 +1,28 @@
 #!/usr/bin/env bash
-# Opportunistic TPU measurement collector.
-#
-# The axon TPU tunnel is intermittently available (it can hang device init
-# for hours, then come back). This script loops: probe the tunnel with a
-# hard timeout; when it is up, run every measurement that has not yet
-# succeeded, saving each tool's stdout under perf_runs/. Thanks to the
-# persistent XLA compilation cache (distributed.enable_compilation_cache) a
-# run that dies mid-compile resumes cheaply on the next window.
+# Opportunistic TPU measurement collector: the round's full pending list
+# (headline bench, lmbench sweeps, decodebench, scaling anchor, hetero A/B).
+# Window-catching machinery lives in tpu_window_lib.sh.
 #
 # Usage: scripts/tpu_grab.sh [max_hours]
 set -u
 cd "$(dirname "$0")/.."
-OUT=perf_runs
-mkdir -p "$OUT"
-MAX_HOURS=${1:-9}
-DEADLINE=$(( $(date +%s) + MAX_HOURS * 3600 ))
+. scripts/tpu_window_lib.sh
 
-probe() {
-  # -s KILL: a client hung inside the axon plugin holds the GIL in a C call
-  # and ignores SIGTERM; a lingering hung client can block jax import in
-  # EVERY other process on the machine, so it must die hard and fast.
-  timeout -s KILL 90 python -c \
-    "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1
-}
-
-run_one() {  # name cmd...
-  local name=$1; shift
-  [ -e "$OUT/$name.ok" ] && return 0
-  echo "[tpu_grab $(date +%H:%M:%S)] running $name" >&2
-  if timeout -k 30 2400 "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"; then
-    mv "$OUT/$name.out" "$OUT/$name.json"
-    : > "$OUT/$name.ok"
-    echo "[tpu_grab] $name OK" >&2
-  else
-    echo "[tpu_grab] $name failed (rc=$?); tail of stderr:" >&2
-    tail -3 "$OUT/$name.err" >&2
-  fi
+tasks() {
+  run_one bench              python bench.py --probe-timeout-s 60
+  run_one lmbench_synthtext  python -m ddlbench_tpu.tools.lmbench -b synthtext
+  run_one lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
+  run_one lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s
+  run_one decodebench        python -m ddlbench_tpu.tools.decodebench
+  # scaling-curve anchor: the on-chip points scalebench can measure on the
+  # attached slice (1 chip -> the per-chip single/dp anchors; a larger
+  # slice sweeps further automatically)
+  run_one scalebench_tpu     python -m ddlbench_tpu.tools.scalebench \
+                               -b imagenet -m resnet50 --devices 1 \
+                               --strategies dp --steps 20 --repeats 3
+  # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
+  run_one heterobench_tpu    python -m ddlbench_tpu.tools.heterobench \
+                               -b mnist -m resnet18 --plan 2,2 --uneven 1,3
 }
 
 all_done() {
@@ -46,30 +33,4 @@ all_done() {
   return 0
 }
 
-while [ "$(date +%s)" -lt "$DEADLINE" ]; do
-  if all_done; then
-    echo "[tpu_grab] all measurements collected" >&2
-    exit 0
-  fi
-  if probe; then
-    run_one bench              python bench.py --probe-timeout-s 60
-    run_one lmbench_synthtext  python -m ddlbench_tpu.tools.lmbench -b synthtext
-    run_one lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
-    run_one lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s
-    run_one decodebench        python -m ddlbench_tpu.tools.decodebench
-    # scaling-curve anchor: the on-chip points scalebench can measure on the
-    # attached slice (1 chip -> the per-chip single/dp anchors; a larger
-    # slice sweeps further automatically)
-    run_one scalebench_tpu     python -m ddlbench_tpu.tools.scalebench \
-                                 -b imagenet -m resnet50 --devices 1 \
-                                 --strategies dp --steps 20 --repeats 3
-    # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
-    run_one heterobench_tpu    python -m ddlbench_tpu.tools.heterobench \
-                                 -b mnist -m resnet18 --plan 2,2 --uneven 1,3
-  else
-    echo "[tpu_grab $(date +%H:%M:%S)] tunnel down; sleeping" >&2
-    sleep 540
-  fi
-done
-echo "[tpu_grab] deadline reached" >&2
-all_done
+window_loop "${1:-9}" all_done tasks

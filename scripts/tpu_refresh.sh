@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# Round-3 final-kernel refresh: re-measure the headline and the lmbench
+# sweeps against the FINAL hybrid flash kernels + auto dispatch, so the
+# committed artifacts reflect the shipped code (the originals were captured
+# mid-round, before the hybrid refactor — same resident design, but fresh
+# numbers close the loop).
+#
+# Usage: scripts/tpu_refresh.sh [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+tasks() {
+  run_one bench_final             python bench.py
+  run_one lmbench_synthtext_final python -m ddlbench_tpu.tools.lmbench \
+                                    -b synthtext --configs \
+                                    flash+fused,flash+logits,xla+fused,xla+logits,auto
+  run_one lmbench_longctx_final   python -m ddlbench_tpu.tools.lmbench -b longctx
+}
+
+all_done() {
+  for n in bench_final lmbench_synthtext_final lmbench_longctx_final; do
+    [ -e "$OUT/$n.ok" ] || return 1
+  done
+  return 0
+}
+
+window_loop "${1:-8}" all_done tasks

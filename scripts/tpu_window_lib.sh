@@ -1,0 +1,53 @@
+# Shared tunnel-window machinery for the opportunistic TPU measurement
+# collectors (tpu_grab.sh, tpu_refresh.sh). Source this, define tasks with
+# run_one, and drive the loop with window_loop <max_hours> <all_done_fn>
+# <run_tasks_fn>.
+#
+# The axon TPU tunnel is intermittently available (device init can hang for
+# hours, then come back). Discipline: probe with a hard timeout; when up,
+# run every not-yet-succeeded task, saving stdout under perf_runs/. The
+# persistent XLA compilation cache makes a run that dies mid-compile resume
+# cheaply on the next window.
+
+OUT=perf_runs
+mkdir -p "$OUT"
+
+probe() {
+  # -s KILL: a client hung inside the axon plugin holds the GIL in a C call
+  # and ignores SIGTERM; a lingering hung client can block jax import in
+  # EVERY other process on the machine, so it must die hard and fast.
+  timeout -s KILL 90 python -c \
+    "import jax; assert jax.devices()[0].platform == 'tpu'" >/dev/null 2>&1
+}
+
+run_one() {  # name cmd...
+  local name=$1; shift
+  [ -e "$OUT/$name.ok" ] && return 0
+  echo "[tpu_window $(date +%H:%M:%S)] running $name" >&2
+  if timeout -k 30 2400 "$@" > "$OUT/$name.out" 2> "$OUT/$name.err"; then
+    mv "$OUT/$name.out" "$OUT/$name.json"
+    : > "$OUT/$name.ok"
+    echo "[tpu_window] $name OK" >&2
+  else
+    echo "[tpu_window] $name failed (rc=$?); tail of stderr:" >&2
+    tail -3 "$OUT/$name.err" >&2
+  fi
+}
+
+window_loop() {  # max_hours all_done_fn run_tasks_fn
+  local deadline=$(( $(date +%s) + $1 * 3600 ))
+  while [ "$(date +%s)" -lt "$deadline" ]; do
+    if "$2"; then
+      echo "[tpu_window] all measurements collected" >&2
+      return 0
+    fi
+    if probe; then
+      "$3"
+    else
+      echo "[tpu_window $(date +%H:%M:%S)] tunnel down; sleeping" >&2
+      sleep 540
+    fi
+  done
+  echo "[tpu_window] deadline reached" >&2
+  "$2"
+}

@@ -301,3 +301,24 @@ def test_nasnet_auto_partition_packed_execute(devices, capsys):
     y = jax.random.randint(jax.random.key(5), (8,), 0, 10)
     ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.slow
+def test_nasnet_auto_partition_interleaved(devices, capsys):
+    """Composition corner: branchy auto-partition x interleaved V=2 — the
+    packed rebuild must track the interleaved plan's C=S*V chunk bounds."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                    num_devices=2, auto_partition=True, virtual_stages=2,
+                    micro_batch_size=2, num_microbatches=4,
+                    compute_dtype="float32", profile_mode="flops")
+    strat = make_strategy(cfg)
+    out = capsys.readouterr().out
+    assert "auto-partition (interleaved)" in out
+    assert "packed-boundary chain, 4 spans" in out  # S*V chunks
+    ts = strat.init(jax.random.key(0))
+    x = jax.random.normal(jax.random.key(4), (8, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(5), (8,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
