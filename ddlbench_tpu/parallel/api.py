@@ -21,12 +21,81 @@ a uniform interface consumed by one train loop (ddlbench_tpu/train/loop.py):
 
 from __future__ import annotations
 
+import json
+import os
 from typing import Optional, Sequence
 
 import jax
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.zoo import get_model
+
+
+
+# Persisted auto-partition plan (reference parity: the optimizer's output
+# outlives the process as gpus=N.txt + generated stage code,
+# optimizer_graph_hierarchical.py:334-346 / run_template.sh:436-498). Here
+# the plan is data: the graph-level stage bounds plus the cfg fields the
+# plan rewrote. Persisting it next to the checkpoints makes --resume
+# independent of profiling noise — a time-mode re-profile could otherwise
+# pick different bounds and fail the restore on shape mismatch.
+_PLAN_FILE = "partition.json"
+
+
+def _plan_path(cfg: RunConfig):
+    return (os.path.join(cfg.checkpoint_dir, _PLAN_FILE)
+            if cfg.checkpoint_dir else None)
+
+
+def _load_plan(cfg: RunConfig):
+    path = _plan_path(cfg)
+    if not (cfg.resume and path and os.path.exists(path)):
+        return None
+    try:
+        with open(path) as f:
+            plan = json.load(f)
+    except (json.JSONDecodeError, OSError) as e:
+        print(f"auto-partition: ignoring unreadable plan {path} ({e}); "
+              f"re-profiling", flush=True)
+        return None
+    if plan.get("key") != _plan_key(cfg):
+        print(f"auto-partition: persisted plan {path} was computed for "
+              f"{plan.get('key')}, run is {_plan_key(cfg)}; re-profiling",
+              flush=True)
+        return None
+    return plan
+
+
+def _plan_key(cfg: RunConfig) -> dict:
+    """The fields a persisted plan must match to be reusable: a plan from a
+    different model/topology would mis-shard or trip shape asserts."""
+    return {"arch": cfg.arch, "benchmark": cfg.benchmark,
+            "strategy": cfg.strategy, "num_devices": cfg.num_devices,
+            "num_hosts": cfg.num_hosts}
+
+
+def _save_plan(cfg: RunConfig, graph_bounds) -> None:
+    path = _plan_path(cfg)
+    if path is None:
+        return
+    os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+    repl = cfg.stage_replication
+    payload = {
+        "key": _plan_key(cfg),
+        "graph_bounds": [int(b) for b in graph_bounds],
+        "num_stages": cfg.num_stages,
+        "dp_replicas": cfg.dp_replicas,
+        "stage_replication": list(repl) if repl else None,
+        "micro_batch_size": cfg.micro_batch_size,
+        "num_microbatches": cfg.num_microbatches,
+        "virtual_stages": cfg.virtual_stages,
+    }
+    # atomic: the window-catching harness SIGKILLs overdue runs, and a
+    # truncated plan file would break every later --resume
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(payload, f)
+    os.replace(tmp, path)
 
 
 def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None,
@@ -63,100 +132,117 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
         spec = cfg.dataset()
         dag = get_dag(cfg.arch, spec.image_size, spec.num_classes)
-        if dag is not None:
-            # branchy arch: profile the REAL dataflow DAG (the reference
-            # traces these with TensorWrapper, graph_creator.py:55-195),
-            # then chainize it at NODE granularity with packed-crossing
-            # boundary sizes — the partitioner may cut at any position
-            # (incl. non-articulation cuts where several tensors cross,
-            # e.g. between nasnet cells) and the chosen cuts are executed
-            # via branchy.to_packed_chain below
-            from ddlbench_tpu.profiler.profile import (packed_chain_graph,
-                                                       profile_dag)
-
-            cdtype = jax.numpy.dtype(cfg.compute_dtype)
-            dag_graph, dag_shapes = profile_dag(
-                dag, mb, mode=cfg.profile_mode, dtype=cdtype,
-                hw=cfg.hardware, return_shapes=True)
-            # one itemsize everywhere: the profile's activation sizes and
-            # the input-crossing bytes below must share units for the DP's
-            # cut comparison to be meaningful
-            graph = packed_chain_graph(dag_graph, dag, mb,
-                                       itemsize=cdtype.itemsize)
-            if input_time_ms > 0.0:
-                # fold_input_node semantics: data loading prices into the
-                # stage hosting block 0
-                graph.topological_sort()[0].forward_compute_time += (
-                    input_time_ms)
-        else:
-            graph = profile_model(model, mb, mode=cfg.profile_mode,
-                                  hw=cfg.hardware,
-                                  input_time_ms=input_time_ms)
-            # DP view: the Input node folds into layer 0's stage — the
-            # reference co-locates its DataLoader with stage 0's ranks, and
-            # a chip cannot run "just data loading", so Input must never
-            # form its own stage.
-            from ddlbench_tpu.profiler.profile import fold_input_node
-
-            graph = fold_input_node(graph)
-
-        if cfg.virtual_stages > 1:
-            # interleaved runtimes live on the 2-D grid, whose plans are
-            # uniform by construction — search ONLY that executable family
-            # (partition_interleaved) and execute the winner, rather than
-            # emitting a hetero plan the V>1 runtime would have to drop
-            from ddlbench_tpu.partition.optimizer import partition_interleaved
-
-            iplan = partition_interleaved(
-                graph, cfg.num_devices, cfg.virtual_stages, cfg.hardware,
-                num_hosts=cfg.num_hosts, num_microbatches=chunks,
-                micro_batch=mb)
-            stage_bounds = list(iplan.bounds)
-            # replicas split each microbatch's rows — the caller's global
-            # batch M*mb is unchanged (same convention as the uniform-plan
-            # rewrite below)
+        dag_shapes = None
+        persisted = _load_plan(cfg)
+        if persisted is not None:
+            stage_bounds = [int(b) for b in persisted["graph_bounds"]]
+            repl_p = persisted.get("stage_replication")
             cfg = cfg.replace(
-                num_stages=iplan.num_stages, dp_replicas=iplan.replication,
-                stage_replication=None,
-                micro_batch_size=mb // iplan.replication,
-                num_microbatches=chunks)
-            print(
-                f"auto-partition (interleaved): executing "
-                f"S={iplan.num_stages} x V={iplan.virtual_stages} "
-                f"(replication={iplan.replication}, bounds={stage_bounds}, "
-                f"bottleneck {iplan.pipeline_time_ms:.3f} ms)",
-                flush=True,
-            )
-            plan = None
+                num_stages=persisted["num_stages"],
+                dp_replicas=persisted["dp_replicas"],
+                stage_replication=tuple(repl_p) if repl_p else None,
+                micro_batch_size=persisted["micro_batch_size"],
+                num_microbatches=persisted["num_microbatches"],
+                virtual_stages=persisted.get("virtual_stages", 1))
+            cfg.validate()
+            print(f"auto-partition: reusing persisted plan "
+                  f"({_plan_path(cfg)}, bounds={stage_bounds})", flush=True)
         else:
-            plan = partition_hierarchical(
-                graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
-            )
-            repl = tuple(s.replication for s in plan.stages)
-        if plan is not None:
-            cfg_planned = cfg.replace(
-                num_stages=None, dp_replicas=1, stage_replication=repl)
-            try:
-                cfg_planned.validate()
-                stage_bounds = plan.stage_bounds()
-                cfg = cfg_planned
+            if dag is not None:
+                # branchy arch: profile the REAL dataflow DAG (the reference
+                # traces these with TensorWrapper, graph_creator.py:55-195),
+                # then chainize it at NODE granularity with packed-crossing
+                # boundary sizes — the partitioner may cut at any position
+                # (incl. non-articulation cuts where several tensors cross,
+                # e.g. between nasnet cells) and the chosen cuts are executed
+                # via branchy.to_packed_chain below
+                from ddlbench_tpu.profiler.profile import (packed_chain_graph,
+                                                           profile_dag)
+
+                cdtype = jax.numpy.dtype(cfg.compute_dtype)
+                dag_graph, dag_shapes = profile_dag(
+                    dag, mb, mode=cfg.profile_mode, dtype=cdtype,
+                    hw=cfg.hardware, return_shapes=True)
+                # one itemsize everywhere: the profile's activation sizes and
+                # the input-crossing bytes below must share units for the DP's
+                # cut comparison to be meaningful
+                graph = packed_chain_graph(dag_graph, dag, mb,
+                                           itemsize=cdtype.itemsize)
+                if input_time_ms > 0.0:
+                    # fold_input_node semantics: data loading prices into the
+                    # stage hosting block 0
+                    graph.topological_sort()[0].forward_compute_time += (
+                        input_time_ms)
+            else:
+                graph = profile_model(model, mb, mode=cfg.profile_mode,
+                                      hw=cfg.hardware,
+                                      input_time_ms=input_time_ms)
+                # DP view: the Input node folds into layer 0's stage — the
+                # reference co-locates its DataLoader with stage 0's ranks, and
+                # a chip cannot run "just data loading", so Input must never
+                # form its own stage.
+                from ddlbench_tpu.profiler.profile import fold_input_node
+
+                graph = fold_input_node(graph)
+
+            if cfg.virtual_stages > 1:
+                # interleaved runtimes live on the 2-D grid, whose plans are
+                # uniform by construction — search ONLY that executable family
+                # (partition_interleaved) and execute the winner, rather than
+                # emitting a hetero plan the V>1 runtime would have to drop
+                from ddlbench_tpu.partition.optimizer import partition_interleaved
+
+                iplan = partition_interleaved(
+                    graph, cfg.num_devices, cfg.virtual_stages, cfg.hardware,
+                    num_hosts=cfg.num_hosts, num_microbatches=chunks,
+                    micro_batch=mb)
+                stage_bounds = list(iplan.bounds)
+                # replicas split each microbatch's rows — the caller's global
+                # batch M*mb is unchanged (same convention as the uniform-plan
+                # rewrite below)
+                cfg = cfg.replace(
+                    num_stages=iplan.num_stages, dp_replicas=iplan.replication,
+                    stage_replication=None,
+                    micro_batch_size=mb // iplan.replication,
+                    num_microbatches=chunks)
                 print(
-                    f"auto-partition: executing plan "
-                    f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
-                    f"(bounds={stage_bounds}, replication={repl}, "
-                    f"bottleneck {plan.pipeline_time_ms:.3f} ms)",
+                    f"auto-partition (interleaved): executing "
+                    f"S={iplan.num_stages} x V={iplan.virtual_stages} "
+                    f"(replication={iplan.replication}, bounds={stage_bounds}, "
+                    f"bottleneck {iplan.pipeline_time_ms:.3f} ms)",
                     flush=True,
                 )
-            except ValueError as e:
-                # e.g. micro-batch not divisible by a replication factor:
-                # keep the profiled balanced split rather than fail the run
-                stage_bounds = stage_bounds_from_graph(
-                    graph, cfg.resolved_stages())
-                print(
-                    f"auto-partition: plan {repl} not executable ({e}); "
-                    f"falling back to balanced bounds {stage_bounds}",
-                    flush=True,
+                plan = None
+            else:
+                plan = partition_hierarchical(
+                    graph, cfg.num_devices, cfg.hardware, num_hosts=cfg.num_hosts
                 )
+                repl = tuple(s.replication for s in plan.stages)
+            if plan is not None:
+                cfg_planned = cfg.replace(
+                    num_stages=None, dp_replicas=1, stage_replication=repl)
+                try:
+                    cfg_planned.validate()
+                    stage_bounds = plan.stage_bounds()
+                    cfg = cfg_planned
+                    print(
+                        f"auto-partition: executing plan "
+                        f"{[(s.start, s.end, s.replication) for s in plan.stages]} "
+                        f"(bounds={stage_bounds}, replication={repl}, "
+                        f"bottleneck {plan.pipeline_time_ms:.3f} ms)",
+                        flush=True,
+                    )
+                except ValueError as e:
+                    # e.g. micro-batch not divisible by a replication factor:
+                    # keep the profiled balanced split rather than fail the run
+                    stage_bounds = stage_bounds_from_graph(
+                        graph, cfg.resolved_stages())
+                    print(
+                        f"auto-partition: plan {repl} not executable ({e}); "
+                        f"falling back to balanced bounds {stage_bounds}",
+                        flush=True,
+                    )
+            _save_plan(cfg, stage_bounds)
         if dag is not None:
             # execute the chosen node-position cuts: one packed composite
             # span per chunk, boundaries carry every crossing tensor in one

@@ -8,29 +8,16 @@ set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
-tasks() {
-  run_one bench              python bench.py --probe-timeout-s 60
-  run_one lmbench_synthtext  python -m ddlbench_tpu.tools.lmbench -b synthtext
-  run_one lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
-  run_one lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s
-  run_one decodebench        python -m ddlbench_tpu.tools.decodebench
-  # scaling-curve anchor: the on-chip points scalebench can measure on the
-  # attached slice (1 chip -> the per-chip single/dp anchors; a larger
-  # slice sweeps further automatically)
-  run_one scalebench_tpu     python -m ddlbench_tpu.tools.scalebench \
-                               -b imagenet -m resnet50 --devices 1 \
-                               --strategies dp --steps 20 --repeats 3
-  # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
-  run_one heterobench_tpu    python -m ddlbench_tpu.tools.heterobench \
-                               -b mnist -m resnet18 --plan 2,2 --uneven 1,3
-}
+add_task bench              python bench.py --probe-timeout-s 60
+add_task lmbench_synthtext  python -m ddlbench_tpu.tools.lmbench -b synthtext
+add_task lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
+add_task lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s
+add_task decodebench        python -m ddlbench_tpu.tools.decodebench
+# scaling-curve anchor: the on-chip points scalebench can measure on the
+# attached slice (1 chip -> the per-chip single/dp anchors; a larger slice
+# sweeps further automatically)
+add_task scalebench_tpu     python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --devices 1 --strategies dp --steps 20 --repeats 3
+# hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
+add_task heterobench_tpu    python -m ddlbench_tpu.tools.heterobench -b mnist -m resnet18 --plan 2,2 --uneven 1,3
 
-all_done() {
-  for n in bench lmbench_synthtext lmbench_longctx lmbench_synthmt \
-           decodebench scalebench_tpu heterobench_tpu; do
-    [ -e "$OUT/$n.ok" ] || return 1
-  done
-  return 0
-}
-
-window_loop "${1:-9}" all_done tasks
+window_loop "${1:-9}"

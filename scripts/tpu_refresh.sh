@@ -10,19 +10,8 @@ set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
-tasks() {
-  run_one bench_final             python bench.py
-  run_one lmbench_synthtext_final python -m ddlbench_tpu.tools.lmbench \
-                                    -b synthtext --configs \
-                                    flash+fused,flash+logits,xla+fused,xla+logits,auto
-  run_one lmbench_longctx_final   python -m ddlbench_tpu.tools.lmbench -b longctx
-}
+add_task bench_final             python bench.py
+add_task lmbench_synthtext_final python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task lmbench_longctx_final   python -m ddlbench_tpu.tools.lmbench -b longctx
 
-all_done() {
-  for n in bench_final lmbench_synthtext_final lmbench_longctx_final; do
-    [ -e "$OUT/$n.ok" ] || return 1
-  done
-  return 0
-}
-
-window_loop "${1:-8}" all_done tasks
+window_loop "${1:-8}"

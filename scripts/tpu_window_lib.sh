@@ -1,7 +1,8 @@
 # Shared tunnel-window machinery for the opportunistic TPU measurement
-# collectors (tpu_grab.sh, tpu_refresh.sh). Source this, define tasks with
-# run_one, and drive the loop with window_loop <max_hours> <all_done_fn>
-# <run_tasks_fn>.
+# collectors (tpu_grab.sh, tpu_refresh.sh). Source this, declare tasks with
+# add_task <name> <cmd...>, and drive with window_loop <max_hours>. The one
+# task list serves both execution and the all-done check, so a task cannot
+# be silently dropped from completion accounting.
 #
 # The axon TPU tunnel is intermittently available (device init can hang for
 # hours, then come back). Discipline: probe with a hard timeout; when up,
@@ -11,6 +12,15 @@
 
 OUT=perf_runs
 mkdir -p "$OUT"
+
+TASK_NAMES=()
+TASK_CMDS=()
+
+add_task() {  # name cmd...
+  local name=$1; shift
+  TASK_NAMES+=("$name")
+  TASK_CMDS+=("$*")
+}
 
 probe() {
   # -s KILL: a client hung inside the axon plugin holds the GIL in a C call
@@ -34,20 +44,39 @@ run_one() {  # name cmd...
   fi
 }
 
-window_loop() {  # max_hours all_done_fn run_tasks_fn
+run_tasks() {
+  local i
+  for i in "${!TASK_NAMES[@]}"; do
+    # task commands are static strings we author (no quoted-space args);
+    # word splitting is the intended parse
+    # shellcheck disable=SC2086
+    run_one "${TASK_NAMES[$i]}" ${TASK_CMDS[$i]}
+  done
+}
+
+all_done() {
+  [ "${#TASK_NAMES[@]}" -gt 0 ] || return 1
+  local n
+  for n in "${TASK_NAMES[@]}"; do
+    [ -e "$OUT/$n.ok" ] || return 1
+  done
+  return 0
+}
+
+window_loop() {  # max_hours
   local deadline=$(( $(date +%s) + $1 * 3600 ))
   while [ "$(date +%s)" -lt "$deadline" ]; do
-    if "$2"; then
+    if all_done; then
       echo "[tpu_window] all measurements collected" >&2
       return 0
     fi
     if probe; then
-      "$3"
+      run_tasks
     else
       echo "[tpu_window $(date +%H:%M:%S)] tunnel down; sleeping" >&2
       sleep 540
     fi
   done
   echo "[tpu_window] deadline reached" >&2
-  "$2"
+  all_done
 }

@@ -63,3 +63,59 @@ def test_resume_matches_uninterrupted(tmp_path, capsys, strategy, extra):
         rtol=1e-6, atol=1e-7)
     np.testing.assert_allclose(res["valid_accuracy"], res_u["valid_accuracy"],
                                rtol=1e-6)
+
+
+def test_auto_partition_plan_persists_across_resume(tmp_path, capsys):
+    """--auto-partition + --resume must NOT re-profile: the plan is
+    persisted next to the checkpoints (reference parity: the optimizer's
+    output outlives the process as gpus=N.txt + generated stage code) so a
+    noisy time-mode re-profile can't change the bounds and fail the restore
+    on shape mismatch. Covers the branchy (packed-chain) path too."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    base = dict(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                num_devices=2, auto_partition=True, micro_batch_size=4,
+                num_microbatches=2, compute_dtype="float32",
+                profile_mode="flops", checkpoint_dir=str(tmp_path))
+    s1 = make_strategy(RunConfig(**base))
+    assert (tmp_path / "partition.json").exists()
+    capsys.readouterr()
+    s2 = make_strategy(RunConfig(**base, resume=True))
+    out = capsys.readouterr().out
+    assert "reusing persisted plan" in out
+    assert "executing plan" not in out  # no re-partition
+    ts1 = s1.init(jax.random.key(0))
+    ts2 = s2.init(jax.random.key(0))
+    for a, b in zip(jax.tree.leaves(ts1), jax.tree.leaves(ts2)):
+        assert a.shape == b.shape
+
+
+def test_stale_or_corrupt_plan_is_ignored(tmp_path, capsys):
+    """A plan computed for a different topology (or a truncated file from a
+    SIGKILLed run) must not be applied — the run re-profiles instead."""
+    import json
+
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    base = dict(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                num_devices=2, auto_partition=True, micro_batch_size=4,
+                num_microbatches=2, compute_dtype="float32",
+                profile_mode="flops", checkpoint_dir=str(tmp_path))
+    make_strategy(RunConfig(**base))
+    plan_file = tmp_path / "partition.json"
+
+    # stale: recorded for a different device count
+    plan = json.loads(plan_file.read_text())
+    plan["key"]["num_devices"] = 4
+    plan_file.write_text(json.dumps(plan))
+    capsys.readouterr()
+    make_strategy(RunConfig(**base, resume=True))
+    out = capsys.readouterr().out
+    assert "re-profiling" in out and "reusing persisted plan" not in out
+
+    # corrupt: truncated write
+    plan_file.write_text("{\"graph_bounds\": [0, 4")
+    capsys.readouterr()
+    make_strategy(RunConfig(**base, resume=True))
+    out = capsys.readouterr().out
+    assert "ignoring unreadable plan" in out
