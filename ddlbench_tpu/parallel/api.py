@@ -47,41 +47,50 @@ def _plan_path(cfg: RunConfig):
             if cfg.checkpoint_dir else None)
 
 
-def _load_plan(cfg: RunConfig):
+def _load_plan(cfg: RunConfig, key: dict):
+    """Returns (plan_or_None, keep_existing): ``keep_existing`` marks a
+    readable plan whose key mismatched — it belongs to a DIFFERENT run
+    configuration (possibly a flag typo) and must not be overwritten by
+    this run's re-profile."""
     path = _plan_path(cfg)
     if not (cfg.resume and path and os.path.exists(path)):
-        return None
+        return None, False
     try:
         with open(path) as f:
             plan = json.load(f)
     except (json.JSONDecodeError, OSError) as e:
         print(f"auto-partition: ignoring unreadable plan {path} ({e}); "
               f"re-profiling", flush=True)
-        return None
-    if plan.get("key") != _plan_key(cfg):
+        return None, False
+    if plan.get("key") != key:
         print(f"auto-partition: persisted plan {path} was computed for "
-              f"{plan.get('key')}, run is {_plan_key(cfg)}; re-profiling",
-              flush=True)
-        return None
-    return plan
+              f"{plan.get('key')}, run is {key}; re-profiling (the "
+              f"existing plan file is kept)", flush=True)
+        return None, True
+    return plan, False
 
 
 def _plan_key(cfg: RunConfig) -> dict:
     """The fields a persisted plan must match to be reusable: a plan from a
-    different model/topology would mis-shard or trip shape asserts."""
+    different model/topology would mis-shard or trip shape asserts, and one
+    from different batch/virtual-stage flags would silently override what
+    the user asked for. Must be computed from the PRE-rewrite cfg (plans
+    rewrite micro_batch_size etc.), so callers capture it up front."""
+    mb, chunks = cfg.resolved_batches()
     return {"arch": cfg.arch, "benchmark": cfg.benchmark,
             "strategy": cfg.strategy, "num_devices": cfg.num_devices,
-            "num_hosts": cfg.num_hosts}
+            "num_hosts": cfg.num_hosts, "micro_batch_size": mb,
+            "num_microbatches": chunks, "virtual_stages": cfg.virtual_stages}
 
 
-def _save_plan(cfg: RunConfig, graph_bounds) -> None:
+def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
     path = _plan_path(cfg)
     if path is None:
         return
     os.makedirs(cfg.checkpoint_dir, exist_ok=True)
     repl = cfg.stage_replication
     payload = {
-        "key": _plan_key(cfg),
+        "key": key,
         "graph_bounds": [int(b) for b in graph_bounds],
         "num_stages": cfg.num_stages,
         "dp_replicas": cfg.dp_replicas,
@@ -133,21 +142,33 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
         spec = cfg.dataset()
         dag = get_dag(cfg.arch, spec.image_size, spec.num_classes)
         dag_shapes = None
-        persisted = _load_plan(cfg)
+        plan_key = _plan_key(cfg)  # pre-rewrite flags; plans rewrite cfg
+        persisted, keep_existing = _load_plan(cfg, plan_key)
+        applied = False
         if persisted is not None:
-            stage_bounds = [int(b) for b in persisted["graph_bounds"]]
-            repl_p = persisted.get("stage_replication")
-            cfg = cfg.replace(
-                num_stages=persisted["num_stages"],
-                dp_replicas=persisted["dp_replicas"],
-                stage_replication=tuple(repl_p) if repl_p else None,
-                micro_batch_size=persisted["micro_batch_size"],
-                num_microbatches=persisted["num_microbatches"],
-                virtual_stages=persisted.get("virtual_stages", 1))
-            cfg.validate()
-            print(f"auto-partition: reusing persisted plan "
-                  f"({_plan_path(cfg)}, bounds={stage_bounds})", flush=True)
-        else:
+            cfg_before = cfg
+            try:
+                stage_bounds = [int(b) for b in persisted["graph_bounds"]]
+                repl_p = persisted.get("stage_replication")
+                cfg = cfg.replace(
+                    num_stages=persisted["num_stages"],
+                    dp_replicas=persisted["dp_replicas"],
+                    stage_replication=tuple(repl_p) if repl_p else None,
+                    micro_batch_size=persisted["micro_batch_size"],
+                    num_microbatches=persisted["num_microbatches"],
+                    virtual_stages=persisted.get("virtual_stages", 1))
+                cfg.validate()
+                applied = True
+                print(f"auto-partition: reusing persisted plan "
+                      f"({_plan_path(cfg)}, bounds={stage_bounds})",
+                      flush=True)
+            except (KeyError, TypeError, ValueError) as e:
+                # schema drift / hand edit / no-longer-valid combination:
+                # fall back to re-profiling, same as no plan at all
+                cfg = cfg_before
+                print(f"auto-partition: persisted plan not applicable "
+                      f"({e!r}); re-profiling", flush=True)
+        if not applied:
             if dag is not None:
                 # branchy arch: profile the REAL dataflow DAG (the reference
                 # traces these with TensorWrapper, graph_creator.py:55-195),
@@ -242,7 +263,8 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                         f"falling back to balanced bounds {stage_bounds}",
                         flush=True,
                     )
-            _save_plan(cfg, stage_bounds)
+            if not keep_existing:
+                _save_plan(plan_key, cfg, stage_bounds)
         if dag is not None:
             # execute the chosen node-position cuts: one packed composite
             # span per chunk, boundaries carry every crossing tensor in one

@@ -19,5 +19,7 @@ add_task decodebench        python -m ddlbench_tpu.tools.decodebench
 add_task scalebench_tpu     python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --devices 1 --strategies dp --steps 20 --repeats 3
 # hetero conveyor A/B (needs >=4 chips; records a skip note on 1)
 add_task heterobench_tpu    python -m ddlbench_tpu.tools.heterobench -b mnist -m resnet18 --plan 2,2 --uneven 1,3
+# 32k-context benchmark (streaming flash kernels; xla cells record OOM rows)
+add_task lmbench_longctx32k python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
 
 window_loop "${1:-9}"

@@ -1,0 +1,27 @@
+"""heterobench tool: the hetero-vs-grid A/B runs end-to-end on the CPU mesh."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # compile-heavy (see conftest --runslow)
+
+
+def test_heterobench_runs(capsys):
+    from ddlbench_tpu.tools.heterobench import main
+
+    rc = main(["-b", "mnist", "-m", "lenet", "-f", "gpipe",
+               "--plan", "1,1", "--uneven", "1,2",
+               "--micro-batch-size", "2", "--num-microbatches", "2",
+               "--steps", "1", "--warmup", "1", "--dtype", "float32",
+               "--in-process"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    points = [l for l in lines if "engine" in l]
+    # uniform A/B pair (same plan, both engines) + the uneven hetero point
+    assert [(p["engine"], p["plan"]) for p in points] == [
+        ("hetero", [1, 1]), ("grid", [1, 1]), ("hetero", [1, 2])]
+    assert all(p["samples_per_sec"] > 0 for p in points)
+    ratio = [l for l in lines if l.get("comparison") == "hetero/grid"]
+    assert ratio and ratio[0]["throughput_ratio"] > 0

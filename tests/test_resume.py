@@ -119,3 +119,37 @@ def test_stale_or_corrupt_plan_is_ignored(tmp_path, capsys):
     make_strategy(RunConfig(**base, resume=True))
     out = capsys.readouterr().out
     assert "ignoring unreadable plan" in out
+
+
+def test_mismatched_plan_is_not_clobbered_and_flags_key(tmp_path, capsys):
+    """A resume under different flags must keep the original plan file (the
+    mismatch may be a flag typo), and differing batch flags count as a
+    mismatch (the plan must not silently override the requested batch)."""
+    import json
+
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    base = dict(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                num_devices=2, auto_partition=True, micro_batch_size=4,
+                num_microbatches=2, compute_dtype="float32",
+                profile_mode="flops", checkpoint_dir=str(tmp_path))
+    make_strategy(RunConfig(**base))
+    plan_file = tmp_path / "partition.json"
+    original = plan_file.read_text()
+    capsys.readouterr()
+
+    # resume with a different micro-batch: plan rejected, file untouched
+    other = dict(base, micro_batch_size=8)
+    make_strategy(RunConfig(**other, resume=True))
+    out = capsys.readouterr().out
+    assert "re-profiling" in out and "existing plan file is kept" in out
+    assert plan_file.read_text() == original
+
+    # schema drift: matching key but missing field -> fallback, no crash
+    plan = json.loads(original)
+    del plan["graph_bounds"]
+    plan_file.write_text(json.dumps(plan))
+    capsys.readouterr()
+    make_strategy(RunConfig(**base, resume=True))
+    out = capsys.readouterr().out
+    assert "not applicable" in out
