@@ -375,7 +375,15 @@ def _packed_span(model: DagModel, a: int, b: int, in_ids: List[int],
             [env[i].reshape(B, -1) for i in out_ids], axis=1)
         return packed, new_states
 
-    return Layer(f"{model.name}_span{a}_{b}", init, apply)
+    # the span's flat packed boundary hides the compute geometry from the
+    # analytic FLOP heuristic (spatial would read as 1); advertise the
+    # span's true spatial scale — exact for the per-node spans the manual
+    # pipeline path builds, an upper bound for multi-node spans
+    spatial = max(
+        _flat_size(shape_of(i)[:-1]) if len(shape_of(i)) > 1 else 1
+        for i in range(a, b))
+    return Layer(f"{model.name}_span{a}_{b}", init, apply,
+                 cost_spatial=spatial)
 
 
 # ---- nasnet family ---------------------------------------------------------

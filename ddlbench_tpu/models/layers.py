@@ -110,6 +110,14 @@ class Layer:
     # (ce_sum, correct, correct_top5, valid)`` — same fusion for the
     # validation metrics (incl. prec@5 with torch.topk tie order).
     fused_eval: Any = None
+    # Per-example spatial factor for the analytic FLOP heuristic
+    # (parallel/packing.layer_flop_costs): conv FLOPs ~ 2*params*H*W, read
+    # from the layer's OUTPUT shape by default. Layers whose output shape
+    # hides the compute geometry set this — packed composite spans
+    # (models/branchy._packed_span) emit flat [N] boundaries whose spatial
+    # would read as 1, underweighting convolutional spans by orders of
+    # magnitude in the balanced stage split.
+    cost_spatial: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

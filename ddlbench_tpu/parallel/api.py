@@ -284,6 +284,23 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                 cfg.resolved_stages(), chunks, len(model.layers))
             print(f"schedule advisor (S={cfg.resolved_stages()}, M={chunks}): "
                   f"{table}", flush=True)
+    if (stage_bounds is None and cfg.strategy in ("gpipe", "pipedream")):
+        # Manual (non-auto-partition) pipeline run on a branchy arch: the
+        # articulation chain is hopeless to balance (nasnet's whole cell
+        # stack is ONE block — two tensors cross every cell boundary), so
+        # split at NODE granularity over packed boundaries instead; the
+        # engines' balanced default split then has n positions to choose
+        # from, like any chain model.
+        from ddlbench_tpu.models.branchy import get_dag, to_packed_chain
+
+        spec_b = cfg.dataset()
+        dag_b = get_dag(cfg.arch, spec_b.image_size, spec_b.num_classes)
+        if dag_b is not None:
+            model = to_packed_chain(
+                dag_b, range(1, len(dag_b.layers)))
+            print(f"branchy arch: node-granular packed chain "
+                  f"({len(model.layers)} layers) for the stage split",
+                  flush=True)
     if cfg.strategy == "single":
         from ddlbench_tpu.parallel.single import SingleStrategy
 

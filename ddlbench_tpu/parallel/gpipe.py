@@ -131,7 +131,8 @@ class GPipeStrategy:
             if self._stage_bounds_override is not None:
                 bounds = list(self._stage_bounds_override)
             else:
-                costs = layer_flop_costs(params_list, shapes)
+                costs = layer_flop_costs(params_list, shapes,
+                                          self.model.layers)
                 bounds = balanced_stage_bounds(costs, C)
             assert len(bounds) == C + 1 and bounds[0] == 0 and bounds[-1] == len(self.model.layers)
             self.bounds = bounds

@@ -154,7 +154,8 @@ class HeteroGPipeStrategy:
             if self._stage_bounds_override is not None:
                 bounds = list(self._stage_bounds_override)
             else:
-                costs = layer_flop_costs(params_list, shapes)
+                costs = layer_flop_costs(params_list, shapes,
+                                          self.model.layers)
                 bounds = balanced_stage_bounds(costs, S)
             assert (len(bounds) == S + 1 and bounds[0] == 0
                     and bounds[-1] == len(self.model.layers))

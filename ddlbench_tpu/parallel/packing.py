@@ -27,7 +27,7 @@ not source code.
 from __future__ import annotations
 
 import math
-from typing import Any, Callable, List, Sequence, Tuple
+from typing import Any, Callable, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
@@ -111,16 +111,25 @@ def balanced_stage_bounds(costs: Sequence[float], num_stages: int) -> List[int]:
     return bounds[::-1]
 
 
-def layer_flop_costs(params_list: Sequence[Any], shapes: Sequence[Tuple[int, ...]]) -> List[float]:
+def layer_flop_costs(params_list: Sequence[Any],
+                     shapes: Sequence[Tuple[int, ...]],
+                     layers: Optional[Sequence[Any]] = None) -> List[float]:
     """Analytic per-layer FLOP estimate for load balancing.
 
     For convolutions FLOPs = 2 * n_params * out_H * out_W (exact for dense
-    layers with spatial=1), which is what dominates these CNNs. ``shapes`` are
-    the per-example boundary shapes from init_model.
+    layers with spatial=1), which is what dominates these CNNs. ``shapes``
+    are the per-example boundary shapes from init_model. A layer whose
+    output shape hides its compute geometry overrides the spatial factor
+    via Layer.cost_spatial (packed composite spans emit flat boundaries
+    that would otherwise read as spatial=1) — pass ``layers`` to honor it.
     """
     costs = []
-    for p, out_shape in zip(params_list, shapes[1:]):
+    for i, (p, out_shape) in enumerate(zip(params_list, shapes[1:])):
         n_params = sum(int(x.size) for x in jax.tree.leaves(p))
-        spatial = math.prod(out_shape[:-1]) if len(out_shape) > 1 else 1
+        spatial = None
+        if layers is not None:
+            spatial = getattr(layers[i], "cost_spatial", None)
+        if spatial is None:
+            spatial = math.prod(out_shape[:-1]) if len(out_shape) > 1 else 1
         costs.append(max(1.0, 2.0 * n_params * spatial))
     return costs

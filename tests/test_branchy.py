@@ -322,3 +322,47 @@ def test_nasnet_auto_partition_interleaved(devices, capsys):
     y = jax.random.randint(jax.random.key(5), (8,), 0, 10)
     ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
     assert np.isfinite(float(m["loss"]))
+
+
+@pytest.mark.slow
+def test_manual_pipeline_uses_node_granular_packing(devices, capsys):
+    """A manual (non-auto) pipeline run on a branchy arch splits a
+    node-granular packed chain — the articulation chain would put nasnet's
+    whole cell stack in one unsplittable block."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                    num_devices=4, num_stages=4, micro_batch_size=2,
+                    num_microbatches=4, compute_dtype="float32")
+    strat = make_strategy(cfg)
+    out = capsys.readouterr().out
+    assert "node-granular packed chain (51 layers)" in out
+    ts = strat.init(jax.random.key(0))
+    B = cfg.global_batch()
+    x = jax.random.normal(jax.random.key(4), (B, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(5), (B,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
+
+
+def test_packed_span_cost_spatial_drives_balance():
+    """Packed spans advertise their true spatial scale to the FLOP
+    heuristic — the flat boundary would otherwise read as spatial=1 and
+    skew the balanced split toward parameter count."""
+    from ddlbench_tpu.models.branchy import to_packed_chain
+    from ddlbench_tpu.parallel.packing import layer_flop_costs
+
+    dag = _nas_dag()
+    chain = to_packed_chain(dag, range(1, len(dag.layers)))
+    assert all(l.cost_spatial is not None and l.cost_spatial >= 1
+               for l in chain.layers)
+    # conv spans at the 8x8 input carry spatial 64; the fc span is 1
+    assert max(l.cost_spatial for l in chain.layers) == 64
+    assert chain.layers[-1].cost_spatial == 1
+    pd, sd, _ = init_dag(dag, jax.random.key(0))
+    pc = [[p] for p in pd]
+    shapes = [dag.in_shape] + [(1,)] * len(chain.layers)  # flat boundaries
+    with_hint = layer_flop_costs(pc, shapes, chain.layers)
+    without = layer_flop_costs(pc, shapes)
+    # the hint scales conv spans up by their spatial factor
+    assert max(w / max(o, 1.0) for w, o in zip(with_hint, without)) >= 16
