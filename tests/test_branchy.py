@@ -366,3 +366,26 @@ def test_packed_span_cost_spatial_drives_balance():
     without = layer_flop_costs(pc, shapes)
     # the hint scales conv spans up by their spatial factor
     assert max(w / max(o, 1.0) for w, o in zip(with_hint, without)) >= 16
+
+
+@pytest.mark.slow
+def test_manual_hetero_over_packed_chain(devices, capsys):
+    """Composition: uneven hetero replication x branchy packed chain — the
+    conveyor engine splits the node-granular chain like any other model."""
+    from ddlbench_tpu.parallel.api import make_strategy
+    from ddlbench_tpu.parallel.hetero import HeteroGPipeStrategy
+
+    cfg = RunConfig(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                    num_devices=3, stage_replication=(1, 2),
+                    micro_batch_size=2, num_microbatches=2,
+                    compute_dtype="float32")
+    cfg.validate()
+    strat = make_strategy(cfg)
+    assert isinstance(strat, HeteroGPipeStrategy)
+    assert "node-granular packed chain" in capsys.readouterr().out
+    ts = strat.init(jax.random.key(0))
+    B = cfg.global_batch()
+    x = jax.random.normal(jax.random.key(4), (B, 32, 32, 3))
+    y = jax.random.randint(jax.random.key(5), (B,), 0, 10)
+    ts, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert np.isfinite(float(m["loss"]))
