@@ -45,6 +45,51 @@ def _device_probe(timeout_s: float) -> tuple[bool, str]:
     return True, ""
 
 
+def _last_known_onchip() -> dict | None:
+    """Newest committed on-chip headline from perf_runs/, with provenance.
+
+    Three rounds of driver-captured BENCH_r0*.json read "cpu-fallback" because
+    the tunnel happened to be down at driver time, while the real measured
+    chip numbers lived only in perf_runs/ (VERDICT r3, missing item 2). On
+    fallback the official artifact now carries the last-known-good on-chip
+    result next to the fallback measurement instead of silently reporting
+    0.63 img/s as the round's number.
+    """
+    import datetime
+    import glob
+
+    best: dict | None = None
+    here = os.path.dirname(os.path.abspath(__file__))
+    for path in glob.glob(os.path.join(here, "perf_runs", "bench*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+        except (OSError, ValueError):
+            continue
+        if rec.get("platform") not in ("tpu", "axon"):
+            continue
+        if "images_per_sec" not in str(rec.get("metric", "")):
+            continue
+        # Recency: prefer the record's own measured_at stamp (records since
+        # round 4 carry one); file mtime is only a fallback and is marked as
+        # approximate — git checkouts do not preserve measurement times.
+        if "measured_at" in rec:
+            stamp, source = rec["measured_at"], "record"
+        else:
+            stamp = datetime.datetime.fromtimestamp(
+                os.path.getmtime(path), datetime.timezone.utc
+            ).isoformat(timespec="seconds")
+            source = "file-mtime (approximate; record predates stamping)"
+        if best is None or stamp > best["measured_at"]:
+            best = {k: rec[k] for k in
+                    ("metric", "value", "unit", "vs_baseline", "platform")
+                    if k in rec}
+            best["measured_at"] = stamp
+            best["measured_at_source"] = source
+            best["source"] = os.path.relpath(path, here)
+    return best
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--arch", default="resnet50")
@@ -137,6 +182,14 @@ def main() -> int:
         # the platform the measurement actually ran on is part of the record.
         "platform": platform_note or jax.devices()[0].platform,
     }
+    import datetime
+
+    record["measured_at"] = datetime.datetime.now(
+        datetime.timezone.utc).isoformat(timespec="seconds")
+    if platform_note:  # cpu-fallback: surface the newest real chip number too
+        lkg = _last_known_onchip()
+        if lkg:
+            record["last_known_onchip"] = lkg
     # Roofline context: XLA's own cost analysis of the compiled step vs the
     # chip's peak FLOP/s and HBM bandwidth (PERF.md methodology). Best-effort:
     # some backends return no cost model.

@@ -377,11 +377,15 @@ def _packed_span(model: DagModel, a: int, b: int, in_ids: List[int],
 
     # the span's flat packed boundary hides the compute geometry from the
     # analytic FLOP heuristic (spatial would read as 1); advertise the
-    # span's true spatial scale — exact for the per-node spans the manual
-    # pipeline path builds, an upper bound for multi-node spans
-    spatial = max(
+    # span's true spatial scale. Single-node spans (the manual pipeline
+    # path) keep the scalar form — exact. Multi-node spans carry the full
+    # per-node tuple so layer_flop_costs can sum exact per-node costs; a
+    # max over a span mixing large-spatial convs with dense nodes would
+    # over-weight it (ADVICE r3).
+    per_node = tuple(
         _flat_size(shape_of(i)[:-1]) if len(shape_of(i)) > 1 else 1
         for i in range(a, b))
+    spatial = per_node[0] if len(per_node) == 1 else per_node
     return Layer(f"{model.name}_span{a}_{b}", init, apply,
                  cost_spatial=spatial)
 

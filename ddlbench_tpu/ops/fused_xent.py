@@ -115,7 +115,10 @@ def _pallas_feasible(h, w, backend: str, interpret: bool) -> bool:
     if interpret:
         return True
     D, V = w.shape
-    isz = w.dtype.itemsize
+    # Price with the wider of the two dtypes: the launch sites size blocks
+    # with h.dtype.itemsize (lines 442/555+), so a gate priced only on w
+    # could pass while _budget_v_block returns None at launch (ADVICE r3).
+    isz = max(h.dtype.itemsize, w.dtype.itemsize)
     br = _row_block(h.shape[0], interpret)
     ok = (
         _budget_v_block(V, D, br, isz, False) is not None  # fwd
@@ -439,7 +442,8 @@ def _fxent_fwd_pallas(h, w, labels, smoothing: float, interpret: bool):
     hp, lp, _ = _pad_rows(h, labels, br)
     Np = hp.shape[0]
     nr = Np // br
-    bv = _budget_v_block(V, D, br, h.dtype.itemsize, interpret)
+    bv = _budget_v_block(V, D, br,
+                         max(h.dtype.itemsize, w.dtype.itemsize), interpret)
     nv = V // bv
     lab2 = lp[:, None].astype(jnp.int32)
 
@@ -552,7 +556,7 @@ def _fxent_bwd_pallas(h, w, labels, lses, go, gce, smoothing: float,
     # (bv-independent); dW carries an f32 [D, bv] scratch plus a
     # double-buffered f32 [D, bv] out block, so its lane block must shrink
     # when D is wide (VMEM_BUDGET note above; formulas in _dh/_dw_price).
-    isz = h.dtype.itemsize
+    isz = max(h.dtype.itemsize, w.dtype.itemsize)
     bv = _budget_v_block(V, D, br, isz, interpret, **_dh_price(D, br, isz))
     nv = V // bv
     bv_dw = _budget_v_block(V, D, br, isz, interpret,

@@ -88,6 +88,21 @@ def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
     if path is None:
         return
     os.makedirs(cfg.checkpoint_dir, exist_ok=True)
+    # A fresh (non-resume) run pointed at a checkpoint_dir holding a
+    # DIFFERENT configuration's plan — e.g. a flag typo — must not silently
+    # clobber it next to that run's checkpoints (ADVICE r3): keep a backup.
+    if os.path.exists(path):
+        try:
+            with open(path) as f:
+                old_key = json.load(f).get("key")
+        except (json.JSONDecodeError, OSError):
+            old_key = None
+        if old_key != key:
+            bak = path + ".bak"
+            os.replace(path, bak)
+            print(f"auto-partition: existing plan {path} belongs to a "
+                  f"different configuration ({old_key}); backed up to {bak}",
+                  flush=True)
     repl = cfg.stage_replication
     payload = {
         "key": key,

@@ -125,10 +125,22 @@ def layer_flop_costs(params_list: Sequence[Any],
     """
     costs = []
     for i, (p, out_shape) in enumerate(zip(params_list, shapes[1:])):
-        n_params = sum(int(x.size) for x in jax.tree.leaves(p))
         spatial = None
         if layers is not None:
             spatial = getattr(layers[i], "cost_spatial", None)
+        if isinstance(spatial, (list, tuple)):
+            # multi-node packed span: its params are the span's per-node
+            # list, so the exact per-node sum is available — a max would
+            # over-weight spans mixing large-spatial convs with dense
+            # nodes (ADVICE r3)
+            if isinstance(p, (list, tuple)) and len(p) == len(spatial):
+                costs.append(sum(
+                    max(1.0, 2.0 * sum(int(x.size)
+                                       for x in jax.tree.leaves(pn)) * s)
+                    for pn, s in zip(p, spatial)))
+                continue
+            spatial = max(spatial)  # params shape unknown: upper bound
+        n_params = sum(int(x.size) for x in jax.tree.leaves(p))
         if spatial is None:
             spatial = math.prod(out_shape[:-1]) if len(out_shape) > 1 else 1
         costs.append(max(1.0, 2.0 * n_params * spatial))

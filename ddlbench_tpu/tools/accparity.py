@@ -1,0 +1,150 @@
+"""Accuracy-parity benchmark: every engine trains REAL data to accuracy.
+
+The reference's protocol is anchored on per-epoch validation accuracy on real
+datasets (benchmark/mnist/mnist_pytorch.py:102-133, final summary :225-226;
+PipeDream logs prec@1/5, runtime/image_classification/main_with_runtime.py:
+639-653). Loss decreasing on synthetic random-label batches cannot catch
+subtly-wrong training semantics — BN statistics handling, dp's lr x world
+scaling, pipedream's weight-stashing staleness, the hetero conveyor's
+intra-stage batch split all meet their one end-to-end check here: the SAME
+real dataset trained under every engine must reach the SAME accuracy.
+
+Dataset: sklearn's bundled handwritten digits (1797 real 8x8 scans — the one
+real image dataset available in this zero-egress environment; MNIST/CIFAR
+archives are not shipped), exported as MNIST IDX at 28x28 by
+data/digits.export_digits_idx and served through the framework's standard
+real-data ingest (imagefolder.import_mnist_idx -> native raw store).
+
+Each engine runs through the PUBLIC CLI in a subprocess (fresh backend per
+engine, XLA_FLAGS virtual CPU mesh applied at init) and is scraped from its
+``result:`` line — the same machine interface the reference's
+process_output.py scrapers rely on.
+
+One JSON document on stdout:
+    {"dataset": ..., "engines": {...}, "final_spread": s, "pass": true}
+
+Usage:
+    python -m ddlbench_tpu.tools.accparity [--epochs 20] [--lr 0.05]
+        [--arch lenet] [--threshold 0.97] [--max-spread 0.02]
+        [--engines single,dp,gpipe,pipedream,hetero] [--data-dir DIR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+# Engine -> extra CLI argv. Global batch 32 everywhere it divides evenly;
+# hetero's uneven (1,3) plan needs micro_batch % 3 == 0, so it runs 30
+# (documented in the artifact). lr is NOT scaled here — dp applies its own
+# Horovod-parity lr x world scaling internally, which is part of what this
+# benchmark validates.
+ENGINES = {
+    "single": ["-f", "single", "--batch-size", "32"],
+    "dp": ["-f", "dp", "-g", "2", "--batch-size", "32"],
+    "gpipe": ["-f", "gpipe", "-g", "2",
+              "--micro-batch-size", "8", "--num-microbatches", "4"],
+    "pipedream": ["-f", "pipedream", "-g", "2",
+                  "--micro-batch-size", "8", "--num-microbatches", "4"],
+    "hetero": ["-f", "gpipe", "-g", "4", "--stage-replication", "1,3",
+               "--micro-batch-size", "6", "--num-microbatches", "5"],
+    "hetero-pd": ["-f", "pipedream", "-g", "4", "--stage-replication", "1,3",
+                  "--micro-batch-size", "6", "--num-microbatches", "5"],
+}
+
+
+def run_engine(name: str, data_dir: str, args) -> dict:
+    argv = [sys.executable, "-m", "ddlbench_tpu.cli",
+            "-b", "mnist", "-m", args.arch, "-e", str(args.epochs),
+            "-p", "1000", "--dtype", "float32", "--lr", str(args.lr),
+            "-s", "--data-dir", data_dir, "--platform", "cpu",
+            *ENGINES[name]]
+    env = dict(os.environ,
+               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    try:
+        r = subprocess.run(argv, capture_output=True, text=True, env=env,
+                           timeout=args.timeout_s)
+    except subprocess.TimeoutExpired:
+        # one slow engine must not discard the others' completed results
+        return {"error": f"timeout > {args.timeout_s}s"}
+    result = None
+    for line in r.stdout.splitlines():
+        if line.startswith("result: "):
+            result = json.loads(line[len("result: "):])
+    if r.returncode != 0 or result is None:
+        tail = (r.stderr or "").strip().splitlines()[-5:]
+        return {"error": f"rc={r.returncode}", "stderr_tail": tail}
+    return {
+        "final_accuracy": result["valid_accuracy"],
+        "accuracy_per_epoch": [h["accuracy"]
+                               for h in result.get("valid_history", [])],
+        "samples_per_sec": result["samples_per_sec"],
+        "argv": argv[2:],
+    }
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--epochs", type=int, default=20)
+    p.add_argument("--lr", type=float, default=0.05)
+    p.add_argument("--arch", default="lenet")
+    p.add_argument("--threshold", type=float, default=0.97,
+                   help="minimum final validation accuracy per engine")
+    p.add_argument("--max-spread", type=float, default=0.02,
+                   help="maximum final-accuracy spread across engines")
+    p.add_argument("--engines", default="single,dp,gpipe,pipedream,hetero")
+    p.add_argument("--data-dir", default=None,
+                   help="where to export/reuse the digits IDX files "
+                        "(default: a temp dir)")
+    p.add_argument("--timeout-s", type=int, default=1800)
+    args = p.parse_args(argv)
+
+    names = [e.strip() for e in args.engines.split(",") if e.strip()]
+    unknown = [e for e in names if e not in ENGINES]
+    if unknown:
+        p.error(f"unknown engines {unknown}; choose from {sorted(ENGINES)}")
+
+    from ddlbench_tpu.data.digits import export_digits_idx
+
+    data_dir = args.data_dir or os.path.join(
+        tempfile.gettempdir(), "ddlbench_digits")
+    export_digits_idx(data_dir)
+
+    engines = {}
+    for name in names:
+        print(f"accparity: training {name} ({args.epochs} epochs)...",
+              file=sys.stderr, flush=True)
+        engines[name] = run_engine(name, data_dir, args)
+
+    finals = {n: e["final_accuracy"] for n, e in engines.items()
+              if "final_accuracy" in e}
+    spread = (max(finals.values()) - min(finals.values())) if finals else None
+    ok = (len(finals) == len(names)
+          and all(v >= args.threshold for v in finals.values())
+          and spread is not None and spread <= args.max_spread)
+    doc = {
+        "dataset": "sklearn load_digits: 1797 real handwritten digit scans "
+                   "(8x8 UCI optdigits), exported as 28x28 MNIST IDX; "
+                   "stratified 1498 train / 299 test",
+        "protocol": f"{args.epochs} epochs, SGD lr={args.lr} "
+                    f"(dp scales by world), global batch 32 (hetero 30), "
+                    f"per-epoch validation accuracy "
+                    f"(mnist_pytorch.py:102-133 parity)",
+        "arch": args.arch,
+        "engines": engines,
+        "final_accuracies": finals,
+        "final_spread": spread,
+        "threshold": args.threshold,
+        "max_spread": args.max_spread,
+        "pass": ok,
+    }
+    print(json.dumps(doc))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

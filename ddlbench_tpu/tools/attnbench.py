@@ -35,6 +35,10 @@ def main(argv=None) -> int:
     p.add_argument("--prefix", type=int, default=0,
                    help="prefix-LM visible-prefix length (seq2seq shape)")
     p.add_argument("--steps", type=int, default=50)
+    p.add_argument("--repeats", type=int, default=1,
+                   help="timed loops per cell; the reported ms is the median "
+                        "(the shared tunnel swings sub-640 cells run to run "
+                        "— PERF.md auto-dispatch section)")
     p.add_argument("--dtype", default="bfloat16")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
@@ -53,7 +57,7 @@ def main(argv=None) -> int:
     backends = ("flash", "xla") if is_tpu_backend() else ("xla",)
     dtype = jnp.dtype(args.dtype)
 
-    def timed(f, *xs):
+    def timed_once(f, *xs):
         o = f(*xs)
         float(jax.tree.leaves(o)[0].ravel()[0].astype(jnp.float32))
         t0 = time.perf_counter()
@@ -61,6 +65,11 @@ def main(argv=None) -> int:
             o = f(*xs)
         float(jax.tree.leaves(o)[0].ravel()[0].astype(jnp.float32))
         return (time.perf_counter() - t0) / args.steps
+
+    def timed(f, *xs):
+        import statistics
+        return statistics.median(
+            timed_once(f, *xs) for _ in range(max(1, args.repeats)))
 
     for T in (int(t) for t in args.seq_lens.split(",")):
         ks = jax.random.split(jax.random.key(0), 3)

@@ -70,6 +70,10 @@ class MetricLogger:
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self.epoch_throughputs: list[float] = []
         self.epoch_times: list[float] = []
+        # per-epoch validation curve (reference protocol: one validation
+        # accuracy per train epoch, mnist_pytorch.py:102-133); surfaced in
+        # summary() so accuracy-parity artifacts carry the full curve
+        self.valid_history: list[Dict[str, float]] = []
 
     def _emit(self, line: str, record: Dict[str, Any]) -> None:
         if self.rank == 0:
@@ -122,6 +126,8 @@ class MetricLogger:
             # keep matching the line prefix
             line += f" | top5 {top5:.4f}"
             record["top5"] = top5
+        self.valid_history.append(
+            {"epoch": epoch, "loss": loss, "accuracy": accuracy})
         self._emit(line, record)
 
     def summary(self, valid_accuracy: float) -> Dict[str, float]:
@@ -142,6 +148,9 @@ class MetricLogger:
             "valid_accuracy": valid_accuracy,
             "samples_per_sec": avg_tp,
             "sec_per_epoch": avg_t,
+            # full per-epoch curve (printed lines keep the reference
+            # schema; the dict is the structured superset)
+            "valid_history": list(self.valid_history),
         }
 
     def close(self) -> None:

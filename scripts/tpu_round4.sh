@@ -1,0 +1,30 @@
+#!/usr/bin/env bash
+# Round-4 opportunistic TPU collector (VERDICT r3 items 3 and 5, plus the
+# round-3 pending queue): re-measure every headline against the FINAL hybrid
+# kernels with fresh _r4 task names (the round-3 .ok markers persist on this
+# machine), and collect the median-of-5 shape-aware attention sweep that the
+# dispatch decision table is built from.
+#
+# Usage: scripts/tpu_round4.sh [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+add_task bench_r4              python bench.py --probe-timeout-s 60
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task lmbench_longctx_r4    python -m ddlbench_tpu.tools.lmbench -b longctx
+add_task lmbench_longctx32k_r4 python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
+add_task lmbench_synthmt_r4    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s --configs flash+fused,xla+fused,auto
+add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+# Shape-aware attention crossover (median-of-5 per cell): the default B=16
+# causal sweep densified around the old 640 threshold, the B=64 prefix-LM
+# shape (synthmt: reproducible 0.61x flash), and a small-batch long-seq line.
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task attnsweep_b64pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,512,1024 --batch 64 --prefix 128 --repeats 5
+add_task attnsweep_b4_r4       python -m ddlbench_tpu.tools.attnbench --seq-lens 512,1024,2048,4096 --batch 4 --repeats 5
+add_task attnsweep_b16pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 256,512,1024 --batch 16 --prefix 128 --repeats 5
+# per-op HBM-traffic table of the compiled step (VERDICT r3 weak #1): the
+# roofline evidence must come from the TPU executable's fusion decisions
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+
+window_loop "${1:-11}"

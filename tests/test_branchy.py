@@ -368,6 +368,37 @@ def test_packed_span_cost_spatial_drives_balance():
     assert max(w / max(o, 1.0) for w, o in zip(with_hint, without)) >= 16
 
 
+def test_packed_multinode_span_cost_is_per_node_sum():
+    """A multi-node span mixing large-spatial convs with dense nodes prices
+    as the SUM of per-node conv costs, not total_params x max(spatial) —
+    max over the span over-weights it (ADVICE r3)."""
+    from ddlbench_tpu.models.branchy import to_packed_chain
+    from ddlbench_tpu.parallel.packing import layer_flop_costs
+
+    dag = _nas_dag()
+    n = len(dag.layers)
+    # two spans: [0, n-2) holds the conv stack, [n-2, n) pool+fc
+    chain = to_packed_chain(dag, [n - 2])
+    multi = chain.layers[0]
+    assert isinstance(multi.cost_spatial, tuple) and len(multi.cost_spatial) > 1
+    params, _, shapes = init_model(chain, jax.random.key(0))
+    costs = layer_flop_costs(params, shapes, chain.layers)
+    # exact expectation from the underlying DAG nodes
+    pd, _, out_shapes = init_dag(dag, jax.random.key(0))
+
+    def node_cost(i):
+        npar = sum(int(x.size) for x in jax.tree.leaves(pd[i]))
+        sp = (int(np.prod(out_shapes[i][:-1]))
+              if len(out_shapes[i]) > 1 else 1)
+        return max(1.0, 2.0 * npar * sp)
+
+    expected = sum(node_cost(i) for i in range(n - 2))
+    assert costs[0] == pytest.approx(expected, rel=1e-6)
+    # and strictly below the old max-over-span pricing when spatials mix
+    total_params = sum(int(x.size) for x in jax.tree.leaves(params[0]))
+    assert costs[0] < 2.0 * total_params * max(multi.cost_spatial)
+
+
 @pytest.mark.slow
 def test_manual_hetero_over_packed_chain(devices, capsys):
     """Composition: uneven hetero replication x branchy packed chain — the

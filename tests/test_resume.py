@@ -153,3 +153,38 @@ def test_mismatched_plan_is_not_clobbered_and_flags_key(tmp_path, capsys):
     make_strategy(RunConfig(**base, resume=True))
     out = capsys.readouterr().out
     assert "not applicable" in out
+
+
+def test_fresh_run_backs_up_mismatched_plan(tmp_path, capsys):
+    """A FRESH (non-resume) auto-partition run pointed at a checkpoint_dir
+    holding a different configuration's plan — e.g. a flag typo — must not
+    silently clobber it: the old file is preserved as partition.json.bak
+    (ADVICE r3)."""
+    import json
+
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    base = dict(benchmark="cifar10", strategy="gpipe", arch="nasnet_t",
+                num_devices=2, auto_partition=True, micro_batch_size=4,
+                num_microbatches=2, compute_dtype="float32",
+                profile_mode="flops", checkpoint_dir=str(tmp_path))
+    make_strategy(RunConfig(**base))
+    plan_file = tmp_path / "partition.json"
+    original = plan_file.read_text()
+    capsys.readouterr()
+
+    # fresh run, different micro-batch (typo scenario): old plan backed up
+    make_strategy(RunConfig(**dict(base, micro_batch_size=8)))
+    out = capsys.readouterr().out
+    assert "backed up to" in out
+    bak = tmp_path / "partition.json.bak"
+    assert bak.read_text() == original
+    new_plan = json.loads(plan_file.read_text())
+    assert new_plan["key"]["micro_batch_size"] == 8
+
+    # same-key rerun: plain refresh, no backup churn
+    bak.unlink()
+    capsys.readouterr()
+    make_strategy(RunConfig(**dict(base, micro_batch_size=8)))
+    assert "backed up to" not in capsys.readouterr().out
+    assert not bak.exists()
