@@ -80,13 +80,20 @@ def _last_known_onchip() -> dict | None:
                 os.path.getmtime(path), datetime.timezone.utc
             ).isoformat(timespec="seconds")
             source = "file-mtime (approximate; record predates stamping)"
-        if best is None or stamp > best["measured_at"]:
+        # stamped records always outrank mtime-approximated ones: a fresh
+        # checkout gives unstamped files a checkout-time mtime that would
+        # otherwise shadow every genuinely stamped measurement
+        rank = (source == "record", stamp)
+        if best is None or rank > best["_rank"]:
             best = {k: rec[k] for k in
                     ("metric", "value", "unit", "vs_baseline", "platform")
                     if k in rec}
+            best["_rank"] = rank
             best["measured_at"] = stamp
             best["measured_at_source"] = source
             best["source"] = os.path.relpath(path, here)
+    if best:
+        best.pop("_rank")
     return best
 
 

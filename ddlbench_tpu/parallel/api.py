@@ -99,6 +99,10 @@ def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
             old_key = None
         if old_key != key:
             bak = path + ".bak"
+            n = 1
+            while os.path.exists(bak):  # never clobber an earlier backup
+                bak = f"{path}.bak{n}"
+                n += 1
             os.replace(path, bak)
             print(f"auto-partition: existing plan {path} belongs to a "
                   f"different configuration ({old_key}); backed up to {bak}",
