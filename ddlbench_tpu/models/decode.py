@@ -91,6 +91,92 @@ def decode_one(model: LayerModel, params, state, caches, tok, pos):
     return h, out
 
 
+# ---------------------------------------------------------------------------
+# Paged-cache variants (ops/paged_decode.py): copy-on-write beam reorder +
+# live-page-only attention. The decode loops run in one-page SEGMENTS so the
+# page count each attention kernel walks is static (live_pages context).
+# ---------------------------------------------------------------------------
+
+
+def supports_paged(model: LayerModel) -> bool:
+    """True if every cache-allocating layer provides the paged protocol."""
+    return supports_cache(model) and all(
+        l.paged is not None for l in model.layers if l.init_cache is not None
+    )
+
+
+def _require_paged_support(model: LayerModel) -> None:
+    if not supports_paged(model):
+        missing = [l.name for l in model.layers
+                   if l.init_cache is not None and l.paged is None]
+        raise NotImplementedError(
+            f"{model.name} has cached layers without paged-decode support: "
+            f"{missing or 'cached path unsupported'}; use paged=False"
+        )
+
+
+def init_paged_caches(model: LayerModel, params, batch: int, max_len: int,
+                      dtype):
+    return [
+        l.paged.init_cache(p, batch, max_len, dtype) if l.paged else None
+        for l, p in zip(model.layers, params)
+    ]
+
+
+def paged_prefill(model: LayerModel, params, state, caches, tokens):
+    h = tokens
+    out = []
+    for layer, p, s, c in zip(model.layers, params, state, caches):
+        if layer.paged is not None:
+            h, c = layer.paged.prefill(p, s, c, h, 0)
+        elif layer.prefill is not None:
+            h, c = layer.prefill(p, s, c, h, 0)
+        else:
+            h, _ = layer.apply(p, s, h, False)
+        out.append(c)
+    return h, out
+
+
+def paged_decode_one(model: LayerModel, params, state, caches, tok, pos):
+    h = tok
+    out = []
+    for layer, p, s, c in zip(model.layers, params, state, caches):
+        if layer.paged is not None:
+            h, c = layer.paged.decode(p, s, c, h, pos)
+        elif layer.decode is not None:
+            h, c = layer.decode(p, s, c, h, pos)
+        else:
+            h, _ = layer.apply(p, s, h, False)
+        out.append(c)
+    return h, out
+
+
+def paged_reorder_caches(model: LayerModel, caches, parent, pos):
+    # in paged mode every layer either has PagedOps (_require_paged_support)
+    # or carries no cache at all (init_paged_caches gives it None)
+    return [
+        l.paged.reorder(c, parent, pos) if l.paged is not None else None
+        for l, c in zip(model.layers, caches)
+    ]
+
+
+def _segmented_fori(start: int, stop: int, body, carry):
+    """fori_loop over [start, stop) split at page boundaries, each segment
+    traced under live_pages(p + 1) so paged attention sees a static page
+    count. Equivalent to lax.fori_loop(start, stop, body, carry)."""
+    from jax import lax
+
+    from ddlbench_tpu.ops.paged_decode import PAGE, live_pages
+
+    for p in range(start // PAGE, (stop - 1) // PAGE + 1):
+        lo, hi = max(start, p * PAGE), min(stop, (p + 1) * PAGE)
+        if lo >= hi:
+            continue
+        with live_pages(p + 1):
+            carry = lax.fori_loop(lo, hi, body, carry)
+    return carry
+
+
 def _start_len(model: LayerModel, src) -> int:
     if model.src_len is not None and src.shape[1] != model.src_len:
         raise ValueError(
@@ -101,48 +187,66 @@ def _start_len(model: LayerModel, src) -> int:
 
 
 def greedy_decode(model: LayerModel, params, state, src, total_len: int,
-                  dtype=jnp.float32):
+                  dtype=jnp.float32, paged: bool = False):
     """KV-cached greedy continuation of `src` [B, S] to length `total_len`.
 
     Token-identical to models/seq2seq.greedy_decode's full-forward loop for
-    dense models (MoE caveat: see module docstring).
+    dense models (MoE caveat: see module docstring). ``paged=True`` uses the
+    paged cache (attention reads only the live pages — ops/paged_decode.py);
+    greedy never reorders, so the win is the read traffic alone.
     """
-    _require_cache_support(model)
+    if paged:
+        _require_paged_support(model)
+    else:
+        _require_cache_support(model)
     S = _start_len(model, src)
     T = model.in_shape[0]
     if not S < total_len <= T:
         raise ValueError(f"total_len must be in ({S}, {T}], got {total_len}")
     B = src.shape[0]
 
-    caches = init_caches(model, params, B, total_len, dtype)
-    logits, caches = prefill(model, params, state, caches, src)
+    if paged:
+        caches = init_paged_caches(model, params, B, total_len, dtype)
+        logits, caches = paged_prefill(model, params, state, caches, src)
+    else:
+        caches = init_caches(model, params, B, total_len, dtype)
+        logits, caches = prefill(model, params, state, caches, src)
     first = jnp.argmax(logits[:, -1], axis=-1).astype(jnp.int32)
     x0 = (jnp.zeros((B, total_len), jnp.int32)
           .at[:, :S].set(src).at[:, S].set(first))
+    step = paged_decode_one if paged else decode_one
 
     def body(t, carry):
         x, caches = carry
         tok = lax.dynamic_slice_in_dim(x, t, 1, axis=1)
-        logits, caches = decode_one(model, params, state, caches, tok, t)
+        logits, caches = step(model, params, state, caches, tok, t)
         nxt = jnp.argmax(logits[:, 0], axis=-1).astype(jnp.int32)
         return lax.dynamic_update_slice_in_dim(
             x, nxt[:, None], t + 1, axis=1), caches
 
-    x, _ = lax.fori_loop(S, total_len - 1, body, (x0, caches))
+    loop = _segmented_fori if paged else lax.fori_loop
+    x, _ = loop(S, total_len - 1, body, (x0, caches))
     return x
 
 
 def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
                        beam: int = 4, length_penalty: float = 0.6,
-                       dtype=jnp.float32):
+                       dtype=jnp.float32, paged: bool = False):
     """KV-cached beam search; same semantics/scores as
     models/seq2seq.beam_search_decode (length-normalized, GNMT-style).
 
-    Caches are kept per hypothesis ([B*beam, ...]) and re-gathered to follow
-    the parent beam at every expansion — the transformer analog of reordering
-    GNMT's recurrent decoder state.
+    Caches are kept per hypothesis ([B*beam, ...]) and follow the parent
+    beam at every expansion — the transformer analog of reordering GNMT's
+    recurrent decoder state. Default: a physical gather of every cache.
+    ``paged=True``: copy-on-write page tables (ops/paged_decode.py) — the
+    reorder moves pointers plus one partial page instead of the full cache,
+    and attention reads only the live pages. Token-identical to the dense
+    path in f32.
     """
-    _require_cache_support(model)
+    if paged:
+        _require_paged_support(model)
+    else:
+        _require_cache_support(model)
     S = _start_len(model, src)
     T = model.in_shape[0]
     if not S < total_len <= T:
@@ -151,8 +255,12 @@ def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
     V = model.num_classes
 
     src_rep = jnp.repeat(src, beam, axis=0)
-    caches = init_caches(model, params, B * beam, total_len, dtype)
-    logits, caches = prefill(model, params, state, caches, src_rep)
+    if paged:
+        caches = init_paged_caches(model, params, B * beam, total_len, dtype)
+        logits, caches = paged_prefill(model, params, state, caches, src_rep)
+    else:
+        caches = init_caches(model, params, B * beam, total_len, dtype)
+        logits, caches = prefill(model, params, state, caches, src_rep)
     logits_prev = logits[:, -1]  # [B*beam, V]
 
     x0 = jnp.zeros((B * beam, total_len), jnp.int32).at[:, :S].set(src_rep)
@@ -175,17 +283,23 @@ def beam_search_decode(model: LayerModel, params, state, src, total_len: int,
             x[flat_src], token.reshape(-1)[:, None], t, axis=1)
         return x, top_score.reshape(-1), flat_src
 
+    step = paged_decode_one if paged else decode_one
+
     def body(t, carry):
         x, score, caches, logits_prev = carry
         x, score, flat_src = expand(x, score, logits_prev, t)
-        caches = gather_caches(caches, flat_src)
+        if paged:
+            caches = paged_reorder_caches(model, caches, flat_src, t)
+        else:
+            caches = gather_caches(caches, flat_src)
         tok = lax.dynamic_slice_in_dim(x, t, 1, axis=1)
-        logits, caches = decode_one(model, params, state, caches, tok, t)
+        logits, caches = step(model, params, state, caches, tok, t)
         return x, score, caches, logits[:, 0]
 
     # The last position needs only the expansion — no decode_one afterwards
     # (its logits would be discarded), so the loop stops one early.
-    x, score, _, logits_prev = lax.fori_loop(
+    loop = _segmented_fori if paged else lax.fori_loop
+    x, score, _, logits_prev = loop(
         S, total_len - 1, body, (x0, score0, caches, logits_prev))
     x, score, _ = expand(x, score, logits_prev, total_len - 1)
     norm = ((5.0 + (total_len - S)) / 6.0) ** length_penalty

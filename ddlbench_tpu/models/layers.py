@@ -118,6 +118,26 @@ class Layer:
     # would read as 1, underweighting convolutional spans by orders of
     # magnitude in the balanced stage split.
     cost_spatial: Any = None
+    # Optional paged-KV-cache decode protocol (ops/paged_decode.py): the
+    # copy-on-write fast path for beam search. Layers that allocate a cache
+    # (init_cache) may also provide a PagedOps; cache-free decode layers
+    # participate through their ordinary ``decode``.
+    paged: Any = None
+
+
+@dataclasses.dataclass(frozen=True)
+class PagedOps:
+    """Paged-cache decode protocol (models/decode.py paged loops).
+
+    Same shapes/positions as the dense protocol; ``reorder`` is the
+    copy-on-write replacement for the full-cache gather in beam search, and
+    ``decode`` must be traced inside a ``live_pages`` segment (the static
+    page count the attention kernel grid needs)."""
+
+    init_cache: Callable  # (params, batch, max_len, dtype) -> cache
+    prefill: Callable  # (params, state, cache, x, start) -> (y, cache)
+    decode: Callable  # (params, state, cache, x, pos) -> (y, cache)
+    reorder: Callable  # (cache, parent, pos) -> cache
 
 
 @dataclasses.dataclass(frozen=True)

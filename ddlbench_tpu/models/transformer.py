@@ -116,7 +116,38 @@ _ATTENTION_BACKEND = ["auto"]
 # short T the kernel's grid/stream overhead exceeds its HBM savings; the
 # quadratic score tensor is small enough for XLA to keep in registers/VMEM
 # through its own fusions. (perf_runs + PERF.md "auto dispatch", round 3.)
-FLASH_AUTO_MIN_SEQ = 640
+FLASH_AUTO_MIN_SEQ = 640  # base threshold; see flash_pays_off for the table
+
+
+def flash_pays_off(seq_len: int, batch: int, prefix_len: int) -> bool:
+    """Shape-aware flash-vs-XLA decision table (the "auto" backend policy).
+
+    Round 3 used the single FLASH_AUTO_MIN_SEQ threshold, picked from a
+    noisy single-shot sweep (VERDICT r3 weak #2). The table below encodes
+    the REPRODUCIBLE signals of perf_runs/attn_crossover.json and PERF.md's
+    auto-dispatch section, and is refreshed from the round-4 median-of-5
+    sweeps (scripts/tpu_round4.sh attnsweep_* tasks; reader:
+    tools/attnpolicy.py):
+
+    * T >= 768: flash wins monotonically (1.24x @ 768 -> 2.06x @ 2048,
+      B=16 causal) — flash.
+    * T < 640: XLA's fused attention wins (0.82-0.96x) — xla.
+    * [640, 768) is the noise band (sub-2ms cells swing with tunnel
+      latency); flash only for the plain causal shape that measured above
+      1.0 there (prefix == 0, B <= 32).
+    * Prefix-LM at large batch is the strongest XLA signal (0.61x at
+      B=64, T=256 — the synthmt shape): with prefix > 0 and B >= 64,
+      require T >= 1024 until the b64pfx sweep shows the crossover.
+    """
+    if seq_len >= 1024:
+        return True
+    if prefix_len > 0 and batch >= 64:
+        return False
+    if seq_len >= 768:
+        return True
+    if seq_len >= FLASH_AUTO_MIN_SEQ:
+        return prefix_len == 0 and batch <= 32
+    return False
 
 
 def set_attention_backend(backend: str) -> None:
@@ -127,7 +158,7 @@ def set_attention_backend(backend: str) -> None:
     _ATTENTION_BACKEND[0] = backend
 
 
-def _flash_dispatch(*operands):
+def _flash_dispatch(*operands, prefix_len: int = 0):
     """Return (use_flash, interpret) for the current backend setting.
 
     "auto" picks the Pallas kernel only where it partitions correctly:
@@ -151,10 +182,12 @@ def _flash_dispatch(*operands):
     # _pick_block); odd sequence lengths take the XLA einsum path
     if any(o.ndim >= 3 and o.shape[2] % 8 for o in operands):
         return False, False
-    # short (local) sequences: XLA's own fused attention is faster than the
-    # kernel (FLASH_AUTO_MIN_SEQ note above); ring attention shares the rule
-    # on its per-shard block length
-    if max(o.shape[2] for o in operands if o.ndim >= 3) < FLASH_AUTO_MIN_SEQ:
+    # shape-aware crossover (flash_pays_off table): local sequence length,
+    # batch, and the prefix-LM flag all shift the flash/XLA winner; ring
+    # attention applies the same rule to its per-shard block length
+    T = max(o.shape[2] for o in operands if o.ndim >= 3)
+    B = max(o.shape[0] for o in operands if o.ndim >= 3)
+    if not flash_pays_off(T, B, prefix_len):
         return False, False
     return pallas_partitions_safely(*operands), False
 
@@ -172,7 +205,7 @@ def causal_attention(q, k, v, q_offset: int = 0, k_offset: int = 0,
     which implements the same prefix rule with block-level skipping — unless
     set_attention_backend("xla") was called.
     """
-    use_flash, interpret = _flash_dispatch(q, k, v)
+    use_flash, interpret = _flash_dispatch(q, k, v, prefix_len=prefix_len)
     if use_flash:
         from ddlbench_tpu.ops.flash_attention import flash_attention
 
@@ -214,7 +247,7 @@ def ring_attention(q, k, v, axis: str, prefix_len: int = 0):
     block is data-dependent on the shard index, which the kernel's static
     offsets can't express).
     """
-    use_flash, interpret = _flash_dispatch(q, k, v)
+    use_flash, interpret = _flash_dispatch(q, k, v, prefix_len=prefix_len)
     if use_flash and prefix_len == 0:
         return _ring_attention_flash(q, k, v, axis, interpret)
     n = lax.psum(1, axis)
@@ -369,8 +402,22 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
         x, cache = attn_decode_op(p, x, cache, n_heads, pos)
         return mlp(p, x), cache
 
+    def paged_prefill(p, s, cache, x, start):
+        x, cache = attn_paged_prefill_op(p, x, cache, n_heads, prefix_len,
+                                         start)
+        return mlp(p, x), cache
+
+    def paged_decode(p, s, cache, x, pos):
+        x, cache = attn_paged_decode_op(p, x, cache, n_heads, pos)
+        return mlp(p, x), cache
+
+    from ddlbench_tpu.models.layers import PagedOps
+
     return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
-                 prefill=prefill, decode=decode)
+                 prefill=prefill, decode=decode,
+                 paged=PagedOps(attn_paged_cache_init(n_heads, dh),
+                                paged_prefill, paged_decode,
+                                attn_paged_reorder))
 
 
 # ---------------------------------------------------------------------------
@@ -415,6 +462,55 @@ def attn_prefill_op(p, x, cache, n_heads: int, prefix_len: int, start: int):
     o = causal_attention(q, k, v, start, start, prefix_len=prefix_len)
     x = x + o.transpose(0, 2, 1, 3).reshape(B, T, d) @ p["wo"].astype(x.dtype)
     return x, cache
+
+
+def attn_paged_cache_init(n_heads: int, dh: int):
+    def init_cache(p, batch, max_len, dtype):
+        from ddlbench_tpu.ops.paged_decode import paged_cache_init
+
+        return paged_cache_init(batch, max_len, n_heads, dh, dtype)
+
+    return init_cache
+
+
+def attn_paged_prefill_op(p, x, cache, n_heads: int, prefix_len: int,
+                          start: int):
+    """attn_prefill_op with the K/V recorded into pages ([rows, T, H, dh]
+    page layout; ops/paged_decode.py)."""
+    from ddlbench_tpu.ops.paged_decode import paged_prefill_write
+
+    assert start == 0, "chunked prefill (start > 0) is not implemented"
+    B, T, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)
+    cache = paged_prefill_write(cache, k.transpose(0, 2, 1, 3),
+                                v.transpose(0, 2, 1, 3))
+    o = causal_attention(q, k, v, start, start, prefix_len=prefix_len)
+    x = x + o.transpose(0, 2, 1, 3).reshape(B, T, d) @ p["wo"].astype(x.dtype)
+    return x, cache
+
+
+def attn_paged_decode_op(p, x, cache, n_heads: int, pos):
+    """attn_decode_op against the paged cache: write one position into the
+    row's own page slot, then single-query attention over only the LIVE
+    pages (flash-decode kernel on TPU). Must be traced inside a
+    ``live_pages`` segment (models/decode.py paged loops)."""
+    from ddlbench_tpu.ops.paged_decode import (live_pages, paged_attention,
+                                               paged_decode_write)
+
+    B, _, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)  # [B, H, 1, dh]
+    cache = paged_decode_write(cache, k.transpose(0, 2, 1, 3),
+                               v.transpose(0, 2, 1, 3), pos)
+    o = paged_attention(q[:, :, 0].astype(x.dtype), cache, pos,
+                        live_pages.current())  # [B, H, dh]
+    x = x + o.reshape(B, 1, d) @ p["wo"].astype(x.dtype)
+    return x, cache
+
+
+def attn_paged_reorder(cache, parent, pos):
+    from ddlbench_tpu.ops.paged_decode import paged_reorder
+
+    return paged_reorder(cache, parent, pos)
 
 
 def attn_decode_op(p, x, cache, n_heads: int, pos):

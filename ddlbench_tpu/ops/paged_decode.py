@@ -1,0 +1,304 @@
+"""Paged KV cache + single-query flash-decode kernel (beam inference fast path).
+
+Profiling target (VERDICT r3 next #6): KV-cached beam-4 decode measured
+3.2k tok/s — the weakest on-chip number. The dominant traffic is structural:
+``beam_search_decode`` re-gathers EVERY layer's full [rows, H, max_len, dh]
+K/V cache to follow the parent beam at every token (models/decode.py
+``gather_caches``), and the attention einsum then reads the full masked
+max_len even when only t positions are live. For the decodebench
+configuration (seq2seq_s: 8 layers, rows=32, L=256, f32) the permutation
+alone moves ~536 MB per token — read AND write — before any compute.
+
+The paged design eliminates that:
+
+* The cache is a POOL of fixed-size pages ([rows * n_pages, page, H, dh])
+  plus a tiny int32 page TABLE per row. Every row owns one private slot per
+  page index; completed pages are immutable (positions only grow), so a beam
+  reorder copies POINTERS for completed pages and physically copies only the
+  one partial page per row (``paged_reorder`` — copy-on-write). Per-token
+  reorder traffic drops from O(rows * L) to O(rows * page).
+* Attention walks only the LIVE pages through the table — the Pallas kernel
+  (``paged_attention``) scalar-prefetches the table, DMAs each page block
+  directly from the pool (no gathered copy in HBM), and accumulates an
+  online softmax across pages, FlashAttention-style with a page-granular
+  grid. The jnp reference path (``_paged_attention_ref``) materializes the
+  gathered pages and is used on CPU and as the numerics oracle.
+
+vLLM's PagedAttention introduced page tables for serving (PAPERS.md);
+here the copy-on-write table doubles as the beam-search ancestry structure,
+which is what removes the reference-style cache reshuffle
+(GNMT reorders its recurrent decoder state per expansion — SURVEY.md §2
+C13; the transformer analog is the cache gather this module deletes).
+
+The page count walked per step must be static under jit: callers run the
+decode loop in SEGMENTS of one page (models/decode.py paged loops), so each
+segment's kernel compiles with ``num_pages = p + 1``.
+"""
+
+from __future__ import annotations
+
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+NEG_INF = -1e30
+
+# Positions per page; 64 * H * dh blocks DMA efficiently. Module-level so
+# tests can shrink it (every entry point resolves the default at CALL time).
+PAGE = 64
+
+
+class live_pages:
+    """Trace-time marker for how many pages are live in the current decode
+    segment (the static page count the kernel grid needs). The paged decode
+    loops (models/decode.py) trace each one-page segment's body under
+    ``with live_pages(p + 1):``; attention layers read ``current()`` at
+    trace time. Same idiom as models/layers.axis_context."""
+
+    _stack: list = []
+
+    def __init__(self, n: int):
+        self.n = int(n)
+
+    def __enter__(self):
+        live_pages._stack.append(self.n)
+        return self
+
+    def __exit__(self, *exc):
+        live_pages._stack.pop()
+        return False
+
+    @staticmethod
+    def current():
+        if not live_pages._stack:
+            raise RuntimeError(
+                "paged attention decode traced outside a live_pages(...) "
+                "segment — use the paged loops in models/decode.py")
+        return live_pages._stack[-1]
+
+
+def num_pages(total_len: int, page: int | None = None) -> int:
+    page = page or PAGE
+    return -(-total_len // page)
+
+
+def paged_cache_init(rows: int, total_len: int, n_heads: int, dh: int,
+                     dtype, page: int | None = None):
+    """Cache dict: pool_k/pool_v [rows*n_pages, page, H, dh] + table.
+
+    ``table[r, q]`` is the pool slot holding row r's K/V for positions
+    [q*page, (q+1)*page). Initially every row points at its own private
+    slots (slot r*n_pages + q). Invariant maintained by ``paged_reorder``:
+    entries for the current and future pages always point at the row's OWN
+    slot, so decode writes never collide across rows.
+    """
+    page = page or PAGE
+    npg = num_pages(total_len, page)
+    shape = (rows * npg, page, n_heads, dh)
+    own = (jnp.arange(rows, dtype=jnp.int32)[:, None] * npg
+           + jnp.arange(npg, dtype=jnp.int32)[None, :])
+    # NOTE: ``page`` is deliberately NOT in the dict — the cache is a traced
+    # pytree in decode-loop carries, and the kernel's BlockSpecs need the
+    # page size static. Callers pass it explicitly (layer closures carry it).
+    return {
+        "pool_k": jnp.zeros(shape, dtype),
+        "pool_v": jnp.zeros(shape, dtype),
+        "table": own,
+    }
+
+
+def _own_table(rows: int, npg: int) -> jax.Array:
+    return (jnp.arange(rows, dtype=jnp.int32)[:, None] * npg
+            + jnp.arange(npg, dtype=jnp.int32)[None, :])
+
+
+def _pool5d(pool, rows: int):
+    n, page, H, dh = pool.shape
+    return pool.reshape(rows, n // rows, page, H, dh)
+
+
+def paged_prefill_write(cache, k, v, page: int | None = None, start: int = 0):
+    """Write the prompt's K/V [rows, S, H, dh] into each row's own pages."""
+    assert start == 0, "chunked prefill (start > 0) is not implemented"
+    page = page or PAGE
+    rows, S, H, dh = k.shape
+    npg_s = num_pages(S, page)
+    pad = npg_s * page - S
+
+    def write(pool, x):
+        p5 = _pool5d(pool, rows)
+        xp = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+        x5 = xp.reshape(rows, npg_s, page, H, dh).astype(pool.dtype)
+        return p5.at[:, :npg_s].set(x5).reshape(pool.shape)
+
+    return {**cache, "pool_k": write(cache["pool_k"], k),
+            "pool_v": write(cache["pool_v"], v)}
+
+
+def paged_decode_write(cache, k1, v1, pos, page: int | None = None):
+    """Write one token's K/V [rows, 1, H, dh] at dynamic position pos into
+    each row's own slot for the current page."""
+    page = page or PAGE
+    rows = cache["table"].shape[0]
+    p, off = pos // page, pos % page
+
+    def write(pool, x):
+        p5 = _pool5d(pool, rows)
+        blk = x.astype(pool.dtype)[:, None]  # [rows, 1(page), 1(pos), H, dh]
+        return lax.dynamic_update_slice(
+            p5, blk, (0, p, off, 0, 0)).reshape(pool.shape)
+
+    return {**cache, "pool_k": write(cache["pool_k"], k1),
+            "pool_v": write(cache["pool_v"], v1)}
+
+
+def paged_reorder(cache, parent, pos, page: int | None = None):
+    """Copy-on-write beam reorder BEFORE decoding position pos.
+
+    ``parent[r]`` = the row whose history row r continues. Completed pages
+    (< pos // page) are pointer-copied through the table; the current page
+    is physically copied from the parent's slot into r's own slot iff it is
+    partially filled (pos % page > 0). Current-and-future table entries stay
+    owned, preserving the write invariant.
+    """
+    page = page or PAGE
+    rows, npg = cache["table"].shape
+    p, off = pos // page, pos % page
+    own = _own_table(rows, npg)
+    page_idx = jnp.arange(npg, dtype=jnp.int32)[None, :]
+    table = jnp.where(page_idx >= p, own, cache["table"][parent])
+
+    def copy_partial(pool):
+        src_slot = cache["table"][parent, p]  # parent owns its partial page
+        blk = pool[src_slot][:, None]  # [rows, 1, page, H, dh]
+        p5 = _pool5d(pool, rows)
+        return lax.dynamic_update_slice(
+            p5, blk, (0, p, 0, 0, 0)).reshape(pool.shape)
+
+    def no_copy(pool):
+        return pool
+
+    pool_k, pool_v = lax.cond(
+        off > 0,
+        lambda: (copy_partial(cache["pool_k"]), copy_partial(cache["pool_v"])),
+        lambda: (cache["pool_k"], cache["pool_v"]),
+    )
+    return {**cache, "pool_k": pool_k, "pool_v": pool_v, "table": table}
+
+
+# ---------------------------------------------------------------------------
+# Attention over the live pages.
+# ---------------------------------------------------------------------------
+
+
+def _paged_attention_ref(q, cache, pos, npages_live: int,
+                         page: int | None = None):
+    """jnp oracle: gather the live pages, mask, softmax. [rows, H, dh]."""
+    page = page or PAGE
+    rows, H, dh = q.shape
+    tbl = cache["table"][:, :npages_live]  # [rows, np]
+    kc = cache["pool_k"][tbl]  # [rows, np, page, H, dh]
+    vc = cache["pool_v"][tbl]
+    L = npages_live * page
+    kc = kc.reshape(rows, L, H, dh).astype(q.dtype)
+    vc = vc.reshape(rows, L, H, dh).astype(q.dtype)
+    scores = jnp.einsum("rhd,rkhd->rhk", q, kc) / math.sqrt(dh)
+    k_pos = jnp.arange(L)[None, None, :]
+    scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("rhk,rkhd->rhd", probs, vc)
+
+
+def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
+                       m_sc, l_sc, acc_sc, *, scale, page, npages):
+    j = pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full(m_sc.shape, NEG_INF, jnp.float32)
+        l_sc[:] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[:] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    q = q_ref[0, 0].astype(jnp.float32)  # [H, dh]
+    k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
+    v = pv_ref[0].astype(jnp.float32)
+    s = jax.lax.dot_general(  # [H, page]: contract dh per head (batched)
+        q, k, (((1,), (2,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos <= t_ref[0], s, NEG_INF)
+
+    m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
+    alpha = jnp.exp(m_prev - m_new)
+    p_blk = jnp.exp(s - m_new)  # [H, page]
+    l_new = alpha * l_prev + jnp.sum(p_blk, axis=1, keepdims=True)
+    # [H, dh]: per-head p row times the page's V rows (batched over H)
+    pv = jax.lax.dot_general(
+        p_blk, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+    m_sc[:], l_sc[:] = m_new, l_new
+    acc_sc[:] = acc_prev * alpha + pv
+
+    @pl.when(j == npages - 1)
+    def _fini():
+        l_safe = jnp.maximum(l_sc[:], 1e-20)
+        o_ref[0, 0] = (acc_sc[:] / l_safe).astype(o_ref.dtype)
+
+
+def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
+                    interpret: bool = False, use_kernel: bool | None = None):
+    """Single-query attention of q [rows, H, dh] against the live pages.
+
+    ``npages_live`` must be static (callers segment the decode loop by
+    page); ``pos`` is the dynamic query position (mask: key pos <= pos).
+    ``use_kernel=None`` picks the Pallas kernel on TPU, the jnp reference
+    elsewhere.
+    """
+    from ddlbench_tpu.distributed import is_tpu_backend
+
+    page = page or PAGE
+    if use_kernel is None:
+        use_kernel = is_tpu_backend()
+    if not (use_kernel or interpret):
+        return _paged_attention_ref(q, cache, pos, npages_live, page)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, H, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    tbl = cache["table"][:, :npages_live]
+    t32 = jnp.asarray(pos, jnp.int32).reshape(1)
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # table, t
+        grid=(rows, npages_live),
+        in_specs=[
+            pl.BlockSpec((1, 1, H, dh), lambda r, j, tab, t: (r, 0, 0, 0)),
+            pl.BlockSpec((1, page, H, dh),
+                         lambda r, j, tab, t: (tab[r, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, H, dh),
+                         lambda r, j, tab, t: (tab[r, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, H, dh),
+                               lambda r, j, tab, t: (r, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, 1), jnp.float32),
+            pltpu.VMEM((H, dh), jnp.float32),
+        ],
+    )
+    out = pl.pallas_call(
+        functools.partial(_paged_attn_kernel, scale=scale, page=page,
+                          npages=npages_live),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, 1, H, dh), q.dtype),
+        interpret=interpret,
+    )(tbl, t32, q[:, None], cache["pool_k"], cache["pool_v"])
+    return out[:, 0]

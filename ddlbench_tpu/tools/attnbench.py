@@ -82,7 +82,7 @@ def main(argv=None) -> int:
 
         row = {"T": T, "B": args.batch, "H": args.heads,
                "dh": args.head_dim, "prefix": args.prefix,
-               "dtype": args.dtype}
+               "dtype": args.dtype, "repeats": args.repeats}
         for mode in backends:
             set_attention_backend(mode)
             try:

@@ -69,12 +69,34 @@ def main(argv=None) -> int:
                              spec.num_classes, jnp.int32)
     new_tokens = (T - S) * args.batch
 
-    runs = [("greedy", True), ("beam", True)]
-    if not args.skip_uncached:
-        runs += [("greedy", False), ("beam", False)]
+    import ddlbench_tpu.models.decode as dec
 
-    for mode, cached in runs:
-        if mode == "greedy":
+    # "paged": copy-on-write page-table cache + live-page flash decode
+    # (ops/paged_decode.py) — the round-4 fast path; "cached": dense KV
+    # cache with the full gather-per-expansion; "full": the full-forward
+    # reference loop.
+    runs = [("greedy", "paged"), ("beam", "paged"),
+            ("greedy", "cached"), ("beam", "cached")]
+    if not args.skip_uncached:
+        runs += [("greedy", "full"), ("beam", "full")]
+
+    for mode, variant in runs:
+        cached = variant != "full"
+        if variant == "paged" and not dec.supports_paged(model):
+            print(json.dumps({"tool": "decodebench", "mode": mode,
+                              "variant": "paged",
+                              "skipped": f"{args.model} lacks paged support"}),
+                  flush=True)
+            continue
+        if variant == "paged":
+            if mode == "greedy":
+                fn = jax.jit(lambda: dec.greedy_decode(
+                    model, params, state, src, T, paged=True))
+            else:
+                fn = jax.jit(lambda: dec.beam_search_decode(
+                    model, params, state, src, T, beam=args.beam,
+                    paged=True)[0])
+        elif mode == "greedy":
             fn = jax.jit(lambda: s2s.greedy_decode(
                 model, params, state, src, T, use_cache=cached))
         else:
@@ -89,12 +111,21 @@ def main(argv=None) -> int:
         def sync():
             jax.tree.map(lambda a: float(jnp.sum(a)), out[0])
 
-        dt = _bench(run, sync, args.repeats)
+        try:
+            dt = _bench(run, sync, args.repeats)
+        except Exception as e:  # e.g. Mosaic rejects a kernel shape: record
+            # the row and keep the sweep alive (lmbench hbm-oom row pattern)
+            print(json.dumps({
+                "tool": "decodebench", "mode": mode, "variant": variant,
+                "error": f"{type(e).__name__}: {str(e).splitlines()[0][:200]}",
+            }), flush=True)
+            continue
         print(json.dumps({
             "tool": "decodebench",
             "model": args.model,
             "benchmark": args.benchmark,
             "mode": mode,
+            "variant": variant,
             "cached": cached,
             "batch": args.batch,
             "beam": args.beam if mode == "beam" else 1,

@@ -47,6 +47,33 @@ def test_auto_unaligned_seq_takes_xla(on_tpu):
     assert not use_flash
 
 
+def test_policy_prefix_lm_large_batch_takes_xla(on_tpu):
+    """The strongest measured XLA signal: prefix-LM at B=64 (synthmt shape,
+    0.61x flash) stays on XLA through the noise band; plain causal at the
+    same length flips to flash."""
+    assert not tfm._flash_dispatch(*_qkv(768, B=64), prefix_len=128)[0]
+    assert tfm._flash_dispatch(*_qkv(768, B=64), prefix_len=0)[0]
+    # but 1024+ is a flash win in every measured configuration
+    assert tfm._flash_dispatch(*_qkv(1024, B=64), prefix_len=128)[0]
+
+
+def test_policy_noise_band_is_conservative(on_tpu):
+    """[640, 768): flash only for the plain causal small-batch shape."""
+    assert tfm._flash_dispatch(*_qkv(640, B=16))[0]
+    assert not tfm._flash_dispatch(*_qkv(640, B=64))[0]
+    assert not tfm._flash_dispatch(*_qkv(640, B=16), prefix_len=64)[0]
+
+
+def test_policy_table_is_monotone_in_seq_len():
+    """Sanity: for any fixed (B, prefix), longer sequences never flip flash
+    back OFF — the table must stay a crossover, not an interval."""
+    for B in (2, 16, 32, 64, 128):
+        for prefix in (0, 128):
+            decisions = [tfm.flash_pays_off(T, B, prefix)
+                         for T in (128, 256, 512, 640, 768, 1024, 2048, 8192)]
+            assert decisions == sorted(decisions), (B, prefix, decisions)
+
+
 def test_forced_flash_ignores_threshold(on_tpu):
     tfm.set_attention_backend("flash")
     try:

@@ -150,10 +150,11 @@ def test_decodebench_tool(capsys):
                                "--repeats", "1"])
     assert rc == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
-    assert len(lines) == 4
-    modes = {(l["mode"], l["cached"]) for l in lines}
-    assert modes == {("greedy", True), ("beam", True),
-                     ("greedy", False), ("beam", False)}
+    assert len(lines) == 6
+    modes = {(l["mode"], l["variant"]) for l in lines}
+    assert modes == {("greedy", "paged"), ("beam", "paged"),
+                     ("greedy", "cached"), ("beam", "cached"),
+                     ("greedy", "full"), ("beam", "full")}
     assert all(l["tokens_per_sec"] > 0 for l in lines)
 
 

@@ -28,7 +28,7 @@ import sys
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 
-STRATEGIES = ("dp", "fsdp", "gpipe", "hetero", "ep", "sp")
+STRATEGIES = ("dp", "fsdp", "gpipe", "hetero", "nasnet", "ep", "sp")
 
 WORKER = r"""
 import os, sys
@@ -81,6 +81,31 @@ for strategy in sys.argv[1].split(","):
         x = jax.random.randint(jax.random.key(1), (2, 64), 0, 64)
         y = jax.random.randint(jax.random.key(2), (2, 64), 0, 64)
         ts, m = sp.train_step(ts, *sp.shard_batch(x, y), jnp.float32(0.1))
+        metric = float(m["loss"])
+    elif strategy == "nasnet":
+        # packed non-series-parallel DAG (round 3): pipeline cut at
+        # non-articulation positions, so the flat packed boundary buffers
+        # carry MULTIPLE tensors across the process boundary via ppermute
+        from ddlbench_tpu.models.branchy import (build_nasnet, crossing_ids,
+                                                 to_packed_chain)
+        from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+
+        dag = build_nasnet("nasnet_t", (8, 8, 3), 10)
+        cuts = [14, 21, 27]
+        assert any(len(crossing_ids(dag, c)) > 1 for c in cuts)
+        nmodel = to_packed_chain(dag, cuts)
+        cfg = RunConfig(benchmark="cifar10", strategy="gpipe",
+                        arch="nasnet_t", num_devices=8, num_stages=4,
+                        dp_replicas=2, micro_batch_size=2,
+                        num_microbatches=4, compute_dtype="float32")
+        cfg.validate()
+        strat = GPipeStrategy(nmodel, cfg, stage_bounds=[0, 1, 2, 3, 4])
+        ts = strat.init(jax.random.key(0))
+        B = cfg.global_batch()
+        x = jax.random.normal(jax.random.key(1), (B, 8, 8, 3))
+        y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+        ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                 jnp.float32(0.05))
         metric = float(m["loss"])
     else:  # ep: expert-sharded param trees + all_to_all across hosts
         import ddlbench_tpu.models.moe as moe

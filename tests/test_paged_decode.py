@@ -1,0 +1,217 @@
+"""Paged KV cache + flash-decode kernel (ops/paged_decode.py).
+
+Oracle: the dense cached path — a [rows, H, L, dh] cache updated by
+dynamic_update_slice and read by the masked full-length einsum
+(models/transformer.attn_decode_op semantics). The paged structures must
+reproduce it bit-for-bit in f32: writes land in the right page slots, the
+copy-on-write reorder preserves exactly the histories a physical gather
+would, and the Pallas kernel (interpret mode) matches the jnp reference.
+"""
+
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlbench_tpu.ops.paged_decode import (
+    num_pages, paged_attention, paged_cache_init, paged_decode_write,
+    paged_prefill_write, paged_reorder, _paged_attention_ref)
+
+ROWS, H, DH, PAGE = 4, 2, 8, 4
+L = 16  # 4 pages
+
+
+def _rand(key, *shape):
+    return jax.random.normal(jax.random.key(key), shape, jnp.float32)
+
+
+def _dense_attention(q, kd, vd, pos):
+    """Masked full-length single-query attention (attn_decode_op oracle)."""
+    scores = jnp.einsum("rhd,rhkd->rhk", q, kd) / math.sqrt(q.shape[-1])
+    k_pos = jnp.arange(kd.shape[2])[None, None, :]
+    scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
+    p = jax.nn.softmax(scores.astype(jnp.float32), -1)
+    return jnp.einsum("rhk,rhkd->rhd", p, vd)
+
+
+def _gather_pages(cache):
+    """Densify: [rows, H, n_pages*page, dh] view of what the table exposes."""
+    rows, npg = cache["table"].shape
+    k = cache["pool_k"][cache["table"]]  # [rows, npg, page, H, dh]
+    k = k.reshape(rows, npg * PAGE, H, DH)
+    v = cache["pool_v"][cache["table"]].reshape(rows, npg * PAGE, H, DH)
+    return k.transpose(0, 2, 1, 3), v.transpose(0, 2, 1, 3)
+
+
+def test_prefill_and_decode_writes_roundtrip():
+    S = 6  # straddles a page boundary (pages of 4)
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    k = _rand(0, ROWS, S, H, DH)
+    v = _rand(1, ROWS, S, H, DH)
+    cache = paged_prefill_write(cache, k, v, page=PAGE)
+    kd, vd = _gather_pages(cache)
+    np.testing.assert_allclose(kd[:, :, :S], k.transpose(0, 2, 1, 3))
+    np.testing.assert_allclose(vd[:, :, :S], v.transpose(0, 2, 1, 3))
+    # sequential single-token writes continue the stream
+    for t in range(S, L):
+        k1 = _rand(10 + t, ROWS, 1, H, DH)
+        cache = paged_decode_write(cache, k1, k1 * 2.0, t, page=PAGE)
+        kd, vd = _gather_pages(cache)
+        np.testing.assert_allclose(kd[:, :, t], k1[:, 0])
+        np.testing.assert_allclose(vd[:, :, t], 2.0 * kd[:, :, t])
+
+
+@pytest.mark.parametrize("pos,npl", [(3, 1), (7, 2), (10, 3), (14, 4)])
+def test_paged_attention_ref_matches_dense(pos, npl):
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    kfull = _rand(2, ROWS, L, H, DH)
+    vfull = _rand(3, ROWS, L, H, DH)
+    cache = paged_prefill_write(cache, kfull, vfull, page=PAGE)
+    q = _rand(4, ROWS, H, DH)
+    out = _paged_attention_ref(q, cache, pos, npl, page=PAGE)
+    exp = _dense_attention(q, kfull.transpose(0, 2, 1, 3),
+                           vfull.transpose(0, 2, 1, 3), pos)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(exp),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("pos,npl", [(3, 1), (10, 3), (15, 4)])
+def test_paged_attention_kernel_matches_ref(pos, npl):
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    cache = paged_prefill_write(cache, _rand(5, ROWS, L, H, DH),
+                                _rand(6, ROWS, L, H, DH), page=PAGE)
+    q = _rand(7, ROWS, H, DH)
+    ref = _paged_attention_ref(q, cache, pos, npl, page=PAGE)
+    out = paged_attention(q, cache, pos, npl, page=PAGE, interpret=True,
+                          use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_cow_reorder_matches_physical_gather():
+    """Random beam-parent chains: after every reorder+write, the table view
+    must equal a physically gathered dense cache."""
+    S = 4
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    k0, v0 = _rand(8, ROWS, S, H, DH), _rand(9, ROWS, S, H, DH)
+    cache = paged_prefill_write(cache, k0, v0, page=PAGE)
+    # dense mirror [rows, L, H, dh]
+    kd = jnp.zeros((ROWS, L, H, DH)).at[:, :S].set(k0)
+    vd = jnp.zeros((ROWS, L, H, DH)).at[:, :S].set(v0)
+    rng = np.random.default_rng(0)
+    for t in range(S, L):
+        parent = jnp.asarray(rng.integers(0, ROWS, ROWS), jnp.int32)
+        cache = paged_reorder(cache, parent, t, page=PAGE)
+        kd, vd = kd[parent], vd[parent]
+        k1, v1 = _rand(20 + t, ROWS, 1, H, DH), _rand(40 + t, ROWS, 1, H, DH)
+        cache = paged_decode_write(cache, k1, v1, t, page=PAGE)
+        kd = kd.at[:, t].set(k1[:, 0])
+        vd = vd.at[:, t].set(v1[:, 0])
+        kp, vp = _gather_pages(cache)
+        np.testing.assert_allclose(np.asarray(kp[:, :, : t + 1]),
+                                   np.asarray(kd[:, : t + 1].transpose(0, 2, 1, 3)),
+                                   rtol=1e-6, atol=1e-6)
+        np.testing.assert_allclose(np.asarray(vp[:, :, : t + 1]),
+                                   np.asarray(vd[:, : t + 1].transpose(0, 2, 1, 3)),
+                                   rtol=1e-6, atol=1e-6)
+        # attention over the live pages agrees with the dense oracle
+        q = _rand(60 + t, ROWS, H, DH)
+        npl = t // PAGE + 1
+        out = _paged_attention_ref(q, cache, t, npl, page=PAGE)
+        exp = _dense_attention(q, kd.transpose(0, 2, 1, 3),
+                               vd.transpose(0, 2, 1, 3), t)
+        np.testing.assert_allclose(np.asarray(out), np.asarray(exp),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_reorder_under_jit_scan():
+    """The CoW ops must be jit/scan-compatible (static shapes, dynamic pos)."""
+    S = 4
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    cache = paged_prefill_write(cache, _rand(70, ROWS, S, H, DH),
+                                _rand(71, ROWS, S, H, DH), page=PAGE)
+
+    def body(t, cache):
+        parent = (jnp.arange(ROWS, dtype=jnp.int32) + t) % ROWS
+        cache = paged_reorder(cache, parent, t, page=PAGE)
+        k1 = jnp.full((ROWS, 1, H, DH), 1.0 * t)
+        return paged_decode_write(cache, k1, k1, t, page=PAGE)
+
+    out = jax.jit(lambda c: jax.lax.fori_loop(S, L, body, c))(cache)
+    kd, _ = _gather_pages(out)
+    np.testing.assert_allclose(np.asarray(kd[:, :, L - 1]),
+                               np.full((ROWS, H, DH), float(L - 1)))
+
+
+def test_num_pages():
+    assert num_pages(256, 64) == 4
+    assert num_pages(257, 64) == 5
+    assert num_pages(64, 64) == 1
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: paged greedy/beam == dense cached path, token-identical (f32).
+# PAGE is shrunk to 4 so the 16-token stream spans 4 segments — the paged
+# loops, live_pages contexts, CoW reorder, and multi-segment compilation all
+# exercised.
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def small_pages(monkeypatch):
+    import ddlbench_tpu.ops.paged_decode as pd
+
+    monkeypatch.setattr(pd, "PAGE", 4)
+
+
+@pytest.fixture(scope="module")
+def mt_model():
+    import ddlbench_tpu.models.seq2seq as s2s
+    from ddlbench_tpu.models.layers import init_model
+    from ddlbench_tpu.models.transformer import set_attention_backend
+
+    s2s._VARIANTS.setdefault("seq2seq_t",
+                             dict(d_model=32, n_layers=2, n_heads=4))
+    set_attention_backend("xla")
+    model = s2s.build_seq2seq("seq2seq_t", (16,), 64, 8)
+    params, state, _ = init_model(model, jax.random.key(0))
+    yield model, params, state
+    set_attention_backend("auto")
+
+
+@pytest.mark.slow
+def test_paged_greedy_token_identical(mt_model, small_pages):
+    import ddlbench_tpu.models.decode as dec
+
+    model, params, state = mt_model
+    assert dec.supports_paged(model)
+    src = jax.random.randint(jax.random.key(4), (3, 8), 0, 64, jnp.int32)
+    ref = dec.greedy_decode(model, params, state, src, 16)
+    got = dec.greedy_decode(model, params, state, src, 16, paged=True)
+    np.testing.assert_array_equal(np.asarray(got), np.asarray(ref))
+
+
+@pytest.mark.slow
+def test_paged_beam_token_identical(mt_model, small_pages):
+    import ddlbench_tpu.models.decode as dec
+
+    model, params, state = mt_model
+    src = jax.random.randint(jax.random.key(5), (2, 8), 0, 64, jnp.int32)
+    ref_x, ref_s = dec.beam_search_decode(model, params, state, src, 16,
+                                          beam=3)
+    got_x, got_s = dec.beam_search_decode(model, params, state, src, 16,
+                                          beam=3, paged=True)
+    np.testing.assert_array_equal(np.asarray(got_x), np.asarray(ref_x))
+    np.testing.assert_allclose(np.asarray(got_s), np.asarray(ref_s),
+                               rtol=1e-4, atol=1e-5)
+
+
+@pytest.mark.slow
+def test_paged_rejects_unsupported(small_pages):
+    import ddlbench_tpu.models.decode as dec
+    from ddlbench_tpu.models.lstm import build_lstm_seq2seq
+
+    model = build_lstm_seq2seq("seq2seq_lstm_t", (16,), 64, 8)
+    assert not dec.supports_paged(model)
