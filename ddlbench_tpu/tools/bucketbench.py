@@ -1,0 +1,246 @@
+"""Fixed-shape vs length-bucketed translation batching, measured.
+
+The framework's fixed-shape choice for the seq2seq workload is priced
+analytically by ``TranslationData.bucketing_report`` (padding efficiency vs
+per-bucket recompiles — data/translation.py module docstring). VERDICT r3
+next #9 asked for the empirical point: this tool actually IMPLEMENTS
+bucketed batching and measures both modes end to end on one chip.
+
+Method: synthesize a parallel corpus with a realistic (lognormal) length
+distribution, tokenize once, then train the SAME rows two ways:
+
+* fixed: every batch packed at the spec shape (S, T) — one compile;
+* bucketed: each pair packed at the smallest grid bucket that fits it —
+  one seq2seq model variant per bucket (attention masks and position
+  slices are shape-derived, so ALL variants share one set of parameters
+  and one optimizer state; the train step compiles once per bucket).
+
+The metric that decides the design is VALID (non-pad) tokens/sec over the
+whole epoch: both modes process identical text, so the ratio is pure
+padding-efficiency win vs bucket-compile + small-batch-shape cost.
+
+One JSON line per mode + a summary line:
+    {"mode": "bucketed", "valid_tokens_per_sec": N, "num_compiles": 4, ...}
+
+Usage:
+    python -m ddlbench_tpu.tools.bucketbench [-m seq2seq_s] [--pairs 4096]
+        [--batch 64] [--src-len 128] [--tgt-len 128] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+
+def synth_corpus(path: str, n_pairs: int, seed: int = 0) -> None:
+    """Parallel corpus with lognormal sentence lengths (mean ~12 words,
+    heavy tail) over a small word vocabulary — enough structure for BPE."""
+    import numpy as np
+
+    rng = np.random.default_rng(seed)
+    words = [f"w{i:03d}" for i in range(200)]
+
+    def sentence(mean_words: float) -> str:
+        n = max(1, int(rng.lognormal(mean=np.log(mean_words), sigma=0.6)))
+        return " ".join(rng.choice(words, size=n))
+
+    os.makedirs(path, exist_ok=True)
+    for split, count in (("train", n_pairs), ("test", max(32, n_pairs // 10))):
+        with open(os.path.join(path, f"{split}.src"), "w") as fs, \
+                open(os.path.join(path, f"{split}.tgt"), "w") as ft:
+            for _ in range(count):
+                fs.write(sentence(12) + "\n")
+                ft.write(sentence(13) + "\n")
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", default="seq2seq_s")
+    p.add_argument("--pairs", type=int, default=4096)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--src-len", type=int, default=128)
+    p.add_argument("--tgt-len", type=int, default=128)
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--corpus-dir", default=None)
+    p.add_argument("--seed", type=int, default=0)
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddlbench_tpu.config import DatasetSpec, RunConfig
+    from ddlbench_tpu.data.synthetic import mask_source_labels
+    from ddlbench_tpu.data.translation import (PAD, TranslationData,
+                                               _pack, _read_pairs,
+                                               find_parallel_corpus)
+    from ddlbench_tpu.distributed import enable_compilation_cache
+    from ddlbench_tpu.models.layers import init_model
+    from ddlbench_tpu.models.seq2seq import build_seq2seq
+    from ddlbench_tpu.parallel.single import SingleStrategy
+
+    enable_compilation_cache()
+    import ddlbench_tpu.models.seq2seq as s2s
+
+    # tiny variant for CPU smokes (same registration as the test suite)
+    s2s._VARIANTS.setdefault("seq2seq_t",
+                             dict(d_model=32, n_layers=2, n_heads=4))
+    S, T = args.src_len, args.tgt_len
+    corpus = args.corpus_dir or os.path.join(
+        tempfile.gettempdir(),
+        f"ddlb_bucket_corpus_{args.pairs}_s{args.seed}")
+    if not find_parallel_corpus(corpus, "train"):
+        synth_corpus(corpus, args.pairs, args.seed)
+
+    spec = DatasetSpec("bucketmt", (S + T,), 32_768, args.pairs,
+                       args.pairs // 10, kind="seq2seq", src_len=S)
+    data = TranslationData(corpus, spec, args.batch)
+    report = data.bucketing_report()
+    tok = data.tokenizer
+    pairs = _read_pairs(*find_parallel_corpus(corpus, "train"))
+
+    # one parameter set serves every bucket shape: attention masks and
+    # position-table slices are derived from the input shape at apply time
+    cfg = RunConfig(benchmark="synthmt", strategy="single", arch=args.model,
+                    batch_size=args.batch, compute_dtype=args.dtype,
+                    steps_per_epoch=1)
+    full_model = build_seq2seq(args.model, (S + T,), spec.num_classes, S)
+    strat_full = SingleStrategy(full_model, cfg)
+    ts0 = strat_full.init(jax.random.key(0))
+    lr = jnp.float32(1e-4)
+
+    def batches_from_rows(rows: np.ndarray, src_len: int):
+        """[N, S_b + T_b + 1] -> list of (x, labels) batches (drop tail)."""
+        out = []
+        for i in range(rows.shape[0] // args.batch):
+            ids = jnp.asarray(rows[i * args.batch:(i + 1) * args.batch])
+            x, labels = ids[:, :-1], ids[:, 1:]
+            labels = mask_source_labels(labels, src_len)
+            labels = jnp.where((labels == PAD) | (x == PAD), -1, labels)
+            out.append((x, labels))
+        return out
+
+    def run_mode(mode: str, shard_lists):
+        """shard_lists: [(strategy, src_len, batches, valid_tokens)]."""
+        # fresh copy per mode: the donated train_state would otherwise be
+        # consumed by the first mode's run
+        ts = jax.tree.map(jnp.copy, ts0)
+        compile_s = 0.0
+        n_compiles = 0
+        # compile each distinct shape once (not charged to throughput;
+        # reported separately — the cost bucketing adds)
+        if not any(batches for _, _, batches, _ in shard_lists):
+            raise SystemExit(
+                f"not enough pairs for one batch of {args.batch} in any "
+                f"shape — raise --pairs or lower --batch")
+        for strat, _, batches, _ in shard_lists:
+            if not batches:
+                continue
+            t0 = time.perf_counter()
+            # train_step donates ts: chain it (the warmup is a real step)
+            ts, m = strat.train_step(ts, *batches[0], lr)
+            float(m["loss"])
+            compile_s += time.perf_counter() - t0
+            n_compiles += 1
+        t0 = time.perf_counter()
+        total_valid = 0
+        total_rows = 0
+        for strat, _, batches, valid in shard_lists:
+            for x, y in batches:
+                ts, m = strat.train_step(ts, x, y, lr)
+                total_rows += x.shape[0]
+            total_valid += valid
+        float(m["loss"])  # device sync
+        dt = time.perf_counter() - t0
+        return {
+            "tool": "bucketbench", "mode": mode, "model": args.model,
+            "batch": args.batch, "rows_trained": total_rows,
+            "valid_tokens": int(total_valid),
+            "valid_tokens_per_sec": round(total_valid / dt, 1),
+            "steady_sec": round(dt, 3),
+            "num_compiles": n_compiles,
+            "compile_sec": round(compile_s, 1),
+            "platform": jax.devices()[0].platform,
+        }
+
+    # ---- fixed: all rows at (S, T) --------------------------------------
+    rows_fixed, lens_fixed = _pack(tok, pairs, S, T)
+    n_batches = rows_fixed.shape[0] // args.batch
+    kept = n_batches * args.batch
+    valid_fixed = int(lens_fixed[:kept].sum())
+    fixed = run_mode("fixed", [
+        (strat_full, (S, T), batches_from_rows(rows_fixed[:kept], S),
+         valid_fixed)])
+    fixed["padding_efficiency"] = round(report["fixed_efficiency"], 4)
+    print(json.dumps(fixed), flush=True)
+
+    # ---- bucketed: smallest grid bucket that fits each pair -------------
+    grid = [(S // 4, T // 4), (S // 2, T // 2), (3 * S // 4, 3 * T // 4),
+            (S, T)]
+    # bucket criterion from the ONE full-shape _pack above: lens_fixed
+    # holds (src_len clipped at S, [BOS]+tgt(+EOS) len clipped at T+1) per
+    # pair — clipping only affects pairs that belong in the last bucket
+    # anyway, so no re-encoding is needed
+    assigned = [False] * len(pairs)
+    shard_lists = []
+    for gs, gt in grid:
+        # smallest bucket that fits: src <= gs and [BOS]+tgt(+EOS) <= gt+1;
+        # the last (spec-shape) bucket takes every remaining pair so
+        # over-long pairs are truncated exactly as the fixed mode does
+        last = (gs, gt) == grid[-1]
+        take = [i for i in range(len(pairs))
+                if not assigned[i]
+                and (last or (lens_fixed[i][0] <= gs
+                              and lens_fixed[i][1] <= gt + 1))]
+        nb = len(take) // args.batch
+        kept_b = nb * args.batch
+        if not kept_b:
+            continue
+        # only pairs that actually train here are consumed; batch-tail
+        # pairs fall through to a bigger bucket instead of dropping
+        take = take[:kept_b]
+        for i in take:
+            assigned[i] = True
+        rows_b, lens_b = _pack(tok, [pairs[i] for i in take], gs, gt)
+        bmodel = build_seq2seq(args.model, (gs + gt,), spec.num_classes, gs)
+        strat_b = SingleStrategy(bmodel, cfg)
+        shard_lists.append((strat_b, (gs, gt),
+                            batches_from_rows(rows_b, gs),
+                            int(lens_b.sum())))
+    leftover = sum(1 for a in assigned if not a)
+    if leftover:
+        print(json.dumps({"tool": "bucketbench", "note":
+                          f"{leftover} batch-tail pairs train in no "
+                          f"bucket (dropped from the bucketed pass)"}),
+              flush=True)
+    bucketed = run_mode("bucketed", shard_lists)
+    bucketed["padding_efficiency"] = round(report["bucketed_efficiency"], 4)
+    bucketed["buckets"] = [
+        {"shape": list(s[1]), "batches": len(s[2])} for s in shard_lists]
+    print(json.dumps(bucketed), flush=True)
+
+    print(json.dumps({
+        "tool": "bucketbench", "mode": "summary",
+        "bucketed_over_fixed_steady": round(
+            bucketed["valid_tokens_per_sec"] / fixed["valid_tokens_per_sec"],
+            3),
+        "extra_compiles": bucketed["num_compiles"] - fixed["num_compiles"],
+        "extra_compile_sec": round(
+            bucketed["compile_sec"] - fixed["compile_sec"], 1),
+        "analytic_efficiency_ratio": round(
+            report["bucketed_efficiency"] / report["fixed_efficiency"], 3),
+    }), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -26,5 +26,7 @@ add_task attnsweep_b16pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens
 # per-op HBM-traffic table of the compiled step (VERDICT r3 weak #1): the
 # roofline evidence must come from the TPU executable's fusion decisions
 add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+# fixed vs length-bucketed translation batching, empirical (VERDICT r3 #9)
+add_task bucketbench_r4        python -m ddlbench_tpu.tools.bucketbench --pairs 4096 --batch 64
 
 window_loop "${1:-11}"

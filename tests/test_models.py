@@ -15,15 +15,18 @@ from ddlbench_tpu.models.layers import param_count
 CASES = [
     ("resnet18", "mnist"),
     ("resnet18", "cifar10"),
-    ("resnet50", "cifar10"),
     ("vgg11", "mnist"),
     ("vgg16", "cifar10"),
-    ("mobilenetv2", "cifar10"),
     # extended profiler family (models/extra.py; reference profiler
     # models dir "+ unused alexnet/.../resnext/lenet", SURVEY.md §2 B7);
-    # the big ones compile slowly on the 1-core CPU mesh -> slow marker
+    # slow-compiling archs (measured --durations: mobilenetv2 57s,
+    # squeezenet 25s, resnet50 12s on the 1-core CPU) run under --runslow
+    # to keep the default gate < 5 min (VERDICT r3 weak #3); resnet18/vgg
+    # keep the default-gate shape coverage per family
     ("lenet", "mnist"),
-    ("squeezenet", "cifar10"),
+    pytest.param("resnet50", "cifar10", marks=pytest.mark.slow),
+    pytest.param("mobilenetv2", "cifar10", marks=pytest.mark.slow),
+    pytest.param("squeezenet", "cifar10", marks=pytest.mark.slow),
     pytest.param("alexnet", "cifar10", marks=pytest.mark.slow),
     pytest.param("resnext50", "cifar10", marks=pytest.mark.slow),
     pytest.param("densenet121", "mnist", marks=pytest.mark.slow),
@@ -81,6 +84,7 @@ def test_bn_state_updates_in_train_only():
     assert any(changed)
 
 
+@pytest.mark.slow
 def test_extra_family_trains_and_profiles():
     """The extended family members train (one SGD step) and produce profile
     graphs the partitioner consumes — the profile->partition path the
