@@ -82,6 +82,7 @@ def test_freq_and_steps_gating(tmp_path, small_model):
     assert logger.log(2, 0, params, state, x, y) is None
 
 
+@pytest.mark.slow  # 15s; npz-layout test keeps the default coverage
 def test_moe_aux_loss_included(tmp_path):
     from tiny_models import tiny_moe
     from ddlbench_tpu.parallel.common import loss_with_moe_aux

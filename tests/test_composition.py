@@ -72,6 +72,7 @@ def test_partition_interleaved_infeasible_raises():
                               num_microbatches=5)
 
 
+@pytest.mark.slow  # 16s; plain auto-partition stays in the default gate
 def test_auto_partition_interleaved_executes(capsys):
     """make_strategy with V>1 + auto-partition must EXECUTE a plan (grid
     engine, uniform replication) — never emit an advisory one."""

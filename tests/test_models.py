@@ -46,6 +46,7 @@ def test_forward_shapes(arch, ds):
     assert jax.tree.structure(new_state) == jax.tree.structure(state)
 
 
+@pytest.mark.slow  # 69s measured: absorbs the big-arch compile warm
 def test_imagenet_variants_build():
     # Large-input stems: just init (no forward; 224x224 fwd is slow on 1-core CPU).
     for arch in ("resnet50", "vgg16", "mobilenetv2"):
@@ -54,6 +55,7 @@ def test_imagenet_variants_build():
         assert shapes[-1] == (1000,)
 
 
+@pytest.mark.slow  # imagenet-scale init is ~40s of threefry on 1-core CPU
 def test_param_counts_match_torch_families():
     # Known torchvision-scale parameter counts (imagenet heads):
     # resnet18 ~11.7M, resnet50 ~25.6M, vgg16 ~138M, mobilenetv2 ~3.5M.
