@@ -61,10 +61,11 @@ def run_engine(name: str, data_dir: str, args) -> dict:
     argv = [sys.executable, "-m", "ddlbench_tpu.cli",
             "-b", "mnist", "-m", args.arch, "-e", str(args.epochs),
             "-p", "1000", "--dtype", "float32", "--lr", str(args.lr),
-            "-s", "--data-dir", data_dir, "--platform", "cpu",
+            "-s", "--data-dir", data_dir, "--platform", args.platform,
             *ENGINES[name]]
-    env = dict(os.environ,
-               XLA_FLAGS="--xla_force_host_platform_device_count=8")
+    env = dict(os.environ)
+    if args.platform == "cpu":
+        env["XLA_FLAGS"] = "--xla_force_host_platform_device_count=8"
     try:
         r = subprocess.run(argv, capture_output=True, text=True, env=env,
                            timeout=args.timeout_s)
@@ -101,6 +102,10 @@ def main(argv=None) -> int:
                    help="where to export/reuse the digits IDX files "
                         "(default: a temp dir)")
     p.add_argument("--timeout-s", type=int, default=1800)
+    p.add_argument("--platform", default="cpu",
+                   help="cpu (8-virtual-device mesh; the default) or tpu — "
+                        "single-chip engines (single/dp-1) can collect a "
+                        "REAL-chip accuracy point in a tunnel window")
     args = p.parse_args(argv)
 
     names = [e.strip() for e in args.engines.split(",") if e.strip()]

@@ -41,6 +41,9 @@ def main(argv=None) -> int:
     p.add_argument("--batch", type=int, default=8)
     p.add_argument("--beam", type=int, default=4)
     p.add_argument("--repeats", type=int, default=3)
+    p.add_argument("--cache-dtype", default="float32",
+                   help="KV-cache storage dtype for the paged variants "
+                        "(bfloat16 halves cache traffic; scores stay f32)")
     p.add_argument("--skip-uncached", action="store_true",
                    help="skip the slow full-forward reference path")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
@@ -89,13 +92,14 @@ def main(argv=None) -> int:
                   flush=True)
             continue
         if variant == "paged":
+            cdt = jnp.dtype(args.cache_dtype)
             if mode == "greedy":
                 fn = jax.jit(lambda: dec.greedy_decode(
-                    model, params, state, src, T, paged=True))
+                    model, params, state, src, T, dtype=cdt, paged=True))
             else:
                 fn = jax.jit(lambda: dec.beam_search_decode(
                     model, params, state, src, T, beam=args.beam,
-                    paged=True)[0])
+                    dtype=cdt, paged=True)[0])
         elif mode == "greedy":
             fn = jax.jit(lambda: s2s.greedy_decode(
                 model, params, state, src, T, use_cache=cached))
@@ -126,6 +130,8 @@ def main(argv=None) -> int:
             "benchmark": args.benchmark,
             "mode": mode,
             "variant": variant,
+            "cache_dtype": (args.cache_dtype if variant == "paged"
+                            else "float32"),
             "cached": cached,
             "batch": args.batch,
             "beam": args.beam if mode == "beam" else 1,

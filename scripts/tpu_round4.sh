@@ -16,6 +16,10 @@ add_task lmbench_longctx_r4    python -m ddlbench_tpu.tools.lmbench -b longctx
 add_task lmbench_longctx32k_r4 python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
 add_task lmbench_synthmt_r4    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s --configs flash+fused,xla+fused,auto
 add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+# paged decode with a bf16 cache (halves KV traffic; greedy/beam rows only)
+add_task decodebench_bf16_r4   python -m ddlbench_tpu.tools.decodebench --cache-dtype bfloat16 --skip-uncached
+# REAL-chip accuracy point: single-engine digits training on the TPU itself
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
 # Shape-aware attention crossover (median-of-5 per cell): the default B=16
 # causal sweep densified around the old 640 threshold, the B=64 prefix-LM
 # shape (synthmt: reproducible 0.61x flash), and a small-batch long-seq line.
