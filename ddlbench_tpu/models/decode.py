@@ -163,7 +163,14 @@ def paged_reorder_caches(model: LayerModel, caches, parent, pos):
 def _segmented_fori(start: int, stop: int, body, carry):
     """fori_loop over [start, stop) split at page boundaries, each segment
     traced under live_pages(p + 1) so paged attention sees a static page
-    count. Equivalent to lax.fori_loop(start, stop, body, carry)."""
+    count. Equivalent to lax.fori_loop(start, stop, body, carry).
+
+    Each segment wraps ``body`` in a FRESH function object: fori_loop caches
+    the traced body by function identity + avals, and the live-page count is
+    a trace-time constant invisible to that cache — reusing ``body`` would
+    silently run every segment with the first segment's page count
+    (measured: tokens past the first boundary attended only the stale page
+    range)."""
     from jax import lax
 
     from ddlbench_tpu.ops.paged_decode import PAGE, live_pages
@@ -172,8 +179,12 @@ def _segmented_fori(start: int, stop: int, body, carry):
         lo, hi = max(start, p * PAGE), min(stop, (p + 1) * PAGE)
         if lo >= hi:
             continue
-        with live_pages(p + 1):
-            carry = lax.fori_loop(lo, hi, body, carry)
+
+        def seg_body(t, c, _npl=p + 1):
+            with live_pages(_npl):
+                return body(t, c)
+
+        carry = lax.fori_loop(lo, hi, seg_body, carry)
     return carry
 
 

@@ -216,25 +216,23 @@ def moe_block(name: str, d_model: int, n_heads: int, n_experts: int,
             {"gate": p["gate"], "experts": p["experts"]}, h, capacity_factor
         )
 
-    def prefill(p, s, cache, x, start):
+    def _reject_ep():
         if _expert_axis() is not None:
             raise NotImplementedError(
                 "cached decoding under expert_parallel is not supported; "
                 "decode outside the ep shard_map")
+
+    def prefill(p, s, cache, x, start):
+        _reject_ep()
         x, cache = attn_prefill_op(p, x, cache, n_heads, 0, start)
         return _moe_ffn(p, x), cache
 
-    def decode(p, s, cache, x, pos):
-        """One token: attention against the cache, then per-token top-1
-        expert FFN. Decode routing has no capacity limit (each token simply
-        runs its chosen expert — standard MoE inference); this matches the
-        training semantics exactly whenever apply's capacity didn't drop the
+    def _moe_ffn_token(p, x):
+        """Per-token top-1 expert FFN for one decoded position [B, 1, d].
+        Decode routing has no capacity limit (each token simply runs its
+        chosen expert — standard MoE inference); this matches the training
+        semantics exactly whenever apply's capacity didn't drop the
         token."""
-        if _expert_axis() is not None:
-            raise NotImplementedError(
-                "cached decoding under expert_parallel is not supported; "
-                "decode outside the ep shard_map")
-        x, cache = attn_decode_op(p, x, cache, n_heads, pos)
         h = layer_norm(p["ln2"], x)  # [B, 1, d]
         hf = h[:, 0]
         _, onehot, gate = _top1_gate(hf.astype(jnp.float32) @ p["gate"])
@@ -247,11 +245,38 @@ def moe_block(name: str, d_model: int, n_heads: int, n_experts: int,
         ey = ey + pe["b2"][None].astype(hf.dtype)
         w = (onehot * gate[:, None]).astype(hf.dtype)
         y = jnp.einsum("be,bed->bd", w, ey)
-        return x + y[:, None, :], cache
+        return x + y[:, None, :]
+
+    def decode(p, s, cache, x, pos):
+        _reject_ep()
+        x, cache = attn_decode_op(p, x, cache, n_heads, pos)
+        return _moe_ffn_token(p, x), cache
 
     dh = d_model // n_heads
+
+    # paged-cache protocol: same attention sublayer ops as the dense
+    # transformer block (models/transformer.py), same MoE FFN as decode
+    from ddlbench_tpu.models.layers import PagedOps
+    from ddlbench_tpu.models.transformer import (attn_paged_cache_init,
+                                                 attn_paged_decode_op,
+                                                 attn_paged_prefill_op,
+                                                 attn_paged_reorder)
+
+    def paged_prefill(p, s, cache, x, start):
+        _reject_ep()
+        x, cache = attn_paged_prefill_op(p, x, cache, n_heads, 0, start)
+        return _moe_ffn(p, x), cache
+
+    def paged_decode(p, s, cache, x, pos):
+        _reject_ep()
+        x, cache = attn_paged_decode_op(p, x, cache, n_heads, pos)
+        return _moe_ffn_token(p, x), cache
+
     return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
-                 prefill=prefill, decode=decode)
+                 prefill=prefill, decode=decode,
+                 paged=PagedOps(attn_paged_cache_init(n_heads, dh),
+                                paged_prefill, paged_decode,
+                                attn_paged_reorder))
 
 
 def build_transformer_moe(arch: str, in_shape, vocab: int,
