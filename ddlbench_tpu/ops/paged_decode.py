@@ -213,8 +213,50 @@ def _paged_attention_ref(q, cache, pos, npages_live: int,
     return jnp.einsum("rhk,rkhd->rhd", probs, vc)
 
 
+def _attn_page_math(q, k, v, kpos0, t, scale, elementwise: bool):
+    """One page's (scores, p_blk, pv) in f32. Two formulations sharing the
+    math: batched dot_general ("dots" — MXU-shaped but small batched
+    contractions), and a broadcast/multiply/reduce form ("elementwise" —
+    only ops Mosaic lowers canonically on any shape; the compile-risk
+    hedge, selectable via set_paged_kernel_style)."""
+    if elementwise:
+        # s[h, p] = sum_d q[h, d] * k[p, h, d]
+        s = jnp.sum(q[None, :, :] * k, axis=2).T * scale  # [H, page]
+    else:
+        s = jax.lax.dot_general(  # contract dh per head (batched over H)
+            q, k, (((1,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    k_pos = kpos0 + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos <= t, s, NEG_INF)
+    return s
+
+
+def _pv_page_math(p_blk, v, elementwise: bool):
+    if elementwise:
+        # pv[h, d] = sum_p p[h, p] * v[p, h, d]
+        return jnp.sum(p_blk.T[:, :, None] * v, axis=0)  # [H, dh]
+    return jax.lax.dot_general(
+        p_blk, v, (((1,), (0,)), ((0,), (1,))),
+        preferred_element_type=jnp.float32,
+    )
+
+
+# "dots" | "elementwise": which kernel math the compiled paged kernel uses
+# (numerics identical; pinned against each other in tests). decodebench's
+# watcher tasks queue both so a Mosaic rejection of one cannot waste the
+# tunnel window.
+_KERNEL_STYLE = ["dots"]
+
+
+def set_paged_kernel_style(style: str) -> None:
+    assert style in ("dots", "elementwise"), style
+    _KERNEL_STYLE[0] = style
+
+
 def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
-                       m_sc, l_sc, acc_sc, *, scale, page, npages):
+                       m_sc, l_sc, acc_sc, *, scale, page, npages,
+                       elementwise):
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -226,23 +268,14 @@ def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32)  # [H, dh]
     k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
     v = pv_ref[0].astype(jnp.float32)
-    s = jax.lax.dot_general(  # [H, page]: contract dh per head (batched)
-        q, k, (((1,), (2,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32,
-    ) * scale
-    k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
-    s = jnp.where(k_pos <= t_ref[0], s, NEG_INF)
+    s = _attn_page_math(q, k, v, j * page, t_ref[0], scale, elementwise)
 
     m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
     alpha = jnp.exp(m_prev - m_new)
     p_blk = jnp.exp(s - m_new)  # [H, page]
     l_new = alpha * l_prev + jnp.sum(p_blk, axis=1, keepdims=True)
-    # [H, dh]: per-head p row times the page's V rows (batched over H)
-    pv = jax.lax.dot_general(
-        p_blk, v, (((1,), (0,)), ((0,), (1,))),
-        preferred_element_type=jnp.float32,
-    )
+    pv = _pv_page_math(p_blk, v, elementwise)
     m_sc[:], l_sc[:] = m_new, l_new
     acc_sc[:] = acc_prev * alpha + pv
 
@@ -296,7 +329,8 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
     )
     out = pl.pallas_call(
         functools.partial(_paged_attn_kernel, scale=scale, page=page,
-                          npages=npages_live),
+                          npages=npages_live,
+                          elementwise=_KERNEL_STYLE[0] == "elementwise"),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, 1, H, dh), q.dtype),
         interpret=interpret,

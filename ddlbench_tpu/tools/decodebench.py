@@ -49,6 +49,11 @@ def main(argv=None) -> int:
     p.add_argument("--cache-dtype", default="float32",
                    help="KV-cache storage dtype for the paged variants "
                         "(bfloat16 halves cache traffic; scores stay f32)")
+    p.add_argument("--paged-kernel", default="dots",
+                   choices=("dots", "elementwise"),
+                   help="paged-kernel math formulation (identical numerics; "
+                        "the elementwise form is the Mosaic compile-risk "
+                        "hedge — ops/paged_decode.py)")
     p.add_argument("--skip-uncached", action="store_true",
                    help="skip the slow full-forward reference path")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
@@ -83,6 +88,9 @@ def main(argv=None) -> int:
     new_tokens = (T - S) * args.batch
 
     import ddlbench_tpu.models.decode as dec
+    from ddlbench_tpu.ops.paged_decode import set_paged_kernel_style
+
+    set_paged_kernel_style(args.paged_kernel)
 
     # "paged": copy-on-write page-table cache + live-page flash decode
     # (ops/paged_decode.py) — the round-4 fast path; "cached": dense KV

@@ -21,6 +21,9 @@ add_task decodebench_bf16_r4   python -m ddlbench_tpu.tools.decodebench --cache-
 # long-context causal-LM decode (2k stream, 1k prompt): the shape where the
 # paged cache pays most — live pages vs masked full length
 add_task decodebench_lctx_r4   python -m ddlbench_tpu.tools.decodebench -m transformer_s -b longctx --batch 4 --total-len 2048 --repeats 2
+# kernel-formulation hedge: if Mosaic rejects the batched-dot kernel the
+# elementwise form still collects the paged A/B in the same window
+add_task decodebench_ew_r4     python -m ddlbench_tpu.tools.decodebench --paged-kernel elementwise --skip-uncached
 # REAL-chip accuracy point: single-engine digits training on the TPU itself
 add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
 # Shape-aware attention crossover (median-of-5 per cell): the default B=16

@@ -77,15 +77,24 @@ def test_paged_attention_ref_matches_dense(pos, npl):
                                rtol=1e-5, atol=1e-5)
 
 
+@pytest.mark.parametrize("style", ["dots", "elementwise"])
 @pytest.mark.parametrize("pos,npl", [(3, 1), (10, 3), (15, 4)])
-def test_paged_attention_kernel_matches_ref(pos, npl):
+def test_paged_attention_kernel_matches_ref(pos, npl, style):
+    """Both kernel math formulations (the batched-dot form and the
+    Mosaic-compile-risk elementwise hedge) match the jnp oracle."""
+    from ddlbench_tpu.ops.paged_decode import set_paged_kernel_style
+
     cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
     cache = paged_prefill_write(cache, _rand(5, ROWS, L, H, DH),
                                 _rand(6, ROWS, L, H, DH), page=PAGE)
     q = _rand(7, ROWS, H, DH)
     ref = _paged_attention_ref(q, cache, pos, npl, page=PAGE)
-    out = paged_attention(q, cache, pos, npl, page=PAGE, interpret=True,
-                          use_kernel=True)
+    set_paged_kernel_style(style)
+    try:
+        out = paged_attention(q, cache, pos, npl, page=PAGE, interpret=True,
+                              use_kernel=True)
+    finally:
+        set_paged_kernel_style("dots")
     np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
                                rtol=1e-5, atol=1e-5)
 
