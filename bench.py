@@ -45,7 +45,7 @@ def _device_probe(timeout_s: float) -> tuple[bool, str]:
     return True, ""
 
 
-def _last_known_onchip() -> dict | None:
+def _last_known_onchip(perf_dir: str | None = None) -> dict | None:
     """Newest committed on-chip headline from perf_runs/, with provenance.
 
     Three rounds of driver-captured BENCH_r0*.json read "cpu-fallback" because
@@ -60,7 +60,8 @@ def _last_known_onchip() -> dict | None:
 
     best: dict | None = None
     here = os.path.dirname(os.path.abspath(__file__))
-    for path in glob.glob(os.path.join(here, "perf_runs", "bench*.json")):
+    perf_dir = perf_dir or os.path.join(here, "perf_runs")
+    for path in glob.glob(os.path.join(perf_dir, "bench*.json")):
         try:
             with open(path) as f:
                 rec = json.load(f)
