@@ -1,3 +1,6 @@
 """Hand-written TPU kernels (Pallas) for the framework's hot ops."""
 
 from ddlbench_tpu.ops.flash_attention import flash_attention  # noqa: F401
+from ddlbench_tpu.ops.paged_decode import (  # noqa: F401
+    paged_attention, paged_cache_init, paged_decode_write,
+    paged_prefill_write, paged_reorder)

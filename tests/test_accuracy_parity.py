@@ -28,7 +28,10 @@ class _Args:
     arch = "lenet"
     epochs = 20
     lr = 0.05
-    timeout_s = 1800
+    # generous: six sequential 20-epoch subprocess runs on the 1-core box,
+    # frequently contended by the rest of a --runslow sweep
+    timeout_s = 2700
+    platform = "cpu"
 
 
 @pytest.fixture(scope="module")
