@@ -1,0 +1,144 @@
+"""Translation-accuracy benchmark: seq2seq trains to SEQUENCE accuracy.
+
+The reference's translation protocol is anchored on output quality (GNMT
+trains to a BLEU target; pipedream-fork/runtime/translation scrapes loss +
+BLEU-oriented eval — SURVEY.md §2 C13). The image-side analog here is the
+digits accuracy-parity gate (tools/accparity.py); this is the seq2seq side:
+a DETERMINISTIC synthetic language — target = token-permuted source in
+REVERSED order — that a correct encoder-decoder must learn essentially
+perfectly (the reversal forces genuine cross-position attention; the
+permutation forces the full vocabulary mapping), measured by exact-match
+sequence accuracy on held-out sources.
+
+Beyond training correctness this validates INFERENCE quality end to end on
+TRAINED weights — the place where cache/mask/position bugs that random-
+weight token-identity tests can miss actually bite: greedy, beam, the
+full-forward reference loop, and the paged copy-on-write beam path must all
+reproduce the learned mapping.
+
+One JSON document:
+    {"seq_accuracy": {"greedy": 1.0, "beam": 1.0, "paged_beam": 1.0, ...},
+     "token_accuracy": ..., "pass": true}
+
+Usage:
+    python -m ddlbench_tpu.tools.mtacc [--steps 400] [--src-len 12]
+        [--vocab 64] [--batch 64] [--threshold 0.95] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--steps", type=int, default=400)
+    p.add_argument("--src-len", type=int, default=12)
+    p.add_argument("--vocab", type=int, default=64)
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--eval-size", type=int, default=64)
+    p.add_argument("--beam", type=int, default=4)
+    p.add_argument("--threshold", type=float, default=0.95,
+                   help="minimum held-out exact-match sequence accuracy")
+    p.add_argument("--arch", default="seq2seq_t")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    import numpy as np
+    import jax
+    import jax.numpy as jnp
+
+    import ddlbench_tpu.models.decode as dec
+    import ddlbench_tpu.models.seq2seq as s2s
+    from ddlbench_tpu.config import RunConfig
+    from ddlbench_tpu.data.synthetic import mask_source_labels
+    from ddlbench_tpu.distributed import enable_compilation_cache
+    from ddlbench_tpu.parallel.single import SingleStrategy
+
+    enable_compilation_cache()
+    s2s._VARIANTS.setdefault("seq2seq_t",
+                             dict(d_model=32, n_layers=2, n_heads=4))
+    V, S = args.vocab, args.src_len
+    T = 2 * S + 2  # src S | BOS | tgt S | EOS
+    BOS, EOS = 1, 2  # ids 0..3 reserved (pad/bos/eos/unk convention)
+    rng = np.random.default_rng(0)
+    perm = rng.permutation(np.arange(4, V))
+
+    def make(n, seed):
+        r = np.random.default_rng(seed)
+        src = r.integers(4, V, (n, S))
+        tgt = perm[src - 4][:, ::-1]
+        rows = np.zeros((n, T + 1), np.int32)
+        rows[:, :S] = src
+        rows[:, S] = BOS
+        rows[:, S + 1:S + 1 + S] = tgt
+        rows[:, S + 1 + S] = EOS
+        return rows
+
+    model = s2s.build_seq2seq(args.arch, (T,), V, S)
+    cfg = RunConfig(benchmark="synthmt", strategy="single", arch=args.arch,
+                    batch_size=args.batch, compute_dtype="float32",
+                    optimizer="adam", label_smoothing=0.0)
+    if args.steps < 1:
+        p.error("--steps must be >= 1 (the gate measures TRAINED accuracy)")
+    strat = SingleStrategy(model, cfg)
+    ts = strat.init(jax.random.key(0))
+    lr = jnp.float32(args.lr)
+    for step in range(args.steps):
+        rows = jnp.asarray(make(args.batch, 10_000 + step))
+        x, lab = rows[:, :-1], rows[:, 1:]
+        lab = mask_source_labels(lab, S)
+        ts, m = strat.train_step(ts, x, lab, lr)
+    final_loss = float(m["loss"])
+
+    # held-out evaluation (seed range disjoint from training)
+    test = make(args.eval_size, 7)
+    src = jnp.asarray(test[:, :S])
+    gold = test[:, S + 1:S + 1 + S]
+    params, state = ts.params, ts.model_state
+
+    def accuracy(decoded) -> tuple:
+        pred = np.asarray(decoded)[:, S + 1:S + 1 + S]
+        return (float((pred == gold).all(1).mean()),
+                float((pred == gold).mean()))
+
+    outs = {
+        "greedy": dec.greedy_decode(model, params, state, src, T),
+        "beam": dec.beam_search_decode(model, params, state, src, T,
+                                       beam=args.beam)[0],
+        "paged_beam": dec.beam_search_decode(model, params, state, src, T,
+                                             beam=args.beam, paged=True)[0],
+        "full_forward_greedy": s2s.greedy_decode(model, params, state, src,
+                                                 T, use_cache=False),
+    }
+    seq_acc, tok_acc = {}, {}
+    for name, out in outs.items():
+        seq_acc[name], tok_acc[name] = accuracy(out)
+
+    ok = all(v >= args.threshold for v in seq_acc.values())
+    print(json.dumps({
+        "tool": "mtacc",
+        "task": f"target = vocabulary-permuted source, reversed "
+                f"(S={S}, vocab={V}; deterministic — exact match is the "
+                f"correctness bar)",
+        "arch": args.arch,
+        "train_steps": args.steps,
+        "final_loss": round(final_loss, 5),
+        "eval_size": args.eval_size,
+        "seq_accuracy": seq_acc,
+        "token_accuracy": tok_acc,
+        "threshold": args.threshold,
+        "platform": jax.devices()[0].platform,
+        "pass": ok,
+    }))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# (Re)start the round-4 TPU window watcher safely: kill by recorded pid
+# (pattern-based pkill matches the invoking shell's own command string and
+# has repeatedly killed the caller instead), then launch detached.
+#
+# Usage: bash scripts/watcher_ctl.sh [max_hours]
+set -u
+cd "$(dirname "$0")/.."
+PIDFILE=perf_runs/tpu_round4.pid
+if [ -f "$PIDFILE" ]; then
+  # setsid made the recorded pid a session leader: kill the whole group so
+  # an in-flight benchmark task dies with the watcher (a survivor would be
+  # re-launched by the new watcher and the two would contend for the chip)
+  kill -- "-$(cat "$PIDFILE")" 2>/dev/null || kill "$(cat "$PIDFILE")" 2>/dev/null
+  sleep 1
+fi
+setsid nohup bash scripts/tpu_round4.sh "${1:-9}" \
+  >> perf_runs/tpu_round4.log 2>&1 < /dev/null &
+echo $! > "$PIDFILE"
+sleep 1
+if kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then
+  echo "watcher alive, pid $(cat "$PIDFILE")"
+else
+  echo "watcher FAILED to start" >&2
+  exit 1
+fi

@@ -1,0 +1,26 @@
+"""Translation-accuracy gate (tools/mtacc.py) — the seq2seq analog of the
+digits accuracy-parity benchmark: a deterministic synthetic language
+(permuted + reversed source) trained to exact-match sequence accuracy, with
+greedy / beam / PAGED beam / full-forward decode all reproducing the learned
+mapping on held-out sources (GNMT quality-protocol analog, SURVEY.md §2
+C13; committed artifact perf_runs/mt_accuracy.json)."""
+
+import json
+
+import pytest
+
+pytestmark = pytest.mark.slow  # ~400 train steps + four decode compiles
+
+
+def test_seq2seq_trains_to_sequence_accuracy(capsys):
+    from ddlbench_tpu.tools import mtacc
+
+    rc = mtacc.main(["--platform", "cpu", "--eval-size", "32"])
+    assert rc == 0
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert doc["pass"]
+    for name, acc in doc["seq_accuracy"].items():
+        assert acc >= 0.95, (name, acc)
+    # the cached paths must agree with the full-forward reference exactly
+    assert doc["seq_accuracy"]["greedy"] == \
+        doc["seq_accuracy"]["full_forward_greedy"]
