@@ -21,3 +21,21 @@ def test_attnbench_runs(capsys):
         assert "xla_ms" in l and l["xla_ms"] > 0
         assert "flash_ms" not in l and "flash_speedup" not in l
         assert l["prefix"] == 0 and l["B"] == 1
+
+
+def test_dispatch_policy_agrees_with_measured_sweeps():
+    """tools/attnpolicy.py: the flash_pays_off decision table must agree
+    with every MEDIAN-BACKED measured crossover cell in perf_runs/ (rc 1 on
+    a hard disagreement); legacy single-shot rows only report provisional.
+    Re-runs automatically as new sweeps land each round."""
+    import io
+    from contextlib import redirect_stdout
+
+    from ddlbench_tpu.tools import attnpolicy
+
+    buf = io.StringIO()
+    with redirect_stdout(buf):
+        rc = attnpolicy.main(["--dir", "perf_runs"])
+    doc = json.loads(buf.getvalue())
+    assert rc == 0, doc["disagreements"]
+    assert doc["num_cells"] >= 1  # the round-3 crossover artifact at least
