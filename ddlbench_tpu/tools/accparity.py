@@ -54,6 +54,11 @@ ENGINES = {
                "--micro-batch-size", "6", "--num-microbatches", "5"],
     "hetero-pd": ["-f", "pipedream", "-g", "4", "--stage-replication", "1,3",
                   "--micro-batch-size", "6", "--num-microbatches", "5"],
+    # interleaved (virtual-stage) timetables: 2 model chunks per device
+    "gpipe-iv": ["-f", "gpipe", "-g", "2", "--virtual-stages", "2",
+                 "--micro-batch-size", "8", "--num-microbatches", "4"],
+    "pipedream-iv": ["-f", "pipedream", "-g", "2", "--virtual-stages", "2",
+                     "--micro-batch-size", "8", "--num-microbatches", "4"],
 }
 
 
