@@ -84,7 +84,8 @@ def categorize(opcode: str, rhs: str) -> str:
                 + " " + (tgt.group(1) if tgt else "")).lower()
         if "conv" in hint:
             return "convolution"
-        if "dot" in hint or "matmul" in hint or "einsum" in hint:
+        if ("dot" in hint or "matmul" in hint or "einsum" in hint
+                or "gemm" in hint):
             return "matmul"
         if "reduce" in hint or "norm" in hint or "mean" in hint:
             return "reduce"
