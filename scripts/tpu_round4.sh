@@ -1,21 +1,28 @@
 #!/usr/bin/env bash
-# Round-4 opportunistic TPU collector (VERDICT r3 items 3 and 5, plus the
-# round-3 pending queue): re-measure every headline against the FINAL hybrid
-# kernels with fresh _r4 task names (the round-3 .ok markers persist on this
-# machine), and collect the median-of-5 shape-aware attention sweep that the
-# dispatch decision table is built from.
+# Round-4 opportunistic TPU collector (VERDICT r3 items 3-6/9, plus the
+# round-3 pending queue): fresh _r4 task names (the round-3 .ok markers
+# persist on this machine). Ordered so a SHORT window still collects the
+# unique round-4 evidence first: headline bench, the paged-decode A/B, the
+# dispatch sweep, the roofline table — then the round-3 re-measurements.
 #
-# Usage: scripts/tpu_round4.sh [max_hours]
+# Usage: scripts/tpu_round4.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
 set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
+# -- unique round-4 evidence first ------------------------------------------
 add_task bench_r4              python bench.py --probe-timeout-s 60
-add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
-add_task lmbench_longctx_r4    python -m ddlbench_tpu.tools.lmbench -b longctx
-add_task lmbench_longctx32k_r4 python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
-add_task lmbench_synthmt_r4    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s --configs flash+fused,xla+fused,auto
+# paged vs dense-cached vs full-forward decode (VERDICT r3 next #6)
 add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+# per-op HBM-traffic table of the compiled step (VERDICT r3 weak #1)
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+# Shape-aware attention crossover (median-of-5 per cell): the default B=16
+# causal sweep densified around the old 640 threshold, the B=64 prefix-LM
+# shape (synthmt: reproducible 0.61x flash), and a small-batch long-seq line.
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task attnsweep_b64pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,512,1024 --batch 64 --prefix 128 --repeats 5
+add_task attnsweep_b4_r4       python -m ddlbench_tpu.tools.attnbench --seq-lens 512,1024,2048,4096 --batch 4 --repeats 5
+add_task attnsweep_b16pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 256,512,1024 --batch 16 --prefix 128 --repeats 5
 # paged decode with a bf16 cache (halves KV traffic; greedy/beam rows only)
 add_task decodebench_bf16_r4   python -m ddlbench_tpu.tools.decodebench --cache-dtype bfloat16 --skip-uncached
 # long-context causal-LM decode (2k stream, 1k prompt): the shape where the
@@ -24,19 +31,15 @@ add_task decodebench_lctx_r4   python -m ddlbench_tpu.tools.decodebench -m trans
 # kernel-formulation hedge: if Mosaic rejects the batched-dot kernel the
 # elementwise form still collects the paged A/B in the same window
 add_task decodebench_ew_r4     python -m ddlbench_tpu.tools.decodebench --paged-kernel elementwise --skip-uncached
-# REAL-chip accuracy point: single-engine digits training on the TPU itself
-add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
-# Shape-aware attention crossover (median-of-5 per cell): the default B=16
-# causal sweep densified around the old 640 threshold, the B=64 prefix-LM
-# shape (synthmt: reproducible 0.61x flash), and a small-batch long-seq line.
-add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
-add_task attnsweep_b64pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,512,1024 --batch 64 --prefix 128 --repeats 5
-add_task attnsweep_b4_r4       python -m ddlbench_tpu.tools.attnbench --seq-lens 512,1024,2048,4096 --batch 4 --repeats 5
-add_task attnsweep_b16pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 256,512,1024 --batch 16 --prefix 128 --repeats 5
-# per-op HBM-traffic table of the compiled step (VERDICT r3 weak #1): the
-# roofline evidence must come from the TPU executable's fusion decisions
-add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
 # fixed vs length-bucketed translation batching, empirical (VERDICT r3 #9)
 add_task bucketbench_r4        python -m ddlbench_tpu.tools.bucketbench --pairs 4096 --batch 64
+# REAL-chip accuracy point: single-engine digits training on the TPU itself
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
 
-window_loop "${1:-11}"
+# -- round-3 re-measurements against the final hybrid kernels ----------------
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task lmbench_longctx_r4    python -m ddlbench_tpu.tools.lmbench -b longctx
+add_task lmbench_longctx32k_r4 python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
+add_task lmbench_synthmt_r4    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s --configs flash+fused,xla+fused,auto
+
+window_loop "${1:-9}"
