@@ -74,17 +74,27 @@ def _last_known_onchip(perf_dir: str | None = None) -> dict | None:
         # Recency: prefer the record's own measured_at stamp (records since
         # round 4 carry one); file mtime is only a fallback and is marked as
         # approximate — git checkouts do not preserve measurement times.
-        if "measured_at" in rec:
-            stamp, source = rec["measured_at"], "record"
-        else:
-            stamp = datetime.datetime.fromtimestamp(
-                os.path.getmtime(path), datetime.timezone.utc
-            ).isoformat(timespec="seconds")
-            source = "file-mtime (approximate; record predates stamping)"
         # stamped records always outrank mtime-approximated ones: a fresh
         # checkout gives unstamped files a checkout-time mtime that would
-        # otherwise shadow every genuinely stamped measurement
-        rank = (source == "record", stamp)
+        # otherwise shadow every genuinely stamped measurement. Compare
+        # parsed datetimes, not strings — stamps written by bench.py carry
+        # a +00:00 offset while legacy/hand-authored ones may be naive, and
+        # lexicographic comparison mis-ranks the mixed formats (ADVICE r4).
+        stamp = source = when = None
+        if "measured_at" in rec:
+            try:
+                when = datetime.datetime.fromisoformat(rec["measured_at"])
+                stamp, source = rec["measured_at"], "record"
+            except (TypeError, ValueError):
+                pass  # malformed stamp: fall back to mtime, don't drop
+        if when is None:
+            when = datetime.datetime.fromtimestamp(
+                os.path.getmtime(path), datetime.timezone.utc)
+            stamp = when.isoformat(timespec="seconds")
+            source = "file-mtime (approximate; record predates stamping)"
+        if when.tzinfo is None:
+            when = when.replace(tzinfo=datetime.timezone.utc)
+        rank = (source == "record", when)
         if best is None or rank > best["_rank"]:
             best = {k: rec[k] for k in
                     ("metric", "value", "unit", "vs_baseline", "platform")

@@ -122,18 +122,36 @@ def _pool5d(pool, rows: int):
 
 
 def paged_prefill_write(cache, k, v, page: int | None = None, start: int = 0):
-    """Write the prompt's K/V [rows, S, H, dh] into each row's own pages."""
-    assert start == 0, "chunked prefill (start > 0) is not implemented"
-    page = page or PAGE
-    rows, S, H, dh = k.shape
-    npg_s = num_pages(S, page)
-    pad = npg_s * page - S
+    """Write a prompt chunk's K/V [rows, S, H, dh] at positions
+    [start, start+S) into each row's own pages.
 
-    def write(pool, x):
-        p5 = _pool5d(pool, rows)
-        xp = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
-        x5 = xp.reshape(rows, npg_s, page, H, dh).astype(pool.dtype)
-        return p5.at[:, :npg_s].set(x5).reshape(pool.shape)
+    ``start`` is static (a Python int): long-context serving chunks the
+    prompt, calling this once per chunk. ``start == 0`` (the whole-prompt
+    case) takes a dense reshape path; a later chunk — which may begin at a
+    page-unaligned position inside a partially-filled page — scatters by
+    (page, offset) index so existing positions in that page are preserved.
+    """
+    page = page or PAGE
+    start = int(start)
+    rows, S, H, dh = k.shape
+
+    if start == 0:
+        npg_s = num_pages(S, page)
+        pad = npg_s * page - S
+
+        def write(pool, x):
+            p5 = _pool5d(pool, rows)
+            xp = jnp.pad(x, ((0, 0), (0, pad), (0, 0), (0, 0)))
+            x5 = xp.reshape(rows, npg_s, page, H, dh).astype(pool.dtype)
+            return p5.at[:, :npg_s].set(x5).reshape(pool.shape)
+    else:
+        pos = start + jnp.arange(S, dtype=jnp.int32)
+        pg, off = pos // page, pos % page
+
+        def write(pool, x):
+            p5 = _pool5d(pool, rows)
+            return p5.at[:, pg, off].set(x.astype(pool.dtype)).reshape(
+                pool.shape)
 
     return {**cache, "pool_k": write(cache["pool_k"], k),
             "pool_v": write(cache["pool_v"], v)}
@@ -250,6 +268,14 @@ _KERNEL_STYLE = ["dots"]
 
 
 def set_paged_kernel_style(style: str) -> None:
+    """Select the default kernel formulation for subsequent TRACES.
+
+    The global is read at trace time only: a decode function that was
+    already jit-compiled keeps whichever formulation it was traced with
+    (the style is not part of the jit cache key). Call this before the
+    first trace — decodebench does — or pass ``kernel_style=`` directly to
+    ``paged_attention`` from code that controls its own trace.
+    """
     assert style in ("dots", "elementwise"), style
     _KERNEL_STYLE[0] = style
 
@@ -286,16 +312,20 @@ def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
 
 
 def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
-                    interpret: bool = False, use_kernel: bool | None = None):
+                    interpret: bool = False, use_kernel: bool | None = None,
+                    kernel_style: str | None = None):
     """Single-query attention of q [rows, H, dh] against the live pages.
 
     ``npages_live`` must be static (callers segment the decode loop by
     page); ``pos`` is the dynamic query position (mask: key pos <= pos).
     ``use_kernel=None`` picks the Pallas kernel on TPU, the jnp reference
-    elsewhere.
+    elsewhere. ``kernel_style`` ("dots" | "elementwise") overrides the
+    module default set by ``set_paged_kernel_style``; both are resolved at
+    trace time.
     """
     from ddlbench_tpu.distributed import is_tpu_backend
 
+    assert kernel_style in (None, "dots", "elementwise"), kernel_style
     page = page or PAGE
     if use_kernel is None:
         use_kernel = is_tpu_backend()
@@ -328,9 +358,9 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
         ],
     )
     out = pl.pallas_call(
-        functools.partial(_paged_attn_kernel, scale=scale, page=page,
-                          npages=npages_live,
-                          elementwise=_KERNEL_STYLE[0] == "elementwise"),
+        functools.partial(
+            _paged_attn_kernel, scale=scale, page=page, npages=npages_live,
+            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise"),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, 1, H, dh), q.dtype),
         interpret=interpret,

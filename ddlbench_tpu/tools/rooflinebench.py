@@ -155,21 +155,28 @@ def analytic_model(model, cfg, batch: int) -> dict:
     changing torch-BN semantics (the stats must be complete before any
     output element is normalized).
     """
+    import math
+
     import jax
 
     from ddlbench_tpu.models import init_model
 
-    params, _, shapes = init_model(model, jax.random.key(0))
+    params, states, shapes = init_model(model, jax.random.key(0))
     act = 2  # bf16
     conv_io = bn_extra = 0
-    for i, out_shape in enumerate(shapes[1:]):
-        import math
-
-        in_n = math.prod(shapes[i]) if shapes[i] else 0
+    for p, s, in_shape, out_shape in zip(params, states, shapes, shapes[1:]):
+        # only layers that actually carry a conv (a 4-D kernel leaf) and a
+        # BN (running-stats state, models/layers.bn_init) contribute —
+        # pool/flatten/fc layers move bytes too, but charging them conv+BN
+        # traffic inflated the "irreducible" bound (ADVICE r4)
+        has_conv = any(getattr(x, "ndim", 0) == 4 for x in jax.tree.leaves(p))
+        has_bn = bool(jax.tree.leaves(s))
+        in_n = math.prod(in_shape) if in_shape else 0
         out_n = math.prod(out_shape) if out_shape else 0
-        conv_io += batch * (in_n + out_n) * act
-        # every conv in these CNNs carries a BN (models/layers.conv_bn)
-        bn_extra += batch * 2 * out_n * act
+        if has_conv:
+            conv_io += batch * (in_n + out_n) * act
+        if has_bn:
+            bn_extra += batch * 2 * out_n * act
     param_b = sum(int(x.size) * 4 for x in jax.tree.leaves(params))
     fwd = conv_io + bn_extra
     return {

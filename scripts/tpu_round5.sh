@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Round-5 opportunistic TPU collector. The round-4 tunnel never opened
+# (perf_runs/tpu_round4.log: every probe through 04:52 failed), so the
+# whole round-4 queue carries over verbatim — same task names, so any task
+# that DOES land keeps its .ok marker across watcher restarts. Round-5
+# additions go after the carried queue: a BatchNorm-arch real-chip accuracy
+# point (VERDICT r4 next #2/#7) and a re-stamped bench for provenance
+# (VERDICT r4 weak #4).
+#
+# Usage: scripts/tpu_round5.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- unique round-4 evidence first (carried; names unchanged) ---------------
+add_task bench_r4              python bench.py --probe-timeout-s 60
+add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task attnsweep_b64pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,512,1024 --batch 64 --prefix 128 --repeats 5
+add_task attnsweep_b4_r4       python -m ddlbench_tpu.tools.attnbench --seq-lens 512,1024,2048,4096 --batch 4 --repeats 5
+add_task attnsweep_b16pfx_r4   python -m ddlbench_tpu.tools.attnbench --seq-lens 256,512,1024 --batch 16 --prefix 128 --repeats 5
+add_task decodebench_bf16_r4   python -m ddlbench_tpu.tools.decodebench --cache-dtype bfloat16 --skip-uncached
+add_task decodebench_lctx_r4   python -m ddlbench_tpu.tools.decodebench -m transformer_s -b longctx --batch 4 --total-len 2048 --repeats 2
+add_task decodebench_ew_r4     python -m ddlbench_tpu.tools.decodebench --paged-kernel elementwise --skip-uncached
+add_task bucketbench_r4        python -m ddlbench_tpu.tools.bucketbench --pairs 4096 --batch 64
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+
+# -- round-3 re-measurements against the final hybrid kernels ----------------
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task lmbench_longctx_r4    python -m ddlbench_tpu.tools.lmbench -b longctx
+add_task lmbench_longctx32k_r4 python -m ddlbench_tpu.tools.lmbench -b longctx32k --steps 10
+add_task lmbench_synthmt_r4    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s --configs flash+fused,xla+fused,auto
+
+# -- round-5 additions -------------------------------------------------------
+# BatchNorm-arch accuracy on the real chip: the one end-to-end check of BN
+# batch-stats handling on TPU (VERDICT r4 next #2/#7; lenet has no BN)
+add_task accparity_bn_tpu_r5   python -m ddlbench_tpu.tools.accparity --engines single --arch resnet18 --epochs 12 --lr 0.02 --platform tpu
+
+window_loop "${1:-11}"

@@ -1,21 +1,36 @@
 #!/usr/bin/env bash
-# (Re)start the round-4 TPU window watcher safely: kill by recorded pid
-# (pattern-based pkill matches the invoking shell's own command string and
-# has repeatedly killed the caller instead), then launch detached.
+# (Re)start the TPU window watcher safely: kill by recorded pid (pattern-
+# based pkill matches the invoking shell's own command string and has
+# repeatedly killed the caller instead), then launch detached.
+#
+# A recorded pid is only killed if /proc/<pid>/cmdline still names a
+# tpu_round watcher script — after a reboot the pid may have been reused by
+# an unrelated process, and killing its whole group would be destructive.
+# ALL perf_runs/tpu_round*.pid files are swept, not just the current
+# round's: a round rollover must not orphan the previous round's watcher
+# (two watchers would run their queues against the chip simultaneously).
 #
 # Usage: bash scripts/watcher_ctl.sh [max_hours]
 set -u
 cd "$(dirname "$0")/.."
-PIDFILE=perf_runs/tpu_round4.pid
-if [ -f "$PIDFILE" ]; then
-  # setsid made the recorded pid a session leader: kill the whole group so
-  # an in-flight benchmark task dies with the watcher (a survivor would be
-  # re-launched by the new watcher and the two would contend for the chip)
-  kill -- "-$(cat "$PIDFILE")" 2>/dev/null || kill "$(cat "$PIDFILE")" 2>/dev/null
-  sleep 1
-fi
-setsid nohup bash scripts/tpu_round4.sh "${1:-9}" \
-  >> perf_runs/tpu_round4.log 2>&1 < /dev/null &
+WATCHER=scripts/tpu_round5.sh
+PIDFILE=perf_runs/tpu_round5.pid
+LOG=perf_runs/tpu_round5.log
+for pf in perf_runs/tpu_round*.pid; do
+  [ -f "$pf" ] || continue
+  pid=$(cat "$pf")
+  if tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null | grep -q "tpu_round"; then
+    # setsid made the recorded pid a session leader: kill the whole group so
+    # an in-flight benchmark task dies with the watcher (a survivor would be
+    # re-launched by the new watcher and the two would contend for the chip)
+    kill -- "-$pid" 2>/dev/null || kill "$pid" 2>/dev/null
+    sleep 1
+  fi
+  rm -f "$pf"
+done
+setsid nohup bash -c 'bash "$1" "$2"; rm -f "$3"' \
+  _ "$WATCHER" "${1:-11}" "$PIDFILE" \
+  >> "$LOG" 2>&1 < /dev/null &
 echo $! > "$PIDFILE"
 sleep 1
 if kill -0 "$(cat "$PIDFILE")" 2>/dev/null; then

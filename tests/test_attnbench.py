@@ -29,13 +29,18 @@ def test_dispatch_policy_agrees_with_measured_sweeps():
     a hard disagreement); legacy single-shot rows only report provisional.
     Re-runs automatically as new sweeps land each round."""
     import io
+    import os
     from contextlib import redirect_stdout
 
     from ddlbench_tpu.tools import attnpolicy
 
+    # resolve perf_runs from the repo root so the test passes when pytest
+    # runs from another cwd (ADVICE r4)
+    perf_dir = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "perf_runs")
     buf = io.StringIO()
     with redirect_stdout(buf):
-        rc = attnpolicy.main(["--dir", "perf_runs"])
+        rc = attnpolicy.main(["--dir", perf_dir])
     doc = json.loads(buf.getvalue())
     assert rc == 0, doc["disagreements"]
     assert doc["num_cells"] >= 1  # the round-3 crossover artifact at least

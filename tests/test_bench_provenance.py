@@ -57,6 +57,24 @@ def test_newest_stamp_wins_and_fallback_is_labeled(tmp_path):
     assert "approximate" in best["measured_at_source"]
 
 
+def test_mixed_stamp_formats_rank_by_instant(tmp_path):
+    """A naive stamp (assumed UTC) and a +00:00-offset stamp must compare
+    as instants, not strings: lexicographically '2026-07-31T10:00:00'
+    ranks ABOVE '2026-07-31T09:00:00+00:00' only because '+' < 'T' — the
+    parsed comparison must pick the later wall-clock instead (ADVICE r4)."""
+    d = str(tmp_path)
+    _write(d, "bench_naive.json",
+           {**BASE, "value": 1.0, "measured_at": "2026-07-31T10:00:00"})
+    _write(d, "bench_offset.json",
+           {**BASE, "value": 2.0, "measured_at": "2026-07-31T11:30:00+00:00"})
+    best = bench._last_known_onchip(d)
+    assert best["value"] == 2.0
+    # unparseable stamps are skipped, not crashed on
+    _write(d, "bench_junk.json",
+           {**BASE, "value": 3.0, "measured_at": "yesterday-ish"})
+    assert bench._last_known_onchip(d)["value"] == 2.0
+
+
 def test_non_chip_and_foreign_records_ignored(tmp_path):
     d = str(tmp_path)
     _write(d, "bench_cpu.json", {**BASE, "value": 9.0,

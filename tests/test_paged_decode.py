@@ -8,6 +8,7 @@ copy-on-write reorder preserves exactly the histories a physical gather
 would, and the Pallas kernel (interpret mode) matches the jnp reference.
 """
 
+import functools
 import math
 
 import jax
@@ -61,6 +62,33 @@ def test_prefill_and_decode_writes_roundtrip():
         kd, vd = _gather_pages(cache)
         np.testing.assert_allclose(kd[:, :, t], k1[:, 0])
         np.testing.assert_allclose(vd[:, :, t], 2.0 * kd[:, :, t])
+
+
+def test_chunked_prefill_matches_whole_prompt():
+    """Prompt chunking (long-context serving): writing [0, 5) then [5, 11)
+    then [11, 14) — chunk boundaries page-UNALIGNED (pages of 4) — must
+    leave the pool identical to a single whole-prompt write."""
+    k = _rand(90, ROWS, 14, H, DH)
+    v = _rand(91, ROWS, 14, H, DH)
+    whole = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    whole = paged_prefill_write(whole, k, v, page=PAGE)
+    chunked = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    for lo, hi in ((0, 5), (5, 11), (11, 14)):
+        chunked = paged_prefill_write(chunked, k[:, lo:hi], v[:, lo:hi],
+                                      page=PAGE, start=lo)
+    np.testing.assert_array_equal(np.asarray(chunked["pool_k"]),
+                                  np.asarray(whole["pool_k"]))
+    np.testing.assert_array_equal(np.asarray(chunked["pool_v"]),
+                                  np.asarray(whole["pool_v"]))
+    np.testing.assert_array_equal(np.asarray(chunked["table"]),
+                                  np.asarray(whole["table"]))
+    # and it must be jit-compatible (static start, traced chunk)
+    jitted = jax.jit(functools.partial(paged_prefill_write, page=PAGE,
+                                       start=5))
+    chunk2 = jitted(whole, k[:, 5:11] * 2.0, v[:, 5:11] * 2.0)
+    kd, _ = _gather_pages(chunk2)
+    np.testing.assert_allclose(np.asarray(kd[:, :, 5:11]),
+                               np.asarray(2.0 * k[:, 5:11].transpose(0, 2, 1, 3)))
 
 
 @pytest.mark.parametrize("pos,npl", [(3, 1), (7, 2), (10, 3), (14, 4)])
