@@ -50,6 +50,10 @@ def build_parser() -> argparse.ArgumentParser:
                         "chunks per device (cuts the pipeline bubble by "
                         "this factor)")
     p.add_argument("--dp-replicas", type=int, default=1)
+    p.add_argument("--tp-size", type=int, default=1,
+                   help="composed tensor x pipeline parallelism (gpipe + "
+                        "transformer archs): Megatron-slice each stage this "
+                        "many ways; -g = tp_size x stages (parallel/tpp.py)")
     p.add_argument("--stage-replication", default=None,
                    help="uneven hybrid PPxDP: comma list of per-stage "
                         "replication factors summing to -g, e.g. 1,3 "
@@ -139,6 +143,7 @@ def config_from_args(args) -> RunConfig:
         num_stages=args.stages,
         virtual_stages=args.virtual_stages,
         dp_replicas=args.dp_replicas,
+        tp_size=args.tp_size,
         stage_replication=(tuple(int(r) for r in
                                  args.stage_replication.split(","))
                            if args.stage_replication else None),

@@ -224,6 +224,12 @@ class RunConfig:
     # the cost of more (cheap, ICI-neighbor) rotations. Requires
     # num_microbatches % stages == 0 when > 1.
     virtual_stages: int = 1
+    # Composed tensor x pipeline parallelism (gpipe + transformer archs):
+    # each pipeline stage's blocks are Megatron-sliced this many ways over a
+    # 'model' mesh axis inside the stage (parallel/tpp.py). num_devices =
+    # tp_size x stages. No reference analog (its engines compose PP with DP
+    # only); the TPU-native composition rides intra-stage ICI neighbors.
+    tp_size: int = 1
     # PipeDream macrobatch mode (runtime/optimizer.py:36-52,119-164):
     # accumulate gradients across update_interval microbatches inside the
     # 1F1B schedule and step once per interval (grads averaged /K). The
@@ -333,7 +339,8 @@ class RunConfig:
             return len(self.stage_replication)
         if self.num_stages is not None:
             return self.num_stages
-        return max(1, self.num_devices // max(1, self.dp_replicas))
+        return max(1, self.num_devices
+                   // (max(1, self.dp_replicas) * max(1, self.tp_size)))
 
     def resolved_batches(self) -> Tuple[int, int]:
         """Return (micro_batch_size, num_microbatches).
@@ -445,11 +452,32 @@ class RunConfig:
                     "schedule) are mutually exclusive")
         elif self.strategy in ("gpipe", "pipedream"):
             s = self.resolved_stages()
-            if s * max(1, self.dp_replicas) != self.num_devices:
+            if s * max(1, self.dp_replicas) * max(1, self.tp_size) \
+                    != self.num_devices:
                 raise ValueError(
-                    f"stages ({s}) x dp_replicas ({self.dp_replicas}) must equal "
+                    f"stages ({s}) x dp_replicas ({self.dp_replicas}) x "
+                    f"tp_size ({self.tp_size}) must equal "
                     f"num_devices ({self.num_devices})"
                 )
+        if self.tp_size < 1:
+            raise ValueError("tp_size must be >= 1")
+        if self.tp_size > 1:
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    "tp_size > 1 (composed tensor x pipeline parallelism) "
+                    "runs on the gpipe strategy (parallel/tpp.py)")
+            if self.dataset().kind not in ("tokens", "seq2seq"):
+                raise ValueError(
+                    "tp_size > 1 requires a token or seq2seq benchmark "
+                    "(transformer blocks are what gets Megatron-sliced)")
+            if self.dp_replicas > 1 or self.stage_replication is not None:
+                raise ValueError(
+                    "tp_size > 1 composes with pipeline stages only; "
+                    "dp_replicas/stage_replication must stay default")
+            if self.virtual_stages > 1:
+                raise ValueError(
+                    "tp_size > 1 with the interleaved schedule is not "
+                    "supported")
         if self.virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
         if self.update_interval < 1:

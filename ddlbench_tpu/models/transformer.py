@@ -47,6 +47,68 @@ def _seq_axis():
     return sequence_parallel.current()
 
 
+class tensor_parallel(axis_context):
+    """Context manager: trace model applies in Megatron-style tensor-parallel
+    mode over ``num_shards`` shards of a named mesh axis (parallel/tpp.py
+    enters it inside its shard_map). When active, each shard holds the
+    slices produced by :func:`tp_split_layer_params` — attention runs its
+    local contiguous head group (wqkv column-slice, wo row-slice) and the
+    MLP its local hidden columns (w1/b1 column-slice, w2 row-slice) — and
+    the two row-parallel projections psum over the axis. Activations stay
+    replicated across shards, so LN/bias/embedding leaves are shared
+    (their gradients all-reduce via the strategy's replicated param path).
+    """
+
+    _stack: list = []
+
+    def __init__(self, axis: str, num_shards: int):
+        self.axis = (axis, int(num_shards))  # pushed by axis_context
+
+
+def _tp_ctx():
+    return tensor_parallel.current()
+
+
+# Transformer-block leaves sliced per TP shard; everything else (LN scales,
+# the output bias b2, embeddings, heads) is replicated across shards.
+TP_SLICED_KEYS = ("wqkv", "wo", "w1", "b1", "w2")
+
+
+def tp_split_layer_params(p, n: int):
+    """Split one layer's params for n-way tensor parallelism.
+
+    Returns ``(shards, repl)``: ``shards[s]`` is shard s's dict of sliced
+    leaves and ``repl`` the shared remainder; a layer that is not a dense
+    transformer block (no wqkv/wo/w1/w2 — embeddings, heads, MoE blocks
+    whose FFN is expert-routed) is fully replicated (``shards[s] == {}``).
+    Head alignment: the contiguous d/n column group of wqkv covers whole
+    heads iff n divides n_heads — asserted at trace time in
+    attention_sublayer, where the head count is known.
+    """
+    if not (isinstance(p, dict) and {"wqkv", "wo", "w1", "w2"} <= set(p)):
+        return [{} for _ in range(n)], p
+    d = p["wo"].shape[1]
+    f = p["w1"].shape[1]
+    if d % n or f % n:
+        raise ValueError(
+            f"tensor parallelism: d_model={d} / mlp width={f} not divisible "
+            f"by tp_size={n}")
+    dl, fl = d // n, f // n
+    shards = [{
+        # wqkv columns are q|k|v blocks of d each; slice the SAME head
+        # group out of each block and re-concatenate so the apply-side
+        # jnp.split(qkv, 3) still lands on q/k/v
+        "wqkv": p["wqkv"].reshape(d, 3, d)[:, :, s * dl:(s + 1) * dl]
+                .reshape(d, 3 * dl),
+        "wo": p["wo"][s * dl:(s + 1) * dl, :],
+        "w1": p["w1"][:, s * fl:(s + 1) * fl],
+        "b1": p["b1"][s * fl:(s + 1) * fl],
+        "w2": p["w2"][s * fl:(s + 1) * fl, :],
+    } for s in range(n)]
+    repl = {k: v for k, v in p.items() if k not in TP_SLICED_KEYS}
+    return shards, repl
+
+
 def layer_norm(p, x):
     """f32-accumulated LayerNorm over the feature axis, compute-dtype out."""
     mean = jnp.mean(x, axis=-1, keepdims=True, dtype=jnp.float32)
@@ -341,26 +403,47 @@ def attention_sublayer(p, x, n_heads: int, prefix_len: int = 0):
     """Pre-LN self-attention sublayer with residual: reads p["ln1"],
     p["wqkv"], p["wo"]. Dispatches to ring attention over the active
     sequence_parallel axis, so every block (dense and MoE) gets the
-    sequence-parallel path from one implementation. ``prefix_len`` selects
-    the prefix-LM mask (seq2seq) on both paths."""
+    sequence-parallel path from one implementation; under an active
+    tensor_parallel context the shard computes its local head group and the
+    output projection psums over the TP axis. ``prefix_len`` selects the
+    prefix-LM mask (seq2seq) on both paths."""
     B, T, d = x.shape
     dh = d // n_heads
+    # Sliced-vs-replicated is decided by the PARAMS the shard actually
+    # holds, not by the context alone: under tp a layer the splitter left
+    # replicated (e.g. an MoE block — tp_split_layer_params) carries the
+    # full-width wqkv, computes the full attention identically on every
+    # shard, and must NOT psum (that would multiply by tp).
+    tp = _tp_ctx()
+    sliced = tp is not None and p["wqkv"].shape[1] < 3 * d
+    n_local = n_heads
+    if sliced:
+        assert n_heads % tp[1] == 0, (
+            f"tensor parallelism: n_heads={n_heads} not divisible by "
+            f"tp_size={tp[1]}")
+        n_local = n_heads // tp[1]
     h = layer_norm(p["ln1"], x)
     qkv = h @ p["wqkv"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
 
     def heads(t):
-        return t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+        return t.reshape(B, T, n_local, dh).transpose(0, 2, 1, 3)
 
     axis = _seq_axis()
     if axis is None:
         o = causal_attention(heads(q), heads(k), heads(v),
                              prefix_len=prefix_len)
     else:
+        assert tp is None, (
+            "ring (sequence-parallel) attention composed with tensor "
+            "parallelism is not supported")
         o = ring_attention(heads(q), heads(k), heads(v), axis,
                            prefix_len=prefix_len)
-    o = o.transpose(0, 2, 1, 3).reshape(B, T, d)
-    return x + o @ p["wo"].astype(x.dtype)
+    o = o.transpose(0, 2, 1, 3).reshape(B, T, n_local * dh)
+    proj = o @ p["wo"].astype(x.dtype)
+    if sliced:
+        proj = lax.psum(proj, tp[0])
+    return x + proj
 
 
 def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
@@ -392,7 +475,13 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
     def mlp(p, x):
         h = layer_norm(p["ln2"], x)
         h = jax.nn.gelu(h @ p["w1"].astype(x.dtype) + p["b1"].astype(x.dtype))
-        return x + (h @ p["w2"].astype(x.dtype) + p["b2"].astype(x.dtype))
+        proj = h @ p["w2"].astype(x.dtype)
+        tp = _tp_ctx()
+        # row-parallel psum ONLY when this shard holds a column slice (see
+        # attention_sublayer — replicated layers compute the full MLP)
+        if tp is not None and p["w1"].shape[1] < mlp_ratio * d_model:
+            proj = lax.psum(proj, tp[0])
+        return x + proj + p["b2"].astype(x.dtype)
 
     def prefill(p, s, cache, x, start):
         x, cache = attn_prefill_op(p, x, cache, n_heads, prefix_len, start)

@@ -348,6 +348,11 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
             return HeteroGPipeStrategy(model, cfg, devices=devices,
                                        stage_bounds=stage_bounds)
+        if cfg.tp_size > 1:
+            from ddlbench_tpu.parallel.tpp import TPGPipeStrategy
+
+            return TPGPipeStrategy(model, cfg, devices=devices,
+                                   stage_bounds=stage_bounds)
         from ddlbench_tpu.parallel.gpipe import GPipeStrategy
 
         return GPipeStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)

@@ -1,0 +1,421 @@
+"""Composed tensor x pipeline parallelism (Megatron TP inside GPipe stages).
+
+No reference analog: sara-nl/DDLBench composes pipelining with DATA
+parallelism only (run_template.sh's straggler/hybrid plans; SURVEY.md §2 E5)
+— tensor parallelism is listed in SURVEY.md §2 E7 as a new-capability
+recommendation. This module composes the two TPU-natively on one mesh:
+
+* mesh axes ``('stage', 'model')`` — 'model' is innermost so a stage's TP
+  group sits on adjacent ICI neighbors (the TP psums are the
+  bandwidth-hungry collectives; the per-tick stage handoff moves one
+  activation buffer).
+* The pipeline is the gpipe scan (lax.scan over M + S - 1 ticks,
+  lax.switch per stage, ppermute handoffs — parallel/gpipe.py); inside a
+  stage every transformer block runs Megatron-sliced under the
+  ``tensor_parallel`` trace context (models/transformer.py): each 'model'
+  shard computes its local contiguous head group and MLP column block, and
+  the two row-parallel projections ``lax.psum`` over 'model'.
+* Parameters ride TWO packed matrices (parallel/packing.py): the sliced
+  leaves as ``[S, tp, L_sl]`` sharded ``P('stage', 'model')`` — each device
+  holds exactly its (stage, shard) slice — and the shared leaves (LN
+  scales/biases, output bias, embeddings, LM head) as ``[S, L_rp]`` sharded
+  ``P('stage')``, replicated across the 'model' axis. The replicated row is
+  ``pcast`` to varying over 'model' inside the shard_map, so shard_map's
+  transpose inserts their gradient all-reduce over 'model' — the same
+  mechanism gpipe uses for its DP gradient all-reduce — while the sliced
+  matrix's gradients stay per-shard. Activations are replicated across
+  'model' (Megatron's design point), so correctness does not depend on any
+  other collective.
+
+Scope: the synchronous (gpipe) schedule, V=1, unfused CE head. Selected by
+``RunConfig.tp_size > 1`` with strategy='gpipe' (parallel/api.py).
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, List, NamedTuple, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model
+from ddlbench_tpu.models.transformer import (tensor_parallel,
+                                             tp_split_layer_params)
+from ddlbench_tpu.parallel.common import (
+    cast_input, cast_params, correct_and_count, correct_topk,
+    cross_entropy_loss, vary as _vary_axes)
+from ddlbench_tpu.parallel.gpipe import _shard_map
+from ddlbench_tpu.parallel.packing import (
+    balanced_stage_bounds, layer_flop_costs, pack_stage, pad_vec)
+
+_AXES = ("stage", "model")
+
+
+def _vary(v, axes=_AXES):
+    return _vary_axes(v, axes)
+
+
+class TPPipeTrainState(NamedTuple):
+    # params = {"sliced": [S, tp, L_sl] P('stage','model'),
+    #           "repl":   [S, L_rp]     P('stage')}
+    params: Any
+    model_state: jax.Array  # [S, L_st] P('stage')
+    opt: Any  # {"sliced": opt-dict, "repl": opt-dict} (make_optimizer x2)
+
+
+class TPGPipeStrategy:
+    """strategy='gpipe' + tp_size>1: Megatron-sliced stages on a
+    ('stage', 'model') mesh."""
+
+    def __init__(self, model: LayerModel, cfg: RunConfig,
+                 devices: Optional[Sequence[jax.Device]] = None,
+                 stage_bounds: Optional[List[int]] = None):
+        from ddlbench_tpu.distributed import make_mesh
+        from ddlbench_tpu.parallel.common import make_optimizer
+
+        self.model = model
+        self.cfg = cfg
+        self.tp = cfg.tp_size
+        self.num_stages = cfg.resolved_stages()
+        assert self.tp > 1, "use GPipeStrategy for tp_size == 1"
+        self.mesh = make_mesh(
+            [("stage", self.num_stages), ("model", self.tp)],
+            devices=devices)
+        self.compute_dtype = jnp.dtype(cfg.compute_dtype)
+        self.mb, self.num_microbatches = cfg.resolved_batches()
+        self._stage_bounds_override = stage_bounds
+        self._built = False
+        self._opt_init, self._opt_update = make_optimizer(cfg)
+        from ddlbench_tpu.parallel.common import head_fusable
+
+        if cfg.fused_head_loss and head_fusable(model):
+            # default-on flag, so a hard validate() error would hit every
+            # tpp run; surface the scope limit instead of silently differing
+            # from plain gpipe's fused path
+            print("tpp: fused projection+loss head is not supported under "
+                  "tp_size > 1; using the unfused CE head", flush=True)
+
+    # -- initialization ----------------------------------------------------
+
+    def init(self, key) -> TPPipeTrainState:
+        params_list, state_list, shapes = init_model(self.model, key)
+        S, tp = self.num_stages, self.tp
+        bounds = getattr(self, "bounds", None)
+        if bounds is None:
+            if self._stage_bounds_override is not None:
+                bounds = list(self._stage_bounds_override)
+            else:
+                costs = layer_flop_costs(params_list, shapes,
+                                         self.model.layers)
+                bounds = balanced_stage_bounds(costs, S)
+            assert (len(bounds) == S + 1 and bounds[0] == 0
+                    and bounds[-1] == len(self.model.layers))
+            self.bounds = bounds
+            self.shapes = shapes
+
+        sl_rows, rp_vecs = [], []
+        sl_unravels, sl_lens = [], []
+        rp_unravels, rp_lens = [], []
+        st_vecs, st_unravels, st_lens = [], [], []
+        any_sliced = False
+        for c in range(S):
+            chunk = params_list[bounds[c]:bounds[c + 1]]
+            splits = [tp_split_layer_params(p, tp) for p in chunk]
+            any_sliced |= any(bool(sh[0]) for sh, _ in splits)
+            shard_trees = [[sh[s] for sh, _ in splits] for s in range(tp)]
+            repl_tree = [rp for _, rp in splits]
+            vecs = [pack_stage(t) for t in shard_trees]
+            # identical structure across shards: one unravel serves all
+            sl_rows.append([v for v, _, _ in vecs])
+            sl_unravels.append(vecs[0][1])
+            sl_lens.append(vecs[0][2])
+            v, u, n = pack_stage(repl_tree)
+            rp_vecs.append(v)
+            rp_unravels.append(u)
+            rp_lens.append(n)
+            v, u, n = pack_stage(state_list[bounds[c]:bounds[c + 1]])
+            st_vecs.append(v)
+            st_unravels.append(u)
+            st_lens.append(n)
+        if not any_sliced:
+            raise ValueError(
+                f"tp_size={tp}: no layer of {self.model.name} is "
+                f"TP-shardable (models/transformer.tp_split_layer_params)")
+
+        L_sl = max(max(r.size for r in rows) for rows in sl_rows)
+        sliced_mat = jnp.stack([
+            jnp.stack([jnp.pad(r, (0, L_sl - r.size)) for r in rows])
+            for rows in sl_rows])  # [S, tp, L_sl]
+        L_rp = max(v.size for v in rp_vecs)
+        repl_mat = jnp.stack([jnp.pad(v, (0, L_rp - v.size))
+                              for v in rp_vecs])  # [S, L_rp]
+        L_st = max(v.size for v in st_vecs)
+        state_mat = jnp.stack([jnp.pad(v, (0, L_st - v.size))
+                               for v in st_vecs])  # [S, L_st]
+
+        if not self._built:
+            self._sl_unravels, self._sl_lens = sl_unravels, sl_lens
+            self._rp_unravels, self._rp_lens = rp_unravels, rp_lens
+            self._st_unravels, self._st_lens = st_unravels, st_lens
+            interior = [self.mb * math.prod(shapes[bounds[c]])
+                        for c in range(1, S)]
+            self._act_size = max(interior) if interior else 1
+            self._build_steps()
+
+        from ddlbench_tpu.distributed import put_global_batch
+
+        sl_sh = NamedSharding(self.mesh, P("stage", "model", None))
+        rp_sh = NamedSharding(self.mesh, P("stage", None))
+        params = {
+            "sliced": put_global_batch(sliced_mat, sl_sh),
+            "repl": put_global_batch(repl_mat, rp_sh),
+        }
+        state_mat = put_global_batch(state_mat, rp_sh)
+        opt = {
+            "sliced": self._opt_init(params["sliced"],
+                                     step_like=(S, tp, 1)),
+            "repl": self._opt_init(params["repl"], step_like=(S, 1)),
+        }
+        for k, sh in (("sliced", sl_sh), ("repl", rp_sh)):
+            if "step" in opt[k]:
+                opt[k] = {**opt[k],
+                          "step": put_global_batch(opt[k]["step"], sh)}
+        return TPPipeTrainState(params, state_mat, opt)
+
+    # -- stage branch ------------------------------------------------------
+
+    def _make_branch(self, c: int, train: bool):
+        S, M, mb, A = (self.num_stages, self.num_microbatches, self.mb,
+                       self._act_size)
+        layers = self.model.layers[self.bounds[c]:self.bounds[c + 1]]
+        in_shape = self.shapes[self.bounds[c]]
+        sl_unravel, sl_len = self._sl_unravels[c], self._sl_lens[c]
+        rp_unravel, rp_len = self._rp_unravels[c], self._rp_lens[c]
+        st_unravel, st_len = self._st_unravels[c], self._st_lens[c]
+        cdtype = self.compute_dtype
+        last = c == S - 1
+        tp = self.tp
+        smooth = self.cfg.resolved_label_smoothing() if train else 0.0
+        from ddlbench_tpu.models.moe import collect_aux_losses
+
+        def branch(sl_row, rp_row, state_row, x_buf, xs, ys, m):
+            if c == 0:
+                x = lax.dynamic_index_in_dim(xs, m, keepdims=False)
+            else:
+                x = x_buf[: mb * math.prod(in_shape)].reshape(mb, *in_shape)
+            sliced = sl_unravel(sl_row[:sl_len])
+            repl = rp_unravel(rp_row[:rp_len])
+            # merge the shard's sliced leaves back into each layer's dict
+            # ({} sliced entry = fully replicated layer)
+            params = [({**r, **s} if isinstance(s, dict) and s else r)
+                      for s, r in zip(sliced, repl)]
+            params = cast_params(params, cdtype)
+            states = st_unravel(state_row[:st_len])
+            aux: list = []
+            with tensor_parallel("model", tp), collect_aux_losses(aux):
+                y, new_states = apply_slice(layers, params, states,
+                                            cast_input(x, cdtype), train)
+            aux_mb = sum(aux, jnp.float32(0.0))
+            if last:
+                labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                ce = cross_entropy_loss(y, labels)
+                loss = cross_entropy_loss(y, labels, smooth) if smooth else ce
+                correct = correct_and_count(y, labels)[0]
+                correct5 = (jnp.zeros((), jnp.int32) if train
+                            else correct_topk(y, labels))
+                y_out = jnp.zeros((A,), cdtype)
+            else:
+                loss = jnp.zeros((), jnp.float32)
+                ce = jnp.zeros((), jnp.float32)
+                correct = jnp.zeros((), jnp.int32)
+                correct5 = jnp.zeros((), jnp.int32)
+                y_out = pad_vec(y.astype(cdtype), A)
+            new_state_row = pad_vec(
+                ravel_pytree(new_states)[0].astype(jnp.float32),
+                state_row.shape[0])
+            return (_vary(y_out), _vary(new_state_row), _vary(loss),
+                    _vary(ce), _vary(aux_mb), _vary(correct), _vary(correct5))
+
+        if train and self.cfg.remat_stages:
+            branch = jax.checkpoint(branch)
+        return branch
+
+    # -- compiled steps ----------------------------------------------------
+
+    def _build_steps(self):
+        self._sl_sharding = NamedSharding(self.mesh, P("stage", "model", None))
+        self._rp_sharding = NamedSharding(self.mesh, P("stage", None))
+        self._batch_sharding = NamedSharding(self.mesh, P())
+        self.train_step = self._make_train_step()
+        self.eval_step = self._make_eval_step()
+        self._built = True
+
+    def _make_pipe_fn(self, train: bool):
+        """The classic V=1 gpipe timetable (stage s runs microbatch m at
+        tick t = m + s) with TP inside every switch branch. See
+        parallel/gpipe.py _make_pipe_fn for the schedule derivation."""
+        S, M, A = self.num_stages, self.num_microbatches, self._act_size
+        aux_w = self.cfg.moe_aux_weight if train else 0.0
+        branches = [self._make_branch(c, train) for c in range(S)]
+        perm = [(i, i + 1) for i in range(S - 1)]
+
+        def inner(params, state_rows, xs, ys):
+            # local blocks: sliced [1, 1, L_sl], repl [1, L_rp], state
+            # [1, L_st], xs/ys replicated [M, mb, ...]. The pcast on the
+            # replicated row transposes to its gradient psum over 'model'
+            # (shared LN/bias/embedding leaves — module docstring); the
+            # sliced row's gradients stay per-shard.
+            sl_rows = _vary(params["sliced"][0, 0])  # [L_sl]
+            rp_rows = _vary(params["repl"][0])  # [L_rp]
+            state_row = _vary(state_rows[0])
+            xs = _vary(xs)
+            ys = _vary(ys)
+            s_idx = lax.axis_index("stage")
+            T = M + S - 1
+
+            def body(carry, t):
+                (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+                 corr5_acc) = carry
+                m_rel = t - s_idx
+                valid = (m_rel >= 0) & (m_rel < M)
+                m = jnp.clip(m_rel, 0, M - 1)
+                (y_buf, new_st, loss_mb, ce_mb, aux_mb, corr_mb,
+                 corr5_mb) = lax.switch(
+                    s_idx, branches, sl_rows, rp_rows, st_row, x_buf, xs, ys,
+                    m)
+                st_row = jnp.where(valid, new_st, st_row)
+                loss_acc = loss_acc + jnp.where(valid, loss_mb, 0.0)
+                ce_acc = ce_acc + jnp.where(valid, ce_mb, 0.0)
+                aux_acc = aux_acc + jnp.where(valid, aux_mb, 0.0)
+                corr_acc = corr_acc + jnp.where(valid, corr_mb, 0)
+                corr5_acc = corr5_acc + jnp.where(valid, corr5_mb, 0)
+                if perm:
+                    x_next = lax.ppermute(y_buf, "stage", perm)
+                else:
+                    x_next = y_buf
+                return (x_next, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+                        corr5_acc), None
+
+            init_carry = (
+                _vary(jnp.zeros((A,), self.compute_dtype)),
+                state_row,
+                _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.float32)),
+                _vary(jnp.zeros((), jnp.int32)),
+                _vary(jnp.zeros((), jnp.int32)),
+            )
+            (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
+             corr5_acc), _ = lax.scan(body, init_carry, jnp.arange(T))
+            # Loss lives on the last stage: psum over 'stage'. Every 'model'
+            # shard computes the identical value (activations replicated,
+            # row-parallel psums inside the blocks), so reduce over 'model'
+            # with a MEAN — a sum would multiply by tp.
+            def fold(v):
+                return lax.pmean(lax.psum(v, "stage"), "model")
+
+            ce = fold(ce_acc) / M
+            aux = fold(aux_acc) / M
+            loss = fold(loss_acc) / M + aux_w * aux
+            correct = fold(corr_acc.astype(jnp.float32)).astype(jnp.int32)
+            correct5 = fold(corr5_acc.astype(jnp.float32)).astype(jnp.int32)
+            st_row = lax.pmean(st_row, "model")
+            return loss, ce, st_row[None], correct, correct5
+
+        return _shard_map(
+            inner,
+            mesh=self.mesh,
+            in_specs=({"sliced": P("stage", "model", None),
+                       "repl": P("stage", None)},
+                      P("stage", None), P(), P()),
+            out_specs=(P(), P(), P("stage", None), P(), P()),
+        )
+
+    @property
+    def _total_samples(self) -> int:
+        return self.num_microbatches * self.mb
+
+    def _ts_sharding(self):
+        params_sh = {"sliced": self._sl_sharding, "repl": self._rp_sharding}
+        from ddlbench_tpu.parallel.common import opt_state_sharding
+
+        opt_sh = {
+            "sliced": opt_state_sharding(self.cfg, self._sl_sharding,
+                                         self._sl_sharding),
+            "repl": opt_state_sharding(self.cfg, self._rp_sharding,
+                                       self._rp_sharding),
+        }
+        return TPPipeTrainState(params_sh, self._rp_sharding, opt_sh)
+
+    def _make_train_step(self):
+        pipe_train = self._make_pipe_fn(train=True)
+
+        def train_step(ts: TPPipeTrainState, xs, ys, lr):
+            def loss_fn(params):
+                loss, ce, new_state, correct, _c5 = pipe_train(
+                    params, ts.model_state, xs, ys)
+                return loss, (ce, new_state, correct)
+
+            (_, (ce, new_state, correct)), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(ts.params)
+            new_sl, opt_sl = self._opt_update(
+                ts.params["sliced"], grads["sliced"], ts.opt["sliced"], lr)
+            new_rp, opt_rp = self._opt_update(
+                ts.params["repl"], grads["repl"], ts.opt["repl"], lr)
+            valid = jnp.sum((ys >= 0).astype(jnp.float32))
+            metrics = {
+                "loss": ce,
+                "accuracy": correct.astype(jnp.float32)
+                / jnp.maximum(1.0, valid),
+            }
+            return TPPipeTrainState({"sliced": new_sl, "repl": new_rp},
+                                    new_state,
+                                    {"sliced": opt_sl, "repl": opt_rp}), metrics
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding, None),
+        )
+
+    def _make_eval_step(self):
+        pipe_eval = self._make_pipe_fn(train=False)
+
+        def eval_step(ts, xs, ys):
+            loss, _, _, correct, correct5 = pipe_eval(
+                ts.params, ts.model_state, xs, ys)
+            return {
+                "loss": loss,
+                "correct": correct,
+                "correct5": correct5,
+                "count": jnp.sum((ys >= 0).astype(jnp.int32)),
+            }
+
+        return jax.jit(
+            eval_step,
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding),
+        )
+
+    # -- data placement ----------------------------------------------------
+
+    def shard_batch(self, x, y):
+        """Global batch [M*mb, ...] -> [M, mb, ...] replicated (TP shards
+        features, not the batch)."""
+        from ddlbench_tpu.distributed import put_global_batch
+
+        M, mb = self.num_microbatches, self.mb
+        x = x.reshape(M, mb, *x.shape[1:])
+        y = y.reshape(M, mb, *y.shape[1:])
+        return (put_global_batch(x, self._batch_sharding),
+                put_global_batch(y, self._batch_sharding))
+
+    @property
+    def world_size(self) -> int:
+        return self.mesh.devices.size

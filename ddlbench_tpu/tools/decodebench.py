@@ -154,6 +154,7 @@ def main(argv=None) -> int:
             continue
         print(json.dumps({
             "tool": "decodebench",
+            "platform": jax.devices()[0].platform,
             "model": args.model,
             "benchmark": args.benchmark,
             "mode": mode,

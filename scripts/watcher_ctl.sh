@@ -16,10 +16,24 @@ cd "$(dirname "$0")/.."
 WATCHER=scripts/tpu_round5.sh
 PIDFILE=perf_runs/tpu_round5.pid
 LOG=perf_runs/tpu_round5.log
+watcher_group() {  # pid -> 0 if the pid's GROUP still runs watcher work
+  # The leader may be dead (OOM-kill) while an in-flight benchmark child
+  # survives in its process group — check every live group member's
+  # cmdline, not just the leader's, before deciding to kill or skip.
+  local m
+  for m in $(pgrep -g "$1" 2>/dev/null); do
+    if tr '\0' ' ' < "/proc/$m/cmdline" 2>/dev/null \
+        | grep -qE "tpu_round|ddlbench_tpu|bench\.py"; then
+      return 0
+    fi
+  done
+  return 1
+}
+
 for pf in perf_runs/tpu_round*.pid; do
   [ -f "$pf" ] || continue
   pid=$(cat "$pf")
-  if tr '\0' ' ' < "/proc/$pid/cmdline" 2>/dev/null | grep -q "tpu_round"; then
+  if watcher_group "$pid"; then
     # setsid made the recorded pid a session leader: kill the whole group so
     # an in-flight benchmark task dies with the watcher (a survivor would be
     # re-launched by the new watcher and the two would contend for the chip)
