@@ -3,9 +3,14 @@
 # based pkill matches the invoking shell's own command string and has
 # repeatedly killed the caller instead), then launch detached.
 #
-# A recorded pid is only killed if /proc/<pid>/cmdline still names a
-# tpu_round watcher script — after a reboot the pid may have been reused by
-# an unrelated process, and killing its whole group would be destructive.
+# A recorded pid's group is only killed if some LIVE member of that group
+# still looks like watcher-owned work (the watcher script itself, or a
+# benchmark child it spawned: ddlbench_tpu tools / bench.py) — the leader
+# may be dead (OOM-kill) while an in-flight task survives in its group.
+# This accepts one residual pid-reuse collision: a reused pid whose new
+# group ALSO runs this repo's benchmarks would be killed; that is the
+# correct outcome on this single-purpose box (two benchmark runs must not
+# contend for the chip). Unrelated processes are never matched.
 # ALL perf_runs/tpu_round*.pid files are swept, not just the current
 # round's: a round rollover must not orphan the previous round's watcher
 # (two watchers would run their queues against the chip simultaneously).

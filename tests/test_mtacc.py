@@ -24,3 +24,23 @@ def test_seq2seq_trains_to_sequence_accuracy(capsys):
     # the cached paths must agree with the full-forward reference exactly
     assert doc["seq_accuracy"]["greedy"] == \
         doc["seq_accuracy"]["full_forward_greedy"]
+
+
+def test_noisy_variant_has_headroom(capsys):
+    """--noise switches to the graded noisy-channel metric: the Bayes
+    ceiling is strictly below 1, the doc carries it, and a trained model's
+    token accuracy lands within the margin of it (while sequence EM — not
+    gated here — collapses, which is the point: graded, not binary)."""
+    from ddlbench_tpu.tools import mtacc
+
+    rc = mtacc.main(["--platform", "cpu", "--eval-size", "32",
+                     "--noise", "0.15", "--steps", "400"])
+    doc = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert rc == 0, doc
+    assert doc["pass"]
+    assert 0.0 < doc["token_ceiling"] < 1.0
+    ceiling = doc["token_ceiling"]
+    for name, acc in doc["token_accuracy"].items():
+        assert ceiling - 0.05 <= acc, (name, acc, ceiling)
+        # a graded metric must actually sit BELOW perfect
+        assert acc < 1.0, (name, acc)

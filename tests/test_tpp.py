@@ -151,16 +151,23 @@ def test_tpp_moe_replicated_blocks_run_and_match():
     spec = ref.cfg.dataset()
     ts_r = ref.init(jax.random.key(0))
     ts_t = tpp.init(jax.random.key(0))
-    x = jax.random.randint(jax.random.key(7),
-                           (ref.cfg.global_batch(), spec.seq_len), 0,
-                           spec.num_classes, jnp.int32)
-    y = jax.random.randint(jax.random.key(8),
-                           (ref.cfg.global_batch(), spec.seq_len), 0,
-                           spec.num_classes, jnp.int32)
-    _, m_r = ref.train_step(ts_r, *ref.shard_batch(x, y), jnp.float32(0.05))
-    _, m_t = tpp.train_step(ts_t, *tpp.shard_batch(x, y), jnp.float32(0.05))
-    np.testing.assert_allclose(float(m_t["loss"]), float(m_r["loss"]),
-                               rtol=2e-4)
+    # TWO steps: step 2's loss reflects step 1's parameter update, so a
+    # gradient-scaling bug on replicated-under-tp leaves (tp-times or 1/tp
+    # grads from a wrong psum) diverges the comparison — one step would
+    # only compare forwards from identical inits
+    for step in range(2):
+        xs = jax.random.randint(jax.random.key(7 + step),
+                                (ref.cfg.global_batch(), spec.seq_len), 0,
+                                spec.num_classes, jnp.int32)
+        ys = jax.random.randint(jax.random.key(9 + step),
+                                (ref.cfg.global_batch(), spec.seq_len), 0,
+                                spec.num_classes, jnp.int32)
+        ts_r, m_r = ref.train_step(ts_r, *ref.shard_batch(xs, ys),
+                                   jnp.float32(0.05))
+        ts_t, m_t = tpp.train_step(ts_t, *tpp.shard_batch(xs, ys),
+                                   jnp.float32(0.05))
+        np.testing.assert_allclose(float(m_t["loss"]), float(m_r["loss"]),
+                                   rtol=2e-4)
 
 
 @pytest.mark.slow
