@@ -53,7 +53,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--tp-size", type=int, default=1,
                    help="composed tensor x pipeline parallelism (gpipe + "
                         "transformer archs): Megatron-slice each stage this "
-                        "many ways; -g = tp_size x stages (parallel/tpp.py)")
+                        "many ways; -g = dp_replicas x tp_size x stages "
+                        "(parallel/tpp.py; add --dp-replicas for 3-D)")
     p.add_argument("--stage-replication", default=None,
                    help="uneven hybrid PPxDP: comma list of per-stage "
                         "replication factors summing to -g, e.g. 1,3 "

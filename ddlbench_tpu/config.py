@@ -470,10 +470,11 @@ class RunConfig:
                 raise ValueError(
                     "tp_size > 1 requires a token or seq2seq benchmark "
                     "(transformer blocks are what gets Megatron-sliced)")
-            if self.dp_replicas > 1 or self.stage_replication is not None:
+            if self.stage_replication is not None:
                 raise ValueError(
-                    "tp_size > 1 composes with pipeline stages only; "
-                    "dp_replicas/stage_replication must stay default")
+                    "tp_size > 1 composes with uniform pipeline stages "
+                    "(plus dp_replicas for 3-D parallelism); "
+                    "stage_replication must stay default")
             if self.virtual_stages > 1:
                 raise ValueError(
                     "tp_size > 1 with the interleaved schedule is not "

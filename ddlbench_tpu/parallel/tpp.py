@@ -1,14 +1,16 @@
-"""Composed tensor x pipeline parallelism (Megatron TP inside GPipe stages).
+"""Composed tensor x pipeline (x data) parallelism — Megatron TP inside
+GPipe stages, with an optional DP axis on top (full 3-D parallelism).
 
 No reference analog: sara-nl/DDLBench composes pipelining with DATA
 parallelism only (run_template.sh's straggler/hybrid plans; SURVEY.md §2 E5)
 — tensor parallelism is listed in SURVEY.md §2 E7 as a new-capability
-recommendation. This module composes the two TPU-natively on one mesh:
+recommendation. This module composes all three TPU-natively on one mesh:
 
-* mesh axes ``('stage', 'model')`` — 'model' is innermost so a stage's TP
-  group sits on adjacent ICI neighbors (the TP psums are the
+* mesh axes ``('data', 'stage', 'model')`` — 'model' is innermost so a
+  stage's TP group sits on adjacent ICI neighbors (the TP psums are the
   bandwidth-hungry collectives; the per-tick stage handoff moves one
-  activation buffer).
+  activation buffer; the DP gradient all-reduce is once per step and may
+  span DCN).
 * The pipeline is the gpipe scan (lax.scan over M + S - 1 ticks,
   lax.switch per stage, ppermute handoffs — parallel/gpipe.py); inside a
   stage every transformer block runs Megatron-sliced under the
@@ -19,16 +21,20 @@ recommendation. This module composes the two TPU-natively on one mesh:
   leaves as ``[S, tp, L_sl]`` sharded ``P('stage', 'model')`` — each device
   holds exactly its (stage, shard) slice — and the shared leaves (LN
   scales/biases, output bias, embeddings, LM head) as ``[S, L_rp]`` sharded
-  ``P('stage')``, replicated across the 'model' axis. The replicated row is
-  ``pcast`` to varying over 'model' inside the shard_map, so shard_map's
-  transpose inserts their gradient all-reduce over 'model' — the same
-  mechanism gpipe uses for its DP gradient all-reduce — while the sliced
-  matrix's gradients stay per-shard. Activations are replicated across
-  'model' (Megatron's design point), so correctness does not depend on any
-  other collective.
+  ``P('stage')``, replicated across the 'model' axis. Each matrix is
+  ``pcast`` to varying over exactly the axes its in_spec does NOT name, so
+  shard_map's transpose inserts exactly the right gradient all-reduces —
+  over 'model' for the shared leaves (Megatron's LN/embedding sync) and
+  over 'data' for both matrices (the DP all-reduce) — the same mechanism
+  gpipe uses. Activations are replicated across 'model' (Megatron's design
+  point), so correctness does not depend on any other collective.
+* The batch shards over 'data' exactly as in gpipe: the global batch is
+  ``M * mb * dp`` with each data replica running ``mb`` rows of every
+  microbatch.
 
 Scope: the synchronous (gpipe) schedule, V=1, unfused CE head. Selected by
-``RunConfig.tp_size > 1`` with strategy='gpipe' (parallel/api.py).
+``RunConfig.tp_size > 1`` with strategy='gpipe' (parallel/api.py);
+``dp_replicas > 1`` adds the data axis (num_devices = dp x stages x tp).
 """
 
 from __future__ import annotations
@@ -53,7 +59,7 @@ from ddlbench_tpu.parallel.gpipe import _shard_map
 from ddlbench_tpu.parallel.packing import (
     balanced_stage_bounds, layer_flop_costs, pack_stage, pad_vec)
 
-_AXES = ("stage", "model")
+_AXES = ("data", "stage", "model")
 
 
 def _vary(v, axes=_AXES):
@@ -81,11 +87,13 @@ class TPGPipeStrategy:
         self.model = model
         self.cfg = cfg
         self.tp = cfg.tp_size
+        self.dp = max(1, cfg.dp_replicas)
         self.num_stages = cfg.resolved_stages()
         assert self.tp > 1, "use GPipeStrategy for tp_size == 1"
         self.mesh = make_mesh(
-            [("stage", self.num_stages), ("model", self.tp)],
-            devices=devices)
+            [("data", self.dp), ("stage", self.num_stages),
+             ("model", self.tp)],
+            devices=devices, dcn_axis="data")
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         self.mb, self.num_microbatches = cfg.resolved_batches()
         self._stage_bounds_override = stage_bounds
@@ -250,7 +258,7 @@ class TPGPipeStrategy:
     def _build_steps(self):
         self._sl_sharding = NamedSharding(self.mesh, P("stage", "model", None))
         self._rp_sharding = NamedSharding(self.mesh, P("stage", None))
-        self._batch_sharding = NamedSharding(self.mesh, P())
+        self._batch_sharding = NamedSharding(self.mesh, P(None, "data"))
         self.train_step = self._make_train_step()
         self.eval_step = self._make_eval_step()
         self._built = True
@@ -315,16 +323,25 @@ class TPGPipeStrategy:
             # Loss lives on the last stage: psum over 'stage'. Every 'model'
             # shard computes the identical value (activations replicated,
             # row-parallel psums inside the blocks), so reduce over 'model'
-            # with a MEAN — a sum would multiply by tp.
-            def fold(v):
-                return lax.pmean(lax.psum(v, "stage"), "model")
+            # with a MEAN — a sum would multiply by tp. 'data' replicas see
+            # DISTINCT samples: means average over it, counts sum.
+            def fold_mean(v):
+                return lax.pmean(lax.pmean(lax.psum(v, "stage"), "data"),
+                                 "model")
 
-            ce = fold(ce_acc) / M
-            aux = fold(aux_acc) / M
-            loss = fold(loss_acc) / M + aux_w * aux
-            correct = fold(corr_acc.astype(jnp.float32)).astype(jnp.int32)
-            correct5 = fold(corr5_acc.astype(jnp.float32)).astype(jnp.int32)
-            st_row = lax.pmean(st_row, "model")
+            def fold_count(v):
+                return lax.pmean(lax.psum(lax.psum(v.astype(jnp.float32),
+                                                   "stage"), "data"),
+                                 "model").astype(jnp.int32)
+
+            ce = fold_mean(ce_acc) / M
+            aux = fold_mean(aux_acc) / M
+            loss = fold_mean(loss_acc) / M + aux_w * aux
+            correct = fold_count(corr_acc)
+            correct5 = fold_count(corr5_acc)
+            # Sync BN-style state across data replicas (sync-BN choice,
+            # gpipe parity); 'model' shards carry identical state.
+            st_row = lax.pmean(lax.pmean(st_row, "data"), "model")
             return loss, ce, st_row[None], correct, correct5
 
         return _shard_map(
@@ -332,13 +349,13 @@ class TPGPipeStrategy:
             mesh=self.mesh,
             in_specs=({"sliced": P("stage", "model", None),
                        "repl": P("stage", None)},
-                      P("stage", None), P(), P()),
+                      P("stage", None), P(None, "data"), P(None, "data")),
             out_specs=(P(), P(), P("stage", None), P(), P()),
         )
 
     @property
     def _total_samples(self) -> int:
-        return self.num_microbatches * self.mb
+        return self.num_microbatches * self.mb * self.dp
 
     def _ts_sharding(self):
         params_sh = {"sliced": self._sl_sharding, "repl": self._rp_sharding}
@@ -406,13 +423,14 @@ class TPGPipeStrategy:
     # -- data placement ----------------------------------------------------
 
     def shard_batch(self, x, y):
-        """Global batch [M*mb, ...] -> [M, mb, ...] replicated (TP shards
-        features, not the batch)."""
+        """Global batch [M*mb*dp, ...] -> [M, dp*mb, ...] sharded over
+        'data' (TP shards features; each data replica runs mb rows of every
+        microbatch — gpipe convention)."""
         from ddlbench_tpu.distributed import put_global_batch
 
-        M, mb = self.num_microbatches, self.mb
-        x = x.reshape(M, mb, *x.shape[1:])
-        y = y.reshape(M, mb, *y.shape[1:])
+        M, mb, dp = self.num_microbatches, self.mb, self.dp
+        x = x.reshape(M, dp * mb, *x.shape[1:])
+        y = y.reshape(M, dp * mb, *y.shape[1:])
         return (put_global_batch(x, self._batch_sharding),
                 put_global_batch(y, self._batch_sharding))
 

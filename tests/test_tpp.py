@@ -130,6 +130,46 @@ def test_tpp_matches_gpipe_loss_trajectory():
 
 
 @pytest.mark.slow
+def test_tpp_3d_matches_hybrid_gpipe():
+    """Full 3-D parallelism: dp=2 x stages=2 x tp=2 (8 devices) must match
+    the hybrid dp=2 x stages=2 gpipe (4 devices) on the same global batch —
+    the DP gradient all-reduce composes onto both packed matrices via the
+    same pcast transpose."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    _VARIANTS.setdefault("transformer_t", dict(d_model=32, n_layers=2,
+                                               n_heads=4))
+    base = dict(benchmark="synthtext", arch="transformer_t",
+                strategy="gpipe", micro_batch_size=2, num_microbatches=2,
+                dp_replicas=2, compute_dtype="float32",
+                fused_head_loss=False, steps_per_epoch=2,
+                attention_backend="xla")
+    cfg_ref = RunConfig(num_devices=4, num_stages=2, **base)
+    cfg_tpp = RunConfig(num_devices=8, num_stages=2, tp_size=2, **base)
+    ref = make_strategy(cfg_ref)
+    tpp = make_strategy(cfg_tpp)
+    assert cfg_ref.global_batch() == cfg_tpp.global_batch() == 8
+    spec = cfg_ref.dataset()
+    ts_r = ref.init(jax.random.key(0))
+    ts_t = tpp.init(jax.random.key(0))
+    for step in range(2):
+        x = jax.random.randint(jax.random.key(20 + step),
+                               (cfg_ref.global_batch(), spec.seq_len), 0,
+                               spec.num_classes, jnp.int32)
+        y = jax.random.randint(jax.random.key(40 + step),
+                               (cfg_ref.global_batch(), spec.seq_len), 0,
+                               spec.num_classes, jnp.int32)
+        ts_r, m_r = ref.train_step(ts_r, *ref.shard_batch(x, y),
+                                   jnp.float32(0.05))
+        ts_t, m_t = tpp.train_step(ts_t, *tpp.shard_batch(x, y),
+                                   jnp.float32(0.05))
+        np.testing.assert_allclose(float(m_t["loss"]), float(m_r["loss"]),
+                                   rtol=2e-4)
+        np.testing.assert_allclose(float(m_t["accuracy"]),
+                                   float(m_r["accuracy"]), atol=1e-6)
+
+
+@pytest.mark.slow
 def test_tpp_moe_replicated_blocks_run_and_match():
     """MoE archs under tp_size>1: the splitter replicates MoE blocks whole
     (expert FFN is not Megatron-sliced), so the apply side must run them
