@@ -161,7 +161,20 @@ def analytic_model(model, cfg, batch: int) -> dict:
 
     from ddlbench_tpu.models import init_model
 
-    params, states, shapes = init_model(model, jax.random.key(0))
+    # shapes suffice — eval_shape skips the real (threefry-heavy) init, so
+    # the analytic bound is computable in milliseconds on any host. The
+    # per-layer boundary shapes are Python int tuples computed during
+    # tracing; eval_shape would abstract them in the RETURN value, so they
+    # are captured from inside the traced function instead.
+    captured = {}
+
+    def _init(k):
+        p, s, shp = init_model(model, k)
+        captured["shapes"] = shp
+        return p, s
+
+    params, states = jax.eval_shape(_init, jax.random.key(0))
+    shapes = captured["shapes"]
     act = 2  # bf16
     conv_io = bn_extra = 0
     for p, s, in_shape, out_shape in zip(params, states, shapes, shapes[1:]):
