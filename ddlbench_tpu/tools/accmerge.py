@@ -1,0 +1,59 @@
+"""Merge accparity JSON documents (tools/accparity.py output).
+
+A long matrix can lose single engines to per-engine timeouts on a
+contended host; re-running ONLY those engines (same --data-dir, same
+protocol) and merging is cheaper than repeating the whole matrix. Later
+documents override earlier ones per engine; error rows are replaced by
+successful re-runs. The summary block (final_accuracies / spread / pass)
+is recomputed over the merged engine set with the FIRST document's
+thresholds, and the protocol fields are carried from the first document —
+callers must only merge runs of the same protocol.
+
+Usage:
+    python -m ddlbench_tpu.tools.accmerge a.json b.json [...] > merged.json
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+
+
+def merge(docs: list[dict]) -> dict:
+    base = dict(docs[0])
+    engines: dict = {}
+    for doc in docs:
+        for name, row in doc["engines"].items():
+            if name in engines and "final_accuracy" in engines[name] \
+                    and "final_accuracy" not in row:
+                continue  # never replace a success with an error
+            engines[name] = row
+    finals = {n: e["final_accuracy"] for n, e in engines.items()
+              if "final_accuracy" in e}
+    spread = (max(finals.values()) - min(finals.values())) if finals else None
+    base["engines"] = engines
+    base["final_accuracies"] = finals
+    base["final_spread"] = spread
+    base["pass"] = (len(finals) == len(engines)
+                    and all(v >= base["threshold"] for v in finals.values())
+                    and spread is not None
+                    and spread <= base["max_spread"])
+    base["merged_from"] = len(docs)
+    return base
+
+
+def main(argv=None) -> int:
+    paths = argv if argv is not None else sys.argv[1:]
+    if len(paths) < 2:
+        print(__doc__, file=sys.stderr)
+        return 2
+    docs = []
+    for p in paths:
+        with open(p) as f:
+            docs.append(json.load(f))
+    print(json.dumps(merge(docs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

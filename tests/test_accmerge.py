@@ -1,0 +1,43 @@
+"""accparity document merging (tools/accmerge.py): engine-timeout recovery."""
+
+from ddlbench_tpu.tools.accmerge import merge
+
+BASE = {"threshold": 0.97, "max_spread": 0.02, "arch": "resnet18"}
+
+
+def _doc(engines):
+    finals = {n: e["final_accuracy"] for n, e in engines.items()
+              if "final_accuracy" in e}
+    return {**BASE, "engines": engines, "final_accuracies": finals,
+            "pass": False}
+
+
+def test_rerun_replaces_timeouts_and_recomputes_summary():
+    a = _doc({"single": {"final_accuracy": 0.98},
+              "gpipe": {"error": "timeout > 3600s"}})
+    b = _doc({"gpipe": {"final_accuracy": 0.975}})
+    m = merge([a, b])
+    assert m["final_accuracies"] == {"single": 0.98, "gpipe": 0.975}
+    assert m["pass"] is True
+    assert abs(m["final_spread"] - 0.005) < 1e-12
+    assert m["merged_from"] == 2
+
+
+def test_success_never_replaced_by_error():
+    a = _doc({"gpipe": {"final_accuracy": 0.975}})
+    b = _doc({"gpipe": {"error": "timeout"}})
+    m = merge([a, b])
+    assert m["final_accuracies"] == {"gpipe": 0.975}
+    assert m["pass"] is True
+
+
+def test_unresolved_error_fails_the_gate():
+    a = _doc({"single": {"final_accuracy": 0.98},
+              "gpipe": {"error": "timeout"}})
+    m = merge([a, _doc({})])
+    assert m["pass"] is False
+
+
+def test_below_threshold_fails_the_gate():
+    m = merge([_doc({"single": {"final_accuracy": 0.95}}), _doc({})])
+    assert m["pass"] is False
