@@ -9,8 +9,13 @@ is recomputed over the merged engine set with the FIRST document's
 thresholds, and the protocol fields are carried from the first document —
 callers must only merge runs of the same protocol.
 
+``--drop-unresolved`` removes engines whose merged row is still an error
+(e.g. variants deliberately not re-run), moving them to a ``dropped``
+record so the omission is explicit in the artifact rather than silent.
+
 Usage:
-    python -m ddlbench_tpu.tools.accmerge a.json b.json [...] > merged.json
+    python -m ddlbench_tpu.tools.accmerge [--drop-unresolved]
+        a.json b.json [...] > merged.json
 """
 
 from __future__ import annotations
@@ -19,7 +24,7 @@ import json
 import sys
 
 
-def merge(docs: list[dict]) -> dict:
+def merge(docs: list[dict], drop_unresolved: bool = False) -> dict:
     base = dict(docs[0])
     engines: dict = {}
     for doc in docs:
@@ -28,6 +33,12 @@ def merge(docs: list[dict]) -> dict:
                     and "final_accuracy" not in row:
                 continue  # never replace a success with an error
             engines[name] = row
+    if drop_unresolved:
+        dropped = {n: e for n, e in engines.items()
+                   if "final_accuracy" not in e}
+        if dropped:
+            engines = {n: e for n, e in engines.items() if n not in dropped}
+            base["dropped"] = dropped
     finals = {n: e["final_accuracy"] for n, e in engines.items()
               if "final_accuracy" in e}
     spread = (max(finals.values()) - min(finals.values())) if finals else None
@@ -43,7 +54,10 @@ def merge(docs: list[dict]) -> dict:
 
 
 def main(argv=None) -> int:
-    paths = argv if argv is not None else sys.argv[1:]
+    paths = list(argv if argv is not None else sys.argv[1:])
+    drop = "--drop-unresolved" in paths
+    if drop:
+        paths.remove("--drop-unresolved")
     if len(paths) < 2:
         print(__doc__, file=sys.stderr)
         return 2
@@ -51,7 +65,7 @@ def main(argv=None) -> int:
     for p in paths:
         with open(p) as f:
             docs.append(json.load(f))
-    print(json.dumps(merge(docs)))
+    print(json.dumps(merge(docs, drop_unresolved=drop)))
     return 0
 
 

@@ -41,3 +41,12 @@ def test_unresolved_error_fails_the_gate():
 def test_below_threshold_fails_the_gate():
     m = merge([_doc({"single": {"final_accuracy": 0.95}}), _doc({})])
     assert m["pass"] is False
+
+
+def test_drop_unresolved_records_the_omission():
+    a = _doc({"single": {"final_accuracy": 0.98},
+              "gpipe-iv": {"error": "timeout > 3600s"}})
+    m = merge([a, _doc({})], drop_unresolved=True)
+    assert m["pass"] is True
+    assert "gpipe-iv" not in m["engines"]
+    assert m["dropped"]["gpipe-iv"]["error"].startswith("timeout")
