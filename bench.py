@@ -120,6 +120,9 @@ def main() -> int:
                         "shared TPU tunnel's throughput swings +-20-45%% run "
                         "to run — PERF.md, scalebench item 6)")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="async input pipeline depth (data/prefetch.py); "
+                        "0 = synchronous batch generation on the timed path")
     p.add_argument("--quick", action="store_true", help="tiny run for smoke testing")
     p.add_argument("--probe-timeout-s", type=float, default=180.0)
     args = p.parse_args()
@@ -174,7 +177,8 @@ def main() -> int:
     # and the roofline cost analysis (no second compile). Measurement
     # discipline (warmup >= 1, chained train state, float(loss) sync — the
     # axon tunnel's block_until_ready is unreliable) lives in tools/timing.
-    from ddlbench_tpu.tools.timing import timed_steps
+    from ddlbench_tpu.data.prefetch import Prefetcher
+    from ddlbench_tpu.tools.timing import timed_steps_prefetched
 
     x, y = data.batch(0, 0)
     step_fn = strategy.train_step.lower(ts, x, y, lr).compile()
@@ -184,18 +188,29 @@ def main() -> int:
         ts, m = step_fn(ts, bx, by, lr)
         return m
 
-    import statistics
+    # The timed loop rides the same async input pipeline as training, so the
+    # headline number includes (and reports) any input-boundedness.
+    prefetcher = Prefetcher(data, strategy.shard_batch,
+                            depth=args.prefetch_depth)
+    runs = sorted(timed_steps_prefetched(run_step, prefetcher, args.warmup)
+                  for _ in range(max(1, args.repeats)))
+    # the median-dt RUN, keeping its own stall figure — mixing medians of the
+    # two series could pair a throughput with another run's stall
+    dt, stall_s, steps_run = runs[len(runs) // 2]
 
-    dt = statistics.median(
-        timed_steps(run_step, data.batch, args.steps, args.warmup)
-        for _ in range(max(1, args.repeats)))
-
-    ips = args.steps * args.batch_size / dt
+    # steps_run, not args.steps: the timed loop drives one full epoch of the
+    # stream, and the two agree only while make_synthetic keeps train_size an
+    # exact multiple of the batch
+    ips = steps_run * args.batch_size / dt
     record = {
         "metric": f"{args.arch}_{args.benchmark}_images_per_sec_per_chip",
         "value": round(ips, 2),
         "unit": "images/sec",
         "vs_baseline": round(ips / REFERENCE_1080TI_RESNET50_IPS, 3),
+        # Input-boundedness next to samples/sec: the timed loop is one
+        # epoch, so this is directly comparable across BENCH_*.json rounds.
+        "input_stall_ms_per_epoch": round(stall_s * 1e3, 2),
+        "prefetch_depth": args.prefetch_depth,
         # A CPU fallback must never masquerade as a chip number (VERDICT r1):
         # the platform the measurement actually ran on is part of the record.
         "platform": platform_note or jax.devices()[0].platform,
@@ -216,7 +231,7 @@ def main() -> int:
         if isinstance(cost, list):  # older jax returns [dict]
             cost = cost[0]
         flops, byts = cost.get("flops", 0.0), cost.get("bytes accessed", 0.0)
-        step_s = dt / args.steps
+        step_s = dt / steps_run  # same denominator as the headline ips
         on_chip = record["platform"] in ("tpu", "axon")  # tunnel says either
         if flops and on_chip:
             record["mfu"] = round(flops / step_s / cfg.hardware.peak_flops, 4)

@@ -40,6 +40,14 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-augment", action="store_true",
                    help="disable train-time augmentation in -s mode "
                         "(crop/flip per the reference transforms)")
+    p.add_argument("--prefetch-depth", type=int, default=2,
+                   help="async input pipeline depth: batches prepared (incl. "
+                        "device placement) ahead of the train loop by a "
+                        "background thread (data/prefetch.py); 0 = "
+                        "synchronous")
+    p.add_argument("--no-prefetch", action="store_true",
+                   help="shorthand for --prefetch-depth 0 (fully synchronous "
+                        "input pipeline)")
     p.add_argument("-e", "--epochs", type=int, default=3)
     p.add_argument("--batch-size", type=int, default=None)
     p.add_argument("--micro-batch-size", type=int, default=None)
@@ -136,6 +144,7 @@ def config_from_args(args) -> RunConfig:
         synthetic=not args.real_data,
         data_dir=args.data_dir,
         augment=not args.no_augment,
+        prefetch_depth=0 if args.no_prefetch else args.prefetch_depth,
         epochs=args.epochs,
         log_interval=args.log_interval,
         batch_size=args.batch_size,

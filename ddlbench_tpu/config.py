@@ -164,6 +164,13 @@ class RunConfig:
     num_hosts: int = 1
     synthetic: bool = True
     data_dir: Optional[str] = None
+    # Asynchronous input pipeline (data/prefetch.py): the producer thread
+    # runs batch production + shard_batch/device_put this many steps ahead
+    # of the consuming loop through a bounded ring, overlapping host input
+    # work and H2D transfers with device compute. 0 = synchronous
+    # (--no-prefetch); batches are (epoch, step)-addressed, so losses are
+    # bitwise identical either way.
+    prefetch_depth: int = 2
     # Train-time augmentation for the on-disk (-s) image path, mirroring the
     # reference drivers' torchvision transforms (see data/ondisk.py).
     augment: bool = True
@@ -398,6 +405,8 @@ class RunConfig:
             )
         if self.hang_timeout_s is not None and self.hang_timeout_s <= 0:
             raise ValueError("hang_timeout_s must be positive")
+        if self.prefetch_depth < 0:
+            raise ValueError("prefetch_depth must be >= 0 (0 = synchronous)")
         if self.label_smoothing is not None and not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError("label_smoothing must be in [0, 1)")
         if self.strategy == "sp" and self.dataset().kind not in ("tokens", "seq2seq"):

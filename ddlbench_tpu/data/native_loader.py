@@ -103,7 +103,8 @@ class NativeDataLoader:
     """Prefetching batch iterator over a generated dataset directory."""
 
     def __init__(self, dataset_dir: str, batch_size: int, seed: int = 1,
-                 shuffle: bool = True, ring_depth: int = 4):
+                 shuffle: bool = True, ring_depth: int = 4,
+                 prefetch_depth: int = 2):
         lib = _load()
         if lib is None:
             raise RuntimeError("native dataloader unavailable")
@@ -119,21 +120,47 @@ class NativeDataLoader:
         )
         if not self._handle:
             raise RuntimeError(f"loader_open failed for {dataset_dir}")
-        self._img_buf = np.empty(
-            (batch_size, meta["h"], meta["w"], meta["c"]), np.uint8
-        )
-        self._lbl_buf = np.empty((batch_size,), np.int32)
+        # Rotating ring of preallocated buffer pairs: next() hands out a
+        # pair WITHOUT copying (the old implementation memcpy'd both
+        # buffers per call). THE SAFETY INVARIANT IS THE CONSUMER'S
+        # BARRIER, NOT THE RING SIZE: data/ondisk.py fully consumes every
+        # batch before requesting the next one — synchronous numpy
+        # arithmetic on the token path, a device_get execution barrier on
+        # the jitted normalize/augment image path — so even a 2-buffer
+        # ring would be safe, and no ring size alone would be (jax can
+        # zero-copy alias an aligned host buffer, leaving nothing a
+        # lifetime window could protect). The prefetch_depth+1 sizing just
+        # keeps a grace window for that contract's documented lifetime.
+        nbuf = max(2, prefetch_depth + 1)
+        self._bufs = [
+            (np.empty((batch_size, meta["h"], meta["w"], meta["c"]), np.uint8),
+             np.empty((batch_size,), np.int32))
+            for _ in range(nbuf)
+        ]
+        self._buf_i = 0
 
     @property
     def steps_per_epoch(self) -> int:
         return self.meta["count"] // self.batch_size
 
     def next(self) -> Tuple[np.ndarray, np.ndarray]:
-        rc = self._lib.loader_next(self._handle, self._img_buf.reshape(-1),
-                                   self._lbl_buf)
+        """Return the next (images, labels) batch.
+
+        The arrays are views into a rotating ring of ``max(2,
+        prefetch_depth + 1)`` preallocated pairs: a returned batch stays
+        valid for ``ring_size - 1`` further ``next()`` calls and is
+        overwritten by the ``ring_size``-th.
+        FULLY consume (or copy) a batch before calling ``next()`` again —
+        a jax array built from these views may zero-copy alias them, so
+        deferring consumption to any later point is unsafe regardless of
+        the ring size (see data/ondisk.py's execution barrier)."""
+        img_buf, lbl_buf = self._bufs[self._buf_i]
+        self._buf_i = (self._buf_i + 1) % len(self._bufs)
+        rc = self._lib.loader_next(self._handle, img_buf.reshape(-1),
+                                   lbl_buf)
         if rc != 0:
             raise RuntimeError(f"loader_next rc={rc}")
-        return self._img_buf.copy(), self._lbl_buf.copy()
+        return img_buf, lbl_buf
 
     def close(self) -> None:
         if getattr(self, "_handle", None):

@@ -77,11 +77,12 @@ class OnDiskData:
     def __init__(self, data_dir: str, spec: DatasetSpec, batch_size: int,
                  seed: int = 1, dtype=jnp.float32,
                  train_count: int | None = None, test_count: int | None = None,
-                 augment: bool = True):
+                 augment: bool = True, prefetch_depth: int = 2):
         self.spec = spec
         self.batch_size = batch_size
         self.dtype_name = str(jnp.dtype(dtype))
         self.seed = seed
+        self.prefetch_depth = prefetch_depth
         self.augment_policy = _AUGMENT.get(spec.name) if augment else None
         self._loaders = {}
         if spec.kind in ("tokens", "seq2seq"):
@@ -112,8 +113,13 @@ class OnDiskData:
                     f"spec wants kind={spec.kind} shape={want_hwc}; delete the "
                     f"directory or point --data-dir elsewhere"
                 )
+            # prefetch_depth sizes the loader's zero-copy buffer ring; the
+            # actual lifetime invariant is batch()'s execution barrier
+            # below, which fully consumes each batch before the next
+            # next() call (native_loader.NativeDataLoader.next)
             self._loaders[split] = NativeDataLoader(
-                split_dir, batch_size, seed=seed, shuffle=(split == "train")
+                split_dir, batch_size, seed=seed, shuffle=(split == "train"),
+                prefetch_depth=prefetch_depth,
             )
 
     def steps_per_epoch(self, train: bool = True) -> int:
@@ -135,14 +141,38 @@ class OnDiskData:
 
                 labels = mask_source_labels(labels, self.spec.src_len)
             return ids[:, :-1], labels
+        if self.prefetch_depth == 0:
+            # Synchronous mode (--no-prefetch): batch() runs ON the train
+            # loop's critical path, so keep the pre-pipeline semantics —
+            # copy out of the loader's ring and return lazy arrays (the
+            # loop syncs only at log intervals). A per-batch execution
+            # barrier here would tax the A/B baseline the async path never
+            # pays inline.
+            imgs, labels = imgs.copy(), labels.copy()
         imgs = jnp.asarray(imgs)
+        labels = jnp.asarray(labels)
         if train and self.augment_policy:
             steps = self.steps_per_epoch(train=True)
             key = jax.random.fold_in(jax.random.key(self.seed),
                                      epoch * steps + step)
             imgs = _augment_u8(imgs, key, self.augment_policy["pad"],
                                self.augment_policy["flip"])
-        return _normalize(imgs, jnp.asarray(labels), self.dtype_name)
+        x, y = _normalize(imgs, labels, self.dtype_name)
+        if self.prefetch_depth > 0:
+            # Ring-buffer lifetime guard (async mode, zero-copy ring): the
+            # native loader recycles the host buffers behind imgs/labels
+            # after prefetch_depth further batches, and jax may ZERO-COPY
+            # alias an aligned host buffer (CPU backend) or still have its
+            # upload in flight — so force the jitted augment/normalize
+            # pipeline to EXECUTE before returning: jit outputs are fresh
+            # device buffers (even for passthrough args of aliased inputs —
+            # pinned by tests/test_prefetch.py), after which recycling the
+            # ring cannot touch them. A device->host transfer, not
+            # block_until_ready, because on the axon TPU tunnel the latter
+            # can return early (tools/timing.py caveat). The wait sits on
+            # the prefetch producer thread, off the loop's critical path.
+            jax.device_get((x.ravel()[0:1], y.ravel()[0:1]))
+        return x, y
 
     def close(self) -> None:
         for l in self._loaders.values():

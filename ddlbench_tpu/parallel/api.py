@@ -10,7 +10,13 @@ a uniform interface consumed by one train loop (ddlbench_tpu/train/loop.py):
   step functions expect; callers always splat it
   (``train_step(ts, *batch_args, lr)``). Most strategies return (x, y); the
   hetero engines return per-device row shards plus a per-microbatch
-  valid-count vector.
+  valid-count vector. CONTRACT: ``shard_batch`` must be callable off the
+  main thread — the async input pipeline (data/prefetch.py) runs it on a
+  producer thread so device placement overlaps compute. Implementations
+  must therefore be pure placement (device_put / reshape of their
+  arguments + immutable self state), never mutate per-call host state, and
+  never assume main-thread-only facilities (signal handlers, thread-local
+  tracing contexts).
 * ``train_step(train_state, *batch_args, lr) -> (train_state, metrics)``
   (jitted)
 * ``eval_step(train_state, *batch_args) -> {loss, correct, count[,

@@ -31,7 +31,7 @@ TRAIN_RE = re.compile(
 )
 EPOCH_RE = re.compile(
     r"epoch (?P<epoch>\d+)/(?P<total>\d+) done \| (?P<sps>[\d.]+) samples/sec \| "
-    r"(?P<sec>[\d.]+) sec"
+    r"(?P<sec>[\d.]+) sec(?: \| input stall (?P<stall>[\d.]+) ms)?"
 )
 VALID_RE = re.compile(
     r"valid \| (?P<epoch>\d+)/(?P<total>\d+) epoch \| loss (?P<loss>[-\d.naife]+) \| "
@@ -73,6 +73,8 @@ def scrape(text: str) -> Dict[str, Any]:
             epochs.setdefault(e, {"epoch": e})
             epochs[e]["samples_per_sec"] = float(m["sps"])
             epochs[e]["epoch_seconds"] = float(m["sec"])
+            if m["stall"]:  # input-stall suffix (async input pipeline)
+                epochs[e]["input_stall_ms"] = float(m["stall"])
         elif m := VALID_RE.search(line):
             e = int(m["epoch"])
             epochs.setdefault(e, {"epoch": e})

@@ -34,3 +34,39 @@ def timed_steps(run_step: Callable[[object, object], dict],
         m = run_step(x, y)
     float(m["loss"])
     return time.perf_counter() - t0
+
+
+def timed_steps_prefetched(run_step: Callable[..., dict], prefetcher,
+                           warmup: int) -> Tuple[float, float, int]:
+    """``timed_steps`` driven by the async input pipeline.
+
+    ``prefetcher`` is a data.prefetch.Prefetcher; the timed region consumes
+    one full epoch-1 stream (so batch production + device placement overlap
+    the steps, exactly as in the training loop) and returns
+    ``(seconds, input_stall_seconds, steps)`` — the stall term is how much
+    of the measured wall clock was spent blocked waiting on input, and
+    ``steps`` is the number of steps actually driven (the stream's epoch
+    length; callers must derive throughput from it, not from their own
+    step count). Same discipline as timed_steps: warmup outside the clock,
+    chained state, float(loss) as the closing barrier."""
+    m = None
+    batch = prefetcher.shard_fn(*prefetcher.data.batch(0, 0))
+    for _ in range(max(1, warmup)):
+        m = run_step(*batch)
+    float(m["loss"])
+    # clock starts BEFORE the stream spawns its producer (training-loop
+    # parity: loop.py takes its epoch tick before prefetch.stream) — a
+    # pre-clock head start of depth batches would bias both dt and the
+    # stall figure optimistic
+    t0 = time.perf_counter()
+    stream = prefetcher.stream(1, train=True)
+    steps = 0
+    try:
+        for fetched in stream:
+            m = run_step(*fetched.batch)
+            steps += 1
+        float(m["loss"])
+        dt = time.perf_counter() - t0
+    finally:
+        stream.close()
+    return dt, stream.stall_s, steps

@@ -16,9 +16,10 @@ import jax
 import jax.numpy as jnp
 
 from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.data.prefetch import Prefetcher
 from ddlbench_tpu.data.synthetic import make_synthetic
 from ddlbench_tpu.parallel.api import make_strategy
-from ddlbench_tpu.train.metrics import AverageMeter, MetricLogger
+from ddlbench_tpu.train.metrics import MetricLogger
 from ddlbench_tpu.train.watchdog import HangWatchdog, check_finite
 from ddlbench_tpu.parallel.common import step_decay_lr
 
@@ -121,7 +122,7 @@ def _make_data(cfg: RunConfig):
     return OnDiskData(
         cfg.data_dir or "./data", spec, global_batch, seed=cfg.seed,
         train_count=train_count, test_count=test_count,
-        augment=cfg.augment,
+        augment=cfg.augment, prefetch_depth=cfg.prefetch_depth,
     )
 
 
@@ -180,6 +181,15 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     except Exception:
         pass
 
+    # Asynchronous input pipeline (data/prefetch.py): batch production AND
+    # shard_batch/device_put run a bounded prefetch_depth ahead of the
+    # consuming loop on a producer thread, so step N's H2D transfer overlaps
+    # step N-1's compute. depth 0 (--no-prefetch) is the synchronous
+    # fallback through the same interface; both paths feed the loop the same
+    # (epoch, step)-addressed batches, so losses are bitwise identical.
+    prefetch = Prefetcher(data, strategy.shard_batch,
+                          depth=cfg.prefetch_depth, watchdog=wd)
+
     start_epoch = 1
     if cfg.checkpoint_dir and cfg.resume:
         from ddlbench_tpu.train.checkpoint import latest_epoch, restore_checkpoint
@@ -192,7 +202,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             # semantics: main_with_runtime.py:374-376 re-runs validate()
             # right after restoring) — confirms the restored state is the
             # one that was saved, not merely loadable
-            ev = evaluate(cfg, strategy, ts, data, ep, wd)
+            ev = evaluate(cfg, strategy, ts, data, ep, wd,
+                          prefetcher=prefetch)
             logger.valid_epoch(ep, ev["loss"], ev["accuracy"],
                                top5=ev.get("top5"))
 
@@ -229,57 +240,89 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     for epoch in range(start_epoch, cfg.epochs + 1):
         lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
         steps = data.steps_per_epoch(train=True)
-        loss_meter = AverageMeter("loss")
         tick = time.perf_counter()
         interval_tick, interval_samples = tick, 0
-        for step in range(steps):
-            bx, by = data.batch(epoch, step)
-            if actlog is not None and actlog.should_log(epoch, step):
-                try:
-                    path = actlog.log(epoch, step, ts.params, ts.model_state,
-                                      bx, by)
-                except RuntimeError as e:  # e.g. non-addressable sharded params
-                    print(f"activation logging failed ({e}); disabled",
-                          flush=True)
-                    actlog, path = None, None
-                if path:
-                    print(f"activations logged: {path}", flush=True)
-            batch = strategy.shard_batch(bx, by)
-            step_lr = lr
-            if cfg.warmup_epochs and epoch - 1 < cfg.warmup_epochs:
-                from ddlbench_tpu.parallel.common import gradual_warmup_lr
+        # On-device metric accumulation: step losses are summed as lazy
+        # jax.Arrays and transferred ONCE per log interval (the logged loss
+        # is the interval mean), so the host never blocks the dispatch queue
+        # between intervals. The watchdog path below keeps its opt-in
+        # per-step sync — and since every loss already lands on the host
+        # there, it accumulates the plain floats instead of paying a
+        # second device-side sum and interval transfer.
+        loss_sum, host_loss_sum, interval_steps = None, 0.0, 0
+        stream = prefetch.stream(epoch, train=True,
+                                 keep_raw=actlog is not None)
+        try:
+            for step, fetched in enumerate(stream):
+                if actlog is not None and actlog.should_log(epoch, step):
+                    bx, by = fetched.raw
+                    try:
+                        path = actlog.log(epoch, step, ts.params,
+                                          ts.model_state, bx, by)
+                    except RuntimeError as e:  # e.g. non-addressable sharded params
+                        print(f"activation logging failed ({e}); disabled",
+                              flush=True)
+                        actlog, path = None, None
+                    if path:
+                        print(f"activations logged: {path}", flush=True)
+                step_lr = lr
+                if cfg.warmup_epochs and epoch - 1 < cfg.warmup_epochs:
+                    from ddlbench_tpu.parallel.common import gradual_warmup_lr
 
-                step_lr = gradual_warmup_lr(
-                    lr, warmup_world, epoch - 1, step, steps,
-                    cfg.warmup_epochs)
-            ts, metrics = strategy.train_step(ts, *batch,
-                                              jnp.float32(step_lr))
-            interval_samples += global_batch
-            # With the watchdog armed, sync every step so the deadline really
-            # is per-step (a small pipelining cost, only when opted in);
-            # otherwise the loop syncs only at log intervals.
-            log_step = (step + 1) % cfg.log_interval == 0 or step == steps - 1
-            if wd or log_step:
-                loss = float(metrics["loss"])  # transfer = sync
+                    step_lr = gradual_warmup_lr(
+                        lr, warmup_world, epoch - 1, step, steps,
+                        cfg.warmup_epochs)
+                ts, metrics = strategy.train_step(ts, *fetched.batch,
+                                                  jnp.float32(step_lr))
+                interval_samples += global_batch
+                interval_steps += 1
+                # With the watchdog armed, sync every step so the deadline
+                # really is per-step (a small pipelining cost, only when
+                # opted in); otherwise the loop transfers one accumulated
+                # scalar per log interval.
+                log_step = (step + 1) % cfg.log_interval == 0 or step == steps - 1
                 if wd:
+                    step_loss = float(metrics["loss"])  # transfer = sync
+                    check_finite(step_loss, epoch, step + 1, cfg.nan_policy)
                     wd.kick()
-                check_finite(loss, epoch, step + 1, cfg.nan_policy)
-            if log_step:
-                loss_meter.update(loss)
-                now = time.perf_counter()
-                logger.train_interval(
-                    epoch,
-                    100.0 * (step + 1) / steps,
-                    interval_samples / max(1e-9, now - interval_tick),
-                    loss,
-                )
-                interval_tick, interval_samples = now, 0
-        float(metrics["loss"])  # transfer = sync (ts chain forces all steps)
+                    host_loss_sum += step_loss
+                else:
+                    loss_sum = (metrics["loss"] if loss_sum is None
+                                else loss_sum + metrics["loss"])
+                if log_step:
+                    if wd:
+                        # per-step syncs already landed (and checked) every
+                        # loss; the interval mean is free host math
+                        loss = host_loss_sum / interval_steps
+                    else:
+                        # one transfer = sync; the sum chains every step in
+                        # the interval, so non-finite losses propagate into
+                        # it (the interval mean cannot pin the offending
+                        # step — only the watchdog's per-step sync can)
+                        loss = float(loss_sum) / interval_steps
+                        check_finite(loss, epoch, step + 1, cfg.nan_policy,
+                                     where=f"in epoch {epoch} interval "
+                                           f"ending step {step + 1}")
+                    loss_sum, host_loss_sum, interval_steps = None, 0.0, 0
+                    now = time.perf_counter()
+                    logger.train_interval(
+                        epoch,
+                        100.0 * (step + 1) / steps,
+                        interval_samples / max(1e-9, now - interval_tick),
+                        loss,
+                    )
+                    interval_tick, interval_samples = now, 0
+        finally:
+            stream.close()
+        # the final step is always a log_step, so the loop already synced on
+        # the full ts chain before the clock stops here
         epoch_time = time.perf_counter() - tick
-        logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time)
+        logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time,
+                          input_stall_ms=stream.stall_ms)
 
         # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
-        val = evaluate(cfg, strategy, ts, data, epoch, wd)
+        val = evaluate(cfg, strategy, ts, data, epoch, wd,
+                       prefetcher=prefetch)
         logger.valid_epoch(epoch, val["loss"], val["accuracy"],
                            top5=val.get("top5"))
         summary_acc = val["accuracy"]
@@ -299,28 +342,64 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
 
 
 def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
-             wd: Optional[HangWatchdog] = None) -> Dict[str, float]:
-    total_loss, total_correct, total_correct5, total_count = 0.0, 0, 0, 0
+             wd: Optional[HangWatchdog] = None,
+             prefetcher: Optional[Prefetcher] = None) -> Dict[str, float]:
+    """One validation epoch with on-device metric accumulation.
+
+    loss*count / correct / correct5 / count are summed as lazy jax.Arrays —
+    ONE device->host transfer per epoch instead of a blocking ``float()``
+    per step, so eval steps pipeline like train steps. With a watchdog
+    ARMED, eval keeps the per-step sync (train-path parity,
+    train/watchdog.py semantics: the deadline must bound DEVICE progress,
+    which the prefetcher heartbeat — host input progress — cannot prove);
+    the heartbeat additionally covers gaps where slow input production is
+    the bottleneck."""
+    pf = prefetcher or Prefetcher(data, strategy.shard_batch,
+                                  depth=cfg.prefetch_depth, watchdog=wd)
+    loss_sum = correct_sum = correct5_sum = count_sum = None
     saw_correct5 = True
-    for step in range(data.steps_per_epoch(train=False)):
-        m = strategy.eval_step(
-            ts, *strategy.shard_batch(*data.batch(epoch, step, train=False)))
-        loss = float(m["loss"])
-        check_finite(loss, epoch, step + 1, cfg.nan_policy)
-        total_loss += loss * int(m["count"])
-        total_correct += int(m["correct"])
-        if "correct5" in m:
-            total_correct5 += int(m["correct5"])
-        else:  # strategy without prec@5 support: report None, never 0.0
-            saw_correct5 = False
-        total_count += int(m["count"])
-        if wd:
-            wd.kick()
+    steps = 0
+
+    def acc(total, v):
+        return v if total is None else total + v
+
+    stream = pf.stream(epoch, train=False)
+    try:
+        for fetched in stream:
+            m = strategy.eval_step(ts, *fetched.batch)
+            steps += 1
+            if wd is not None:
+                # armed watchdog: per-step transfer = sync, so a device hang
+                # mid-eval dies within one deadline (and a non-finite eval
+                # loss is attributed to its actual step)
+                check_finite(float(m["loss"]), epoch, steps, cfg.nan_policy)
+                wd.kick()
+            loss_sum = acc(loss_sum, m["loss"] * m["count"])
+            correct_sum = acc(correct_sum, m["correct"])
+            count_sum = acc(count_sum, m["count"])
+            if "correct5" in m:
+                correct5_sum = acc(correct5_sum, m["correct5"])
+            else:  # strategy without prec@5 support: report None, never 0.0
+                saw_correct5 = False
+    finally:
+        stream.close()
+    if steps:  # ONE device->host transfer for all accumulators = epoch sync
+        loss_sum, correct_sum, correct5_sum, count_sum = jax.device_get(
+            (loss_sum, correct_sum,
+             correct5_sum if saw_correct5 else 0, count_sum))
+    total_count = int(count_sum) if steps else 0
+    loss = float(loss_sum) / max(1, total_count) if steps else 0.0
+    # detection happens at the one epoch-end transfer, so no specific step
+    # can honestly be blamed
+    check_finite(loss, epoch, steps, cfg.nan_policy,
+                 where=f"in validation epoch {epoch} (epoch-end check)")
+    if wd:
+        wd.kick()  # the epoch-end transfer above proved device progress
     return {
-        "loss": total_loss / max(1, total_count),
-        "accuracy": total_correct / max(1, total_count),
+        "loss": loss,
+        "accuracy": int(correct_sum) / max(1, total_count) if steps else 0.0,
         # prec@5 (PipeDream eval parity, main_with_runtime.py:639-653);
         # None when unsupported by the strategy or when no eval step ran
-        "top5": (total_correct5 / total_count
-                 if saw_correct5 and total_count else None),
+        "top5": (int(correct5_sum) / total_count
+                 if saw_correct5 and steps and total_count else None),
     }

@@ -26,7 +26,13 @@ import jax
 
 
 class AverageMeter:
-    """Running value/average/sum/count accumulator."""
+    """Running value/average/sum/count accumulator.
+
+    Reference-parity API (PipeDream's AverageMeter,
+    main_with_runtime.py:587-602 — SURVEY.md §5.5), kept exported for
+    external consumers even though the benchmark loop itself now
+    accumulates metrics on device (train/loop.py) rather than through
+    host-side meters."""
 
     def __init__(self, name: str = ""):
         self.name = name
@@ -70,6 +76,10 @@ class MetricLogger:
         self._jsonl = open(jsonl_path, "a") if jsonl_path else None
         self.epoch_throughputs: list[float] = []
         self.epoch_times: list[float] = []
+        # per-epoch input-stall time (data/prefetch.py): how long the train
+        # loop sat blocked waiting for input — the signal that separates
+        # input-bound from compute-bound regimes in the throughput curves
+        self.epoch_stall_ms: list[float] = []
         # per-epoch validation curve (reference protocol: one validation
         # accuracy per train epoch, mnist_pytorch.py:102-133); surfaced in
         # summary() so accuracy-parity artifacts carry the full curve
@@ -101,19 +111,27 @@ class MetricLogger:
             },
         )
 
-    def epoch_done(self, epoch: int, samples_per_sec: float, epoch_seconds: float) -> None:
+    def epoch_done(self, epoch: int, samples_per_sec: float, epoch_seconds: float,
+                   input_stall_ms: Optional[float] = None) -> None:
         self.epoch_throughputs.append(samples_per_sec)
         self.epoch_times.append(epoch_seconds)
-        self._emit(
+        line = (
             f"epoch {epoch}/{self.total_epochs} done | {samples_per_sec:.2f} samples/sec | "
-            f"{epoch_seconds:.2f} sec",
-            {
-                "kind": "epoch",
-                "epoch": epoch,
-                "samples_per_sec": samples_per_sec,
-                "epoch_seconds": epoch_seconds,
-            },
+            f"{epoch_seconds:.2f} sec"
         )
+        record = {
+            "kind": "epoch",
+            "epoch": epoch,
+            "samples_per_sec": samples_per_sec,
+            "epoch_seconds": epoch_seconds,
+        }
+        if input_stall_ms is not None:
+            # appended so the reference-schema prefix keeps matching existing
+            # scrapers (same convention as the valid line's top5 suffix)
+            self.epoch_stall_ms.append(input_stall_ms)
+            line += f" | input stall {input_stall_ms:.1f} ms"
+            record["input_stall_ms"] = input_stall_ms
+        self._emit(line, record)
 
     def valid_epoch(self, epoch: int, loss: float, accuracy: float,
                     top5: Optional[float] = None) -> None:
@@ -144,7 +162,7 @@ class MetricLogger:
                 "sec_per_epoch": avg_t,
             },
         )
-        return {
+        result = {
             "valid_accuracy": valid_accuracy,
             "samples_per_sec": avg_tp,
             "sec_per_epoch": avg_t,
@@ -152,6 +170,10 @@ class MetricLogger:
             # schema; the dict is the structured superset)
             "valid_history": list(self.valid_history),
         }
+        if self.epoch_stall_ms:
+            result["input_stall_ms_per_epoch"] = (
+                sum(self.epoch_stall_ms) / len(self.epoch_stall_ms))
+        return result
 
     def close(self) -> None:
         if self._jsonl:

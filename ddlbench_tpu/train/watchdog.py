@@ -41,17 +41,21 @@ class TrainingFailure(RuntimeError):
     """Raised when the configured failure policy aborts the run."""
 
 
-def check_finite(loss: float, epoch: int, step: int, policy: str = "abort") -> bool:
-    """Apply the non-finite-loss policy; returns True if the loss is finite."""
+def check_finite(loss: float, epoch: int, step: int, policy: str = "abort",
+                 where: Optional[str] = None) -> bool:
+    """Apply the non-finite-loss policy; returns True if the loss is finite.
+
+    ``where`` overrides the default "epoch E step S" location — callers that
+    detect non-finiteness away from the offending step (e.g. the eval loop's
+    one epoch-end transfer) must not claim a specific step."""
     if math.isfinite(loss):
         return True
+    where = where or f"at epoch {epoch} step {step}"
     if policy == "abort":
-        raise TrainingFailure(
-            f"non-finite loss {loss!r} at epoch {epoch} step {step}"
-        )
+        raise TrainingFailure(f"non-finite loss {loss!r} {where}")
     if policy == "warn":
         print(
-            f"WARNING: non-finite loss {loss!r} at epoch {epoch} step {step}",
+            f"WARNING: non-finite loss {loss!r} {where}",
             file=sys.stderr,
             flush=True,
         )

@@ -8,7 +8,7 @@ set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
-add_task bench              python bench.py --probe-timeout-s 60
+add_task bench              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
 add_task lmbench_synthtext  python -m ddlbench_tpu.tools.lmbench -b synthtext
 add_task lmbench_longctx    python -m ddlbench_tpu.tools.lmbench -b longctx
 add_task lmbench_synthmt    python -m ddlbench_tpu.tools.lmbench -b synthmt -m seq2seq_s

@@ -10,7 +10,7 @@ set -u
 cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
-add_task bench_final             python bench.py
+add_task bench_final             python bench.py --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
 add_task lmbench_synthtext_final python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
 add_task lmbench_longctx_final   python -m ddlbench_tpu.tools.lmbench -b longctx
 

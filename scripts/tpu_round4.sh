@@ -11,7 +11,7 @@ cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
 # -- unique round-4 evidence first ------------------------------------------
-add_task bench_r4              python bench.py --probe-timeout-s 60
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
 # paged vs dense-cached vs full-forward decode (VERDICT r3 next #6)
 add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
 # per-op HBM-traffic table of the compiled step (VERDICT r3 weak #1)

@@ -13,7 +13,7 @@ cd "$(dirname "$0")/.."
 . scripts/tpu_window_lib.sh
 
 # -- unique round-4 evidence first (carried; names unchanged) ---------------
-add_task bench_r4              python bench.py --probe-timeout-s 60
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
 add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
 add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
 add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
