@@ -178,6 +178,7 @@ def main() -> int:
     # discipline (warmup >= 1, chained train state, float(loss) sync — the
     # axon tunnel's block_until_ready is unreliable) lives in tools/timing.
     from ddlbench_tpu.data.prefetch import Prefetcher
+    from ddlbench_tpu.telemetry.stats import percentile
     from ddlbench_tpu.tools.timing import timed_steps_prefetched
 
     x, y = data.batch(0, 0)
@@ -192,11 +193,13 @@ def main() -> int:
     # headline number includes (and reports) any input-boundedness.
     prefetcher = Prefetcher(data, strategy.shard_batch,
                             depth=args.prefetch_depth)
-    runs = sorted(timed_steps_prefetched(run_step, prefetcher, args.warmup)
-                  for _ in range(max(1, args.repeats)))
-    # the median-dt RUN, keeping its own stall figure — mixing medians of the
-    # two series could pair a throughput with another run's stall
-    dt, stall_s, steps_run = runs[len(runs) // 2]
+    runs = sorted((timed_steps_prefetched(run_step, prefetcher, args.warmup)
+                   for _ in range(max(1, args.repeats))),
+                  key=lambda r: r[0])
+    # the median-dt RUN, keeping its own stall/step-latency figures —
+    # mixing medians of the series could pair a throughput with another
+    # run's stall
+    dt, stall_s, steps_run, step_s = runs[len(runs) // 2]
 
     # steps_run, not args.steps: the timed loop drives one full epoch of the
     # stream, and the two agree only while make_synthetic keeps train_size an
@@ -210,6 +213,13 @@ def main() -> int:
         # Input-boundedness next to samples/sec: the timed loop is one
         # epoch, so this is directly comparable across BENCH_*.json rounds.
         "input_stall_ms_per_epoch": round(stall_s * 1e3, 2),
+        # Step-latency percentiles + stall fraction (telemetry/stats.py):
+        # a tight p50 with stall_frac near 0 is compute-bound; a large
+        # stall_frac says the input pipeline is the regime, regardless of
+        # what samples/sec alone suggests.
+        "step_time_p50_ms": round(percentile([t * 1e3 for t in step_s], 50), 3),
+        "step_time_p95_ms": round(percentile([t * 1e3 for t in step_s], 95), 3),
+        "stall_frac": round(stall_s / dt, 4) if dt else 0.0,
         "prefetch_depth": args.prefetch_depth,
         # A CPU fallback must never masquerade as a chip number (VERDICT r1):
         # the platform the measurement actually ran on is part of the record.

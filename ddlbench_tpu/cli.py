@@ -111,6 +111,19 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--profile-mode", default="flops", choices=("flops", "time"))
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler trace of the run here")
+    p.add_argument("--xla-trace-steps", default=None, metavar="A:B",
+                   help="capture the jax.profiler trace only for global "
+                        "train steps [A, B) instead of the whole run "
+                        "(requires --trace-dir; keeps device profiles "
+                        "openable on long runs)")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="write the host-side span trace (train loop, "
+                        "prefetch producer, sync/checkpoint phases) as "
+                        "Chrome trace-event JSON here — load in Perfetto "
+                        "(ui.perfetto.dev) or chrome://tracing")
+    p.add_argument("--trace-capacity", type=int, default=200_000,
+                   help="span ring-buffer bound; the newest events win "
+                        "when a run outlives it")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save a checkpoint per epoch here (orbax)")
     p.add_argument("--resume", action="store_true",
@@ -133,6 +146,18 @@ def build_parser() -> argparse.ArgumentParser:
 
     add_platform_arg(p)
     return p
+
+
+def _parse_step_window(spec):
+    """'A:B' -> (A, B); bounds validated by RunConfig.validate()."""
+    if spec is None:
+        return None
+    try:
+        a, b = spec.split(":")
+        return int(a), int(b)
+    except ValueError:
+        raise SystemExit(
+            f"--xla-trace-steps expects A:B (two integers); got {spec!r}")
 
 
 def config_from_args(args) -> RunConfig:
@@ -178,6 +203,10 @@ def config_from_args(args) -> RunConfig:
         hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
         profile_mode=args.profile_mode,
+        trace=args.trace,
+        trace_capacity=args.trace_capacity,
+        trace_dir=args.trace_dir,
+        xla_trace_steps=_parse_step_window(args.xla_trace_steps),
         activation_log_dir=args.log_activations_dir,
         activation_log_freq=args.log_activations_freq,
         activation_log_steps=args.log_activations_steps,
@@ -202,15 +231,22 @@ def main(argv=None) -> int:
     print("run manifest: " + json.dumps(manifest), flush=True)
 
     logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=args.jsonl)
-    if args.trace_dir:
-        # jax.profiler trace — the TPU-native replacement for the reference's
-        # hook-based torchprofiler (SURVEY.md §5.1).
-        import jax
+    try:
+        if args.trace_dir and cfg.xla_trace_steps is None:
+            # Whole-run jax.profiler trace — the TPU-native replacement for
+            # the reference's hook-based torchprofiler (SURVEY.md §5.1).
+            # With --xla-trace-steps the loop opens/closes the capture
+            # window itself (train/loop.py _XlaWindow).
+            import jax
 
-        with jax.profiler.trace(args.trace_dir):
+            with jax.profiler.trace(args.trace_dir):
+                result = run_benchmark(cfg, logger=logger)
+        else:
             result = run_benchmark(cfg, logger=logger)
-    else:
-        result = run_benchmark(cfg, logger=logger)
+    finally:
+        # flush + close the --jsonl stream even when a run dies mid-epoch:
+        # the structured log is most valuable for exactly those runs
+        logger.close()
     result.pop("train_state", None)
     print("result: " + json.dumps(result), flush=True)
     return 0

@@ -297,6 +297,20 @@ class RunConfig:
     nan_policy: str = "abort"  # abort | warn | ignore
     hang_timeout_s: Optional[float] = None
 
+    # Step-level telemetry (ddlbench_tpu/telemetry/): host-side span tracing
+    # into a bounded ring buffer, exported as a Chrome-trace-event JSON
+    # (Perfetto-loadable) at `trace`. None disables tracing entirely — the
+    # hot loop then pays one no-op check per span site and nothing else.
+    trace: Optional[str] = None
+    trace_capacity: int = 200_000  # ring-buffer bound (events)
+    # Whole-run device/XLA profile directory (jax.profiler.trace), and an
+    # optional [start, stop) global-step window for the capture — a short
+    # window keeps the profile small enough to open while the host trace
+    # above covers the whole run. Steps are counted over the whole run
+    # (epoch boundaries do not reset the counter; warmup is excluded).
+    trace_dir: Optional[str] = None
+    xla_trace_steps: Optional[Tuple[int, int]] = None
+
     # Activation/gradient deep-dive logging (torchlogger analog, SURVEY.md
     # §5.5; reference profiler main.py:543-582): every activation_log_freq
     # epochs, dump per-layer activations + dLoss/d(activation) for the first
@@ -407,6 +421,18 @@ class RunConfig:
             raise ValueError("hang_timeout_s must be positive")
         if self.prefetch_depth < 0:
             raise ValueError("prefetch_depth must be >= 0 (0 = synchronous)")
+        if self.trace_capacity < 1:
+            raise ValueError("trace_capacity must be >= 1")
+        if self.xla_trace_steps is not None:
+            a, b = self.xla_trace_steps
+            if a < 0 or b <= a:
+                raise ValueError(
+                    f"xla_trace_steps must be a [start, stop) window with "
+                    f"0 <= start < stop; got {self.xla_trace_steps}")
+            if self.trace_dir is None:
+                raise ValueError(
+                    "xla_trace_steps needs --trace-dir for the profile "
+                    "output location")
         if self.label_smoothing is not None and not 0.0 <= self.label_smoothing < 1.0:
             raise ValueError("label_smoothing must be in [0, 1)")
         if self.strategy == "sp" and self.dataset().kind not in ("tokens", "seq2seq"):

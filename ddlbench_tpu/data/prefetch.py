@@ -53,6 +53,8 @@ import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+from ddlbench_tpu.telemetry import get_tracer
+
 # Sentinel step index marking an exception delivery from the producer.
 _ERROR = -1
 
@@ -103,8 +105,24 @@ class EpochStream:
     # ---- producer (background thread) ----
 
     def _fetch(self, step: int) -> Fetched:
+        # Telemetry (telemetry/tracer.py): the producer's two phases —
+        # host-side batch production and shard/device_put — become separate
+        # spans on the producer thread's track, so an input-bound epoch
+        # shows WHERE the producer spends its time. Disabled: one flag
+        # check, no clock reads.
+        tr = get_tracer()
+        if not tr.enabled:
+            bx, by = self._data.batch(self._epoch, step, train=self._train)
+            batch = self._shard_fn(bx, by)
+            return Fetched(batch, (bx, by) if self._keep_raw else None)
+        args = {"epoch": self._epoch, "step": step, "train": self._train}
+        t0 = time.perf_counter_ns()
         bx, by = self._data.batch(self._epoch, step, train=self._train)
+        t1 = time.perf_counter_ns()
         batch = self._shard_fn(bx, by)
+        t2 = time.perf_counter_ns()
+        tr.complete("batch_produce", t0, t1, args)
+        tr.complete("shard_device_put", t1, t2, args)
         return Fetched(batch, (bx, by) if self._keep_raw else None)
 
     def _put(self, item) -> bool:
@@ -140,17 +158,27 @@ class EpochStream:
         if self._served >= self._steps:
             self.close()
             raise StopIteration
+        tr = get_tracer()
         if self._queue is None:  # synchronous (depth 0): inline fetch is the stall
-            t0 = time.perf_counter()
+            t0 = time.perf_counter_ns()
             item = self._fetch(self._served)
-            self.stall_s += time.perf_counter() - t0
+            t1 = time.perf_counter_ns()
+            self.stall_s += (t1 - t0) / 1e9
         else:
-            t0 = time.perf_counter()
+            t0 = time.perf_counter_ns()
             step, item = self._queue.get()
-            self.stall_s += time.perf_counter() - t0
+            t1 = time.perf_counter_ns()
+            self.stall_s += (t1 - t0) / 1e9
             if step == _ERROR:
                 self.close()
                 raise item
+        if tr.enabled:
+            # the consumer-side blocking wait on the ring (or the inline
+            # fetch in synchronous mode) — today's stall scalar, visible
+            # as spans on the consuming thread's timeline
+            tr.complete("ring_wait", t0, t1,
+                        {"epoch": self._epoch, "step": self._served,
+                         "train": self._train})
         self._served += 1
         if self._watchdog is not None:
             self._watchdog.kick()

@@ -40,16 +40,8 @@ from jax.experimental import pallas as pl
 from ddlbench_tpu.ops.util import pallas_out_struct as _pl_out
 
 
-def _vma(x):
-    """Varying-axes set of x (shard_map manual-mode type); () outside."""
-    return tuple(getattr(jax.typeof(x), "vma", ()) or ())
-
-
-def _pcast_to(v, axes):
-    """Mark v varying over any of `axes` it isn't already (scan carries and
-    lax.cond branches must agree on VMA types inside shard_map)."""
-    missing = tuple(a for a in axes if a not in _vma(v))
-    return lax.pcast(v, missing, to="varying") if missing else v
+from ddlbench_tpu.compat import pcast_varying as _pcast_to
+from ddlbench_tpu.compat import vma_of as _vma
 
 
 def _pad_rows(h, labels, chunk: int):

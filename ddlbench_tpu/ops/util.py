@@ -32,7 +32,9 @@ def pallas_partitions_safely(*operands) -> bool:
     to partition — both are safe. The shared policy behind the "auto"
     backends of ops/fused_xent.py and the flash-attention dispatch
     (models/transformer.py)."""
-    if any(getattr(jax.typeof(o), "vma", None) for o in operands):
+    from ddlbench_tpu.compat import vma_of
+
+    if any(vma_of(o) for o in operands):
         return True
     return not _IN_SHARDED_JIT[0]
 
@@ -60,9 +62,11 @@ def pallas_out_struct(shape, dtype, *operands):
     operands' varying-axes (VMA) types — required when a kernel runs inside a
     shard_map (e.g. per-block calls from ring attention, or any strategy
     whose model apply is shard_mapped)."""
+    from ddlbench_tpu.compat import vma_of
+
     vma = set()
     for a in operands:
-        vma |= set(getattr(jax.typeof(a), "vma", ()) or ())
+        vma |= set(vma_of(a))
     if vma:
         return jax.ShapeDtypeStruct(shape, dtype, vma=frozenset(vma))
     return jax.ShapeDtypeStruct(shape, dtype)

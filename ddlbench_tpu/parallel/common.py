@@ -265,12 +265,12 @@ def vary(v, axes):
 
     shard_map's VMA type system requires lax.switch branches and lax.scan
     carries to agree on varying-axes; constants (jnp.zeros) start invariant.
+    On pre-VMA jax (no ``jax.typeof``/``lax.pcast``) this is a no-op — see
+    ddlbench_tpu/compat.py.
     """
-    from jax import lax
+    from ddlbench_tpu.compat import pcast_varying
 
-    cur = jax.typeof(v).vma
-    missing = tuple(a for a in axes if a not in cur)
-    return lax.pcast(v, missing, to="varying") if missing else v
+    return pcast_varying(v, axes)
 
 
 def cast_input(x, dtype):

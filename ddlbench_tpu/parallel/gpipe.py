@@ -38,10 +38,9 @@ from jax import lax
 from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
-try:  # jax >= 0.4.35 exposes shard_map at top level
-    from jax import shard_map as _shard_map
-except ImportError:  # pragma: no cover
-    from jax.experimental.shard_map import shard_map as _shard_map
+# Version-adaptive shard_map (ddlbench_tpu/compat.py); every strategy
+# imports the one symbol so the policy cannot drift.
+from ddlbench_tpu.compat import shard_map as _shard_map
 
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.models.layers import LayerModel, apply_slice, init_model

@@ -1,0 +1,39 @@
+"""Step-level telemetry: unified host/device tracing + latency percentiles.
+
+The reference's observability is print-scraped throughput lines (SURVEY.md
+§5.5) — they say *that* a strategy is slow, never *where* the time went.
+This package is the decomposition layer the ROADMAP north star needs:
+
+* :mod:`telemetry.tracer` — a thread-safe, ring-buffered span/counter
+  tracer on monotonic clocks. Disabled (the default) it is a single
+  attribute check returning a cached no-op context manager, so the hot
+  loop pays nothing; enabled, every producer/consumer/watchdog thread
+  records into one bounded buffer.
+* :mod:`telemetry.export` — Chrome trace-event JSON (``traceEvents``)
+  loadable in Perfetto / ``chrome://tracing``: one track per thread (main
+  loop, prefetch producer, watchdog), named via ``thread_name`` metadata
+  events.
+* :mod:`telemetry.stats` — step-latency aggregation: p50/p95/p99/max per
+  epoch plus explicit warmup/compile-time accounting, feeding the epoch
+  log lines, JSONL, ``summary()``, and ``bench.py`` JSON.
+
+Host spans align with device traces through
+``jax.profiler.StepTraceAnnotation`` wrapping in ``train/loop.py`` and the
+windowed ``--xla-trace-steps A:B`` capture next to ``--trace-dir``
+(ddlbench_tpu/cli.py).
+
+Telemetry is metrics-neutral by construction: it only reads clocks, so
+losses are bitwise identical with tracing on or off (pinned by
+tests/test_telemetry.py).
+"""
+
+from ddlbench_tpu.telemetry.tracer import (  # noqa: F401
+    Tracer,
+    get_tracer,
+    set_tracer,
+)
+from ddlbench_tpu.telemetry.export import export_chrome_trace  # noqa: F401
+from ddlbench_tpu.telemetry.stats import (  # noqa: F401
+    StepLatencyStats,
+    percentile,
+)

@@ -1,0 +1,72 @@
+"""Chrome trace-event JSON export — the Perfetto-loadable trace format.
+
+Emits the JSON Object Format of the Trace Event spec (the format
+``chrome://tracing`` and https://ui.perfetto.dev load directly):
+
+* one ``"X"`` (complete) event per span with ``ts``/``dur`` in
+  MICROSECONDS (float; the spec's unit),
+* ``"C"`` counter samples and ``"i"`` instants pass through,
+* one ``"M"`` ``thread_name`` metadata event per thread, so the main
+  loop, every prefetch producer, and the watchdog each get a named track,
+* a top-level ``metadata`` object recording the tracer's drop count (the
+  ring keeps the newest window when a run outlives its capacity).
+
+All events share one ``pid`` (this is a single-process host trace; device
+timelines come from the ``jax.profiler`` capture next to it, aligned via
+``StepTraceAnnotation`` step numbers in the span args).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List
+
+from ddlbench_tpu.telemetry.tracer import Tracer
+
+_PID = 1  # single host process; one pid keeps Perfetto's track grouping flat
+
+
+def chrome_trace_dict(tracer: Tracer) -> Dict[str, Any]:
+    """Build the trace-event dict (separated from file I/O for tests)."""
+    events: List[Dict[str, Any]] = []
+    # Track key is (os thread id, thread name), mapped to a synthetic tid:
+    # the OS reuses idents of joined threads (each epoch's prefetch
+    # producer would otherwise alias the previous one's track).
+    track_ids: Dict[tuple, int] = {}
+    for phase, name, t0_ns, dur_ns, os_tid, tname, args in tracer.events():
+        key = (os_tid, tname)
+        tid = track_ids.get(key)
+        if tid is None:
+            tid = track_ids[key] = len(track_ids) + 1
+            events.append({
+                "ph": "M", "name": "thread_name", "pid": _PID, "tid": tid,
+                "args": {"name": tname},
+            })
+        evt: Dict[str, Any] = {
+            "ph": phase, "name": name, "pid": _PID, "tid": tid,
+            "ts": t0_ns / 1e3,
+        }
+        if phase == "X":
+            evt["dur"] = dur_ns / 1e3
+        if phase == "i":
+            evt["s"] = "t"  # thread-scoped instant
+        if args:
+            evt["args"] = dict(args)
+        events.append(evt)
+    return {
+        "traceEvents": events,
+        "displayTimeUnit": "ms",
+        "metadata": {
+            "producer": "ddlbench_tpu.telemetry",
+            "dropped_events": tracer.dropped_events,
+        },
+    }
+
+
+def export_chrome_trace(tracer: Tracer, path: str) -> int:
+    """Write the trace to ``path``; returns the number of span/counter
+    events written (metadata events excluded)."""
+    doc = chrome_trace_dict(tracer)
+    with open(path, "w") as f:
+        json.dump(doc, f)
+    return sum(1 for e in doc["traceEvents"] if e["ph"] != "M")

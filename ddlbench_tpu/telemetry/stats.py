@@ -1,0 +1,92 @@
+"""Step-latency aggregation: per-epoch percentiles + warmup accounting.
+
+Wall-clock percentiles over per-step host times answer the first
+observability question — is the step-time distribution tight (compute
+bound, healthy pipeline) or heavy-tailed (input stalls, periodic syncs,
+recompiles)? The loop records EVERY step's wall time (two monotonic clock
+reads — cheap enough to stay on even when tracing is off), and the
+per-epoch p50/p95/p99/max land on the epoch log line, in JSONL, in
+``summary()``, and in ``bench.py`` JSON.
+
+Interpretation note (documented in ARCHITECTURE.md): with async dispatch
+and on-device metric accumulation the host loop runs ahead of the device,
+so most steps measure DISPATCH cost and the interval-boundary steps absorb
+the accumulated device time — a tight p50 with a p95 near
+``log_interval x`` the true step time is the signature of a healthy
+pipelined loop, not a stutter. The armed watchdog (per-step sync) makes
+every sample a true device-step latency.
+
+Warmup/compile accounting is explicit: XLA's first-compile seconds are
+clocked separately (``warmup_compile_s``) and NEVER mixed into the step
+distribution, so percentiles describe steady-state only.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Dict, List, Optional
+
+
+def percentile(samples: List[float], q: float) -> float:
+    """q-th percentile (0..100) with linear interpolation (numpy default).
+
+    Pure-Python on sorted copies — sample counts here are steps/epoch
+    (thousands at most), far below where numpy would matter.
+    """
+    if not samples:
+        return 0.0
+    if not 0.0 <= q <= 100.0:
+        raise ValueError(f"percentile q={q} outside [0, 100]")
+    s = sorted(samples)
+    k = (len(s) - 1) * (q / 100.0)
+    lo = math.floor(k)
+    hi = math.ceil(k)
+    if lo == hi:
+        return s[int(k)]
+    return s[lo] + (s[hi] - s[lo]) * (k - lo)
+
+
+def latency_summary(samples_s: List[float]) -> Dict[str, float]:
+    """p50/p95/p99/max (milliseconds) + count for one sample set."""
+    ms = [t * 1e3 for t in samples_s]
+    return {
+        "p50_ms": percentile(ms, 50.0),
+        "p95_ms": percentile(ms, 95.0),
+        "p99_ms": percentile(ms, 99.0),
+        "max_ms": max(ms) if ms else 0.0,
+        "steps": len(ms),
+    }
+
+
+class StepLatencyStats:
+    """Per-epoch step-duration collector for one run (single-threaded:
+    only the train loop records)."""
+
+    def __init__(self) -> None:
+        self._epochs: Dict[int, List[float]] = {}
+        self.warmup_compile_s: Optional[float] = None
+
+    def record_step(self, epoch: int, seconds: float) -> None:
+        self._epochs.setdefault(epoch, []).append(seconds)
+
+    def set_warmup(self, seconds: float) -> None:
+        """Clock the out-of-band warmup/compile block (train/loop.py runs
+        it on a throwaway state before the measured epochs)."""
+        self.warmup_compile_s = seconds
+
+    def epoch_summary(self, epoch: int) -> Optional[Dict[str, float]]:
+        samples = self._epochs.get(epoch)
+        if not samples:
+            return None
+        return latency_summary(samples)
+
+    def run_summary(self) -> Optional[Dict[str, float]]:
+        """Percentiles over ALL recorded steps (not a mean of per-epoch
+        percentiles), plus the warmup/compile accounting."""
+        samples = [t for ep in sorted(self._epochs) for t in self._epochs[ep]]
+        if not samples:
+            return None
+        out = latency_summary(samples)
+        if self.warmup_compile_s is not None:
+            out["warmup_compile_s"] = self.warmup_compile_s
+        return out

@@ -32,6 +32,7 @@ TRAIN_RE = re.compile(
 EPOCH_RE = re.compile(
     r"epoch (?P<epoch>\d+)/(?P<total>\d+) done \| (?P<sps>[\d.]+) samples/sec \| "
     r"(?P<sec>[\d.]+) sec(?: \| input stall (?P<stall>[\d.]+) ms)?"
+    r"(?: \| step p50 (?P<p50>[\d.]+) ms, p95 (?P<p95>[\d.]+) ms)?"
 )
 VALID_RE = re.compile(
     r"valid \| (?P<epoch>\d+)/(?P<total>\d+) epoch \| loss (?P<loss>[-\d.naife]+) \| "
@@ -75,6 +76,9 @@ def scrape(text: str) -> Dict[str, Any]:
             epochs[e]["epoch_seconds"] = float(m["sec"])
             if m["stall"]:  # input-stall suffix (async input pipeline)
                 epochs[e]["input_stall_ms"] = float(m["stall"])
+            if m["p50"]:  # step-latency suffix (telemetry/stats.py)
+                epochs[e]["step_time_p50_ms"] = float(m["p50"])
+                epochs[e]["step_time_p95_ms"] = float(m["p95"])
         elif m := VALID_RE.search(line):
             e = int(m["epoch"])
             epochs.setdefault(e, {"epoch": e})

@@ -37,18 +37,21 @@ def timed_steps(run_step: Callable[[object, object], dict],
 
 
 def timed_steps_prefetched(run_step: Callable[..., dict], prefetcher,
-                           warmup: int) -> Tuple[float, float, int]:
+                           warmup: int) -> Tuple[float, float, int, list]:
     """``timed_steps`` driven by the async input pipeline.
 
     ``prefetcher`` is a data.prefetch.Prefetcher; the timed region consumes
     one full epoch-1 stream (so batch production + device placement overlap
     the steps, exactly as in the training loop) and returns
-    ``(seconds, input_stall_seconds, steps)`` — the stall term is how much
-    of the measured wall clock was spent blocked waiting on input, and
-    ``steps`` is the number of steps actually driven (the stream's epoch
-    length; callers must derive throughput from it, not from their own
-    step count). Same discipline as timed_steps: warmup outside the clock,
-    chained state, float(loss) as the closing barrier."""
+    ``(seconds, input_stall_seconds, steps, step_seconds)`` — the stall
+    term is how much of the measured wall clock was spent blocked waiting
+    on input, ``steps`` is the number of steps actually driven (the
+    stream's epoch length; callers must derive throughput from it, not
+    from their own step count), and ``step_seconds`` is the per-step
+    dispatch wall time (ring wait excluded — it is the stall), feeding the
+    p50/p95 step-latency fields of bench.py's JSON. Same discipline as
+    timed_steps: warmup outside the clock, chained state, float(loss) as
+    the closing barrier."""
     m = None
     batch = prefetcher.shard_fn(*prefetcher.data.batch(0, 0))
     for _ in range(max(1, warmup)):
@@ -61,12 +64,15 @@ def timed_steps_prefetched(run_step: Callable[..., dict], prefetcher,
     t0 = time.perf_counter()
     stream = prefetcher.stream(1, train=True)
     steps = 0
+    step_s = []
     try:
         for fetched in stream:
+            ts0 = time.perf_counter()
             m = run_step(*fetched.batch)
+            step_s.append(time.perf_counter() - ts0)
             steps += 1
         float(m["loss"])
         dt = time.perf_counter() - t0
     finally:
         stream.close()
-    return dt, stream.stall_s, steps
+    return dt, stream.stall_s, steps, step_s

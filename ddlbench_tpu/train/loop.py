@@ -9,6 +9,7 @@ device-side work lives in the strategy's jitted steps.
 
 from __future__ import annotations
 
+import contextlib
 import time
 from typing import Any, Dict, Optional
 
@@ -19,9 +20,48 @@ from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.data.prefetch import Prefetcher
 from ddlbench_tpu.data.synthetic import make_synthetic
 from ddlbench_tpu.parallel.api import make_strategy
+from ddlbench_tpu.telemetry import (StepLatencyStats, Tracer,
+                                    export_chrome_trace, get_tracer,
+                                    set_tracer)
 from ddlbench_tpu.train.metrics import MetricLogger
 from ddlbench_tpu.train.watchdog import HangWatchdog, check_finite
 from ddlbench_tpu.parallel.common import step_decay_lr
+
+_NULL_CTX = contextlib.nullcontext()
+
+
+class _XlaWindow:
+    """Windowed jax.profiler capture: ``--xla-trace-steps A:B`` profiles
+    global train steps [A, B) into ``trace_dir`` (device timelines stay
+    small enough to open; the host trace covers the whole run). With no
+    window configured every call is a no-op."""
+
+    def __init__(self, cfg: RunConfig):
+        self.window = cfg.xla_trace_steps
+        self.trace_dir = cfg.trace_dir
+        self.active = False
+        self.done = False
+
+    def step(self, gstep: int, sync) -> None:
+        """Called before dispatching global step ``gstep``; ``sync()`` must
+        block until the device drained (used to close the window)."""
+        if self.window is None or self.done:
+            return
+        start, stop = self.window
+        if not self.active and start <= gstep < stop:
+            jax.profiler.start_trace(self.trace_dir)
+            self.active = True
+        elif self.active and gstep >= stop:
+            sync()
+            self.close()
+
+    def close(self) -> None:
+        if self.active:
+            jax.profiler.stop_trace()
+            self.active = False
+            self.done = True
+            print(f"xla profile (steps {self.window[0]}:{self.window[1]}) "
+                  f"written to {self.trace_dir}", flush=True)
 
 
 def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] = None,
@@ -60,16 +100,47 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
         strategy = make_strategy(cfg, input_time_ms=input_ms)
     logger = logger or MetricLogger(cfg.epochs, cfg.log_interval)
 
+    # Step-level telemetry (ddlbench_tpu/telemetry/): a fresh bounded
+    # tracer per run when --trace is set, exported (Perfetto-loadable) in
+    # the finally so a run that dies mid-epoch still leaves its trace.
+    # With tracing off the global tracer stays disabled and every span
+    # site below is a no-op check.
+    tracer, prev_tracer = None, None
+    if cfg.trace:
+        # fail fast on an unwritable path — the export happens at run END,
+        # and discovering a bad --trace there would waste the whole run
+        with open(cfg.trace, "a"):
+            pass
+        prev_tracer = get_tracer()
+        tracer = set_tracer(Tracer(cfg.trace_capacity)).enable()
+
     # Failure detection (SURVEY.md §5.3): the watchdog is kicked at every
     # host sync point below; non-finite losses go through cfg.nan_policy.
     # Started only after warmup so the first deadline excludes XLA compile
     # (tens of seconds); with warmup_steps=0 the first step's compile counts.
     wd = HangWatchdog(cfg.hang_timeout_s) if cfg.hang_timeout_s else None
+    xla_window = _XlaWindow(cfg)
     try:
-        return _run_benchmark(cfg, strategy, data, logger, warmup_steps, wd)
+        return _run_benchmark(cfg, strategy, data, logger, warmup_steps, wd,
+                              xla_window)
     finally:
         if wd:
             wd.stop()
+        # an exception mid-window must still stop + flush the device
+        # profile (and leave jax.profiler usable for the next run)
+        xla_window.close()
+        if tracer is not None:
+            tracer.disable()
+            set_tracer(prev_tracer)  # drop the ring; untraced runs follow
+            try:
+                n = export_chrome_trace(tracer, cfg.trace)
+            except OSError as e:  # never mask the run's own exception
+                print(f"telemetry: trace export to {cfg.trace} failed: {e}",
+                      flush=True)
+            else:
+                print(f"telemetry: {n} trace events written to {cfg.trace}"
+                      + (f" ({tracer.dropped_events} dropped: ring full)"
+                         if tracer.dropped_events else ""), flush=True)
 
 
 def _make_data(cfg: RunConfig):
@@ -127,7 +198,8 @@ def _make_data(cfg: RunConfig):
 
 
 def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
-                   warmup_steps: int, wd: Optional[HangWatchdog]) -> Dict[str, Any]:
+                   warmup_steps: int, wd: Optional[HangWatchdog],
+                   xla_window: Optional[_XlaWindow] = None) -> Dict[str, Any]:
 
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
@@ -148,11 +220,22 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         base_lr = base_lr * strategy.world_size * cfg.grad_accum_steps
         warmup_world = strategy.world_size
 
+    # Step-latency accounting (telemetry/stats.py): every loop iteration's
+    # wall time is recorded (two monotonic clock reads — stays on even with
+    # tracing off) and aggregated to p50/p95/p99/max per epoch for the
+    # epoch lines / JSONL / summary. The tracer is only consulted through
+    # its `enabled` flag on the hot path.
+    stats = StepLatencyStats()
+    tracer = get_tracer()
+
     # Warmup: trigger compilation outside the timed region (first XLA compile is
     # tens of seconds; the reference's closest analog is cudnn.benchmark=True,
     # imagenet_pytorch.py:58-66). Runs on a throwaway state so the measured run
-    # starts from pristine params/momentum/BN stats.
+    # starts from pristine params/momentum/BN stats. The wall time is kept
+    # as the run's explicit warmup/compile accounting — never mixed into
+    # the step-latency distribution.
     if warmup_steps > 0:
+        t_warm = time.perf_counter_ns()
         ts_warm = strategy.init(jax.random.key(cfg.seed))
         batch = strategy.shard_batch(*data.batch(epoch=0, step=0))
         for _ in range(warmup_steps):
@@ -164,6 +247,9 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             # below) never spans a first-eval XLA compile
             float(strategy.eval_step(ts_warm, *batch)["loss"])
         del ts_warm
+        t_warm_end = time.perf_counter_ns()
+        stats.set_warmup((t_warm_end - t_warm) / 1e9)
+        tracer.complete("warmup_compile", t_warm, t_warm_end)
 
     ts = strategy.init(jax.random.key(cfg.seed))
 
@@ -195,7 +281,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         from ddlbench_tpu.train.checkpoint import latest_epoch, restore_checkpoint
 
         if latest_epoch(cfg.checkpoint_dir) is not None:
-            ep, ts = restore_checkpoint(cfg.checkpoint_dir, ts)
+            with tracer.span("checkpoint_restore"):
+                ep, ts = restore_checkpoint(cfg.checkpoint_dir, ts)
             start_epoch = ep + 1
             print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
             # post-resume validation BEFORE training continues (reference
@@ -236,6 +323,15 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         wd.kick()
         wd.start()
 
+    # Host/device trace alignment: when a jax.profiler capture is on (whole
+    # run via cli.py's --trace-dir, or the [A, B) window below), every step
+    # dispatch is wrapped in a StepTraceAnnotation carrying the global step
+    # number, so device timelines line up with the host spans' step args.
+    annotate_steps = cfg.trace_dir is not None
+    if xla_window is None:
+        xla_window = _XlaWindow(cfg)
+    global_step = 0
+
     summary_acc = 0.0
     for epoch in range(start_epoch, cfg.epochs + 1):
         lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
@@ -250,6 +346,7 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         # there, it accumulates the plain floats instead of paying a
         # second device-side sum and interval transfer.
         loss_sum, host_loss_sum, interval_steps = None, 0.0, 0
+        metrics = None
         stream = prefetch.stream(epoch, train=True,
                                  keep_raw=actlog is not None)
         try:
@@ -272,8 +369,20 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     step_lr = gradual_warmup_lr(
                         lr, warmup_world, epoch - 1, step, steps,
                         cfg.warmup_epochs)
-                ts, metrics = strategy.train_step(ts, *fetched.batch,
-                                                  jnp.float32(step_lr))
+                # Step wall time = this loop body (dispatch + any sync the
+                # body performs); the ring wait on input is accounted
+                # separately as stall (data/prefetch.py), so the two
+                # decompose the epoch instead of double-counting it.
+                t_step = time.perf_counter_ns()
+                xla_window.step(global_step, lambda: (
+                    float(metrics["loss"]) if metrics is not None else None))
+                ann = (jax.profiler.StepTraceAnnotation(
+                    "train", step_num=global_step)
+                    if annotate_steps else _NULL_CTX)
+                with ann:
+                    ts, metrics = strategy.train_step(ts, *fetched.batch,
+                                                      jnp.float32(step_lr))
+                global_step += 1
                 interval_samples += global_batch
                 interval_steps += 1
                 # With the watchdog armed, sync every step so the deadline
@@ -282,7 +391,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 # scalar per log interval.
                 log_step = (step + 1) % cfg.log_interval == 0 or step == steps - 1
                 if wd:
-                    step_loss = float(metrics["loss"])  # transfer = sync
+                    with tracer.span("step_sync"):
+                        step_loss = float(metrics["loss"])  # transfer = sync
                     check_finite(step_loss, epoch, step + 1, cfg.nan_policy)
                     wd.kick()
                     host_loss_sum += step_loss
@@ -299,7 +409,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                         # the interval, so non-finite losses propagate into
                         # it (the interval mean cannot pin the offending
                         # step — only the watchdog's per-step sync can)
-                        loss = float(loss_sum) / interval_steps
+                        with tracer.span("interval_sync"):
+                            loss = float(loss_sum) / interval_steps
                         check_finite(loss, epoch, step + 1, cfg.nan_policy,
                                      where=f"in epoch {epoch} interval "
                                            f"ending step {step + 1}")
@@ -312,17 +423,25 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                         loss,
                     )
                     interval_tick, interval_samples = now, 0
+                t_step_end = time.perf_counter_ns()
+                stats.record_step(epoch, (t_step_end - t_step) / 1e9)
+                if tracer.enabled:
+                    tracer.complete("train_step", t_step, t_step_end,
+                                    {"epoch": epoch, "step": step,
+                                     "global_step": global_step - 1})
         finally:
             stream.close()
         # the final step is always a log_step, so the loop already synced on
         # the full ts chain before the clock stops here
         epoch_time = time.perf_counter() - tick
         logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time,
-                          input_stall_ms=stream.stall_ms)
+                          input_stall_ms=stream.stall_ms,
+                          step_ms=stats.epoch_summary(epoch))
 
         # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
-        val = evaluate(cfg, strategy, ts, data, epoch, wd,
-                       prefetcher=prefetch)
+        with tracer.span("eval_epoch", epoch=epoch):
+            val = evaluate(cfg, strategy, ts, data, epoch, wd,
+                           prefetcher=prefetch)
         logger.valid_epoch(epoch, val["loss"], val["accuracy"],
                            top5=val.get("top5"))
         summary_acc = val["accuracy"]
@@ -332,11 +451,13 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
 
             if wd:
                 wd.kick()  # the save itself gets a full deadline
-            save_checkpoint(cfg.checkpoint_dir, epoch, ts)
+            with tracer.span("checkpoint_save", epoch=epoch):
+                save_checkpoint(cfg.checkpoint_dir, epoch, ts)
             if wd:
                 wd.kick()
 
-    result = logger.summary(summary_acc)
+    xla_window.close()  # a window that outlived the run still gets flushed
+    result = logger.summary(summary_acc, step_time=stats.run_summary())
     result["train_state"] = ts
     return result
 
@@ -363,10 +484,12 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
     def acc(total, v):
         return v if total is None else total + v
 
+    tracer = get_tracer()
     stream = pf.stream(epoch, train=False)
     try:
         for fetched in stream:
-            m = strategy.eval_step(ts, *fetched.batch)
+            with tracer.span("eval_step"):
+                m = strategy.eval_step(ts, *fetched.batch)
             steps += 1
             if wd is not None:
                 # armed watchdog: per-step transfer = sync, so a device hang
@@ -384,9 +507,10 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
     finally:
         stream.close()
     if steps:  # ONE device->host transfer for all accumulators = epoch sync
-        loss_sum, correct_sum, correct5_sum, count_sum = jax.device_get(
-            (loss_sum, correct_sum,
-             correct5_sum if saw_correct5 else 0, count_sum))
+        with tracer.span("eval_epoch_sync"):
+            loss_sum, correct_sum, correct5_sum, count_sum = jax.device_get(
+                (loss_sum, correct_sum,
+                 correct5_sum if saw_correct5 else 0, count_sum))
     total_count = int(count_sum) if steps else 0
     loss = float(loss_sum) / max(1, total_count) if steps else 0.0
     # detection happens at the one epoch-end transfer, so no specific step

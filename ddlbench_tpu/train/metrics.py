@@ -112,7 +112,8 @@ class MetricLogger:
         )
 
     def epoch_done(self, epoch: int, samples_per_sec: float, epoch_seconds: float,
-                   input_stall_ms: Optional[float] = None) -> None:
+                   input_stall_ms: Optional[float] = None,
+                   step_ms: Optional[Dict[str, float]] = None) -> None:
         self.epoch_throughputs.append(samples_per_sec)
         self.epoch_times.append(epoch_seconds)
         line = (
@@ -131,6 +132,15 @@ class MetricLogger:
             self.epoch_stall_ms.append(input_stall_ms)
             line += f" | input stall {input_stall_ms:.1f} ms"
             record["input_stall_ms"] = input_stall_ms
+        if step_ms:
+            # step-latency percentiles (telemetry/stats.py) — appended after
+            # the stall field, same suffix convention
+            line += (f" | step p50 {step_ms['p50_ms']:.2f} ms, "
+                     f"p95 {step_ms['p95_ms']:.2f} ms")
+            record["step_time_p50_ms"] = step_ms["p50_ms"]
+            record["step_time_p95_ms"] = step_ms["p95_ms"]
+            record["step_time_p99_ms"] = step_ms["p99_ms"]
+            record["step_time_max_ms"] = step_ms["max_ms"]
         self._emit(line, record)
 
     def valid_epoch(self, epoch: int, loss: float, accuracy: float,
@@ -139,29 +149,34 @@ class MetricLogger:
                 f"loss {loss:.4f} | accuracy {accuracy:.4f}")
         record = {"kind": "valid", "epoch": epoch, "loss": loss,
                   "accuracy": accuracy}
+        hist = {"epoch": epoch, "loss": loss, "accuracy": accuracy}
         if top5 is not None:
             # prec@5 (PipeDream parity); appended so top-1-only scrapers
             # keep matching the line prefix
             line += f" | top5 {top5:.4f}"
             record["top5"] = top5
-        self.valid_history.append(
-            {"epoch": epoch, "loss": loss, "accuracy": accuracy})
+            hist["top5"] = top5
+        self.valid_history.append(hist)
         self._emit(line, record)
 
-    def summary(self, valid_accuracy: float) -> Dict[str, float]:
-        """Final line matching mnist_pytorch.py:225-226's schema."""
+    def summary(self, valid_accuracy: float,
+                step_time: Optional[Dict[str, float]] = None) -> Dict[str, float]:
+        """Final line matching mnist_pytorch.py:225-226's schema.
+
+        ``step_time`` is the run-level step-latency aggregate
+        (telemetry/stats.py ``StepLatencyStats.run_summary``): percentiles
+        over all recorded steps plus the warmup/compile accounting. The
+        printed line keeps the reference schema; the JSONL record and the
+        returned dict carry the percentiles.
+        """
         avg_tp = sum(self.epoch_throughputs) / max(1, len(self.epoch_throughputs))
         avg_t = sum(self.epoch_times) / max(1, len(self.epoch_times))
-        self._emit(
-            f"valid accuracy: {valid_accuracy:.4f} | "
-            f"{avg_tp:.2f} samples/sec, {avg_t:.2f} sec/epoch (average)",
-            {
-                "kind": "summary",
-                "valid_accuracy": valid_accuracy,
-                "samples_per_sec": avg_tp,
-                "sec_per_epoch": avg_t,
-            },
-        )
+        record = {
+            "kind": "summary",
+            "valid_accuracy": valid_accuracy,
+            "samples_per_sec": avg_tp,
+            "sec_per_epoch": avg_t,
+        }
         result = {
             "valid_accuracy": valid_accuracy,
             "samples_per_sec": avg_tp,
@@ -170,9 +185,25 @@ class MetricLogger:
             # schema; the dict is the structured superset)
             "valid_history": list(self.valid_history),
         }
+        if step_time:
+            extras = {
+                "step_time_p50_ms": step_time["p50_ms"],
+                "step_time_p95_ms": step_time["p95_ms"],
+                "step_time_p99_ms": step_time["p99_ms"],
+                "step_time_max_ms": step_time["max_ms"],
+            }
+            if "warmup_compile_s" in step_time:
+                extras["warmup_compile_s"] = step_time["warmup_compile_s"]
+            record.update(extras)
+            result.update(extras)
         if self.epoch_stall_ms:
             result["input_stall_ms_per_epoch"] = (
                 sum(self.epoch_stall_ms) / len(self.epoch_stall_ms))
+        self._emit(
+            f"valid accuracy: {valid_accuracy:.4f} | "
+            f"{avg_tp:.2f} samples/sec, {avg_t:.2f} sec/epoch (average)",
+            record,
+        )
         return result
 
     def close(self) -> None:
