@@ -83,6 +83,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--shard-opt-state", action="store_true",
                    help="ZeRO-1 on dp: shard optimizer state over the data "
                         "axis (params stay replicated)")
+    p.add_argument("--dp-shard-update", action="store_true",
+                   help="explicit sharded weight update on dp (ZeRO-1 via "
+                        "shard_map): reduce-scatter grads, update a 1/world "
+                        "slice of packed params + optimizer state per chip, "
+                        "all-gather updated params")
+    p.add_argument("--allreduce-dtype", default="f32",
+                   choices=("f32", "float32", "bf16", "bfloat16"),
+                   help="wire dtype for dp's gradient collectives "
+                        "(bf16 = EQuARX-style compressed allreduce, half "
+                        "the gradient wire bytes)")
     p.add_argument("--warmup-epochs", type=int, default=0,
                    help="gradual lr warmup epochs (Horovod ImageNet parity: "
                         "base lr -> base*world over this many epochs)")
@@ -188,6 +198,8 @@ def config_from_args(args) -> RunConfig:
         lr=args.lr,
         optimizer=args.optimizer,
         shard_opt_state=args.shard_opt_state,
+        dp_shard_update=args.dp_shard_update,
+        allreduce_dtype=args.allreduce_dtype,
         warmup_epochs=args.warmup_epochs,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,

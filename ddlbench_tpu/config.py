@@ -210,6 +210,21 @@ class RunConfig:
     # the update and all-gathers the delta. No reference analog (its DP
     # replicates everything).
     shard_opt_state: bool = False
+    # Explicit sharded weight update for dp (ZeRO-1 via shard_map, not
+    # GSPMD placement): gradients reduce-scatter over 'data', the packed
+    # flat-vector optimizer state and the weight update live 1/world per
+    # chip (contiguous slice), updated params all-gather back. Same wire
+    # bytes as the replicated ring allreduce (RS + AG = 2(r-1)/r x P), but
+    # optimizer memory and update FLOPs drop ~world x. See
+    # parallel/dp.py DPShardedEngine.
+    dp_shard_update: bool = False
+    # Wire dtype for dp's explicit gradient collectives (EQuARX-style
+    # compressed allreduce): "float32" (exact; the default) or "bfloat16"
+    # (halves gradient wire bytes; accuracy parity gated by the digits
+    # matrix — tools/accparity.py dp-bf16 engines). Values "f32"/"bf16"
+    # normalize. Any non-f32 setting routes dp through the explicit
+    # shard_map collective engine even without dp_shard_update.
+    allreduce_dtype: str = "float32"
     # Gradient accumulation: K micro-steps between optimizer updates, grads
     # averaged (Horovod backward_passes_per_step / batches_per_allreduce
     # parity, imagenet_horovod.py:131-139; dp with SGD also scales lr by K —
@@ -339,6 +354,25 @@ class RunConfig:
         if self.dataset().kind in ("tokens", "seq2seq"):
             return 0.01
         return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
+
+    def resolved_allreduce_dtype(self) -> str:
+        """Canonical allreduce_dtype: 'float32' or 'bfloat16'."""
+        alias = {"f32": "float32", "float32": "float32",
+                 "bf16": "bfloat16", "bfloat16": "bfloat16"}
+        try:
+            return alias[self.allreduce_dtype]
+        except KeyError:
+            raise ValueError(
+                f"unknown allreduce_dtype {self.allreduce_dtype!r} "
+                f"(choose f32/float32 or bf16/bfloat16)")
+
+    def dp_explicit_collectives(self) -> bool:
+        """True when dp runs the explicit shard_map collective engine
+        (sharded weight update and/or compressed gradient collectives)
+        instead of leaving the gradient allreduce to GSPMD."""
+        return self.strategy == "dp" and (
+            self.dp_shard_update
+            or self.resolved_allreduce_dtype() != "float32")
 
     def resolved_label_smoothing(self) -> float:
         if self.label_smoothing is not None:
@@ -546,6 +580,38 @@ class RunConfig:
             raise ValueError(
                 "shard_opt_state (ZeRO-1) applies to the dp strategy "
                 "(fsdp already shards everything)")
+        self.resolved_allreduce_dtype()  # raises on unknown values
+        if self.dp_shard_update and self.strategy != "dp":
+            raise ValueError(
+                "dp_shard_update (sharded weight update) applies to the dp "
+                "strategy (fsdp already shards everything)")
+        if self.dp_shard_update and self.shard_opt_state:
+            raise ValueError(
+                "dp_shard_update supersedes shard_opt_state: the explicit "
+                "engine already shards the optimizer state (pick one)")
+        if self.shard_opt_state and self.strategy == "dp" and \
+                self.resolved_allreduce_dtype() != "float32":
+            raise ValueError(
+                "shard_opt_state is a GSPMD placement knob; the compressed-"
+                "allreduce engine pins the optimizer state replicated — "
+                "use dp_shard_update for sharded state with bf16 wire")
+        if self.resolved_allreduce_dtype() != "float32" and \
+                self.strategy != "dp":
+            raise ValueError(
+                "allreduce_dtype applies to the dp strategy's gradient "
+                "collectives")
+        if self.dp_explicit_collectives():
+            if "moe" in self.arch:
+                raise ValueError(
+                    "dp_shard_update / compressed allreduce run the train "
+                    "step under shard_map, where MoE router statistics "
+                    "would become per-shard (replicated dp routes over the "
+                    "global batch); use replicated dp for MoE archs")
+            if self.remat_layers:
+                raise ValueError(
+                    "remat_layers is incompatible with the explicit dp "
+                    "collective engine (checkpointed traces cannot carry "
+                    "the shard_map axis context); use replicated dp")
         if self.virtual_stages > 1:
             if self.strategy not in ("gpipe", "pipedream"):
                 raise ValueError(

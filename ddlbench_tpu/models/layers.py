@@ -70,6 +70,66 @@ class axis_context:
         return cls._stack[-1] if cls._stack else None
 
 
+class batch_parallel(axis_context):
+    """Trace-time marker: the model is applied inside a shard_map whose
+    named axis shards the BATCH dimension (the dp sharded-update engine,
+    parallel/dp.py). batchnorm then computes cross-replica (global-batch)
+    statistics explicitly via :func:`sync_batch_mean` — the same sync-BN
+    semantics GSPMD derives automatically when the batch axis is sharded
+    under one jit. The entry carries (axis_name, world) because the
+    unbiased-variance correction needs the static global count."""
+
+    _stack: List[Any] = []
+
+    def __init__(self, axis: str, world: int):
+        super().__init__(axis)
+        self.world = int(world)
+
+    def __enter__(self):
+        type(self)._stack.append((self.axis, self.world))
+        return self
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1, 2, 3))
+def sync_batch_mean(x, shape, axis, world):
+    """Global-batch mean of ``x`` over all-but-last axes, f32-accumulated,
+    inside a shard_map whose ``axis`` shards the leading (batch) dim.
+
+    Mirrors the op order of GSPMD's partitioned ``jnp.mean(x, axes,
+    dtype=f32)`` — local reduce, cross-replica sum, divide by the GLOBAL
+    count — and defines the matching backward explicitly: the stat
+    cotangents are genuinely partial per device (each device's backward
+    only sees its local rows' contributions), so they are psum'd, divided
+    by the global count, and broadcast over the local rows; exactly the
+    reduce/divide/broadcast sequence of the partitioned transpose.
+    ``shape`` is the static LOCAL shape of x, ``world`` the axis size.
+    """
+    axes = tuple(range(len(shape) - 1))
+    local = 1
+    for a in axes:
+        local *= shape[a]
+    return lax.psum(jnp.sum(x, axis=axes, dtype=jnp.float32), axis) / (
+        local * world)
+
+
+def _sync_batch_mean_fwd(x, shape, axis, world):
+    return sync_batch_mean(x, shape, axis, world), jnp.zeros((), x.dtype)
+
+
+def _sync_batch_mean_bwd(shape, axis, world, res, ct):
+    axes = tuple(range(len(shape) - 1))
+    local = 1
+    for a in axes:
+        local *= shape[a]
+    ct = lax.psum(ct, axis) / (local * world)
+    bshape = [1] * len(shape)
+    bshape[-1] = shape[-1]
+    return (jnp.broadcast_to(ct.reshape(bshape), shape).astype(res.dtype),)
+
+
+sync_batch_mean.defvjp(_sync_batch_mean_fwd, _sync_batch_mean_bwd)
+
+
 @dataclasses.dataclass(frozen=True)
 class Layer:
     """One pipeline-atomic unit of a model.
@@ -248,14 +308,26 @@ def batchnorm(p, s, x, train: bool):
     """
     axes = tuple(range(x.ndim - 1))
     if train:
+        sync = batch_parallel.current()
         # One-pass stats; the f32 converts fuse into the reductions (no f32
-        # copy of x hits HBM, unlike a two-pass mean-then-var).
-        mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
-        mean2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes, dtype=jnp.float32)
+        # copy of x hits HBM, unlike a two-pass mean-then-var). Under a
+        # batch_parallel axis (the dp sharded-update engine) the means are
+        # explicit cross-replica psums over the global batch — the sync-BN
+        # semantics the sharded-jit strategies get from GSPMD.
+        if sync is not None:
+            axis, world = sync
+            mean = sync_batch_mean(x, x.shape, axis, world)
+            mean2 = sync_batch_mean(lax.square(x.astype(jnp.float32)),
+                                    x.shape, axis, world)
+        else:
+            world = 1
+            mean = jnp.mean(x, axis=axes, dtype=jnp.float32)
+            mean2 = jnp.mean(lax.square(x.astype(jnp.float32)), axis=axes,
+                             dtype=jnp.float32)
         var = jnp.maximum(mean2 - lax.square(mean), 0.0)
         # Running var uses the unbiased estimator (torch BatchNorm semantics);
         # normalization below uses the biased batch var, also matching torch.
-        n = x.size // x.shape[-1]
+        n = (x.size // x.shape[-1]) * world
         unbiased = var * (n / max(1, n - 1))
         new_s = {
             "mean": (1 - BN_MOMENTUM) * s["mean"] + BN_MOMENTUM * mean,

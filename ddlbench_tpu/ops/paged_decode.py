@@ -134,6 +134,14 @@ def paged_prefill_write(cache, k, v, page: int | None = None, start: int = 0):
     page = page or PAGE
     start = int(start)
     rows, S, H, dh = k.shape
+    capacity = cache["table"].shape[1] * page
+    # .at[...].set scatters with out-of-bounds indices silently dropped /
+    # clamped, so a chunk running past the pool would truncate KV history
+    # with no error (advisor r5) — reject it at trace time instead.
+    assert start + S <= capacity, (
+        f"prefill chunk [{start}, {start + S}) exceeds the paged cache "
+        f"capacity {capacity} ({cache['table'].shape[1]} pages x {page}); "
+        f"allocate the cache for the full prompt before chunked prefill")
 
     if start == 0:
         npg_s = num_pages(S, page)

@@ -9,6 +9,9 @@ unchanged to packed flat-vector stage parameters in the pipeline strategies.
 
 from __future__ import annotations
 
+import functools
+from typing import NamedTuple
+
 import jax
 import jax.numpy as jnp
 
@@ -219,6 +222,85 @@ def make_optimizer(cfg):
         return pick(0), {"m": pick(1), "v": pick(2), "step": step}
 
     return init, update
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(1,))
+def psum_keepgrad(x, axis):
+    """``lax.psum`` whose backward is the identity (pbroadcast semantics).
+
+    Inside shard_map, differentiating a psum'd LOSS must seed each device's
+    local backward with the replicated cotangent unchanged: every device
+    already holds the same seed (e.g. 1/global_count), and the cross-device
+    gradient sum happens once, explicitly, on the gradients themselves
+    (psum_scatter in the dp sharded engine). Stock pre-VMA jax transposes
+    psum-under-grad to another psum, which would scale such gradients by
+    the axis size. Use this for aggregates whose cotangent is replicated
+    (loss sums); aggregates with genuinely per-device partial cotangents
+    (sync-BN batch statistics) need the mirrored reduction in
+    models/layers.sync_batch_mean instead.
+    """
+    from jax import lax
+
+    return lax.psum(x, axis)
+
+
+def _psum_keepgrad_fwd(x, axis):
+    from jax import lax
+
+    return lax.psum(x, axis), None
+
+
+def _psum_keepgrad_bwd(axis, _res, ct):
+    return (ct,)
+
+
+psum_keepgrad.defvjp(_psum_keepgrad_fwd, _psum_keepgrad_bwd)
+
+
+class FlatMeta(NamedTuple):
+    """Packing recipe for one pytree <-> one flat f32 vector.
+
+    ``length`` is the unpadded element count; ``padded`` rounds it up so a
+    'data'-axis shard is a contiguous equal slice per device. The pad tail
+    is mathematically inert through both SGD and Adam: zero params with
+    zero grads update to zero (Adam's denominator bottoms out at eps).
+    """
+
+    treedef: object
+    shapes: tuple
+    dtypes: tuple
+    sizes: tuple
+    length: int
+    padded: int
+
+
+def flat_meta(params, world: int) -> FlatMeta:
+    """Works on concrete leaves and jax.eval_shape ShapeDtypeStructs."""
+    import math
+
+    leaves, treedef = jax.tree.flatten(params)
+    shapes = tuple(tuple(l.shape) for l in leaves)
+    dtypes = tuple(jnp.dtype(l.dtype) for l in leaves)
+    sizes = tuple(math.prod(s) for s in shapes)
+    length = int(sum(sizes))
+    padded = -(-length // world) * world
+    return FlatMeta(treedef, shapes, dtypes, sizes, length, padded)
+
+
+def pack_flat(tree, meta: FlatMeta) -> jax.Array:
+    """Concatenate the tree's raveled f32 leaves into one [padded] vector."""
+    flat = jnp.concatenate(
+        [l.astype(jnp.float32).ravel() for l in jax.tree.leaves(tree)])
+    return jnp.pad(flat, (0, meta.padded - meta.length))
+
+
+def unpack_flat(flat: jax.Array, meta: FlatMeta):
+    """Inverse of pack_flat (drops the pad tail, restores leaf dtypes)."""
+    out, off = [], 0
+    for size, shape, dtype in zip(meta.sizes, meta.shapes, meta.dtypes):
+        out.append(flat[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(meta.treedef, out)
 
 
 def opt_state_sharding(cfg, param_sharding, scalar_sharding):

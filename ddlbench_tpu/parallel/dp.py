@@ -18,6 +18,29 @@ Deviation (documented): BatchNorm statistics are computed over the *global*
 batch (sync-BN) because the batch axis is sharded under one jit; Horovod
 computes per-replica statistics. Throughput is unaffected; accuracy parity is
 equal or better (SURVEY.md §7 "BatchNorm under pipeline/DP").
+
+Sharded weight update (``--dp-shard-update``, ZeRO-1): with the flag on, the
+train step runs under an explicit shard_map over the 'data' axis instead of
+leaving the collective pattern to GSPMD: each device computes its batch
+shard's partial gradients, the packed flat gradient vector reduce-scatters
+(``lax.psum_scatter``) so every chip receives one contiguous 1/world slice
+of the summed gradient, momentum/Adam state and the weight update live on
+that slice only (the packed flat-vector optimizer of parallel/common.py
+makes the shard a contiguous slice), and the updated parameter shard
+all-gathers back to the replicated pytree at the shard_map boundary. Wire
+bytes equal the replicated ring allreduce (RS + AG = 2(r-1)/r x P) but
+optimizer-state memory and update FLOPs drop ~world x. BatchNorm runs
+explicit cross-replica statistics (models/layers.batch_parallel), keeping
+replicated dp's sync-BN semantics. ``--allreduce-dtype bf16`` additionally
+casts the gradient partials to bfloat16 before the collective (EQuARX-style
+compressed allreduce — dtype-narrowed ring collectives without block
+rescaling), halving gradient wire bytes; it composes with or without the
+sharded update (without, the engine runs an explicit bf16 ``lax.psum`` and
+keeps the update replicated). Numerics: the f32 sharded update is pinned
+bitwise-identical to replicated dp on the CPU mesh for non-BN models
+(tests/test_dp_shard.py); BN models agree to float rounding only, because
+GSPMD places the BN-backward cross-replica reductions around linear ops at
+its own discretion while the explicit engine fixes them (sync_batch_mean).
 """
 
 from __future__ import annotations
@@ -50,16 +73,28 @@ class DPStrategy:
         self.mesh = mesh or make_data_mesh(cfg.num_devices)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         self._opt_init, opt_update = make_optimizer(cfg)
+        self._opt_update = opt_update
         smooth = cfg.resolved_label_smoothing()
 
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P("data"))
 
+        # Explicit collective engine (sharded weight update / compressed
+        # allreduce): the train step is built by _build_explicit_engine
+        # below instead of the GSPMD path; eval is identical either way.
+        self.shard_update = bool(cfg.dp_shard_update)
+        self.wire_dtype = jnp.dtype(cfg.resolved_allreduce_dtype())
+        self._explicit = cfg.dp_explicit_collectives()
+        self._flat_meta = None
+
         def train_step(ts: TrainState, x, y, lr):
             # MoE routing statistics are global-batch (dense semantics: the
             # batch axis is sharded under one jit). With grad_accum_steps > 1
-            # this is Horovod backward_passes_per_step parity: K micro-steps,
-            # one allreduce on the averaged gradient.
+            # this is Horovod backward_passes_per_step parity: K micro-steps
+            # between optimizer updates. (GSPMD reduces each micro-gradient
+            # inside the scan — the carry needs a concrete sharding — so the
+            # wire cost is K allreduces; the explicit sharded engine below
+            # halves that with K reduce-scatters.)
             from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import loss_and_grads
 
@@ -83,21 +118,259 @@ class DPStrategy:
                 return eval_metrics(model, cfg, ts.params, ts.model_state,
                                     x, y, self.compute_dtype)
 
-        self.train_step = jax.jit(
-            train_step,
-            donate_argnums=(0,),
-            in_shardings=(None, self._batch_sharding, self._batch_sharding, None),
-            out_shardings=None,
-        )
+        if self._explicit:
+            self._build_explicit_engine(smooth)
+        else:
+            self.train_step = jax.jit(
+                train_step,
+                donate_argnums=(0,),
+                in_shardings=(None, self._batch_sharding,
+                              self._batch_sharding, None),
+                out_shardings=None,
+            )
         self.eval_step = jax.jit(
             eval_step,
             in_shardings=(None, self._batch_sharding, self._batch_sharding),
         )
 
+    # -- explicit collective engine (ZeRO-1 / compressed allreduce) --------
+
+    def _local_loss_sums(self, params, state, x, y, smooth):
+        """Local-shard (obj_sum, ce_sum, correct, valid, norm) mirroring
+        loss_with_moe_aux's global computation op for op, so the explicit
+        engine's partial gradients and metrics match the GSPMD path's.
+        ``norm`` is the LOCAL loss normalizer contribution (float mask sum
+        for the unfused CE, int valid count for the fused head — the two
+        paths normalize with different dtypes in the replicated step)."""
+        from ddlbench_tpu.models.layers import apply_model
+        from ddlbench_tpu.parallel.common import (cast_input, cast_params,
+                                                  correct_and_count,
+                                                  fused_head_loss_sums,
+                                                  head_fusable)
+
+        cfg = self.cfg
+        p = cast_params(params, self.compute_dtype)
+        xc = cast_input(x, self.compute_dtype)
+        if cfg.fused_head_loss and head_fusable(self.model):
+            obj_sum, ce_sum, correct, valid, new_state = fused_head_loss_sums(
+                self.model, p, state, xc, y, smooth)
+            return obj_sum, ce_sum, correct, valid, valid, new_state
+        logits, new_state = apply_model(self.model, p, state, xc, True)
+        lf = logits.astype(jnp.float32)
+        logp = jax.nn.log_softmax(lf, axis=-1)
+        maskf = (y >= 0).astype(jnp.float32)
+        safe = jnp.maximum(y, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        ce_sum = jnp.sum(nll * maskf)
+        if smooth:
+            nll_s = (1.0 - smooth) * nll - smooth * jnp.mean(logp, axis=-1)
+            obj_sum = jnp.sum(nll_s * maskf)
+        else:
+            obj_sum = ce_sum
+        correct, valid = correct_and_count(logits, y)
+        return obj_sum, ce_sum, correct, valid, jnp.sum(maskf), new_state
+
+    def _build_explicit_engine(self, smooth):
+        """Build train_step as one jit whose body is an explicit shard_map
+        over 'data': per-device partial grads -> packed flat vector ->
+        psum_scatter (sharded update) or psum (replicated update), in
+        self.wire_dtype on the wire -> packed-slice optimizer update ->
+        params re-assembled at the sharding boundary (the all-gather)."""
+        from jax import lax
+
+        from ddlbench_tpu.compat import shard_map as _shard_map
+        from ddlbench_tpu.models.layers import batch_parallel
+        from ddlbench_tpu.parallel.common import (flat_meta, pack_flat,
+                                                  psum_keepgrad, unpack_flat,
+                                                  vary)
+
+        cfg = self.cfg
+        model = self.model
+        mesh = self.mesh
+        n = mesh.devices.size
+        K = cfg.grad_accum_steps
+        shard_update = self.shard_update
+        wire = self.wire_dtype
+        opt_update = self._opt_update
+
+        abs_params = jax.eval_shape(
+            lambda k: init_model(model, k)[0], jax.random.key(0))
+        meta = flat_meta(abs_params, n)
+        self._flat_meta = meta
+        shard_len = meta.padded // n
+
+        def reduce_grads(g):
+            """Partial gradient pytree -> REDUCED packed flat f32 vector:
+            the wire-dtype cast, then psum_scatter (sharded update: each
+            device keeps one contiguous 1/world slice of the sum) or psum
+            (replicated update). The single collective site of the step."""
+            gf = pack_flat(g, meta).astype(wire)
+            if shard_update:
+                return lax.psum_scatter(gf, "data",
+                                        tiled=True).astype(jnp.float32)
+            return lax.psum(gf, "data").astype(jnp.float32)
+
+        def local_grads(params, state, x, y):
+            """(ce, correct, valid, new_state, g_reduced): psum'd metrics
+            plus the reduced flat gradient (shard or full vector).
+            Non-accum partials are pre-seeded by 1/global_count (the GSPMD
+            backward's seed) and reduced once. Grad accumulation reduces
+            EVERY micro-gradient inside the scan — mirroring the
+            replicated step, whose scan carry forces GSPMD to allreduce
+            each micro-gradient (one fused multiply-add per step on the
+            reduced value; bitwise parity needs the same summation order)
+            — and divides the reduced sum by the total weight at the end.
+            Wire-wise this still halves replicated accum's cost: K
+            reduce-scatters vs K full allreduces."""
+            from ddlbench_tpu.ops.util import sharded_jit_tracing
+
+            if K == 1:
+                def loss_fn(p):
+                    with sharded_jit_tracing():
+                        obj_sum, ce_sum, correct, valid, norm, new_state = \
+                            self._local_loss_sums(p, state, x, y, smooth)
+                    denom = jnp.maximum(
+                        1.0, lax.psum(norm, "data").astype(jnp.float32))
+                    obj = psum_keepgrad(obj_sum, "data") / denom
+                    return obj, (ce_sum, correct, valid, denom, new_state)
+
+                (_, (ce_sum, correct, valid, denom, new_state)), g = \
+                    jax.value_and_grad(loss_fn, has_aux=True)(params)
+                ce = lax.psum(ce_sum, "data") / denom
+                return (ce, lax.psum(correct, "data"),
+                        lax.psum(valid, "data"), new_state, reduce_grads(g))
+
+            B = x.shape[0]
+            assert B % K == 0, (
+                f"local batch {B} not divisible by grad_accum_steps {K}")
+            # Micro-step k takes every K-th local row — the same rows of
+            # the global micro-batch that GSPMD keeps on this device
+            # (common.accum_loss_and_grads's re-grouping, applied to the
+            # local shard).
+            xs = x.reshape(B // K, K, *x.shape[1:])
+            ys = y.reshape(B // K, K, *y.shape[1:])
+
+            def step(carry, k):
+                st, gsum = carry
+                xk = lax.dynamic_index_in_dim(xs, k, axis=1, keepdims=False)
+                yk = lax.dynamic_index_in_dim(ys, k, axis=1, keepdims=False)
+
+                def f(p):
+                    with sharded_jit_tracing():
+                        obj_sum, ce_sum, correct, valid, norm, new_st = \
+                            self._local_loss_sums(p, st, xk, yk, smooth)
+                    denom = jnp.maximum(
+                        1.0, lax.psum(norm, "data").astype(jnp.float32))
+                    obj = psum_keepgrad(obj_sum, "data") / denom
+                    return obj, (ce_sum, correct, valid, denom, new_st)
+
+                (_, (ce_sum, correct, valid, denom, new_st)), g = \
+                    jax.value_and_grad(f, has_aux=True)(params)
+                ce_k = lax.psum(ce_sum, "data") / denom
+                wk = lax.psum(valid, "data").astype(jnp.float32)
+                gsum = gsum + wk * reduce_grads(g)
+                return (new_st, gsum), (ce_k, wk, lax.psum(correct, "data"),
+                                        lax.psum(valid, "data"))
+
+            gsum0 = jnp.zeros(
+                (shard_len if shard_update else meta.padded,), jnp.float32)
+            if shard_update:
+                # psum_scatter outputs are device-varying; the scan carry
+                # must start with matching varying-axes type
+                gsum0 = vary(gsum0, ("data",))
+            (new_state, gsum), (ces, wks, corrs, valids) = lax.scan(
+                step, (state, gsum0), jnp.arange(K))
+            total = jnp.maximum(1.0, jnp.sum(wks))
+            ce = jnp.sum(ces * wks) / total
+            return (ce, jnp.sum(corrs), jnp.sum(valids), new_state,
+                    gsum / total)
+
+        def local_step(params, state, opt, x, y, lr):
+            with batch_parallel("data", n):
+                ce, correct, valid, new_state, gr = local_grads(
+                    params, state, x, y)
+            metrics = {
+                "loss": ce,
+                "accuracy": correct.astype(jnp.float32)
+                / jnp.maximum(1.0, valid.astype(jnp.float32)),
+            }
+            if shard_update:
+                pf = pack_flat(params, meta)
+                ps = lax.dynamic_slice_in_dim(
+                    pf, lax.axis_index("data") * shard_len, shard_len)
+                new_ps, new_opt = opt_update(ps, gr, opt, lr)
+                # out_spec P('data') on the updated slice re-assembles the
+                # flat parameter vector across devices — the all-gather
+                # happens at the shard_map output boundary.
+                return new_ps, new_state, new_opt, metrics
+            # compressed allreduce with the replicated update: the explicit
+            # psum already ran in the wire dtype; per-leaf optimizer step.
+            new_params, new_opt = opt_update(
+                params, unpack_flat(gr, meta), opt, lr)
+            return new_params, new_state, new_opt, metrics
+
+        flat_spec = P("data") if shard_update else P()
+        flat_sh = (NamedSharding(mesh, P("data")) if shard_update
+                   else self._replicated)
+        opt_specs = {"m": flat_spec}
+        opt_shardings = {"m": flat_sh}
+        if cfg.resolved_optimizer() == "adam":
+            opt_specs.update(v=flat_spec, step=P())
+            opt_shardings.update(v=flat_sh, step=self._replicated)
+        self._opt_shardings = opt_shardings
+
+        sharded = _shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P(), opt_specs, P("data"), P("data"), P()),
+            out_specs=(P("data") if shard_update else P(), P(), opt_specs,
+                       P()),
+        )
+
+        def step(ts: TrainState, x, y, lr):
+            p_out, new_state, new_opt, metrics = sharded(
+                ts.params, ts.model_state, ts.opt, x, y, lr)
+            new_params = unpack_flat(p_out, meta) if shard_update else p_out
+            return TrainState(new_params, new_state, new_opt), metrics
+
+        jit_step = jax.jit(
+            step,
+            donate_argnums=(0,),
+            in_shardings=(None, self._batch_sharding, self._batch_sharding,
+                          None),
+            out_shardings=(TrainState(self._replicated, self._replicated,
+                                      opt_shardings), None),
+        )
+        self._jit_train_step = jit_step  # introspection (tests, tools)
+        span_args = {"mode": "sharded" if shard_update else "replicated",
+                     "wire": str(jnp.dtype(wire))}
+
+        def train_step(ts, x, y, lr):
+            from ddlbench_tpu.telemetry import get_tracer
+
+            tracer = get_tracer()
+            if tracer.enabled:
+                # marks the update phase's dispatch on the host timeline;
+                # device time lives in the --trace-dir XLA capture
+                with tracer.span("dp_explicit_update", **span_args):
+                    return jit_step(ts, x, y, lr)
+            return jit_step(ts, x, y, lr)
+
+        self.train_step = train_step
+
     def init(self, key) -> TrainState:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
+        if self._explicit and self.shard_update:
+            # ZeRO-1: optimizer state lives on the packed flat vector, one
+            # contiguous [padded/world] slice per device.
+            opt = self._opt_init(
+                jnp.zeros((self._flat_meta.padded,), jnp.float32))
+            ts = TrainState(params, state, opt)
+            shardings = TrainState(self._replicated, self._replicated,
+                                   self._opt_shardings)
+            return put_global_tree(ts, shardings)
         ts = TrainState(params, state, self._opt_init(params))
         # Broadcast-init parity (mnist_horovod.py:230-231): replicate to the
         # mesh — identical on every host since init is seed-deterministic.

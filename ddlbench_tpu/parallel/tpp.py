@@ -104,9 +104,13 @@ class TPGPipeStrategy:
         if cfg.fused_head_loss and head_fusable(model):
             # default-on flag, so a hard validate() error would hit every
             # tpp run; surface the scope limit instead of silently differing
-            # from plain gpipe's fused path
+            # from plain gpipe's fused path. stderr: stdout carries the
+            # machine-scraped result/JSON lines (advisor r5).
+            import sys
+
             print("tpp: fused projection+loss head is not supported under "
-                  "tp_size > 1; using the unfused CE head", flush=True)
+                  "tp_size > 1; using the unfused CE head", file=sys.stderr,
+                  flush=True)
 
     # -- initialization ----------------------------------------------------
 

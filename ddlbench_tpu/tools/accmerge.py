@@ -6,8 +6,10 @@ protocol) and merging is cheaper than repeating the whole matrix. Later
 documents override earlier ones per engine; error rows are replaced by
 successful re-runs. The summary block (final_accuracies / spread / pass)
 is recomputed over the merged engine set with the FIRST document's
-thresholds, and the protocol fields are carried from the first document —
-callers must only merge runs of the same protocol.
+thresholds, and the protocol fields are carried from the first document.
+Protocol identity is ENFORCED: documents disagreeing on arch / threshold /
+max_spread / protocol / dataset are different experiments and refuse to
+merge (exit 2), so a stale matrix cannot silently override a newer re-run.
 
 ``--drop-unresolved`` removes engines whose merged row is still an error
 (e.g. variants deliberately not re-run), moving them to a ``dropped``
@@ -24,7 +26,33 @@ import json
 import sys
 
 
+# Fields that define the measurement protocol: documents disagreeing on any
+# of these are different experiments, and merging them would let a stale
+# matrix silently override a newer re-run (advisor r5). ``protocol`` itself
+# is prose (epochs/lr/batch live in it), so it participates too.
+PROTOCOL_FIELDS = ("arch", "threshold", "max_spread", "protocol", "dataset")
+
+
+class ProtocolMismatch(ValueError):
+    pass
+
+
+def check_protocol(docs: list[dict]) -> None:
+    """Raise ProtocolMismatch when any input disagrees with the first
+    document on a protocol-identity field (missing fields are tolerated —
+    older artifacts predate some of them)."""
+    base = docs[0]
+    for i, doc in enumerate(docs[1:], start=1):
+        for field in PROTOCOL_FIELDS:
+            if field in base and field in doc and doc[field] != base[field]:
+                raise ProtocolMismatch(
+                    f"document {i} disagrees with document 0 on protocol "
+                    f"field {field!r}: {doc[field]!r} != {base[field]!r}; "
+                    f"only re-runs of the SAME protocol may be merged")
+
+
 def merge(docs: list[dict], drop_unresolved: bool = False) -> dict:
+    check_protocol(docs)
     base = dict(docs[0])
     engines: dict = {}
     for doc in docs:
@@ -65,7 +93,12 @@ def main(argv=None) -> int:
     for p in paths:
         with open(p) as f:
             docs.append(json.load(f))
-    print(json.dumps(merge(docs, drop_unresolved=drop)))
+    try:
+        merged = merge(docs, drop_unresolved=drop)
+    except ProtocolMismatch as e:
+        print(f"accmerge: {e}", file=sys.stderr)
+        return 2
+    print(json.dumps(merged))
     return 0
 
 

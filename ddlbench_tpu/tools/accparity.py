@@ -46,6 +46,16 @@ import tempfile
 ENGINES = {
     "single": ["-f", "single", "--batch-size", "32"],
     "dp": ["-f", "dp", "-g", "2", "--batch-size", "32"],
+    # explicit collective engine (parallel/dp.py): ZeRO-1 sharded weight
+    # update, and the EQuARX-style bf16 compressed allreduce — the
+    # accuracy-parity gate for --allreduce-dtype bf16 lives HERE (the f32
+    # sharded update is pinned bitwise by tests/test_dp_shard.py)
+    "dp-shard": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                 "--dp-shard-update"],
+    "dp-bf16": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                "--allreduce-dtype", "bf16"],
+    "dp-shard-bf16": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                      "--dp-shard-update", "--allreduce-dtype", "bf16"],
     "gpipe": ["-f", "gpipe", "-g", "2",
               "--micro-batch-size", "8", "--num-microbatches", "4"],
     "pipedream": ["-f", "pipedream", "-g", "2",

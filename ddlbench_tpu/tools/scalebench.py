@@ -75,6 +75,15 @@ def main(argv=None) -> int:
                    help="timed loops per point; the reported figure is the "
                         "median (3+ recommended on the shared TPU tunnel)")
     p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--dp-shard-update", action="store_true",
+                   help="run the dp points with the explicit sharded weight "
+                        "update (ZeRO-1; parallel/dp.py) — A/B against a "
+                        "plain run to price the reduce-scatter/all-gather "
+                        "pattern")
+    p.add_argument("--allreduce-dtype", default="f32",
+                   choices=("f32", "float32", "bf16", "bfloat16"),
+                   help="wire dtype for dp's gradient collectives "
+                        "(bf16 = compressed allreduce)")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -118,17 +127,24 @@ def main(argv=None) -> int:
                       batch_size=args.batch_size)
             if strat not in ("dp", "fsdp"):
                 kw["num_stages"] = n
+            point = {"strategy": strat, "devices": n}
+            if strat == "dp" and (args.dp_shard_update
+                                  or args.allreduce_dtype not in
+                                  ("f32", "float32")):
+                kw["dp_shard_update"] = args.dp_shard_update
+                kw["allreduce_dtype"] = args.allreduce_dtype
+                point["dp_shard_update"] = args.dp_shard_update
+                point["allreduce_dtype"] = args.allreduce_dtype
             cfg = RunConfig(**kw)
             try:
                 cfg.validate()
                 ips = _run_point(cfg, args.steps, args.warmup, args.repeats)
             except Exception as e:  # point failures shouldn't kill the sweep
-                print(json.dumps({"strategy": strat, "devices": n,
-                                  "error": str(e)[:200]}), flush=True)
+                print(json.dumps({**point, "error": str(e)[:200]}),
+                      flush=True)
                 continue
             print(json.dumps({
-                "strategy": strat,
-                "devices": n,
+                **point,
                 "samples_per_sec": round(ips, 2),
                 "per_chip": round(ips / n, 2),
                 "efficiency": round(ips / n / anchor, 4),

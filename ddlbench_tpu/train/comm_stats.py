@@ -9,6 +9,15 @@ counters we compute the exact analytic volume per train step from the strategy
 topology — same numbers, no instrumentation overhead:
 
 * dp: ring all-reduce of all gradients, 2 (r-1)/r * param_bytes per step.
+  With the explicit sharded weight update (--dp-shard-update, ZeRO-1) the
+  pattern decomposes into its two halves and is reported as such:
+  reduce-scatter of the gradients ((r-1)/r * grad_wire_bytes, where the
+  wire dtype follows --allreduce-dtype) plus all-gather of the updated
+  params ((r-1)/r * param_bytes, always f32 — the master weights). The
+  physical_* twins price the PADDED packed flat vector the engine actually
+  ships (the pad aligns the per-device shard; logical payload excludes it).
+  A bf16 --allreduce-dtype without the sharded update is an explicit bf16
+  ring all-reduce: half the gradient wire bytes, same pattern.
 * gpipe: every microbatch crosses every interior stage boundary twice
   (activation forward, gradient backward) + one per-step gradient all-reduce
   across each stage's 'data' replicas.
@@ -34,15 +43,35 @@ def comm_stats(strategy) -> Dict[str, float]:
     out: Dict[str, float] = {
         "boundary_bytes": 0.0,
         "allreduce_bytes": 0.0,
+        "reduce_scatter_bytes": 0.0,
+        "all_gather_bytes": 0.0,
     }
     if name == "SingleStrategy":
         pass
     elif name == "DPStrategy":
-        import jax
+        import numpy as np
 
         params, _, _ = _model_params(strategy)
         r = strategy.world_size
-        out["allreduce_bytes"] = _ring_allreduce_bytes(float(pb(params)), r)
+        pbytes = float(pb(params))
+        wire_itemsize = np.dtype(
+            getattr(strategy, "wire_dtype", "float32")).itemsize
+        # gradient elements ride the wire in the (possibly narrowed)
+        # --allreduce-dtype; params are f32 (pb already prices them)
+        grad_wire = pbytes / 4.0 * wire_itemsize
+        meta = getattr(strategy, "_flat_meta", None)
+        if getattr(strategy, "shard_update", False):
+            out["reduce_scatter_bytes"] = (r - 1) / r * grad_wire
+            out["all_gather_bytes"] = (r - 1) / r * pbytes
+            # physical: the engine ships the PADDED packed flat vector
+            out["physical_reduce_scatter_bytes"] = (
+                (r - 1) / r * meta.padded * wire_itemsize)
+            out["physical_all_gather_bytes"] = (r - 1) / r * meta.padded * 4.0
+        else:
+            out["allreduce_bytes"] = _ring_allreduce_bytes(grad_wire, r)
+            if meta is not None:  # explicit bf16 engine, replicated update
+                out["physical_allreduce_bytes"] = _ring_allreduce_bytes(
+                    float(meta.padded * wire_itemsize), r)
     elif name in ("HeteroGPipeStrategy", "HeteroPipeDreamStrategy"):
         # Uneven hybrid PPxDP (parallel/hetero.py). boundary/allreduce are
         # LOGICAL payload bytes (reference RuntimeStats parity,
@@ -103,7 +132,9 @@ def comm_stats(strategy) -> Dict[str, float]:
             per_sync = _ring_allreduce_bytes(grad_bytes, dp)
             syncs = M if name == "PipeDreamStrategy" else 1
             out["allreduce_bytes"] = per_sync * syncs
-    out["total_bytes"] = out["boundary_bytes"] + out["allreduce_bytes"]
+    out["total_bytes"] = (out["boundary_bytes"] + out["allreduce_bytes"]
+                          + out["reduce_scatter_bytes"]
+                          + out["all_gather_bytes"])
     return out
 
 

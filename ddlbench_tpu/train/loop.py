@@ -258,12 +258,15 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         from ddlbench_tpu.train.comm_stats import comm_stats
 
         cs = comm_stats(strategy)
-        print(
-            f"comm volume/step: {cs['total_bytes'] / 1e6:.2f} MB "
-            f"(boundaries {cs['boundary_bytes'] / 1e6:.2f} MB, "
-            f"allreduce {cs['allreduce_bytes'] / 1e6:.2f} MB)",
-            flush=True,
-        )
+        parts = [f"boundaries {cs['boundary_bytes'] / 1e6:.2f} MB",
+                 f"allreduce {cs['allreduce_bytes'] / 1e6:.2f} MB"]
+        if cs.get("reduce_scatter_bytes") or cs.get("all_gather_bytes"):
+            # explicit sharded weight update: the allreduce decomposes
+            parts.append(f"reduce-scatter "
+                         f"{cs['reduce_scatter_bytes'] / 1e6:.2f} MB")
+            parts.append(f"all-gather {cs['all_gather_bytes'] / 1e6:.2f} MB")
+        print(f"comm volume/step: {cs['total_bytes'] / 1e6:.2f} MB "
+              f"({', '.join(parts)})", flush=True)
     except Exception:
         pass
 

@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# Round-6 opportunistic TPU collector. Carries the still-unlanded round-4/5
+# queue (same task names, so any .ok marker earned in an earlier window
+# sticks), then adds the sharded-weight-update / compressed-allreduce A/B:
+# scalebench dp curves with and without --dp-shard-update and with the bf16
+# wire, a multi-chip bench.py dp A/B at the attached device count, and the
+# digits accuracy-parity gate for the bf16 engines (the f32 sharded update
+# is pinned bitwise by tier-1, so it needs no accuracy budget of its own).
+#
+# Usage: scripts/tpu_round6.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task accparity_bn_tpu_r5   python -m ddlbench_tpu.tools.accparity --engines single --arch resnet18 --epochs 12 --lr 0.02 --platform tpu
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+
+# -- round-6: sharded weight update + quantized allreduce A/B ---------------
+# scaling curve A/B: same dp points, replicated vs ZeRO-1 vs ZeRO-1+bf16.
+# Multi-chip only shows the effect from >= 2 devices; scalebench skips
+# counts above the attached slice on its own.
+add_task scalebench_dp_r6        python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3
+add_task scalebench_dpshard_r6   python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update
+add_task scalebench_dpshard_bf16_r6 python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update --allreduce-dtype bf16
+# headline-harness dp A/B (bench.py -f dp): per-chip img/s + stall/step
+# percentiles with identical measurement discipline to the 1-chip headline
+add_task bench_dp_r6             python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64
+add_task bench_dpshard_r6        python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update
+add_task bench_dpshard_bf16_r6   python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --allreduce-dtype bf16
+# accuracy-parity gate for the bf16 wire (digits matrix, real data):
+# dp-bf16 and dp-shard-bf16 must land inside the documented spread
+add_task accparity_dpshard_r6    python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-shard,dp-bf16,dp-shard-bf16
+
+window_loop "${1:-11}"

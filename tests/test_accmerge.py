@@ -50,3 +50,24 @@ def test_drop_unresolved_records_the_omission():
     assert m["pass"] is True
     assert "gpipe-iv" not in m["engines"]
     assert m["dropped"]["gpipe-iv"]["error"].startswith("timeout")
+
+
+def test_protocol_mismatch_refuses_merge():
+    import pytest
+
+    from ddlbench_tpu.tools.accmerge import ProtocolMismatch
+
+    a = _doc({"single": {"final_accuracy": 0.98}})
+    stale = {**_doc({"single": {"final_accuracy": 0.99}}), "arch": "lenet"}
+    with pytest.raises(ProtocolMismatch, match="arch"):
+        merge([a, stale])
+    looser = {**_doc({"single": {"final_accuracy": 0.99}}), "threshold": 0.5}
+    with pytest.raises(ProtocolMismatch, match="threshold"):
+        merge([a, looser])
+
+
+def test_protocol_fields_missing_in_legacy_docs_tolerated():
+    a = _doc({"single": {"final_accuracy": 0.98}})
+    legacy = _doc({"gpipe": {"final_accuracy": 0.975}})
+    del legacy["arch"]  # pre-protocol-check artifact
+    assert merge([a, legacy])["pass"] is True

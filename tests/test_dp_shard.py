@@ -1,0 +1,306 @@
+"""Sharded weight update (ZeRO-1) + compressed allreduce for dp.
+
+The explicit shard_map engine (parallel/dp.py, --dp-shard-update /
+--allreduce-dtype) must not change training semantics: for non-BN models
+the f32 sharded update is pinned BITWISE-identical to replicated dp over a
+20+-step trajectory on the 8-virtual-device CPU mesh (loss AND params),
+while shrinking per-device optimizer-state bytes by ~world. BatchNorm
+models run explicit sync-BN (models/layers.sync_batch_mean) whose backward
+agrees with GSPMD's to float rounding only — pinned with tolerances —
+because GSPMD places the BN-backward cross-replica reductions around
+linear ops at its own discretion.
+
+All cases here are tier-1-fast: tiny dense models, 2-6 steps for the
+non-bitwise checks, one 24-step bitwise trajectory.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import (LayerModel, conv_bn, dense, flatten,
+                                        global_avg_pool)
+from ddlbench_tpu.parallel.dp import DPStrategy
+from ddlbench_tpu.train.comm_stats import comm_stats
+
+pytestmark = pytest.mark.dpshard
+
+
+def _dense_model(num_classes=4):
+    layers = [flatten(), dense("fc1", 9, relu=True), dense("fc2", 8,
+                                                           relu=True),
+              dense("fc3", num_classes)]
+    return LayerModel("tinydense", layers, (4, 4, 1), num_classes)
+
+
+def _bn_model(num_classes=4):
+    layers = [conv_bn("c1", 4), global_avg_pool(), flatten(),
+              dense("fc", num_classes)]
+    return LayerModel("tinybn", layers, (4, 4, 1), num_classes)
+
+
+def _cfg(**kw):
+    base = dict(benchmark="mnist", strategy="dp", num_devices=8,
+                compute_dtype="float32", batch_size=2, steps_per_epoch=2,
+                momentum=0.5, weight_decay=1e-4)
+    base.update(kw)
+    cfg = RunConfig(**base)
+    cfg.validate()
+    return cfg
+
+
+def _batch(B, step, num_classes=4, shape=(4, 4, 1)):
+    kx, ky = jax.random.split(jax.random.key(100 + step))
+    return (jax.random.normal(kx, (B, *shape)),
+            jax.random.randint(ky, (B,), 0, num_classes))
+
+
+def _run(model, cfg, steps, lr=0.2):
+    strat = DPStrategy(model, cfg)
+    ts = strat.init(jax.random.key(cfg.seed))
+    B = cfg.global_batch()
+    losses = []
+    for s in range(steps):
+        x, y = _batch(B, s, model.num_classes, model.in_shape)
+        ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                 jnp.float32(lr))
+        losses.append(float(m["loss"]))
+    return np.array(losses), ts, strat
+
+
+def _flat_params(ts):
+    return np.concatenate(
+        [np.asarray(l).ravel() for l in jax.tree.leaves(ts.params)])
+
+
+# ---- acceptance: bitwise f32 parity + optimizer-state memory --------------
+
+
+def test_sharded_update_bitwise_trajectory_20_steps(devices):
+    """--dp-shard-update must reproduce replicated dp's f32 loss trajectory
+    BITWISE over >= 20 steps on the 8-virtual-device mesh (and end with
+    bitwise-identical params)."""
+    model = _dense_model()
+    la, tsa, _ = _run(model, _cfg(), steps=24)
+    lb, tsb, _ = _run(model, _cfg(dp_shard_update=True), steps=24)
+    np.testing.assert_array_equal(la, lb)
+    np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
+
+
+@pytest.mark.parametrize("opt", ["sgd", "adam"])
+@pytest.mark.parametrize("accum", [1, 2])
+def test_sharded_update_bitwise_variants(devices, opt, accum):
+    """Bitwise parity holds across the optimizer family and gradient
+    accumulation (the K-microstep scan mirrors the replicated weighting)."""
+    model = _dense_model()
+    kw = dict(optimizer=opt, grad_accum_steps=accum)
+    la, tsa, _ = _run(model, _cfg(**kw), steps=4)
+    lb, tsb, _ = _run(model, _cfg(dp_shard_update=True, **kw), steps=4)
+    np.testing.assert_array_equal(la, lb)
+    np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
+
+
+def test_sharded_update_bitwise_label_smoothing(devices):
+    """The smoothed-objective path (separate obj/ce sums) stays bitwise."""
+    model = _dense_model()
+    la, tsa, _ = _run(model, _cfg(label_smoothing=0.1), steps=4)
+    lb, tsb, _ = _run(model, _cfg(label_smoothing=0.1,
+                                  dp_shard_update=True), steps=4)
+    np.testing.assert_array_equal(la, lb)
+    np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
+
+
+def test_optimizer_state_bytes_shrink_by_world(devices):
+    """ZeRO-1 memory criterion: per-device optimizer-state bytes must be
+    ~world x smaller than replicated dp's (exactly total/world here — the
+    flat packed vector shards into equal contiguous slices)."""
+    model = _dense_model()
+    _, ts_rep, _ = _run(model, _cfg(optimizer="adam"), steps=1)
+    _, ts_sh, strat = _run(model, _cfg(optimizer="adam",
+                                       dp_shard_update=True), steps=1)
+    world = strat.world_size
+
+    def per_device_bytes(opt):
+        total = 0
+        for leaf in jax.tree.leaves(opt):
+            total += leaf.addressable_shards[0].data.nbytes
+        return total
+
+    rep = per_device_bytes(ts_rep.opt)
+    sh = per_device_bytes(ts_sh.opt)
+    # m+v shard 1/world each (+ the replicated scalar step and pad tail)
+    assert sh < rep / world * 1.5, (sh, rep, world)
+    for name in ("m", "v"):
+        leaf = ts_sh.opt[name]
+        assert leaf.addressable_shards[0].data.nbytes * world == leaf.nbytes
+
+
+def test_compiled_memory_analysis_reflects_sharding(devices):
+    """Cost-analysis cross-check (soft: not every backend reports it): the
+    sharded-update executable's argument bytes per device shrink vs
+    replicated — the optimizer state enters as 1/world slices."""
+    model = _dense_model()
+    _, ts, strat = _run(model, _cfg(optimizer="adam",
+                                    dp_shard_update=True), steps=1)
+    jit_step = strat._jit_train_step
+    B = strat.cfg.global_batch()
+    x, y = _batch(B, 0)
+    try:
+        compiled = jit_step.lower(ts, *strat.shard_batch(x, y),
+                                  jnp.float32(0.2)).compile()
+        mem = compiled.memory_analysis()
+        if mem is None:
+            pytest.skip("backend reports no memory analysis")
+        arg_bytes = mem.argument_size_in_bytes
+    except (AttributeError, NotImplementedError, jax.errors.JaxRuntimeError):
+        pytest.skip("backend reports no memory analysis")
+    total_opt = sum(l.nbytes for l in jax.tree.leaves(ts.opt))
+    params_bytes = sum(l.nbytes for l in jax.tree.leaves(ts.params))
+    # per-device args hold replicated params + 1/world of the opt state;
+    # replicated opt state would push args past params + total_opt
+    assert arg_bytes < params_bytes + total_opt
+
+
+# ---- sync-BN: semantics preserved, rounding-level agreement ---------------
+
+
+def test_bn_sync_statistics_close_to_replicated(devices):
+    """BN models: the explicit sync-BN engine must track replicated dp's
+    global-batch statistics and trajectory to float rounding (bitwise is
+    out of reach: GSPMD re-associates the BN-backward reductions)."""
+    model = _bn_model()
+    la, tsa, _ = _run(model, _cfg(batch_size=4), steps=6)
+    lb, tsb, _ = _run(model, _cfg(batch_size=4, dp_shard_update=True),
+                      steps=6)
+    np.testing.assert_allclose(la, lb, rtol=2e-4, atol=1e-6)
+    for sa, sb in zip(jax.tree.leaves(tsa.model_state),
+                      jax.tree.leaves(tsb.model_state)):
+        np.testing.assert_allclose(np.asarray(sa), np.asarray(sb),
+                                   rtol=2e-4, atol=1e-6)
+    np.testing.assert_allclose(_flat_params(tsa), _flat_params(tsb),
+                               rtol=5e-3, atol=1e-5)
+
+
+def test_bn_first_step_forward_is_bitwise(devices):
+    """The sync-BN FORWARD mirrors GSPMD exactly (only the backward's
+    reduction placement differs): step-1 loss and running stats match
+    bitwise."""
+    model = _bn_model()
+    la, tsa, _ = _run(model, _cfg(batch_size=4), steps=1)
+    lb, tsb, _ = _run(model, _cfg(batch_size=4, dp_shard_update=True),
+                      steps=1)
+    np.testing.assert_array_equal(la, lb)
+    for sa, sb in zip(jax.tree.leaves(tsa.model_state),
+                      jax.tree.leaves(tsb.model_state)):
+        np.testing.assert_array_equal(np.asarray(sa), np.asarray(sb))
+
+
+# ---- fused LM head path ---------------------------------------------------
+
+
+def test_fused_head_bitwise(devices):
+    """The fused projection+CE head (token workloads) keeps bitwise parity
+    under the sharded update."""
+    from tests.tiny_models import TINY_LM, tiny_transformer
+
+    model = tiny_transformer()
+    cfg_rep = _cfg(batch_size=2, optimizer="adam")
+    cfg_sh = _cfg(batch_size=2, optimizer="adam", dp_shard_update=True)
+    losses = {}
+    for name, cfg in (("rep", cfg_rep), ("sh", cfg_sh)):
+        strat = DPStrategy(model, cfg)
+        ts = strat.init(jax.random.key(0))
+        B = cfg.global_batch()
+        ls = []
+        for s in range(3):
+            kx, ky = jax.random.split(jax.random.key(7 + s))
+            x = jax.random.randint(kx, (B, TINY_LM.image_size[0]), 0,
+                                   TINY_LM.num_classes)
+            y = jax.random.randint(ky, (B, TINY_LM.image_size[0]), 0,
+                                   TINY_LM.num_classes)
+            ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                     jnp.float32(1e-2))
+            ls.append(float(m["loss"]))
+        losses[name] = np.array(ls)
+    np.testing.assert_array_equal(losses["rep"], losses["sh"])
+
+
+# ---- compressed (bf16) allreduce ------------------------------------------
+
+
+@pytest.mark.parametrize("shard", [False, True])
+def test_bf16_allreduce_trains(devices, shard):
+    """--allreduce-dtype bf16 (with and without the sharded update) must
+    train: finite losses tracking the f32 trajectory loosely (the gradient
+    sum carries bf16 rounding)."""
+    model = _dense_model()
+    lref, _, _ = _run(model, _cfg(), steps=4)
+    lq, _, _ = _run(model, _cfg(allreduce_dtype="bf16",
+                                dp_shard_update=shard), steps=4)
+    assert np.all(np.isfinite(lq))
+    np.testing.assert_allclose(lq, lref, rtol=0.05)
+
+
+# ---- comm accounting ------------------------------------------------------
+
+
+def _dp_stats(**kw):
+    cfg = _cfg(arch="lenet", **kw)
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    return comm_stats(make_strategy(cfg)), cfg
+
+
+def test_comm_stats_sharded_update_decomposition(devices):
+    """Logical wire bytes: RS(f32 grads) + AG(f32 params) must equal the
+    replicated ring-allreduce figure (the two halves of the same ring);
+    physical bytes price the padded packed vector and can only be larger."""
+    rep, _ = _dp_stats()
+    sh, _ = _dp_stats(dp_shard_update=True)
+    assert rep["allreduce_bytes"] > 0
+    assert sh["allreduce_bytes"] == 0.0
+    assert sh["reduce_scatter_bytes"] > 0 and sh["all_gather_bytes"] > 0
+    np.testing.assert_allclose(
+        sh["reduce_scatter_bytes"] + sh["all_gather_bytes"],
+        rep["allreduce_bytes"], rtol=1e-12)
+    assert sh["physical_reduce_scatter_bytes"] >= sh["reduce_scatter_bytes"]
+    assert sh["physical_all_gather_bytes"] >= sh["all_gather_bytes"]
+    assert sh["total_bytes"] == pytest.approx(
+        sh["reduce_scatter_bytes"] + sh["all_gather_bytes"])
+
+
+def test_comm_stats_bf16_halves_gradient_wire(devices):
+    rep, _ = _dp_stats()
+    q, _ = _dp_stats(allreduce_dtype="bf16")
+    np.testing.assert_allclose(q["allreduce_bytes"],
+                               rep["allreduce_bytes"] / 2, rtol=1e-12)
+    qsh, _ = _dp_stats(allreduce_dtype="bf16", dp_shard_update=True)
+    sh, _ = _dp_stats(dp_shard_update=True)
+    np.testing.assert_allclose(qsh["reduce_scatter_bytes"],
+                               sh["reduce_scatter_bytes"] / 2, rtol=1e-12)
+    # the param all-gather stays f32 (master weights)
+    np.testing.assert_allclose(qsh["all_gather_bytes"],
+                               sh["all_gather_bytes"], rtol=1e-12)
+
+
+# ---- config gates ---------------------------------------------------------
+
+
+def test_validate_gates():
+    with pytest.raises(ValueError, match="dp strategy"):
+        _cfg(strategy="fsdp", dp_shard_update=True)
+    with pytest.raises(ValueError, match="supersedes"):
+        _cfg(dp_shard_update=True, shard_opt_state=True)
+    with pytest.raises(ValueError, match="MoE"):
+        _cfg(arch="transformer_moe_s", benchmark="synthtext",
+             dp_shard_update=True)
+    with pytest.raises(ValueError, match="allreduce_dtype"):
+        _cfg(allreduce_dtype="int8")
+    with pytest.raises(ValueError, match="dp strategy"):
+        _cfg(strategy="single", num_devices=1, allreduce_dtype="bf16")
+    cfg = _cfg(allreduce_dtype="bf16")
+    assert cfg.resolved_allreduce_dtype() == "bfloat16"
+    assert cfg.dp_explicit_collectives()
+    assert not _cfg().dp_explicit_collectives()

@@ -91,6 +91,21 @@ def test_chunked_prefill_matches_whole_prompt():
                                np.asarray(2.0 * k[:, 5:11].transpose(0, 2, 1, 3)))
 
 
+def test_chunked_prefill_rejects_out_of_bounds_chunk():
+    # a chunk running past the pool capacity would silently truncate KV
+    # history through the clamped .at[].set scatter (advisor r5): the
+    # bounds assert must reject it at trace time instead
+    import pytest
+
+    cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
+    k = _rand(0, ROWS, 6, H, DH)
+    v = _rand(1, ROWS, 6, H, DH)
+    with pytest.raises(AssertionError, match="capacity"):
+        paged_prefill_write(cache, k, v, page=PAGE, start=L - 4)
+    # the last in-bounds chunk position still works
+    paged_prefill_write(cache, k[:, :4], v[:, :4], page=PAGE, start=L - 4)
+
+
 @pytest.mark.parametrize("pos,npl", [(3, 1), (7, 2), (10, 3), (14, 4)])
 def test_paged_attention_ref_matches_dense(pos, npl):
     cache = paged_cache_init(ROWS, L, H, DH, jnp.float32, page=PAGE)
