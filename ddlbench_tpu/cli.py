@@ -135,9 +135,25 @@ def build_parser() -> argparse.ArgumentParser:
                    help="span ring-buffer bound; the newest events win "
                         "when a run outlives it")
     p.add_argument("--checkpoint-dir", default=None,
-                   help="save a checkpoint per epoch here (orbax)")
+                   help="save a checkpoint per epoch here (orbax, atomic "
+                        "commit protocol)")
     p.add_argument("--resume", action="store_true",
-                   help="resume from the latest checkpoint in --checkpoint-dir")
+                   help="resume from the newest VALID checkpoint in "
+                        "--checkpoint-dir (torn/corrupt ones are skipped); "
+                        "an empty dir warns and starts fresh")
+    p.add_argument("--checkpoint-every-steps", type=int, default=None,
+                   metavar="K",
+                   help="also commit a mid-epoch checkpoint every K steps "
+                        "(full resume state: bitwise mid-epoch resume)")
+    p.add_argument("--keep-checkpoints", type=int, default=None, metavar="N",
+                   help="retain only the newest N committed checkpoints "
+                        "(older ones + stale .tmp dirs are GC'd)")
+    p.add_argument("--inject", action="append", default=[],
+                   metavar="KIND@EPOCH:STEP",
+                   help="deterministic fault injection (repeatable): kill | "
+                        "ckpt-corrupt | prefetch-die | nan-loss | slow-host "
+                        "at the given 1-based epoch / 0-based step "
+                        "(ddlbench_tpu/faults/)")
     from ddlbench_tpu.train.watchdog import NAN_POLICIES
 
     p.add_argument("--nan-policy", default="abort", choices=NAN_POLICIES,
@@ -211,6 +227,9 @@ def config_from_args(args) -> RunConfig:
         seed=args.seed,
         checkpoint_dir=args.checkpoint_dir,
         resume=args.resume,
+        checkpoint_every_steps=args.checkpoint_every_steps,
+        keep_checkpoints=args.keep_checkpoints,
+        inject=tuple(args.inject),
         nan_policy=args.nan_policy,
         hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
@@ -230,6 +249,13 @@ def main(argv=None) -> int:
     from ddlbench_tpu.distributed import apply_platform, initialize
 
     apply_platform(args.platform)
+
+    if args.inject:
+        # armed BEFORE initialize() so slow-host can hit the multihost init
+        # path; run_benchmark re-arms the same specs (fired state persists)
+        from ddlbench_tpu import faults
+
+        faults.arm(args.inject)
 
     initialize()  # no-op unless DDLB_* multi-host env is set
     cfg = config_from_args(args)

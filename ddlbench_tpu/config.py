@@ -302,9 +302,24 @@ class RunConfig:
     seed: int = 1  # reference seeds torch.manual_seed(1) (imagenet_pytorch.py:58-66)
 
     # Checkpoint/resume (reference: per-stage checkpoint.{stage}.pth.tar per
-    # epoch, main_with_runtime.py:580-584; resume :241-262).
+    # epoch, main_with_runtime.py:580-584; resume :241-262). Saves go through
+    # the atomic commit protocol in train/checkpoint.py (tmp -> fsync ->
+    # COMMIT marker -> rename); resume picks the newest checkpoint that
+    # VERIFIES against its manifest, falling back past torn or corrupt ones.
     checkpoint_dir: Optional[str] = None
     resume: bool = False
+    # Step-granular checkpoints: also commit a mid-epoch checkpoint every K
+    # completed steps (epoch_N_step_S), carrying the full resume state
+    # (global step, interior data-iterator position, metric-logger counters,
+    # seed) so a kill mid-epoch resumes bit-for-bit. None = per-epoch only.
+    checkpoint_every_steps: Optional[int] = None
+    # Retention: keep only the newest N committed checkpoints (older ones
+    # and stale .tmp dirs are GC'd after each commit). None = keep all.
+    keep_checkpoints: Optional[int] = None
+    # Deterministic fault injection (ddlbench_tpu/faults/): repeatable
+    # KIND@EPOCH:STEP specs, e.g. ("kill@2:5", "nan-loss@1:3"). Empty =
+    # disarmed; the hooks then cost one falsy check each.
+    inject: Tuple[str, ...] = ()
 
     # Failure detection (reference has none beyond a 120-min process-group
     # timeout, SURVEY.md §5.3): abort/warn/ignore on non-finite loss, and an
@@ -453,6 +468,21 @@ class RunConfig:
             )
         if self.hang_timeout_s is not None and self.hang_timeout_s <= 0:
             raise ValueError("hang_timeout_s must be positive")
+        if self.checkpoint_every_steps is not None:
+            if self.checkpoint_every_steps < 1:
+                raise ValueError("checkpoint_every_steps must be >= 1")
+            if self.checkpoint_dir is None:
+                raise ValueError(
+                    "checkpoint_every_steps needs --checkpoint-dir for the "
+                    "checkpoint location")
+        if self.keep_checkpoints is not None and self.keep_checkpoints < 1:
+            raise ValueError(
+                "keep_checkpoints must be >= 1 (the newest checkpoint is "
+                "never dropped)")
+        if self.inject:
+            from ddlbench_tpu.faults import parse_injections
+
+            parse_injections(self.inject)  # raises on bad grammar/kind
         if self.prefetch_depth < 0:
             raise ValueError("prefetch_depth must be >= 0 (0 = synchronous)")
         if self.trace_capacity < 1:

@@ -53,7 +53,9 @@ import threading
 import time
 from typing import Any, Callable, NamedTuple, Optional, Tuple
 
+from ddlbench_tpu import faults
 from ddlbench_tpu.telemetry import get_tracer
+from ddlbench_tpu.train.watchdog import TrainingFailure
 
 # Sentinel step index marking an exception delivery from the producer.
 _ERROR = -1
@@ -79,7 +81,8 @@ class EpochStream:
 
     def __init__(self, data, shard_fn: Callable, epoch: int, steps: int,
                  train: bool, depth: int, watchdog=None,
-                 keep_raw: bool = False, heartbeat: bool = True):
+                 keep_raw: bool = False, heartbeat: bool = True,
+                 start_step: int = 0):
         if not heartbeat:
             watchdog = None
         self._data = data
@@ -89,6 +92,14 @@ class EpochStream:
         self._train = train
         self._watchdog = watchdog
         self._keep_raw = keep_raw
+        # mid-epoch resume (train/checkpoint.py step-granular checkpoints):
+        # the stream serves steps [start_step, steps). Random-access sources
+        # jump straight to start_step; sequential streams (OnDiskData) are
+        # fast-forwarded — earlier batches are fetched and DISCARDED so the
+        # underlying reader/shuffle state matches an uninterrupted epoch.
+        self._start = start_step
+        self._ff_pending = (start_step if getattr(data, "stateful_stream",
+                                                  False) else 0)
         self._served = 0
         self.stall_s = 0.0
         self._queue: Optional[queue.Queue] = None
@@ -138,9 +149,13 @@ class EpochStream:
 
     def _produce(self) -> None:
         try:
-            for step in range(self._steps):
+            if self._ff_pending:
+                self._fast_forward()
+            for step in range(self._start, self._steps):
                 if self._stop.is_set():
                     return
+                # fault hook: `prefetch-die` kills this producer thread here
+                faults.prefetch_producer(self._epoch, step)
                 item = self._fetch(step)
                 if not self._put((step, item)):
                     return
@@ -149,40 +164,83 @@ class EpochStream:
         except BaseException as e:  # delivered to the consumer, then re-raised there
             self._put((_ERROR, e))
 
+    def _fast_forward(self) -> None:
+        """Advance a sequential source past the resumed-over steps."""
+        tr = get_tracer()
+        t0 = time.perf_counter_ns()
+        for step in range(self._ff_pending):
+            if self._stop.is_set():
+                return
+            self._data.batch(self._epoch, step, train=self._train)
+        self._ff_pending = 0
+        if tr.enabled:
+            tr.complete("resume_fastforward", t0, time.perf_counter_ns(),
+                        {"epoch": self._epoch, "steps": self._start})
+
     # ---- consumer ----
 
     def __iter__(self) -> "EpochStream":
         return self
 
     def __next__(self) -> Fetched:
-        if self._served >= self._steps:
+        if self._start + self._served >= self._steps:
             self.close()
             raise StopIteration
         tr = get_tracer()
         if self._queue is None:  # synchronous (depth 0): inline fetch is the stall
             t0 = time.perf_counter_ns()
-            item = self._fetch(self._served)
+            if self._ff_pending:
+                self._fast_forward()
+            item = self._fetch(self._start + self._served)
             t1 = time.perf_counter_ns()
             self.stall_s += (t1 - t0) / 1e9
         else:
             t0 = time.perf_counter_ns()
-            step, item = self._queue.get()
+            step, item = self._get_or_fail()
             t1 = time.perf_counter_ns()
             self.stall_s += (t1 - t0) / 1e9
             if step == _ERROR:
                 self.close()
-                raise item
+                # TrainingFailure with the producer's exception CHAINED, so
+                # the consumer-side abort carries the original traceback
+                # (a dead producer must not surface only as a watchdog
+                # timeout or an anonymous hang)
+                raise TrainingFailure(
+                    f"prefetch producer failed in epoch {self._epoch}: "
+                    f"{item}") from item
         if tr.enabled:
             # the consumer-side blocking wait on the ring (or the inline
             # fetch in synchronous mode) — today's stall scalar, visible
             # as spans on the consuming thread's timeline
             tr.complete("ring_wait", t0, t1,
-                        {"epoch": self._epoch, "step": self._served,
+                        {"epoch": self._epoch,
+                         "step": self._start + self._served,
                          "train": self._train})
         self._served += 1
         if self._watchdog is not None:
             self._watchdog.kick()
         return item
+
+    def _get_or_fail(self):
+        """Ring get that notices a dead producer instead of blocking forever.
+
+        The producer delivers its own exceptions through the ring; this
+        covers the remaining gap — a producer that died WITHOUT managing a
+        delivery (e.g. killed hard, or the interpreter tore the thread
+        down) — by polling thread liveness while waiting."""
+        while True:
+            try:
+                return self._queue.get(timeout=0.2)
+            except queue.Empty:
+                t = self._thread
+                if t is not None and not t.is_alive():
+                    try:  # a final drain beats the race where the producer
+                        return self._queue.get_nowait()  # put then exited
+                    except queue.Empty:
+                        self.close()
+                        raise TrainingFailure(
+                            f"prefetch producer for epoch {self._epoch} "
+                            f"died without delivering a batch") from None
 
     @property
     def stall_ms(self) -> float:
@@ -247,14 +305,20 @@ class Prefetcher:
         self.watchdog = watchdog
 
     def stream(self, epoch: int, train: bool = True, keep_raw: bool = False,
-               heartbeat: Optional[bool] = None) -> EpochStream:
+               heartbeat: Optional[bool] = None,
+               start_step: int = 0) -> EpochStream:
         """``heartbeat`` defaults to eval-only (``not train``): an armed
         watchdog's train-path deadline stays per-step (driven by the loop's
         own float() syncs), while eval — which never syncs mid-epoch —
-        takes its liveness from the pipeline."""
+        takes its liveness from the pipeline. ``start_step`` serves only
+        steps [start_step, steps) — the mid-epoch resume entry point."""
         if heartbeat is None:
             heartbeat = not train
         steps = self.data.steps_per_epoch(train=train)
+        if not 0 <= start_step <= steps:
+            raise ValueError(
+                f"start_step {start_step} outside epoch of {steps} steps")
         return EpochStream(self.data, self.shard_fn, epoch, steps, train,
                           self.depth, watchdog=self.watchdog,
-                          keep_raw=keep_raw, heartbeat=heartbeat)
+                          keep_raw=keep_raw, heartbeat=heartbeat,
+                          start_step=start_step)

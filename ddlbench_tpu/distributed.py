@@ -89,24 +89,61 @@ def force_host_mesh_platform() -> None:
             pass  # backend already initialized; caller sees real devices
 
 
+def _initialize_with_retry(connect, what: str) -> None:
+    """Bounded retry with exponential backoff around one connect attempt.
+
+    A slow-starting peer (host still booting, coordinator not yet bound)
+    must not fail the whole multihost run on the first connect error — the
+    reference's SLURM launcher simply dies there. Tunables:
+    ``DDLB_INIT_ATTEMPTS`` (default 3) total attempts and
+    ``DDLB_INIT_BACKOFF_S`` (default 1.0) base delay, doubling per retry.
+    The final attempt's exception propagates to the caller.
+    """
+    import time
+
+    attempts = max(1, int(os.environ.get("DDLB_INIT_ATTEMPTS", "3")))
+    base = float(os.environ.get("DDLB_INIT_BACKOFF_S", "1.0"))
+    for attempt in range(1, attempts + 1):
+        try:
+            connect()
+            return
+        except Exception as e:
+            if attempt == attempts:
+                raise
+            delay = base * 2 ** (attempt - 1)
+            print(f"{what} attempt {attempt}/{attempts} failed ({e}); "
+                  f"retrying in {delay:.1f}s", flush=True)
+            time.sleep(delay)
+
+
 def initialize() -> bool:
     """Join the jax.distributed world if configured; returns True if multi-host."""
     global _initialized
     if _initialized:
         return jax.process_count() > 1
+    # fault hook: `slow-host` injects a delay here, modeling a peer that is
+    # slow to reach the coordinator (ddlbench_tpu/faults/)
+    from ddlbench_tpu import faults
+
+    faults.multihost_init()
     coord = os.environ.get("DDLB_COORDINATOR")
     nproc = os.environ.get("DDLB_NUM_PROCESSES")
     pid = os.environ.get("DDLB_PROCESS_ID")
     try:
         if coord and nproc and pid:
-            jax.distributed.initialize(
-                coordinator_address=coord,
-                num_processes=int(nproc),
-                process_id=int(pid),
+            _initialize_with_retry(
+                lambda: jax.distributed.initialize(
+                    coordinator_address=coord,
+                    num_processes=int(nproc),
+                    process_id=int(pid),
+                ),
+                f"jax.distributed.initialize({coord})",
             )
             _initialized = True
         elif os.environ.get("DDLB_AUTO_DISTRIBUTED") == "1":
-            jax.distributed.initialize()  # TPU metadata auto-detection
+            # TPU metadata auto-detection
+            _initialize_with_retry(lambda: jax.distributed.initialize(),
+                                   "jax.distributed.initialize(auto)")
             _initialized = True
     except Exception as e:  # pragma: no cover - depends on environment
         print(f"jax.distributed.initialize failed: {e}", flush=True)

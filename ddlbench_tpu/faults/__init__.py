@@ -1,0 +1,38 @@
+"""Deterministic fault injection for robustness benchmarking.
+
+The reference suite has no failure story at all (SURVEY.md §5.3: a 2-hour
+process-group timeout and a pkill cleanup script); nothing in it can *prove*
+that a kill mid-run recovers. This package is the injection half of the
+fault-tolerance subsystem: a registry of host-side faults armed from
+``--inject KIND@EPOCH:STEP`` specs (repeatable), fired from hooks in
+``train/loop.py`` (step boundaries, loss poisoning), ``train/checkpoint.py``
+(post-commit corruption), ``data/prefetch.py`` (producer death), and
+``distributed.py`` (multihost init delay). ``tools/chaosbench.py`` drives a
+kill/restart supervisor over these faults and measures recovery.
+
+Zero-cost contract: with the registry empty (the default), every hook is a
+single module-attribute truthiness check and an immediate return — no
+allocation, no parsing, no clock reads on the hot path.
+
+Determinism contract: faults address the same ``(epoch, step)`` coordinates
+the data pipeline uses, so an injected run is reproducible — the same spec
+always fires at the same point of the same trajectory. Each spec fires at
+most once per process.
+
+See :mod:`ddlbench_tpu.faults.registry` for the spec grammar and kinds.
+"""
+
+from ddlbench_tpu.faults.registry import (  # noqa: F401
+    FAULT_KINDS,
+    FaultSpec,
+    arm,
+    armed_specs,
+    checkpoint_saved,
+    corrupt_checkpoint,
+    disarm,
+    multihost_init,
+    parse_injections,
+    poison_loss,
+    prefetch_producer,
+    step_boundary,
+)

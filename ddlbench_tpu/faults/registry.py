@@ -1,0 +1,229 @@
+"""Fault spec grammar, the armed-spec registry, and the injection hooks.
+
+Spec grammar (the ``--inject`` flag, repeatable)::
+
+    KIND@EPOCH:STEP
+
+``EPOCH`` is the 1-based training epoch and ``STEP`` the 0-based step index
+within it — the same coordinates the (epoch, step)-addressed data pipeline
+uses, so injections are deterministic and reproducible. Kinds:
+
+* ``kill``           — SIGKILL this process at the step boundary *before*
+                       dispatching (EPOCH, STEP). The hard-crash primitive:
+                       no atexit handlers, no flushes, no cleanup — exactly
+                       what the checkpoint commit protocol must survive.
+* ``ckpt-corrupt``   — after the checkpoint save for (EPOCH, STEP) commits,
+                       truncate + bit-flip bytes in that (newest) checkpoint.
+                       Epoch-granular saves fire with STEP 0. Models silent
+                       media corruption; ``latest_valid`` must detect it and
+                       fall back.
+* ``prefetch-die``   — raise inside the prefetch producer thread before it
+                       fetches (EPOCH, STEP). Exercises the producer-death
+                       propagation path (data/prefetch.py).
+* ``nan-loss``       — poison the host-side loss of (EPOCH, STEP) with NaN.
+                       Exercises the --nan-policy path without perturbing
+                       device state.
+* ``slow-host``      — sleep ``DDLB_FAULT_SLOWHOST_S`` (default 2.0) seconds
+                       inside ``distributed.initialize()``, modeling a
+                       slow-starting peer. EPOCH:STEP are parsed but unused
+                       (the init path predates the step clock); use 0:0.
+
+Each armed spec fires at most once per process. The registry is module
+state: ``arm()`` installs specs (idempotent re-arm with the same specs is a
+no-op), ``disarm()`` clears them. With nothing armed every hook returns
+after one falsy check — the hot loop pays nothing.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+import signal
+import sys
+import time
+from typing import List, Optional, Sequence, Tuple
+
+FAULT_KINDS = ("kill", "ckpt-corrupt", "prefetch-die", "nan-loss", "slow-host")
+
+# Armed specs; empty = disarmed. Every hook checks this first.
+_SPECS: List["FaultSpec"] = []
+
+
+@dataclasses.dataclass
+class FaultSpec:
+    kind: str
+    epoch: int
+    step: int
+    fired: bool = False
+
+    def matches(self, epoch: int, step: int) -> bool:
+        return (not self.fired and self.epoch == epoch and self.step == step)
+
+    def __str__(self) -> str:
+        return f"{self.kind}@{self.epoch}:{self.step}"
+
+
+def parse_injections(specs: Sequence[str]) -> Tuple[FaultSpec, ...]:
+    """Parse ``KIND@EPOCH:STEP`` specs; raises ValueError on bad grammar."""
+    out = []
+    for raw in specs:
+        try:
+            kind, at = raw.split("@", 1)
+            ep_s, st_s = at.split(":", 1)
+            epoch, step = int(ep_s), int(st_s)
+        except ValueError:
+            raise ValueError(
+                f"bad --inject spec {raw!r}: expected KIND@EPOCH:STEP "
+                f"(e.g. kill@2:5)")
+        if kind not in FAULT_KINDS:
+            raise ValueError(
+                f"unknown fault kind {kind!r} in --inject {raw!r} "
+                f"(choose from {', '.join(FAULT_KINDS)})")
+        if epoch < 0 or step < 0:
+            raise ValueError(
+                f"--inject {raw!r}: EPOCH and STEP must be >= 0")
+        out.append(FaultSpec(kind, epoch, step))
+    return tuple(out)
+
+
+def arm(specs: Sequence[str]) -> None:
+    """Install (parsed) fault specs, replacing whatever was armed before.
+
+    Already-fired state is preserved across a re-arm with identical specs
+    (run_benchmark re-arms what the CLI armed earlier in the same process;
+    a fault must still fire only once).
+    """
+    parsed = parse_injections(specs)
+    if [(s.kind, s.epoch, s.step) for s in parsed] == \
+            [(s.kind, s.epoch, s.step) for s in _SPECS]:
+        return
+    _SPECS[:] = list(parsed)
+
+
+def disarm() -> None:
+    _SPECS.clear()
+
+
+def armed_specs() -> Tuple[FaultSpec, ...]:
+    return tuple(_SPECS)
+
+
+def _take(kind: str, epoch: int, step: int) -> Optional[FaultSpec]:
+    for s in _SPECS:
+        if s.kind == kind and s.matches(epoch, step):
+            s.fired = True
+            return s
+    return None
+
+
+# ---- hooks (call sites: train/loop.py, train/checkpoint.py,
+# ---- data/prefetch.py, distributed.py) ------------------------------------
+
+def step_boundary(epoch: int, step: int) -> None:
+    """Train-loop hook, called before dispatching (epoch, step).
+
+    ``kill``: announce (flushed — the supervisor's MTTR clock reads it),
+    then SIGKILL. SIGKILL and not sys.exit: the whole point is that no
+    Python-level cleanup runs, so the commit protocol is what is tested.
+    """
+    if not _SPECS:
+        return
+    if _take("kill", epoch, step):
+        print(f"fault-inject: kill at epoch {epoch} step {step}", flush=True)
+        sys.stdout.flush()
+        sys.stderr.flush()
+        os.kill(os.getpid(), signal.SIGKILL)
+
+
+def poison_loss(epoch: int, step: int) -> bool:
+    """True when (epoch, step)'s host-side loss should be replaced with NaN."""
+    if not _SPECS:
+        return False
+    spec = _take("nan-loss", epoch, step)
+    if spec:
+        print(f"fault-inject: nan-loss at epoch {epoch} step {step}",
+              flush=True)
+        return True
+    return False
+
+
+def prefetch_producer(epoch: int, step: int) -> None:
+    """Producer-thread hook (data/prefetch.py), before fetching (epoch, step)."""
+    if not _SPECS:
+        return
+    if _take("prefetch-die", epoch, step):
+        raise RuntimeError(
+            f"fault-inject: prefetch producer killed at epoch {epoch} "
+            f"step {step}")
+
+
+def checkpoint_saved(path: str, epoch: int, step: Optional[int]) -> None:
+    """Post-commit hook (train/checkpoint.py). Epoch-granular saves match
+    STEP 0 specs (they carry no interior step)."""
+    if not _SPECS:
+        return
+    if _take("ckpt-corrupt", epoch, step if step is not None else 0):
+        corrupt_checkpoint(path)
+
+
+def multihost_init() -> None:
+    """distributed.initialize() hook: the slow-host delay."""
+    if not _SPECS:
+        return
+    if _take("slow-host", *_first_pending("slow-host")):
+        delay = float(os.environ.get("DDLB_FAULT_SLOWHOST_S", "2.0"))
+        print(f"fault-inject: slow-host sleeping {delay:.1f}s in multihost "
+              f"init", flush=True)
+        time.sleep(delay)
+
+
+def _first_pending(kind: str) -> Tuple[int, int]:
+    """(epoch, step) of the first unfired spec of ``kind`` — used by hooks
+    at sites that predate the step clock (multihost init), so their specs
+    fire regardless of the coordinates they were written with."""
+    for s in _SPECS:
+        if s.kind == kind and not s.fired:
+            return s.epoch, s.step
+    return -1, -1
+
+
+def corrupt_checkpoint(path: str) -> List[str]:
+    """Truncate + bit-flip bytes in a checkpoint directory (or file).
+
+    Damages the largest NON-MARKER file under ``path`` (the array data — a
+    damaged COMMIT marker is the trivially-detected case; the manifest
+    verification must catch damage to the payload): flips one byte in the
+    middle and truncates the tail — both silent-media-corruption shapes
+    ``latest_valid`` must catch. Returns the damaged file paths.
+    """
+    targets = []
+    if os.path.isfile(path):
+        targets = [path]
+    else:
+        best, best_size = None, -1
+        for root, _, files in os.walk(path):
+            for name in files:
+                if name == "COMMIT.json":
+                    continue
+                p = os.path.join(root, name)
+                try:
+                    size = os.path.getsize(p)
+                except OSError:
+                    continue
+                if size > best_size:
+                    best, best_size = p, size
+        if best is not None:
+            targets = [best]
+    damaged = []
+    for p in targets:
+        size = os.path.getsize(p)
+        with open(p, "r+b") as f:
+            if size > 0:
+                f.seek(size // 2)
+                b = f.read(1)
+                f.seek(size // 2)
+                f.write(bytes([b[0] ^ 0xFF]) if b else b"\xff")
+            f.truncate(max(1, size - max(1, size // 4)))
+        damaged.append(p)
+        print(f"fault-inject: ckpt-corrupt damaged {p}", flush=True)
+    return damaged

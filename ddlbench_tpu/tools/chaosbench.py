@@ -1,0 +1,387 @@
+#!/usr/bin/env python
+"""Chaos benchmark: kill/restart supervision + recovery measurement.
+
+The reference suite cannot answer "what happens when a worker dies?" — its
+only failure handling is a 2-hour process-group timeout and a pkill script
+(SURVEY.md §5.3). This tool makes recovery a *benchmark dimension*: it runs
+the train CLI as a child process under a supervisor that
+
+1. schedules ``--kills N`` deterministic SIGKILL injections (``--inject
+   kill@E:S``, one per attempt, spread evenly over the run's global steps),
+2. relaunches the child with ``--resume`` after every death, with
+   exponential backoff and a bounded restart budget (a crash-looping run
+   must not spin forever),
+3. verifies the interrupted trajectory against an uninterrupted baseline
+   run **bit-for-bit** (per-step train losses via ``--log-interval 1``
+   JSONL records and per-epoch validation loss/accuracy — synthetic data is
+   (epoch, step)-addressed, so any divergence means state was lost), and
+4. emits a bench.py-style JSON line: recoveries, MTTR (child death -> the
+   resumed child's "resumed from" line), steps lost per kill, and
+   checkpoint write overhead (the ``checkpoint_save``/``checkpoint_restore``
+   telemetry spans from each attempt's ``--trace`` file, as a fraction of
+   chaos-run wall time).
+
+Usage (CPU smoke)::
+
+    python -m ddlbench_tpu.tools.chaosbench --kills 2 --platform cpu \
+        -b mnist -m lenet --steps-per-epoch 6 -e 2 --batch-size 8 \
+        --checkpoint-every-steps 2 --json chaos.json
+
+Any flags after ``--`` are passed through to the train CLI verbatim.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+
+def _parse_args(argv=None):
+    p = argparse.ArgumentParser(
+        prog="chaosbench", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter)
+    p.add_argument("--kills", type=int, default=1,
+                   help="number of SIGKILL injections to schedule")
+    p.add_argument("--restart-budget", type=int, default=None,
+                   help="max child relaunches (default: kills + 3)")
+    p.add_argument("--backoff-base-s", type=float, default=0.5,
+                   help="restart backoff base (doubles per consecutive "
+                        "restart, capped by --backoff-max-s)")
+    p.add_argument("--backoff-max-s", type=float, default=8.0)
+    p.add_argument("-b", "--benchmark", default="mnist")
+    p.add_argument("-m", "--model", default="lenet")
+    p.add_argument("-f", "--framework", default="single")
+    p.add_argument("-g", "--devices", type=int, default=1)
+    p.add_argument("-e", "--epochs", type=int, default=2)
+    p.add_argument("--steps-per-epoch", type=int, default=6,
+                   help="fixed steps/epoch (required: the kill schedule and "
+                        "steps-lost accounting are computed from it)")
+    p.add_argument("--batch-size", type=int, default=8)
+    p.add_argument("--log-interval", type=int, default=1,
+                   help="1 = per-step loss records (the bitwise trajectory "
+                        "check compares every overlapping step)")
+    p.add_argument("--dtype", default="float32",
+                   help="float32 default: the bitwise check is the point")
+    p.add_argument("--seed", type=int, default=1)
+    p.add_argument("--checkpoint-every-steps", type=int, default=2)
+    p.add_argument("--keep-checkpoints", type=int, default=None)
+    p.add_argument("--platform", default=None,
+                   help="forwarded to the train CLI (e.g. cpu)")
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir for checkpoints/logs (default: a "
+                        "fresh chaosbench_runs/<pid> dir, removed unless "
+                        "--keep-workdir)")
+    p.add_argument("--keep-workdir", action="store_true")
+    p.add_argument("--json", default=None, help="also write the report here")
+    p.add_argument("--skip-verify", action="store_true",
+                   help="skip the uninterrupted baseline run (no bitwise "
+                        "trajectory check, no overhead denominator A/B)")
+    p.add_argument("train_args", nargs="*", default=[],
+                   help="extra flags after -- forwarded to the train CLI")
+    return p.parse_args(argv)
+
+
+def kill_schedule(kills: int, epochs: int, steps_per_epoch: int
+                  ) -> List[Tuple[int, int]]:
+    """Evenly spaced (epoch, step) kill points over the run's global steps.
+
+    Deterministic by construction (no RNG): chaos runs are reproducible
+    benchmark configurations, not fuzzing.
+    """
+    total = epochs * steps_per_epoch
+    points = []
+    for k in range(1, kills + 1):
+        g = max(1, min(total - 1, round(k * total / (kills + 1))))
+        points.append((g // steps_per_epoch + 1, g % steps_per_epoch))
+    # collapse duplicates from tiny runs while preserving order
+    seen, out = set(), []
+    for pt in points:
+        if pt not in seen:
+            seen.add(pt)
+            out.append(pt)
+    return out
+
+
+def _global_step(epoch: int, step: int, steps_per_epoch: int) -> int:
+    return (epoch - 1) * steps_per_epoch + step
+
+
+def _train_argv(args, ckpt_dir: Optional[str], jsonl: str,
+                trace: Optional[str], inject: List[str],
+                resume: bool) -> List[str]:
+    argv = [sys.executable, "-m", "ddlbench_tpu.cli",
+            "-b", args.benchmark, "-m", args.model, "-f", args.framework,
+            "-g", str(args.devices), "-e", str(args.epochs),
+            "--steps-per-epoch", str(args.steps_per_epoch),
+            "--batch-size", str(args.batch_size),
+            "--log-interval", str(args.log_interval),
+            "--dtype", args.dtype, "--seed", str(args.seed),
+            "--jsonl", jsonl]
+    if args.platform:
+        argv += ["--platform", args.platform]
+    if ckpt_dir:
+        argv += ["--checkpoint-dir", ckpt_dir,
+                 "--checkpoint-every-steps", str(args.checkpoint_every_steps)]
+        if args.keep_checkpoints:
+            argv += ["--keep-checkpoints", str(args.keep_checkpoints)]
+    if resume:
+        argv += ["--resume"]
+    if trace:
+        argv += ["--trace", trace]
+    for spec in inject:
+        argv += ["--inject", spec]
+    argv += list(args.train_args)
+    return argv
+
+
+class AttemptResult:
+    def __init__(self):
+        self.rc: Optional[int] = None
+        self.wall_s = 0.0
+        self.resumed_line: Optional[str] = None
+        self.resumed_at: Optional[float] = None  # monotonic
+        self.died_at: Optional[float] = None
+        self.lines: List[str] = []
+
+
+def _run_attempt(argv: List[str], log_path: str) -> AttemptResult:
+    """Launch one child; stream stdout (timestamping the recovery line)."""
+    res = AttemptResult()
+    t0 = time.monotonic()
+    with open(log_path, "w") as log:
+        proc = subprocess.Popen(argv, stdout=subprocess.PIPE,
+                                stderr=subprocess.STDOUT, text=True)
+        assert proc.stdout is not None
+        for line in proc.stdout:
+            log.write(line)
+            res.lines.append(line.rstrip("\n"))
+            if line.startswith("resumed from") and res.resumed_at is None:
+                res.resumed_at = time.monotonic()
+                res.resumed_line = line.strip()
+        res.rc = proc.wait()
+    res.died_at = time.monotonic()
+    res.wall_s = res.died_at - t0
+    return res
+
+
+def _parse_resumed_global(line: Optional[str], steps_per_epoch: int
+                          ) -> Optional[int]:
+    """'resumed from <dir> epoch E[ step S (mid-epoch)]' -> resumed global step."""
+    if not line:
+        return None
+    toks = line.split()
+    try:
+        ep = int(toks[toks.index("epoch") + 1])
+        if "step" in toks:
+            return _global_step(ep, int(toks[toks.index("step") + 1]) + 1,
+                                steps_per_epoch)
+        return ep * steps_per_epoch
+    except (ValueError, IndexError):
+        return None
+
+
+def _span_seconds(trace_path: str, names: Tuple[str, ...]) -> Dict[str, float]:
+    """Total duration (s) of the named complete-spans in a Chrome trace."""
+    totals = {n: 0.0 for n in names}
+    try:
+        with open(trace_path) as f:
+            events = json.load(f).get("traceEvents", [])
+    except (OSError, ValueError):
+        return totals
+    for ev in events:
+        if ev.get("ph") == "X" and ev.get("name") in totals:
+            totals[ev["name"]] += ev.get("dur", 0) / 1e6
+    return totals
+
+
+def _jsonl_trajectory(path: str) -> Tuple[Dict, Dict]:
+    """(train, valid) maps from a metrics JSONL; last write wins, so a
+    chaos run's re-executed steps are compared at their FINAL values."""
+    train: Dict[Tuple[int, float], float] = {}
+    valid: Dict[int, Tuple[float, float]] = {}
+    try:
+        with open(path) as f:
+            for raw in f:
+                try:
+                    rec = json.loads(raw)
+                except ValueError:
+                    continue
+                if rec.get("kind") == "train_interval":
+                    train[(rec["epoch"], rec["progress_pct"])] = rec["loss"]
+                elif rec.get("kind") == "valid":
+                    valid[rec["epoch"]] = (rec["loss"], rec["accuracy"])
+    except OSError:
+        pass
+    return train, valid
+
+
+def verify_trajectory(baseline_jsonl: str, chaos_jsonl: str
+                      ) -> Tuple[bool, List[str]]:
+    """Bit-for-bit comparison (exact float equality — no tolerance: the
+    commit protocol's claim is bitwise resume, not approximate resume)."""
+    b_train, b_valid = _jsonl_trajectory(baseline_jsonl)
+    c_train, c_valid = _jsonl_trajectory(chaos_jsonl)
+    mismatches = []
+    for key, loss in sorted(b_train.items()):
+        if key not in c_train:
+            mismatches.append(f"missing train record {key}")
+        elif c_train[key] != loss:
+            mismatches.append(
+                f"train loss @ {key}: {c_train[key]!r} != {loss!r}")
+    for ep, lv in sorted(b_valid.items()):
+        if ep not in c_valid:
+            mismatches.append(f"missing valid record epoch {ep}")
+        elif c_valid[ep] != lv:
+            mismatches.append(
+                f"valid @ epoch {ep}: {c_valid[ep]!r} != {lv!r}")
+    return not mismatches, mismatches
+
+
+def run_chaos(args) -> Dict[str, Any]:
+    workdir = args.workdir or os.path.join("chaosbench_runs", str(os.getpid()))
+    os.makedirs(workdir, exist_ok=True)
+    ckpt_dir = os.path.join(workdir, "ckpt")
+    schedule = kill_schedule(args.kills, args.epochs, args.steps_per_epoch)
+    budget = (args.restart_budget if args.restart_budget is not None
+              else args.kills + 3)
+
+    report: Dict[str, Any] = {
+        "metric": "chaosbench_recovery",
+        "benchmark": args.benchmark, "arch": args.model,
+        "framework": args.framework,
+        "epochs": args.epochs, "steps_per_epoch": args.steps_per_epoch,
+        "checkpoint_every_steps": args.checkpoint_every_steps,
+        "kills_scheduled": [f"kill@{e}:{s}" for e, s in schedule],
+        "restart_budget": budget,
+    }
+
+    # -- baseline: uninterrupted, checkpoint-free (overhead denominator +
+    # -- the bitwise trajectory reference) ---------------------------------
+    baseline_jsonl = os.path.join(workdir, "baseline.jsonl")
+    if not args.skip_verify:
+        print(f"chaosbench: baseline run (uninterrupted, no checkpoints)",
+              flush=True)
+        base = _run_attempt(
+            _train_argv(args, None, baseline_jsonl, None, [], resume=False),
+            os.path.join(workdir, "baseline.log"))
+        if base.rc != 0:
+            report["error"] = f"baseline run failed (rc={base.rc})"
+            print(json.dumps(report), flush=True)
+            return report
+        report["baseline_wall_s"] = round(base.wall_s, 3)
+
+    # -- chaos run: supervised kill/restart loop ---------------------------
+    chaos_jsonl = os.path.join(workdir, "chaos.jsonl")
+    pending = list(schedule)
+    attempts: List[AttemptResult] = []
+    mttr_s: List[float] = []
+    steps_lost: List[int] = []
+    recoveries = restarts = 0
+    consecutive_failures = 0
+    save_s = restore_s = 0.0
+    last_death: Optional[float] = None
+    killed_at: Optional[Tuple[int, int]] = None
+    completed = False
+
+    while True:
+        attempt_no = len(attempts)
+        inject = [f"kill@{e}:{s}" for e, s in pending[:1]]
+        trace = os.path.join(workdir, f"attempt_{attempt_no}.trace.json")
+        argv = _train_argv(args, ckpt_dir, chaos_jsonl, trace, inject,
+                           resume=True)
+        print(f"chaosbench: attempt {attempt_no}"
+              + (f" (pending {inject[0]})" if inject else " (no more kills)"),
+              flush=True)
+        res = _run_attempt(argv,
+                           os.path.join(workdir, f"attempt_{attempt_no}.log"))
+        attempts.append(res)
+        spans = _span_seconds(trace, ("checkpoint_save",
+                                      "checkpoint_restore"))
+        save_s += spans["checkpoint_save"]
+        restore_s += spans["checkpoint_restore"]
+
+        if res.resumed_at is not None and last_death is not None:
+            mttr_s.append(res.resumed_at - last_death)
+            recoveries += 1
+            resumed_g = _parse_resumed_global(res.resumed_line,
+                                              args.steps_per_epoch)
+            if resumed_g is not None and killed_at is not None and \
+                    steps_lost and steps_lost[-1] is None:
+                steps_lost[-1] = _global_step(*killed_at,
+                                              args.steps_per_epoch) - resumed_g
+            last_death = None
+
+        if res.rc == 0:
+            completed = True
+            break
+        if res.rc == -signal.SIGKILL and pending and \
+                any(l.startswith("fault-inject: kill") for l in res.lines):
+            killed_at = pending.pop(0)
+            steps_lost.append(None)  # filled in by the next resume line
+            last_death = res.died_at
+            consecutive_failures = 0
+        else:
+            consecutive_failures += 1
+            print(f"chaosbench: unexpected child exit rc={res.rc}",
+                  flush=True)
+        restarts += 1
+        if restarts > budget:
+            report["error"] = (f"restart budget ({budget}) exhausted after "
+                               f"{len(attempts)} attempts")
+            break
+        delay = min(args.backoff_max_s,
+                    args.backoff_base_s * 2 ** consecutive_failures)
+        print(f"chaosbench: restarting in {delay:.2f}s", flush=True)
+        time.sleep(delay)
+
+    chaos_wall = sum(a.wall_s for a in attempts)
+    report.update({
+        "completed": completed,
+        "attempts": len(attempts),
+        "restarts": restarts,
+        # len(schedule), not args.kills: tiny runs collapse duplicate kill
+        # points, and the report must agree with mttr_s/steps_lost lengths
+        "kills": len(schedule) - len(pending),
+        "recoveries": recoveries,
+        "mttr_s": [round(t, 3) for t in mttr_s],
+        "mttr_s_mean": round(sum(mttr_s) / len(mttr_s), 3) if mttr_s else None,
+        "steps_lost_per_kill": steps_lost,
+        "chaos_wall_s": round(chaos_wall, 3),
+        "checkpoint_save_s": round(save_s, 3),
+        "checkpoint_restore_s": round(restore_s, 3),
+        "checkpoint_overhead_pct": (
+            round(100.0 * save_s / chaos_wall, 2) if chaos_wall else None),
+    })
+
+    if not args.skip_verify and completed:
+        match, mismatches = verify_trajectory(baseline_jsonl, chaos_jsonl)
+        report["trajectory_match"] = match
+        if not match:
+            report["trajectory_mismatches"] = mismatches[:20]
+
+    print(json.dumps(report), flush=True)
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(report, f, indent=2)
+    if not args.keep_workdir and args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return report
+
+
+def main(argv=None) -> int:
+    args = _parse_args(argv)
+    report = run_chaos(args)
+    ok = report.get("completed") and "error" not in report and \
+        report.get("trajectory_match", True)
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

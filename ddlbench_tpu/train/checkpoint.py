@@ -1,23 +1,63 @@
-"""Checkpoint/resume for sharded train states.
+"""Crash-consistent checkpoint/resume for sharded train states.
 
 Reference behavior (the only checkpointing in DDLBench lives in the PipeDream
 runtime): per-stage files ``checkpoint.{stage}.pth.tar`` holding
 epoch/arch/state_dict/optimizer, written by rank 0 of each stage per epoch and
-restored before resuming (main_with_runtime.py:393-403,580-584,:241-262).
+restored before resuming (main_with_runtime.py:393-403,580-584,:241-262) —
+plain ``torch.save`` with no commit protocol: a crash mid-write leaves a
+truncated file that the restore happily loads or dies on.
 
 TPU-native equivalent: one orbax checkpoint of the whole (sharded) train-state
-pytree per epoch. The pipeline strategies' packed ``[S, L]`` stage matrices are
-sharded over the 'stage' mesh axis, so orbax's OCDBT layout naturally writes
-per-stage shards — the same on-disk decomposition as the reference's per-stage
-files, without per-rank coordination code.
+pytree, wrapped in an explicit **atomic commit protocol**:
+
+1. orbax writes the state under ``<name>.tmp/state``;
+2. ``resume.json`` (epoch, interior step, global step, metric-logger
+   counters, seed) is written next to it;
+3. a ``COMMIT.json`` marker — carrying a manifest of every file's size and
+   SHA-256 — is written + fsynced *last*;
+4. the ``.tmp`` directory is atomically renamed to its final name and the
+   parent directory fsynced.
+
+A crash at any point leaves either a ``.tmp`` directory without a marker
+(ignored and GC'd) or a fully committed checkpoint. ``latest_valid`` walks
+checkpoints newest-first, verifies each against its manifest (catching
+truncation AND bit flips, e.g. the ``ckpt-corrupt`` fault), logs what it
+skips, and falls back to the previous good one. ``--keep-checkpoints N``
+bounds retention.
+
+Checkpoints come in two granularities: per-epoch (``epoch_N``, resume
+restarts at epoch N+1 — the historical behavior) and per-step
+(``epoch_N_step_S``, written every ``--checkpoint-every-steps K`` steps).
+Step checkpoints carry the *full* resume state — the interior data-iterator
+position is just the step index (every data source is (epoch, step)
+addressed, and the per-epoch RNG streams are pure fold-ins of
+``(seed, epoch, step)``), so a mid-epoch resume replays the identical
+trajectory bit-for-bit (pinned by tests/test_faults.py).
+
+The pipeline strategies' packed ``[S, L]`` stage matrices are sharded over
+the 'stage' mesh axis, so orbax's OCDBT layout naturally writes per-stage
+shards — the same on-disk decomposition as the reference's per-stage files,
+without per-rank coordination code.
 """
 
 from __future__ import annotations
 
+import dataclasses
+import hashlib
+import json
 import os
-from typing import Any, Optional, Tuple
+import re
+import shutil
+from typing import Any, Dict, List, Optional, Tuple
 
 import jax
+
+from ddlbench_tpu import faults
+
+COMMIT_MARKER = "COMMIT.json"
+RESUME_META = "resume.json"
+_STATE_SUBDIR = "state"
+_NAME_RE = re.compile(r"^epoch_(\d+)(?:_step_(\d+))?$")
 
 
 def _checkpointer():
@@ -26,43 +66,292 @@ def _checkpointer():
     return ocp.StandardCheckpointer()
 
 
-def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any) -> str:
-    """Write train_state under <ckpt_dir>/epoch_<n>; returns the path."""
-    path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
+def checkpoint_name(epoch: int, step: Optional[int] = None) -> str:
+    return f"epoch_{epoch}" if step is None else f"epoch_{epoch}_step_{step}"
+
+
+def _parse_name(name: str) -> Optional[Tuple[int, Optional[int]]]:
+    m = _NAME_RE.match(name)
+    if not m:
+        return None
+    return int(m.group(1)), (int(m.group(2)) if m.group(2) else None)
+
+
+def _order_key(epoch: int, step: Optional[int]) -> Tuple[int, float]:
+    # within an epoch, the epoch-end checkpoint outranks any interior step
+    return (epoch, float("inf") if step is None else float(step))
+
+
+def _fsync_path(path: str) -> None:
+    try:
+        fd = os.open(path, os.O_RDONLY)
+    except OSError:
+        return
+    try:
+        os.fsync(fd)
+    finally:
+        os.close(fd)
+
+
+def _sha256(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
+
+def _manifest(root: str, skip: Tuple[str, ...] = (COMMIT_MARKER,)) -> Dict[str, Dict[str, Any]]:
+    out: Dict[str, Dict[str, Any]] = {}
+    for dirpath, _, files in os.walk(root):
+        for name in files:
+            p = os.path.join(dirpath, name)
+            rel = os.path.relpath(p, root)
+            if rel in skip:
+                continue
+            # fsync every payload file while building the manifest: the
+            # COMMIT marker's durability claim (marker present => every
+            # other byte durable) needs the orbax-written data flushed too,
+            # not just our own metadata files — a directory fsync does not
+            # flush file CONTENTS
+            _fsync_path(p)
+            out[rel] = {"size": os.path.getsize(p), "sha256": _sha256(p)}
+    return out
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckpointInfo:
+    """One committed checkpoint: coordinates + on-disk path + resume meta."""
+
+    epoch: int
+    step: Optional[int]  # interior step index of the LAST COMPLETED step
+    path: str
+    meta: Dict[str, Any]
+
+    @property
+    def mid_epoch(self) -> bool:
+        return self.step is not None
+
+
+def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
+                    step: Optional[int] = None,
+                    global_step: Optional[int] = None,
+                    logger_state: Optional[Dict[str, Any]] = None,
+                    seed: Optional[int] = None,
+                    keep: Optional[int] = None) -> str:
+    """Atomically commit ``train_state`` under ``<ckpt_dir>/<name>``.
+
+    ``step`` (interior, 0-based index of the last completed step) selects the
+    step-granular name; None is the per-epoch checkpoint. Returns the
+    committed path. ``keep`` applies the retention policy after the commit
+    (see :func:`gc_checkpoints`).
+    """
+    ckpt_dir = os.path.abspath(ckpt_dir)
+    os.makedirs(ckpt_dir, exist_ok=True)
+    name = checkpoint_name(epoch, step)
+    final = os.path.join(ckpt_dir, name)
+    tmp = final + ".tmp"
+    if os.path.isdir(tmp):  # stale tmp from a crashed save: never trusted
+        shutil.rmtree(tmp)
+    if os.path.isdir(final):  # force-overwrite semantics (orbax parity)
+        shutil.rmtree(final)
+
     ckptr = _checkpointer()
-    ckptr.save(path, train_state, force=True)
+    ckptr.save(os.path.join(tmp, _STATE_SUBDIR), train_state, force=True)
     ckptr.wait_until_finished()
-    return path
+
+    meta = {
+        "epoch": epoch,
+        "step": step,
+        "global_step": global_step,
+        "seed": seed,
+        "logger": logger_state,
+    }
+    meta_path = os.path.join(tmp, RESUME_META)
+    with open(meta_path, "w") as f:
+        json.dump(meta, f)
+        f.flush()
+        os.fsync(f.fileno())
+
+    # COMMIT marker last: its presence asserts every other byte is durable
+    # and its manifest (size + sha256 per file) is what latest_valid verifies
+    marker = {"epoch": epoch, "step": step, "files": _manifest(tmp)}
+    marker_path = os.path.join(tmp, COMMIT_MARKER)
+    with open(marker_path, "w") as f:
+        json.dump(marker, f)
+        f.flush()
+        os.fsync(f.fileno())
+    _fsync_path(tmp)
+    os.rename(tmp, final)
+    _fsync_path(ckpt_dir)
+    # fault hook: ckpt-corrupt damages the just-committed checkpoint
+    faults.checkpoint_saved(final, epoch, step)
+    if keep is not None:
+        gc_checkpoints(ckpt_dir, keep)
+    return final
+
+
+def list_checkpoints(ckpt_dir: str) -> List[Tuple[int, Optional[int], str]]:
+    """All checkpoint-named entries (committed or not), oldest first."""
+    if not os.path.isdir(ckpt_dir):
+        return []
+    found = []
+    for name in os.listdir(ckpt_dir):
+        parsed = _parse_name(name)
+        if parsed is not None:
+            found.append((*parsed, os.path.join(ckpt_dir, name)))
+    found.sort(key=lambda t: _order_key(t[0], t[1]))
+    return found
+
+
+def is_legacy_checkpoint(path: str) -> bool:
+    """True for a pre-commit-protocol checkpoint: no COMMIT marker AND the
+    legacy on-disk layout (orbax files directly under ``epoch_N``, no
+    ``state`` subdir). Under the new protocol a marker-less FINAL-named
+    directory cannot be a crash remnant — saves build under ``.tmp`` and
+    publish by atomic rename only after the marker — so this shape can only
+    be a checkpoint written before the protocol existed. It is restorable
+    (``_restore_path`` handles the layout) but unverifiable."""
+    return (os.path.isdir(path)
+            and not os.path.exists(os.path.join(path, COMMIT_MARKER))
+            and not os.path.isdir(os.path.join(path, _STATE_SUBDIR))
+            and bool(os.listdir(path)))
+
+
+def verify_checkpoint(path: str) -> Optional[str]:
+    """None if ``path`` is a committed, manifest-clean checkpoint; else the
+    human-readable reason it is invalid."""
+    marker_path = os.path.join(path, COMMIT_MARKER)
+    if not os.path.exists(marker_path):
+        return "no COMMIT marker (crashed mid-save?)"
+    try:
+        with open(marker_path) as f:
+            marker = json.load(f)
+        files = marker["files"]
+    except (OSError, ValueError, KeyError) as e:
+        return f"unreadable COMMIT marker ({e})"
+    for rel, want in files.items():
+        p = os.path.join(path, rel)
+        if not os.path.exists(p):
+            return f"missing file {rel}"
+        size = os.path.getsize(p)
+        if size != want["size"]:
+            return f"size mismatch on {rel} ({size} != {want['size']})"
+        if _sha256(p) != want["sha256"]:
+            return f"checksum mismatch on {rel} (corrupt?)"
+    return None
+
+
+def latest_valid(ckpt_dir: str) -> Optional[CheckpointInfo]:
+    """Newest committed + verified checkpoint, falling back past invalid ones.
+
+    Walks newest-first; anything uncommitted (no marker — e.g. a crash
+    mid-save left only ``.tmp``, or a crash between orbax and the marker),
+    truncated, or bit-flipped is skipped WITH A LOG LINE, and the previous
+    good checkpoint wins. Returns None when nothing valid exists.
+    """
+    for epoch, step, path in reversed(list_checkpoints(ckpt_dir)):
+        if is_legacy_checkpoint(path):
+            # pre-protocol checkpoint: restorable but carries no manifest.
+            # Accepting it (with a log) beats silently restarting a user's
+            # run from scratch; anything torn in it fails loudly at restore.
+            print(f"checkpoint: {os.path.basename(path)} predates the "
+                  f"commit protocol (no manifest); restoring unverified",
+                  flush=True)
+            return CheckpointInfo(epoch, step, path,
+                                  {"epoch": epoch, "step": step})
+        reason = verify_checkpoint(path)
+        if reason is not None:
+            print(f"checkpoint: skipping {os.path.basename(path)}: {reason}",
+                  flush=True)
+            continue
+        meta: Dict[str, Any] = {}
+        try:
+            with open(os.path.join(path, RESUME_META)) as f:
+                meta = json.load(f)
+        except (OSError, ValueError):
+            meta = {"epoch": epoch, "step": step}
+        return CheckpointInfo(epoch, step, path, meta)
+    return None
+
+
+def gc_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
+    """Retention policy: keep the newest ``keep`` restorable checkpoints
+    (committed ones AND pre-protocol legacy ones — legacy dirs are real
+    user data, never remnants), delete everything older, plus stale
+    ``.tmp`` directories and marker-less NEW-layout directories (those are
+    unreachable states under the protocol: tampered or hand-copied, never
+    restorable). Restorability here is a marker/layout check, not a full
+    manifest verification — GC runs after every save and must not re-hash
+    the whole retention window. Returns deleted paths."""
+    if keep < 1:
+        raise ValueError("keep-checkpoints must be >= 1")
+    deleted = []
+    if not os.path.isdir(ckpt_dir):
+        return deleted
+    for name in os.listdir(ckpt_dir):
+        if name.endswith(".tmp") and _parse_name(name[:-4]) is not None:
+            shutil.rmtree(os.path.join(ckpt_dir, name), ignore_errors=True)
+            deleted.append(os.path.join(ckpt_dir, name))
+
+    def _restorable(p: str) -> bool:
+        return (os.path.exists(os.path.join(p, COMMIT_MARKER))
+                or is_legacy_checkpoint(p))
+
+    entries = list_checkpoints(ckpt_dir)
+    keepers = [t for t in entries if _restorable(t[2])]
+    drop = keepers[:-keep] if len(keepers) > keep else []
+    remnants = [t for t in entries if not _restorable(t[2])]
+    for _, _, path in drop + remnants:
+        shutil.rmtree(path, ignore_errors=True)
+        deleted.append(path)
+        print(f"checkpoint: retention dropped {os.path.basename(path)}",
+              flush=True)
+    return deleted
 
 
 def latest_epoch(ckpt_dir: str) -> Optional[int]:
-    if not os.path.isdir(ckpt_dir):
-        return None
-    epochs = []
-    for name in os.listdir(ckpt_dir):
-        if name.startswith("epoch_"):
-            try:
-                epochs.append(int(name.split("_", 1)[1]))
-            except ValueError:
-                continue
+    """Newest epoch number present by NAME (committed or not) — the legacy
+    existence probe. Resume paths should use :func:`latest_valid`."""
+    epochs = [e for e, s, _ in list_checkpoints(ckpt_dir) if s is None]
     return max(epochs) if epochs else None
 
 
-def restore_checkpoint(ckpt_dir: str, target: Any,
-                       epoch: Optional[int] = None) -> Tuple[int, Any]:
-    """Restore the given (or latest) epoch into target's structure/shardings.
-
-    ``target`` is a live train state (e.g. freshly init'd) supplying pytree
-    structure, dtypes, and shardings. Returns (epoch, restored_state).
-    """
-    epoch = epoch if epoch is not None else latest_epoch(ckpt_dir)
-    if epoch is None:
-        raise FileNotFoundError(f"no checkpoints under {ckpt_dir!r}")
-    path = os.path.join(os.path.abspath(ckpt_dir), f"epoch_{epoch}")
-    abstract = jax.tree.map(
+def _abstract_like(target: Any):
+    return jax.tree.map(
         lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype, sharding=x.sharding)
         if isinstance(x, jax.Array) else x,
         target,
     )
-    restored = _checkpointer().restore(path, abstract)
-    return epoch, restored
+
+
+def _restore_path(path: str, target: Any) -> Any:
+    state_path = os.path.join(path, _STATE_SUBDIR)
+    if not os.path.isdir(state_path):
+        state_path = path  # legacy layout: orbax state directly at <name>/
+    return _checkpointer().restore(state_path, _abstract_like(target))
+
+
+def restore_checkpoint(ckpt_dir: str, target: Any,
+                       epoch: Optional[int] = None) -> Tuple[int, Any]:
+    """Restore the given (or latest valid) EPOCH checkpoint into target's
+    structure/shardings.
+
+    ``target`` is a live train state (e.g. freshly init'd) supplying pytree
+    structure, dtypes, and shardings. Returns (epoch, restored_state).
+    """
+    if epoch is None:
+        info = latest_valid(ckpt_dir)
+        if info is None:
+            raise FileNotFoundError(
+                f"no valid checkpoints under {ckpt_dir!r}")
+        return info.epoch, _restore_path(info.path, target)
+    path = os.path.join(os.path.abspath(ckpt_dir), checkpoint_name(epoch))
+    if not os.path.isdir(path):
+        raise FileNotFoundError(f"no checkpoint {path!r}")
+    return epoch, _restore_path(path, target)
+
+
+def restore_info(info: CheckpointInfo, target: Any) -> Any:
+    """Restore the state of an already-validated :class:`CheckpointInfo`."""
+    return _restore_path(info.path, target)

@@ -16,6 +16,7 @@ from typing import Any, Dict, Optional
 import jax
 import jax.numpy as jnp
 
+from ddlbench_tpu import faults
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.data.prefetch import Prefetcher
 from ddlbench_tpu.data.synthetic import make_synthetic
@@ -120,10 +121,15 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
     # (tens of seconds); with warmup_steps=0 the first step's compile counts.
     wd = HangWatchdog(cfg.hang_timeout_s) if cfg.hang_timeout_s else None
     xla_window = _XlaWindow(cfg)
+    # Deterministic fault injection (ddlbench_tpu/faults/): armed for the
+    # run, disarmed in the finally. With cfg.inject empty this arms nothing
+    # and every hook below is a single falsy check.
+    faults.arm(cfg.inject)
     try:
         return _run_benchmark(cfg, strategy, data, logger, warmup_steps, wd,
                               xla_window)
     finally:
+        faults.disarm()
         if wd:
             wd.stop()
         # an exception mid-window must still stop + flush the device
@@ -279,23 +285,59 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     prefetch = Prefetcher(data, strategy.shard_batch,
                           depth=cfg.prefetch_depth, watchdog=wd)
 
-    start_epoch = 1
+    start_epoch, resume_step, global_step = 1, 0, 0
     if cfg.checkpoint_dir and cfg.resume:
-        from ddlbench_tpu.train.checkpoint import latest_epoch, restore_checkpoint
+        from ddlbench_tpu.train.checkpoint import latest_valid, restore_info
 
-        if latest_epoch(cfg.checkpoint_dir) is not None:
+        info = latest_valid(cfg.checkpoint_dir)
+        if info is None:
+            # A restarted-from-scratch supervisor loop (tools/chaosbench.py)
+            # passes --resume unconditionally; an empty/missing checkpoint
+            # dir must start fresh, not crash.
+            print(f"resume: no valid checkpoint under {cfg.checkpoint_dir}; "
+                  f"starting fresh", flush=True)
+        else:
             with tracer.span("checkpoint_restore"):
-                ep, ts = restore_checkpoint(cfg.checkpoint_dir, ts)
-            start_epoch = ep + 1
-            print(f"resumed from {cfg.checkpoint_dir} epoch {ep}", flush=True)
-            # post-resume validation BEFORE training continues (reference
-            # semantics: main_with_runtime.py:374-376 re-runs validate()
-            # right after restoring) — confirms the restored state is the
-            # one that was saved, not merely loadable
-            ev = evaluate(cfg, strategy, ts, data, ep, wd,
-                          prefetcher=prefetch)
-            logger.valid_epoch(ep, ev["loss"], ev["accuracy"],
-                               top5=ev.get("top5"))
+                ts = restore_info(info, ts)
+            meta = info.meta
+            if meta.get("seed") is not None and meta["seed"] != cfg.seed:
+                print(f"resume: WARNING checkpoint was written with seed "
+                      f"{meta['seed']}, run uses seed {cfg.seed} — the "
+                      f"(epoch, step)-addressed data/RNG streams will not "
+                      f"match the original trajectory", flush=True)
+            if meta.get("logger"):
+                logger.load_state_dict(meta["logger"])
+            steps_ = data.steps_per_epoch(train=True)
+            if info.mid_epoch:
+                # step-granular checkpoint: resume INSIDE the epoch at the
+                # next step — the data iterator position IS the step index
+                # (every source is (epoch, step)-addressed) and per-step
+                # RNG streams are pure (seed, epoch, step) fold-ins, so the
+                # replayed trajectory is bitwise
+                start_epoch, resume_step = info.epoch, info.step + 1
+                if resume_step >= steps_:  # epoch actually completed
+                    start_epoch, resume_step = info.epoch + 1, 0
+                print(f"resumed from {cfg.checkpoint_dir} epoch "
+                      f"{info.epoch} step {info.step} (mid-epoch)",
+                      flush=True)
+            else:
+                start_epoch = info.epoch + 1
+                print(f"resumed from {cfg.checkpoint_dir} epoch "
+                      f"{info.epoch}", flush=True)
+            global_step = (meta.get("global_step")
+                           if meta.get("global_step") is not None
+                           else (start_epoch - 1) * steps_ + resume_step)
+            if not info.mid_epoch:
+                # post-resume validation BEFORE training continues
+                # (reference semantics: main_with_runtime.py:374-376 re-runs
+                # validate() right after restoring) — confirms the restored
+                # state is the one that was saved, not merely loadable.
+                # Mid-epoch resumes skip it: the epoch is not finished, and
+                # its epoch-end validation will run at the normal point.
+                ev = evaluate(cfg, strategy, ts, data, info.epoch, wd,
+                              prefetcher=prefetch)
+                logger.valid_epoch(info.epoch, ev["loss"], ev["accuracy"],
+                                   top5=ev.get("top5"))
 
     # Activation/gradient deep-dive logging (torchlogger analog, §5.5).
     # Works on the flat per-layer param structure; pipeline strategies pack
@@ -333,10 +375,12 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     annotate_steps = cfg.trace_dir is not None
     if xla_window is None:
         xla_window = _XlaWindow(cfg)
-    global_step = 0
 
-    summary_acc = 0.0
+    summary_acc = (logger.valid_history[-1]["accuracy"]
+                   if logger.valid_history else 0.0)
     for epoch in range(start_epoch, cfg.epochs + 1):
+        # mid-epoch resume: only the first epoch starts at an interior step
+        ep_start = resume_step if epoch == start_epoch else 0
         lr = step_decay_lr(base_lr, epoch - 1, cfg.lr_step_epochs, cfg.lr_step_gamma)
         steps = data.steps_per_epoch(train=True)
         tick = time.perf_counter()
@@ -351,9 +395,10 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         loss_sum, host_loss_sum, interval_steps = None, 0.0, 0
         metrics = None
         stream = prefetch.stream(epoch, train=True,
-                                 keep_raw=actlog is not None)
+                                 keep_raw=actlog is not None,
+                                 start_step=ep_start)
         try:
-            for step, fetched in enumerate(stream):
+            for step, fetched in enumerate(stream, start=ep_start):
                 if actlog is not None and actlog.should_log(epoch, step):
                     bx, by = fetched.raw
                     try:
@@ -377,6 +422,10 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 # separately as stall (data/prefetch.py), so the two
                 # decompose the epoch instead of double-counting it.
                 t_step = time.perf_counter_ns()
+                # fault hook: `kill` SIGKILLs at this step boundary — before
+                # the dispatch, so the last committed checkpoint is what a
+                # resume must recover from
+                faults.step_boundary(epoch, step)
                 xla_window.step(global_step, lambda: (
                     float(metrics["loss"]) if metrics is not None else None))
                 ann = (jax.profiler.StepTraceAnnotation(
@@ -385,6 +434,11 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 with ann:
                     ts, metrics = strategy.train_step(ts, *fetched.batch,
                                                       jnp.float32(step_lr))
+                if faults.poison_loss(epoch, step):
+                    # `nan-loss`: poison this step's HOST-side loss (device
+                    # state untouched) — drives the --nan-policy path
+                    metrics = dict(metrics)
+                    metrics["loss"] = jnp.float32(float("nan"))
                 global_step += 1
                 interval_samples += global_batch
                 interval_steps += 1
@@ -432,12 +486,30 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     tracer.complete("train_step", t_step, t_step_end,
                                     {"epoch": epoch, "step": step,
                                      "global_step": global_step - 1})
+                if (cfg.checkpoint_every_steps
+                        and (step + 1) % cfg.checkpoint_every_steps == 0
+                        and step != steps - 1):  # epoch-end save covers last
+                    from ddlbench_tpu.train.checkpoint import save_checkpoint
+
+                    if wd:
+                        wd.kick()  # the save gets a full deadline
+                    with tracer.span("checkpoint_save", epoch=epoch,
+                                     step=step):
+                        save_checkpoint(
+                            cfg.checkpoint_dir, epoch, ts, step=step,
+                            global_step=global_step,
+                            logger_state=logger.state_dict(), seed=cfg.seed,
+                            keep=cfg.keep_checkpoints)
+                    if wd:
+                        wd.kick()
         finally:
             stream.close()
         # the final step is always a log_step, so the loop already synced on
         # the full ts chain before the clock stops here
         epoch_time = time.perf_counter() - tick
-        logger.epoch_done(epoch, steps * global_batch / epoch_time, epoch_time,
+        logger.epoch_done(epoch,
+                          (steps - ep_start) * global_batch / epoch_time,
+                          epoch_time,
                           input_stall_ms=stream.stall_ms,
                           step_ms=stats.epoch_summary(epoch))
 
@@ -455,7 +527,10 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             if wd:
                 wd.kick()  # the save itself gets a full deadline
             with tracer.span("checkpoint_save", epoch=epoch):
-                save_checkpoint(cfg.checkpoint_dir, epoch, ts)
+                save_checkpoint(cfg.checkpoint_dir, epoch, ts,
+                                global_step=global_step,
+                                logger_state=logger.state_dict(),
+                                seed=cfg.seed, keep=cfg.keep_checkpoints)
             if wd:
                 wd.kick()
 

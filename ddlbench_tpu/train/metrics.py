@@ -156,7 +156,11 @@ class MetricLogger:
             line += f" | top5 {top5:.4f}"
             record["top5"] = top5
             hist["top5"] = top5
-        self.valid_history.append(hist)
+        # keyed by epoch: a post-resume re-validation of an epoch restored
+        # from a checkpoint (train/loop.py) replaces the restored entry
+        # instead of duplicating it in the summary's curve
+        self.valid_history = [h for h in self.valid_history
+                              if h["epoch"] != epoch] + [hist]
         self._emit(line, record)
 
     def summary(self, valid_accuracy: float,
@@ -205,6 +209,24 @@ class MetricLogger:
             record,
         )
         return result
+
+    def state_dict(self) -> Dict[str, Any]:
+        """Resumable counters (checkpointed by train/loop.py so a restarted
+        run's summary covers the WHOLE trajectory, not just the tail after
+        the last crash)."""
+        return {
+            "epoch_throughputs": list(self.epoch_throughputs),
+            "epoch_times": list(self.epoch_times),
+            "epoch_stall_ms": list(self.epoch_stall_ms),
+            "valid_history": [dict(h) for h in self.valid_history],
+        }
+
+    def load_state_dict(self, state: Dict[str, Any]) -> None:
+        self.epoch_throughputs = list(state.get("epoch_throughputs", []))
+        self.epoch_times = list(state.get("epoch_times", []))
+        self.epoch_stall_ms = list(state.get("epoch_stall_ms", []))
+        self.valid_history = [dict(h)
+                              for h in state.get("valid_history", [])]
 
     def close(self) -> None:
         if self._jsonl:

@@ -1,0 +1,39 @@
+#!/usr/bin/env bash
+# Round-7 opportunistic TPU collector. Carries the still-unlanded round-4/5/6
+# queue (same task names, so any .ok marker earned in an earlier window
+# sticks), then adds the fault-tolerance round: a chaosbench kill/resume run
+# on the chip — supervised SIGKILLs against the real train CLI with
+# crash-consistent step checkpoints, verifying bitwise recovery and measuring
+# MTTR / checkpoint-write overhead on TPU (the CPU numbers from tier-1 say
+# nothing about orbax device-fetch cost or XLA re-compile-on-restart, which
+# the persistent compilation cache should mostly hide — this measures it).
+#
+# Usage: scripts/tpu_round7.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task accparity_bn_tpu_r5   python -m ddlbench_tpu.tools.accparity --engines single --arch resnet18 --epochs 12 --lr 0.02 --platform tpu
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task scalebench_dp_r6        python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3
+add_task scalebench_dpshard_r6   python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update
+add_task scalebench_dpshard_bf16_r6 python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update --allreduce-dtype bf16
+add_task bench_dp_r6             python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64
+add_task bench_dpshard_r6        python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update
+add_task bench_dpshard_bf16_r6   python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --allreduce-dtype bf16
+add_task accparity_dpshard_r6    python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-shard,dp-bf16,dp-shard-bf16
+
+# -- round-7: chaosbench kill/resume on the chip ----------------------------
+# resnet18/mnist keeps per-attempt compile short; 2 kills over 3 epochs x 30
+# steps with step checkpoints every 10 exercises mid-epoch resume on real
+# hardware. The report (recoveries, MTTR, steps lost, checkpoint overhead %,
+# bitwise trajectory_match) lands in perf_runs/chaosbench_r7.json.
+add_task chaosbench_r7 python -m ddlbench_tpu.tools.chaosbench --kills 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r7_work --keep-workdir --json perf_runs/chaosbench_r7.json
+
+window_loop "${1:-11}"

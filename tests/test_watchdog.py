@@ -31,6 +31,22 @@ def test_check_finite_policies(capsys):
     assert capsys.readouterr().err == ""
 
 
+def test_check_finite_where_override(capsys):
+    """The eval loop detects non-finiteness at its one epoch-end transfer,
+    where no specific step can honestly be blamed — `where=` replaces the
+    default 'epoch E step S' attribution in BOTH policies' messages."""
+    loc = "in validation epoch 3 (epoch-end check)"
+    with pytest.raises(TrainingFailure) as ei:
+        check_finite(float("nan"), 3, 9, "abort", where=loc)
+    assert loc in str(ei.value)
+    assert "step 9" not in str(ei.value)  # the override REPLACES, not adds
+    assert not check_finite(float("inf"), 3, 9, "warn", where=loc)
+    err = capsys.readouterr().err
+    assert loc in err and "step 9" not in err
+    # finite losses never consult the location at all
+    assert check_finite(0.5, 3, 9, "abort", where=loc)
+
+
 def test_nan_policy_validated():
     with pytest.raises(ValueError, match="nan_policy"):
         RunConfig(nan_policy="explode").validate()
@@ -50,6 +66,38 @@ def test_watchdog_survives_with_kicks():
             time.sleep(0.1)
             wd.kick()
     assert not wd.fired and fired == []
+
+
+def test_watchdog_default_timeout_dumps_stacks_and_terminates():
+    """The DEFAULT on_timeout (the production path: stack dump + hard
+    os._exit(124)) — exercised in a subprocess, since its whole point is
+    that the host process dies without Python-level cleanup."""
+    import subprocess
+    import sys
+
+    prog = (
+        "import threading, time\n"
+        "from ddlbench_tpu.train.watchdog import HangWatchdog\n"
+        "def watchdog_visible_hang_frame():\n"
+        "    time.sleep(60)\n"
+        "t = threading.Thread(target=watchdog_visible_hang_frame,\n"
+        "                     daemon=True)\n"
+        "t.start()\n"
+        "HangWatchdog(0.3).start()\n"
+        "t.join()  # never returns: the watchdog must kill us\n"
+        "print('unreachable')\n"
+    )
+    r = subprocess.run([sys.executable, "-c", prog], capture_output=True,
+                       text=True, timeout=60,
+                       env={**__import__('os').environ,
+                            "JAX_PLATFORMS": "cpu"})
+    assert r.returncode == 124  # os._exit(124), not a normal exit
+    assert "unreachable" not in r.stdout
+    assert "HANG: no progress for 0s" in r.stderr
+    # faulthandler dumped EVERY thread's stack: the hung worker's frame —
+    # the diagnosable artifact the reference's silent 2h timeout never had
+    assert "watchdog_visible_hang_frame" in r.stderr
+    assert "Thread" in r.stderr
 
 
 class _NaNStrategy:
