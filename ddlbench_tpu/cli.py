@@ -151,13 +151,33 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--inject", action="append", default=[],
                    metavar="KIND@EPOCH:STEP",
                    help="deterministic fault injection (repeatable): kill | "
-                        "ckpt-corrupt | prefetch-die | nan-loss | slow-host "
-                        "at the given 1-based epoch / 0-based step "
-                        "(ddlbench_tpu/faults/)")
+                        "preempt | ckpt-corrupt | prefetch-die | nan-loss | "
+                        "nan-grad | grad-spike | slow-host at the given "
+                        "1-based epoch / 0-based step (ddlbench_tpu/faults/)")
+    from ddlbench_tpu.guard.policy import ANOMALY_POLICIES
     from ddlbench_tpu.train.watchdog import NAN_POLICIES
 
-    p.add_argument("--nan-policy", default="abort", choices=NAN_POLICIES,
-                   help="what to do when a loss goes non-finite")
+    p.add_argument("--anomaly-policy", default=None,
+                   choices=ANOMALY_POLICIES,
+                   help="stability guard (ddlbench_tpu/guard/): arms "
+                        "on-device (finite, grad-norm) detection in the "
+                        "train step plus a host EWMA spike detector; skip "
+                        "drops anomalous updates in-step (params/opt state "
+                        "bitwise untouched), rewind restores the last "
+                        "committed checkpoint and replays")
+    p.add_argument("--anomaly-budget", type=int, default=3, metavar="K",
+                   help="consecutive anomalies (or rewinds for the same "
+                        "step) tolerated before the run fails")
+    p.add_argument("--loss-scale", default=None, metavar="dynamic|FLOAT",
+                   help="loss scaling for bf16 paths: 'dynamic' "
+                        "(on-device growth/backoff, overflowed updates "
+                        "dropped) or a fixed scale; power-of-two dynamic "
+                        "scales keep f32 runs bitwise")
+    p.add_argument("--grad-spike-factor", type=float, default=10.0,
+                   help="grad-norm spike threshold: factor x EWMA")
+    p.add_argument("--nan-policy", default=None, choices=NAN_POLICIES,
+                   help="DEPRECATED alias for --anomaly-policy (loss-only "
+                        "detection, no on-device guard)")
     p.add_argument("--hang-timeout-s", type=float, default=None,
                    help="abort (with a stack dump) if any step takes longer "
                         "than this; forces a per-step host sync while armed")
@@ -230,7 +250,11 @@ def config_from_args(args) -> RunConfig:
         checkpoint_every_steps=args.checkpoint_every_steps,
         keep_checkpoints=args.keep_checkpoints,
         inject=tuple(args.inject),
-        nan_policy=args.nan_policy,
+        nan_policy=args.nan_policy if args.nan_policy is not None else "abort",
+        anomaly_policy=args.anomaly_policy,
+        anomaly_budget=args.anomaly_budget,
+        loss_scale=args.loss_scale,
+        grad_spike_factor=args.grad_spike_factor,
         hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
         profile_mode=args.profile_mode,
@@ -247,6 +271,13 @@ def config_from_args(args) -> RunConfig:
 def main(argv=None) -> int:
     args = build_parser().parse_args(argv)
     from ddlbench_tpu.distributed import apply_platform, initialize
+
+    if args.nan_policy is not None:
+        # deprecated alias for the unified guard surface (warn once per run)
+        tail = (" (--anomaly-policy wins; the alias is ignored)"
+                if args.anomaly_policy is not None else "")
+        print(f"WARNING: --nan-policy is deprecated; use --anomaly-policy "
+              f"{args.nan_policy}{tail}", file=sys.stderr, flush=True)
 
     apply_platform(args.platform)
 
@@ -268,6 +299,8 @@ def main(argv=None) -> int:
     manifest = {k: v for k, v in vars(args).items()}
     print("run manifest: " + json.dumps(manifest), flush=True)
 
+    from ddlbench_tpu.guard import PREEMPT_EXIT_CODE, GracefulPreemption
+
     logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=args.jsonl)
     try:
         if args.trace_dir and cfg.xla_trace_steps is None:
@@ -281,6 +314,11 @@ def main(argv=None) -> int:
                 result = run_benchmark(cfg, logger=logger)
         else:
             result = run_benchmark(cfg, logger=logger)
+    except GracefulPreemption as e:
+        # the loop already committed the step-granular checkpoint; the
+        # distinct exit code tells supervisors "evicted cleanly, resume me"
+        print(f"preempted: {e} (exit {PREEMPT_EXIT_CODE})", flush=True)
+        return PREEMPT_EXIT_CODE
     finally:
         # flush + close the --jsonl stream even when a run dies mid-epoch:
         # the structured log is most valuable for exactly those runs

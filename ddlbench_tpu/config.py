@@ -324,8 +324,38 @@ class RunConfig:
     # Failure detection (reference has none beyond a 120-min process-group
     # timeout, SURVEY.md §5.3): abort/warn/ignore on non-finite loss, and an
     # optional per-sync hang deadline that stack-dumps and kills the process.
+    # DEPRECATED flag surface: superseded by anomaly_policy below (kept as a
+    # working alias — resolved_anomaly_policy() falls back to it).
     nan_policy: str = "abort"  # abort | warn | ignore
     hang_timeout_s: Optional[float] = None
+
+    # Stability guard (ddlbench_tpu/guard/). Setting anomaly_policy (or
+    # loss_scale) ARMS on-device anomaly detection in the guarded engines
+    # (single, dp incl. the explicit shard_map engine, gpipe, tpp,
+    # pipedream): each train step folds a fused (loss_finite & grad_finite,
+    # global_grad_norm) pair into its metrics, synced on the existing
+    # interval path. Policies beyond the legacy abort/warn/ignore:
+    # * "skip"   — drop an anomalous update IN-STEP (lax select): params and
+    #              optimizer state stay bitwise untouched, including ZeRO-1
+    #              sharded slices.
+    # * "rewind" — restore the last committed checkpoint via the
+    #              latest_valid resume path and replay (the (epoch, step)-
+    #              addressed data stream fast-forwards deterministically);
+    #              requires checkpoint_dir.
+    # None leaves the guard disarmed: engines compile their pre-guard
+    # programs and non-finite losses follow nan_policy as before.
+    anomaly_policy: Optional[str] = None
+    # Consecutive anomalies (skipped steps, backoffs, spikes — or rewinds
+    # for the same step) tolerated before escalating to TrainingFailure.
+    anomaly_budget: int = 3
+    # Loss scaling for the bf16 compute/wire paths: "dynamic" (growth x2
+    # after a clean streak, backoff x1/2 on overflow, overflowed updates
+    # dropped in-step) or a fixed positive float. Power-of-two dynamic
+    # scales keep f32 runs bitwise identical to unscaled ones. None = off.
+    loss_scale: Optional[Any] = None
+    # Host-side EWMA spike detector: a window whose mean grad norm exceeds
+    # factor x EWMA is an anomaly (the diverged-but-finite case).
+    grad_spike_factor: float = 10.0
 
     # Step-level telemetry (ddlbench_tpu/telemetry/): host-side span tracing
     # into a bounded ring buffer, exported as a Chrome-trace-event JSON
@@ -393,6 +423,40 @@ class RunConfig:
         if self.label_smoothing is not None:
             return self.label_smoothing
         return 0.1 if self.dataset().kind == "seq2seq" else 0.0
+
+    def resolved_anomaly_policy(self) -> str:
+        """The ONE anomaly-policy surface: anomaly_policy when set, else the
+        legacy nan_policy alias (whose values are a subset)."""
+        return (self.anomaly_policy if self.anomaly_policy is not None
+                else self.nan_policy)
+
+    def resolved_loss_scale(self):
+        """None (off), "dynamic", or a fixed positive float."""
+        if self.loss_scale is None:
+            return None
+        if isinstance(self.loss_scale, str):
+            if self.loss_scale == "dynamic":
+                return "dynamic"
+            try:
+                v = float(self.loss_scale)
+            except ValueError:
+                raise ValueError(
+                    f"loss_scale must be 'dynamic' or a positive float; "
+                    f"got {self.loss_scale!r}")
+        else:
+            v = float(self.loss_scale)
+        import math
+
+        if not math.isfinite(v) or v <= 0:
+            raise ValueError(
+                f"loss_scale must be 'dynamic' or a positive float; "
+                f"got {self.loss_scale!r}")
+        return v
+
+    def guard_armed(self) -> bool:
+        """True when the engines should compile on-device anomaly
+        detection (and loss scaling) into their train steps."""
+        return self.anomaly_policy is not None or self.loss_scale is not None
 
     def resolved_momentum(self) -> float:
         if self.momentum is not None:
@@ -462,6 +526,35 @@ class RunConfig:
 
         if self.nan_policy not in NAN_POLICIES:
             raise ValueError(f"unknown nan_policy {self.nan_policy!r}")
+        if self.anomaly_policy is not None:
+            from ddlbench_tpu.guard.policy import ANOMALY_POLICIES
+
+            if self.anomaly_policy not in ANOMALY_POLICIES:
+                raise ValueError(
+                    f"unknown anomaly_policy {self.anomaly_policy!r} "
+                    f"(choose from {', '.join(ANOMALY_POLICIES)})")
+            if self.anomaly_policy == "rewind" and self.checkpoint_dir is None:
+                raise ValueError(
+                    "anomaly_policy='rewind' needs --checkpoint-dir (the "
+                    "rewind target is the last committed checkpoint)")
+            if self.anomaly_policy == "skip" and self.strategy in (
+                    "sp", "tp", "fsdp", "ep"):
+                raise ValueError(
+                    f"anomaly_policy='skip' (in-step update drop) is wired "
+                    f"into single/dp/gpipe/pipedream train steps, not "
+                    f"{self.strategy!r}; use abort/warn/rewind there")
+        if self.anomaly_budget < 1:
+            raise ValueError("anomaly_budget must be >= 1")
+        self.resolved_loss_scale()  # raises on malformed values
+        if self.loss_scale is not None and self.strategy not in (
+                "single", "dp", "gpipe"):
+            raise ValueError(
+                f"loss_scale is wired into the single/dp/gpipe (incl. "
+                f"tp_size > 1) train steps; {self.strategy!r} runs "
+                f"unscaled (pipedream's per-microbatch updates would need "
+                f"per-event unscaling)")
+        if self.grad_spike_factor <= 1.0:
+            raise ValueError("grad_spike_factor must be > 1")
         if self.attention_backend not in ATTENTION_BACKENDS:
             raise ValueError(
                 f"unknown attention_backend {self.attention_backend!r}"

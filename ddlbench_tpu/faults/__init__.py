@@ -32,7 +32,9 @@ from ddlbench_tpu.faults.registry import (  # noqa: F401
     disarm,
     multihost_init,
     parse_injections,
+    poison_grad,
     poison_loss,
     prefetch_producer,
+    spike_grad,
     step_boundary,
 )

@@ -27,6 +27,23 @@ uses, so injections are deterministic and reproducible. Kinds:
                        inside ``distributed.initialize()``, modeling a
                        slow-starting peer. EPOCH:STEP are parsed but unused
                        (the init path predates the step clock); use 0:0.
+* ``preempt``        — SIGTERM this process at the step boundary *before*
+                       dispatching (EPOCH, STEP): the deterministic twin of
+                       a cluster eviction. With the guard's preemption
+                       handler installed (checkpoint dir configured), the
+                       loop commits a step-granular checkpoint and exits
+                       with the distinct graceful code
+                       (guard/preempt.py PREEMPT_EXIT_CODE).
+* ``nan-grad``       — poison the DEVICE-side gradients of (EPOCH, STEP):
+                       the loop NaNs that step's lr, and the guard-armed
+                       engines carry the NaN into the backward through the
+                       objective multiplier ``lr*0 + 1`` — so on-device
+                       detection and the in-step skip-select are what get
+                       exercised (unlike ``nan-loss``, which is host-only).
+* ``grad-spike``     — multiply the HOST-observed grad norm of the window
+                       containing (EPOCH, STEP) by ``DDLB_FAULT_SPIKE``
+                       (default 1000.0): drives the EWMA spike detector and
+                       its policy path without perturbing device state.
 
 Each armed spec fires at most once per process. The registry is module
 state: ``arm()`` installs specs (idempotent re-arm with the same specs is a
@@ -43,7 +60,8 @@ import sys
 import time
 from typing import List, Optional, Sequence, Tuple
 
-FAULT_KINDS = ("kill", "ckpt-corrupt", "prefetch-die", "nan-loss", "slow-host")
+FAULT_KINDS = ("kill", "ckpt-corrupt", "prefetch-die", "nan-loss",
+               "slow-host", "preempt", "nan-grad", "grad-spike")
 
 # Armed specs; empty = disarmed. Every hook checks this first.
 _SPECS: List["FaultSpec"] = []
@@ -133,6 +151,14 @@ def step_boundary(epoch: int, step: int) -> None:
         sys.stdout.flush()
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
+    if _take("preempt", epoch, step):
+        # SIGTERM, not an exception: the graceful path under test IS the
+        # signal handler -> flag -> boundary-check -> checkpoint chain.
+        # Python delivers the signal before the next bytecode, so the flag
+        # is visible to the check right after this hook.
+        print(f"fault-inject: preempt (SIGTERM) at epoch {epoch} step "
+              f"{step}", flush=True)
+        os.kill(os.getpid(), signal.SIGTERM)
 
 
 def poison_loss(epoch: int, step: int) -> bool:
@@ -145,6 +171,36 @@ def poison_loss(epoch: int, step: int) -> bool:
               flush=True)
         return True
     return False
+
+
+def poison_grad(epoch: int, step: int) -> bool:
+    """True when (epoch, step)'s DEVICE gradients should be poisoned (the
+    loop NaNs the step's lr; guard-armed engines carry it into the
+    backward — see the ``nan-grad`` grammar entry)."""
+    if not _SPECS:
+        return False
+    if _take("nan-grad", epoch, step):
+        print(f"fault-inject: nan-grad at epoch {epoch} step {step}",
+              flush=True)
+        return True
+    return False
+
+
+def spike_grad(epoch: int, step_lo: int, step_hi: int) -> float:
+    """Multiplier for the host-observed grad norm of the window covering
+    0-based steps [step_lo, step_hi] (the guard syncs health once per log
+    interval, so the spec fires when its step falls inside the window)."""
+    if not _SPECS:
+        return 1.0
+    for s in _SPECS:
+        if (s.kind == "grad-spike" and not s.fired and s.epoch == epoch
+                and step_lo <= s.step <= step_hi):
+            s.fired = True
+            factor = float(os.environ.get("DDLB_FAULT_SPIKE", "1000.0"))
+            print(f"fault-inject: grad-spike x{factor:g} at epoch {epoch} "
+                  f"step {s.step}", flush=True)
+            return factor
+    return 1.0
 
 
 def prefetch_producer(epoch: int, step: int) -> None:

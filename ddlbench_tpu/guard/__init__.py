@@ -1,0 +1,60 @@
+"""Training stability guard: anomaly detection, recovery policy, loss
+scaling, and graceful preemption.
+
+The reference suite treats every numeric anomaly as fatal-or-invisible: a
+NaN loss either aborts the run or silently poisons the trajectory, and a
+preemption is indistinguishable from a crash. Production training stacks
+absorb both. This package makes "steps survived per anomaly" a first-class
+benchmark dimension:
+
+* :mod:`ddlbench_tpu.guard.device` — in-jit helpers every engine uses to
+  compute a fused ``(loss_finite & grad_finite, global_grad_norm)`` scalar
+  pair per step (piggybacking on the on-device metrics path: no extra host
+  transfers), to drop a poisoned update in-step (``--anomaly-policy skip``
+  keeps params and optimizer state bitwise untouched), and to run dynamic
+  bf16 loss scaling (growth/backoff driven by the on-device overflow flag,
+  power-of-two scales so f32 runs stay bitwise).
+* :mod:`ddlbench_tpu.guard.policy` — the host-side policy engine behind
+  ``--anomaly-policy {abort,warn,ignore,skip,rewind}`` (superseding the flat
+  ``--nan-policy``, which remains a deprecated alias), an EWMA grad-norm
+  spike detector, and the ``--anomaly-budget`` escalation to
+  :class:`~ddlbench_tpu.train.watchdog.TrainingFailure`.
+* :mod:`ddlbench_tpu.guard.preempt` — SIGTERM/SIGINT graceful preemption:
+  a flag the train loop checks at each step boundary; the loop commits a
+  step-granular checkpoint through the atomic protocol and exits with the
+  distinct :data:`PREEMPT_EXIT_CODE`.
+
+Zero-cost contract: with the guard disarmed (no ``--anomaly-policy``, no
+``--loss-scale``) every engine compiles the exact program it compiled
+before, and the loop pays one falsy check per span site.
+"""
+
+from ddlbench_tpu.guard.preempt import (  # noqa: F401
+    PREEMPT_EXIT_CODE,
+    GracefulPreemption,
+    PreemptionHandler,
+)
+
+# guard.device imports jax and guard.policy reaches it through the train
+# package; re-export both sets of names LAZILY (PEP 562) so the jax-free
+# consumers of this package — the chaosbench supervisor (PREEMPT_EXIT_CODE)
+# and cli.build_parser (ANOMALY_POLICIES) — never pay the multi-second jax
+# import. Only preempt (stdlib-only) loads eagerly. The engines that call
+# device_guard() have jax loaded already.
+_DEVICE_EXPORTS = ("DeviceGuard", "device_guard", "GUARD_OPT_KEY",
+                   "LOSS_SCALE_GROWTH_INTERVAL", "LOSS_SCALE_INIT",
+                   "LOSS_SCALE_MAX", "LOSS_SCALE_MIN")
+_POLICY_EXPORTS = ("ANOMALY_POLICIES", "GuardRewind", "StabilityGuard")
+
+
+def __getattr__(name):
+    if name in _DEVICE_EXPORTS:
+        from ddlbench_tpu.guard import device
+
+        return getattr(device, name)
+    if name in _POLICY_EXPORTS:
+        from ddlbench_tpu.guard import policy
+
+        return getattr(policy, name)
+    raise AttributeError(
+        f"module {__name__!r} has no attribute {name!r}")

@@ -1,0 +1,188 @@
+"""In-jit guard primitives: anomaly flags, skip-select, dynamic loss scale.
+
+Every engine that arms the guard (``RunConfig.guard_armed()``) builds its
+train step with these helpers:
+
+1. The training objective is multiplied by :meth:`DeviceGuard.smul` — the
+   loss scale times a *poison carrier* ``lr * 0 + 1`` (1.0 normally, NaN
+   when the ``nan-grad`` fault NaN's the step's lr), so a deterministic
+   fault injection genuinely poisons the device-side gradients.
+2. After the backward, :meth:`DeviceGuard.health` fuses the anomaly pair
+   ``(loss_finite & grad_finite, global_grad_norm)`` from the unscaled
+   gradients. The pair rides the existing metrics dict, so it reaches the
+   host on the metrics path the loop already syncs — no extra transfers.
+3. With ``--anomaly-policy skip`` (or dynamic loss scaling, which always
+   drops overflowed updates), :meth:`DeviceGuard.select` keeps the OLD
+   params/optimizer/model state bitwise when the step is anomalous.
+4. With ``--loss-scale dynamic``, the scale state lives inside the
+   optimizer-state dict under :data:`GUARD_OPT_KEY` (so it is checkpointed,
+   donated, and restored with the rest of the train state) and is updated
+   on device by :meth:`DeviceGuard.scaler_update`: backoff x1/2 on
+   overflow, growth x2 after ``LOSS_SCALE_GROWTH_INTERVAL`` clean steps.
+
+Numerics: scales are powers of two, and power-of-two scaling commutes
+exactly with IEEE rounding (it is an exponent shift), so an f32 run with
+dynamic scaling armed is bitwise identical to the unscaled run — pinned by
+tests/test_guard.py.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+# Key under which the dynamic-loss-scale state rides the optimizer-state
+# dict (engines split it off before calling opt_update and fold the updated
+# state back in afterwards).
+GUARD_OPT_KEY = "_guard"
+
+LOSS_SCALE_INIT = 2.0 ** 15
+LOSS_SCALE_MIN = 1.0
+LOSS_SCALE_MAX = 2.0 ** 24
+LOSS_SCALE_GROWTH_INTERVAL = 200
+
+
+def device_guard(cfg) -> Optional["DeviceGuard"]:
+    """The engine-side guard for ``cfg``, or None when disarmed (the engine
+    then compiles the exact pre-guard program)."""
+    return DeviceGuard(cfg) if cfg.guard_armed() else None
+
+
+class DeviceGuard:
+    """Traced helpers shared by every guarded engine (module docstring)."""
+
+    def __init__(self, cfg):
+        self.policy = cfg.resolved_anomaly_policy()
+        ls = cfg.resolved_loss_scale()
+        self.dynamic = ls == "dynamic"
+        self.static_scale = ls if isinstance(ls, float) else None
+        # dynamic scaling ALWAYS drops the overflowed update (that is what
+        # makes backoff safe); the skip policy does so for any anomaly
+        self.select_update = self.policy == "skip" or self.dynamic
+
+    # -- loss-scale state (lives in the optimizer dict) --------------------
+
+    def opt_entry(self) -> Optional[dict]:
+        """Fresh scale state for strategy.init, or None when not dynamic."""
+        if not self.dynamic:
+            return None
+        return {"scale": jnp.float32(LOSS_SCALE_INIT),
+                "good": jnp.zeros((), jnp.int32)}
+
+    def split_opt(self, opt: dict) -> Tuple[dict, Optional[dict]]:
+        """(opt without the guard entry, scale state or None)."""
+        if GUARD_OPT_KEY not in opt:
+            return opt, None
+        return ({k: v for k, v in opt.items() if k != GUARD_OPT_KEY},
+                opt[GUARD_OPT_KEY])
+
+    # -- traced step pieces ------------------------------------------------
+
+    def smul(self, gstate: Optional[dict], lr) -> jax.Array:
+        """Objective multiplier: loss scale x the nan-grad poison carrier.
+
+        ``lr * 0 + 1`` is 1.0 for every finite lr and NaN when the loop
+        NaN'd the lr for an injected ``nan-grad`` fault — so the poison
+        rides the gradients (where detection looks), not just the update.
+        """
+        unit = lr * 0.0 + 1.0
+        if self.dynamic:
+            return gstate["scale"] * unit
+        if self.static_scale is not None:
+            return jnp.float32(self.static_scale) * unit
+        return unit
+
+    def unscale(self, grads, smul):
+        """Undo the objective scaling on the gradients (exact for the
+        power-of-two scales the dynamic scaler uses; NaN/Inf propagate)."""
+        return jax.tree.map(lambda g: (g / smul).astype(g.dtype), grads)
+
+    def health(self, loss, grads) -> Tuple[jax.Array, jax.Array]:
+        """Fused anomaly pair from UNSCALED grads: (finite bool, grad L2).
+
+        One reduction serves both signals: any NaN/Inf gradient element
+        makes the norm non-finite, so ``isfinite(norm)`` is the fused
+        grad-finite flag and no per-leaf isfinite sweep is needed.
+        """
+        sumsq = sum(jnp.sum(jnp.square(g.astype(jnp.float32)))
+                    for g in jax.tree.leaves(grads))
+        return self.finite(loss, jnp.sqrt(sumsq))
+
+    def finite(self, loss, grad_norm) -> Tuple[jax.Array, jax.Array]:
+        """(loss_finite & grad_finite, grad_norm) from a precomputed norm —
+        for engines whose norm needs strategy-specific collectives (the dp
+        explicit shard_map engine, pipedream's per-microbatch updates)."""
+        return jnp.isfinite(loss) & jnp.isfinite(grad_norm), grad_norm
+
+    def select(self, finite, new_tree, old_tree):
+        """Keep ``new_tree`` on a clean step, the bitwise-untouched
+        ``old_tree`` on an anomalous one. No-op pass-through when neither
+        skip nor dynamic scaling asks for in-step drops."""
+        if not self.select_update:
+            return new_tree
+        return jax.tree.map(lambda a, b: jnp.where(finite, a, b),
+                            new_tree, old_tree)
+
+    def scaler_update(self, gstate: Optional[dict], finite) -> Optional[dict]:
+        """Dynamic scale transition: backoff x1/2 on overflow, growth x2
+        after LOSS_SCALE_GROWTH_INTERVAL consecutive clean steps."""
+        if not self.dynamic:
+            return gstate
+        good = jnp.where(finite, gstate["good"] + 1, 0)
+        grow = good >= LOSS_SCALE_GROWTH_INTERVAL
+        scale = jnp.where(
+            finite,
+            jnp.where(grow,
+                      jnp.minimum(gstate["scale"] * 2.0, LOSS_SCALE_MAX),
+                      gstate["scale"]),
+            jnp.maximum(gstate["scale"] * 0.5, LOSS_SCALE_MIN))
+        return {"scale": scale, "good": jnp.where(grow, 0, good)}
+
+    def fold_opt(self, opt: dict, gstate: Optional[dict]) -> dict:
+        """Re-attach the (updated) scale state to the optimizer dict."""
+        if gstate is None:
+            return opt
+        return {**opt, GUARD_OPT_KEY: gstate}
+
+    def commit(self, finite, grad_norm, gstate: Optional[dict],
+               new_tree, old_tree):
+        """The guarded-update tail shared by the one-jit engines
+        (single / dp GSPMD / gpipe / tpp): skip-select, scale-state
+        transition, fold the state back into the opt dict, and build the
+        metric entries. ``new_tree``/``old_tree`` are (params, model_state,
+        opt) triples (opt WITHOUT the guard entry — split_opt's output).
+        Keeping the ordering in one place is the point: select must
+        compare against the pre-step opt, and the reported loss_scale is
+        the post-transition one. Returns (params, model_state, opt,
+        metric_entries)."""
+        params, state, opt = self.select(finite, new_tree, old_tree)
+        gstate = self.scaler_update(gstate, finite)
+        return (params, state, self.fold_opt(opt, gstate),
+                self.metrics(finite, grad_norm, gstate))
+
+    def metrics(self, finite, grad_norm,
+                gstate: Optional[dict] = None) -> dict:
+        """The guard's metric entries — lazy scalars on the device metrics
+        path; the loop accumulates them and syncs once per log interval."""
+        out = {"finite": finite.astype(jnp.float32), "grad_norm": grad_norm}
+        if self.dynamic and gstate is not None:
+            out["loss_scale"] = gstate["scale"]
+        return out
+
+    # -- init-time helpers -------------------------------------------------
+
+    def attach_opt_state(self, opt: dict) -> dict:
+        """Add the fresh scale state to an engine's initial optimizer dict
+        (no-op when not dynamic)."""
+        entry = self.opt_entry()
+        return opt if entry is None else {**opt, GUARD_OPT_KEY: entry}
+
+    def opt_state_spec(self, opt_specs: dict, scalar_spec: Any) -> dict:
+        """Mirror :meth:`attach_opt_state` on a sharding/spec pytree: the
+        scale state is two replicated scalars."""
+        if not self.dynamic:
+            return opt_specs
+        return {**opt_specs,
+                GUARD_OPT_KEY: {"scale": scalar_spec, "good": scalar_spec}}

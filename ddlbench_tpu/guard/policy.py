@@ -1,0 +1,324 @@
+"""Host-side stability policy engine.
+
+One policy surface for every anomaly the run can see:
+
+* device anomalies — the ``(finite, grad_norm)`` pair each guarded engine
+  folds into its metrics dict. The loop accumulates them as lazy
+  jax.Arrays and hands them to :meth:`StabilityGuard.flush` at the same
+  sync points it already pays for (log intervals, checkpoint commits), or
+  per step under an armed watchdog (:meth:`StabilityGuard.step_health`).
+* host anomalies — non-finite losses at the existing ``check_finite`` call
+  sites (train intervals, eval steps, epoch-end eval), now routed through
+  :meth:`StabilityGuard.check_loss` so ``--anomaly-policy`` governs all of
+  them (``--nan-policy`` remains a deprecated alias).
+* grad-norm spikes — an EWMA detector over the grad-norm stream: a window
+  whose mean norm exceeds ``grad_spike_factor x EWMA`` is an anomaly even
+  though every value is finite (the loss-diverged-but-not-NaN case).
+
+Policies: ``abort`` raises TrainingFailure; ``warn``/``ignore`` keep the
+legacy semantics; ``skip`` counts the updates the engine already dropped
+in-step (host-side-only anomalies degrade to warn — there is nothing left
+to drop); ``rewind`` raises :class:`GuardRewind`, which run_benchmark
+catches to restore the last committed checkpoint through the existing
+``latest_valid`` resume path and deterministically fast-forward the
+(epoch, step)-addressed data stream. ``--anomaly-budget K`` bounds
+consecutive failures (and repeated rewinds to the same step) before
+escalating to TrainingFailure. Dynamic loss scaling absorbs non-finite
+steps as backoffs — counted, never fatal below the budget.
+"""
+
+from __future__ import annotations
+
+import math
+import sys
+from typing import Any, Dict, Optional, Tuple
+
+from ddlbench_tpu import faults
+from ddlbench_tpu.train.watchdog import TrainingFailure, check_finite
+
+ANOMALY_POLICIES = ("abort", "warn", "ignore", "skip", "rewind")
+
+# Strategies whose engines carry no device-guard wiring: they emit no
+# (finite, grad_norm) metrics even with the guard armed, so in-step `skip`
+# is rejected (config.validate) and the grad-spike fault cannot fire there.
+GUARD_UNWIRED_STRATEGIES = ("sp", "tp", "fsdp", "ep")
+
+# EWMA spike detector tuning: the smoothing weight of each new observation
+# and the observations needed before spike checks arm (the first steps of a
+# run legitimately swing the grad norm).
+EWMA_ALPHA = 0.2
+EWMA_WARMUP_OBS = 3
+
+
+class GuardRewind(Exception):
+    """Raised by the guard to request a restore-from-last-checkpoint;
+    caught by run_benchmark, never user-visible."""
+
+
+class StabilityGuard:
+    """Host half of the stability guard (module docstring)."""
+
+    def __init__(self, cfg):
+        self.policy = cfg.resolved_anomaly_policy()
+        self.budget = cfg.anomaly_budget
+        self.device_armed = cfg.guard_armed()
+        self.dynamic_scale = cfg.resolved_loss_scale() == "dynamic"
+        self.spike_factor = cfg.grad_spike_factor
+        self.explicit = cfg.anomaly_policy is not None
+        self.counters: Dict[str, Any] = {
+            "anomalies": 0, "skipped_steps": 0, "spikes": 0,
+            "rewinds": 0, "loss_scale_backoffs": 0,
+        }
+        self.last_loss_scale: Optional[float] = None
+        # whether a guarded engine has actually delivered device flags:
+        # config-level arming is not enough — sp/tp/fsdp/ep engines emit no
+        # device metrics even with anomaly_policy set, and check_loss must
+        # keep the books itself there (the device window owns them
+        # otherwise, or every real anomaly would be counted twice)
+        self._saw_device_metrics = False
+        self._ewma: Optional[float] = None
+        self._obs = 0
+        self._consecutive = 0
+        self._rewind_at: Optional[Tuple[int, int]] = None
+        self._rewind_streak = 0
+        # lazy device accumulators (one transfer per flush)
+        self._fin_sum = None
+        self._gn_sum = None
+        self._scale = None
+        self._n = 0
+
+    @property
+    def active(self) -> bool:
+        """True when the guard should surface counters in the summary."""
+        return (self.device_armed or self.explicit
+                or any(self.counters.values()))
+
+    # -- device-metric accounting -----------------------------------------
+
+    def accumulate(self, metrics: Dict[str, Any]) -> None:
+        """Chain this step's (finite, grad_norm) lazily; no transfer."""
+        if not self.device_armed or "finite" not in metrics:
+            return
+        self._saw_device_metrics = True
+        f, g = metrics["finite"], metrics["grad_norm"]
+        self._fin_sum = f if self._fin_sum is None else self._fin_sum + f
+        self._gn_sum = g if self._gn_sum is None else self._gn_sum + g
+        self._scale = metrics.get("loss_scale")
+        self._n += 1
+
+    def flush(self, epoch: int, end_step: int) -> None:
+        """Sync + process everything accumulated since the last flush.
+
+        ``end_step`` is 1-based (the loop's ``step + 1``); called at every
+        log interval and immediately before each checkpoint commit, so a
+        poisoned state is detected before it can be committed. May raise
+        (abort / budget escalation / GuardRewind).
+        """
+        if self._n == 0:
+            return
+        import jax
+
+        fin, gn, scale = jax.device_get(
+            (self._fin_sum, self._gn_sum, self._scale))
+        n = self._n
+        self._fin_sum, self._gn_sum, self._scale, self._n = None, None, None, 0
+        if scale is not None:
+            self.last_loss_scale = float(scale)
+        self._window(epoch, end_step, n, float(fin), float(gn) / n)
+
+    def reset_window(self) -> None:
+        """Drop pending lazy accumulators (the abandoned interval of a
+        rewound run must not pollute the replay's first flush)."""
+        self._fin_sum, self._gn_sum, self._scale, self._n = None, None, None, 0
+
+    def step_health(self, epoch: int, step: int,
+                    metrics: Dict[str, Any]) -> None:
+        """Per-step path (armed watchdog: every loss already syncs)."""
+        if not self.device_armed or "finite" not in metrics:
+            return
+        self._saw_device_metrics = True
+        import jax
+
+        # one bundled transfer (the step is already synced by the loop's
+        # loss read; separate float()s would pay a round-trip each)
+        fin, gn, scale = jax.device_get(
+            (metrics["finite"], metrics["grad_norm"],
+             metrics.get("loss_scale")))
+        if scale is not None:
+            self.last_loss_scale = float(scale)
+        self._window(epoch, step, 1, float(fin), float(gn))
+
+    # -- the policy core ---------------------------------------------------
+
+    def _window(self, epoch: int, end_step: int, n: int,
+                fin_total: float, gn_mean: float) -> None:
+        lo, hi = end_step - n + 1, end_step  # 1-based inclusive window
+        n_bad = int(round(n - fin_total))
+        if n_bad:
+            # an injected spike targeting THIS window must still fire (the
+            # faults contract: an armed spec fires deterministically), even
+            # though the window's mean norm is poisoned by the bad step(s)
+            # and the numeric detector below never runs for it
+            if faults.spike_grad(epoch, lo - 1, hi - 1) != 1.0:
+                self.counters["anomalies"] += 1
+                self.counters["spikes"] += 1
+                if self.policy != "ignore":
+                    print(f"guard: grad-norm spike (injected) in epoch "
+                          f"{epoch} steps {lo}-{hi}", file=sys.stderr,
+                          flush=True)
+            self.counters["anomalies"] += n_bad
+            self._consecutive = (self._consecutive + n_bad
+                                 if n_bad == n else n_bad)
+            where = (f"at epoch {epoch} step {hi}" if n == 1 else
+                     f"in epoch {epoch} steps {lo}-{hi}")
+            if self.dynamic_scale:
+                # overflowed updates were dropped + the scale backed off on
+                # device: absorbed, not fatal (below the budget)
+                self.counters["loss_scale_backoffs"] += n_bad
+                scale = (f" (scale now {self.last_loss_scale:g})"
+                         if self.last_loss_scale is not None else "")
+                print(f"guard: loss-scale backoff x{n_bad} {where}{scale}",
+                      flush=True)
+            elif self.policy == "skip":
+                self.counters["skipped_steps"] += n_bad
+                print(f"guard: dropped {n_bad} non-finite update(s) {where} "
+                      f"(skip)", flush=True)
+            elif self.policy == "rewind":
+                self._trigger_rewind(epoch, hi,
+                                     f"non-finite gradients {where}")
+            elif self.policy == "abort":
+                raise TrainingFailure(
+                    f"guard: non-finite gradients ({n_bad} step(s)) {where}")
+            elif self.policy == "warn":
+                print(f"guard: WARNING non-finite gradients ({n_bad} "
+                      f"step(s)) {where}", file=sys.stderr, flush=True)
+            if (self.dynamic_scale or self.policy == "skip") and n_bad == n:
+                # the budget bounds ABSORBED anomalies (drops/backoffs);
+                # abort already raised, and warn/ignore are the user's
+                # explicit "keep going regardless" (legacy parity). A MIXED
+                # window proves at least one clean step interleaves the bad
+                # ones — the device reports only the sum, so adjacency is
+                # unknown and escalating would abort isolated anomalies the
+                # per-step path (armed watchdog) absorbs; _consecutive still
+                # carries n_bad as the possible tail streak, so a following
+                # fully-bad window checks the accumulated run
+                self._check_budget(where)
+        else:
+            if not self._spike_check(epoch, lo, hi, gn_mean):
+                # EWMA learns only clean, UN-SPIKED windows — absorbing a
+                # spiked value would re-baseline the detector onto a
+                # sustained divergence after one window — and only a fully
+                # clean window breaks the consecutive-anomaly streak (a
+                # reset before the spike check would make the spike budget
+                # unreachable: it would always be checked at 1)
+                self._consecutive = 0
+                self._ewma = (gn_mean if self._ewma is None else
+                              EWMA_ALPHA * gn_mean
+                              + (1.0 - EWMA_ALPHA) * self._ewma)
+                self._obs += 1
+
+    def _spike_check(self, epoch: int, lo: int, hi: int,
+                     gn_mean: float) -> bool:
+        """Returns True when the window spiked (and applied its policy)."""
+        # deterministic injection: the grad-spike fault inflates the
+        # observed value (the detector path is what is under test). An
+        # injected spike fires even inside the EWMA warmup — consuming the
+        # spec and then suppressing it would break the faults contract
+        # ("the same spec always fires at the same point").
+        factor = faults.spike_grad(epoch, lo - 1, hi - 1)
+        injected = factor != 1.0
+        gn_obs = gn_mean * factor
+        if not injected and (self._ewma is None
+                             or self._obs < EWMA_WARMUP_OBS):
+            return False
+        ref = self._ewma if self._ewma is not None else gn_mean
+        if not injected and (not math.isfinite(gn_obs)
+                             or gn_obs <= self.spike_factor * ref):
+            # an injected spec was already consumed by spike_grad() above,
+            # so it must fire even when the inflated value still clears the
+            # threshold (e.g. a zero-gradient window: 0 x factor == 0)
+            return False
+        self.counters["anomalies"] += 1
+        self.counters["spikes"] += 1
+        self._consecutive += 1
+        where = (f"at epoch {epoch} step {hi}" if lo == hi else
+                 f"in epoch {epoch} steps {lo}-{hi}")
+        msg = (f"grad-norm spike ({gn_obs:.3e} > {self.spike_factor:g}x "
+               f"EWMA {ref:.3e}) {where}")
+        # the spike detector is a HEURISTIC: it only gets fatal teeth when
+        # the user explicitly chose an anomaly policy. Armed implicitly
+        # (--loss-scale alone; self.policy then inherits the legacy
+        # nan_policy default "abort") a finite fluctuation must warn, not
+        # kill a run that only asked for loss scaling.
+        policy = self.policy if self.explicit else "warn"
+        if policy == "abort":
+            raise TrainingFailure(f"guard: {msg}")
+        if policy == "rewind":
+            self._trigger_rewind(epoch, hi, msg)
+        if policy != "ignore":
+            # a spike survives the update that caused it — skip cannot drop
+            # it retroactively, so it degrades to a warning + budget count
+            print(f"guard: {msg}", file=sys.stderr, flush=True)
+        if self.dynamic_scale or self.policy == "skip":
+            self._check_budget(where)
+        return True
+
+    def check_loss(self, loss: float, epoch: int, step: int,
+                   where: Optional[str] = None, train: bool = True) -> bool:
+        """The unified non-finite-LOSS policy (every legacy check_finite
+        call site routes here). Returns True when the loss is finite.
+
+        When a guarded engine is delivering device flags, this site only
+        APPLIES the policy: a genuinely non-finite loss also trips the
+        device finite flag, so counting/budgeting here too would
+        double-count every real anomaly (halving the effective budget) —
+        the device window owns the counters then. Without device flags
+        (legacy configs, or strategies whose engines have no guard
+        wiring), this is the only detector and keeps the books itself."""
+        if math.isfinite(loss):
+            return True
+        if not self._saw_device_metrics:
+            self.counters["anomalies"] += 1
+        if train and self.policy == "rewind":
+            self._trigger_rewind(epoch, step,
+                                 where or f"non-finite loss at epoch "
+                                          f"{epoch} step {step}")
+        # a host-detected NaN loss survives in the metrics stream only; the
+        # update (if any) already happened — skip/rewind degrade to warn
+        # (rewind only on the eval path, where there is nothing to rewind)
+        policy = "warn" if self.policy in ("skip", "rewind") else self.policy
+        ok = check_finite(loss, epoch, step, policy, where)
+        # train-path rewind never reaches here (_trigger_rewind raised), so
+        # only skip keeps host-side books
+        if train and not self._saw_device_metrics and self.policy == "skip":
+            self._consecutive += 1
+            self._check_budget(where or f"at epoch {epoch} step {step}")
+        return ok
+
+    # -- escalation --------------------------------------------------------
+
+    def _trigger_rewind(self, epoch: int, step: int, reason: str) -> None:
+        at = (epoch, step)
+        self._rewind_streak = (self._rewind_streak + 1
+                               if at == self._rewind_at else 1)
+        self._rewind_at = at
+        if self._rewind_streak > self.budget:
+            raise TrainingFailure(
+                f"guard: anomaly budget ({self.budget}) exhausted — "
+                f"{self._rewind_streak} rewinds for the same anomaly "
+                f"({reason})")
+        self.counters["rewinds"] += 1
+        raise GuardRewind(reason)
+
+    def _check_budget(self, where: str) -> None:
+        if self._consecutive > self.budget:
+            raise TrainingFailure(
+                f"guard: anomaly budget ({self.budget}) exhausted — "
+                f"{self._consecutive} consecutive anomalous steps "
+                f"(last {where})")
+
+    def summary(self) -> Dict[str, Any]:
+        out = dict(self.counters)
+        if self.last_loss_scale is not None:
+            out["loss_scale"] = self.last_loss_scale
+        return out

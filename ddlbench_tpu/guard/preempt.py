@@ -1,0 +1,93 @@
+"""Graceful preemption: turn SIGTERM/SIGINT into a committed checkpoint.
+
+TPU preemptions (and most cluster evictions) deliver SIGTERM with a grace
+window. The stock behavior — die mid-step, recover from the last periodic
+checkpoint — wastes up to ``checkpoint_every_steps`` steps per preemption.
+With a checkpoint dir configured, run_benchmark installs
+:class:`PreemptionHandler`: the signal handler only sets a flag (safe in
+any async context); the train loop checks the flag at each step boundary,
+commits a step-granular checkpoint through the atomic protocol
+(train/checkpoint.py), and raises :class:`GracefulPreemption`, which the
+CLI converts into the distinct exit code :data:`PREEMPT_EXIT_CODE` — so a
+supervisor (tools/chaosbench.py, or any cluster runner) can tell "evicted
+cleanly, zero steps lost" from "crashed".
+
+The deterministic twin is the ``preempt@E:S`` fault kind, which SIGTERMs
+the process at exactly that step boundary.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Optional
+
+# EX_TEMPFAIL: "temporary failure; retry" — distinct from 0 (done), 1
+# (TrainingFailure), -9/-15 (hard kills) and 124 (hang watchdog).
+PREEMPT_EXIT_CODE = 75
+
+
+class GracefulPreemption(Exception):
+    """Raised by the train loop after the preemption checkpoint committed;
+    cli.py converts it to PREEMPT_EXIT_CODE."""
+
+    def __init__(self, message: str, checkpoint_path: Optional[str] = None):
+        super().__init__(message)
+        self.checkpoint_path = checkpoint_path
+
+
+class PreemptionHandler:
+    """Flag-setting SIGTERM/SIGINT handler with install/uninstall."""
+
+    def __init__(self, signals=(signal.SIGTERM, signal.SIGINT)):
+        self._signals = signals
+        self._prev: dict = {}
+        self._requested = threading.Event()
+        self.installed = False
+
+    def _handle(self, signum, frame) -> None:
+        if not self._requested.is_set():
+            self._requested.set()
+            print(f"preempt: caught signal {signum}; will commit a "
+                  f"checkpoint at the next step boundary (repeat the "
+                  f"signal to exit immediately)", file=sys.stderr,
+                  flush=True)
+            return
+        # second delivery: the run is likely stuck before a step boundary
+        # (e.g. a long XLA compile) — restore the original disposition and
+        # re-deliver, so a second Ctrl-C/SIGTERM behaves as if we were
+        # never installed instead of being swallowed forever
+        prev = self._prev.get(signum, signal.SIG_DFL)
+        try:
+            signal.signal(signum, prev if prev is not None
+                          else signal.SIG_DFL)
+        except (ValueError, TypeError):
+            signal.signal(signum, signal.SIG_DFL)
+        os.kill(os.getpid(), signum)
+
+    @property
+    def requested(self) -> bool:
+        return self._requested.is_set()
+
+    def install(self) -> "PreemptionHandler":
+        """Install handlers; a no-op off the main thread (signal.signal
+        raises there — e.g. run_benchmark driven from a worker thread),
+        leaving default delivery semantics."""
+        try:
+            for sig in self._signals:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            self.installed = True
+        except ValueError:
+            self._prev.clear()
+        return self
+
+    def uninstall(self) -> None:
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, TypeError):
+                pass
+        self._prev.clear()
+        self.installed = False

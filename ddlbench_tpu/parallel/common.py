@@ -68,7 +68,7 @@ def correct_topk(logits: jax.Array, labels: jax.Array, k: int = 5) -> jax.Array:
 
 def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
                          aux_weight, smoothing, fused, accum_steps: int,
-                         remat: bool = False):
+                         remat: bool = False, obj_scale=None):
     """K-way gradient accumulation: split the leading batch axis into K
     micro-steps, scan value_and_grad over them, and average the gradients
     weighted by each micro-step's valid-label count (exact K=1 equivalence;
@@ -108,6 +108,8 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
             obj, ce, stats, new_st = loss_with_moe_aux(
                 model, p, st, xk, yk, True, compute_dtype, aux_weight,
                 smoothing, fused, remat)
+            if obj_scale is not None:  # stability guard: loss scaling /
+                obj = obj * obj_scale  # nan-grad poison carrier
             return obj, (ce, stats, new_st)
 
         (obj, (ce, (corr, valid), new_st)), g = jax.value_and_grad(
@@ -127,15 +129,19 @@ def accum_loss_and_grads(model, params, model_state, x, y, compute_dtype,
 
 
 def loss_and_grads(model, cfg, params, model_state, x, y, compute_dtype,
-                   smoothing):
+                   smoothing, obj_scale=None):
     """One-apply training loss + gradients, dispatching on
     cfg.grad_accum_steps (the shared core of the single/dp/tp/fsdp train
-    steps). Returns (ce, (correct, valid), new_state, grads)."""
+    steps). Returns (ce, (correct, valid), new_state, grads).
+
+    ``obj_scale`` (stability guard) multiplies the training OBJECTIVE only
+    — loss scaling plus the nan-grad poison carrier; the returned ``ce``
+    metric and the gradients' downstream unscaling are the caller's."""
     if cfg.grad_accum_steps > 1:
         _, ce, stats, new_state, grads = accum_loss_and_grads(
             model, params, model_state, x, y, compute_dtype,
             cfg.moe_aux_weight, smoothing, cfg.fused_head_loss,
-            cfg.grad_accum_steps, cfg.remat_layers)
+            cfg.grad_accum_steps, cfg.remat_layers, obj_scale=obj_scale)
         return ce, stats, new_state, grads
 
     def loss_fn(p):
@@ -143,6 +149,8 @@ def loss_and_grads(model, cfg, params, model_state, x, y, compute_dtype,
             model, p, model_state, x, y, True, compute_dtype,
             cfg.moe_aux_weight, smoothing, fused=cfg.fused_head_loss,
             remat=cfg.remat_layers)
+        if obj_scale is not None:
+            loss = loss * obj_scale
         return loss, (ce, stats, new_state)
 
     (_, (ce, stats, new_state)), grads = jax.value_and_grad(

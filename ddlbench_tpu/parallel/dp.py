@@ -68,6 +68,8 @@ class DPStrategy:
     """strategy='dp': batch sharded over the 'data' mesh axis, params replicated."""
 
     def __init__(self, model: LayerModel, cfg: RunConfig, mesh: Optional[Mesh] = None):
+        from ddlbench_tpu.guard import device_guard
+
         self.model = model
         self.cfg = cfg
         self.mesh = mesh or make_data_mesh(cfg.num_devices)
@@ -75,6 +77,7 @@ class DPStrategy:
         self._opt_init, opt_update = make_optimizer(cfg)
         self._opt_update = opt_update
         smooth = cfg.resolved_label_smoothing()
+        guard = self._guard = device_guard(cfg)  # None = pre-guard program
 
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, P("data"))
@@ -98,16 +101,35 @@ class DPStrategy:
             from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import loss_and_grads
 
-            with sharded_jit_tracing():  # auto-Pallas unsafe under GSPMD
-                ce, (correct, valid), new_state, grads = loss_and_grads(
-                    model, cfg, ts.params, ts.model_state, x, y,
-                    self.compute_dtype, smooth)
-            params, opt = opt_update(ts.params, grads, ts.opt, lr)
+            if guard is None:
+                with sharded_jit_tracing():  # auto-Pallas unsafe under GSPMD
+                    ce, (correct, valid), new_state, grads = loss_and_grads(
+                        model, cfg, ts.params, ts.model_state, x, y,
+                        self.compute_dtype, smooth)
+                params, opt = opt_update(ts.params, grads, ts.opt, lr)
+            else:
+                # Stability guard (same shape as the single engine): scaled
+                # objective, fused health pair, in-step skip-select. GSPMD
+                # shards the norm reduction like any other reduction.
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
+                with sharded_jit_tracing():
+                    ce, (correct, valid), new_state, grads = loss_and_grads(
+                        model, cfg, ts.params, ts.model_state, x, y,
+                        self.compute_dtype, smooth, obj_scale=smul)
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
+                params, opt = opt_update(ts.params, grads, opt_in, lr)
+                params, new_state, opt, gm = guard.commit(
+                    finite, gnorm, gstate, (params, new_state, opt),
+                    (ts.params, ts.model_state, opt_in))
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
                 / jnp.maximum(1.0, valid.astype(jnp.float32)),
             }
+            if guard is not None:
+                metrics.update(gm)
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
@@ -210,7 +232,9 @@ class DPStrategy:
                                         tiled=True).astype(jnp.float32)
             return lax.psum(gf, "data").astype(jnp.float32)
 
-        def local_grads(params, state, x, y):
+        guard = self._guard
+
+        def local_grads(params, state, x, y, smul):
             """(ce, correct, valid, new_state, g_reduced): psum'd metrics
             plus the reduced flat gradient (shard or full vector).
             Non-accum partials are pre-seeded by 1/global_count (the GSPMD
@@ -232,6 +256,8 @@ class DPStrategy:
                     denom = jnp.maximum(
                         1.0, lax.psum(norm, "data").astype(jnp.float32))
                     obj = psum_keepgrad(obj_sum, "data") / denom
+                    if smul is not None:  # guard: loss scale / poison
+                        obj = obj * smul
                     return obj, (ce_sum, correct, valid, denom, new_state)
 
                 (_, (ce_sum, correct, valid, denom, new_state)), g = \
@@ -262,6 +288,8 @@ class DPStrategy:
                     denom = jnp.maximum(
                         1.0, lax.psum(norm, "data").astype(jnp.float32))
                     obj = psum_keepgrad(obj_sum, "data") / denom
+                    if smul is not None:
+                        obj = obj * smul
                     return obj, (ce_sum, correct, valid, denom, new_st)
 
                 (_, (ce_sum, correct, valid, denom, new_st)), g = \
@@ -286,19 +314,43 @@ class DPStrategy:
                     gsum / total)
 
         def local_step(params, state, opt, x, y, lr):
+            gstate, smul = None, None
+            if guard is not None:
+                opt, gstate = guard.split_opt(opt)
+                smul = guard.smul(gstate, lr)
             with batch_parallel("data", n):
                 ce, correct, valid, new_state, gr = local_grads(
-                    params, state, x, y)
+                    params, state, x, y, smul)
+            if guard is not None:
+                # unscale AFTER the (wire-dtype) collective — the scaled
+                # values are what rides the wire — then fuse the health
+                # pair: the shard's sumsq psums to the global grad norm.
+                gr = gr / smul
+                sumsq = jnp.sum(jnp.square(gr))
+                if shard_update:
+                    sumsq = lax.psum(sumsq, "data")
+                finite, gnorm = guard.finite(ce, jnp.sqrt(sumsq))
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
                 / jnp.maximum(1.0, valid.astype(jnp.float32)),
             }
+            if guard is not None:
+                new_gstate = guard.scaler_update(gstate, finite)
+                metrics.update(guard.metrics(finite, gnorm, new_gstate))
             if shard_update:
                 pf = pack_flat(params, meta)
                 ps = lax.dynamic_slice_in_dim(
                     pf, lax.axis_index("data") * shard_len, shard_len)
                 new_ps, new_opt = opt_update(ps, gr, opt, lr)
+                if guard is not None:
+                    # skip-select covers the ZeRO-1 SHARDED slices too: the
+                    # untouched old slice all-gathers back, so the
+                    # re-assembled params are bitwise the pre-step ones
+                    new_ps, new_state, new_opt = guard.select(
+                        finite, (new_ps, new_state, new_opt),
+                        (ps, state, opt))
+                    new_opt = guard.fold_opt(new_opt, new_gstate)
                 # out_spec P('data') on the updated slice re-assembles the
                 # flat parameter vector across devices — the all-gather
                 # happens at the shard_map output boundary.
@@ -307,6 +359,11 @@ class DPStrategy:
             # psum already ran in the wire dtype; per-leaf optimizer step.
             new_params, new_opt = opt_update(
                 params, unpack_flat(gr, meta), opt, lr)
+            if guard is not None:
+                new_params, new_state, new_opt = guard.select(
+                    finite, (new_params, new_state, new_opt),
+                    (params, state, opt))
+                new_opt = guard.fold_opt(new_opt, new_gstate)
             return new_params, new_state, new_opt, metrics
 
         flat_spec = P("data") if shard_update else P()
@@ -317,6 +374,11 @@ class DPStrategy:
         if cfg.resolved_optimizer() == "adam":
             opt_specs.update(v=flat_spec, step=P())
             opt_shardings.update(v=flat_sh, step=self._replicated)
+        if guard is not None:
+            # dynamic loss-scale state: two replicated scalars in the dict
+            opt_specs = guard.opt_state_spec(opt_specs, P())
+            opt_shardings = guard.opt_state_spec(opt_shardings,
+                                                 self._replicated)
         self._opt_shardings = opt_shardings
 
         sharded = _shard_map(
@@ -367,11 +429,16 @@ class DPStrategy:
             # contiguous [padded/world] slice per device.
             opt = self._opt_init(
                 jnp.zeros((self._flat_meta.padded,), jnp.float32))
+            if self._guard is not None:
+                opt = self._guard.attach_opt_state(opt)
             ts = TrainState(params, state, opt)
             shardings = TrainState(self._replicated, self._replicated,
                                    self._opt_shardings)
             return put_global_tree(ts, shardings)
-        ts = TrainState(params, state, self._opt_init(params))
+        opt = self._opt_init(params)
+        if self._guard is not None:
+            opt = self._guard.attach_opt_state(opt)
+        ts = TrainState(params, state, opt)
         # Broadcast-init parity (mnist_horovod.py:230-231): replicate to the
         # mesh — identical on every host since init is seed-deterministic.
         shardings = TrainState(self._replicated, self._replicated,

@@ -112,9 +112,11 @@ class GPipeStrategy:
         self.mb, self.num_microbatches = cfg.resolved_batches()
         self._stage_bounds_override = stage_bounds
         self._built = False
+        from ddlbench_tpu.guard import device_guard
         from ddlbench_tpu.parallel.common import make_optimizer
 
         self._opt_init, self._opt_update = make_optimizer(cfg)
+        self._guard = device_guard(cfg)  # None = pre-guard program
 
     # -- initialization ----------------------------------------------------
 
@@ -169,6 +171,8 @@ class GPipeStrategy:
                              step_like=params_mat.shape[:-1] + (1,))
         if "step" in opt:
             opt = {**opt, "step": put_global_batch(opt["step"], sharding)}
+        if self._guard is not None:
+            opt = self._guard.attach_opt_state(opt)  # dynamic loss scale
         return PipeTrainState(params_mat, state_mat, opt)
 
     # -- stage branch construction ----------------------------------------
@@ -408,21 +412,46 @@ class GPipeStrategy:
 
     def _ts_sharding(self):
         sh = self._stage_sharding
-        return PipeTrainState(sh, sh, sh)
+        opt_sh = sh
+        if self._guard is not None and self._guard.dynamic:
+            # the loss-scale scalars break the one-sharding-for-the-whole-
+            # opt-subtree shorthand: spell the dict out, scalars replicated
+            from ddlbench_tpu.parallel.common import opt_state_sharding
+
+            opt_sh = self._guard.opt_state_spec(
+                opt_state_sharding(self.cfg, sh, sh),
+                NamedSharding(self.mesh, P()))
+        return PipeTrainState(sh, sh, opt_sh)
 
     def _make_train_step(self):
         pipe_train = self._make_pipe_fn(train=True)
+        guard = self._guard
 
         def train_step(ts: PipeTrainState, xs, ys, lr):
+            gstate, smul, opt_in = None, None, ts.opt
+            if guard is not None:
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
+
             def loss_fn(params_mat):
                 loss, ce, new_state, correct, _c5 = pipe_train(
                     params_mat, ts.model_state, xs, ys)
+                if smul is not None:  # guard: loss scale / poison carrier
+                    loss = loss * smul
                 return loss, (ce, new_state, correct)
 
             (_, (ce, new_state, correct)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
-            params, opt = self._opt_update(ts.params, grads, ts.opt, lr)
+            gm = None
+            if guard is not None:
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
+            params, opt = self._opt_update(ts.params, grads, opt_in, lr)
+            if guard is not None:
+                params, new_state, opt, gm = guard.commit(
+                    finite, gnorm, gstate, (params, new_state, opt),
+                    (ts.params, ts.model_state, opt_in))
             # valid label positions (samples, or unmasked tokens for LM /
             # seq2seq workloads)
             valid = jnp.sum((ys >= 0).astype(jnp.float32))
@@ -430,6 +459,8 @@ class GPipeStrategy:
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, valid),
             }
+            if gm is not None:
+                metrics.update(gm)
             return PipeTrainState(params, new_state, opt), metrics
 
         return jax.jit(

@@ -510,6 +510,8 @@ class PipeDreamStrategy(GPipeStrategy):
             out_specs=(spec, spec, spec, P(), P()),
         )
 
+        guard = self._guard
+
         def train_step(ts: PDTrainState, xs, ys, lr):
             params, st, opt, loss, correct = pipe(
                 ts.params, ts.model_state, ts.opt, xs, ys, lr)
@@ -519,6 +521,21 @@ class PipeDreamStrategy(GPipeStrategy):
                 "accuracy": correct.astype(jnp.float32)
                 / jnp.maximum(1.0, valid),
             }
+            if guard is not None:
+                # Stability guard, pipedream flavor: gradients are consumed
+                # by per-microbatch updates inside the compiled schedule, so
+                # the fused health pair is taken from the post-step
+                # parameter DELTA — any NaN/Inf gradient (incl. a nan-grad
+                # fault's NaN lr) poisons some update and therefore the
+                # delta; the reported "grad_norm" is the update norm
+                # ||params_new - params_old|| (documented deviation).
+                delta_sq = jnp.sum(jnp.square(
+                    (params - ts.params).astype(jnp.float32)))
+                finite, gnorm = guard.finite(loss, jnp.sqrt(delta_sq))
+                params, st, opt = guard.select(
+                    finite, (params, st, opt),
+                    (ts.params, ts.model_state, ts.opt))
+                metrics.update(guard.metrics(finite, gnorm))
             return PDTrainState(params, st, opt), metrics
 
         return jax.jit(

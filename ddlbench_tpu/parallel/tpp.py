@@ -99,6 +99,9 @@ class TPGPipeStrategy:
         self._stage_bounds_override = stage_bounds
         self._built = False
         self._opt_init, self._opt_update = make_optimizer(cfg)
+        from ddlbench_tpu.guard import device_guard
+
+        self._guard = device_guard(cfg)  # None = pre-guard program
         from ddlbench_tpu.parallel.common import head_fusable
 
         if cfg.fused_head_loss and head_fusable(model):
@@ -197,6 +200,8 @@ class TPGPipeStrategy:
             if "step" in opt[k]:
                 opt[k] = {**opt[k],
                           "step": put_global_batch(opt[k]["step"], sh)}
+        if self._guard is not None:
+            opt = self._guard.attach_opt_state(opt)  # dynamic loss scale
         return TPPipeTrainState(params, state_mat, opt)
 
     # -- stage branch ------------------------------------------------------
@@ -275,8 +280,16 @@ class TPGPipeStrategy:
         aux_w = self.cfg.moe_aux_weight if train else 0.0
         branches = [self._make_branch(c, train) for c in range(S)]
         perm = [(i, i + 1) for i in range(S - 1)]
+        # Guard objective multiplier (loss scale x nan-grad poison carrier):
+        # applied INSIDE the shard_map — seeding the backward with a traced
+        # scalar from outside would give the cotangent an unknown
+        # replication type over 'model' and fail shard_map's rep checks on
+        # the TP pad/psum transposes; in-shard, the extra P() input is
+        # replicated by construction. Unarmed traces take no extra arg and
+        # compile the exact pre-guard program.
+        guarded = train and self._guard is not None
 
-        def inner(params, state_rows, xs, ys):
+        def inner(params, state_rows, xs, ys, *guard_args):
             # local blocks: sliced [1, 1, L_sl], repl [1, L_rp], state
             # [1, L_st], xs/ys replicated [M, mb, ...]. The pcast on the
             # replicated row transposes to its gradient psum over 'model'
@@ -341,6 +354,8 @@ class TPGPipeStrategy:
             ce = fold_mean(ce_acc) / M
             aux = fold_mean(aux_acc) / M
             loss = fold_mean(loss_acc) / M + aux_w * aux
+            if guarded:
+                loss = loss * guard_args[0]
             correct = fold_count(corr_acc)
             correct5 = fold_count(corr5_acc)
             # Sync BN-style state across data replicas (sync-BN choice,
@@ -348,12 +363,15 @@ class TPGPipeStrategy:
             st_row = lax.pmean(lax.pmean(st_row, "data"), "model")
             return loss, ce, st_row[None], correct, correct5
 
+        in_specs = ({"sliced": P("stage", "model", None),
+                     "repl": P("stage", None)},
+                    P("stage", None), P(None, "data"), P(None, "data"))
+        if guarded:
+            in_specs = in_specs + (P(),)
         return _shard_map(
             inner,
             mesh=self.mesh,
-            in_specs=({"sliced": P("stage", "model", None),
-                       "repl": P("stage", None)},
-                      P("stage", None), P(None, "data"), P(None, "data")),
+            in_specs=in_specs,
             out_specs=(P(), P(), P("stage", None), P(), P()),
         )
 
@@ -371,32 +389,55 @@ class TPGPipeStrategy:
             "repl": opt_state_sharding(self.cfg, self._rp_sharding,
                                        self._rp_sharding),
         }
+        if self._guard is not None:
+            opt_sh = self._guard.opt_state_spec(
+                opt_sh, NamedSharding(self.mesh, P()))
         return TPPipeTrainState(params_sh, self._rp_sharding, opt_sh)
 
     def _make_train_step(self):
         pipe_train = self._make_pipe_fn(train=True)
+        guard = self._guard
 
         def train_step(ts: TPPipeTrainState, xs, ys, lr):
+            gstate, smul, opt_in = None, None, ts.opt
+            if guard is not None:
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
+
             def loss_fn(params):
+                # smul rides into the shard_map as a replicated input (see
+                # _make_pipe_fn): the objective scaling must happen
+                # in-shard for the 'model'-axis transposes to typecheck
+                args = (smul,) if smul is not None else ()
                 loss, ce, new_state, correct, _c5 = pipe_train(
-                    params, ts.model_state, xs, ys)
+                    params, ts.model_state, xs, ys, *args)
                 return loss, (ce, new_state, correct)
 
             (_, (ce, new_state, correct)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True)(ts.params)
+            if guard is not None:
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
             new_sl, opt_sl = self._opt_update(
-                ts.params["sliced"], grads["sliced"], ts.opt["sliced"], lr)
+                ts.params["sliced"], grads["sliced"], opt_in["sliced"], lr)
             new_rp, opt_rp = self._opt_update(
-                ts.params["repl"], grads["repl"], ts.opt["repl"], lr)
+                ts.params["repl"], grads["repl"], opt_in["repl"], lr)
+            new_params = {"sliced": new_sl, "repl": new_rp}
+            new_opt = {"sliced": opt_sl, "repl": opt_rp}
+            gm = None
+            if guard is not None:
+                new_params, new_state, new_opt, gm = guard.commit(
+                    finite, gnorm, gstate, (new_params, new_state, new_opt),
+                    (ts.params, ts.model_state, opt_in))
             valid = jnp.sum((ys >= 0).astype(jnp.float32))
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
                 / jnp.maximum(1.0, valid),
             }
-            return TPPipeTrainState({"sliced": new_sl, "repl": new_rp},
-                                    new_state,
-                                    {"sliced": opt_sl, "repl": opt_rp}), metrics
+            if gm is not None:
+                metrics.update(gm)
+            return TPPipeTrainState(new_params, new_state, new_opt), metrics
 
         return jax.jit(
             train_step,

@@ -1,33 +1,39 @@
 #!/usr/bin/env python
-"""Chaos benchmark: kill/restart supervision + recovery measurement.
+"""Chaos benchmark: kill/preempt/restart supervision + recovery measurement.
 
 The reference suite cannot answer "what happens when a worker dies?" — its
 only failure handling is a 2-hour process-group timeout and a pkill script
 (SURVEY.md §5.3). This tool makes recovery a *benchmark dimension*: it runs
 the train CLI as a child process under a supervisor that
 
-1. schedules ``--kills N`` deterministic SIGKILL injections (``--inject
-   kill@E:S``, one per attempt, spread evenly over the run's global steps),
+1. schedules ``--kills N`` deterministic SIGKILL injections and
+   ``--preempts N`` graceful SIGTERM preemptions (``--inject kill@E:S`` /
+   ``preempt@E:S``, one per attempt, spread evenly over the run's global
+   steps),
 2. relaunches the child with ``--resume`` after every death, with
    exponential backoff and a bounded restart budget (a crash-looping run
-   must not spin forever),
+   must not spin forever; an exhausted budget exits nonzero),
 3. verifies the interrupted trajectory against an uninterrupted baseline
    run **bit-for-bit** (per-step train losses via ``--log-interval 1``
    JSONL records and per-epoch validation loss/accuracy — synthetic data is
    (epoch, step)-addressed, so any divergence means state was lost), and
 4. emits a bench.py-style JSON line: recoveries, MTTR (child death -> the
-   resumed child's "resumed from" line), steps lost per kill, and
-   checkpoint write overhead (the ``checkpoint_save``/``checkpoint_restore``
-   telemetry spans from each attempt's ``--trace`` file, as a fraction of
-   chaos-run wall time).
+   resumed child's "resumed from" line) split between SIGKILL deaths and
+   graceful preemptions (exit code guard/preempt.py PREEMPT_EXIT_CODE with
+   a committed checkpoint — counted separately from hard crashes), steps
+   lost per kill, checkpoint write overhead (telemetry spans from each
+   attempt's ``--trace``), and the stability-guard event counts scraped
+   from the children's ``guard:`` lines (anomalies detected / steps
+   skipped / rewinds / loss-scale backoffs).
 
 Usage (CPU smoke)::
 
-    python -m ddlbench_tpu.tools.chaosbench --kills 2 --platform cpu \
-        -b mnist -m lenet --steps-per-epoch 6 -e 2 --batch-size 8 \
-        --checkpoint-every-steps 2 --json chaos.json
+    python -m ddlbench_tpu.tools.chaosbench --kills 2 --preempts 1 \
+        --platform cpu -b mnist -m lenet --steps-per-epoch 6 -e 2 \
+        --batch-size 8 --checkpoint-every-steps 2 --json chaos.json
 
-Any flags after ``--`` are passed through to the train CLI verbatim.
+Any flags after ``--`` are passed through to the train CLI verbatim (e.g.
+``-- --anomaly-policy skip --inject nan-grad@1:3`` for an anomaly mix).
 """
 
 from __future__ import annotations
@@ -35,12 +41,15 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
 import shutil
 import signal
 import subprocess
 import sys
 import time
 from typing import Any, Dict, List, Optional, Tuple
+
+from ddlbench_tpu.guard.preempt import PREEMPT_EXIT_CODE
 
 
 def _parse_args(argv=None):
@@ -49,8 +58,14 @@ def _parse_args(argv=None):
         formatter_class=argparse.RawDescriptionHelpFormatter)
     p.add_argument("--kills", type=int, default=1,
                    help="number of SIGKILL injections to schedule")
+    p.add_argument("--preempts", type=int, default=0,
+                   help="number of graceful SIGTERM preemptions to "
+                        "schedule (interleaved with the kills; the child "
+                        "commits a checkpoint and exits with the distinct "
+                        "graceful code)")
     p.add_argument("--restart-budget", type=int, default=None,
-                   help="max child relaunches (default: kills + 3)")
+                   help="max child relaunches (default: kills + preempts "
+                        "+ 3)")
     p.add_argument("--backoff-base-s", type=float, default=0.5,
                    help="restart backoff base (doubles per consecutive "
                         "restart, capped by --backoff-max-s)")
@@ -109,8 +124,57 @@ def kill_schedule(kills: int, epochs: int, steps_per_epoch: int
     return out
 
 
+def event_schedule(kills: int, preempts: int, epochs: int,
+                   steps_per_epoch: int) -> List[Tuple[str, int, int]]:
+    """Deterministic (kind, epoch, step) schedule: kills and graceful
+    preemptions interleaved over the evenly-spaced disruption points."""
+    points = kill_schedule(kills + preempts, epochs, steps_per_epoch)
+    events, k_left, p_left, want_kill = [], kills, preempts, True
+    for e, s in points:
+        pick_kill = (want_kill and k_left > 0) or p_left <= 0
+        if pick_kill:
+            events.append(("kill", e, s))
+            k_left -= 1
+        else:
+            events.append(("preempt", e, s))
+            p_left -= 1
+        want_kill = not want_kill
+    return events
+
+
 def _global_step(epoch: int, step: int, steps_per_epoch: int) -> int:
     return (epoch - 1) * steps_per_epoch + step
+
+
+# Stability-guard event lines (train/loop.py + guard/policy.py print these
+# with stable prefixes precisely so the supervisor can aggregate them).
+_GUARD_COUNTED = {
+    "steps_skipped": re.compile(r"guard: dropped (\d+) non-finite"),
+    "loss_scale_backoffs": re.compile(r"guard: loss-scale backoff x(\d+)"),
+    "warned_steps": re.compile(
+        r"guard: WARNING non-finite gradients \((\d+) step"),
+}
+_GUARD_FLAGGED = {
+    "spikes": re.compile(r"guard: grad-norm spike"),
+    "rewinds": re.compile(r"guard: rewinding to the last valid checkpoint"),
+}
+
+
+def guard_events(lines: List[str]) -> Dict[str, int]:
+    """Aggregate guard event counts from one attempt's output lines."""
+    out = {k: 0 for k in (*_GUARD_COUNTED, *_GUARD_FLAGGED)}
+    for line in lines:
+        for key, pat in _GUARD_COUNTED.items():
+            m = pat.search(line)
+            if m:
+                out[key] += int(m.group(1))
+        for key, pat in _GUARD_FLAGGED.items():
+            if pat.search(line):
+                out[key] += 1
+    out["anomalies_detected"] = sum(
+        out[k] for k in ("steps_skipped", "loss_scale_backoffs", "spikes",
+                         "rewinds", "warned_steps"))
+    return out
 
 
 def _train_argv(args, ckpt_dir: Optional[str], jsonl: str,
@@ -248,9 +312,10 @@ def run_chaos(args) -> Dict[str, Any]:
     workdir = args.workdir or os.path.join("chaosbench_runs", str(os.getpid()))
     os.makedirs(workdir, exist_ok=True)
     ckpt_dir = os.path.join(workdir, "ckpt")
-    schedule = kill_schedule(args.kills, args.epochs, args.steps_per_epoch)
+    schedule = event_schedule(args.kills, getattr(args, "preempts", 0),
+                              args.epochs, args.steps_per_epoch)
     budget = (args.restart_budget if args.restart_budget is not None
-              else args.kills + 3)
+              else len(schedule) + 3)
 
     report: Dict[str, Any] = {
         "metric": "chaosbench_recovery",
@@ -258,7 +323,10 @@ def run_chaos(args) -> Dict[str, Any]:
         "framework": args.framework,
         "epochs": args.epochs, "steps_per_epoch": args.steps_per_epoch,
         "checkpoint_every_steps": args.checkpoint_every_steps,
-        "kills_scheduled": [f"kill@{e}:{s}" for e, s in schedule],
+        "kills_scheduled": [f"{k}@{e}:{s}" for k, e, s in schedule
+                            if k == "kill"],
+        "preempts_scheduled": [f"{k}@{e}:{s}" for k, e, s in schedule
+                               if k == "preempt"],
         "restart_budget": budget,
     }
 
@@ -277,27 +345,32 @@ def run_chaos(args) -> Dict[str, Any]:
             return report
         report["baseline_wall_s"] = round(base.wall_s, 3)
 
-    # -- chaos run: supervised kill/restart loop ---------------------------
+    # -- chaos run: supervised kill/preempt/restart loop -------------------
     chaos_jsonl = os.path.join(workdir, "chaos.jsonl")
     pending = list(schedule)
     attempts: List[AttemptResult] = []
-    mttr_s: List[float] = []
+    mttr_s: List[float] = []  # hard-kill MTTRs (legacy field name)
+    mttr_preempt_s: List[float] = []  # graceful-preemption MTTRs
     steps_lost: List[int] = []
     recoveries = restarts = 0
+    kills_fired = preempts_fired = graceful_exits = 0
     consecutive_failures = 0
     save_s = restore_s = 0.0
     last_death: Optional[float] = None
+    death_kind: Optional[str] = None
     killed_at: Optional[Tuple[int, int]] = None
+    guard_totals: Dict[str, int] = {}
     completed = False
 
     while True:
         attempt_no = len(attempts)
-        inject = [f"kill@{e}:{s}" for e, s in pending[:1]]
+        inject = [f"{k}@{e}:{s}" for k, e, s in pending[:1]]
         trace = os.path.join(workdir, f"attempt_{attempt_no}.trace.json")
         argv = _train_argv(args, ckpt_dir, chaos_jsonl, trace, inject,
                            resume=True)
         print(f"chaosbench: attempt {attempt_no}"
-              + (f" (pending {inject[0]})" if inject else " (no more kills)"),
+              + (f" (pending {inject[0]})" if inject
+                 else " (no more disruptions)"),
               flush=True)
         res = _run_attempt(argv,
                            os.path.join(workdir, f"attempt_{attempt_no}.log"))
@@ -306,9 +379,13 @@ def run_chaos(args) -> Dict[str, Any]:
                                       "checkpoint_restore"))
         save_s += spans["checkpoint_save"]
         restore_s += spans["checkpoint_restore"]
+        for key, v in guard_events(res.lines).items():
+            guard_totals[key] = guard_totals.get(key, 0) + v
 
         if res.resumed_at is not None and last_death is not None:
-            mttr_s.append(res.resumed_at - last_death)
+            mttr = res.resumed_at - last_death
+            (mttr_preempt_s if death_kind == "preempt"
+             else mttr_s).append(mttr)
             recoveries += 1
             resumed_g = _parse_resumed_global(res.resumed_line,
                                               args.steps_per_epoch)
@@ -316,16 +393,36 @@ def run_chaos(args) -> Dict[str, Any]:
                     steps_lost and steps_lost[-1] is None:
                 steps_lost[-1] = _global_step(*killed_at,
                                               args.steps_per_epoch) - resumed_g
-            last_death = None
+            last_death, death_kind = None, None
 
         if res.rc == 0:
             completed = True
             break
         if res.rc == -signal.SIGKILL and pending and \
+                pending[0][0] == "kill" and \
                 any(l.startswith("fault-inject: kill") for l in res.lines):
-            killed_at = pending.pop(0)
+            killed_at = pending.pop(0)[1:]
+            kills_fired += 1
             steps_lost.append(None)  # filled in by the next resume line
-            last_death = res.died_at
+            last_death, death_kind = res.died_at, "kill"
+            consecutive_failures = 0
+        elif res.rc == PREEMPT_EXIT_CODE and \
+                any(l.startswith("preempt: checkpoint committed")
+                    for l in res.lines):
+            # graceful exit: the child committed its preemption checkpoint
+            # and exited with the distinct code — an EXPECTED eviction, not
+            # a crash (counted, timed, and budgeted separately)
+            # pop the scheduled spec only when the INJECTED preemption
+            # actually fired (kill-branch parity): a stray external SIGTERM
+            # also exits 75 with a committed line, but must not consume the
+            # scheduled disruption point
+            if pending and pending[0][0] == "preempt" and \
+                    any(l.startswith("fault-inject: preempt")
+                        for l in res.lines):
+                pending.pop(0)
+                preempts_fired += 1
+            graceful_exits += 1
+            last_death, death_kind = res.died_at, "preempt"
             consecutive_failures = 0
         else:
             consecutive_failures += 1
@@ -346,13 +443,20 @@ def run_chaos(args) -> Dict[str, Any]:
         "completed": completed,
         "attempts": len(attempts),
         "restarts": restarts,
-        # len(schedule), not args.kills: tiny runs collapse duplicate kill
-        # points, and the report must agree with mttr_s/steps_lost lengths
-        "kills": len(schedule) - len(pending),
+        # fired counts, not args.kills: tiny runs collapse duplicate
+        # disruption points, and the report must agree with mttr/steps_lost
+        "kills": kills_fired,
+        "preempts": preempts_fired,
+        "graceful_exits": graceful_exits,
         "recoveries": recoveries,
         "mttr_s": [round(t, 3) for t in mttr_s],
         "mttr_s_mean": round(sum(mttr_s) / len(mttr_s), 3) if mttr_s else None,
+        "mttr_preempt_s": [round(t, 3) for t in mttr_preempt_s],
+        "mttr_preempt_s_mean": (round(sum(mttr_preempt_s)
+                                      / len(mttr_preempt_s), 3)
+                                if mttr_preempt_s else None),
         "steps_lost_per_kill": steps_lost,
+        "guard": guard_totals,
         "chaos_wall_s": round(chaos_wall, 3),
         "checkpoint_save_s": round(save_s, 3),
         "checkpoint_restore_s": round(restore_s, 3),
@@ -378,7 +482,10 @@ def run_chaos(args) -> Dict[str, Any]:
 def main(argv=None) -> int:
     args = _parse_args(argv)
     report = run_chaos(args)
-    ok = report.get("completed") and "error" not in report and \
+    # nonzero whenever no run COMPLETED (e.g. the restart budget was
+    # exhausted on a crash-looping child), an error was recorded, or the
+    # recovered trajectory diverged — supervisor callers key off this
+    ok = bool(report.get("completed")) and "error" not in report and \
         report.get("trajectory_match", True)
     return 0 if ok else 1
 

@@ -1,3 +1,14 @@
-from ddlbench_tpu.train.metrics import AverageMeter, MetricLogger
+"""Train-loop package. The metric re-exports are lazy (PEP 562): metrics
+imports jax, but jax-free consumers of sibling submodules (the chaosbench
+supervisor reaching train.watchdog, tools parsing args) run this package
+init on the way in and must not pay the multi-second jax import for it."""
 
 __all__ = ["AverageMeter", "MetricLogger"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from ddlbench_tpu.train import metrics
+
+        return getattr(metrics, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

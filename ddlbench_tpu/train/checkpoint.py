@@ -138,13 +138,17 @@ def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
                     global_step: Optional[int] = None,
                     logger_state: Optional[Dict[str, Any]] = None,
                     seed: Optional[int] = None,
-                    keep: Optional[int] = None) -> str:
+                    keep: Optional[int] = None,
+                    pin: Optional[str] = None) -> str:
     """Atomically commit ``train_state`` under ``<ckpt_dir>/<name>``.
 
     ``step`` (interior, 0-based index of the last completed step) selects the
     step-granular name; None is the per-epoch checkpoint. Returns the
     committed path. ``keep`` applies the retention policy after the commit
-    (see :func:`gc_checkpoints`).
+    (see :func:`gc_checkpoints`); ``pin`` names a checkpoint retention must
+    never drop — the loop pins its current rewind/resume target so a stale
+    but marker-bearing (possibly corrupt) newer checkpoint cannot crowd the
+    only verified-restorable state out of the window.
     """
     ckpt_dir = os.path.abspath(ckpt_dir)
     os.makedirs(ckpt_dir, exist_ok=True)
@@ -153,8 +157,6 @@ def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
     tmp = final + ".tmp"
     if os.path.isdir(tmp):  # stale tmp from a crashed save: never trusted
         shutil.rmtree(tmp)
-    if os.path.isdir(final):  # force-overwrite semantics (orbax parity)
-        shutil.rmtree(final)
 
     ckptr = _checkpointer()
     ckptr.save(os.path.join(tmp, _STATE_SUBDIR), train_state, force=True)
@@ -182,12 +184,18 @@ def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
         f.flush()
         os.fsync(f.fileno())
     _fsync_path(tmp)
+    # force-overwrite semantics (orbax parity) — deferred until the tmp is
+    # fully durable, so a same-name re-save that dies mid-write can only
+    # lose the old copy in this rmtree->rename gap, not during the whole
+    # (slow) orbax save above
+    if os.path.isdir(final):
+        shutil.rmtree(final)
     os.rename(tmp, final)
     _fsync_path(ckpt_dir)
     # fault hook: ckpt-corrupt damages the just-committed checkpoint
     faults.checkpoint_saved(final, epoch, step)
     if keep is not None:
-        gc_checkpoints(ckpt_dir, keep)
+        gc_checkpoints(ckpt_dir, keep, pin=pin)
     return final
 
 
@@ -275,7 +283,8 @@ def latest_valid(ckpt_dir: str) -> Optional[CheckpointInfo]:
     return None
 
 
-def gc_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
+def gc_checkpoints(ckpt_dir: str, keep: int,
+                   pin: Optional[str] = None) -> List[str]:
     """Retention policy: keep the newest ``keep`` restorable checkpoints
     (committed ones AND pre-protocol legacy ones — legacy dirs are real
     user data, never remnants), delete everything older, plus stale
@@ -283,7 +292,14 @@ def gc_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
     unreachable states under the protocol: tampered or hand-copied, never
     restorable). Restorability here is a marker/layout check, not a full
     manifest verification — GC runs after every save and must not re-hash
-    the whole retention window. Returns deleted paths."""
+    the whole retention window.
+
+    ``pin`` (a path) is exempt from the age-out: the train loop pins its
+    current rewind/resume target, so a NEWER but post-commit-corrupted
+    checkpoint (marker present, manifest broken — undetectable without the
+    re-hash GC must not pay) can never crowd the one checkpoint the run is
+    known to be able to restore out of the window. Returns deleted paths.
+    """
     if keep < 1:
         raise ValueError("keep-checkpoints must be >= 1")
     deleted = []
@@ -298,9 +314,11 @@ def gc_checkpoints(ckpt_dir: str, keep: int) -> List[str]:
         return (os.path.exists(os.path.join(p, COMMIT_MARKER))
                 or is_legacy_checkpoint(p))
 
+    pin_real = os.path.realpath(pin) if pin else None
     entries = list_checkpoints(ckpt_dir)
     keepers = [t for t in entries if _restorable(t[2])]
     drop = keepers[:-keep] if len(keepers) > keep else []
+    drop = [t for t in drop if os.path.realpath(t[2]) != pin_real]
     remnants = [t for t in entries if not _restorable(t[2])]
     for _, _, path in drop + remnants:
         shutil.rmtree(path, ignore_errors=True)

@@ -10,6 +10,8 @@ device-side work lives in the strategy's jitted steps.
 from __future__ import annotations
 
 import contextlib
+import os
+import sys
 import time
 from typing import Any, Dict, Optional
 
@@ -20,12 +22,15 @@ from ddlbench_tpu import faults
 from ddlbench_tpu.config import RunConfig
 from ddlbench_tpu.data.prefetch import Prefetcher
 from ddlbench_tpu.data.synthetic import make_synthetic
+from ddlbench_tpu.guard import (GracefulPreemption, GuardRewind,
+                                PreemptionHandler, StabilityGuard)
 from ddlbench_tpu.parallel.api import make_strategy
 from ddlbench_tpu.telemetry import (StepLatencyStats, Tracer,
                                     export_chrome_trace, get_tracer,
                                     set_tracer)
 from ddlbench_tpu.train.metrics import MetricLogger
-from ddlbench_tpu.train.watchdog import HangWatchdog, check_finite
+from ddlbench_tpu.train.watchdog import (HangWatchdog, TrainingFailure,
+                                         check_finite)
 from ddlbench_tpu.parallel.common import step_decay_lr
 
 _NULL_CTX = contextlib.nullcontext()
@@ -121,15 +126,81 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
     # (tens of seconds); with warmup_steps=0 the first step's compile counts.
     wd = HangWatchdog(cfg.hang_timeout_s) if cfg.hang_timeout_s else None
     xla_window = _XlaWindow(cfg)
+    # Stability guard (ddlbench_tpu/guard/): the ONE policy surface for
+    # every anomaly — on-device (finite, grad_norm) flags from the guarded
+    # engines, non-finite losses at the legacy check sites, EWMA grad-norm
+    # spikes — plus graceful preemption. With neither --anomaly-policy nor
+    # --loss-scale set, the guard only mirrors the legacy nan_policy checks.
+    guard = StabilityGuard(cfg)
+    preempt = None
+    if cfg.checkpoint_dir:
+        # SIGTERM/SIGINT -> flag -> step-boundary checkpoint -> distinct
+        # exit code. Only armed when there is somewhere to commit to.
+        preempt = PreemptionHandler().install()
     # Deterministic fault injection (ddlbench_tpu/faults/): armed for the
     # run, disarmed in the finally. With cfg.inject empty this arms nothing
     # and every hook below is a single falsy check.
     faults.arm(cfg.inject)
+    if any(s.kind == "grad-spike" for s in faults.armed_specs()):
+        from ddlbench_tpu.guard.policy import GUARD_UNWIRED_STRATEGIES
+
+        # grad-spike is consumed by the guard's device-metric window; with
+        # the guard disarmed — or a strategy whose engine carries no guard
+        # wiring and so emits no device metrics — the spec would silently
+        # never fire. Surface it instead of breaking the deterministic-
+        # firing contract quietly.
+        if not guard.device_armed:
+            print("WARNING: --inject grad-spike has no effect without "
+                  "--anomaly-policy/--loss-scale (the guard's grad-norm "
+                  "detector is what consumes it)", file=sys.stderr,
+                  flush=True)
+        elif cfg.strategy in GUARD_UNWIRED_STRATEGIES:
+            print(f"WARNING: --inject grad-spike has no effect with "
+                  f"-f {cfg.strategy} (its engine has no device-guard "
+                  f"wiring, so no grad-norm stream feeds the detector)",
+                  file=sys.stderr, flush=True)
+    if preempt is None and \
+            any(s.kind == "preempt" for s in faults.armed_specs()):
+        # the graceful path needs somewhere to commit; without it the
+        # injected SIGTERM is just an uncheckpointed death (rc -15)
+        print("WARNING: --inject preempt without --checkpoint-dir kills "
+              "the run uncheckpointed (graceful preemption needs a commit "
+              "target)", file=sys.stderr, flush=True)
     try:
-        return _run_benchmark(cfg, strategy, data, logger, warmup_steps, wd,
-                              xla_window)
+        while True:
+            try:
+                return _run_benchmark(cfg, strategy, data, logger,
+                                      warmup_steps, wd, xla_window, guard,
+                                      preempt)
+            except GuardRewind as rw:
+                # --anomaly-policy rewind: restore the last committed
+                # checkpoint through the existing latest_valid resume path;
+                # the (epoch, step)-addressed data stream fast-forwards
+                # deterministically, so the replay is bitwise. The guard
+                # bounds repeated rewinds for the same step by the budget.
+                from ddlbench_tpu.train.checkpoint import latest_valid
+
+                if latest_valid(cfg.checkpoint_dir) is None:
+                    # no committed checkpoint yet: re-entering would fall
+                    # through the empty-dir resume path and silently restart
+                    # with FRESH params (not a rewind) while the logger keeps
+                    # the abandoned attempt's records — escalate instead
+                    raise TrainingFailure(
+                        f"guard: rewind requested but no committed "
+                        f"checkpoint exists in {cfg.checkpoint_dir} ({rw}); "
+                        f"use --checkpoint-every-steps to bound the window "
+                        f"before the first epoch-end commit") from rw
+                print(f"guard: rewinding to the last valid checkpoint "
+                      f"({rw})", flush=True)
+                get_tracer().complete("guard_rewind",
+                                      time.perf_counter_ns(),
+                                      time.perf_counter_ns())
+                guard.reset_window()  # drop the abandoned interval's flags
+                cfg = cfg.replace(resume=True)
     finally:
         faults.disarm()
+        if preempt is not None:
+            preempt.uninstall()
         if wd:
             wd.stop()
         # an exception mid-window must still stop + flush the device
@@ -205,8 +276,12 @@ def _make_data(cfg: RunConfig):
 
 def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                    warmup_steps: int, wd: Optional[HangWatchdog],
-                   xla_window: Optional[_XlaWindow] = None) -> Dict[str, Any]:
+                   xla_window: Optional[_XlaWindow] = None,
+                   guard: Optional[StabilityGuard] = None,
+                   preempt: Optional[PreemptionHandler] = None
+                   ) -> Dict[str, Any]:
 
+    guard = guard or StabilityGuard(cfg)
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
 
@@ -285,11 +360,21 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     prefetch = Prefetcher(data, strategy.shard_batch,
                           depth=cfg.prefetch_depth, watchdog=wd)
 
+    # Retention pin: the path of the checkpoint the run would currently
+    # rewind/resume to — gc never drops it (train/checkpoint.py), so a
+    # newer post-commit-corrupted checkpoint cannot crowd the only known-
+    # restorable state out of a tight --keep-checkpoints window. Updated to
+    # every newly committed checkpoint (which then IS the rewind target).
+    ckpt_pin: Optional[str] = None
     start_epoch, resume_step, global_step = 1, 0, 0
     if cfg.checkpoint_dir and cfg.resume:
         from ddlbench_tpu.train.checkpoint import latest_valid, restore_info
 
         info = latest_valid(cfg.checkpoint_dir)
+        if wd:
+            # on a rewind re-entry the watchdog thread is already running;
+            # the restore below gets a full deadline
+            wd.kick()
         if info is None:
             # A restarted-from-scratch supervisor loop (tools/chaosbench.py)
             # passes --resume unconditionally; an empty/missing checkpoint
@@ -299,6 +384,7 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         else:
             with tracer.span("checkpoint_restore"):
                 ts = restore_info(info, ts)
+            ckpt_pin = info.path
             meta = info.meta
             if meta.get("seed") is not None and meta["seed"] != cfg.seed:
                 print(f"resume: WARNING checkpoint was written with seed "
@@ -335,7 +421,7 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 # Mid-epoch resumes skip it: the epoch is not finished, and
                 # its epoch-end validation will run at the normal point.
                 ev = evaluate(cfg, strategy, ts, data, info.epoch, wd,
-                              prefetcher=prefetch)
+                              prefetcher=prefetch, guard=guard)
                 logger.valid_epoch(info.epoch, ev["loss"], ev["accuracy"],
                                    top5=ev.get("top5"))
 
@@ -422,10 +508,29 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 # separately as stall (data/prefetch.py), so the two
                 # decompose the epoch instead of double-counting it.
                 t_step = time.perf_counter_ns()
-                # fault hook: `kill` SIGKILLs at this step boundary — before
-                # the dispatch, so the last committed checkpoint is what a
-                # resume must recover from
+                # fault hook: `kill` SIGKILLs / `preempt` SIGTERMs at this
+                # step boundary — before the dispatch, so the last committed
+                # checkpoint is what a resume must recover from
                 faults.step_boundary(epoch, step)
+                if preempt is not None and preempt.requested:
+                    # graceful preemption: commit the state as of the LAST
+                    # COMPLETED step through the atomic protocol, then exit
+                    # with the distinct code (cli.py). The guard flushes
+                    # first so an anomalous pending step cannot be the
+                    # state that gets committed.
+                    guard.flush(epoch, step)
+                    _commit_preemption(cfg, ts, epoch, step, global_step,
+                                       logger, tracer, wd, ckpt_pin)
+                if faults.poison_grad(epoch, step):
+                    # `nan-grad`: a NaN lr rides into the backward through
+                    # the guard-armed engines' objective multiplier
+                    # (lr*0+1), poisoning the device-side gradients — the
+                    # on-device detection/skip path is what gets exercised.
+                    # Disarmed engines have no multiplier: the NaN scales
+                    # the update directly and params stay NaN, which is
+                    # exactly what a real NaN gradient does without a guard
+                    # (nan_policy then sees it at the next loss sync)
+                    step_lr = float("nan")
                 xla_window.step(global_step, lambda: (
                     float(metrics["loss"]) if metrics is not None else None))
                 ann = (jax.profiler.StepTraceAnnotation(
@@ -450,12 +555,18 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 if wd:
                     with tracer.span("step_sync"):
                         step_loss = float(metrics["loss"])  # transfer = sync
-                    check_finite(step_loss, epoch, step + 1, cfg.nan_policy)
+                    # per-step health first: a dropped/rewound update is the
+                    # step's primary event, the loss value its symptom
+                    guard.step_health(epoch, step + 1, metrics)
+                    guard.check_loss(step_loss, epoch, step + 1)
                     wd.kick()
                     host_loss_sum += step_loss
                 else:
                     loss_sum = (metrics["loss"] if loss_sum is None
                                 else loss_sum + metrics["loss"])
+                    # guard: chain (finite, grad_norm) lazily on device —
+                    # synced with the same interval transfer below
+                    guard.accumulate(metrics)
                 if log_step:
                     if wd:
                         # per-step syncs already landed (and checked) every
@@ -468,9 +579,10 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                         # step — only the watchdog's per-step sync can)
                         with tracer.span("interval_sync"):
                             loss = float(loss_sum) / interval_steps
-                        check_finite(loss, epoch, step + 1, cfg.nan_policy,
-                                     where=f"in epoch {epoch} interval "
-                                           f"ending step {step + 1}")
+                        guard.check_loss(loss, epoch, step + 1,
+                                         where=f"in epoch {epoch} interval "
+                                               f"ending step {step + 1}")
+                        guard.flush(epoch, step + 1)
                     loss_sum, host_loss_sum, interval_steps = None, 0.0, 0
                     now = time.perf_counter()
                     logger.train_interval(
@@ -491,15 +603,20 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                         and step != steps - 1):  # epoch-end save covers last
                     from ddlbench_tpu.train.checkpoint import save_checkpoint
 
+                    # a pending anomaly must apply its policy BEFORE the
+                    # commit — under rewind/abort the live state may be
+                    # poisoned, and a poisoned commit would become the
+                    # rewind target itself
+                    guard.flush(epoch, step + 1)
                     if wd:
                         wd.kick()  # the save gets a full deadline
                     with tracer.span("checkpoint_save", epoch=epoch,
                                      step=step):
-                        save_checkpoint(
+                        ckpt_pin = save_checkpoint(
                             cfg.checkpoint_dir, epoch, ts, step=step,
                             global_step=global_step,
                             logger_state=logger.state_dict(), seed=cfg.seed,
-                            keep=cfg.keep_checkpoints)
+                            keep=cfg.keep_checkpoints, pin=ckpt_pin)
                     if wd:
                         wd.kick()
         finally:
@@ -516,7 +633,7 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
         # Validation epoch (test_epoch parity, mnist_pytorch.py:102-133).
         with tracer.span("eval_epoch", epoch=epoch):
             val = evaluate(cfg, strategy, ts, data, epoch, wd,
-                           prefetcher=prefetch)
+                           prefetcher=prefetch, guard=guard)
         logger.valid_epoch(epoch, val["loss"], val["accuracy"],
                            top5=val.get("top5"))
         summary_acc = val["accuracy"]
@@ -527,22 +644,72 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             if wd:
                 wd.kick()  # the save itself gets a full deadline
             with tracer.span("checkpoint_save", epoch=epoch):
-                save_checkpoint(cfg.checkpoint_dir, epoch, ts,
-                                global_step=global_step,
-                                logger_state=logger.state_dict(),
-                                seed=cfg.seed, keep=cfg.keep_checkpoints)
+                ckpt_pin = save_checkpoint(
+                    cfg.checkpoint_dir, epoch, ts,
+                    global_step=global_step,
+                    logger_state=logger.state_dict(),
+                    seed=cfg.seed, keep=cfg.keep_checkpoints, pin=ckpt_pin)
             if wd:
                 wd.kick()
 
     xla_window.close()  # a window that outlived the run still gets flushed
     result = logger.summary(summary_acc, step_time=stats.run_summary())
+    if guard.active:
+        # anomalies absorbed / skipped / rewound / backed off — the
+        # robustness half of the benchmark result (chaosbench aggregates
+        # the per-event "guard:" lines across attempts too)
+        result["guard"] = guard.summary()
     result["train_state"] = ts
     return result
 
 
+def _commit_preemption(cfg: RunConfig, ts, epoch: int, step: int,
+                       global_step: int, logger: MetricLogger, tracer, wd,
+                       pin: Optional[str]) -> None:
+    """Graceful preemption at the (epoch, step) boundary: commit the state
+    as of the last COMPLETED step through the atomic protocol, then raise
+    :class:`GracefulPreemption` (cli.py maps it to PREEMPT_EXIT_CODE)."""
+    from ddlbench_tpu.train.checkpoint import checkpoint_name, save_checkpoint
+
+    # state at this boundary = end of step-1 (or the previous epoch's end
+    # when preempted before the epoch's first dispatch)
+    ck_epoch, ck_step = (epoch, step - 1) if step > 0 else (epoch - 1, None)
+    if pin and os.path.basename(pin) == checkpoint_name(ck_epoch, ck_step) \
+            and os.path.isdir(pin):
+        # zero steps completed since the pinned commit (preempted right
+        # after a periodic save, or at the first boundary after a resume):
+        # re-saving would rmtree-and-rewrite the only restorable state —
+        # a second signal mid-save would destroy it for nothing
+        where = (f"epoch {ck_epoch} step {ck_step}" if ck_step is not None
+                 else f"epoch {ck_epoch}")
+        # prefix must stay "preempt: checkpoint committed" — the chaosbench
+        # supervisor matches it to classify the exit as graceful
+        print(f"preempt: checkpoint committed at {where} (reusing the "
+              f"existing commit)", flush=True)
+        raise GracefulPreemption(
+            f"preemption checkpoint committed at {where}",
+            checkpoint_path=pin)
+    if wd:
+        wd.kick()  # the save gets a full deadline
+    span_args = {"epoch": ck_epoch}
+    if ck_step is not None:
+        span_args["step"] = ck_step
+    with tracer.span("checkpoint_save", **span_args):
+        path = save_checkpoint(
+            cfg.checkpoint_dir, ck_epoch, ts, step=ck_step,
+            global_step=global_step, logger_state=logger.state_dict(),
+            seed=cfg.seed, keep=cfg.keep_checkpoints, pin=pin)
+    where = (f"epoch {ck_epoch} step {ck_step}" if ck_step is not None
+             else f"epoch {ck_epoch}")
+    print(f"preempt: checkpoint committed at {where}", flush=True)
+    raise GracefulPreemption(
+        f"preemption checkpoint committed at {where}", checkpoint_path=path)
+
+
 def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
              wd: Optional[HangWatchdog] = None,
-             prefetcher: Optional[Prefetcher] = None) -> Dict[str, float]:
+             prefetcher: Optional[Prefetcher] = None,
+             guard: Optional[StabilityGuard] = None) -> Dict[str, float]:
     """One validation epoch with on-device metric accumulation.
 
     loss*count / correct / correct5 / count are summed as lazy jax.Arrays —
@@ -573,7 +740,11 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
                 # armed watchdog: per-step transfer = sync, so a device hang
                 # mid-eval dies within one deadline (and a non-finite eval
                 # loss is attributed to its actual step)
-                check_finite(float(m["loss"]), epoch, steps, cfg.nan_policy)
+                step_loss = float(m["loss"])
+                if guard is not None:  # unified policy surface
+                    guard.check_loss(step_loss, epoch, steps, train=False)
+                else:
+                    check_finite(step_loss, epoch, steps, cfg.nan_policy)
                 wd.kick()
             loss_sum = acc(loss_sum, m["loss"] * m["count"])
             correct_sum = acc(correct_sum, m["correct"])
@@ -592,9 +763,15 @@ def evaluate(cfg: RunConfig, strategy, ts, data, epoch: int,
     total_count = int(count_sum) if steps else 0
     loss = float(loss_sum) / max(1, total_count) if steps else 0.0
     # detection happens at the one epoch-end transfer, so no specific step
-    # can honestly be blamed
-    check_finite(loss, epoch, steps, cfg.nan_policy,
-                 where=f"in validation epoch {epoch} (epoch-end check)")
+    # can honestly be blamed. The guard is the one policy surface for this
+    # site too (skip/rewind degrade to warn: eval has no update to drop).
+    if guard is not None:
+        guard.check_loss(loss, epoch, steps, train=False,
+                         where=f"in validation epoch {epoch} "
+                               f"(epoch-end check)")
+    else:
+        check_finite(loss, epoch, steps, cfg.nan_policy,
+                     where=f"in validation epoch {epoch} (epoch-end check)")
     if wd:
         wd.kick()  # the epoch-end transfer above proved device progress
     return {

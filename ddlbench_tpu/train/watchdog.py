@@ -97,7 +97,13 @@ class HangWatchdog:
         )
 
     def start(self) -> "HangWatchdog":
-        self._thread.start()
+        """Idempotent: the guard's rewind path re-enters the run loop with
+        the same watchdog, and threading.Thread.start() raises on reuse."""
+        if not self._thread.is_alive() and not self._stop.is_set():
+            try:
+                self._thread.start()
+            except RuntimeError:  # already started and since finished
+                pass
         return self
 
     def kick(self) -> None:

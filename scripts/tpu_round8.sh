@@ -1,0 +1,45 @@
+#!/usr/bin/env bash
+# Round-8 opportunistic TPU collector. Carries the still-unlanded round-4..7
+# queue (same task names, so any .ok marker earned in an earlier window
+# sticks), then adds the stability round: a chaosbench run mixing graceful
+# SIGTERM preemptions with an in-run nan-grad anomaly under
+# --anomaly-policy skip — measuring, on the chip, what the CPU tier-1 can
+# only pin functionally: graceful-preemption MTTR vs SIGKILL MTTR, steps
+# lost per disruption, guard overhead at real step times (<1% expected,
+# PERF.md), and that the recovered trajectory still matches bit-for-bit.
+#
+# Usage: scripts/tpu_round8.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task decodebench_r4        python -m ddlbench_tpu.tools.decodebench
+add_task roofline_r4           python -m ddlbench_tpu.tools.rooflinebench --batch-size 256
+add_task attnsweep_b16_r4      python -m ddlbench_tpu.tools.attnbench --seq-lens 128,256,384,512,640,768,1024,2048 --repeats 5
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task accparity_bn_tpu_r5   python -m ddlbench_tpu.tools.accparity --engines single --arch resnet18 --epochs 12 --lr 0.02 --platform tpu
+add_task lmbench_synthtext_r4  python -m ddlbench_tpu.tools.lmbench -b synthtext --configs flash+fused,flash+logits,xla+fused,xla+logits,auto
+add_task scalebench_dp_r6        python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3
+add_task scalebench_dpshard_r6   python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update
+add_task scalebench_dpshard_bf16_r6 python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update --allreduce-dtype bf16
+add_task bench_dp_r6             python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64
+add_task bench_dpshard_r6        python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update
+add_task bench_dpshard_bf16_r6   python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --allreduce-dtype bf16
+add_task accparity_dpshard_r6    python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-shard,dp-bf16,dp-shard-bf16
+add_task chaosbench_r7 python -m ddlbench_tpu.tools.chaosbench --kills 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r7_work --keep-workdir --json perf_runs/chaosbench_r7.json
+
+# -- round-8: stability guard under preemption + anomalies on the chip ------
+# 1 SIGKILL + 2 graceful preemptions interleaved over 3 epochs x 30 steps,
+# with a deterministic nan-grad anomaly absorbed in-step by the skip policy
+# (the guard's on-device detection riding the real metrics path). The JSON
+# report separates mttr_s (kills) from mttr_preempt_s and aggregates the
+# children's guard event lines; trajectory_match pins bitwise recovery.
+add_task chaosbench_stability_r8 python -m ddlbench_tpu.tools.chaosbench --kills 1 --preempts 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r8_work --keep-workdir --json perf_runs/chaosbench_r8.json -- --anomaly-policy skip --inject nan-grad@2:7
+# guard-overhead A/B at real step times: armed-but-quiet vs disarmed (the
+# step p50/p95 land in each run's JSONL summary record; PERF.md expects <1%)
+add_task guard_overhead_off_r8 python -m ddlbench_tpu.cli -b mnist -m resnet18 --batch-size 32 -e 1 --steps-per-epoch 200 --jsonl perf_runs/guard_off_r8.jsonl
+add_task guard_overhead_on_r8 python -m ddlbench_tpu.cli -b mnist -m resnet18 --batch-size 32 -e 1 --steps-per-epoch 200 --anomaly-policy skip --jsonl perf_runs/guard_on_r8.jsonl
+
+window_loop "${1:-11}"

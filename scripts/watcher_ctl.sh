@@ -18,9 +18,9 @@
 # Usage: bash scripts/watcher_ctl.sh [max_hours]
 set -u
 cd "$(dirname "$0")/.."
-WATCHER=scripts/tpu_round7.sh
-PIDFILE=perf_runs/tpu_round7.pid
-LOG=perf_runs/tpu_round7.log
+WATCHER=scripts/tpu_round8.sh
+PIDFILE=perf_runs/tpu_round8.pid
+LOG=perf_runs/tpu_round8.log
 watcher_group() {  # pid -> 0 if the pid's GROUP still runs watcher work
   # The leader may be dead (OOM-kill) while an in-flight benchmark child
   # survives in its process group — check every live group member's

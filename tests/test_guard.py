@@ -1,0 +1,583 @@
+"""Training stability guard (ISSUE 5 tentpole).
+
+Four layers of pinning, all CPU-only and tier-1-fast (``guard`` marker):
+
+* policy/flag plumbing — RunConfig validation of the new surface, the
+  deprecated ``--nan-policy`` alias, the dynamic-loss-scale state machine;
+* the bitwise claims — a ``nan-grad@E:S`` injection under
+  ``--anomaly-policy skip`` ends with params AND optimizer state identical
+  to a run that never saw step S's update (single, dp, dp
+  ``--dp-shard-update``); ``rewind`` re-converges onto the uninterrupted
+  JSONL trajectory; dynamic loss scaling is bitwise-neutral for f32 and
+  overflow-free for a bf16 run;
+* graceful preemption — SIGTERM (the ``preempt`` fault) produces a
+  committed, ``latest_valid``-verified checkpoint, the distinct exit code
+  end-to-end through the CLI, and separate graceful accounting in a
+  chaosbench invocation;
+* retention/restore edges — the GC pin keeps the current rewind target
+  restorable when a newer corrupt checkpoint crowds the window, plus the
+  previously log-only seed-mismatch and legacy-layout resume paths.
+"""
+
+import os
+import subprocess
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.guard
+
+from ddlbench_tpu import faults
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.guard import (GracefulPreemption, PREEMPT_EXIT_CODE,
+                                DeviceGuard, LOSS_SCALE_GROWTH_INTERVAL,
+                                LOSS_SCALE_INIT)
+from ddlbench_tpu.train import checkpoint as ck
+from ddlbench_tpu.train.loop import run_benchmark
+from ddlbench_tpu.train.watchdog import TrainingFailure
+
+
+@pytest.fixture(autouse=True)
+def _disarm():
+    yield
+    faults.disarm()
+
+
+def _cfg(ck_dir=None, **kw):
+    base = dict(benchmark="mnist", strategy="single", arch="lenet",
+                compute_dtype="float32", steps_per_epoch=4, log_interval=1,
+                batch_size=8, epochs=1, checkpoint_dir=ck_dir)
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def _state_vec(ts):
+    """Params AND optimizer state, flattened — the full bitwise surface
+    (the loss-scale entry is excluded: it is guard state, not optimizer
+    state, and legitimately moves on skipped steps)."""
+    opt = {k: v for k, v in ts.opt.items() if k != "_guard"} \
+        if isinstance(ts.opt, dict) else ts.opt
+    return np.concatenate([np.asarray(l).ravel()
+                           for l in jax.tree.leaves((ts.params, opt))])
+
+
+# ---- policy/flag plumbing -------------------------------------------------
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="anomaly_policy"):
+        _cfg(anomaly_policy="explode").validate()
+    with pytest.raises(ValueError, match="rewind"):
+        _cfg(anomaly_policy="rewind").validate()  # needs checkpoint_dir
+    _cfg("/tmp/ck", anomaly_policy="rewind").validate()
+    with pytest.raises(ValueError, match="anomaly_budget"):
+        _cfg(anomaly_policy="skip", anomaly_budget=0).validate()
+    with pytest.raises(ValueError, match="loss_scale"):
+        _cfg(loss_scale="huge").validate()
+    with pytest.raises(ValueError, match="loss_scale"):
+        _cfg(loss_scale=-2.0).validate()
+    assert _cfg(loss_scale="65536").resolved_loss_scale() == 65536.0
+    assert _cfg(loss_scale="dynamic").resolved_loss_scale() == "dynamic"
+    with pytest.raises(ValueError, match="skip"):
+        _cfg(strategy="fsdp", num_devices=2, anomaly_policy="skip",
+             batch_size=8).validate()
+    with pytest.raises(ValueError, match="loss_scale"):
+        _cfg(strategy="pipedream", num_devices=2, batch_size=None,
+             loss_scale="dynamic").validate()
+    # the ONE policy surface: explicit flag wins, else the legacy alias
+    assert _cfg(nan_policy="warn").resolved_anomaly_policy() == "warn"
+    assert _cfg(nan_policy="warn",
+                anomaly_policy="skip").resolved_anomaly_policy() == "skip"
+    assert not _cfg().guard_armed()
+    assert _cfg(anomaly_policy="abort").guard_armed()
+    assert _cfg(loss_scale="dynamic").guard_armed()
+
+
+def test_nan_policy_cli_alias_warns(capsys):
+    from ddlbench_tpu import cli
+
+    # --anomaly-budget 0 fails validation right after the deprecation
+    # warning, so the test never pays for a training run
+    with pytest.raises(ValueError, match="anomaly_budget"):
+        cli.main(["--platform", "cpu", "--nan-policy", "warn",
+                  "--anomaly-budget", "0"])
+    assert "--nan-policy is deprecated" in capsys.readouterr().err
+    # the alias maps into the config (and the new flags ride along)
+    args = cli.build_parser().parse_args(
+        ["--nan-policy", "warn", "--loss-scale", "dynamic",
+         "--anomaly-budget", "7"])
+    cfg = cli.config_from_args(args)
+    assert cfg.nan_policy == "warn" and cfg.anomaly_policy is None
+    assert cfg.resolved_anomaly_policy() == "warn"
+    assert cfg.loss_scale == "dynamic" and cfg.anomaly_budget == 7
+
+
+def test_dynamic_scaler_state_machine():
+    g = DeviceGuard(_cfg(loss_scale="dynamic"))
+    st = g.opt_entry()
+    assert float(st["scale"]) == LOSS_SCALE_INIT
+    # overflow: backoff x1/2, clean streak resets
+    st2 = g.scaler_update(st, jnp.bool_(False))
+    assert float(st2["scale"]) == LOSS_SCALE_INIT / 2
+    assert int(st2["good"]) == 0
+    # clean step: counter advances, scale holds
+    st3 = g.scaler_update(st2, jnp.bool_(True))
+    assert float(st3["scale"]) == LOSS_SCALE_INIT / 2
+    assert int(st3["good"]) == 1
+    # growth after the full clean interval: scale x2, counter resets
+    st4 = {"scale": st3["scale"],
+           "good": jnp.int32(LOSS_SCALE_GROWTH_INTERVAL - 1)}
+    st5 = g.scaler_update(st4, jnp.bool_(True))
+    assert float(st5["scale"]) == LOSS_SCALE_INIT
+    assert int(st5["good"]) == 0
+
+
+def test_disarmed_engine_emits_no_guard_metrics():
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = _cfg()
+    strat = make_strategy(cfg)
+    ts = strat.init(jax.random.key(1))
+    from ddlbench_tpu.train.loop import _make_data
+
+    data = _make_data(cfg)
+    _, m = strat.train_step(ts, *strat.shard_batch(*data.batch(1, 0)),
+                            jnp.float32(0.01))
+    assert "finite" not in m and "grad_norm" not in m
+
+
+# ---- skip: bitwise in-step drop ------------------------------------------
+
+SKIP_ENGINES = [
+    ("single", dict()),
+    ("dp", dict(strategy="dp", num_devices=2)),
+    ("dp-shard", dict(strategy="dp", num_devices=2, dp_shard_update=True)),
+]
+
+
+@pytest.mark.parametrize("name,extra", SKIP_ENGINES,
+                         ids=[n for n, _ in SKIP_ENGINES])
+def test_skip_bitwise(name, extra):
+    """A nan-grad@1:2 injection under skip ends with params AND opt state
+    identical to a run that never saw step 2's update."""
+    from ddlbench_tpu.parallel.api import make_strategy
+    from ddlbench_tpu.train.loop import _make_data
+
+    res = run_benchmark(
+        _cfg(anomaly_policy="skip", inject=("nan-grad@1:2",), **extra),
+        warmup_steps=0)
+    assert res["guard"]["skipped_steps"] == 1
+
+    # reference: a PLAIN (guard-disarmed) engine replaying the identical
+    # (epoch, step)-addressed stream, with step 2's update simply absent
+    cfg = _cfg(**extra)
+    data = _make_data(cfg)
+    strat = make_strategy(cfg)
+    ts = strat.init(jax.random.key(cfg.seed))
+    lr = cfg.resolved_lr()
+    if cfg.strategy == "dp" and cfg.scale_lr_by_world:
+        lr *= strat.world_size  # loop parity (sgd linear scaling)
+    for step in range(cfg.steps_per_epoch):
+        if step == 2:
+            continue  # the update the skip policy dropped
+        batch = strat.shard_batch(*data.batch(1, step))
+        ts, _ = strat.train_step(ts, *batch, jnp.float32(lr))
+
+    np.testing.assert_array_equal(_state_vec(res["train_state"]),
+                                  _state_vec(ts))
+
+
+def test_skip_budget_escalates():
+    with pytest.raises(TrainingFailure, match="anomaly budget"):
+        run_benchmark(_cfg(anomaly_policy="skip", anomaly_budget=1,
+                           inject=("nan-grad@1:1", "nan-grad@1:2")),
+                      warmup_steps=0)
+    # warn is the explicit "keep going regardless": it reports the same
+    # anomalies but never budget-escalates (legacy nan-policy parity)
+    res = run_benchmark(_cfg(anomaly_policy="warn", anomaly_budget=1,
+                             inject=("nan-grad@1:1", "nan-grad@1:2")),
+                        warmup_steps=0)
+    assert res["guard"]["anomalies"] >= 2
+
+
+def test_skip_budget_ignores_isolated_anomalies_in_mixed_window():
+    """The device reports only the SUM of finite flags per flush window:
+    a mixed window proves clean steps interleave the bad ones, so isolated
+    anomalies under a coarse log interval must be absorbed (the per-step
+    path would absorb them), not counted as a consecutive streak."""
+    from ddlbench_tpu.guard import StabilityGuard
+
+    g = StabilityGuard(_cfg(anomaly_policy="skip", anomaly_budget=3))
+    # 4 bad steps inside a 100-step window: over budget if mislabeled
+    # consecutive, absorbed when the mix is respected
+    g._window(1, 100, 100, 96.0, 2.0)
+    assert g.counters["skipped_steps"] == 4
+    # a following FULLY-bad window accumulates onto the possible tail
+    # streak and does escalate
+    with pytest.raises(TrainingFailure, match="anomaly budget"):
+        g._window(1, 102, 2, 0.0, float("nan"))
+
+
+# ---- rewind: checkpoint restore + deterministic replay --------------------
+
+def test_rewind_reconverges_onto_uninterrupted_trajectory(tmp_path):
+    from ddlbench_tpu.tools.chaosbench import verify_trajectory
+    from ddlbench_tpu.train.metrics import MetricLogger
+
+    def jsonl_run(path, **kw):
+        cfg = _cfg(**kw)
+        logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=path)
+        try:
+            res = run_benchmark(cfg, logger=logger, warmup_steps=0)
+        finally:
+            logger.close()
+        return res
+
+    base = str(tmp_path / "base.jsonl")
+    res_u = jsonl_run(base)
+    chaos = str(tmp_path / "rewind.jsonl")
+    res_r = jsonl_run(chaos, checkpoint_dir=str(tmp_path / "ck"),
+                      checkpoint_every_steps=1, anomaly_policy="rewind",
+                      inject=("nan-grad@1:2",))
+    assert res_r["guard"]["rewinds"] == 1
+    match, mismatches = verify_trajectory(base, chaos)
+    assert match, mismatches
+    np.testing.assert_array_equal(_state_vec(res_r["train_state"]),
+                                  _state_vec(res_u["train_state"]))
+
+
+def test_rewind_with_retention_gc_interleaved(tmp_path):
+    """Step-granular checkpoints + keep=1 GC + a rewind in the same run:
+    the pin keeps the live rewind target restorable throughout."""
+    res = run_benchmark(
+        _cfg(str(tmp_path / "ck"), checkpoint_every_steps=1,
+             keep_checkpoints=1, anomaly_policy="rewind",
+             inject=("nan-grad@1:2",)),
+        warmup_steps=0)
+    assert res["guard"]["rewinds"] == 1
+    assert ck.latest_valid(str(tmp_path / "ck")) is not None
+
+
+def test_rewind_with_armed_watchdog_survives(tmp_path):
+    """The rewind path re-enters the run loop with the same HangWatchdog;
+    Thread.start() raises on reuse, so start must be idempotent or every
+    recoverable anomaly becomes a hard crash when both are combined."""
+    res = run_benchmark(
+        _cfg(str(tmp_path / "ck"), checkpoint_every_steps=1,
+             anomaly_policy="rewind", hang_timeout_s=300,
+             inject=("nan-grad@1:2",)),
+        warmup_steps=0)
+    assert res["guard"]["rewinds"] == 1
+
+
+def test_spike_detector_warns_when_armed_implicitly():
+    """--loss-scale alone arms the guard with the legacy nan_policy
+    default 'abort'; the HEURISTIC spike detector must degrade to warn
+    there — a finite fluctuation may not kill a run that only asked for
+    loss scaling."""
+    from ddlbench_tpu.guard import StabilityGuard
+
+    faults.arm(["grad-spike@1:0"])
+    g = StabilityGuard(_cfg(loss_scale="dynamic"))
+    assert g.policy == "abort" and not g.explicit
+    g._window(1, 1, 1, 1.0, 2.0)  # injected spike: warns, no raise
+    assert g.counters["spikes"] == 1
+    # explicitly chosen abort keeps its teeth
+    faults.disarm()  # re-arming an identical spec list is a no-op
+    faults.arm(["grad-spike@1:0"])
+    g2 = StabilityGuard(_cfg(anomaly_policy="abort"))
+    with pytest.raises(TrainingFailure, match="grad-norm spike"):
+        g2._window(1, 1, 1, 1.0, 2.0)
+
+
+def test_rewind_without_committed_checkpoint_escalates(tmp_path):
+    """An anomaly before the first commit has no rewind target: the run
+    must fail crisply, not silently restart with fresh params through the
+    empty-dir resume path."""
+    with pytest.raises(TrainingFailure, match="no committed checkpoint"):
+        run_benchmark(
+            _cfg(str(tmp_path / "ck"), anomaly_policy="rewind",
+                 inject=("nan-grad@1:2",)),
+            warmup_steps=0)
+
+
+# ---- grad-norm spike detector --------------------------------------------
+
+def test_grad_spike_policies():
+    spike = dict(steps_per_epoch=8, inject=("grad-spike@1:6",))
+    with pytest.raises(TrainingFailure, match="grad-norm spike"):
+        run_benchmark(_cfg(anomaly_policy="abort", **spike), warmup_steps=0)
+    res = run_benchmark(_cfg(anomaly_policy="warn", **spike),
+                        warmup_steps=0)
+    assert res["guard"]["spikes"] == 1
+
+
+def test_grad_spike_injection_fires_during_ewma_warmup():
+    """An injected spike landing before the EWMA has warmed up must still
+    fire (the fault contract: the same spec always fires at the same
+    point), not be silently consumed by the warmup guard."""
+    res = run_benchmark(_cfg(anomaly_policy="warn",
+                             inject=("grad-spike@1:0",)), warmup_steps=0)
+    assert res["guard"]["spikes"] == 1
+
+
+def test_grad_spike_injection_fires_in_mixed_window():
+    """A spike spec targeting a window that ALSO contains a non-finite
+    step must still fire (its step never falls in a later window, so
+    skipping it would strand the spec unfired forever)."""
+    from ddlbench_tpu.guard import StabilityGuard
+
+    faults.arm(["grad-spike@1:2"])
+    g = StabilityGuard(_cfg(anomaly_policy="warn"))
+    g._window(1, 4, 4, 3.0, float("nan"))  # steps 1-4, one bad step
+    assert g.counters["spikes"] == 1
+    assert not any(not s.fired for s in faults.armed_specs())
+
+
+def test_grad_spike_injection_fires_on_zero_gradient_window():
+    """An injected spike over a zero-gradient window (0 x factor == 0
+    never clears the threshold) must still fire: the spec was already
+    consumed, and a consumed-but-suppressed spec can never fire again."""
+    from ddlbench_tpu.guard import StabilityGuard
+
+    faults.arm(["grad-spike@1:0"])
+    g = StabilityGuard(_cfg(anomaly_policy="warn"))
+    g._window(1, 1, 1, 1.0, 0.0)  # clean step, grad norm exactly 0
+    assert g.counters["spikes"] == 1
+    assert not any(not s.fired for s in faults.armed_specs())
+
+
+def test_no_double_count_with_device_detection():
+    """A genuinely non-finite step is seen by BOTH the device window and
+    the host loss check; only the window may book it, or every real
+    anomaly counts twice and the effective budget halves."""
+    from ddlbench_tpu.guard import StabilityGuard
+
+    g = StabilityGuard(_cfg(anomaly_policy="skip", anomaly_budget=3))
+    for step in (1, 2):
+        g.step_health(1, step, {"finite": 0.0, "grad_norm": float("nan")})
+        g.check_loss(float("nan"), 1, step)
+    assert g.counters["anomalies"] == 2
+    assert g._consecutive == 2
+    # without device flags (legacy configs, or strategies whose engines
+    # carry no guard wiring) the loss check is the only bookkeeper
+    g2 = StabilityGuard(_cfg(nan_policy="warn"))
+    g2.check_loss(float("nan"), 1, 1)
+    assert g2.counters["anomalies"] == 1
+    g3 = StabilityGuard(_cfg(anomaly_policy="warn"))  # armed, no metrics
+    g3.check_loss(float("nan"), 1, 1)
+    assert g3.counters["anomalies"] == 1
+
+
+# ---- dynamic loss scaling -------------------------------------------------
+
+@pytest.mark.parametrize("extra", [dict(), dict(strategy="dp",
+                                               num_devices=2,
+                                               dp_shard_update=True)],
+                         ids=["single", "dp-shard"])
+def test_dynamic_loss_scale_bitwise_neutral_f32(extra):
+    res_p = run_benchmark(_cfg(**extra), warmup_steps=0)
+    res_s = run_benchmark(_cfg(loss_scale="dynamic", **extra),
+                          warmup_steps=0)
+    # power-of-two scaling commutes exactly with IEEE rounding
+    np.testing.assert_array_equal(_state_vec(res_p["train_state"]),
+                                  _state_vec(res_s["train_state"]))
+    assert res_s["valid_accuracy"] == res_p["valid_accuracy"]
+    assert res_s["guard"]["loss_scale_backoffs"] == 0
+
+
+def test_dynamic_loss_scale_bf16_overflow_free():
+    import math
+
+    res = run_benchmark(_cfg(compute_dtype="bfloat16", steps_per_epoch=6,
+                             loss_scale="dynamic"), warmup_steps=0)
+    assert math.isfinite(res["valid_history"][-1]["loss"])
+    assert res["guard"]["loss_scale_backoffs"] == 0
+    assert res["guard"]["loss_scale"] >= 1.0
+
+
+# ---- graceful preemption --------------------------------------------------
+
+def test_preempt_commits_and_resume_is_bitwise(tmp_path):
+    d = str(tmp_path / "ck")
+    with pytest.raises(GracefulPreemption):
+        run_benchmark(_cfg(d, inject=("preempt@1:2",)), warmup_steps=0)
+    info = ck.latest_valid(d)
+    assert info is not None and (info.epoch, info.step) == (1, 1)
+    assert ck.verify_checkpoint(info.path) is None  # manifest-clean
+    # resume completes the run and lands bitwise on the uninterrupted state
+    res_u = run_benchmark(_cfg(), warmup_steps=0)
+    res_r = run_benchmark(_cfg(d, resume=True), warmup_steps=0)
+    np.testing.assert_array_equal(_state_vec(res_r["train_state"]),
+                                  _state_vec(res_u["train_state"]))
+
+
+def test_preempt_zero_steps_after_resume_reuses_committed(tmp_path, capsys):
+    """Preemption at the first boundary after a resume (zero steps
+    completed since the pinned commit) must NOT re-save: the rmtree-and-
+    rewrite of the same name would put the only restorable state at risk
+    for nothing."""
+    d = str(tmp_path / "ck")
+    with pytest.raises(GracefulPreemption):
+        run_benchmark(_cfg(d, inject=("preempt@1:2",)), warmup_steps=0)
+    info = ck.latest_valid(d)
+    assert (info.epoch, info.step) == (1, 1)
+    before = os.stat(info.path).st_mtime_ns
+    with pytest.raises(GracefulPreemption) as exc:
+        run_benchmark(_cfg(d, resume=True, inject=("preempt@1:2",)),
+                      warmup_steps=0)
+    assert exc.value.checkpoint_path == info.path
+    assert os.stat(info.path).st_mtime_ns == before  # untouched, not rewritten
+    assert "reusing the existing commit" in capsys.readouterr().out
+
+
+def test_guard_preempt_import_is_jax_free():
+    """The chaosbench supervisor imports guard.preempt for
+    PREEMPT_EXIT_CODE; that import must never pull the jax-importing
+    modules (train.metrics, guard.device, guard.policy) along."""
+    code = ("import sys; import ddlbench_tpu.guard.preempt; "
+            "bad = [m for m in ('ddlbench_tpu.train.metrics', "
+            "'ddlbench_tpu.guard.device', 'ddlbench_tpu.guard.policy') "
+            "if m in sys.modules]; "
+            "assert not bad, bad")
+    subprocess.run([sys.executable, "-c", code], check=True, timeout=120)
+
+
+def test_preempt_cli_exit_code(tmp_path):
+    proc = subprocess.run(
+        [sys.executable, "-m", "ddlbench_tpu.cli", "--platform", "cpu",
+         "-b", "mnist", "-m", "lenet", "-e", "1", "--steps-per-epoch", "3",
+         "--batch-size", "8", "--dtype", "float32", "--log-interval", "1",
+         "--checkpoint-dir", str(tmp_path / "ck"),
+         "--inject", "preempt@1:1"],
+        capture_output=True, text=True, timeout=300)
+    assert proc.returncode == PREEMPT_EXIT_CODE, proc.stdout + proc.stderr
+    assert "preempt: checkpoint committed" in proc.stdout
+
+
+def test_chaosbench_counts_graceful_exits_separately(tmp_path):
+    from ddlbench_tpu.tools import chaosbench
+
+    args = chaosbench._parse_args([
+        "--kills", "0", "--preempts", "1", "--platform", "cpu",
+        "-b", "mnist", "-m", "lenet", "--steps-per-epoch", "4",
+        "-e", "2", "--batch-size", "8", "--log-interval", "1",
+        "--checkpoint-every-steps", "2",
+        "--workdir", str(tmp_path / "w"), "--keep-workdir",
+        "--skip-verify"])
+    report = chaosbench.run_chaos(args)
+    assert report["completed"], report
+    assert report["graceful_exits"] == 1 and report["preempts"] == 1
+    assert report["kills"] == 0 and report["mttr_s"] == []
+    assert report["mttr_preempt_s_mean"] > 0
+    assert report["steps_lost_per_kill"] == []  # graceful = zero loss
+
+
+def test_chaosbench_budget_exhausted_exits_nonzero(tmp_path):
+    from ddlbench_tpu.tools import chaosbench
+
+    # a child that dies instantly on an unknown flag: the supervisor must
+    # burn its restart budget and exit NONZERO, never spin or report success
+    rc = chaosbench.main([
+        "--kills", "1", "--restart-budget", "1", "--platform", "cpu",
+        "--backoff-base-s", "0.01", "--backoff-max-s", "0.02",
+        "--workdir", str(tmp_path / "w"), "--keep-workdir", "--skip-verify",
+        "--", "--definitely-not-a-flag"])
+    assert rc == 1  # the nonzero exit IS the supervisor contract under test
+
+
+def test_chaosbench_guard_event_scraping():
+    from ddlbench_tpu.tools.chaosbench import guard_events
+
+    lines = [
+        "guard: dropped 2 non-finite update(s) in epoch 1 steps 3-4 (skip)",
+        "guard: loss-scale backoff x1 at epoch 2 step 1 (scale now 16384)",
+        "guard: grad-norm spike (1.0e+03 > 10x EWMA 2.0e+00) at epoch 1 step 5",
+        "guard: rewinding to the last valid checkpoint (non-finite ...)",
+        "guard: WARNING non-finite gradients (3 step(s)) at epoch 2 step 7",
+        "train | 1/1 epoch (25%) | ...",
+    ]
+    ev = guard_events(lines)
+    assert ev["steps_skipped"] == 2
+    assert ev["loss_scale_backoffs"] == 1
+    assert ev["spikes"] == 1 and ev["rewinds"] == 1
+    assert ev["warned_steps"] == 3
+    assert ev["anomalies_detected"] == 8
+
+
+def test_event_schedule_interleaves_kinds():
+    from ddlbench_tpu.tools.chaosbench import event_schedule
+
+    ev = event_schedule(2, 1, 3, 10)
+    assert [k for k, _, _ in ev] == ["kill", "preempt", "kill"]
+    assert event_schedule(2, 1, 3, 10) == ev  # deterministic
+    assert all(k == "preempt" for k, _, _ in event_schedule(0, 2, 2, 6))
+
+
+# ---- retention pin: the rewind target survives GC -------------------------
+
+def _save_state():
+    return {"w": jnp.arange(16.0).reshape(4, 4), "b": jnp.ones((3,))}
+
+
+def test_gc_pin_keeps_rewind_target(tmp_path, capsys):
+    """A newer checkpoint corrupted AFTER its commit (marker present,
+    manifest broken) outranks everything by order; with keep=1, only the
+    pin keeps the one known-restorable checkpoint in the window."""
+    state = _save_state()
+
+    # control: without the pin the valid target is collected and NOTHING
+    # restorable remains — the regression this feature fixes
+    d0 = str(tmp_path / "unpinned")
+    ck.save_checkpoint(d0, 1, state, step=1, seed=1)
+    faults.corrupt_checkpoint(ck.save_checkpoint(d0, 1, state, step=3,
+                                                 seed=1))
+    ck.save_checkpoint(d0, 1, state, step=2, seed=1, keep=1)
+    capsys.readouterr()
+    assert ck.latest_valid(d0) is None
+
+    # pinned: the loop pins its restore target (epoch_1_step_1) while
+    # committing the replay's step checkpoints through the same keep=1 GC
+    d1 = str(tmp_path / "pinned")
+    ck.save_checkpoint(d1, 1, state, step=1, seed=1)
+    faults.corrupt_checkpoint(ck.save_checkpoint(d1, 1, state, step=3,
+                                                 seed=1))
+    capsys.readouterr()
+    info = ck.latest_valid(d1)
+    assert (info.epoch, info.step) == (1, 1)  # fell back past the damage
+    ck.save_checkpoint(d1, 1, state, step=2, seed=1, keep=1, pin=info.path)
+    survivor = ck.latest_valid(d1)
+    assert survivor is not None and (survivor.epoch, survivor.step) == (1, 1)
+
+
+# ---- previously log-only resume paths ------------------------------------
+
+def test_resume_seed_mismatch_warns(tmp_path, capsys):
+    d = str(tmp_path / "ck")
+    run_benchmark(_cfg(d), warmup_steps=0)
+    capsys.readouterr()
+    res = run_benchmark(_cfg(d, epochs=2, resume=True, seed=2),
+                        warmup_steps=0)
+    out = capsys.readouterr().out
+    assert "WARNING checkpoint was written with seed 1" in out
+    assert "run uses seed 2" in out
+    assert "samples_per_sec" in res  # the run continues regardless
+
+
+def test_legacy_layout_restores_unverified_through_loop(tmp_path, capsys):
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    d = str(tmp_path)
+    cfg = _cfg(epochs=2)
+    ts = make_strategy(cfg).init(jax.random.key(cfg.seed))
+    # pre-protocol layout: orbax state directly under epoch_1, no marker
+    ckptr = ck._checkpointer()
+    ckptr.save(os.path.join(d, "epoch_1"), ts, force=True)
+    ckptr.wait_until_finished()
+    res = run_benchmark(_cfg(d, epochs=2, resume=True), warmup_steps=0)
+    out = capsys.readouterr().out
+    assert "predates the commit protocol" in out
+    assert "resumed from" in out and "epoch 1" in out
+    assert [h["epoch"] for h in res["valid_history"]] == [1, 2]
