@@ -89,10 +89,20 @@ def build_parser() -> argparse.ArgumentParser:
                         "slice of packed params + optimizer state per chip, "
                         "all-gather updated params")
     p.add_argument("--allreduce-dtype", default="f32",
-                   choices=("f32", "float32", "bf16", "bfloat16"),
+                   choices=("f32", "float32", "bf16", "bfloat16", "int8"),
                    help="wire dtype for dp's gradient collectives "
                         "(bf16 = EQuARX-style compressed allreduce, half "
-                        "the gradient wire bytes)")
+                        "the gradient wire bytes; int8 = per-bucket absmax "
+                        "scaling + stochastic rounding, quarter the bytes, "
+                        "deterministic under --seed)")
+    p.add_argument("--comm-buckets", type=int, default=1, metavar="K",
+                   help="dp comm/compute overlap: split the packed flat "
+                        "gradient into K layer-aligned buckets, each riding "
+                        "its own reduce-scatter as the backward unwinds; "
+                        "with --dp-shard-update the params stay sharded "
+                        "between steps and the forward all-gathers each "
+                        "bucket just-in-time (parallel/dp.py overlapped "
+                        "engine). 1 = the monolithic collective program")
     p.add_argument("--warmup-epochs", type=int, default=0,
                    help="gradual lr warmup epochs (Horovod ImageNet parity: "
                         "base lr -> base*world over this many epochs)")
@@ -236,6 +246,7 @@ def config_from_args(args) -> RunConfig:
         shard_opt_state=args.shard_opt_state,
         dp_shard_update=args.dp_shard_update,
         allreduce_dtype=args.allreduce_dtype,
+        comm_buckets=args.comm_buckets,
         warmup_epochs=args.warmup_epochs,
         moe_aux_weight=args.moe_aux_weight,
         moe_capacity_factor=args.moe_capacity_factor,
@@ -280,6 +291,12 @@ def main(argv=None) -> int:
               f"{args.nan_policy}{tail}", file=sys.stderr, flush=True)
 
     apply_platform(args.platform)
+    if args.comm_buckets > 1:
+        # async-collective overlap flags must land in XLA_FLAGS before the
+        # first backend touch; no-op on cpu-pinned runs
+        from ddlbench_tpu.distributed import apply_comm_flags
+
+        apply_comm_flags(args.platform)
 
     if args.inject:
         # armed BEFORE initialize() so slow-host can hit the multihost init

@@ -219,12 +219,23 @@ class RunConfig:
     # parallel/dp.py DPShardedEngine.
     dp_shard_update: bool = False
     # Wire dtype for dp's explicit gradient collectives (EQuARX-style
-    # compressed allreduce): "float32" (exact; the default) or "bfloat16"
-    # (halves gradient wire bytes; accuracy parity gated by the digits
-    # matrix — tools/accparity.py dp-bf16 engines). Values "f32"/"bf16"
-    # normalize. Any non-f32 setting routes dp through the explicit
-    # shard_map collective engine even without dp_shard_update.
+    # compressed allreduce): "float32" (exact; the default), "bfloat16"
+    # (halves gradient wire bytes), or "int8" (quarter wire bytes:
+    # per-bucket absmax scaling + stochastic rounding on the gradient
+    # partials, deterministic under the run seed; accuracy parity gated by
+    # the digits matrix — tools/accparity.py dp-bf16/dp-int8 engines).
+    # Values "f32"/"bf16" normalize. Any non-f32 setting routes dp through
+    # the explicit shard_map collective engine even without dp_shard_update.
     allreduce_dtype: str = "float32"
+    # Comm/compute overlap for the explicit dp engine: split the packed
+    # flat gradient into this many contiguous, layer-aligned buckets, each
+    # riding its own reduce-scatter as the backward unwinds, and (with
+    # --dp-shard-update) keep the parameters SHARDED between steps so the
+    # forward all-gathers each bucket just-in-time before the first layer
+    # that consumes it — earlier layers' compute hides later buckets' wire
+    # time under XLA's latency-hiding scheduler (distributed.comm_flags()).
+    # 1 (the default) compiles the exact monolithic-collective program.
+    comm_buckets: int = 1
     # Gradient accumulation: K micro-steps between optimizer updates, grads
     # averaged (Horovod backward_passes_per_step / batches_per_allreduce
     # parity, imagenet_horovod.py:131-139; dp with SGD also scales lr by K —
@@ -401,22 +412,36 @@ class RunConfig:
         return 0.1 if self.benchmark in ("imagenet", "highres") else 0.01
 
     def resolved_allreduce_dtype(self) -> str:
-        """Canonical allreduce_dtype: 'float32' or 'bfloat16'."""
+        """Canonical allreduce_dtype: 'float32', 'bfloat16', or 'int8'."""
         alias = {"f32": "float32", "float32": "float32",
-                 "bf16": "bfloat16", "bfloat16": "bfloat16"}
+                 "bf16": "bfloat16", "bfloat16": "bfloat16",
+                 "int8": "int8"}
         try:
             return alias[self.allreduce_dtype]
         except KeyError:
             raise ValueError(
                 f"unknown allreduce_dtype {self.allreduce_dtype!r} "
-                f"(choose f32/float32 or bf16/bfloat16)")
+                f"(choose f32/float32, bf16/bfloat16, or int8)")
+
+    def dp_overlap_engine(self) -> bool:
+        """True when dp runs the OVERLAPPED sharded-update engine: params
+        stay sharded between steps (just-in-time bucketed all-gather in the
+        forward) and the backward reduce-scatters per bucket. Requires both
+        the sharded update and more than one comm bucket; with one bucket
+        the engine compiles the exact monolithic (PR 3) program."""
+        return (self.dp_explicit_collectives() and self.dp_shard_update
+                and self.comm_buckets > 1)
 
     def dp_explicit_collectives(self) -> bool:
         """True when dp runs the explicit shard_map collective engine
-        (sharded weight update and/or compressed gradient collectives)
-        instead of leaving the gradient allreduce to GSPMD."""
+        (sharded weight update, compressed gradient collectives, and/or
+        bucketed collectives) instead of leaving the gradient allreduce to
+        GSPMD. comm_buckets > 1 routes here like a non-f32 wire dtype
+        does: an f32 bucketed run is the replicated engine with one psum
+        per bucket (bitwise vs GSPMD dp for non-BN models)."""
         return self.strategy == "dp" and (
             self.dp_shard_update
+            or self.comm_buckets > 1
             or self.resolved_allreduce_dtype() != "float32")
 
     def resolved_label_smoothing(self) -> float:
@@ -704,6 +729,14 @@ class RunConfig:
                 "shard_opt_state (ZeRO-1) applies to the dp strategy "
                 "(fsdp already shards everything)")
         self.resolved_allreduce_dtype()  # raises on unknown values
+        if self.comm_buckets < 1:
+            raise ValueError("comm_buckets must be >= 1")
+        if self.comm_buckets > 1 and self.strategy != "dp":
+            raise ValueError(
+                "comm_buckets > 1 (bucketed gradient collectives) applies "
+                "to the dp strategy's explicit collective engine (-f dp; "
+                "combine with --dp-shard-update for the fully overlapped "
+                "just-in-time all-gather)")
         if self.dp_shard_update and self.strategy != "dp":
             raise ValueError(
                 "dp_shard_update (sharded weight update) applies to the dp "

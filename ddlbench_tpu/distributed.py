@@ -62,6 +62,98 @@ def enable_compilation_cache(path: str = "/tmp/ddlbench_xla_cache") -> None:
         pass
 
 
+# XLA latency-hiding-scheduler knobs for the comm/compute-overlap engine
+# (--comm-buckets > 1): convert the bucketed reduce-scatters/all-gathers
+# into async collectives that the scheduler interleaves with the
+# backward/forward compute instead of running them back-to-back at the
+# step boundary. apply_comm_flags gates on the platform: a CPU-only XLA
+# build rejects unknown tpu-prefixed flags at backend init.
+_COMM_OVERLAP_FLAGS = (
+    "--xla_tpu_enable_async_collective_fusion=true",
+    "--xla_tpu_enable_async_collective_fusion_fuse_all_gather=true",
+    "--xla_tpu_enable_async_collective_fusion_multiple_steps=true",
+    "--xla_tpu_overlap_compute_collective_tc=true",
+    "--xla_tpu_spmd_threshold_for_windowed_einsum_mib=0",
+)
+
+
+def comm_flags() -> str:
+    """The XLA_FLAGS string enabling async-collective overlap on TPU.
+
+    One authoritative home (ISSUE 6): the train CLI / bench drivers apply
+    it via :func:`apply_comm_flags` before the first backend touch, and
+    the round scripts can export it verbatim
+    (``XLA_FLAGS="$(python -c 'from ddlbench_tpu.distributed import
+    comm_flags; print(comm_flags())')"``).
+    """
+    return " ".join(_COMM_OVERLAP_FLAGS)
+
+
+def apply_comm_flags(platform: Optional[str] = None) -> bool:
+    """Append the overlap flags to XLA_FLAGS if a TPU backend is plausible.
+
+    Returns True when applied. Must run BEFORE the first backend touch
+    (env-var flags are read at backend init). Requires an AFFIRMATIVE tpu
+    signal: a tpu/axon platform pin, or — unpinned — an importable libtpu
+    plugin. An unknown tpu-prefixed flag is a fatal parse error at backend
+    init on a CPU/GPU-only XLA build, so failing open on "nothing pinned"
+    would crash exactly the machines that can't use the flags. Idempotent
+    across retried entry points.
+    """
+    pinned = (platform or os.environ.get("JAX_PLATFORMS", "")).lower()
+    if pinned:
+        if not any(p in pinned for p in ("tpu", "axon")):
+            return False
+    else:
+        import importlib.util
+        if importlib.util.find_spec("libtpu") is None:
+            return False
+    current = os.environ.get("XLA_FLAGS", "")
+    # exact flag-NAME comparison on tokenized flags — a substring test
+    # would see the base ..._async_collective_fusion as already present
+    # whenever only a longer variant (..._fuse_all_gather) is set
+    present = {tok.split("=")[0] for tok in current.split()}
+    missing = [f for f in _COMM_OVERLAP_FLAGS
+               if f.split("=")[0] not in present]
+    if not missing:
+        return True
+    os.environ["XLA_FLAGS"] = (current + " " + " ".join(missing)).strip()
+    return True
+
+
+def backend_provenance(platform_arg: Optional[str] = None) -> dict:
+    """What jax ACTUALLY selected, vs what was asked for. One authoritative
+    home for the cpu-fallback classification: recent BENCH rounds silently
+    ran on cpu when TPU init hung (ROADMAP "Recent"), poisoning the
+    trajectory — every measurement artifact embeds this record and warns
+    via :func:`warn_cpu_fallback`. Touches the backend; call only after
+    platform pinning (apply_platform / jax.config) is done.
+    """
+    backend = jax.default_backend()
+    cpu_requested = ((platform_arg or "").lower() == "cpu" or
+                     os.environ.get("JAX_PLATFORMS", "").lower() == "cpu")
+    return {
+        "jax_backend": backend,
+        "jax_device_count": jax.device_count(),
+        "cpu_requested": cpu_requested,
+        "cpu_fallback": backend == "cpu" and not cpu_requested,
+    }
+
+
+def warn_cpu_fallback(prov: dict, what: str) -> bool:
+    """Loud stderr banner when ``prov`` says cpu ran without being asked
+    for. Returns True when the warning fired."""
+    import sys
+
+    if not prov.get("cpu_fallback"):
+        return False
+    print("=" * 72 + f"\nWARNING: {what} is running on the CPU backend "
+          "without cpu being asked for\n(--platform/JAX_PLATFORMS) — this "
+          "measurement is harness validation only,\nNOT a chip number.\n"
+          + "=" * 72, file=sys.stderr, flush=True)
+    return True
+
+
 def apply_platform(platform) -> None:
     """Apply a --platform override before the first backend touch. Safe on
     images whose sitecustomize imports jax early: jax.config works until a

@@ -272,6 +272,21 @@ class FlatMeta(NamedTuple):
     'data'-axis shard is a contiguous equal slice per device. The pad tail
     is mathematically inert through both SGD and Adam: zero params with
     zero grads update to zero (Adam's denominator bottoms out at eps).
+
+    Bucketing (``--comm-buckets K``, the dp comm/compute-overlap engine):
+    the flat vector is the concatenation of K contiguous, LEAF-ALIGNED
+    buckets, each padded to a multiple of ``world`` so every bucket shards
+    into equal contiguous per-device slices and can ride its own collective
+    (the per-bucket reduce-scatters/all-gathers are what the latency-hiding
+    scheduler interleaves with backward/forward compute).
+    ``bucket_leaves[b]`` is the (start, stop) leaf range of bucket b,
+    ``bucket_padded[b]`` its padded element count, ``bucket_offsets[b]``
+    its start offset in the flat vector; ``padded == sum(bucket_padded)``.
+    With one bucket the layout is EXACTLY the pre-bucketing one (single
+    tail pad), so ``--comm-buckets 1`` compiles the same program as before.
+    Bucketing only moves where pad zeros sit between leaves — never the
+    leaf values or any reduction order within a bucket — which is what
+    keeps the bucketed f32 path bitwise-pinned to the monolithic one.
     """
 
     treedef: object
@@ -280,10 +295,56 @@ class FlatMeta(NamedTuple):
     sizes: tuple
     length: int
     padded: int
+    bucket_leaves: tuple = ((0, 0),)
+    bucket_padded: tuple = (0,)
+    bucket_offsets: tuple = (0,)
+
+    @property
+    def num_buckets(self) -> int:
+        return len(self.bucket_padded)
 
 
-def flat_meta(params, world: int) -> FlatMeta:
-    """Works on concrete leaves and jax.eval_shape ShapeDtypeStructs."""
+def _bucket_bounds(group_sizes, buckets: int):
+    """Greedy contiguous split of ``group_sizes`` (elements per leaf group)
+    into <= ``buckets`` groups-aligned chunks balancing element counts.
+    Returns group-index boundaries [0, ..., len(group_sizes)]."""
+    total = sum(group_sizes)
+    buckets = max(1, min(buckets, len(group_sizes) or 1))
+    bounds = [0]
+    cum = 0  # elements before group i (boundary targets are cumulative)
+    acc = 0  # elements in the currently-open bucket (must stay nonzero:
+    #          an empty bucket would reduce-scatter a zero-size shard)
+    for i, s in enumerate(group_sizes):
+        remaining_groups = len(group_sizes) - i
+        remaining_buckets = buckets - len(bounds) + 1
+        # place boundary k where the CUMULATIVE element count crosses
+        # k/buckets of the total (per-boundary fair-share target — a
+        # per-bucket threshold drifts: one oversized bucket inflates
+        # every later one), but never leave fewer groups than buckets
+        # still to fill
+        if (len(bounds) <= buckets - 1 and acc > 0
+                and (cum >= total * len(bounds) / buckets
+                     or remaining_groups <= remaining_buckets)):
+            bounds.append(i)
+            acc = 0
+        cum += s
+        acc += s
+    bounds.append(len(group_sizes))
+    return bounds
+
+
+def flat_meta(params, world: int, buckets: int = 1,
+              leaf_groups=None) -> FlatMeta:
+    """Works on concrete leaves and jax.eval_shape ShapeDtypeStructs.
+
+    ``buckets`` splits the packed vector into contiguous leaf-aligned
+    buckets (see FlatMeta); ``leaf_groups`` optionally gives the leaf count
+    of each alignment group (e.g. leaves per model layer) so bucket
+    boundaries fall on LAYER boundaries — the backward then finishes a
+    bucket's gradients as one contiguous stretch of layers unwinds. With
+    no groups every leaf is its own group. ``buckets=1`` reproduces the
+    pre-bucketing layout exactly.
+    """
     import math
 
     leaves, treedef = jax.tree.flatten(params)
@@ -291,24 +352,209 @@ def flat_meta(params, world: int) -> FlatMeta:
     dtypes = tuple(jnp.dtype(l.dtype) for l in leaves)
     sizes = tuple(math.prod(s) for s in shapes)
     length = int(sum(sizes))
-    padded = -(-length // world) * world
-    return FlatMeta(treedef, shapes, dtypes, sizes, length, padded)
+
+    if leaf_groups is None:
+        leaf_groups = [1] * len(leaves)
+    assert sum(leaf_groups) == len(leaves), (leaf_groups, len(leaves))
+    group_sizes = []
+    li = 0
+    for g in leaf_groups:
+        group_sizes.append(int(sum(sizes[li:li + g])))
+        li += g
+    # empty-parameter groups (flatten/pool layers) can never host a
+    # boundary worth having; merging them right keeps buckets non-trivial
+    gbounds = _bucket_bounds(group_sizes, buckets)
+    leaf_starts = [0]
+    for g in leaf_groups:
+        leaf_starts.append(leaf_starts[-1] + g)
+
+    bucket_leaves, bucket_padded, bucket_offsets = [], [], []
+    off = 0
+    for b in range(len(gbounds) - 1):
+        l0 = leaf_starts[gbounds[b]]
+        l1 = leaf_starts[gbounds[b + 1]]
+        blen = int(sum(sizes[l0:l1]))
+        bpad = -(-blen // world) * world if blen else 0
+        if bpad == 0 and bucket_leaves:
+            # fold an empty bucket into its predecessor
+            bucket_leaves[-1] = (bucket_leaves[-1][0], l1)
+            continue
+        bucket_leaves.append((l0, l1))
+        bucket_padded.append(bpad)
+        bucket_offsets.append(off)
+        off += bpad
+    if not bucket_leaves:  # degenerate: a model with zero parameters
+        bucket_leaves, bucket_padded, bucket_offsets = [(0, 0)], [0], [0]
+    padded = int(sum(bucket_padded))
+    return FlatMeta(treedef, shapes, dtypes, sizes, length, padded,
+                    tuple(bucket_leaves), tuple(bucket_padded),
+                    tuple(bucket_offsets))
 
 
 def pack_flat(tree, meta: FlatMeta) -> jax.Array:
-    """Concatenate the tree's raveled f32 leaves into one [padded] vector."""
-    flat = jnp.concatenate(
-        [l.astype(jnp.float32).ravel() for l in jax.tree.leaves(tree)])
-    return jnp.pad(flat, (0, meta.padded - meta.length))
+    """Concatenate the tree's raveled f32 leaves into one [padded] vector
+    (bucket-padded layout: each bucket's leaves then its pad zeros).
+
+    The single-bucket path is kept byte-for-byte the pre-bucketing program
+    (concat + one tail pad) — ``--comm-buckets 1`` must compile exactly
+    the monolithic engine."""
+    leaves = jax.tree.leaves(tree)
+    if meta.num_buckets == 1:
+        flat = jnp.concatenate([l.astype(jnp.float32).ravel()
+                                for l in leaves])
+        return jnp.pad(flat, (0, meta.padded - meta.length))
+    parts = []
+    for (l0, l1), bpad in zip(meta.bucket_leaves, meta.bucket_padded):
+        parts.extend(l.astype(jnp.float32).ravel() for l in leaves[l0:l1])
+        blen = int(sum(meta.sizes[l0:l1]))
+        if bpad > blen:
+            parts.append(jnp.zeros((bpad - blen,), jnp.float32))
+    return jnp.concatenate(parts) if parts else jnp.zeros((0,), jnp.float32)
 
 
 def unpack_flat(flat: jax.Array, meta: FlatMeta):
-    """Inverse of pack_flat (drops the pad tail, restores leaf dtypes)."""
-    out, off = [], 0
-    for size, shape, dtype in zip(meta.sizes, meta.shapes, meta.dtypes):
-        out.append(flat[off:off + size].reshape(shape).astype(dtype))
-        off += size
+    """Inverse of pack_flat (drops the pads, restores leaf dtypes).
+
+    Each leaf is sliced from ITS bucket's stretch of the flat vector only —
+    under the overlapped dp engine the buckets arrive as separate
+    all-gathers, so this dataflow lets the forward's first layers start on
+    early buckets while late buckets are still on the wire.
+    """
+    out = []
+    for (l0, l1), boff in zip(meta.bucket_leaves, meta.bucket_offsets):
+        off = boff
+        for i in range(l0, l1):
+            size, shape, dtype = meta.sizes[i], meta.shapes[i], meta.dtypes[i]
+            out.append(flat[off:off + size].reshape(shape).astype(dtype))
+            off += size
     return jax.tree.unflatten(meta.treedef, out)
+
+
+def bucket_slice(flat: jax.Array, meta: FlatMeta, b: int) -> jax.Array:
+    """Bucket b's [bucket_padded[b]] stretch of a packed flat vector."""
+    return flat[meta.bucket_offsets[b]:
+                meta.bucket_offsets[b] + meta.bucket_padded[b]]
+
+
+def unpack_buckets(bucket_arrays, meta: FlatMeta):
+    """Pytree from per-bucket flat stretches (each [bucket_padded[b]]).
+
+    The overlapped dp engine's forward: every bucket arrives as its own
+    all-gather, and each leaf depends ONLY on its bucket's array — the
+    dataflow that lets the first layers start on early buckets while late
+    buckets are still on the wire.
+    """
+    out = []
+    for (l0, l1), arr in zip(meta.bucket_leaves, bucket_arrays):
+        off = 0
+        for i in range(l0, l1):
+            size, shape, dtype = meta.sizes[i], meta.shapes[i], meta.dtypes[i]
+            out.append(arr[off:off + size].reshape(shape).astype(dtype))
+            off += size
+    return jax.tree.unflatten(meta.treedef, out)
+
+
+def to_device_major(flat: jax.Array, meta: FlatMeta, world: int) -> jax.Array:
+    """Bucket-layout [padded] vector -> the overlapped engine's DEVICE-MAJOR
+    layout: concat over devices of (concat over buckets of that device's
+    1/world bucket slice).
+
+    This is the layout per-bucket ``psum_scatter`` outputs naturally produce
+    when a device's shard is the concatenation of its bucket slices, and
+    the layout the engine keeps params in BETWEEN steps (sharding P('data')
+    makes device d own exactly its stretch). With one bucket it is the
+    identity permutation.
+    """
+    parts = []
+    for d in range(world):
+        for b in range(meta.num_buckets):
+            o = meta.bucket_offsets[b]
+            bl = meta.bucket_padded[b] // world
+            parts.append(flat[o + d * bl:o + (d + 1) * bl])
+    return jnp.concatenate(parts) if parts else flat
+
+
+def from_device_major(flat_dm: jax.Array, meta: FlatMeta,
+                      world: int) -> jax.Array:
+    """Inverse of :func:`to_device_major` (device-major -> bucket layout)."""
+    shard_len = meta.padded // world
+    parts = []
+    for b in range(meta.num_buckets):
+        bo = meta.bucket_offsets[b] // world
+        bl = meta.bucket_padded[b] // world
+        parts.extend(flat_dm[d * shard_len + bo:d * shard_len + bo + bl]
+                     for d in range(world))
+    return jnp.concatenate(parts) if parts else flat_dm
+
+
+def shard_bucket_slice(shard: jax.Array, meta: FlatMeta, world: int,
+                       b: int) -> jax.Array:
+    """Bucket b's segment of one device's [padded/world] shard.
+
+    The sharded layout is per-bucket: a device's shard is the concatenation
+    over buckets of its 1/world slice of each bucket, so bucket b occupies
+    ``bucket_offsets[b]/world : (bucket_offsets[b]+bucket_padded[b])/world``
+    of the local shard.
+    """
+    o = meta.bucket_offsets[b] // world
+    return shard[o:o + meta.bucket_padded[b] // world]
+
+
+# ---- int8 wire path (EQuARX-style block-scaled quantized collectives) ----
+
+
+def sum_safe_qmax(world: int) -> int:
+    """Largest per-device quantized magnitude whose WORLD-device sum still
+    fits int8: the wire collective (psum / psum_scatter) accumulates IN
+    int8, so each device may contribute at most 127 // world — e.g. +-15
+    on an 8-way mesh, +-63 on a 2-way one. The lost bits are the price of
+    summing on the wire (EQuARX pays the same with block headroom);
+    stochastic rounding keeps the estimate unbiased regardless.
+    """
+    if world > 127:
+        raise ValueError(
+            f"int8 wire supports up to 127 devices (got {world}): the "
+            f"in-dtype collective sum would overflow")
+    return max(1, 127 // world)
+
+
+def stochastic_round_int8(v: jax.Array, key, qmax: int = 127) -> jax.Array:
+    """Unbiased stochastic rounding of ``v`` (already scaled into
+    [-qmax, qmax]) to int8: floor(v) + Bernoulli(frac(v)).
+
+    E[result] == v elementwise for any v in range, which is what keeps the
+    quantized gradient sum an unbiased estimate of the f32 sum; the
+    rounding noise is the ONLY stochastic element of the int8 wire and is
+    fully determined by ``key`` (derived from the run seed + step counter +
+    device/bucket indices in parallel/dp.py), so runs replay bitwise.
+    The clip at ``qmax`` only defends against float-division round-off
+    pushing an exact-absmax element one ulp past the bound — in-range
+    values are never clipped, so no bias is introduced.
+    """
+    lo = jnp.floor(v)
+    frac = v - lo
+    u = jax.random.uniform(key, v.shape, dtype=jnp.float32)
+    r = lo + (u < frac).astype(jnp.float32)
+    return jnp.clip(r, -float(qmax), float(qmax)).astype(jnp.int8)
+
+
+def quantize_int8(g: jax.Array, key, qmax: int = 127, absmax=None):
+    """(q int8, scale f32): absmax-scaled stochastic int8 quantization.
+
+    ``scale = absmax/qmax`` maps the largest-magnitude element to exactly
+    +-qmax (representable, zero rounding error); an all-zero block gets
+    scale 1 so the division below stays finite. ``absmax`` may be supplied
+    by the caller (the dp engine psums a GLOBAL absmax so every device
+    shares one scale — a per-device scale could not be summed on the
+    wire). Dequantize with ``q.astype(f32) * scale`` — exact for values
+    that are integer multiples of the scale (the absmax round-trip
+    property pinned by tests/test_comm_overlap.py).
+    """
+    if absmax is None:
+        absmax = jnp.max(jnp.abs(g))
+    scale = jnp.where(absmax > 0,
+                      absmax.astype(jnp.float32) / qmax, jnp.float32(1.0))
+    return stochastic_round_int8(g / scale, key, qmax), scale
 
 
 def opt_state_sharding(cfg, param_sharding, scalar_sharding):

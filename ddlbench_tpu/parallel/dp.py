@@ -41,6 +41,33 @@ bitwise-identical to replicated dp on the CPU mesh for non-BN models
 (tests/test_dp_shard.py); BN models agree to float rounding only, because
 GSPMD places the BN-backward cross-replica reductions around linear ops at
 its own discretion while the explicit engine fixes them (sync_batch_mean).
+
+Comm/compute overlap (``--comm-buckets K``, ISSUE 6): with K > 1 the packed
+flat gradient splits into K contiguous, LAYER-ALIGNED buckets
+(common.flat_meta's leaf_groups = leaves per model layer), each riding its
+OWN collective. The per-bucket reduce-scatter depends only on that
+bucket's layers' gradients, so under XLA's latency-hiding scheduler
+(distributed.comm_flags) late buckets' wire time hides under earlier
+layers' backward compute — the cross-replica sharded-weight-update
+overlap, expressed as dataflow instead of a schedule. Combined with
+``--dp-shard-update`` the engine goes fully OVERLAPPED: parameters stay
+SHARDED between steps (TrainState.params is the flat device-major f32
+vector, one contiguous shard per chip) and the forward all-gathers each
+bucket just-in-time — every leaf depends only on its bucket's all-gather,
+so the first layers start while late buckets are still in flight
+(FSDP-style prefetch left to the scheduler). Bucketing only moves pad
+zeros between leaves and never splits or reorders a reduction, so the f32
+bucketed path is bitwise-pinned to the monolithic PR 3 engine and
+``--comm-buckets 1`` compiles the exact PR 3 program.
+
+int8 wire (``--allreduce-dtype int8``, EQuARX-lite): per-bucket GLOBAL
+absmax (lax.pmax) -> shared scale absmax/qmax with qmax = 127 // world
+(the collective sums IN int8; see common.sum_safe_qmax) -> stochastic
+rounding seeded from the run seed + a step counter in the optimizer dict
++ device + bucket indices (bitwise-reproducible runs) -> int8
+psum/psum_scatter -> dequantize. Quarter gradient wire bytes vs f32;
+accuracy is gated by the digits matrix (tools/accparity.py dp-int8 rows),
+not claimed by construction.
 """
 
 from __future__ import annotations
@@ -137,9 +164,10 @@ class DPStrategy:
             from ddlbench_tpu.parallel.common import eval_metrics
 
             with sharded_jit_tracing():
-                return eval_metrics(model, cfg, ts.params, ts.model_state,
-                                    x, y, self.compute_dtype)
+                return eval_metrics(model, cfg, self._params_pytree(ts),
+                                    ts.model_state, x, y, self.compute_dtype)
 
+        self._overlap = False  # _build_explicit_engine may flip it
         if self._explicit:
             self._build_explicit_engine(smooth)
         else:
@@ -154,6 +182,30 @@ class DPStrategy:
             eval_step,
             in_shardings=(None, self._batch_sharding, self._batch_sharding),
         )
+        self._materialize = jax.jit(self._params_pytree,
+                                    out_shardings=self._replicated)
+
+    def _params_pytree(self, ts: TrainState):
+        """ts.params as the per-layer pytree — identity except under the
+        overlapped engine, whose between-steps params are the flat
+        device-major sharded vector (jit callers let XLA insert the
+        gathers; GSPMD slices what each consumer needs)."""
+        if not self._overlap:
+            return ts.params
+        from ddlbench_tpu.parallel.common import from_device_major, unpack_flat
+
+        meta = self._flat_meta
+        return unpack_flat(
+            from_device_major(ts.params, meta, self.mesh.devices.size), meta)
+
+    def materialize_params(self, ts: TrainState):
+        """Replicated per-layer params pytree for host-side consumers
+        (activation logging, tools) — the train loop calls this instead of
+        touching ts.params so the overlapped engine's flat sharded state
+        stays an implementation detail."""
+        if not self._overlap:
+            return ts.params
+        return self._materialize(ts)
 
     # -- explicit collective engine (ZeRO-1 / compressed allreduce) --------
 
@@ -195,15 +247,22 @@ class DPStrategy:
     def _build_explicit_engine(self, smooth):
         """Build train_step as one jit whose body is an explicit shard_map
         over 'data': per-device partial grads -> packed flat vector ->
-        psum_scatter (sharded update) or psum (replicated update), in
-        self.wire_dtype on the wire -> packed-slice optimizer update ->
-        params re-assembled at the sharding boundary (the all-gather)."""
+        per-bucket psum_scatter (sharded update) or psum (replicated
+        update), in self.wire_dtype on the wire -> packed-slice optimizer
+        update -> params re-assembled at the sharding boundary (monolithic
+        all-gather) or kept SHARDED between steps with per-bucket
+        just-in-time all-gathers in the forward (the overlapped engine,
+        --comm-buckets > 1 with --dp-shard-update)."""
         from jax import lax
 
         from ddlbench_tpu.compat import shard_map as _shard_map
         from ddlbench_tpu.models.layers import batch_parallel
-        from ddlbench_tpu.parallel.common import (flat_meta, pack_flat,
-                                                  psum_keepgrad, unpack_flat,
+        from ddlbench_tpu.parallel.common import (bucket_slice, flat_meta,
+                                                  pack_flat, psum_keepgrad,
+                                                  quantize_int8,
+                                                  shard_bucket_slice,
+                                                  sum_safe_qmax,
+                                                  unpack_buckets, unpack_flat,
                                                   vary)
 
         cfg = self.cfg
@@ -214,27 +273,83 @@ class DPStrategy:
         shard_update = self.shard_update
         wire = self.wire_dtype
         opt_update = self._opt_update
+        overlap = self._overlap = cfg.dp_overlap_engine()
+        int8_wire = wire == jnp.dtype(jnp.int8)
 
         abs_params = jax.eval_shape(
             lambda k: init_model(model, k)[0], jax.random.key(0))
-        meta = flat_meta(abs_params, n)
+        # Layer-aligned buckets: abs_params is the per-layer params list, so
+        # each layer's leaves form one alignment group and bucket boundaries
+        # fall on layer boundaries — the backward finishes a bucket's
+        # gradients as one contiguous stretch of layers unwinds.
+        leaf_groups = [len(jax.tree.leaves(p)) for p in abs_params]
+        meta = flat_meta(abs_params, n, buckets=cfg.comm_buckets,
+                         leaf_groups=leaf_groups)
         self._flat_meta = meta
         shard_len = meta.padded // n
+        qmax = sum_safe_qmax(n) if int8_wire else None
+        # int8 stochastic-rounding key root: run seed + a fixed tag keeping
+        # the stream disjoint from data/init keys; the step counter
+        # (optimizer dict "qstep"), device index, micro-step, and bucket
+        # index fold in below — fully deterministic under the run seed.
+        int8_key_root = (jax.random.fold_in(jax.random.key(cfg.seed), 0x1A8)
+                         if int8_wire else None)
 
-        def reduce_grads(g):
+        def reduce_grads(g, qkey=None):
             """Partial gradient pytree -> REDUCED packed flat f32 vector:
-            the wire-dtype cast, then psum_scatter (sharded update: each
-            device keeps one contiguous 1/world slice of the sum) or psum
-            (replicated update). The single collective site of the step."""
-            gf = pack_flat(g, meta).astype(wire)
-            if shard_update:
-                return lax.psum_scatter(gf, "data",
-                                        tiled=True).astype(jnp.float32)
-            return lax.psum(gf, "data").astype(jnp.float32)
+            the wire-dtype cast (int8: global-absmax scaling + stochastic
+            rounding), then per-bucket psum_scatter (sharded update: each
+            device keeps one contiguous 1/world slice of EACH bucket,
+            concatenated — the device-major layout) or psum (replicated
+            update). Each bucket's collective depends only on its own
+            layers' gradients, which is the whole overlap story: the
+            latency-hiding scheduler starts late buckets' wire time while
+            earlier layers' backward still computes. Within a bucket the
+            reduction is the same elementwise cross-device sum as the
+            monolithic path, so the f32 result is bitwise-pinned."""
+            gf = pack_flat(g, meta)
+            if meta.num_buckets == 1 and not int8_wire:
+                # the exact PR 3 monolithic program (--comm-buckets 1)
+                gw = gf.astype(wire)
+                if shard_update:
+                    return lax.psum_scatter(gw, "data",
+                                            tiled=True).astype(jnp.float32)
+                return lax.psum(gw, "data").astype(jnp.float32)
+            parts = []
+            for b in range(meta.num_buckets):
+                gb = bucket_slice(gf, meta, b)
+                if int8_wire:
+                    # one scale per bucket, shared across devices (pmax of
+                    # the local absmaxes) — a per-device scale could not be
+                    # summed on the wire
+                    absmax = lax.pmax(jnp.max(jnp.abs(gb)), "data")
+                    q, scale = quantize_int8(gb, jax.random.fold_in(qkey, b),
+                                             qmax=qmax, absmax=absmax)
+                    red = (lax.psum_scatter(q, "data", tiled=True)
+                           if shard_update else lax.psum(q, "data"))
+                    parts.append(red.astype(jnp.float32) * scale)
+                else:
+                    gw = gb.astype(wire)
+                    red = (lax.psum_scatter(gw, "data", tiled=True)
+                           if shard_update else lax.psum(gw, "data"))
+                    parts.append(red.astype(jnp.float32))
+            return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
 
         guard = self._guard
 
-        def local_grads(params, state, x, y, smul):
+        def gather_params(pshard):
+            """Overlapped forward: one all-gather PER BUCKET, each leaf
+            sliced from its bucket's gathered stretch only — the first
+            forward layer depends on bucket 0's all-gather alone, so
+            compute starts while late buckets are still on the wire."""
+            stretches = [
+                lax.all_gather(shard_bucket_slice(pshard, meta, n, b),
+                               "data", tiled=True)
+                for b in range(meta.num_buckets)
+            ]
+            return unpack_buckets(stretches, meta)
+
+        def local_grads(params, state, x, y, smul, qkey=None):
             """(ce, correct, valid, new_state, g_reduced): psum'd metrics
             plus the reduced flat gradient (shard or full vector).
             Non-accum partials are pre-seeded by 1/global_count (the GSPMD
@@ -264,7 +379,8 @@ class DPStrategy:
                     jax.value_and_grad(loss_fn, has_aux=True)(params)
                 ce = lax.psum(ce_sum, "data") / denom
                 return (ce, lax.psum(correct, "data"),
-                        lax.psum(valid, "data"), new_state, reduce_grads(g))
+                        lax.psum(valid, "data"), new_state,
+                        reduce_grads(g, qkey))
 
             B = x.shape[0]
             assert B % K == 0, (
@@ -296,7 +412,9 @@ class DPStrategy:
                     jax.value_and_grad(f, has_aux=True)(params)
                 ce_k = lax.psum(ce_sum, "data") / denom
                 wk = lax.psum(valid, "data").astype(jnp.float32)
-                gsum = gsum + wk * reduce_grads(g)
+                qk = (jax.random.fold_in(qkey, k) if qkey is not None
+                      else None)
+                gsum = gsum + wk * reduce_grads(g, qk)
                 return (new_st, gsum), (ce_k, wk, lax.psum(correct, "data"),
                                         lax.psum(valid, "data"))
 
@@ -314,13 +432,27 @@ class DPStrategy:
                     gsum / total)
 
         def local_step(params, state, opt, x, y, lr):
-            gstate, smul = None, None
+            gstate, smul, qstep, qkey = None, None, None, None
+            if int8_wire:
+                # the stochastic-rounding step counter rides in the opt dict
+                # (split out before the optimizer update, advanced after —
+                # the same pattern as the guard's scale state); it advances
+                # on skipped steps too, keeping select's tree shapes simple
+                qstep = opt["qstep"]
+                opt = {k: v for k, v in opt.items() if k != "qstep"}
+                qkey = jax.random.fold_in(int8_key_root, qstep)
+                qkey = jax.random.fold_in(qkey, lax.axis_index("data"))
             if guard is not None:
                 opt, gstate = guard.split_opt(opt)
                 smul = guard.smul(gstate, lr)
+            if overlap:
+                # params arrive as this device's flat shard: just-in-time
+                # per-bucket all-gather rebuilds the pytree for the forward
+                pshard = params
+                params = gather_params(pshard)
             with batch_parallel("data", n):
                 ce, correct, valid, new_state, gr = local_grads(
-                    params, state, x, y, smul)
+                    params, state, x, y, smul, qkey)
             if guard is not None:
                 # unscale AFTER the (wire-dtype) collective — the scaled
                 # values are what rides the wire — then fuse the health
@@ -339,9 +471,14 @@ class DPStrategy:
                 new_gstate = guard.scaler_update(gstate, finite)
                 metrics.update(guard.metrics(finite, gnorm, new_gstate))
             if shard_update:
-                pf = pack_flat(params, meta)
-                ps = lax.dynamic_slice_in_dim(
-                    pf, lax.axis_index("data") * shard_len, shard_len)
+                if overlap:
+                    # params already flat+sharded between steps: the local
+                    # shard IS the optimizer's parameter slice
+                    ps = pshard
+                else:
+                    pf = pack_flat(params, meta)
+                    ps = lax.dynamic_slice_in_dim(
+                        pf, lax.axis_index("data") * shard_len, shard_len)
                 new_ps, new_opt = opt_update(ps, gr, opt, lr)
                 if guard is not None:
                     # skip-select covers the ZeRO-1 SHARDED slices too: the
@@ -351,9 +488,14 @@ class DPStrategy:
                         finite, (new_ps, new_state, new_opt),
                         (ps, state, opt))
                     new_opt = guard.fold_opt(new_opt, new_gstate)
-                # out_spec P('data') on the updated slice re-assembles the
-                # flat parameter vector across devices — the all-gather
-                # happens at the shard_map output boundary.
+                if qstep is not None:
+                    new_opt = {**new_opt, "qstep": qstep + 1}
+                # Monolithic engine: out_spec P('data') on the updated slice
+                # re-assembles the flat parameter vector across devices —
+                # the all-gather happens at the shard_map output boundary.
+                # Overlapped engine: the slice STAYS the state (out spec
+                # P('data') with no host unpack) and the NEXT step's forward
+                # all-gathers it per bucket, just in time.
                 return new_ps, new_state, new_opt, metrics
             # compressed allreduce with the replicated update: the explicit
             # psum already ran in the wire dtype; per-leaf optimizer step.
@@ -364,6 +506,8 @@ class DPStrategy:
                     finite, (new_params, new_state, new_opt),
                     (params, state, opt))
                 new_opt = guard.fold_opt(new_opt, new_gstate)
+            if qstep is not None:
+                new_opt = {**new_opt, "qstep": qstep + 1}
             return new_params, new_state, new_opt, metrics
 
         flat_spec = P("data") if shard_update else P()
@@ -374,6 +518,10 @@ class DPStrategy:
         if cfg.resolved_optimizer() == "adam":
             opt_specs.update(v=flat_spec, step=P())
             opt_shardings.update(v=flat_sh, step=self._replicated)
+        if int8_wire:
+            # replicated int32 stochastic-rounding step counter
+            opt_specs.update(qstep=P())
+            opt_shardings.update(qstep=self._replicated)
         if guard is not None:
             # dynamic loss-scale state: two replicated scalars in the dict
             opt_specs = guard.opt_state_spec(opt_specs, P())
@@ -384,7 +532,8 @@ class DPStrategy:
         sharded = _shard_map(
             local_step,
             mesh=mesh,
-            in_specs=(P(), P(), opt_specs, P("data"), P("data"), P()),
+            in_specs=(P("data") if overlap else P(), P(), opt_specs,
+                      P("data"), P("data"), P()),
             out_specs=(P("data") if shard_update else P(), P(), opt_specs,
                        P()),
         )
@@ -392,31 +541,73 @@ class DPStrategy:
         def step(ts: TrainState, x, y, lr):
             p_out, new_state, new_opt, metrics = sharded(
                 ts.params, ts.model_state, ts.opt, x, y, lr)
-            new_params = unpack_flat(p_out, meta) if shard_update else p_out
-            return TrainState(new_params, new_state, new_opt), metrics
+            if shard_update and not overlap:
+                p_out = unpack_flat(p_out, meta)
+            # overlapped engine: p_out STAYS the flat device-major sharded
+            # vector — no boundary all-gather; the next step (and eval /
+            # materialize_params) gathers per bucket on demand
+            return TrainState(p_out, new_state, new_opt), metrics
 
+        param_out_sh = (NamedSharding(mesh, P("data")) if overlap
+                        else self._replicated)
         jit_step = jax.jit(
             step,
             donate_argnums=(0,),
             in_shardings=(None, self._batch_sharding, self._batch_sharding,
                           None),
-            out_shardings=(TrainState(self._replicated, self._replicated,
+            out_shardings=(TrainState(param_out_sh, self._replicated,
                                       opt_shardings), None),
         )
         self._jit_train_step = jit_step  # introspection (tests, tools)
-        span_args = {"mode": "sharded" if shard_update else "replicated",
-                     "wire": str(jnp.dtype(wire))}
+        mode = ("overlapped" if overlap
+                else "sharded" if shard_update else "replicated")
+        span_args = {"mode": mode, "wire": str(jnp.dtype(wire)),
+                     "buckets": meta.num_buckets}
+        # Exact per-bucket wire-byte schedule for the rs_bucket/ag_bucket/
+        # ar_bucket marker spans: ring RS ships (n-1)/n of the (padded)
+        # bucket in the wire dtype, the param AG the same fraction in f32
+        # (master weights), and the replicated engine's ring ALLREDUCE
+        # ships 2(n-1)/n (RS + AG halves of the same ring — matching
+        # comm_stats._ring_allreduce_bytes). Host spans MARK the schedule
+        # with exact byte accounting — per-bucket device time lives in the
+        # --trace-dir XLA capture, where the async collectives are visible
+        # interleaved with compute.
+        wire_itemsize = 1 if int8_wire else jnp.dtype(wire).itemsize
+        rs_scale = ((n - 1) / n if shard_update
+                    else 2.0 * (n - 1) / n if n > 1 else 0.0)
+        bucket_sched = [
+            {"bucket": b, "offset": meta.bucket_offsets[b],
+             "elems": meta.bucket_padded[b],
+             "rs_wire_bytes": rs_scale * meta.bucket_padded[b]
+             * wire_itemsize,
+             "ag_wire_bytes": (n - 1) / n * meta.bucket_padded[b] * 4.0}
+            for b in range(meta.num_buckets)
+        ]
+        self._bucket_schedule = bucket_sched
 
         def train_step(ts, x, y, lr):
             from ddlbench_tpu.telemetry import get_tracer
 
             tracer = get_tracer()
-            if tracer.enabled:
-                # marks the update phase's dispatch on the host timeline;
-                # device time lives in the --trace-dir XLA capture
-                with tracer.span("dp_explicit_update", **span_args):
-                    return jit_step(ts, x, y, lr)
-            return jit_step(ts, x, y, lr)
+            if not tracer.enabled:
+                return jit_step(ts, x, y, lr)
+            # marks the update phase's dispatch on the host timeline;
+            # device time lives in the --trace-dir XLA capture
+            with tracer.span("dp_explicit_update", **span_args):
+                out = jit_step(ts, x, y, lr)
+                for sc in bucket_sched:
+                    coll = "rs_bucket" if shard_update else "ar_bucket"
+                    with tracer.span(coll, bucket=sc["bucket"],
+                                     wire_bytes=sc["rs_wire_bytes"],
+                                     dtype=str(jnp.dtype(wire)),
+                                     offset=sc["offset"]):
+                        pass
+                    if shard_update:
+                        with tracer.span("ag_bucket", bucket=sc["bucket"],
+                                         wire_bytes=sc["ag_wire_bytes"],
+                                         dtype="float32", jit=overlap):
+                            pass
+            return out
 
         self.train_step = train_step
 
@@ -424,18 +615,39 @@ class DPStrategy:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
+        int8_wire = self._explicit and self.wire_dtype == jnp.dtype(jnp.int8)
         if self._explicit and self.shard_update:
             # ZeRO-1: optimizer state lives on the packed flat vector, one
             # contiguous [padded/world] slice per device.
             opt = self._opt_init(
                 jnp.zeros((self._flat_meta.padded,), jnp.float32))
+            if int8_wire:
+                opt = {**opt, "qstep": jnp.zeros((), jnp.int32)}
             if self._guard is not None:
                 opt = self._guard.attach_opt_state(opt)
+            if self._overlap:
+                # overlapped engine: params live SHARDED between steps as
+                # the flat device-major vector (broadcast-init parity still
+                # holds — every host computes the same seed-deterministic
+                # init, each device keeps its 1/world stretch)
+                from ddlbench_tpu.parallel.common import (pack_flat,
+                                                          to_device_major)
+
+                meta = self._flat_meta
+                pflat = to_device_major(pack_flat(params, meta), meta,
+                                        self.mesh.devices.size)
+                ts = TrainState(pflat, state, opt)
+                shardings = TrainState(
+                    NamedSharding(self.mesh, P("data")), self._replicated,
+                    self._opt_shardings)
+                return put_global_tree(ts, shardings)
             ts = TrainState(params, state, opt)
             shardings = TrainState(self._replicated, self._replicated,
                                    self._opt_shardings)
             return put_global_tree(ts, shardings)
         opt = self._opt_init(params)
+        if int8_wire:
+            opt = {**opt, "qstep": jnp.zeros((), jnp.int32)}
         if self._guard is not None:
             opt = self._guard.attach_opt_state(opt)
         ts = TrainState(params, state, opt)

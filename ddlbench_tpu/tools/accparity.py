@@ -56,6 +56,18 @@ ENGINES = {
                 "--allreduce-dtype", "bf16"],
     "dp-shard-bf16": ["-f", "dp", "-g", "2", "--batch-size", "32",
                       "--dp-shard-update", "--allreduce-dtype", "bf16"],
+    # int8 wire (absmax + stochastic rounding, quarter gradient bytes): the
+    # digits-parity gate for --allreduce-dtype int8 — the ONLY accuracy
+    # claim the int8 path makes (ISSUE 6); same harness as the bf16 gate
+    "dp-int8": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                "--allreduce-dtype", "int8"],
+    "dp-shard-int8": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                      "--dp-shard-update", "--allreduce-dtype", "int8"],
+    # overlapped engine (bucketed RS + just-in-time AG): f32 is bitwise-
+    # pinned by tests/test_comm_overlap.py; this row is the end-to-end
+    # digits cross-check that the overlap restructure changed nothing
+    "dp-shard-ov4": ["-f", "dp", "-g", "2", "--batch-size", "32",
+                     "--dp-shard-update", "--comm-buckets", "4"],
     "gpipe": ["-f", "gpipe", "-g", "2",
               "--micro-batch-size", "8", "--num-microbatches", "4"],
     "pipedream": ["-f", "pipedream", "-g", "2",

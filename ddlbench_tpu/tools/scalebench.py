@@ -81,14 +81,21 @@ def main(argv=None) -> int:
                         "plain run to price the reduce-scatter/all-gather "
                         "pattern")
     p.add_argument("--allreduce-dtype", default="f32",
-                   choices=("f32", "float32", "bf16", "bfloat16"),
+                   choices=("f32", "float32", "bf16", "bfloat16", "int8"),
                    help="wire dtype for dp's gradient collectives "
-                        "(bf16 = compressed allreduce)")
-    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+                        "(bf16 = compressed allreduce, int8 = absmax + "
+                        "stochastic rounding at quarter bytes)")
+    p.add_argument("--comm-buckets", type=int, default=1,
+                   help="dp points: layer-aligned gradient buckets for "
+                        "comm/compute overlap (1 = monolithic)")
+    from ddlbench_tpu.distributed import (add_platform_arg, apply_comm_flags,
+                                          apply_platform)
 
     add_platform_arg(p)
     args = p.parse_args(argv)
     apply_platform(args.platform)
+    if args.comm_buckets > 1:
+        apply_comm_flags(args.platform)
 
     import jax
 
@@ -96,6 +103,16 @@ def main(argv=None) -> int:
     from ddlbench_tpu.distributed import enable_compilation_cache
 
     enable_compilation_cache()
+    # Backend provenance header: one JSON line recording what jax ACTUALLY
+    # selected (shared classification — distributed.backend_provenance),
+    # so every scalebench artifact self-identifies and a cpu backend
+    # nobody asked for warns loudly on stderr.
+    from ddlbench_tpu.distributed import backend_provenance, warn_cpu_fallback
+
+    prov = backend_provenance(args.platform)
+    print(json.dumps({"provenance": {**prov, "platform_arg": args.platform}}),
+          flush=True)
+    warn_cpu_fallback(prov, "scalebench")
     avail = len(jax.devices())
     if args.devices:
         counts = [int(c) for c in args.devices.split(",")]
@@ -129,12 +146,15 @@ def main(argv=None) -> int:
                 kw["num_stages"] = n
             point = {"strategy": strat, "devices": n}
             if strat == "dp" and (args.dp_shard_update
+                                  or args.comm_buckets > 1
                                   or args.allreduce_dtype not in
                                   ("f32", "float32")):
                 kw["dp_shard_update"] = args.dp_shard_update
                 kw["allreduce_dtype"] = args.allreduce_dtype
+                kw["comm_buckets"] = args.comm_buckets if n > 1 else 1
                 point["dp_shard_update"] = args.dp_shard_update
                 point["allreduce_dtype"] = args.allreduce_dtype
+                point["comm_buckets"] = kw["comm_buckets"]
             cfg = RunConfig(**kw)
             try:
                 cfg.validate()

@@ -54,12 +54,21 @@ def comm_stats(strategy) -> Dict[str, float]:
         params, _, _ = _model_params(strategy)
         r = strategy.world_size
         pbytes = float(pb(params))
-        wire_itemsize = np.dtype(
-            getattr(strategy, "wire_dtype", "float32")).itemsize
+        wire_dtype = np.dtype(getattr(strategy, "wire_dtype", "float32"))
+        wire_itemsize = wire_dtype.itemsize
         # gradient elements ride the wire in the (possibly narrowed)
-        # --allreduce-dtype; params are f32 (pb already prices them)
+        # --allreduce-dtype (int8 = quarter f32 bytes); params are f32
+        # (pb already prices them)
         grad_wire = pbytes / 4.0 * wire_itemsize
         meta = getattr(strategy, "_flat_meta", None)
+        if meta is not None:
+            # bucketed collectives (--comm-buckets) change neither the
+            # logical nor the physical totals — the buckets partition the
+            # same padded vector (per-bucket pads are already in
+            # meta.padded) — only WHEN the bytes move; the per-bucket
+            # split is reported for the span/overlap tooling.
+            out["comm_buckets"] = float(meta.num_buckets)
+            out["wire_dtype"] = str(wire_dtype)
         if getattr(strategy, "shard_update", False):
             out["reduce_scatter_bytes"] = (r - 1) / r * grad_wire
             out["all_gather_bytes"] = (r - 1) / r * pbytes
@@ -67,11 +76,19 @@ def comm_stats(strategy) -> Dict[str, float]:
             out["physical_reduce_scatter_bytes"] = (
                 (r - 1) / r * meta.padded * wire_itemsize)
             out["physical_all_gather_bytes"] = (r - 1) / r * meta.padded * 4.0
+            if wire_dtype == np.dtype(np.int8):
+                # int8 adds one psum'd f32 scale per bucket (the shared
+                # absmax) — priced so the accounting stays EXACT
+                out["scale_bytes"] = _ring_allreduce_bytes(
+                    4.0 * meta.num_buckets, r)
         else:
             out["allreduce_bytes"] = _ring_allreduce_bytes(grad_wire, r)
-            if meta is not None:  # explicit bf16 engine, replicated update
+            if meta is not None:  # explicit wire engine, replicated update
                 out["physical_allreduce_bytes"] = _ring_allreduce_bytes(
                     float(meta.padded * wire_itemsize), r)
+                if wire_dtype == np.dtype(np.int8):
+                    out["scale_bytes"] = _ring_allreduce_bytes(
+                        4.0 * meta.num_buckets, r)
     elif name in ("HeteroGPipeStrategy", "HeteroPipeDreamStrategy"):
         # Uneven hybrid PPxDP (parallel/hetero.py). boundary/allreduce are
         # LOGICAL payload bytes (reference RuntimeStats parity,

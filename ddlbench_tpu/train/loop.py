@@ -488,7 +488,13 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                 if actlog is not None and actlog.should_log(epoch, step):
                     bx, by = fetched.raw
                     try:
-                        path = actlog.log(epoch, step, ts.params,
+                        # overlapped dp keeps params as a flat sharded
+                        # vector between steps; ask the strategy for the
+                        # per-layer pytree instead of touching ts.params
+                        p_log = (strategy.materialize_params(ts)
+                                 if hasattr(strategy, "materialize_params")
+                                 else ts.params)
+                        path = actlog.log(epoch, step, p_log,
                                           ts.model_state, bx, by)
                     except RuntimeError as e:  # e.g. non-addressable sharded params
                         print(f"activation logging failed ({e}); disabled",

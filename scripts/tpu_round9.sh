@@ -1,0 +1,50 @@
+#!/usr/bin/env bash
+# Round-9 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the comm/compute-overlap round: the bucketed dp
+# engine A/B (--comm-buckets 1 vs 4 vs 8) across the wire dtypes
+# (f32/bf16/int8), wire-level bucketed-collective microbenchmarks, and an
+# XLA trace capture for the overlap-fraction reducer
+# (python -m ddlbench_tpu.telemetry.overlap). Expectations in PERF.md §
+# round 9: overlapped step time < monolithic at equal numerics (f32
+# bitwise-pinned by tier-1), int8 wire bytes = 1/4 f32.
+#
+# Usage: scripts/tpu_round9.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task scalebench_dpshard_r6   python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update
+add_task chaosbench_stability_r8 python -m ddlbench_tpu.tools.chaosbench --kills 1 --preempts 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r8_work --keep-workdir --json perf_runs/chaosbench_r8.json -- --anomaly-policy skip --inject nan-grad@2:7
+add_task guard_overhead_off_r8 python -m ddlbench_tpu.cli -b mnist -m resnet18 --batch-size 32 -e 1 --steps-per-epoch 200 --jsonl perf_runs/guard_off_r8.jsonl
+add_task guard_overhead_on_r8 python -m ddlbench_tpu.cli -b mnist -m resnet18 --batch-size 32 -e 1 --steps-per-epoch 200 --anomaly-policy skip --jsonl perf_runs/guard_on_r8.jsonl
+
+# -- round-9: comm/compute overlap A/B (buckets x wire dtype) ---------------
+# bench.py records platform/jax_backend in every JSON now; a cpu-fallback
+# window leaves loudly-labeled records instead of poisoning the trajectory.
+# Buckets 1 is the monolithic PR 3 program (the control); 4 and 8 are the
+# overlapped engine under the async-collective XLA flags
+# (distributed.comm_flags, applied automatically when --comm-buckets > 1).
+add_task bench_ov_b1_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 1
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task bench_ov_b8_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 8
+add_task bench_ov_b4_bf16_r9 python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4 --allreduce-dtype bf16
+add_task bench_ov_b4_int8_r9 python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4 --allreduce-dtype int8
+add_task bench_int8_mono_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --allreduce-dtype int8
+# scaling curve for the overlapped engine vs the monolithic control
+add_task scalebench_ov_b4_r9 python -m ddlbench_tpu.tools.scalebench -b imagenet -m resnet50 --strategies dp --steps 20 --repeats 3 --dp-shard-update --comm-buckets 4
+# wire-level bucketed-collective cost, independent of the train step:
+# RS/AG sweep over bucket counts (commbench --buckets)
+add_task commbench_buckets_r9 python -m ddlbench_tpu.tools.commbench --collectives reduce_scatter,all_gather --sizes 1e6,1e7,1e8 --buckets 1,4,8 --iters 10
+# digits-parity gate for the int8 wire (the bf16 harness, new rows) + the
+# overlapped-engine end-to-end cross-check
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+# XLA device trace of the overlapped engine for the overlap-fraction
+# reducer: export via Perfetto/TensorBoard, then
+#   python -m ddlbench_tpu.telemetry.overlap <exported>.json
+add_task trace_ov_b4_r9 python -m ddlbench_tpu.cli -b imagenet -m resnet50 -f dp -g 4 --batch-size 64 -e 1 --steps-per-epoch 30 --dp-shard-update --comm-buckets 4 --trace perf_runs/trace_ov_b4_r9.json --trace-dir perf_runs/xla_ov_b4_r9 --xla-trace-steps 10:14
+
+window_loop "${1:-11}"

@@ -297,10 +297,15 @@ def test_validate_gates():
         _cfg(arch="transformer_moe_s", benchmark="synthtext",
              dp_shard_update=True)
     with pytest.raises(ValueError, match="allreduce_dtype"):
-        _cfg(allreduce_dtype="int8")
+        _cfg(allreduce_dtype="fp4")
     with pytest.raises(ValueError, match="dp strategy"):
         _cfg(strategy="single", num_devices=1, allreduce_dtype="bf16")
     cfg = _cfg(allreduce_dtype="bf16")
     assert cfg.resolved_allreduce_dtype() == "bfloat16"
     assert cfg.dp_explicit_collectives()
     assert not _cfg().dp_explicit_collectives()
+    # int8 is a valid wire dtype since ISSUE 6 (stochastic-rounding path);
+    # it routes through the explicit engine like bf16
+    cfg8 = _cfg(allreduce_dtype="int8")
+    assert cfg8.resolved_allreduce_dtype() == "int8"
+    assert cfg8.dp_explicit_collectives()

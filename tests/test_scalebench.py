@@ -12,10 +12,18 @@ def test_scalebench_emits_curve(devices, capsys):
 
     rc = main(["-b", "mnist", "-m", "lenet", "--devices", "2",
                "--strategies", "dp,gpipe", "--steps", "2", "--warmup", "1",
-               "--dtype", "float32", "--batch-size", "4"])
+               "--dtype", "float32", "--batch-size", "4",
+               "--platform", "cpu"])
     assert rc == 0
-    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
-             if l.startswith("{")]
+    docs = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")]
+    # backend-provenance header: every artifact self-identifies (a silent
+    # cpu-fallback must never masquerade as a chip curve)
+    prov = [d for d in docs if "provenance" in d]
+    assert len(prov) == 1
+    assert prov[0]["provenance"]["jax_backend"] == "cpu"
+    assert prov[0]["provenance"]["cpu_fallback"] is False  # cpu was asked for
+    lines = [d for d in docs if "provenance" not in d]
     strategies = {(d["strategy"], d["devices"]) for d in lines}
     assert ("single", 1) in strategies
     assert ("dp", 2) in strategies and ("gpipe", 2) in strategies
