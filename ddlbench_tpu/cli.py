@@ -57,6 +57,19 @@ def build_parser() -> argparse.ArgumentParser:
                    help="interleaved schedule (gpipe or pipedream): model "
                         "chunks per device (cuts the pipeline bubble by "
                         "this factor)")
+    from ddlbench_tpu.partition.schedule import PIPE_SCHEDULES
+
+    p.add_argument("--pipe-schedule", default="fill-drain",
+                   choices=PIPE_SCHEDULES,
+                   help="pipeline timetable for -f gpipe, executed by the "
+                        "schedule-programmable runtime "
+                        "(parallel/pipeline_rt.py): fill-drain = GPipe "
+                        "flush (default), 1f1b = synchronous "
+                        "one-forward-one-backward, interleaved = 1F1B over "
+                        "stages x --virtual-stages chunks, zero-bubble = "
+                        "ZB-H1 split backward (weight-grad events fill the "
+                        "drain bubble). pipedream remains the ASYNC 1F1B "
+                        "engine (weight stashing)")
     p.add_argument("--dp-replicas", type=int, default=1)
     p.add_argument("--tp-size", type=int, default=1,
                    help="composed tensor x pipeline parallelism (gpipe + "
@@ -233,6 +246,7 @@ def config_from_args(args) -> RunConfig:
         num_microbatches=args.num_microbatches,
         num_stages=args.stages,
         virtual_stages=args.virtual_stages,
+        pipe_schedule=args.pipe_schedule,
         dp_replicas=args.dp_replicas,
         tp_size=args.tp_size,
         stage_replication=(tuple(int(r) for r in

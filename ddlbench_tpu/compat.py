@@ -143,6 +143,29 @@ def _install_prevma():
     sm._rewrite_rules[sm.pbroadcast_p] = partial(
         sm._no_rewrite, sm.pbroadcast_p, _pbroadcast_check)
 
+    # standard per-primitive check, relaxed to intersection-join semantics:
+    # stock demands every argument's rep set be IDENTICAL, but our lenient
+    # cond/scan joins below (and the identity pbroadcast transpose) re-walk
+    # rewritten jaxprs under and-merged reps, where a pbroadcast that
+    # aligned two args at trace time no longer produces equal sets — e.g.
+    # tpp's pad_vec pads a 'model'-replicated activation with a scan-carry
+    # zero that the join demoted to fully varying. The sound output rep
+    # under mixed inputs is the intersection (an output can only be known
+    # replicated over axes EVERY input is), which is exactly what jax's own
+    # rewrite pass converges to.
+    def _lenient_standard(prim, mesh, *in_rep, **__):
+        in_rep_ = [r for r in in_rep if r is not None]
+        if not in_rep_:
+            return None
+        out = set(in_rep_[0])
+        for r in in_rep_[1:]:
+            out &= r
+        return out
+
+    for prim, rule in list(sm._check_rules.items()):
+        if getattr(rule, "func", None) is sm._standard_check:
+            sm._check_rules[prim] = partial(_lenient_standard, prim)
+
     # cond check: stock demands EXACT rep equality across branches —
     # including grad residuals, where one branch may save a constant (rep
     # None) and another a computed value (rep set()). jax's own rewrite

@@ -257,6 +257,18 @@ class RunConfig:
     # the cost of more (cheap, ICI-neighbor) rotations. Requires
     # num_microbatches % stages == 0 when > 1.
     virtual_stages: int = 1
+    # Pipeline schedule for the gpipe-family strategies — a TIMETABLE the
+    # schedule-programmable runtime executes (partition/schedule.py data,
+    # parallel/pipeline_rt.py engine), not a separate engine per schedule:
+    # * "fill-drain"  — GPipe flush (the autodiff scan; the default, and
+    #                   bitwise the legacy gpipe program),
+    # * "1f1b"        — synchronous 1F1B (same weights every microbatch,
+    #                   one update per step; bubble 2(S-1)/(3M+2(S-1))),
+    # * "interleaved" — interleaved 1F1B over S x virtual_stages chunks,
+    # * "zero-bubble" — ZB-H1-style split backward: weight-grad events
+    #                   fill the drain bubble ((S-1)/(3M+S-1)).
+    # pipedream keeps its own ASYNC 1F1B engine (weight stashing).
+    pipe_schedule: str = "fill-drain"
     # Composed tensor x pipeline parallelism (gpipe + transformer archs):
     # each pipeline stage's blocks are Megatron-sliced this many ways over a
     # 'model' mesh axis inside the stage (parallel/tpp.py). num_devices =
@@ -562,22 +574,23 @@ class RunConfig:
                 raise ValueError(
                     "anomaly_policy='rewind' needs --checkpoint-dir (the "
                     "rewind target is the last committed checkpoint)")
-            if self.anomaly_policy == "skip" and self.strategy in (
-                    "sp", "tp", "fsdp", "ep"):
+            from ddlbench_tpu.guard.policy import GUARD_UNWIRED_STRATEGIES
+
+            if self.anomaly_policy == "skip" and \
+                    self.strategy in GUARD_UNWIRED_STRATEGIES:
                 raise ValueError(
-                    f"anomaly_policy='skip' (in-step update drop) is wired "
-                    f"into single/dp/gpipe/pipedream train steps, not "
-                    f"{self.strategy!r}; use abort/warn/rewind there")
+                    f"anomaly_policy='skip' (in-step update drop) needs "
+                    f"device-guard wiring, which the {self.strategy!r} "
+                    f"engine lacks; use abort/warn/rewind there")
         if self.anomaly_budget < 1:
             raise ValueError("anomaly_budget must be >= 1")
         self.resolved_loss_scale()  # raises on malformed values
-        if self.loss_scale is not None and self.strategy not in (
-                "single", "dp", "gpipe"):
+        if self.loss_scale is not None and self.strategy == "pipedream":
             raise ValueError(
-                f"loss_scale is wired into the single/dp/gpipe (incl. "
-                f"tp_size > 1) train steps; {self.strategy!r} runs "
-                f"unscaled (pipedream's per-microbatch updates would need "
-                f"per-event unscaling)")
+                "loss_scale is wired into the one-update-per-step train "
+                "steps (single/dp/gpipe incl. tp_size > 1, sp/tp/fsdp/ep); "
+                "pipedream's per-microbatch updates would need per-event "
+                "unscaling and run unscaled")
         if self.grad_spike_factor <= 1.0:
             raise ValueError("grad_spike_factor must be > 1")
         if self.attention_backend not in ATTENTION_BACKENDS:
@@ -698,6 +711,36 @@ class RunConfig:
                     "supported")
         if self.virtual_stages < 1:
             raise ValueError("virtual_stages must be >= 1")
+        from ddlbench_tpu.partition.schedule import PIPE_SCHEDULES
+
+        if self.pipe_schedule not in PIPE_SCHEDULES:
+            raise ValueError(
+                f"unknown pipe_schedule {self.pipe_schedule!r} "
+                f"(choose from {', '.join(PIPE_SCHEDULES)})")
+        if self.pipe_schedule != "fill-drain":
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    f"pipe_schedule={self.pipe_schedule!r} runs on the "
+                    f"gpipe strategy's schedule runtime "
+                    f"(parallel/pipeline_rt.py); pipedream is the ASYNC "
+                    f"1F1B engine and {self.strategy!r} has no pipeline")
+            if self.tp_size > 1:
+                raise ValueError(
+                    "tp_size > 1 composes with the fill-drain schedule "
+                    "(parallel/tpp.py); event-mode schedules are scoped "
+                    "to the 2-D data x stage mesh")
+            if self.stage_replication is not None:
+                raise ValueError(
+                    "stage_replication (hetero pipeline) executes the "
+                    "fill-drain schedule only")
+            if self.pipe_schedule == "1f1b" and self.virtual_stages > 1:
+                raise ValueError(
+                    "1f1b is the V=1 schedule; use "
+                    "pipe_schedule='interleaved' with virtual_stages")
+            if self.pipe_schedule == "zero-bubble" and \
+                    self.virtual_stages > 1:
+                raise ValueError(
+                    "zero-bubble (ZB-H1) is scoped to virtual_stages=1")
         if self.update_interval < 1:
             raise ValueError("update_interval must be >= 1")
         if self.update_interval > 1:

@@ -38,10 +38,15 @@ from ddlbench_tpu.train.watchdog import TrainingFailure, check_finite
 
 ANOMALY_POLICIES = ("abort", "warn", "ignore", "skip", "rewind")
 
-# Strategies whose engines carry no device-guard wiring: they emit no
-# (finite, grad_norm) metrics even with the guard armed, so in-step `skip`
-# is rejected (config.validate) and the grad-spike fault cannot fire there.
-GUARD_UNWIRED_STRATEGIES = ("sp", "tp", "fsdp", "ep")
+# Strategies whose engines carry no device-guard wiring (they would emit no
+# (finite, grad_norm) metrics even with the guard armed). Empty since the
+# sp/tp/fsdp/ep engines were wired (ROADMAP item 4's remaining half):
+# tp/fsdp reuse the single/dp one-jit guarded step, sp/ep thread the
+# objective multiplier through their shard_map like tpp. Kept as the ONE
+# registry a future unwired engine must name itself in — config.validate,
+# the run-time grad-spike warning, and the conformance matrix's xfail set
+# all read it.
+GUARD_UNWIRED_STRATEGIES = ()
 
 # EWMA spike detector tuning: the smoothing weight of each new observation
 # and the observations needed before spike checks arm (the first steps of a

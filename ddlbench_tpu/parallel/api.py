@@ -302,13 +302,24 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
             print(f"auto-partition: packed-boundary chain, "
                   f"{len(model.layers)} spans", flush=True)
         if cfg.strategy == "gpipe":
-            from ddlbench_tpu.partition.schedule import recommend_virtual_stages
+            from ddlbench_tpu.partition.schedule import (
+                recommend_schedule, recommend_virtual_stages)
 
             _, chunks = cfg.resolved_batches()
             table = recommend_virtual_stages(
                 cfg.resolved_stages(), chunks, len(model.layers))
             print(f"schedule advisor (S={cfg.resolved_stages()}, M={chunks}): "
                   f"{table}", flush=True)
+            # schedules are data now: advise the best TIMETABLE at the
+            # chosen V, not just the best V
+            sched = recommend_schedule(cfg.resolved_stages(), chunks,
+                                       cfg.virtual_stages)
+            best = sched[0]
+            tail = ("" if best["schedule"] == cfg.pipe_schedule else
+                    f" (run has --pipe-schedule {cfg.pipe_schedule})")
+            print(f"schedule advisor: best schedule at V="
+                  f"{cfg.virtual_stages} is {best['schedule']} "
+                  f"(bubble {best['bubble']}){tail}: {sched}", flush=True)
     if (stage_bounds is None and cfg.strategy in ("gpipe", "pipedream")):
         # Manual (non-auto-partition) pipeline run on a branchy arch: the
         # articulation chain is hopeless to balance (nasnet's whole cell
@@ -359,6 +370,15 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
 
             return TPGPipeStrategy(model, cfg, devices=devices,
                                    stage_bounds=stage_bounds)
+        if cfg.pipe_schedule != "fill-drain":
+            # schedule-programmable runtime: 1f1b / interleaved /
+            # zero-bubble are TIMETABLES compiled by one event-mode engine
+            # (parallel/pipeline_rt.py), not engines of their own
+            from ddlbench_tpu.parallel.pipeline_rt import (
+                ScheduledPipelineStrategy)
+
+            return ScheduledPipelineStrategy(model, cfg, devices=devices,
+                                             stage_bounds=stage_bounds)
         from ddlbench_tpu.parallel.gpipe import GPipeStrategy
 
         return GPipeStrategy(model, cfg, devices=devices, stage_bounds=stage_bounds)

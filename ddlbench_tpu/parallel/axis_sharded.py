@@ -55,6 +55,8 @@ class AxisShardedStrategy:
     def __init__(self, model: LayerModel, cfg: RunConfig,
                  mesh: Optional[Mesh] = None,
                  devices: Optional[Sequence[jax.Device]] = None):
+        from ddlbench_tpu.guard import device_guard
+
         self.model = model
         self.cfg = cfg
         devs = list(devices or jax.devices())[:cfg.num_devices]
@@ -67,6 +69,7 @@ class AxisShardedStrategy:
         n = self.mesh.devices.size
         axis = self.axis_name
         self._check_divisibility(n)
+        guard = self._guard = device_guard(cfg)  # None = pre-guard program
 
         self._replicated = NamedSharding(self.mesh, P())
         self._batch_sharding = NamedSharding(self.mesh, self._batch_spec())
@@ -130,14 +133,29 @@ class AxisShardedStrategy:
             return loss, ce, correct, correct5, count, new_state
 
         def make_sharded(train: bool):
-            def inner(params, state, xl, yl):
-                return fwd_local(params, state, xl, yl, train)
+            # Guard objective multiplier (loss scale x nan-grad poison
+            # carrier): applied INSIDE the shard_map, same reasoning as
+            # tpp's pipe fn — an outside-seeded scaled cotangent can fail
+            # the axis replication checks; in-shard, the extra P() input is
+            # replicated by construction. Unarmed traces take no extra arg
+            # and compile the exact pre-guard program.
+            guarded = train and guard is not None
 
+            def inner(params, state, xl, yl, *guard_args):
+                out = fwd_local(params, state, xl, yl, train)
+                if guarded:
+                    loss, *rest = out
+                    out = (loss * guard_args[0], *rest)
+                return out
+
+            in_specs = (self._param_specs(), P(), self._batch_spec(),
+                        self._batch_spec())
+            if guarded:
+                in_specs = in_specs + (P(),)
             return _shard_map(
                 inner,
                 mesh=self.mesh,
-                in_specs=(self._param_specs(), P(), self._batch_spec(),
-                          self._batch_spec()),
+                in_specs=in_specs,
                 out_specs=(P(), P(), P(), P(), P(), P()),
             )
 
@@ -145,19 +163,37 @@ class AxisShardedStrategy:
         fn_eval = make_sharded(False)
 
         def train_step(ts: TrainState, x, y, lr):
+            # Stability guard (ROADMAP item 4): sp/ep grad THROUGH the
+            # shard_map like tpp, so the wiring mirrors tpp's train step.
+            gstate, smul, opt_in = None, None, ts.opt
+            if guard is not None:
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
+
             def loss_fn(params):
+                args = (smul,) if smul is not None else ()
                 loss, ce, correct, _c5, count, new_state = fn_train(
-                    params, ts.model_state, x, y)
+                    params, ts.model_state, x, y, *args)
                 return loss, (ce, correct, count, new_state)
 
             (_, (ce, correct, count, new_state)), grads = jax.value_and_grad(
                 loss_fn, has_aux=True
             )(ts.params)
-            params, opt = opt_update(ts.params, grads, ts.opt, lr)
+            gm = None
+            if guard is not None:
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
+            params, opt = opt_update(ts.params, grads, opt_in, lr)
+            if guard is not None:
+                params, new_state, opt, gm = guard.commit(
+                    finite, gnorm, gstate, (params, new_state, opt),
+                    (ts.params, ts.model_state, opt_in))
             metrics = {
                 "loss": ce,  # headline metric stays comparable across strategies
                 "accuracy": correct.astype(jnp.float32) / jnp.maximum(1.0, count),
             }
+            if gm is not None:
+                metrics.update(gm)
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
@@ -208,8 +244,17 @@ class AxisShardedStrategy:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, self._opt_init(params))
-        return put_global_tree(ts, self._initial_state_sharding(ts))
+        opt = self._opt_init(params)
+        if self._guard is not None:
+            opt = self._guard.attach_opt_state(opt)  # dynamic loss scale
+        ts = TrainState(params, state, opt)
+        sharding = self._initial_state_sharding(ts)
+        if self._guard is not None and isinstance(sharding, TrainState):
+            # per-leaf sharding trees (ep) must mirror the guard opt entry
+            sharding = TrainState(
+                sharding.params, sharding.model_state,
+                self._guard.opt_state_spec(sharding.opt, self._replicated))
+        return put_global_tree(ts, sharding)
 
     def shard_batch(self, x, y):
         from ddlbench_tpu.distributed import put_global_batch

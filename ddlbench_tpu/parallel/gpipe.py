@@ -299,18 +299,19 @@ class GPipeStrategy:
     def _make_pipe_fn(self, train: bool):
         """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval).
 
-        Timetable: chunk c = v*S + s (on device s) runs microbatch
-        m = g*S + r at tick t = g*S*V + v*S + s + r — conflict-free (for a
-        fixed device the (g, v, r) triple is a mixed-radix decomposition of
-        t - s) and dependency-correct (chunk c+1 runs exactly one tick after
-        chunk c, so the handoff is always a one-step ring rotation, wrapping
-        S-1 -> 0 between chunk groups). Fill/drain cost is S-1 CHUNK times
-        instead of the classic (S-1) stage times — the interleaved-schedule
-        bubble reduction — at the price of C-1 rotations per microbatch.
-        For V = 1 this degenerates to the classic t = m + s timetable
-        (non-wrapping permute kept for that case). The backward pipeline is
-        jax.grad through this scan, inheriting the same schedule reversed.
-        Requires M % S == 0 when V > 1 (microbatch groups of S).
+        The schedule is DATA (partition/schedule.py fill_drain_timetable):
+        chunk c = v*S + s (on device s) runs microbatch m = g*S + r at tick
+        t = g*S*V + v*S + s + r — conflict-free and dependency-correct
+        (chunk c+1 runs exactly one tick after chunk c, so the handoff is
+        always a one-step ring rotation, wrapping S-1 -> 0 between chunk
+        groups). The scan body reads its (v, m, valid) triple from the
+        table's forward_tick_arrays — the schedule-programmable runtime's
+        autodiff mode (parallel/pipeline_rt.py module docstring); the
+        backward half of the timetable is jax.grad through this scan,
+        inheriting the same schedule reversed. Fill/drain cost is S-1
+        CHUNK times instead of the classic (S-1) stage times — the
+        interleaved-schedule bubble reduction — at the price of C-1
+        rotations per microbatch. Requires M % S == 0 when V > 1.
         """
         S, M, A = self.num_stages, self.num_microbatches, self._act_size
         V, C = self.vstages, self.num_chunks
@@ -321,6 +322,17 @@ class GPipeStrategy:
             perm = [(i, i + 1) for i in range(S - 1)]
         else:
             perm = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
+        from ddlbench_tpu.partition.schedule import fill_drain_timetable
+
+        tt = fill_drain_timetable(S, M, V)
+        if train:
+            # the TRAIN schedule drives --trace pipe_tick markers; eval
+            # always runs fill-drain, and pipedream (async 1F1B train, no
+            # static half-tick table) must not inherit this one
+            self.timetable = tt
+        tv_np, tm_np, tvalid_np = tt.forward_tick_arrays()
+        t_v, t_m, t_valid = (jnp.asarray(tv_np), jnp.asarray(tm_np),
+                             jnp.asarray(tvalid_np))
 
         def inner(params_rows, state_rows, xs, ys):
             # params_rows local: [1, L] (V=1) or [V, 1, L]; xs [M, mb, ...]
@@ -342,13 +354,9 @@ class GPipeStrategy:
             def body(carry, t):
                 (x_buf, st_rows, loss_acc, ce_acc, aux_acc, corr_acc,
                  corr5_acc) = carry
-                u = t - s_idx
-                g = u // (S * V)
-                rem = u % (S * V)  # jnp mod: non-negative for positive divisor
-                v = jnp.clip(rem // S, 0, V - 1)
-                r = rem % S
-                valid = (u >= 0) & (u < M * V)
-                m = jnp.clip(g * S + r, 0, M - 1)
+                v = t_v[t, s_idx]
+                valid = t_valid[t, s_idx]
+                m = t_m[t, s_idx]
                 chunk = v * S + s_idx
                 param_row = lax.dynamic_index_in_dim(param_rows, v,
                                                      keepdims=False)

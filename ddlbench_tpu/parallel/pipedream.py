@@ -53,12 +53,10 @@ from typing import Any, NamedTuple, Optional
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.flatten_util import ravel_pytree
-from jax.sharding import NamedSharding, PartitionSpec as P
+from jax.sharding import PartitionSpec as P
 
-from ddlbench_tpu.models.layers import apply_slice
-from ddlbench_tpu.parallel.common import (
-    cast_input, cast_params, correct_and_count, cross_entropy_loss)
+from ddlbench_tpu.parallel.common import (correct_and_count,
+                                          cross_entropy_loss)
 from ddlbench_tpu.parallel.gpipe import GPipeStrategy, _shard_map, _vary
 from ddlbench_tpu.parallel.packing import pad_vec
 
@@ -121,74 +119,19 @@ class PipeDreamStrategy(GPipeStrategy):
         return PDTrainState(ts.params, ts.model_state, ts.opt)
 
     def _make_stage_fwd(self, s: int):
-        """Pure stage forward:
-        (param_row, state_row, x) -> (y, new_state_row, aux).
+        """Shared with the schedule runtime — parallel/pipeline_rt.py
+        make_stage_fwd (the vjp-friendly chunk forward both engines'
+        recompute-based backwards take vjps of)."""
+        from ddlbench_tpu.parallel.pipeline_rt import make_stage_fwd
 
-        Unlike the gpipe branch this is vjp-friendly: no input unpacking from a
-        shared buffer, no loss; shapes are the stage's true shapes. ``aux`` is
-        the sum of this stage's MoE router load-balance terms (zero for dense
-        stages); the backward adds cfg.moe_aux_weight * aux to the
-        per-microbatch objective.
-        """
-        from ddlbench_tpu.models.moe import collect_aux_losses
-
-        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
-        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
-        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
-        cdtype = self.compute_dtype
-
-        def stage_fwd(param_row, state_row, x):
-            params = cast_params(p_unravel(param_row[:p_len]), cdtype)
-            states = s_unravel(state_row[:s_len])
-            aux: list = []
-            with collect_aux_losses(aux):
-                y, new_states = apply_slice(layers, params, states,
-                                            cast_input(x, cdtype), True)
-            new_state_row = pad_vec(
-                ravel_pytree(new_states)[0].astype(jnp.float32), state_row.shape[0]
-            )
-            return y, new_state_row, sum(aux, jnp.float32(0.0))
-
-        return stage_fwd
+        return make_stage_fwd(self, s)
 
     def _make_stage_fwd_fused(self, s: int):
-        """Fused-head variant for the LAST stage (ops/fused_xent.py): applies
-        the stage body, then the head's fused projection+CE — the
-        [mb*T, vocab] logits never materialize. Returns None when the model's
-        head has no fused path or cfg disables it.
+        """Shared with the schedule runtime — parallel/pipeline_rt.py
+        make_stage_fwd_fused (fused projection+CE last-chunk variant)."""
+        from ddlbench_tpu.parallel.pipeline_rt import make_stage_fwd_fused
 
-        Signature: (param_row, state_row, x, labels)
-                   -> (obj_sum, ce_sum, correct, new_state_row, aux).
-        """
-        from ddlbench_tpu.models.moe import collect_aux_losses
-
-        head = self.model.layers[-1]
-        if not (self.cfg.fused_head_loss and head.fused_loss is not None):
-            return None
-        layers = self.model.layers[self.bounds[s]:self.bounds[s + 1]]
-        p_unravel, p_len = self._p_unravels[s], self._p_lens[s]
-        s_unravel, s_len = self._s_unravels[s], self._s_lens[s]
-        cdtype = self.compute_dtype
-        smooth = self.cfg.resolved_label_smoothing()
-
-        def stage_fwd_fused(param_row, state_row, x, labels):
-            from ddlbench_tpu.parallel.common import fused_slice_loss_sums
-
-            params = cast_params(p_unravel(param_row[:p_len]), cdtype)
-            states = s_unravel(state_row[:s_len])
-            aux: list = []
-            with collect_aux_losses(aux):
-                obj_sum, ce_sum, correct, new_states = fused_slice_loss_sums(
-                    layers, params, states, cast_input(x, cdtype), labels,
-                    smooth)
-            new_state_row = pad_vec(
-                ravel_pytree(new_states)[0].astype(jnp.float32),
-                state_row.shape[0]
-            )
-            return (obj_sum, ce_sum, correct, new_state_row,
-                    sum(aux, jnp.float32(0.0)))
-
-        return stage_fwd_fused
+        return make_stage_fwd_fused(self, s)
 
     def _make_train_step(self):
         """Async 1F1B over C = S*V chunks, V per device (class docstring).

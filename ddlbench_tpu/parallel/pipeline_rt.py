@@ -1,0 +1,545 @@
+"""Programmable pipeline-schedule runtime: timetables in, one engine out.
+
+ROADMAP item 2 / Piper's thesis (PAPERS.md): a pipeline SCHEDULE should be
+a description consumed by one runtime, not an engine. partition/schedule.py
+ships four timetables as data — fill-drain (GPipe), synchronous 1F1B,
+interleaved-1F1B, zero-bubble (ZB-H1-style split backward) — and this
+module compiles any of them to the one-XLA-program scan+ppermute machinery
+the legacy gpipe/pipedream engines each reimplemented:
+
+* **autodiff mode** (fill-drain, any V): the timetable's forward phase
+  drives a `lax.scan` over T = M*V + S - 1 ticks (`lax.switch` per tick,
+  ring ppermute handoffs); `jax.grad` through the scan realizes the
+  table's backward half automatically (ppermute transposes to the reverse
+  permutation, jax.checkpoint per stage = recompute). parallel/gpipe.py
+  and parallel/tpp.py consume the table through
+  :func:`Timetable.forward_tick_arrays` — the closed-form index math they
+  used to inline now lives in the schedule description.
+* **event mode** (1f1b / interleaved / zero-bubble —
+  :class:`ScheduledPipelineStrategy`): a `lax.scan` over the table's H
+  half-ticks; each device looks up its event (idle / F / B / W) and
+  microbatch from the table constants, dispatches through `lax.switch`,
+  and exchanges one activation + one cotangent buffer per half-tick on the
+  stage ring. The backward is recompute-based per event: B takes the vjp
+  w.r.t. the stashed INPUT (producing the upstream cotangent), W the vjp
+  w.r.t. the parameters (accumulated into a flat per-chunk gradient) — the
+  ZB-H1 event split, which is what lets the zero-bubble table fill the
+  drain with W events. Synchronous semantics throughout: every microbatch
+  runs at the step-start weights, gradients accumulate, ONE optimizer
+  update per step — so no weight stash ring (pipedream's async 1F1B keeps
+  its own engine for exactly that feature, but shares this module's stage
+  forward builders).
+
+Parity contract (tests/test_pipeline_rt.py, `pipesched` marker):
+fill-drain through the runtime is bitwise the legacy gpipe program; the
+event schedules are trajectory-pinned against it — the per-step gradient
+SUMS match, with drift bounded by f32 reduction-order only (the event
+engine accumulates per-microbatch grads in schedule order and divides by M
+once; autodiff accumulates in reversed-scan order with the 1/M folded into
+the cotangent seed). Documented deviation: B/W recompute uses the
+step-current model state, so BN running stats see post-F updates — BN
+archs are execution-tested, stateless archs parity-pinned (the same
+tradeoff parallel/pipedream.py documents).
+
+The 3-D `data x stage x model` mesh composes because the runtime owns ONLY
+the stage axis: dp replicas ride the 'data' axis exactly as in gpipe (the
+gradient pmean over 'data' after the scan), and tpp keeps its 'model' axis
+inside the switch branches.
+"""
+
+from __future__ import annotations
+
+import math
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import PartitionSpec as P
+
+from ddlbench_tpu.models.layers import apply_slice
+from ddlbench_tpu.parallel.common import (
+    cast_input, cast_params, correct_and_count, cross_entropy_loss)
+from ddlbench_tpu.parallel.gpipe import (GPipeStrategy, PipeTrainState,
+                                         _shard_map, _vary)
+from ddlbench_tpu.parallel.packing import pad_vec
+from ddlbench_tpu.partition.schedule import (EVENT_BWD_IN, EVENT_BWD_W,
+                                             Timetable, make_timetable)
+
+
+# -- shared stage forward builders (moved from parallel/pipedream.py) ------
+
+
+def make_stage_fwd(strategy, c: int):
+    """Pure chunk forward for vjp-based backward events:
+    ``(param_row, state_row, x) -> (y, new_state_row, aux)``.
+
+    Unlike the autodiff-mode branch this has the chunk's TRUE shapes (no
+    shared-buffer unpacking, no loss), so `jax.vjp` at a stashed input
+    reproduces exactly the per-(microbatch, chunk) backward. ``aux`` is
+    the sum of the chunk's MoE router load-balance terms (zero for dense
+    chunks). Shared by the event-mode runtime and pipedream's async 1F1B.
+    """
+    from ddlbench_tpu.models.moe import collect_aux_losses
+
+    layers = strategy.model.layers[strategy.bounds[c]:strategy.bounds[c + 1]]
+    p_unravel, p_len = strategy._p_unravels[c], strategy._p_lens[c]
+    s_unravel, s_len = strategy._s_unravels[c], strategy._s_lens[c]
+    cdtype = strategy.compute_dtype
+
+    def stage_fwd(param_row, state_row, x):
+        params = cast_params(p_unravel(param_row[:p_len]), cdtype)
+        states = s_unravel(state_row[:s_len])
+        aux: list = []
+        with collect_aux_losses(aux):
+            y, new_states = apply_slice(layers, params, states,
+                                        cast_input(x, cdtype), True)
+        new_state_row = pad_vec(
+            ravel_pytree(new_states)[0].astype(jnp.float32),
+            state_row.shape[0]
+        )
+        return y, new_state_row, sum(aux, jnp.float32(0.0))
+
+    return stage_fwd
+
+
+def make_stage_fwd_fused(strategy, c: int):
+    """Fused-head variant for the LAST chunk (ops/fused_xent.py): applies
+    the chunk body, then the head's fused projection+CE — the
+    [mb*T, vocab] logits never materialize. Returns None when the model's
+    head has no fused path or cfg disables it.
+
+    Signature: ``(param_row, state_row, x, labels)
+    -> (obj_sum, ce_sum, correct, new_state_row, aux)``.
+    """
+    from ddlbench_tpu.models.moe import collect_aux_losses
+
+    head = strategy.model.layers[-1]
+    if not (strategy.cfg.fused_head_loss and head.fused_loss is not None):
+        return None
+    layers = strategy.model.layers[strategy.bounds[c]:strategy.bounds[c + 1]]
+    p_unravel, p_len = strategy._p_unravels[c], strategy._p_lens[c]
+    s_unravel, s_len = strategy._s_unravels[c], strategy._s_lens[c]
+    cdtype = strategy.compute_dtype
+    smooth = strategy.cfg.resolved_label_smoothing()
+
+    def stage_fwd_fused(param_row, state_row, x, labels):
+        from ddlbench_tpu.parallel.common import fused_slice_loss_sums
+
+        params = cast_params(p_unravel(param_row[:p_len]), cdtype)
+        states = s_unravel(state_row[:s_len])
+        aux: list = []
+        with collect_aux_losses(aux):
+            obj_sum, ce_sum, correct, new_states = fused_slice_loss_sums(
+                layers, params, states, cast_input(x, cdtype), labels,
+                smooth)
+        new_state_row = pad_vec(
+            ravel_pytree(new_states)[0].astype(jnp.float32),
+            state_row.shape[0]
+        )
+        return (obj_sum, ce_sum, correct, new_state_row,
+                sum(aux, jnp.float32(0.0)))
+
+    return stage_fwd_fused
+
+
+# -- event-mode runtime ----------------------------------------------------
+
+
+class ScheduledPipelineStrategy(GPipeStrategy):
+    """``--pipe-schedule {1f1b, interleaved, zero-bubble}``: the event-mode
+    pipeline runtime (module docstring). Inherits gpipe's mesh, stage
+    packing, balanced partitioning, eval pipeline (the synchronous
+    fill-drain eval is schedule-independent), checkpointing surface and
+    state layout; only the TRAIN step is compiled from the timetable."""
+
+    def __init__(self, *args, **kw):
+        super().__init__(*args, **kw)
+        self.schedule = self.cfg.pipe_schedule
+
+    # gpipe builds steps lazily on first init(); our hook replaces only the
+    # train step builder, so _build_steps needs no override.
+
+    def _timetable(self) -> Timetable:
+        return make_timetable(self.schedule, self.num_stages,
+                              self.num_microbatches, self.vstages)
+
+    def _make_train_step(self):
+        S, M, mb = self.num_stages, self.num_microbatches, self.mb
+        V, C = self.vstages, self.num_chunks
+        A = self._act_size
+        cdtype = self.compute_dtype
+        aux_w = self.cfg.moe_aux_weight
+        smooth = self.cfg.resolved_label_smoothing()
+        mesh = self.mesh
+        guard = self._guard
+        guarded = guard is not None
+        opt_update = self._opt_update
+
+        tt = self._timetable()
+        self.timetable = tt  # the loop reads it for --trace tick markers
+        ea = tt.engine_arrays()
+        H = tt.half_ticks
+        NQF, NQB = int(ea["nq_f"]), int(ea["nq_b"])
+        NSX, NSG = int(ea["ns_x"]), int(ea["ns_g"])
+        # When the table glues W to B (1f1b/interleaved: W(c,m) == B(c,m)+1
+        # everywhere — the legacy combined backward), ONE vjp at the B
+        # event produces both cotangents and the W event just accumulates
+        # the stashed param-grad: no second forward recompute. zero-bubble
+        # genuinely defers W, so it pays the split-vjp recompute — that
+        # tax is the schedule's cost model (PERF.md round 10).
+        B_t, W_t = tt.event_times(EVENT_BWD_IN), tt.event_times(EVENT_BWD_W)
+        fused_bw = all(W_t[k] == B_t[k] + 1 for k in B_t)
+        self._fused_bw = fused_bw  # introspected by the parity tests
+        ring_f = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
+        ring_b = [((i + 1) % S, i) for i in range(S)] if S > 1 else []
+
+        stage_fwds = [make_stage_fwd(self, c) for c in range(C)]
+        fused_last = make_stage_fwd_fused(self, C - 1)
+        in_shapes = [self.shapes[self.bounds[c]] for c in range(C)]
+        in_sizes = [mb * math.prod(sh) for sh in in_shapes]
+        out_shapes = [self.shapes[self.bounds[c + 1]] for c in range(C)]
+        out_sizes = [mb * math.prod(sh) for sh in out_shapes]
+
+        def make_event_fns(c: int):
+            """(fwd_fn, bwd_fn, w_fn) for chunk ``c`` — uniform signature
+            ``(params, st, g_acc, xst, gst, fwd_q, bwd_q, xs, ys, m, smul)
+            -> (st, g_acc, xst, gst, y_out, gx_out, ce_mb, corr_mb)`` over
+            the full [V, ...] carries (each fn touches only its static row
+            v = c // S)."""
+            v = c // S
+            first, last = c == 0, c == C - 1
+            stage_fwd = stage_fwds[c]
+            fused = fused_last if last else None
+            in_shape, in_size = in_shapes[c], in_sizes[c]
+            out_shape, out_size = out_shapes[c], out_sizes[c]
+
+            def unpack_in(buf):
+                return buf[:in_size].reshape(mb, *in_shape)
+
+            def unpack_out(buf):
+                return buf[:out_size].reshape(mb, *out_shape)
+
+            def stashed_x(xst, xs, m):
+                if first:
+                    return lax.dynamic_index_in_dim(xs, m, keepdims=False)
+                return unpack_in(lax.dynamic_index_in_dim(
+                    xst[v], m % NSX, keepdims=False))
+
+            def obj_scale(smul):
+                # guard: loss scale x nan-grad poison carrier rides the
+                # cotangent seeds; unguarded branches never touch smul, so
+                # the disarmed trace is the exact pre-guard program
+                return smul if guarded else jnp.float32(1.0)
+
+            def last_obj(pv, xv, st_row, labels, smul):
+                """Per-microbatch training objective on the last chunk
+                (smoothed CE + weighted router aux), pre-scaled — the ONE
+                definition B and W differentiate. ``st_row`` is the
+                step-current state row (recompute deviation, module
+                docstring)."""
+                if fused is not None:
+                    obj_sum, _, _, _, aux = fused(pv, st_row, xv, labels)
+                    denom = jnp.maximum(
+                        1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+                    obj = obj_sum / denom + aux_w * aux
+                else:
+                    y, _, aux = stage_fwd(pv, st_row, xv)
+                    obj = (cross_entropy_loss(y, labels, smooth)
+                           + aux_w * aux)
+                return obj * obj_scale(smul)
+
+            def fwd_fn(params, st, g_acc, xst, gst, fwd_q, bwd_q, xs, ys,
+                       m, smul):
+                if first:
+                    x = lax.dynamic_index_in_dim(xs, m, keepdims=False)
+                else:
+                    x = unpack_in(lax.dynamic_index_in_dim(
+                        fwd_q[v], m % NQF, keepdims=False))
+                y_out = jnp.zeros((A,), cdtype)
+                ce_mb = jnp.zeros((), jnp.float32)
+                corr_mb = jnp.zeros((), jnp.int32)
+                if last and fused is not None:
+                    labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                    _obj, ce_sum, corr_mb, new_st, _aux = fused(
+                        params[v], st[v], x, labels)
+                    denom = jnp.maximum(
+                        1.0, jnp.sum((labels >= 0).astype(jnp.float32)))
+                    ce_mb = ce_sum / denom
+                else:
+                    y, new_st, _aux = stage_fwd(params[v], st[v], x)
+                    if last:
+                        labels = lax.dynamic_index_in_dim(ys, m,
+                                                          keepdims=False)
+                        ce_mb = cross_entropy_loss(y, labels)
+                        corr_mb = correct_and_count(y, labels)[0]
+                    else:
+                        y_out = pad_vec(y.astype(cdtype), A)
+                st = st.at[v].set(new_st)
+                if not first:
+                    xst = xst.at[v].set(lax.dynamic_update_index_in_dim(
+                        xst[v], pad_vec(x.astype(cdtype), A), m % NSX, 0))
+                return (st, g_acc, xst, gst, y_out,
+                        jnp.zeros((A,), cdtype), ce_mb, corr_mb)
+
+            def bwd_fn(params, st, g_acc, xst, gst, fwd_q, bwd_q, xs, ys,
+                       m, smul):
+                """B event. Split mode (zero-bubble): input-grad only —
+                the vjp w.r.t. the stashed input ships the upstream
+                cotangent, and the incoming cotangent is stashed for the
+                deferred W. Fused mode (W glued to B): ONE vjp produces
+                both cotangents — gx ships now, gp is stashed for the W
+                half-tick to accumulate (no second recompute)."""
+                x_st = stashed_x(xst, xs, m)
+                gx, gp = None, None
+                if last:
+                    labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                    obj = lambda pv, xv: last_obj(pv, xv, st[v], labels,
+                                                  smul)
+                    if fused_bw and first:
+                        gp = jax.grad(obj)(params[v])
+                    elif fused_bw:
+                        gp, gx = jax.grad(obj, argnums=(0, 1))(
+                            params[v], x_st)
+                    elif not first:
+                        gx = jax.grad(
+                            lambda xv: obj(params[v], xv))(x_st)
+                else:
+                    g_cot = unpack_out(lax.dynamic_index_in_dim(
+                        bwd_q[v], m % NQB, keepdims=False))
+                    seed = None
+
+                    def fwd_of(pv, xv):
+                        y, _, aux = stage_fwd(pv, st[v], xv)
+                        return y, aux
+
+                    if fused_bw and first:
+                        (y, _aux), vjp_fn = jax.vjp(
+                            lambda pv: fwd_of(pv, x_st), params[v])
+                    elif fused_bw:
+                        (y, _aux), vjp_fn = jax.vjp(fwd_of, params[v], x_st)
+                    elif not first:
+                        (y, _aux), vjp_fn = jax.vjp(
+                            lambda xv: fwd_of(params[v], xv), x_st)
+                    if fused_bw or not first:
+                        seed = (g_cot.astype(y.dtype),
+                                jnp.float32(aux_w) * obj_scale(smul))
+                    if fused_bw and first:
+                        (gp,) = vjp_fn(seed)
+                    elif fused_bw:
+                        gp, gx = vjp_fn(seed)
+                    elif not first:
+                        (gx,) = vjp_fn(seed)
+                if fused_bw:
+                    # param-grad rides the gst ring (same B->W live range
+                    # the split mode uses for the cotangent)
+                    gst = gst.at[v].set(lax.dynamic_update_index_in_dim(
+                        gst[v], gp.astype(jnp.float32), m % NSG, 0))
+                elif not last:
+                    gst = gst.at[v].set(lax.dynamic_update_index_in_dim(
+                        gst[v], pad_vec(g_cot, A), m % NSG, 0))
+                gx_out = (jnp.zeros((A,), cdtype) if gx is None
+                          else pad_vec(gx.astype(cdtype), A))
+                return (st, g_acc, xst, gst, jnp.zeros((A,), cdtype),
+                        gx_out, jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.int32))
+
+            def w_fn(params, st, g_acc, xst, gst, fwd_q, bwd_q, xs, ys,
+                     m, smul):
+                """W event. Fused mode: accumulate the param-grad the B
+                event stashed — free. Split mode (zero-bubble): the
+                weight-grad vjp at the stashed input (and stashed
+                cotangent), the deferred work that fills the bubble."""
+                if fused_bw:
+                    gp = lax.dynamic_index_in_dim(gst[v], m % NSG,
+                                                  keepdims=False)
+                    g_acc = g_acc.at[v].add(gp)
+                    return (st, g_acc, xst, gst, jnp.zeros((A,), cdtype),
+                            jnp.zeros((A,), cdtype),
+                            jnp.zeros((), jnp.float32),
+                            jnp.zeros((), jnp.int32))
+                x_st = stashed_x(xst, xs, m)
+                if last:
+                    labels = lax.dynamic_index_in_dim(ys, m, keepdims=False)
+                    gp = jax.grad(
+                        lambda pv: last_obj(pv, x_st, st[v], labels,
+                                            smul))(params[v])
+                else:
+                    g_cot = unpack_out(lax.dynamic_index_in_dim(
+                        gst[v], m % NSG, keepdims=False))
+
+                    def fwd_of_p(pv):
+                        y, _, aux = stage_fwd(pv, st[v], x_st)
+                        return y, aux
+
+                    (y, _aux), vjp_fn = jax.vjp(fwd_of_p, params[v])
+                    (gp,) = vjp_fn((g_cot.astype(y.dtype),
+                                    jnp.float32(aux_w) * obj_scale(smul)))
+                g_acc = g_acc.at[v].add(gp.astype(jnp.float32))
+                return (st, g_acc, xst, gst, jnp.zeros((A,), cdtype),
+                        jnp.zeros((A,), cdtype),
+                        jnp.zeros((), jnp.float32),
+                        jnp.zeros((), jnp.int32))
+
+            return fwd_fn, bwd_fn, w_fn
+
+        def make_branch(fn):
+            def branch(op, xs, ys, m, smul):
+                params, st, g_acc, xst, gst, fwd_q, bwd_q = op
+                out = fn(params, st, g_acc, xst, gst, fwd_q, bwd_q,
+                         xs, ys, m, smul)
+                return jax.tree.map(_vary, out)
+
+            return branch
+
+        def idle_branch(op, xs, ys, m, smul):
+            params, st, g_acc, xst, gst, fwd_q, bwd_q = op
+            out = (st, g_acc, xst, gst, jnp.zeros((A,), cdtype),
+                   jnp.zeros((A,), cdtype), jnp.zeros((), jnp.float32),
+                   jnp.zeros((), jnp.int32))
+            return jax.tree.map(_vary, out)
+
+        # branch order: [idle] + C fwd + C bwd + C w; dispatch index below
+        event_fns = [make_event_fns(c) for c in range(C)]
+        branches = ([idle_branch]
+                    + [make_branch(f[0]) for f in event_fns]
+                    + [make_branch(f[1]) for f in event_fns]
+                    + [make_branch(f[2]) for f in event_fns])
+
+        # the timetable as on-device constants (tiny int arrays)
+        t_ev = jnp.asarray(ea["ev"])
+        t_v = jnp.asarray(ea["vrow"])
+        t_m = jnp.asarray(ea["mb"])
+        t_fav = jnp.asarray(ea["fa_valid"])
+        t_far = jnp.asarray(ea["fa_row"])
+        t_fam = jnp.asarray(ea["fa_m"])
+        t_bav = jnp.asarray(ea["ba_valid"])
+        t_bar = jnp.asarray(ea["ba_row"])
+        t_bam = jnp.asarray(ea["ba_m"])
+
+        def inner(params_rows, state_rows, xs, ys, *guard_args):
+            # local views -> [V, X] chunk rows (pipedream's convention):
+            # V=1 state is [1, L] (P('stage', None), already [V, L]);
+            # V>1 is [V, 1, L] (P(None, 'stage', None))
+            if V == 1:
+                params = _vary(params_rows)
+                st = _vary(state_rows)
+            else:
+                params = _vary(params_rows[:, 0])
+                st = _vary(state_rows[:, 0])
+            xs = _vary(xs)
+            ys = _vary(ys)
+            smul = guard_args[0] if guarded else jnp.float32(1.0)
+            s_idx = lax.axis_index("stage")
+            L = params.shape[1]
+
+            def body(carry, t):
+                (st, g_acc, xst, gst, fwd_q, bwd_q, x_in, g_in,
+                 ce_acc, corr_acc) = carry
+
+                # 1. absorb last tick's ring arrivals into the m%N queues
+                #    (routing is table data — V>1 wrap rows baked in)
+                def absorb(q, valid, row, mm, buf, N):
+                    q_row = lax.dynamic_index_in_dim(q, row, keepdims=False)
+                    q_row = lax.dynamic_update_index_in_dim(
+                        q_row, buf, mm % N, 0)
+                    q_upd = lax.dynamic_update_index_in_dim(q, q_row, row, 0)
+                    return jnp.where(valid, q_upd, q)
+
+                fwd_q = absorb(fwd_q, t_fav[t, s_idx], t_far[t, s_idx],
+                               t_fam[t, s_idx], x_in, NQF)
+                bwd_q = absorb(bwd_q, t_bav[t, s_idx], t_bar[t, s_idx],
+                               t_bam[t, s_idx], g_in, NQB)
+
+                # 2. dispatch this device's event per the table
+                ev = t_ev[t, s_idx]
+                chunk = t_v[t, s_idx] * S + s_idx
+                m = t_m[t, s_idx]
+                idx = jnp.where(ev == 0, 0, (ev - 1) * C + chunk + 1)
+                op = (params, st, g_acc, xst, gst, fwd_q, bwd_q)
+                (st, g_acc, xst, gst, y_out, gx_out, ce_mb,
+                 corr_mb) = lax.switch(idx, branches, op, xs, ys, m, smul)
+                ce_acc = ce_acc + ce_mb
+                corr_acc = corr_acc + corr_mb
+
+                # 3. one activation right, one cotangent left, per half-tick
+                if ring_f:
+                    x_in = lax.ppermute(y_out, "stage", ring_f)
+                    g_in = lax.ppermute(gx_out, "stage", ring_b)
+                else:
+                    x_in, g_in = y_out, gx_out
+                out = (st, g_acc, xst, gst, fwd_q, bwd_q, x_in, g_in,
+                       ce_acc, corr_acc)
+                return jax.tree.map(_vary, out), None
+
+            init = jax.tree.map(_vary, (
+                st,
+                jnp.zeros((V, L), jnp.float32),
+                jnp.zeros((V, NSX, A), cdtype),
+                # fused mode stashes the B event's param-grad rows for W;
+                # split mode stashes the incoming cotangent
+                (jnp.zeros((V, NSG, L), jnp.float32) if fused_bw
+                 else jnp.zeros((V, NSG, A), cdtype)),
+                jnp.zeros((V, NQF, A), cdtype),
+                jnp.zeros((V, NQB, A), cdtype),
+                jnp.zeros((A,), cdtype),
+                jnp.zeros((A,), cdtype),
+                jnp.zeros((), jnp.float32),
+                jnp.zeros((), jnp.int32),
+            ))
+            (st, g_acc, *_rest, ce_acc, corr_acc) = lax.scan(
+                body, init, jnp.arange(H))[0]
+            # gpipe-parity reductions: mean objective over M microbatches,
+            # dp replicas averaged ('data' pmean), counts summed
+            ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
+            correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
+            grads = lax.pmean(g_acc, "data") / M
+            st = lax.pmean(st, "data")  # sync-BN parity with gpipe
+            if V == 1:
+                return grads, st, ce, correct
+            return grads[:, None], st[:, None], ce, correct
+
+        spec = self._chunk_sharding_spec()
+        in_specs = (spec, spec, P(None, "data"), P(None, "data"))
+        if guarded:
+            in_specs = in_specs + (P(),)
+        pipe = _shard_map(
+            inner,
+            mesh=mesh,
+            in_specs=in_specs,
+            out_specs=(spec, spec, P(), P()),
+        )
+
+        def train_step(ts: PipeTrainState, xs, ys, lr):
+            gstate, smul, opt_in = None, None, ts.opt
+            if guard is not None:
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
+            args = (smul,) if guarded else ()
+            grads, new_state, ce, correct = pipe(
+                ts.params, ts.model_state, xs, ys, *args)
+            gm = None
+            if guard is not None:
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
+            params, opt = opt_update(ts.params, grads, opt_in, lr)
+            if guard is not None:
+                params, new_state, opt, gm = guard.commit(
+                    finite, gnorm, gstate, (params, new_state, opt),
+                    (ts.params, ts.model_state, opt_in))
+            valid = jnp.sum((ys >= 0).astype(jnp.float32))
+            metrics = {
+                "loss": ce,
+                "accuracy": correct.astype(jnp.float32)
+                / jnp.maximum(1.0, valid),
+            }
+            if gm is not None:
+                metrics.update(gm)
+            return PipeTrainState(params, new_state, opt), metrics
+
+        return jax.jit(
+            train_step,
+            donate_argnums=(0,),
+            in_shardings=(self._ts_sharding(), self._batch_sharding,
+                          self._batch_sharding, None),
+        )

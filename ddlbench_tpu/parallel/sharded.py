@@ -61,6 +61,7 @@ class _ShardedParamStrategy:
     def __init__(self, model: LayerModel, cfg: RunConfig,
                  devices: Optional[Sequence[jax.Device]] = None):
         from ddlbench_tpu.distributed import make_mesh
+        from ddlbench_tpu.guard import device_guard
 
         self.model = model
         self.cfg = cfg
@@ -70,6 +71,7 @@ class _ShardedParamStrategy:
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         self._opt_init, opt_update = make_optimizer(cfg)
         n = self.mesh.devices.size
+        guard = self._guard = device_guard(cfg)  # None = pre-guard program
 
         if self.batch_sharded:
             self._batch_sharding = NamedSharding(self.mesh, P(self.axis_name))
@@ -82,16 +84,36 @@ class _ShardedParamStrategy:
             from ddlbench_tpu.ops.util import sharded_jit_tracing
             from ddlbench_tpu.parallel.common import loss_and_grads
 
+            # Stability guard (ROADMAP item 4): tp/fsdp run the SAME
+            # one-jit step shape as single/dp-GSPMD, so the guard wires in
+            # identically — scaled objective, fused (finite, grad_norm)
+            # health pair on the metrics path, anomalous updates dropped
+            # in-step under skip / dynamic scaling. GSPMD keeps the
+            # skip-select elementwise, so sharded params stay sharded.
+            gstate, smul, opt_in = None, None, ts.opt
+            if guard is not None:
+                opt_in, gstate = guard.split_opt(ts.opt)
+                smul = guard.smul(gstate, lr)
             with sharded_jit_tracing():  # auto-Pallas unsafe under GSPMD
                 ce, (correct, valid), new_state, grads = loss_and_grads(
                     model, cfg, ts.params, ts.model_state, x, y,
-                    self.compute_dtype, smooth)
-            params, opt = opt_update(ts.params, grads, ts.opt, lr)
+                    self.compute_dtype, smooth, obj_scale=smul)
+            gm = None
+            if guard is not None:
+                grads = guard.unscale(grads, smul)
+                finite, gnorm = guard.health(ce, grads)
+            params, opt = opt_update(ts.params, grads, opt_in, lr)
+            if guard is not None:
+                params, new_state, opt, gm = guard.commit(
+                    finite, gnorm, gstate, (params, new_state, opt),
+                    (ts.params, ts.model_state, opt_in))
             metrics = {
                 "loss": ce,
                 "accuracy": correct.astype(jnp.float32)
                 / jnp.maximum(1.0, valid.astype(jnp.float32)),
             }
+            if gm is not None:
+                metrics.update(gm)
             return TrainState(params, new_state, opt), metrics
 
         def eval_step(ts: TrainState, x, y):
@@ -121,20 +143,28 @@ class _ShardedParamStrategy:
             )
 
         param_sh = jax.tree.map(leaf_sh, ts.params)
+        opt_sh = opt_state_sharding(self.cfg, param_sh,
+                                    NamedSharding(self.mesh, P()))
+        if self._guard is not None:
+            # dynamic loss-scale state: two replicated scalars in the dict
+            opt_sh = self._guard.opt_state_spec(
+                opt_sh, NamedSharding(self.mesh, P()))
         return TrainState(
             params=param_sh,
             model_state=jax.tree.map(
                 lambda x: NamedSharding(self.mesh, P()), ts.model_state
             ),
-            opt=opt_state_sharding(self.cfg, param_sh,
-                                   NamedSharding(self.mesh, P())),
+            opt=opt_sh,
         )
 
     def init(self, key) -> TrainState:
         from ddlbench_tpu.distributed import put_global_tree
 
         params, state, _ = init_model(self.model, key)
-        ts = TrainState(params, state, self._opt_init(params))
+        opt = self._opt_init(params)
+        if self._guard is not None:
+            opt = self._guard.attach_opt_state(opt)  # dynamic loss scale
+        ts = TrainState(params, state, opt)
         return put_global_tree(ts, self._state_sharding(ts))
 
     def shard_batch(self, x, y):

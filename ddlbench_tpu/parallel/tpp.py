@@ -273,13 +273,21 @@ class TPGPipeStrategy:
         self._built = True
 
     def _make_pipe_fn(self, train: bool):
-        """The classic V=1 gpipe timetable (stage s runs microbatch m at
-        tick t = m + s) with TP inside every switch branch. See
-        parallel/gpipe.py _make_pipe_fn for the schedule derivation."""
+        """The V=1 fill-drain timetable (stage s runs microbatch m at tick
+        t = m + s, read from partition/schedule.py's table — the runtime's
+        autodiff mode, parallel/pipeline_rt.py) with TP inside every
+        switch branch."""
         S, M, A = self.num_stages, self.num_microbatches, self._act_size
         aux_w = self.cfg.moe_aux_weight if train else 0.0
         branches = [self._make_branch(c, train) for c in range(S)]
         perm = [(i, i + 1) for i in range(S - 1)]
+        from ddlbench_tpu.partition.schedule import fill_drain_timetable
+
+        tt = fill_drain_timetable(S, M, 1)
+        if train:
+            self.timetable = tt  # --trace pipe_tick markers (gpipe parity)
+        _tv, tm_np, tvalid_np = tt.forward_tick_arrays()
+        t_m, t_valid = jnp.asarray(tm_np), jnp.asarray(tvalid_np)
         # Guard objective multiplier (loss scale x nan-grad poison carrier):
         # applied INSIDE the shard_map — seeding the backward with a traced
         # scalar from outside would give the cotangent an unknown
@@ -306,9 +314,8 @@ class TPGPipeStrategy:
             def body(carry, t):
                 (x_buf, st_row, loss_acc, ce_acc, aux_acc, corr_acc,
                  corr5_acc) = carry
-                m_rel = t - s_idx
-                valid = (m_rel >= 0) & (m_rel < M)
-                m = jnp.clip(m_rel, 0, M - 1)
+                valid = t_valid[t, s_idx]
+                m = t_m[t, s_idx]
                 (y_buf, new_st, loss_mb, ce_mb, aux_mb, corr_mb,
                  corr5_mb) = lax.switch(
                     s_idx, branches, sl_rows, rp_rows, st_row, x_buf, xs, ys,

@@ -1,25 +1,492 @@
-"""Pipeline-schedule math: bubble fractions and virtual-stage advice.
+"""Pipeline-schedule math: timetables as DATA, bubble fractions, advice.
 
-The synchronous pipeline (parallel/gpipe.py) runs T = M*V + S - 1 chunk-ticks
-per device for M*V useful ones, so the idle (bubble) fraction is
-(S-1)/(M*V + S-1); interleaving (V chunks per device, cfg.virtual_stages)
-divides the fill/drain cost by V at the price of (S*V - 1) ring rotations per
-microbatch instead of S - 1. These helpers quantify that tradeoff so
---auto-partition can report it alongside the stage bounds.
+The schedule-programmable pipeline runtime (parallel/pipeline_rt.py)
+consumes a :class:`Timetable` — a dense ``(half_tick, device) -> {fwd,
+bwd_input, bwd_weight, idle}`` description — rather than baking a schedule
+into engine code (Piper's "schedules are descriptions" design, PAPERS.md).
+This module is where the four shipped schedules live:
+
+* ``fill-drain``   — GPipe: all forwards flush through, then the combined
+  backward drains in reverse (the autodiff schedule of parallel/gpipe.py).
+* ``1f1b``         — synchronous 1F1B: warmup of ``S-1-s`` forwards per
+  stage, then one-forward-one-backward steady state; same weights for every
+  microbatch (no stashing, unlike pipedream's ASYNC 1F1B).
+* ``interleaved``  — interleaved 1F1B over ``C = S*V`` model chunks
+  (generalizing ``cfg.virtual_stages`` beyond the fill-drain schedule).
+* ``zero-bubble``  — ZB-H1-style: the backward is split into an input-grad
+  event (B, produces the upstream cotangent) and a weight-grad event (W,
+  consumes the stashed input + cotangent), and W is deferred to fill the
+  fill/drain bubbles.
+
+Event cost model (the half-tick grid): one F, one B (input grad) or one W
+(weight grad) each occupy ONE half-tick, one event per device per half-tick
+— the F = B = W unit-cost model of the zero-bubble literature. A legacy
+combined backward is B immediately followed by W (2 half-ticks). Activation
+handoffs take one half-tick (ring ppermute), so F(c+1, m) and B(c, m) run
+at least one half-tick after their producers.
+
+Analytic bubble fractions under this model, at equal (S, M), V = 1::
+
+    fill-drain:   3(S-1) / (3M + 3(S-1))  =  (S-1)/(M+S-1)
+    1f1b:         2(S-1) / (3M + 2(S-1))          (< fill-drain: the split
+                  W lets stage s-1's B start under stage s's W in the drain)
+    interleaved:  == 1f1b at V=1; fill/drain cost shrinks toward /V as the
+                  per-device chunks interleave (measured from the table)
+    zero-bubble:   (S-1) / (3M + 1(S-1))          (deferred W fills the
+                  drain; only the F fill bubble remains)
+
+so ``zero-bubble < 1f1b <= interleaved < fill-drain`` — the ordering the
+schedule-parity suite pins. ``1f1b``/``zero-bubble`` formulas are verified
+against the table-derived fractions in tests/test_pipeline_rt.py.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional, Tuple
+import dataclasses
+import functools
+from typing import Dict, List, Tuple
+
+import numpy as np
+
+# Event codes (Timetable.events values). IDLE must stay 0 (zeros padding).
+EVENT_IDLE, EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W = 0, 1, 2, 3
+EVENT_NAMES = ("idle", "F", "B", "W")
+
+PIPE_SCHEDULES = ("fill-drain", "1f1b", "interleaved", "zero-bubble")
+
+
+@dataclasses.dataclass(frozen=True)
+class Timetable:
+    """One pipeline schedule as data, on the global half-tick grid.
+
+    ``events[h, s]`` is the event device ``s`` executes at half-tick ``h``
+    (EVENT_* code), ``mbs[h, s]`` the microbatch index (-1 when idle) and
+    ``chunks[h, s]`` the model-chunk index ``c = v*S + s`` it applies to
+    (-1 when idle; always the device's own chunk row, i.e. c % S == s).
+    """
+
+    name: str
+    num_stages: int
+    virtual_stages: int
+    num_microbatches: int
+    events: np.ndarray  # [H, S] int8
+    mbs: np.ndarray  # [H, S] int32
+    chunks: np.ndarray  # [H, S] int32
+
+    @property
+    def num_chunks(self) -> int:
+        return self.num_stages * self.virtual_stages
+
+    @property
+    def half_ticks(self) -> int:
+        return int(self.events.shape[0])
+
+    # -- derived figures ---------------------------------------------------
+
+    def bubble_fraction(self) -> float:
+        """Idle fraction of the device-time grid: idle half-ticks over
+        S * H. This is THE schedule's analytic bubble — the runtime executes
+        the table verbatim, and telemetry/bubble.py measures the same
+        quantity from emitted tick spans."""
+        total = self.events.size
+        busy = int(np.count_nonzero(self.events))
+        return (total - busy) / total if total else 0.0
+
+    def event_times(self, kind: int) -> Dict[Tuple[int, int], int]:
+        """{(chunk, microbatch): half_tick} for one event kind."""
+        out: Dict[Tuple[int, int], int] = {}
+        hs, ss = np.nonzero(self.events == kind)
+        for h, s in zip(hs.tolist(), ss.tolist()):
+            out[(int(self.chunks[h, s]), int(self.mbs[h, s]))] = int(h)
+        return out
+
+    def validate(self) -> None:
+        """Dependency-correctness: every (chunk, mb) runs F once, B once,
+        W once, in an order that respects the one-half-tick handoffs.
+        Raises AssertionError with the violated relation."""
+        S, V, M, C = (self.num_stages, self.virtual_stages,
+                      self.num_microbatches, self.num_chunks)
+        F = self.event_times(EVENT_FWD)
+        B = self.event_times(EVENT_BWD_IN)
+        W = self.event_times(EVENT_BWD_W)
+        for table, nm in ((F, "F"), (B, "B"), (W, "W")):
+            assert len(table) == C * M, (
+                f"{self.name}: {nm} covers {len(table)} of {C * M} "
+                f"(chunk, microbatch) events")
+        for c in range(C):
+            for m in range(M):
+                f, b, w = F[(c, m)], B[(c, m)], W[(c, m)]
+                if c > 0:
+                    assert f >= F[(c - 1, m)] + 1, (
+                        f"{self.name}: F({c},{m})@{f} before its input "
+                        f"arrives (producer F({c - 1},{m})@{F[(c - 1, m)]})")
+                if c < C - 1:
+                    assert b >= B[(c + 1, m)] + 1, (
+                        f"{self.name}: B({c},{m})@{b} before its cotangent "
+                        f"arrives (producer B({c + 1},{m})@{B[(c + 1, m)]})")
+                else:
+                    assert b >= f + 1, (
+                        f"{self.name}: last-chunk B({c},{m})@{b} not after "
+                        f"its F@{f}")
+                assert w >= b + 1, (
+                    f"{self.name}: W({c},{m})@{w} not after B@{b}")
+                assert b > f, f"{self.name}: B({c},{m})@{b} not after F@{f}"
+        # one event per device per half-tick is structural ([H, S] grid);
+        # chunk-locality: every event's chunk lives on its device
+        hs, ss = np.nonzero(self.events)
+        assert all(int(self.chunks[h, s]) % S == s
+                   for h, s in zip(hs.tolist(), ss.tolist())), (
+            f"{self.name}: an event landed on a foreign device")
+
+    def forward_tick_arrays(self) -> Tuple[np.ndarray, np.ndarray,
+                                           np.ndarray]:
+        """The F events of the leading forward phase as per-tick arrays
+        ``(v, m, valid)``, each ``[T, S]`` with ``T = M*V + S - 1`` — what
+        the autodiff (fill-drain) runtime scans over; the backward half of
+        the table is realized by jax.grad reversing that scan. Only
+        meaningful for fill-drain (whose forward phase IS its first T
+        half-ticks); asserts that shape."""
+        S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
+        T = M * V + S - 1
+        fwd = self.events[:T] == EVENT_FWD
+        assert int(np.count_nonzero(fwd)) == S * V * M, (
+            f"{self.name}: forward phase is not the leading {T} half-ticks")
+        v = np.where(fwd, self.chunks[:T] // S, 0).astype(np.int32)
+        m = np.where(fwd, self.mbs[:T], 0).astype(np.int32)
+        return v, m, fwd.astype(np.bool_)
+
+    def max_inflight(self) -> int:
+        """Max microbatches any chunk holds stashed at once (F done, W not)
+        — the activation-memory high-water mark the schedule implies."""
+        F = self.event_times(EVENT_FWD)
+        W = self.event_times(EVENT_BWD_W)
+        worst = 0
+        for c in range(self.num_chunks):
+            spans = [(F[(c, m)], W[(c, m)])
+                     for m in range(self.num_microbatches)]
+            for h in range(self.half_ticks):
+                worst = max(worst, sum(1 for a, b in spans if a <= h < b))
+        return worst
+
+    def engine_arrays(self) -> Dict[str, np.ndarray]:
+        """Everything the event-mode runtime (parallel/pipeline_rt.py)
+        needs to EXECUTE this table, precomputed on the host:
+
+        * ``ev/vrow/mb [H, S]`` — the event grid (vrow = chunk row v on the
+          device; -1s clipped to 0, ev==IDLE masks them);
+        * forward-arrival routing ``fa_valid/fa_row/fa_m [H, S]`` — at
+          half-tick h, device s's ring buffer holds the activation chunk
+          ``vrow*S + s`` sent by its left neighbor's F at h-1 (V>1 wrap
+          transfers are baked into the row index);
+        * backward-arrival routing ``ba_* [H, S]`` — same for cotangents
+          from the right neighbor's B events;
+        * ring sizes ``nq_f/nq_b`` (arrival->use queues, slot = m % n) and
+          ``ns_x/ns_g`` (F->W input stash, B->W cotangent stash).
+        """
+        S, V, M, C, H = (self.num_stages, self.virtual_stages,
+                         self.num_microbatches, self.num_chunks,
+                         self.half_ticks)
+        F = self.event_times(EVENT_FWD)
+        B = self.event_times(EVENT_BWD_IN)
+        W = self.event_times(EVENT_BWD_W)
+        fa_valid = np.zeros((H, S), np.bool_)
+        fa_row = np.zeros((H, S), np.int32)
+        fa_m = np.zeros((H, S), np.int32)
+        ba_valid = np.zeros((H, S), np.bool_)
+        ba_row = np.zeros((H, S), np.int32)
+        ba_m = np.zeros((H, S), np.int32)
+        for (c, m), h in F.items():
+            if c < C - 1:  # last chunk's output is the loss, never shipped
+                dev = (c + 1) % S
+                fa_valid[h + 1, dev] = True
+                fa_row[h + 1, dev] = (c + 1) // S
+                fa_m[h + 1, dev] = m
+        for (c, m), h in B.items():
+            if c > 0:  # chunk 0's input grad has no consumer
+                dev = (c - 1) % S
+                ba_valid[h + 1, dev] = True
+                ba_row[h + 1, dev] = (c - 1) // S
+                ba_m[h + 1, dev] = m
+        interior = {(c, m): t for (c, m), t in F.items() if c > 0}
+        return {
+            "ev": self.events.astype(np.int32),
+            "vrow": np.maximum(self.chunks // S, 0).astype(np.int32),
+            "mb": np.maximum(self.mbs, 0).astype(np.int32),
+            "fa_valid": fa_valid, "fa_row": fa_row, "fa_m": fa_m,
+            "ba_valid": ba_valid, "ba_row": ba_row, "ba_m": ba_m,
+            "nq_f": ring_slots(
+                {k: F[(k[0] - 1, k[1])] + 1 for k in interior},
+                interior, C, M),
+            "nq_b": ring_slots(
+                {(c, m): B[(c + 1, m)] + 1 for (c, m) in B if c < C - 1},
+                {k: B[k] for k in B if k[0] < C - 1}, C, M),
+            "ns_x": ring_slots(interior,
+                               {k: W[k] for k in interior}, C, M),
+            "ns_g": ring_slots({k: B[k] for k in B if k[0] < C - 1},
+                               {k: W[k] for k in W if k[0] < C - 1}, C, M),
+        }
+
+
+def ring_slots(writes: Dict[Tuple[int, int], int],
+               reads: Dict[Tuple[int, int], int],
+               num_chunks: int, num_microbatches: int) -> int:
+    """Smallest ring size ``n`` such that slot ``m % n`` never holds two
+    live values at once (live = [write half-tick, read half-tick]). The
+    runtime sizes its stash/queue rings with this, per table, on the host.
+    """
+    for n in range(1, num_microbatches + 1):
+        ok = True
+        for c in range(num_chunks):
+            spans = [(writes[(c, m)], reads[(c, m)], m)
+                     for m in range(num_microbatches) if (c, m) in writes]
+            for i, (a0, b0, m0) in enumerate(spans):
+                for a1, b1, m1 in spans[i + 1:]:
+                    if m0 % n == m1 % n and a0 <= b1 and a1 <= b0:
+                        ok = False
+                        break
+                if not ok:
+                    break
+            if not ok:
+                break
+        if ok:
+            return n
+    return num_microbatches
+
+
+# -- generators ------------------------------------------------------------
+
+
+def _empty(H: int, S: int):
+    return (np.zeros((H, S), np.int8), np.full((H, S), -1, np.int32),
+            np.full((H, S), -1, np.int32))
+
+
+def fill_drain_timetable(S: int, M: int, V: int = 1) -> Timetable:
+    """GPipe: the forward scan's timetable (chunk c = v*S + s runs
+    microbatch m = g*S + r at tick t = g*S*V + v*S + s + r — the same
+    closed form parallel/gpipe.py compiles), followed by the reversed
+    combined backward: forward tick t replays as B then W at half-ticks
+    T + 2*(T-1-t) and T + 2*(T-1-t) + 1 (jax.grad reverses the scan)."""
+    T = M * V + S - 1
+    H = 3 * T
+    events, mbs, chunks = _empty(H, S)
+    for t in range(T):
+        for s in range(S):
+            u = t - s
+            if not 0 <= u < M * V:
+                continue
+            g, rem = divmod(u, S * V)
+            v, r = divmod(rem, S)
+            m = g * S + r
+            if m >= M:
+                continue
+            c = v * S + s
+            events[t, s] = EVENT_FWD
+            mbs[t, s], chunks[t, s] = m, c
+            tb = T + 2 * (T - 1 - t)
+            events[tb, s], events[tb + 1, s] = EVENT_BWD_IN, EVENT_BWD_W
+            mbs[tb, s] = mbs[tb + 1, s] = m
+            chunks[tb, s] = chunks[tb + 1, s] = c
+    return Timetable("fill-drain", S, V, M, events, mbs, chunks)
+
+
+@functools.lru_cache(maxsize=64)
+def _greedy_timetable(name: str, S: int, M: int, V: int,
+                      defer_weight_grads: bool) -> Timetable:
+    """Event-driven greedy generator for the synchronous 1F1B family.
+
+    Closed-form rule set (this IS the schedule description; the dense table
+    is its materialization):
+
+    * chunk c runs a warmup of ``C - 1 - c`` forwards, i.e. at most
+      ``C - c`` microbatches may be in flight (F done, B not) — the classic
+      1F1B in-flight cap over C = S*V chunks;
+    * readiness: F(c, m) one half-tick after F(c-1, m); B(c, m) one after
+      B(c+1, m) (one after F(c, m) on the last chunk); W(c, m) any time
+      after B(c, m);
+    * per half-tick each device runs its highest-priority ready event:
+      B first (drain the pipe), then — 1f1b — W (the legacy combined
+      backward, W glued behind B) or — zero-bubble — F (ZB-H1: W is
+      deferred into half-ticks where nothing else is ready, filling the
+      bubbles). Ties go to the earliest microbatch, then the deepest chunk.
+    """
+    C = S * V
+    F: Dict[Tuple[int, int], int] = {}
+    B: Dict[Tuple[int, int], int] = {}
+    W: Dict[Tuple[int, int], int] = {}
+    rows: List[Tuple[int, int, int, int]] = []  # (h, s, event, c, m)
+
+    def ready_f(c, m, h):
+        if (c, m) in F or m >= M:
+            return False
+        if c > 0 and F.get((c - 1, m), h) >= h:
+            return False
+        inflight = sum(1 for mm in range(M)
+                       if (c, mm) in F and (c, mm) not in B)
+        return inflight < C - c
+
+    def ready_b(c, m, h):
+        if (c, m) in B or (c, m) not in F:
+            return False
+        if c == C - 1:
+            return F[(c, m)] < h
+        return B.get((c + 1, m), h) < h
+
+    def ready_w(c, m, h):
+        return (c, m) in B and (c, m) not in W and B[(c, m)] < h
+
+    h = 0
+    total = 3 * C * M
+    done = 0
+    while done < total:
+        for s in range(S):
+            # candidate (priority, m, -c, event, c) rows; lowest wins
+            cand = []
+            for v in range(V):
+                c = v * S + s
+                for m in range(M):
+                    if ready_b(c, m, h):
+                        cand.append((0, m, -c, EVENT_BWD_IN, c))
+                    if ready_w(c, m, h):
+                        cand.append((2 if defer_weight_grads else 1,
+                                     m, -c, EVENT_BWD_W, c))
+                    if ready_f(c, m, h):
+                        cand.append((1 if defer_weight_grads else 2,
+                                     m, -c, EVENT_FWD, c))
+            if not cand:
+                continue
+            _, m, _, ev, c = min(cand)
+            {EVENT_FWD: F, EVENT_BWD_IN: B, EVENT_BWD_W: W}[ev][(c, m)] = h
+            rows.append((h, s, ev, c, m))
+            done += 1
+        h += 1
+        assert h <= 6 * C * M + 6 * C + 16, (
+            f"{name}: greedy schedule did not converge (S={S}, V={V}, "
+            f"M={M})")
+    events, mbs, chunks = _empty(h, S)
+    for hh, s, ev, c, m in rows:
+        events[hh, s], mbs[hh, s], chunks[hh, s] = ev, m, c
+    tt = Timetable(name, S, V, M, events, mbs, chunks)
+    tt.validate()
+    return tt
+
+
+def sync_1f1b_timetable(S: int, M: int, V: int = 1) -> Timetable:
+    """Synchronous 1F1B (V=1) / interleaved 1F1B (V>1): same step-start
+    weights for every microbatch, grads accumulated, ONE optimizer update
+    per step — unlike parallel/pipedream.py's async engine."""
+    return _greedy_timetable("1f1b" if V == 1 else "interleaved",
+                             S, M, V, defer_weight_grads=False)
+
+
+def zero_bubble_timetable(S: int, M: int) -> Timetable:
+    """ZB-H1-style: weight-grad events deferred to fill the drain bubble
+    (same in-flight cap as 1F1B, so activation memory is 1F1B-equal)."""
+    return _greedy_timetable("zero-bubble", S, M, 1,
+                             defer_weight_grads=True)
+
+
+def make_timetable(schedule: str, S: int, M: int, V: int = 1) -> Timetable:
+    """Factory keyed by the ``--pipe-schedule`` flag value."""
+    if schedule == "fill-drain":
+        return fill_drain_timetable(S, M, V)
+    if schedule == "1f1b":
+        if V != 1:
+            raise ValueError("1f1b is the V=1 schedule; use "
+                             "--pipe-schedule interleaved with "
+                             "--virtual-stages for V > 1")
+        return sync_1f1b_timetable(S, M, 1)
+    if schedule == "interleaved":
+        return sync_1f1b_timetable(S, M, V)
+    if schedule == "zero-bubble":
+        if V != 1:
+            raise ValueError("zero-bubble (ZB-H1) is scoped to V = 1; "
+                             "combine interleaving and W-deferral in a "
+                             "future schedule")
+        return zero_bubble_timetable(S, M)
+    raise ValueError(f"unknown pipe schedule {schedule!r} "
+                     f"(choose from {', '.join(PIPE_SCHEDULES)})")
+
+
+# -- analytic bubble fractions (module docstring's closed forms) -----------
 
 
 def pipeline_bubble_fraction(num_stages: int, num_microbatches: int,
                              virtual_stages: int = 1) -> float:
-    """Idle fraction of the synchronous (fill-drain) schedule."""
+    """Idle fraction of the synchronous fill-drain schedule — the classic
+    (S-1)/(M*V + S-1). Identical on the half-tick grid: both the forward
+    tick and the 2-half-tick combined backward idle S-1 units per device."""
     S, M, V = num_stages, num_microbatches, virtual_stages
     if S <= 1:
         return 0.0
     return (S - 1) / (M * V + S - 1)
+
+
+def schedule_bubble_fraction(schedule: str, num_stages: int,
+                             num_microbatches: int,
+                             virtual_stages: int = 1) -> float:
+    """Analytic bubble fraction for one shipped schedule at (S, M, V).
+
+    fill-drain / 1f1b / zero-bubble use the closed forms (module
+    docstring); interleaved is measured from its table (its fill/drain
+    compression depends on how the greedy packer interleaves chunk rows).
+    Closed forms are pinned against table-derived fractions by the
+    ``pipesched`` suite.
+    """
+    S, M, V = num_stages, num_microbatches, virtual_stages
+    if S <= 1:
+        return 0.0
+    if schedule == "fill-drain":
+        return pipeline_bubble_fraction(S, M, V)
+    if schedule == "1f1b" or (schedule == "interleaved" and V == 1):
+        return 2 * (S - 1) / (3 * M + 2 * (S - 1))
+    if schedule == "zero-bubble":
+        return (S - 1) / (3 * M + (S - 1))
+    if schedule == "interleaved":
+        if bubble_is_estimate(schedule, S, M, V):
+            # advisory-scale guard: the greedy generator is pure Python
+            # (O(H*S*V*M^2) worst case) — beyond a few thousand events,
+            # report the ideal-packing LOWER BOUND (fill/drain shrunk by
+            # V) instead of materializing the table for a printed hint;
+            # the runtime still builds (and caches) the exact table when
+            # the schedule actually executes
+            return 2 * (S - 1) / (3 * M * V + 2 * (S - 1))
+        return make_timetable("interleaved", S, M, V).bubble_fraction()
+    raise ValueError(f"unknown pipe schedule {schedule!r}")
+
+
+def bubble_is_estimate(schedule: str, num_stages: int,
+                       num_microbatches: int,
+                       virtual_stages: int = 1) -> bool:
+    """True when :func:`schedule_bubble_fraction` returns the
+    ideal-packing LOWER BOUND instead of the exact table-derived value
+    (large interleaved shapes) — callers reporting the figure (scalebench
+    ``bubble_analytic``) tag it so measured-vs-analytic comparisons don't
+    read an optimistic bound as the schedule's true prediction."""
+    return (schedule == "interleaved" and virtual_stages > 1
+            and num_stages * virtual_stages * num_microbatches > 2048)
+
+
+def recommend_schedule(num_stages: int, num_microbatches: int,
+                       virtual_stages: int = 1) -> List[dict]:
+    """Feasible schedules at (S, M, V) with their analytic bubbles, best
+    first — what --auto-partition's advisor now reports alongside the best
+    V. zero-bubble/1f1b rows appear only where their constraints hold."""
+    S, M, V = num_stages, num_microbatches, virtual_stages
+    rows = []
+    for name in PIPE_SCHEDULES:
+        if name in ("1f1b", "zero-bubble") and V != 1:
+            continue
+        if name == "interleaved" and V > 1 and M % S:
+            continue  # interleaved groups microbatches in rounds of S
+        rows.append({
+            "schedule": name,
+            "bubble": round(schedule_bubble_fraction(name, S, M, V), 4),
+            "virtual_stages": V if name in ("fill-drain", "interleaved")
+            else 1,
+        })
+    rows.sort(key=lambda r: (r["bubble"], r["schedule"]))
+    return rows
 
 
 def recommend_virtual_stages(num_stages: int, num_microbatches: int,
@@ -32,7 +499,9 @@ def recommend_virtual_stages(num_stages: int, num_microbatches: int,
     (the interleaved timetable groups microbatches in rounds of S) and
     enough layers for S*V chunks. Rows carry the transfer count per
     microbatch so callers can weigh bubble savings against rotation cost
-    (the bubble always shrinks with V; communication always grows).
+    (the bubble always shrinks with V; communication always grows), plus
+    the best schedule at that V (recommend_schedule) now that schedules
+    are data.
     """
     S, M = num_stages, num_microbatches
     rows = []
@@ -41,10 +510,13 @@ def recommend_virtual_stages(num_stages: int, num_microbatches: int,
             continue
         if v == 1 and S * v > num_layers:
             continue
+        best = recommend_schedule(S, M, v)[0]
         rows.append({
             "virtual_stages": v,
             "bubble": round(pipeline_bubble_fraction(S, M, v), 4),
             "transfers_per_microbatch": max(0, S * v - 1),
+            "best_schedule": best["schedule"],
+            "best_schedule_bubble": best["bubble"],
         })
     rows.sort(key=lambda r: (r["bubble"], r["virtual_stages"]))
     return rows

@@ -34,6 +34,7 @@ from ddlbench_tpu.telemetry.tracer import (  # noqa: F401
 )
 from ddlbench_tpu.telemetry.export import export_chrome_trace  # noqa: F401
 from ddlbench_tpu.telemetry.overlap import overlap_fraction  # noqa: F401
+from ddlbench_tpu.telemetry.bubble import bubble_fraction  # noqa: F401
 from ddlbench_tpu.telemetry.stats import (  # noqa: F401
     StepLatencyStats,
     percentile,

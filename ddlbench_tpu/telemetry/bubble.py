@@ -1,0 +1,192 @@
+"""Pipeline bubble-fraction reducer over Chrome-trace files.
+
+The point of a pipeline schedule (``--pipe-schedule``, parallel/
+pipeline_rt.py) is a smaller bubble: the fraction of device time the stage
+ring sits idle between useful tick events. This module turns a trace into
+that number::
+
+    bubble_fraction = sum_over_stages(window - union(tick spans))
+                      / (num_stages * window)
+
+mirroring telemetry/overlap.py's interval machinery: works on any trace in
+the Chrome trace-event JSON format —
+
+* the ``--trace`` host span trace (telemetry/export.py): the runtime emits
+  per-stage ``pipe_tick`` marker spans (:func:`emit_tick_spans`) that
+  project the step's TIMETABLE onto the measured step window, one span per
+  busy half-tick per stage, with ``args = {stage, chunk, mb, event,
+  half_tick, step}``. The reduced fraction is the SCHEDULE's bubble — the
+  analytic quantity partition/schedule.py predicts
+  (Timetable.bubble_fraction), pinned to agree within 10% on the synthetic
+  fixture by the ``pipesched`` suite;
+* an XLA device trace exported from ``--trace-dir`` via Perfetto/
+  TensorBoard: pass ``--spans fusion,dot,conv,...`` (or any op-name
+  prefixes) and group tracks by tid — the measured fraction THERE is the
+  real device bubble the round-10 A/B reports.
+
+Stages are identified by the span's ``stage`` arg when present (host
+marker spans all share one thread track), else by the trace ``tid``
+(device traces put each core on its own track). The window defaults to the
+GLOBAL [earliest start, latest end] across all matched spans — leading and
+trailing fill/drain idle counts, exactly as in the analytic fraction; pass
+``per_stage_window=True`` to measure each stage against its own extent
+instead (drops the fill/drain skew, useful on raggedy device traces).
+
+CLI::
+
+    python -m ddlbench_tpu.telemetry.bubble trace.json \
+        [--spans pipe_tick] [--per-stage-window] [--step N]
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from ddlbench_tpu.telemetry.overlap import (_iter_complete_events, _matches,
+                                            _merge, _total)
+
+# Default span-name prefixes marking useful pipeline work: the runtime's
+# schedule markers plus the tick-span names an annotated device trace uses.
+TICK_PREFIXES = ("pipe_tick",)
+
+
+def emit_tick_spans(tracer, timetable, t0_ns: int, t1_ns: int,
+                    step: Optional[int] = None) -> int:
+    """Project ``timetable`` onto the measured step window as ``pipe_tick``
+    marker spans (one per busy half-tick per stage) — the host-trace food
+    for :func:`bubble_fraction`. The projection divides [t0_ns, t1_ns)
+    into H equal half-ticks; the reduced fraction is timeline-scale
+    invariant, so the wall window only sets the display scale. Returns the
+    number of spans emitted (0 when the tracer is disabled)."""
+    if not getattr(tracer, "enabled", False):
+        return 0
+    import numpy as np
+
+    H, S = timetable.half_ticks, timetable.num_stages
+    tick_ns = max(1, (t1_ns - t0_ns)) / H
+    n = 0
+    hs, ss = np.nonzero(timetable.events)
+    for h, s in zip(hs.tolist(), ss.tolist()):
+        a = int(t0_ns + h * tick_ns)
+        b = int(t0_ns + (h + 1) * tick_ns)
+        args = {
+            "stage": int(s),
+            "chunk": int(timetable.chunks[h, s]),
+            "mb": int(timetable.mbs[h, s]),
+            "event": int(timetable.events[h, s]),
+            "half_tick": int(h),
+            "schedule": timetable.name,
+        }
+        if step is not None:
+            args["step"] = step
+        tracer.complete("pipe_tick", a, b, args)
+        n += 1
+    return n
+
+
+def _track_key(e: Dict[str, Any]) -> Any:
+    args = e.get("args") or {}
+    if "stage" in args:
+        return ("stage", args["stage"])
+    return ("tid", e.get("tid"))
+
+
+def bubble_fraction(trace: Any,
+                    span_prefixes: Sequence[str] = TICK_PREFIXES,
+                    per_stage_window: bool = False,
+                    step: Optional[int] = None) -> Dict[str, Any]:
+    """Reduce a trace to its pipeline-bubble figures.
+
+    ``trace``: a Chrome trace dict (``{"traceEvents": [...]}``), a bare
+    event list, or a live Tracer. ``step`` filters marker spans to one
+    step's projection (spans without a ``step`` arg always match); with
+    ``step=None`` and step-tagged spans present, only the LATEST tagged
+    step's projection is reduced — a multi-epoch --trace emits one
+    projection per epoch, and unioning them against one global window
+    would count every inter-epoch gap as bubble. Returns total/idle
+    stage-time, the bubble fraction (0 when no spans match), span counts,
+    and the per-stage breakdown.
+    """
+    matched = []
+    tagged_steps = set()
+    for e in _iter_complete_events(trace):
+        if not _matches(str(e.get("name", "")), span_prefixes):
+            continue
+        args = e.get("args") or {}
+        if step is not None and "step" in args and args["step"] != step:
+            continue
+        if "step" in args:
+            tagged_steps.add(args["step"])
+        matched.append(e)
+    if step is None and tagged_steps:
+        latest = max(tagged_steps)
+        matched = [e for e in matched
+                   if (e.get("args") or {}).get("step", latest) == latest]
+    tracks: Dict[Any, List[Tuple[float, float]]] = {}
+    spans = 0
+    schedule = None
+    for e in matched:
+        t0 = float(e["ts"])
+        tracks.setdefault(_track_key(e), []).append((t0, t0 + float(e["dur"])))
+        schedule = (e.get("args") or {}).get("schedule", schedule)
+        spans += 1
+    merged = {k: _merge(iv) for k, iv in tracks.items()}
+    if not merged:
+        return {"bubble_fraction": 0.0, "stages": 0, "tick_spans": 0,
+                "total_s": 0.0, "idle_s": 0.0, "per_stage": {},
+                "schedule": schedule}
+    lo = min(iv[0][0] for iv in merged.values() if iv)
+    hi = max(iv[-1][1] for iv in merged.values() if iv)
+    per_stage: Dict[str, float] = {}
+    total_us = idle_us = 0.0
+    for k, iv in sorted(merged.items(), key=lambda kv: str(kv[0])):
+        if per_stage_window and iv:
+            w0, w1 = iv[0][0], iv[-1][1]
+        else:
+            w0, w1 = lo, hi
+        window = w1 - w0
+        busy = _total(iv)
+        total_us += window
+        idle_us += window - busy
+        per_stage[str(k[1])] = ((window - busy) / window) if window else 0.0
+    return {
+        "bubble_fraction": (idle_us / total_us) if total_us else 0.0,
+        "stages": len(merged),
+        "tick_spans": spans,
+        "total_s": total_us / 1e6,  # trace ts/dur are microseconds
+        "idle_s": idle_us / 1e6,
+        "per_stage": per_stage,
+        "schedule": schedule,
+    }
+
+
+def main(argv=None) -> int:
+    import argparse
+
+    p = argparse.ArgumentParser(prog="bubble", description=__doc__)
+    p.add_argument("trace", help="Chrome trace-event JSON file "
+                                 "(--trace output or an exported XLA trace)")
+    p.add_argument("--spans", default=None,
+                   help="comma list of tick span-name prefixes "
+                        f"(default: {','.join(TICK_PREFIXES)}; for device "
+                        f"traces try fusion,dot,conv,loop)")
+    p.add_argument("--per-stage-window", action="store_true",
+                   help="measure each stage against its own first-to-last "
+                        "span extent instead of the global window "
+                        "(drops fill/drain skew)")
+    p.add_argument("--step", type=int, default=None,
+                   help="reduce only the marker spans of this step")
+    args = p.parse_args(argv)
+    with open(args.trace) as f:
+        doc = json.load(f)
+    prefixes = (tuple(s for s in args.spans.split(",") if s) if args.spans
+                else TICK_PREFIXES)
+    print(json.dumps(bubble_fraction(doc, prefixes,
+                                     per_stage_window=args.per_stage_window,
+                                     step=args.step)))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,6 +88,18 @@ def main(argv=None) -> int:
     p.add_argument("--comm-buckets", type=int, default=1,
                    help="dp points: layer-aligned gradient buckets for "
                         "comm/compute overlap (1 = monolithic)")
+    from ddlbench_tpu.partition.schedule import PIPE_SCHEDULES
+
+    p.add_argument("--pipe-schedule", default="fill-drain",
+                   choices=PIPE_SCHEDULES,
+                   help="gpipe points: pipeline timetable executed by the "
+                        "schedule runtime (parallel/pipeline_rt.py) — the "
+                        "round-10 A/B column; analytic bubble fractions "
+                        "ride the JSON points for comparison against the "
+                        "telemetry/bubble.py measured value")
+    p.add_argument("--virtual-stages", type=int, default=1,
+                   help="gpipe points: model chunks per device (fill-drain "
+                        "interleaving, or the interleaved-1f1b schedule)")
     from ddlbench_tpu.distributed import (add_platform_arg, apply_comm_flags,
                                           apply_platform)
 
@@ -145,6 +157,12 @@ def main(argv=None) -> int:
             if strat not in ("dp", "fsdp"):
                 kw["num_stages"] = n
             point = {"strategy": strat, "devices": n}
+            if strat == "gpipe" and (args.pipe_schedule != "fill-drain"
+                                     or args.virtual_stages > 1):
+                kw["pipe_schedule"] = args.pipe_schedule
+                kw["virtual_stages"] = args.virtual_stages
+                point["pipe_schedule"] = args.pipe_schedule
+                point["virtual_stages"] = args.virtual_stages
             if strat == "dp" and (args.dp_shard_update
                                   or args.comm_buckets > 1
                                   or args.allreduce_dtype not in
@@ -158,6 +176,23 @@ def main(argv=None) -> int:
             cfg = RunConfig(**kw)
             try:
                 cfg.validate()
+                if "pipe_schedule" in point:
+                    # analytic bubble rides the point for the round-10
+                    # report table; inside the try so an infeasible
+                    # (schedule, S, M) point records its error like any
+                    # other instead of killing the sweep
+                    from ddlbench_tpu.partition.schedule import (
+                        bubble_is_estimate, schedule_bubble_fraction)
+
+                    _, chunks_b = cfg.resolved_batches()
+                    point["bubble_analytic"] = round(
+                        schedule_bubble_fraction(
+                            args.pipe_schedule, cfg.resolved_stages(),
+                            chunks_b, args.virtual_stages), 4)
+                    if bubble_is_estimate(args.pipe_schedule,
+                                          cfg.resolved_stages(), chunks_b,
+                                          args.virtual_stages):
+                        point["bubble_analytic_is_lower_bound"] = True
                 ips = _run_point(cfg, args.steps, args.warmup, args.repeats)
             except Exception as e:  # point failures shouldn't kill the sweep
                 print(json.dumps({**point, "error": str(e)[:200]}),

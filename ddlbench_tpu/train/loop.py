@@ -604,6 +604,19 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     tracer.complete("train_step", t_step, t_step_end,
                                     {"epoch": epoch, "step": step,
                                      "global_step": global_step - 1})
+                    if step == ep_start and \
+                            getattr(strategy, "timetable", None) is not None:
+                        # pipeline runtimes: project the schedule timetable
+                        # onto this step's window as per-stage pipe_tick
+                        # marker spans — telemetry/bubble.py's food. Once
+                        # per epoch: the projection is identical every step
+                        # (the schedule is static), so more would only fill
+                        # the ring.
+                        from ddlbench_tpu.telemetry.bubble import (
+                            emit_tick_spans)
+
+                        emit_tick_spans(tracer, strategy.timetable, t_step,
+                                        t_step_end, step=global_step - 1)
                 if (cfg.checkpoint_every_steps
                         and (step + 1) % cfg.checkpoint_every_steps == 0
                         and step != steps - 1):  # epoch-end save covers last

@@ -1,0 +1,48 @@
+#!/usr/bin/env bash
+# Round-10 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the pipeline-schedule round: the schedule-programmable
+# runtime A/B (--pipe-schedule fill-drain / 1f1b / interleaved /
+# zero-bubble) on a DEEP transformer at a FIXED partition (S=4, balanced
+# bounds — the schedule, not the partition, is the variable), with host
+# pipe_tick traces + a windowed XLA device capture for the bubble reducer
+#   python -m ddlbench_tpu.telemetry.bubble perf_runs/trace_<sched>_r10.json
+# Expectations in PERF.md § round 10: step time ordering follows the
+# analytic bubble (zero-bubble < 1f1b <= interleaved < fill-drain at equal
+# S, M), measured host-marker bubble == analytic (the markers project the
+# timetable), device-trace bubble within ~10% of analytic on compute-bound
+# shapes.
+#
+# Usage: scripts/tpu_round10.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task chaosbench_stability_r8 python -m ddlbench_tpu.tools.chaosbench --kills 1 --preempts 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r8_work --keep-workdir --json perf_runs/chaosbench_r8.json -- --anomaly-policy skip --inject nan-grad@2:7
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task commbench_buckets_r9 python -m ddlbench_tpu.tools.commbench --collectives reduce_scatter,all_gather --sizes 1e6,1e7,1e8 --buckets 1,4,8 --iters 10
+
+# -- round-10: pipeline-schedule A/B (one engine, four timetables) ----------
+# Deep transformer (transformer_m on synthtext), fixed S=4 partition,
+# M=16 microbatches; analytic bubbles at (S=4, M=16):
+#   fill-drain 3/19 = .158, 1f1b 6/54 = .111, zero-bubble 3/51 = .059,
+#   interleaved V=2 measured-from-table. The schedule flag is the ONLY
+#   difference between the four cli runs.
+PIPE_COMMON="-b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30"
+add_task pipe_filldrain_r10  python -m ddlbench_tpu.cli $PIPE_COMMON --pipe-schedule fill-drain  --jsonl perf_runs/pipe_filldrain_r10.jsonl --trace perf_runs/trace_filldrain_r10.json --trace-dir perf_runs/xla_filldrain_r10 --xla-trace-steps 10:14
+add_task pipe_1f1b_r10       python -m ddlbench_tpu.cli $PIPE_COMMON --pipe-schedule 1f1b        --jsonl perf_runs/pipe_1f1b_r10.jsonl       --trace perf_runs/trace_1f1b_r10.json       --trace-dir perf_runs/xla_1f1b_r10       --xla-trace-steps 10:14
+add_task pipe_interleaved_r10 python -m ddlbench_tpu.cli $PIPE_COMMON --pipe-schedule interleaved --virtual-stages 2 --jsonl perf_runs/pipe_interleaved_r10.jsonl --trace perf_runs/trace_interleaved_r10.json --trace-dir perf_runs/xla_interleaved_r10 --xla-trace-steps 10:14
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli $PIPE_COMMON --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+# scaling column: the schedule A/B through scalebench's JSON points
+# (bubble_analytic rides each gpipe point for the report table)
+add_task scalebench_1f1b_r10 python -m ddlbench_tpu.tools.scalebench -b synthtext -m transformer_m --strategies gpipe --devices 4 --steps 20 --repeats 3 --pipe-schedule 1f1b
+add_task scalebench_zb_r10   python -m ddlbench_tpu.tools.scalebench -b synthtext -m transformer_m --strategies gpipe --devices 4 --steps 20 --repeats 3 --pipe-schedule zero-bubble
+# async 1F1B control: pipedream (weight stashing) on the same shape, so the
+# report can separate schedule-bubble wins from staleness-freedom costs
+add_task pipe_pipedream_r10  python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f pipedream -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --jsonl perf_runs/pipe_pipedream_r10.jsonl
+
+window_loop "${1:-11}"

@@ -17,8 +17,9 @@ engine, builds shared across cells):
   span recording never perturbs the computation.
 * ``guard``      — building the engine with ``--anomaly-policy skip`` arms
   the device guard: the step reports the fused ``finite`` health metric.
-  sp/tp/fsdp/ep are the known-unwired engines
-  (guard/policy.py GUARD_UNWIRED_STRATEGIES).
+  Every registry engine is wired (GUARD_UNWIRED_STRATEGIES is empty since
+  the sp/tp/fsdp/ep wiring landed); a future unwired engine names itself
+  there and xfails here instead of failing silently.
 * ``checkpoint_resume`` — the train state round-trips through the atomic
   checkpoint protocol bitwise (structure, dtypes, shardings from a fresh
   init as the restore target).

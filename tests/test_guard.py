@@ -80,9 +80,14 @@ def test_config_validation():
         _cfg(loss_scale=-2.0).validate()
     assert _cfg(loss_scale="65536").resolved_loss_scale() == 65536.0
     assert _cfg(loss_scale="dynamic").resolved_loss_scale() == "dynamic"
-    with pytest.raises(ValueError, match="skip"):
-        _cfg(strategy="fsdp", num_devices=2, anomaly_policy="skip",
-             batch_size=8).validate()
+    # sp/tp/fsdp/ep are guard-wired since ISSUE 7 (GUARD_UNWIRED_STRATEGIES
+    # is empty): in-step skip and loss scaling validate everywhere but
+    # pipedream (whose per-microbatch updates would need per-event
+    # unscaling)
+    _cfg(strategy="fsdp", num_devices=2, anomaly_policy="skip",
+         batch_size=8).validate()
+    _cfg(strategy="fsdp", num_devices=2, loss_scale="dynamic",
+         batch_size=8).validate()
     with pytest.raises(ValueError, match="loss_scale"):
         _cfg(strategy="pipedream", num_devices=2, batch_size=None,
              loss_scale="dynamic").validate()

@@ -1,0 +1,348 @@
+"""Schedule-programmable pipeline runtime (parallel/pipeline_rt.py) +
+timetable data (partition/schedule.py) + bubble reducer (telemetry/bubble.py).
+
+Parity contract (ISSUE 7 acceptance):
+
+* ``--pipe-schedule fill-drain`` through the runtime is BITWISE the legacy
+  gpipe engine (params + per-step losses);
+* 1f1b / interleaved / zero-bubble are TRAJECTORY-pinned against it: the
+  per-step gradient sums match, with drift bounded by f32 reduction order
+  only (the event engine accumulates per-microbatch grads in schedule
+  order and divides by M once; autodiff folds 1/M into the cotangent seed
+  and accumulates in reversed-scan order) — tolerances here are the
+  documented budget for exactly that;
+* analytic bubbles satisfy zero-bubble < 1f1b <= interleaved < fill-drain
+  at equal (S, M), and the telemetry/bubble.py measured fraction agrees
+  with the analytic value within 10% on a synthetic trace fixture.
+
+All tier-1-fast (tiny dense/token models, CPU mesh): `pipesched` marker.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.pipesched
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+from ddlbench_tpu.parallel.pipeline_rt import ScheduledPipelineStrategy
+from ddlbench_tpu.partition.schedule import (
+    PIPE_SCHEDULES, make_timetable, pipeline_bubble_fraction,
+    recommend_schedule, recommend_virtual_stages, schedule_bubble_fraction)
+
+EVENT_SCHEDULES = ("1f1b", "interleaved", "zero-bubble")
+
+
+def tiny_model(num_classes=10):
+    layers = [flatten(), dense("fc1", 24, relu=True),
+              dense("fc2", 24, relu=True), dense("fc3", 24, relu=True),
+              dense("fc4", num_classes)]
+    return LayerModel("tiny", layers, (8, 8, 1), num_classes)
+
+
+def _cfg(schedule="fill-drain", S=2, M=4, mb=4, dp=1, V=1, **kw):
+    return RunConfig(strategy="gpipe", num_devices=S * dp, num_stages=S,
+                     dp_replicas=dp, micro_batch_size=mb, num_microbatches=M,
+                     virtual_stages=V, pipe_schedule=schedule,
+                     compute_dtype="float32", momentum=0.0, weight_decay=0.0,
+                     **kw)
+
+
+def _build(cfg, bounds):
+    cls = (GPipeStrategy if cfg.pipe_schedule == "fill-drain"
+           else ScheduledPipelineStrategy)
+    strat = cls(tiny_model(), cfg, stage_bounds=bounds)
+    return strat, strat.init(jax.random.key(0))
+
+
+def _trajectory(strat, ts, cfg, steps=3, lr=0.1):
+    B = cfg.global_batch()
+    losses = []
+    for step in range(steps):
+        x = jax.random.normal(jax.random.key(10 + step), (B, 8, 8, 1))
+        y = jax.random.randint(jax.random.key(50 + step), (B,), 0, 10)
+        ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                 jnp.float32(lr))
+        losses.append(float(m["loss"]))
+    return np.asarray(losses), ts
+
+
+# -- timetable data --------------------------------------------------------
+
+
+@pytest.mark.parametrize("S,M", [(2, 2), (2, 4), (3, 6), (4, 8)])
+def test_timetables_validate_and_order(S, M):
+    """Every shipped schedule is dependency-correct at (S, M), the closed
+    forms match the table-derived fractions, and the acceptance ordering
+    zero-bubble < 1f1b <= interleaved < fill-drain holds."""
+    frac = {}
+    for name in PIPE_SCHEDULES:
+        tt = make_timetable(name, S, M, 1)
+        tt.validate()
+        measured = tt.bubble_fraction()
+        analytic = schedule_bubble_fraction(name, S, M, 1)
+        assert measured == pytest.approx(analytic, abs=1e-12), (
+            f"{name}: closed form {analytic} != table {measured}")
+        frac[name] = analytic
+    assert frac["zero-bubble"] < frac["1f1b"] <= frac["interleaved"] \
+        < frac["fill-drain"]
+    assert frac["fill-drain"] == pipeline_bubble_fraction(S, M)
+
+
+@pytest.mark.parametrize("S,M,V", [(2, 4, 2), (2, 4, 3), (4, 8, 2)])
+def test_interleaved_timetable_shrinks_bubble(S, M, V):
+    """V > 1 interleaving beats the V=1 1f1b bubble at equal (S, M) — the
+    point of owning V chunks per device."""
+    tt = make_timetable("interleaved", S, M, V)
+    tt.validate()
+    assert tt.bubble_fraction() < schedule_bubble_fraction("1f1b", S, M)
+
+
+def test_fill_drain_forward_arrays_match_closed_form():
+    """The table's forward phase reproduces gpipe's closed-form timetable
+    m = t - s (V=1) exactly — the autodiff runtime consumes these arrays."""
+    S, M = 3, 4
+    v, m, valid = make_timetable("fill-drain", S, M).forward_tick_arrays()
+    assert v.shape == (M + S - 1, S)
+    for t in range(M + S - 1):
+        for s in range(S):
+            expect = t - s
+            assert bool(valid[t, s]) == (0 <= expect < M)
+            if valid[t, s]:
+                assert m[t, s] == expect and v[t, s] == 0
+
+
+def test_schedule_advice():
+    rows = recommend_schedule(4, 8)
+    assert [r["schedule"] for r in rows][0] == "zero-bubble"
+    assert rows == sorted(rows, key=lambda r: r["bubble"])
+    vrows = recommend_virtual_stages(2, 4, 8)
+    assert all("best_schedule" in r for r in vrows)
+    # at any feasible V the best schedule is never fill-drain (zero-bubble
+    # or interleaved 1f1b always beats the flush)
+    assert all(r["best_schedule"] != "fill-drain" for r in vrows)
+
+
+def test_pipe_schedule_validation():
+    with pytest.raises(ValueError, match="unknown pipe_schedule"):
+        _cfg(schedule="gpipe").validate()
+    with pytest.raises(ValueError, match="gpipe strategy"):
+        _cfg(schedule="1f1b").replace(strategy="pipedream").validate()
+    with pytest.raises(ValueError, match="zero-bubble"):
+        _cfg(schedule="zero-bubble", S=2, M=4, V=2).validate()
+    with pytest.raises(ValueError, match="V=1"):
+        _cfg(schedule="1f1b", S=2, M=4, V=2).validate()
+    with pytest.raises(ValueError, match="fill-drain"):
+        RunConfig(strategy="gpipe", num_devices=4, num_stages=2,
+                  tp_size=2, benchmark="synthtext",
+                  pipe_schedule="1f1b").validate()
+    _cfg(schedule="interleaved", S=2, M=4, V=2).validate()  # ok
+
+
+# -- runtime parity --------------------------------------------------------
+
+
+def test_fill_drain_routes_to_runtime_bitwise(devices):
+    """--pipe-schedule fill-drain through make_strategy IS the (timetable-
+    driven) gpipe engine: same class, bitwise params + losses."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = _cfg("fill-drain")
+    strat = make_strategy(cfg)
+    assert type(strat) is GPipeStrategy
+    legacy, ts_l = _build(cfg, [0, 3, 5])
+    lo_l, ts_l = _trajectory(legacy, ts_l, cfg)
+    routed, ts_r = _build(cfg, [0, 3, 5])
+    lo_r, ts_r = _trajectory(routed, ts_r, cfg)
+    np.testing.assert_array_equal(lo_l, lo_r)
+    np.testing.assert_array_equal(np.asarray(ts_l.params),
+                                  np.asarray(ts_r.params))
+
+
+@pytest.mark.parametrize("schedule", EVENT_SCHEDULES)
+def test_event_schedule_trajectory_pinned_vs_gpipe(devices, schedule):
+    """1f1b / interleaved / zero-bubble vs the fill-drain engine: same
+    per-step gradient sums => same trajectory, within the documented f32
+    reduction-order budget (the ONLY allowed drift — same data, same
+    init, same update rule)."""
+    V = 2 if schedule == "interleaved" else 1
+    bounds = [0, 2, 3, 4, 5] if V == 2 else [0, 3, 5]
+    ref, ts_ref = _build(_cfg("fill-drain"), [0, 3, 5])
+    lo_ref, ts_ref = _trajectory(ref, ts_ref, _cfg("fill-drain"))
+    cfg = _cfg(schedule, V=V)
+    strat, ts = _build(cfg, bounds)
+    assert type(strat) is ScheduledPipelineStrategy
+    lo, ts = _trajectory(strat, ts, cfg)
+    np.testing.assert_allclose(lo, lo_ref, rtol=1e-6, atol=1e-7)
+    assert lo_ref[0] != lo_ref[-1]  # the trajectory moved (not vacuous)
+    # backward cost model: W glued to B (1f1b/interleaved) fuses into ONE
+    # vjp per (chunk, mb); only zero-bubble's deferred W pays the split
+    assert strat._fused_bw == (schedule != "zero-bubble")
+    if V == 1:
+        # same partition: compare the updated packed params chunk-by-chunk
+        np.testing.assert_allclose(np.asarray(ts.params),
+                                   np.asarray(ts_ref.params),
+                                   rtol=1e-6, atol=1e-6)
+
+
+def test_event_schedule_hybrid_dp(devices):
+    """PP x DP composes: dp=2 1f1b matches dp=2 fill-drain (the 'data'
+    axis pmean is the runtime's only cross-replica collective)."""
+    ref, ts_r = _build(_cfg("fill-drain", dp=2), [0, 3, 5])
+    lo_r, ts_r = _trajectory(ref, ts_r, _cfg("fill-drain", dp=2), steps=2)
+    strat, ts = _build(_cfg("1f1b", dp=2), [0, 3, 5])
+    lo, ts = _trajectory(strat, ts, _cfg("1f1b", dp=2), steps=2)
+    np.testing.assert_allclose(lo, lo_r, rtol=1e-6, atol=1e-7)
+
+
+def test_event_engine_eval_matches_gpipe(devices):
+    """Eval rides the schedule-independent synchronous pipeline: identical
+    metrics from both engines at the same params."""
+    ref, ts_r = _build(_cfg("fill-drain"), [0, 3, 5])
+    strat, ts = _build(_cfg("zero-bubble"), [0, 3, 5])
+    x = jax.random.normal(jax.random.key(3), (16, 8, 8, 1))
+    y = jax.random.randint(jax.random.key(4), (16,), 0, 10)
+    ev_r = ref.eval_step(ts_r, *ref.shard_batch(x, y))
+    ev_n = strat.eval_step(ts, *strat.shard_batch(x, y))
+    for k in ("loss", "correct", "count"):
+        np.testing.assert_allclose(np.asarray(ev_r[k]), np.asarray(ev_n[k]))
+
+
+def test_event_engine_guard_skip(devices):
+    """The guard wires into the event engine like gpipe: armed steps report
+    the fused health pair, and a nan-grad-poisoned step is dropped with
+    params bitwise untouched."""
+    cfg = _cfg("1f1b", anomaly_policy="skip")
+    strat, ts = _build(cfg, [0, 3, 5])
+    B = cfg.global_batch()
+    x = jax.random.normal(jax.random.key(1), (B, 8, 8, 1))
+    y = jax.random.randint(jax.random.key(2), (B,), 0, 10)
+    ts1, m = strat.train_step(ts, *strat.shard_batch(x, y), jnp.float32(0.1))
+    assert float(m["finite"]) == 1.0
+    assert np.isfinite(float(m["grad_norm"])) and float(m["grad_norm"]) > 0
+    before = np.asarray(ts1.params).copy()
+    # NaN lr rides the guard's poison carrier into the cotangent seeds
+    ts2, m2 = strat.train_step(ts1, *strat.shard_batch(x, y),
+                               jnp.float32(float("nan")))
+    assert float(m2["finite"]) == 0.0
+    np.testing.assert_array_equal(np.asarray(ts2.params), before)
+
+
+def test_event_schedule_token_model_fused_head(devices):
+    """Token workload through the event engine: fused projection+CE head,
+    label smoothing and adam — trajectory-pinned against fill-drain."""
+    from tests.tiny_models import TINY_LM, tiny_transformer
+
+    base = dict(strategy="gpipe", benchmark="synthtext", num_devices=2,
+                num_stages=2, micro_batch_size=2, num_microbatches=2,
+                compute_dtype="float32", optimizer="adam",
+                label_smoothing=0.1, attention_backend="xla")
+    T, vocab = TINY_LM.image_size[0], TINY_LM.num_classes
+
+    def run(schedule):
+        cfg = RunConfig(pipe_schedule=schedule, **base)
+        cls = (GPipeStrategy if schedule == "fill-drain"
+               else ScheduledPipelineStrategy)
+        strat = cls(tiny_transformer(), cfg, stage_bounds=[0, 2, 4])
+        assert strat.model.layers[-1].fused_loss is not None
+        ts = strat.init(jax.random.key(0))
+        losses = []
+        for step in range(2):
+            x = jax.random.randint(jax.random.key(7 + step), (4, T), 0,
+                                   vocab, jnp.int32)
+            y = jax.random.randint(jax.random.key(9 + step), (4, T), 0,
+                                   vocab, jnp.int32)
+            ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                     jnp.float32(0.01))
+            losses.append(float(m["loss"]))
+        return np.asarray(losses)
+
+    np.testing.assert_allclose(run("1f1b"), run("fill-drain"),
+                               rtol=2e-6, atol=1e-6)
+
+
+# -- bubble telemetry ------------------------------------------------------
+
+
+@pytest.mark.parametrize("schedule,V", [("fill-drain", 1), ("1f1b", 1),
+                                        ("zero-bubble", 1),
+                                        ("interleaved", 2)])
+def test_bubble_reducer_matches_analytic(schedule, V):
+    """Synthetic trace fixture: project the timetable onto a step window
+    (what the loop emits under --trace) and reduce it back — the measured
+    fraction agrees with the analytic value within 10% (acceptance)."""
+    from ddlbench_tpu.telemetry import Tracer
+    from ddlbench_tpu.telemetry.bubble import bubble_fraction, emit_tick_spans
+    from ddlbench_tpu.telemetry.export import chrome_trace_dict
+
+    S, M = 4, 8 if V == 1 else 8
+    tt = make_timetable(schedule, S, M, V)
+    tracer = Tracer(50_000).enable()
+    n = emit_tick_spans(tracer, tt, 1_000_000, 4_000_000, step=7)
+    assert n == int(np.count_nonzero(tt.events))
+    doc = chrome_trace_dict(tracer)
+    got = bubble_fraction(doc)
+    analytic = tt.bubble_fraction()
+    assert got["tick_spans"] == n and got["stages"] == S
+    assert got["schedule"] == tt.name
+    assert abs(got["bubble_fraction"] - analytic) <= 0.1 * analytic
+    # step filter: nothing at the wrong step, everything at the right one
+    assert bubble_fraction(doc, step=8)["tick_spans"] == 0
+    assert bubble_fraction(doc, step=7)["tick_spans"] == n
+
+
+def test_bubble_reducer_disabled_tracer_and_empty():
+    from ddlbench_tpu.telemetry import Tracer
+    from ddlbench_tpu.telemetry.bubble import bubble_fraction, emit_tick_spans
+
+    tt = make_timetable("1f1b", 2, 2)
+    assert emit_tick_spans(Tracer(10), tt, 0, 1000) == 0  # never enabled
+    out = bubble_fraction({"traceEvents": []})
+    assert out["bubble_fraction"] == 0.0 and out["stages"] == 0
+
+
+def test_bubble_cli(tmp_path):
+    import json
+
+    from ddlbench_tpu.telemetry import Tracer
+    from ddlbench_tpu.telemetry.bubble import emit_tick_spans, main
+    from ddlbench_tpu.telemetry.export import export_chrome_trace
+
+    tt = make_timetable("zero-bubble", 3, 6)
+    tracer = Tracer(10_000).enable()
+    emit_tick_spans(tracer, tt, 0, 900_000)
+    path = tmp_path / "trace.json"
+    export_chrome_trace(tracer, str(path))
+    assert main([str(path)]) == 0
+    assert main([str(path), "--per-stage-window", "--spans",
+                 "pipe_tick"]) == 0
+
+
+def test_runtime_emits_tick_markers_in_loop(devices, tmp_path):
+    """End to end: a traced multi-epoch 1f1b run leaves one pipe_tick
+    projection per epoch, and the reducer recovers the schedule's bubble
+    from the LATEST projection alone (unioning epochs against one global
+    window would count every inter-epoch gap as bubble)."""
+    import json
+
+    from ddlbench_tpu.telemetry.bubble import bubble_fraction
+    from ddlbench_tpu.train.loop import run_benchmark
+
+    trace = tmp_path / "t.json"
+    cfg = _cfg("1f1b", S=2, M=2, mb=2).replace(
+        arch="lenet", epochs=2, steps_per_epoch=2, log_interval=1,
+        trace=str(trace), prefetch_depth=0)
+    run_benchmark(cfg, warmup_steps=0)
+    doc = json.loads(trace.read_text())
+    tt = make_timetable("1f1b", 2, 2)
+    n_busy = int(np.count_nonzero(tt.events))
+    all_spans = [e for e in doc["traceEvents"]
+                 if e.get("name") == "pipe_tick"]
+    assert len(all_spans) == 2 * n_busy  # one projection per epoch
+    got = bubble_fraction(doc)
+    assert got["tick_spans"] == n_busy  # latest step only
+    assert abs(got["bubble_fraction"] - tt.bubble_fraction()) \
+        <= 0.1 * tt.bubble_fraction()

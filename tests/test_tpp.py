@@ -82,13 +82,19 @@ def test_tp_size_config_validation():
                   num_stages=4).validate()
 
 
-@pytest.mark.slow
+@pytest.mark.pipesched
 def test_tpp_matches_gpipe_loss_trajectory():
     """2 stages x 2 TP shards == 2-stage plain gpipe, same init/batches:
     the loss trajectories must agree to f32 tolerance over several steps
     (this exercises the sliced-matmul math, the row-parallel psums, AND the
     replicated-leaf gradient all-reduce — a missing LN-grad psum diverges
-    the trajectory within a step or two)."""
+    the trajectory within a step or two).
+
+    Tier-1 since ISSUE 7 (no slow mark): tpp was dead at HEAD on jax
+    0.4.37 — the pre-VMA rep re-checks rejected mixed-rep `pad` args
+    (compat.py lenient standard check) — and now that it rides the
+    schedule runtime's timetable the integration must stay green in the
+    commit gate, not hidden behind --runslow."""
     from ddlbench_tpu.parallel.api import make_strategy
 
     _VARIANTS.setdefault("transformer_t", dict(d_model=32, n_layers=2,
@@ -165,8 +171,12 @@ def test_tpp_3d_matches_hybrid_gpipe():
                                    jnp.float32(0.05))
         np.testing.assert_allclose(float(m_t["loss"]), float(m_r["loss"]),
                                    rtol=2e-4)
+        # accuracy is an integer argmax count over 8192 random-init tokens:
+        # TP's sliced matmuls re-associate the f32 reductions, so a handful
+        # of near-tied logits may flip argmax — tolerate a few tokens, not
+        # a trajectory-level divergence
         np.testing.assert_allclose(float(m_t["accuracy"]),
-                                   float(m_r["accuracy"]), atol=1e-6)
+                                   float(m_r["accuracy"]), atol=5e-4)
 
 
 @pytest.mark.slow
