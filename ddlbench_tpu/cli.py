@@ -70,6 +70,19 @@ def build_parser() -> argparse.ArgumentParser:
                         "ZB-H1 split backward (weight-grad events fill the "
                         "drain bubble). pipedream remains the ASYNC 1F1B "
                         "engine (weight stashing)")
+    p.add_argument("--pipe-costs", default="unit", choices=("unit", "profile"),
+                   help="timetable cost model for the event schedules: "
+                        "unit = F=B=W half-ticks (the classic grids); "
+                        "profile = per-chunk F/B/W cost vectors summed "
+                        "from the --auto-partition profile over the chosen "
+                        "bounds, so uneven stage splits execute on "
+                        "cost-weighted timetables (partition/schedule.py)")
+    p.add_argument("--schedule-trace", default=None, metavar="PATH",
+                   help="a prior run's --trace JSON: --auto-partition's "
+                        "schedule advisor folds the MEASURED bubble "
+                        "fraction reduced from its pipe_tick spans into "
+                        "the ranking (telemetry/bubble.py), outranking "
+                        "the analytic value for that schedule")
     p.add_argument("--dp-replicas", type=int, default=1)
     p.add_argument("--tp-size", type=int, default=1,
                    help="composed tensor x pipeline parallelism (gpipe + "
@@ -97,10 +110,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="ZeRO-1 on dp: shard optimizer state over the data "
                         "axis (params stay replicated)")
     p.add_argument("--dp-shard-update", action="store_true",
-                   help="explicit sharded weight update on dp (ZeRO-1 via "
-                        "shard_map): reduce-scatter grads, update a 1/world "
-                        "slice of packed params + optimizer state per chip, "
-                        "all-gather updated params")
+                   help="explicit sharded weight update (ZeRO-1): on -f dp, "
+                        "reduce-scatter grads and update a 1/world slice of "
+                        "packed params + optimizer state per chip; on "
+                        "-f gpipe, the hybrid PP x ZeRO-1 engine — each "
+                        "stage's packed rows + optimizer state shard across "
+                        "the pipe mesh's 'data' axis (memory/dp, grad wire "
+                        "halved, per-bucket JIT all-gather in the forward)")
     p.add_argument("--allreduce-dtype", default="f32",
                    choices=("f32", "float32", "bf16", "bfloat16", "int8"),
                    help="wire dtype for dp's gradient collectives "
@@ -247,6 +263,8 @@ def config_from_args(args) -> RunConfig:
         num_stages=args.stages,
         virtual_stages=args.virtual_stages,
         pipe_schedule=args.pipe_schedule,
+        pipe_costs=args.pipe_costs,
+        schedule_trace=args.schedule_trace,
         dp_replicas=args.dp_replicas,
         tp_size=args.tp_size,
         stage_replication=(tuple(int(r) for r in

@@ -269,6 +269,24 @@ class RunConfig:
     #                   fill the drain bubble ((S-1)/(3M+S-1)).
     # pipedream keeps its own ASYNC 1F1B engine (weight stashing).
     pipe_schedule: str = "fill-drain"
+    # Cost model for the pipeline timetable (partition/schedule.py):
+    # * "unit"    — the F=B=W unit-cost grids (the PR 7 tables, bitwise);
+    # * "profile" — per-chunk F/B/W cost vectors summed from the
+    #   --auto-partition profile graph over the chosen stage bounds
+    #   (quantize_cost_vectors), so uneven stage splits execute on
+    #   timetables packed for their true costs. Event schedules only
+    #   (the fill-drain autodiff scan is lockstep by construction).
+    pipe_costs: str = "unit"
+    # Resolved per-chunk (f, b, w) half-tick cost vectors — normally
+    # written by the auto-partition path (or restored from a persisted
+    # plan), but settable directly for tests/tools.
+    pipe_cost_vectors: Optional[Tuple[Tuple[int, ...], Tuple[int, ...],
+                                      Tuple[int, ...]]] = None
+    # A prior run's --trace JSON: --auto-partition's schedule advisor
+    # folds the MEASURED bubble fraction reduced from it
+    # (telemetry/bubble.py) into its ranking, outranking the analytic
+    # value for the schedule the trace recorded (ROADMAP item 2c).
+    schedule_trace: Optional[str] = None
     # Composed tensor x pipeline parallelism (gpipe + transformer archs):
     # each pipeline stage's blocks are Megatron-sliced this many ways over a
     # 'model' mesh axis inside the stage (parallel/tpp.py). num_devices =
@@ -455,6 +473,18 @@ class RunConfig:
             self.dp_shard_update
             or self.comm_buckets > 1
             or self.resolved_allreduce_dtype() != "float32")
+
+    def pipe_shard_engine(self) -> bool:
+        """True when the gpipe-family pipeline runtime composes with the
+        ZeRO-1 shard axis (hybrid PP x ZeRO-1, ISSUE 8): each stage's
+        packed parameter row and optimizer state stay flat and SHARDED
+        across the pipe mesh's 'data' axis between steps, the forward
+        all-gathers each bucket just-in-time, and the post-scan gradient
+        pmean becomes a bucketed reduce-scatter feeding one sharded
+        update per step. Selected by --dp-shard-update on -f gpipe
+        (same flag as dp's ZeRO-1 engine; validate() scopes it to the
+        2-D data x stage mesh — no tp, no hetero replication)."""
+        return self.strategy == "gpipe" and self.dp_shard_update
 
     def resolved_label_smoothing(self) -> float:
         if self.label_smoothing is not None:
@@ -774,16 +804,70 @@ class RunConfig:
         self.resolved_allreduce_dtype()  # raises on unknown values
         if self.comm_buckets < 1:
             raise ValueError("comm_buckets must be >= 1")
-        if self.comm_buckets > 1 and self.strategy != "dp":
+        if self.comm_buckets > 1 and self.strategy != "dp" and \
+                not self.pipe_shard_engine():
             raise ValueError(
                 "comm_buckets > 1 (bucketed gradient collectives) applies "
                 "to the dp strategy's explicit collective engine (-f dp; "
                 "combine with --dp-shard-update for the fully overlapped "
-                "just-in-time all-gather)")
-        if self.dp_shard_update and self.strategy != "dp":
+                "just-in-time all-gather) or to -f gpipe with "
+                "--dp-shard-update (hybrid PP x ZeRO-1 bucket count)")
+        if self.dp_shard_update and self.strategy not in ("dp", "gpipe"):
             raise ValueError(
                 "dp_shard_update (sharded weight update) applies to the dp "
-                "strategy (fsdp already shards everything)")
+                "strategy or to -f gpipe (hybrid PP x ZeRO-1 over the pipe "
+                "mesh's 'data' axis; fsdp already shards everything)")
+        if self.pipe_shard_engine():
+            if self.tp_size > 1:
+                raise ValueError(
+                    "dp_shard_update on gpipe (hybrid PP x ZeRO-1) is "
+                    "scoped to the 2-D data x stage mesh; tp_size > 1 "
+                    "keeps the replicated update")
+            if self.stage_replication is not None:
+                raise ValueError(
+                    "dp_shard_update on gpipe needs the uniform 2-D mesh; "
+                    "stage_replication (hetero pipeline) keeps the "
+                    "replicated update")
+        if self.pipe_costs not in ("unit", "profile"):
+            raise ValueError(
+                f"unknown pipe_costs {self.pipe_costs!r} (choose unit or "
+                f"profile)")
+        if self.pipe_costs == "profile":
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    "pipe_costs='profile' (cost-weighted timetables) "
+                    "applies to -f gpipe's schedule runtime")
+            if not self.auto_partition:
+                raise ValueError(
+                    "pipe_costs='profile' needs --auto-partition (the "
+                    "profile graph is where the per-chunk costs come from)")
+            if self.pipe_schedule == "fill-drain":
+                raise ValueError(
+                    "pipe_costs='profile' needs an event schedule "
+                    "(--pipe-schedule 1f1b/interleaved/zero-bubble); the "
+                    "fill-drain autodiff scan executes the unit timetable "
+                    "by construction")
+        if self.schedule_trace is not None:
+            if self.strategy != "gpipe" or not self.auto_partition:
+                raise ValueError(
+                    "schedule_trace (measured-bubble schedule advice) "
+                    "feeds -f gpipe's --auto-partition advisor; without "
+                    "auto-partition there is no advice to fold it into")
+        if self.pipe_cost_vectors is not None:
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    "pipe_cost_vectors applies to -f gpipe's schedule "
+                    "runtime")
+            if self.pipe_schedule == "fill-drain":
+                raise ValueError(
+                    "cost-weighted timetables execute on the EVENT "
+                    "schedules (1f1b/interleaved/zero-bubble); the "
+                    "fill-drain autodiff scan is lockstep by construction")
+            from ddlbench_tpu.partition.schedule import normalize_costs
+
+            normalize_costs(  # raises on malformed vectors
+                self.pipe_cost_vectors,
+                self.resolved_stages() * self.virtual_stages)
         if self.dp_shard_update and self.shard_opt_state:
             raise ValueError(
                 "dp_shard_update supersedes shard_opt_state: the explicit "

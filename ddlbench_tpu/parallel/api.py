@@ -80,13 +80,18 @@ def _plan_key(cfg: RunConfig) -> dict:
     """The fields a persisted plan must match to be reusable: a plan from a
     different model/topology would mis-shard or trip shape asserts, and one
     from different batch/virtual-stage flags would silently override what
-    the user asked for. Must be computed from the PRE-rewrite cfg (plans
+    the user asked for. ``pipe_schedule`` and the cost-model mode are part
+    of the key too — a plan solved (and whose cost vectors were extracted)
+    under one schedule/cost model must never be silently reused by another
+    run's timetable. Must be computed from the PRE-rewrite cfg (plans
     rewrite micro_batch_size etc.), so callers capture it up front."""
     mb, chunks = cfg.resolved_batches()
     return {"arch": cfg.arch, "benchmark": cfg.benchmark,
             "strategy": cfg.strategy, "num_devices": cfg.num_devices,
             "num_hosts": cfg.num_hosts, "micro_batch_size": mb,
-            "num_microbatches": chunks, "virtual_stages": cfg.virtual_stages}
+            "num_microbatches": chunks, "virtual_stages": cfg.virtual_stages,
+            "pipe_schedule": cfg.pipe_schedule,
+            "pipe_costs": cfg.pipe_costs}
 
 
 def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
@@ -123,6 +128,14 @@ def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
         "micro_batch_size": cfg.micro_batch_size,
         "num_microbatches": cfg.num_microbatches,
         "virtual_stages": cfg.virtual_stages,
+        # schedule/cost provenance: which timetable and cost model the
+        # plan was solved under, plus the resolved per-chunk (f, b, w)
+        # half-tick vectors so a --resume reuses the exact weighted
+        # timetable without re-profiling
+        "pipe_schedule": cfg.pipe_schedule,
+        "pipe_costs": cfg.pipe_costs,
+        "pipe_cost_vectors": ([list(v) for v in cfg.pipe_cost_vectors]
+                              if cfg.pipe_cost_vectors else None),
     }
     # atomic: the window-catching harness SIGKILLs overdue runs, and a
     # truncated plan file would break every later --resume
@@ -130,6 +143,37 @@ def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
     with open(tmp, "w") as f:
         json.dump(payload, f)
     os.replace(tmp, path)
+
+
+def _measured_bubbles(cfg: RunConfig):
+    """{schedule: measured bubble fraction} reduced from the trace JSON a
+    prior run left under ``--trace`` (``--schedule-trace PATH``), via the
+    telemetry/bubble.py reducer — the advisor then ranks that schedule by
+    what it actually did on this machine instead of the analytic model.
+    None (advice stays analytic) when no trace is supplied, it is
+    unreadable, or it carries no pipe_tick projections."""
+    if not cfg.schedule_trace:
+        return None
+    from ddlbench_tpu.telemetry.bubble import bubble_fraction
+
+    try:
+        with open(cfg.schedule_trace) as f:
+            doc = json.load(f)
+    except (OSError, json.JSONDecodeError) as e:
+        print(f"schedule advisor: unreadable --schedule-trace "
+              f"{cfg.schedule_trace} ({e}); using analytic bubbles",
+              flush=True)
+        return None
+    got = bubble_fraction(doc)
+    if not got["tick_spans"] or not got.get("schedule"):
+        print(f"schedule advisor: {cfg.schedule_trace} carries no "
+              f"pipe_tick projections; using analytic bubbles", flush=True)
+        return None
+    print(f"schedule advisor: measured bubble "
+          f"{got['bubble_fraction']:.4f} for {got['schedule']} "
+          f"({got['tick_spans']} tick spans, {got['stages']} stages, "
+          f"{cfg.schedule_trace})", flush=True)
+    return {got["schedule"]: got["bubble_fraction"]}
 
 
 def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None,
@@ -175,13 +219,17 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
             try:
                 stage_bounds = [int(b) for b in persisted["graph_bounds"]]
                 repl_p = persisted.get("stage_replication")
+                cv_p = persisted.get("pipe_cost_vectors")
                 cfg = cfg.replace(
                     num_stages=persisted["num_stages"],
                     dp_replicas=persisted["dp_replicas"],
                     stage_replication=tuple(repl_p) if repl_p else None,
                     micro_batch_size=persisted["micro_batch_size"],
                     num_microbatches=persisted["num_microbatches"],
-                    virtual_stages=persisted.get("virtual_stages", 1))
+                    virtual_stages=persisted.get("virtual_stages", 1),
+                    pipe_cost_vectors=(tuple(tuple(int(x) for x in v)
+                                             for v in cv_p)
+                                       if cv_p else None))
                 cfg.validate()
                 applied = True
                 print(f"auto-partition: reusing persisted plan "
@@ -265,8 +313,22 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                 )
                 repl = tuple(s.replication for s in plan.stages)
             if plan is not None:
-                cfg_planned = cfg.replace(
-                    num_stages=None, dp_replicas=1, stage_replication=repl)
+                if repl and len(set(repl)) == 1 and mb % repl[0] == 0:
+                    # uniform plan: normalize straight to the 2-D-mesh
+                    # form (the same rewrite the strategy dispatch below
+                    # applies) so event schedules / the hybrid engine —
+                    # which reject hetero stage_replication tuples — can
+                    # still execute the plan's bounds instead of falling
+                    # back to balanced ones
+                    cfg_planned = cfg.replace(
+                        num_stages=len(repl), dp_replicas=repl[0],
+                        stage_replication=None,
+                        micro_batch_size=mb // repl[0],
+                        num_microbatches=chunks)
+                else:
+                    cfg_planned = cfg.replace(
+                        num_stages=None, dp_replicas=1,
+                        stage_replication=repl)
                 try:
                     cfg_planned.validate()
                     stage_bounds = plan.stage_bounds()
@@ -288,6 +350,21 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                         f"falling back to balanced bounds {stage_bounds}",
                         flush=True,
                     )
+            if cfg.pipe_costs == "profile":
+                # cost-weighted timetables: sum the profile graph's
+                # per-node times over the CHOSEN chunk bounds and
+                # quantize onto the half-tick grid — the event runtime
+                # then executes a table packed for the plan's genuinely
+                # uneven chunks instead of the F=B=W unit fiction
+                from ddlbench_tpu.partition.schedule import (
+                    quantize_cost_vectors)
+                from ddlbench_tpu.profiler.profile import chunk_cost_ms
+
+                f_ms, b_ms = chunk_cost_ms(graph, stage_bounds)
+                vectors = quantize_cost_vectors(f_ms, b_ms)
+                cfg = cfg.replace(pipe_cost_vectors=vectors)
+                print(f"auto-partition: cost-weighted timetable vectors "
+                      f"(f/b/w half-ticks per chunk) {vectors}", flush=True)
             if not keep_existing:
                 _save_plan(plan_key, cfg, stage_bounds)
         if dag is not None:
@@ -311,15 +388,25 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
             print(f"schedule advisor (S={cfg.resolved_stages()}, M={chunks}): "
                   f"{table}", flush=True)
             # schedules are data now: advise the best TIMETABLE at the
-            # chosen V, not just the best V
+            # chosen V, not just the best V — ranked by the cost-weighted
+            # bubble when the plan carries cost vectors, and by the
+            # MEASURED bubble for any schedule a --schedule-trace covers
+            # (reality outranks the model, ROADMAP item 2c)
+            measured = _measured_bubbles(cfg)
             sched = recommend_schedule(cfg.resolved_stages(), chunks,
-                                       cfg.virtual_stages)
+                                       cfg.virtual_stages,
+                                       costs=cfg.pipe_cost_vectors,
+                                       measured=measured)
             best = sched[0]
             tail = ("" if best["schedule"] == cfg.pipe_schedule else
                     f" (run has --pipe-schedule {cfg.pipe_schedule})")
+            basis = ("measured" if "bubble_measured" in best
+                     else "weighted" if cfg.pipe_cost_vectors else "analytic")
             print(f"schedule advisor: best schedule at V="
                   f"{cfg.virtual_stages} is {best['schedule']} "
-                  f"(bubble {best['bubble']}){tail}: {sched}", flush=True)
+                  f"({basis} bubble "
+                  f"{best.get('bubble_measured', best['bubble'])})"
+                  f"{tail}: {sched}", flush=True)
     if (stage_bounds is None and cfg.strategy in ("gpipe", "pipedream")):
         # Manual (non-auto-partition) pipeline run on a branchy arch: the
         # articulation chain is hopeless to balance (nasnet's whole cell

@@ -487,6 +487,53 @@ def from_device_major(flat_dm: jax.Array, meta: FlatMeta,
     return jnp.concatenate(parts) if parts else flat_dm
 
 
+def row_flat_meta(length: int, world: int, buckets: int = 1) -> FlatMeta:
+    """FlatMeta for an ALREADY-FLAT packed row (the pipeline strategies'
+    [S, L] stage-parameter rows), sharded 1/world per device over the pipe
+    mesh's 'data' axis in ``buckets`` contiguous pieces.
+
+    The row has no pytree to align to (pack_stages already concatenated
+    and padded the stage's leaves to a common L), so buckets are
+    near-equal contiguous stretches, each padded-aligned to a multiple of
+    ``world`` — the same per-bucket equal-slice property the dp engine's
+    leaf-aligned buckets have, which is all to/from_device_major and the
+    per-bucket psum_scatter/all_gather need. ``treedef``/``shapes`` are
+    empty: unpacking goes through the stage unravels, not unpack_flat."""
+    units = -(-max(1, length) // world)  # world-sized units in the row
+    buckets = max(1, min(buckets, units))
+    base, rem = divmod(units, buckets)
+    bucket_padded = []
+    bucket_offsets = []
+    off = 0
+    for b in range(buckets):
+        u = base + (1 if b < rem else 0)
+        bucket_padded.append(u * world)
+        bucket_offsets.append(off)
+        off += u * world
+    return FlatMeta(None, (), (), (), int(length), int(off),
+                    ((0, 0),) * buckets, tuple(bucket_padded),
+                    tuple(bucket_offsets))
+
+
+def device_major_perm(meta: FlatMeta, world: int):
+    """Index permutation ``p`` with ``flat[p] == to_device_major(flat)``
+    (and its inverse) as numpy arrays — the pipeline strategies apply the
+    device-major relayout along the last axis of the packed [.., S, L]
+    stage matrix via one jnp.take with a constant index vector."""
+    import numpy as np
+
+    idx = []
+    for d in range(world):
+        for b in range(meta.num_buckets):
+            o = meta.bucket_offsets[b]
+            bl = meta.bucket_padded[b] // world
+            idx.extend(range(o + d * bl, o + (d + 1) * bl))
+    perm = np.asarray(idx, np.int32)
+    inv = np.empty_like(perm)
+    inv[perm] = np.arange(perm.size, dtype=np.int32)
+    return perm, inv
+
+
 def shard_bucket_slice(shard: jax.Array, meta: FlatMeta, world: int,
                        b: int) -> jax.Array:
     """Bucket b's segment of one device's [padded/world] shard.

@@ -107,6 +107,14 @@ class GPipeStrategy:
         # device boundary). V=1 is the classic schedule.
         self.vstages = max(1, getattr(cfg, "virtual_stages", 1))
         self.num_chunks = self.num_stages * self.vstages
+        # Hybrid PP x ZeRO-1 (--dp-shard-update on gpipe): stage parameter
+        # rows + optimizer state live SHARDED across the pipe mesh's
+        # 'data' axis between steps (device-major bucketed flat layout,
+        # parallel/common.py row_flat_meta); the forward all-gathers each
+        # bucket just-in-time and the backward reduce-scatters per bucket
+        # — optimizer bytes/chip drop /dp, the grad wire halves vs the
+        # replicated pmean, and late buckets overlap the drain.
+        self.pipe_shard = cfg.pipe_shard_engine()
         self.mesh = mesh or make_pipe_mesh(self.num_stages, self.dp, devices)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)
         self.mb, self.num_microbatches = cfg.resolved_batches()
@@ -123,6 +131,14 @@ class GPipeStrategy:
     def _chunk_sharding_spec(self) -> P:
         # V=1: [S, L] rows over 'stage'; V>1: [V, S, L] middle axis over it.
         return P("stage", None) if self.vstages == 1 else P(None, "stage", None)
+
+    def _param_spec(self) -> P:
+        """Params (and optimizer m/v): the chunk spec, plus — hybrid
+        PP x ZeRO-1 — the flat row axis sharded over 'data'."""
+        if not self.pipe_shard:
+            return self._chunk_sharding_spec()
+        return (P("stage", "data") if self.vstages == 1
+                else P(None, "stage", "data"))
 
     def init(self, key) -> PipeTrainState:
         params_list, state_list, shapes = init_model(self.model, key)
@@ -150,6 +166,17 @@ class GPipeStrategy:
             params_mat = params_mat.reshape(V, S, -1)
             state_mat = state_mat.reshape(V, S, -1)
 
+        if self.pipe_shard and not self._built:
+            from ddlbench_tpu.parallel.common import (device_major_perm,
+                                                      row_flat_meta)
+
+            self._row_meta = row_flat_meta(
+                int(params_mat.shape[-1]), self.dp,
+                max(1, self.cfg.comm_buckets))
+            perm, inv = device_major_perm(self._row_meta, self.dp)
+            self._row_perm = jnp.asarray(perm)
+            self._row_inv = jnp.asarray(inv)
+
         if not self._built:
             self._p_unravels, self._p_lens = p_unravels, p_lens
             self._s_unravels, self._s_lens = s_unravels, s_lens
@@ -164,8 +191,20 @@ class GPipeStrategy:
 
         from ddlbench_tpu.distributed import put_global_batch
 
+        if self.pipe_shard:
+            # device-major bucketed relayout of every row, then the 'data'
+            # axis shards each device's contiguous 1/dp stretch (the same
+            # layout the per-bucket psum_scatter outputs produce — see
+            # parallel/common.py to_device_major)
+            pad = self._row_meta.padded - params_mat.shape[-1]
+            params_mat = jnp.pad(
+                params_mat,
+                [(0, 0)] * (params_mat.ndim - 1) + [(0, pad)])
+            params_mat = jnp.take(params_mat, self._row_perm, axis=-1)
+
         sharding = NamedSharding(self.mesh, self._chunk_sharding_spec())
-        params_mat = put_global_batch(params_mat, sharding)
+        psharding = NamedSharding(self.mesh, self._param_spec())
+        params_mat = put_global_batch(params_mat, psharding)
         state_mat = put_global_batch(state_mat, sharding)
         opt = self._opt_init(params_mat,
                              step_like=params_mat.shape[:-1] + (1,))
@@ -291,10 +330,31 @@ class GPipeStrategy:
 
     def _build_steps(self):
         self._stage_sharding = NamedSharding(self.mesh, self._chunk_sharding_spec())
+        self._param_sharding = NamedSharding(self.mesh, self._param_spec())
         self._batch_sharding = NamedSharding(self.mesh, P(None, "data"))
+        self._materialize = None  # built lazily (hybrid engine only)
         self.train_step = self._make_train_step()
         self.eval_step = self._make_eval_step()
         self._built = True
+
+    def materialize_params(self, ts: "PipeTrainState"):
+        """The plain packed [.., S, L] stage-parameter matrix, replicated
+        over 'data' — what host-side consumers (activation logging, tests,
+        tools) read. Identity for the replicated engine; the hybrid
+        PP x ZeRO-1 engine's between-steps params are the device-major
+        padded sharded rows, so this inverts the relayout and drops the
+        pad (one jitted gather)."""
+        if not self.pipe_shard:
+            return ts.params
+        if self._materialize is None:
+            inv, L = self._row_inv, self._row_meta.length
+
+            def plain(p):
+                return jnp.take(p, inv, axis=-1)[..., :L]
+
+            self._materialize = jax.jit(
+                plain, out_shardings=self._stage_sharding)
+        return self._materialize(ts.params)
 
     def _make_pipe_fn(self, train: bool):
         """Synchronous fill-drain pipeline fwd (gpipe train fwd and all eval).
@@ -333,9 +393,14 @@ class GPipeStrategy:
         tv_np, tm_np, tvalid_np = tt.forward_tick_arrays()
         t_v, t_m, t_valid = (jnp.asarray(tv_np), jnp.asarray(tm_np),
                              jnp.asarray(tvalid_np))
+        gather_rows = self._make_gather_rows()
 
         def inner(params_rows, state_rows, xs, ys):
             # params_rows local: [1, L] (V=1) or [V, 1, L]; xs [M, mb, ...]
+            # (hybrid PP x ZeRO-1: [1|V, 1?, L/dp] device-major shards,
+            # rebuilt to full rows by the per-bucket just-in-time
+            # all-gather below — whose TRANSPOSE under jax.grad is the
+            # per-bucket psum_scatter that shards the gradients).
             # Mark everything varying over both mesh axes up front so all
             # switch branches produce identical VMA types; the pcast on
             # params transposes to the gradient psum over 'data' (the DP
@@ -346,6 +411,8 @@ class GPipeStrategy:
             else:
                 param_rows = _vary(params_rows[:, 0])  # [V, L]
                 state_rows = _vary(state_rows[:, 0])
+            if gather_rows is not None:
+                param_rows = _vary(gather_rows(param_rows))
             xs = _vary(xs)
             ys = _vary(ys)
             s_idx = lax.axis_index("stage")
@@ -410,9 +477,34 @@ class GPipeStrategy:
         return _shard_map(
             inner,
             mesh=mesh,
-            in_specs=(spec, spec, P(None, "data"), P(None, "data")),
+            in_specs=(self._param_spec(), spec, P(None, "data"),
+                      P(None, "data")),
             out_specs=(P(), P(), spec, P(), P()),
         )
+
+    def _make_gather_rows(self):
+        """Hybrid PP x ZeRO-1: per-bucket just-in-time all-gather of the
+        local [V?, L/dp] device-major param-row shards back to full plain
+        rows, inside the shard_map. Each bucket rides its OWN all-gather
+        so the first chunks' compute starts while late buckets are still
+        on the wire; under jax.grad each gather transposes to that
+        bucket's reduce-scatter, which is where the sharded gradients
+        come from in autodiff mode. None when the engine is replicated."""
+        if not self.pipe_shard:
+            return None
+        meta, dp = self._row_meta, self.dp
+
+        def gather_rows(rows):  # [V?, L/dp] -> [V?, L_pad]
+            parts = []
+            for b in range(meta.num_buckets):
+                o = meta.bucket_offsets[b] // dp
+                ln = meta.bucket_padded[b] // dp
+                parts.append(lax.all_gather(
+                    rows[:, o:o + ln], "data", axis=1, tiled=True))
+            return (jnp.concatenate(parts, axis=1) if len(parts) > 1
+                    else parts[0])
+
+        return gather_rows
 
     @property
     def _total_samples(self) -> int:
@@ -420,16 +512,21 @@ class GPipeStrategy:
 
     def _ts_sharding(self):
         sh = self._stage_sharding
-        opt_sh = sh
-        if self._guard is not None and self._guard.dynamic:
-            # the loss-scale scalars break the one-sharding-for-the-whole-
-            # opt-subtree shorthand: spell the dict out, scalars replicated
+        psh = self._param_sharding
+        opt_sh = psh
+        if self.pipe_shard or (self._guard is not None
+                               and self._guard.dynamic):
+            # hybrid: m/v ride the params' 'data'-sharded rows while the
+            # adam step counter ([.., 1] per stage row) stays on the chunk
+            # sharding; dynamic loss-scale scalars additionally break the
+            # one-sharding-for-the-whole-subtree shorthand
             from ddlbench_tpu.parallel.common import opt_state_sharding
 
-            opt_sh = self._guard.opt_state_spec(
-                opt_state_sharding(self.cfg, sh, sh),
-                NamedSharding(self.mesh, P()))
-        return PipeTrainState(sh, sh, opt_sh)
+            opt_sh = opt_state_sharding(self.cfg, psh, sh)
+            if self._guard is not None and self._guard.dynamic:
+                opt_sh = self._guard.opt_state_spec(
+                    opt_sh, NamedSharding(self.mesh, P()))
+        return PipeTrainState(psh, sh, opt_sh)
 
     def _make_train_step(self):
         pipe_train = self._make_pipe_fn(train=True)

@@ -161,8 +161,12 @@ class ScheduledPipelineStrategy(GPipeStrategy):
     # train step builder, so _build_steps needs no override.
 
     def _timetable(self) -> Timetable:
+        # cost-aware timetables (ISSUE 8): per-chunk (f, b, w) half-tick
+        # vectors from the profiler / persisted plan ride cfg; None (or
+        # all-unit) reproduces the PR 7 unit-cost tables bitwise
         return make_timetable(self.schedule, self.num_stages,
-                              self.num_microbatches, self.vstages)
+                              self.num_microbatches, self.vstages,
+                              costs=self.cfg.pipe_cost_vectors)
 
     def _make_train_step(self):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb
@@ -179,17 +183,23 @@ class ScheduledPipelineStrategy(GPipeStrategy):
         tt = self._timetable()
         self.timetable = tt  # the loop reads it for --trace tick markers
         ea = tt.engine_arrays()
-        H = tt.half_ticks
+        # scan over the EXECUTION grid, not the dense half-tick grid: for
+        # weighted (cost-aware) tables engine_arrays compresses out the
+        # duration-only cells, so the compiled scan length equals the
+        # event count, not the predicted makespan
+        H = int(ea["ev"].shape[0])
         NQF, NQB = int(ea["nq_f"]), int(ea["nq_b"])
         NSX, NSG = int(ea["ns_x"]), int(ea["ns_g"])
-        # When the table glues W to B (1f1b/interleaved: W(c,m) == B(c,m)+1
-        # everywhere — the legacy combined backward), ONE vjp at the B
-        # event produces both cotangents and the W event just accumulates
-        # the stashed param-grad: no second forward recompute. zero-bubble
-        # genuinely defers W, so it pays the split-vjp recompute — that
-        # tax is the schedule's cost model (PERF.md round 10).
+        # When the table glues W to B (1f1b/interleaved: W(c,m) starts the
+        # half-tick B(c,m) ENDS — B+1 on unit grids, B + b_cost[c] on
+        # cost-weighted ones), ONE vjp at the B event produces both
+        # cotangents and the W event just accumulates the stashed
+        # param-grad: no second forward recompute. zero-bubble genuinely
+        # defers W, so it pays the split-vjp recompute — that tax is the
+        # schedule's cost model (PERF.md round 10).
         B_t, W_t = tt.event_times(EVENT_BWD_IN), tt.event_times(EVENT_BWD_W)
-        fused_bw = all(W_t[k] == B_t[k] + 1 for k in B_t)
+        fused_bw = all(
+            W_t[k] == B_t[k] + tt.cost_of(EVENT_BWD_IN, k[0]) for k in B_t)
         self._fused_bw = fused_bw  # introspected by the parity tests
         ring_f = [(i, (i + 1) % S) for i in range(S)] if S > 1 else []
         ring_b = [((i + 1) % S, i) for i in range(S)] if S > 1 else []
@@ -417,16 +427,25 @@ class ScheduledPipelineStrategy(GPipeStrategy):
         t_bar = jnp.asarray(ea["ba_row"])
         t_bam = jnp.asarray(ea["ba_m"])
 
+        pipe_shard = self.pipe_shard
+        dp = self.dp
+        meta = getattr(self, "_row_meta", None)
+        gather_rows = self._make_gather_rows()
+
         def inner(params_rows, state_rows, xs, ys, *guard_args):
             # local views -> [V, X] chunk rows (pipedream's convention):
             # V=1 state is [1, L] (P('stage', None), already [V, L]);
-            # V>1 is [V, 1, L] (P(None, 'stage', None))
+            # V>1 is [V, 1, L] (P(None, 'stage', None)). Hybrid
+            # PP x ZeRO-1: rows arrive as [V, L/dp] device-major shards
+            # and the per-bucket just-in-time all-gather rebuilds them.
             if V == 1:
                 params = _vary(params_rows)
                 st = _vary(state_rows)
             else:
                 params = _vary(params_rows[:, 0])
                 st = _vary(state_rows[:, 0])
+            if gather_rows is not None:
+                params = _vary(gather_rows(params))
             xs = _vary(xs)
             ys = _vary(ys)
             smul = guard_args[0] if guarded else jnp.float32(1.0)
@@ -493,21 +512,40 @@ class ScheduledPipelineStrategy(GPipeStrategy):
             # dp replicas averaged ('data' pmean), counts summed
             ce = lax.pmean(lax.psum(ce_acc, "stage") / M, "data")
             correct = lax.psum(lax.psum(corr_acc, "stage"), "data")
-            grads = lax.pmean(g_acc, "data") / M
+            if pipe_shard:
+                # hybrid PP x ZeRO-1: the post-scan pmean becomes one
+                # reduce-scatter PER BUCKET (late buckets' wire time
+                # overlaps the drain's remaining compute) — each device
+                # keeps its 1/dp device-major slice of the summed
+                # gradient, feeding the sharded update outside. The
+                # /dp /M matches the replicated engine's pmean-then-/M
+                # division order so the trajectories pin.
+                parts = []
+                for b in range(meta.num_buckets):
+                    o, ln = meta.bucket_offsets[b], meta.bucket_padded[b]
+                    parts.append(lax.psum_scatter(
+                        g_acc[:, o:o + ln], "data", scatter_dimension=1,
+                        tiled=True))
+                gsh = (jnp.concatenate(parts, axis=1) if len(parts) > 1
+                       else parts[0])
+                grads = gsh / dp / M
+            else:
+                grads = lax.pmean(g_acc, "data") / M
             st = lax.pmean(st, "data")  # sync-BN parity with gpipe
             if V == 1:
                 return grads, st, ce, correct
             return grads[:, None], st[:, None], ce, correct
 
         spec = self._chunk_sharding_spec()
-        in_specs = (spec, spec, P(None, "data"), P(None, "data"))
+        pspec = self._param_spec()
+        in_specs = (pspec, spec, P(None, "data"), P(None, "data"))
         if guarded:
             in_specs = in_specs + (P(),)
         pipe = _shard_map(
             inner,
             mesh=mesh,
             in_specs=in_specs,
-            out_specs=(spec, spec, P(), P()),
+            out_specs=(pspec, spec, P(), P()),
         )
 
         def train_step(ts: PipeTrainState, xs, ys, lr):

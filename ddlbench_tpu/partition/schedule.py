@@ -38,13 +38,27 @@ Analytic bubble fractions under this model, at equal (S, M), V = 1::
 so ``zero-bubble < 1f1b <= interleaved < fill-drain`` — the ordering the
 schedule-parity suite pins. ``1f1b``/``zero-bubble`` formulas are verified
 against the table-derived fractions in tests/test_pipeline_rt.py.
+
+Cost-aware timetables (ISSUE 8): every generator also accepts per-chunk
+``costs = (f, b, w)`` — three length-C tuples of positive ints pricing each
+chunk's F/B/W event in half-ticks — so the auto-partitioner's deliberately
+UNEVEN stage splits get timetables packed for their true costs instead of
+the F=B=W unit fiction. An event occupies ``cost`` consecutive grid cells;
+``event_times`` reports START half-ticks, handoffs remain one half-tick
+after the producer's END, and ``validate``/``ring_slots``/
+``bubble_fraction`` generalize (a weighted cell grid's idle fraction IS the
+weighted bubble). Unit costs reproduce the PR 7 tables bitwise (pinned by
+tests/test_schedule_costs.py); :func:`quantize_cost_vectors` maps profiled
+per-chunk milliseconds onto the integer grid, and
+:func:`reprice_timetable` re-simulates a unit-cost table's event ORDER
+under true costs — the baseline a cost-aware table must beat.
 """
 
 from __future__ import annotations
 
 import dataclasses
 import functools
-from typing import Dict, List, Tuple
+from typing import Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -53,6 +67,34 @@ EVENT_IDLE, EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W = 0, 1, 2, 3
 EVENT_NAMES = ("idle", "F", "B", "W")
 
 PIPE_SCHEDULES = ("fill-drain", "1f1b", "interleaved", "zero-bubble")
+
+# costs = (f, b, w): three length-C tuples of positive ints, half-ticks per
+# chunk event. None = the F=B=W unit-cost model.
+CostVectors = Tuple[Tuple[int, ...], Tuple[int, ...], Tuple[int, ...]]
+
+
+def normalize_costs(costs, num_chunks: int) -> Optional[CostVectors]:
+    """Canonical cost vectors: three length-``num_chunks`` int tuples, all
+    >= 1; all-unit vectors normalize to None (the closed-form unit paths
+    are then taken, which is what makes "unit costs reproduce the legacy
+    tables bitwise" true by routing as well as by construction)."""
+    if costs is None:
+        return None
+    if len(costs) != 3:
+        raise ValueError(f"costs must be (f, b, w) vectors; got {costs!r}")
+    out = []
+    for vec in costs:
+        vec = tuple(int(v) for v in vec)
+        if len(vec) != num_chunks:
+            raise ValueError(
+                f"cost vector length {len(vec)} != num_chunks {num_chunks}")
+        if any(v < 1 for v in vec):
+            raise ValueError(f"event costs must be >= 1 half-tick; got {vec}")
+        out.append(vec)
+    f, b, w = out
+    if all(v == 1 for v in f + b + w):
+        return None
+    return (f, b, w)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -72,6 +114,10 @@ class Timetable:
     events: np.ndarray  # [H, S] int8
     mbs: np.ndarray  # [H, S] int32
     chunks: np.ndarray  # [H, S] int32
+    # per-chunk (f, b, w) half-tick costs; None = unit-cost model. A
+    # weighted event occupies ``cost`` consecutive grid cells starting at
+    # its event_times() half-tick.
+    costs: Optional[CostVectors] = None
 
     @property
     def num_chunks(self) -> int:
@@ -80,6 +126,12 @@ class Timetable:
     @property
     def half_ticks(self) -> int:
         return int(self.events.shape[0])
+
+    def cost_of(self, kind: int, chunk: int) -> int:
+        """Half-ticks event ``kind`` occupies on ``chunk`` (1 when unit)."""
+        if self.costs is None:
+            return 1
+        return self.costs[kind - EVENT_FWD][chunk]
 
     # -- derived figures ---------------------------------------------------
 
@@ -93,22 +145,30 @@ class Timetable:
         return (total - busy) / total if total else 0.0
 
     def event_times(self, kind: int) -> Dict[Tuple[int, int], int]:
-        """{(chunk, microbatch): half_tick} for one event kind."""
+        """{(chunk, microbatch): START half_tick} for one event kind.
+        Weighted events fill ``cost`` consecutive cells; np.nonzero walks
+        h-ascending, so the first cell seen is the start."""
         out: Dict[Tuple[int, int], int] = {}
         hs, ss = np.nonzero(self.events == kind)
         for h, s in zip(hs.tolist(), ss.tolist()):
-            out[(int(self.chunks[h, s]), int(self.mbs[h, s]))] = int(h)
+            out.setdefault(
+                (int(self.chunks[h, s]), int(self.mbs[h, s])), int(h))
         return out
 
     def validate(self) -> None:
         """Dependency-correctness: every (chunk, mb) runs F once, B once,
-        W once, in an order that respects the one-half-tick handoffs.
-        Raises AssertionError with the violated relation."""
+        W once, in an order that respects the one-half-tick handoffs —
+        generalized to weighted events (a consumer may start no earlier
+        than its producer's END, i.e. start + cost). Raises AssertionError
+        with the violated relation."""
         S, V, M, C = (self.num_stages, self.virtual_stages,
                       self.num_microbatches, self.num_chunks)
         F = self.event_times(EVENT_FWD)
         B = self.event_times(EVENT_BWD_IN)
         W = self.event_times(EVENT_BWD_W)
+        fc = lambda c: self.cost_of(EVENT_FWD, c)
+        bc = lambda c: self.cost_of(EVENT_BWD_IN, c)
+        wc = lambda c: self.cost_of(EVENT_BWD_W, c)
         for table, nm in ((F, "F"), (B, "B"), (W, "W")):
             assert len(table) == C * M, (
                 f"{self.name}: {nm} covers {len(table)} of {C * M} "
@@ -117,21 +177,28 @@ class Timetable:
             for m in range(M):
                 f, b, w = F[(c, m)], B[(c, m)], W[(c, m)]
                 if c > 0:
-                    assert f >= F[(c - 1, m)] + 1, (
+                    assert f >= F[(c - 1, m)] + fc(c - 1), (
                         f"{self.name}: F({c},{m})@{f} before its input "
-                        f"arrives (producer F({c - 1},{m})@{F[(c - 1, m)]})")
+                        f"arrives (producer F({c - 1},{m})@{F[(c - 1, m)]}"
+                        f"+{fc(c - 1)})")
                 if c < C - 1:
-                    assert b >= B[(c + 1, m)] + 1, (
+                    assert b >= B[(c + 1, m)] + bc(c + 1), (
                         f"{self.name}: B({c},{m})@{b} before its cotangent "
-                        f"arrives (producer B({c + 1},{m})@{B[(c + 1, m)]})")
-                else:
-                    assert b >= f + 1, (
-                        f"{self.name}: last-chunk B({c},{m})@{b} not after "
-                        f"its F@{f}")
-                assert w >= b + 1, (
-                    f"{self.name}: W({c},{m})@{w} not after B@{b}")
-                assert b > f, f"{self.name}: B({c},{m})@{b} not after F@{f}"
-        # one event per device per half-tick is structural ([H, S] grid);
+                        f"arrives (producer B({c + 1},{m})@{B[(c + 1, m)]}"
+                        f"+{bc(c + 1)})")
+                assert b >= f + fc(c), (
+                    f"{self.name}: B({c},{m})@{b} not after its F@{f}"
+                    f"+{fc(c)}")
+                assert w >= b + bc(c), (
+                    f"{self.name}: W({c},{m})@{w} not after B@{b}+{bc(c)}")
+        # one event per device per half-tick is structural ([H, S] grid)
+        # PROVIDED no generator overwrote a cell: the busy-cell count must
+        # equal the summed event costs (catches overlapping placements)
+        busy = int(np.count_nonzero(self.events))
+        expect = M * sum(fc(c) + bc(c) + wc(c) for c in range(C))
+        assert busy == expect, (
+            f"{self.name}: {busy} busy cells != {expect} summed event "
+            f"costs (overlapping weighted events?)")
         # chunk-locality: every event's chunk lives on its device
         hs, ss = np.nonzero(self.events)
         assert all(int(self.chunks[h, s]) % S == s
@@ -146,6 +213,10 @@ class Timetable:
         the table is realized by jax.grad reversing that scan. Only
         meaningful for fill-drain (whose forward phase IS its first T
         half-ticks); asserts that shape."""
+        assert self.costs is None, (
+            f"{self.name}: the autodiff (fill-drain) runtime executes the "
+            f"unit-cost schedule only; weighted tables are event-mode/"
+            f"analysis data")
         S, V, M = self.num_stages, self.virtual_stages, self.num_microbatches
         T = M * V + S - 1
         fwd = self.events[:T] == EVENT_FWD
@@ -172,58 +243,86 @@ class Timetable:
         """Everything the event-mode runtime (parallel/pipeline_rt.py)
         needs to EXECUTE this table, precomputed on the host:
 
-        * ``ev/vrow/mb [H, S]`` — the event grid (vrow = chunk row v on the
-          device; -1s clipped to 0, ev==IDLE masks them);
-        * forward-arrival routing ``fa_valid/fa_row/fa_m [H, S]`` — at
-          half-tick h, device s's ring buffer holds the activation chunk
-          ``vrow*S + s`` sent by its left neighbor's F at h-1 (V>1 wrap
-          transfers are baked into the row index);
-        * backward-arrival routing ``ba_* [H, S]`` — same for cotangents
+        * ``ev/vrow/mb [He, S]`` — the EXECUTION grid over the He ticks on
+          which at least one device dispatches an event (for unit-cost
+          tables every busy half-tick; for weighted tables the event START
+          ticks — the in-between cells only model predicted duration, and
+          compressing them out keeps the compiled scan length equal to the
+          event count instead of the weighted makespan). -1s are clipped
+          to 0, ev==IDLE masks them;
+        * forward-arrival routing ``fa_valid/fa_row/fa_m [He, S]`` — at
+          execution tick i, device s's ring buffer holds the activation
+          chunk ``vrow*S + s`` sent by its left neighbor's F dispatched at
+          tick i-1 (V>1 wrap transfers are baked into the row index);
+        * backward-arrival routing ``ba_* [He, S]`` — same for cotangents
           from the right neighbor's B events;
         * ring sizes ``nq_f/nq_b`` (arrival->use queues, slot = m % n) and
           ``ns_x/ns_g`` (F->W input stash, B->W cotangent stash).
         """
-        S, V, M, C, H = (self.num_stages, self.virtual_stages,
-                         self.num_microbatches, self.num_chunks,
-                         self.half_ticks)
+        S, V, M, C = (self.num_stages, self.virtual_stages,
+                      self.num_microbatches, self.num_chunks)
         F = self.event_times(EVENT_FWD)
         B = self.event_times(EVENT_BWD_IN)
         W = self.event_times(EVENT_BWD_W)
-        fa_valid = np.zeros((H, S), np.bool_)
-        fa_row = np.zeros((H, S), np.int32)
-        fa_m = np.zeros((H, S), np.int32)
-        ba_valid = np.zeros((H, S), np.bool_)
-        ba_row = np.zeros((H, S), np.int32)
-        ba_m = np.zeros((H, S), np.int32)
+        # execution ticks: every half-tick where some device STARTS an
+        # event. Dependency-correct by construction: a consumer's start is
+        # a later execution tick than its producer's, and physical ring
+        # arrivals land one EXECUTION tick after the producer's dispatch
+        # (the engine ships at the dispatch tick regardless of the
+        # modelled duration).
+        starts = sorted({h for d in (F, B, W) for h in d.values()})
+        idx = {h: i for i, h in enumerate(starts)}
+        He = len(starts)
+        ev = np.zeros((He, S), np.int32)
+        vrow = np.zeros((He, S), np.int32)
+        mb = np.zeros((He, S), np.int32)
+        fa_valid = np.zeros((He, S), np.bool_)
+        fa_row = np.zeros((He, S), np.int32)
+        fa_m = np.zeros((He, S), np.int32)
+        ba_valid = np.zeros((He, S), np.bool_)
+        ba_row = np.zeros((He, S), np.int32)
+        ba_m = np.zeros((He, S), np.int32)
+        for table, kind in ((F, EVENT_FWD), (B, EVENT_BWD_IN),
+                            (W, EVENT_BWD_W)):
+            for (c, m), h in table.items():
+                i = idx[h]
+                ev[i, c % S] = kind
+                vrow[i, c % S] = c // S
+                mb[i, c % S] = m
         for (c, m), h in F.items():
             if c < C - 1:  # last chunk's output is the loss, never shipped
                 dev = (c + 1) % S
-                fa_valid[h + 1, dev] = True
-                fa_row[h + 1, dev] = (c + 1) // S
-                fa_m[h + 1, dev] = m
+                fa_valid[idx[h] + 1, dev] = True
+                fa_row[idx[h] + 1, dev] = (c + 1) // S
+                fa_m[idx[h] + 1, dev] = m
         for (c, m), h in B.items():
             if c > 0:  # chunk 0's input grad has no consumer
                 dev = (c - 1) % S
-                ba_valid[h + 1, dev] = True
-                ba_row[h + 1, dev] = (c - 1) // S
-                ba_m[h + 1, dev] = m
-        interior = {(c, m): t for (c, m), t in F.items() if c > 0}
+                ba_valid[idx[h] + 1, dev] = True
+                ba_row[idx[h] + 1, dev] = (c - 1) // S
+                ba_m[idx[h] + 1, dev] = m
+        # ring live-ranges in EXECUTION ticks (write = arrival, one tick
+        # after the producer's dispatch; read = the consumer's dispatch)
+        Fi = {k: idx[h] for k, h in F.items()}
+        Bi = {k: idx[h] for k, h in B.items()}
+        Wi = {k: idx[h] for k, h in W.items()}
+        interior = {(c, m): t for (c, m), t in Fi.items() if c > 0}
         return {
-            "ev": self.events.astype(np.int32),
-            "vrow": np.maximum(self.chunks // S, 0).astype(np.int32),
-            "mb": np.maximum(self.mbs, 0).astype(np.int32),
+            "ev": ev,
+            "vrow": vrow,
+            "mb": mb,
             "fa_valid": fa_valid, "fa_row": fa_row, "fa_m": fa_m,
             "ba_valid": ba_valid, "ba_row": ba_row, "ba_m": ba_m,
             "nq_f": ring_slots(
-                {k: F[(k[0] - 1, k[1])] + 1 for k in interior},
+                {k: Fi[(k[0] - 1, k[1])] + 1 for k in interior},
                 interior, C, M),
             "nq_b": ring_slots(
-                {(c, m): B[(c + 1, m)] + 1 for (c, m) in B if c < C - 1},
-                {k: B[k] for k in B if k[0] < C - 1}, C, M),
+                {(c, m): Bi[(c + 1, m)] + 1 for (c, m) in Bi if c < C - 1},
+                {k: Bi[k] for k in Bi if k[0] < C - 1}, C, M),
             "ns_x": ring_slots(interior,
-                               {k: W[k] for k in interior}, C, M),
-            "ns_g": ring_slots({k: B[k] for k in B if k[0] < C - 1},
-                               {k: W[k] for k in W if k[0] < C - 1}, C, M),
+                               {k: Wi[k] for k in interior}, C, M),
+            "ns_g": ring_slots({k: Bi[k] for k in Bi if k[0] < C - 1},
+                               {k: Wi[k] for k in Wi if k[0] < C - 1}, C, M),
         }
 
 
@@ -261,38 +360,119 @@ def _empty(H: int, S: int):
             np.full((H, S), -1, np.int32))
 
 
-def fill_drain_timetable(S: int, M: int, V: int = 1) -> Timetable:
+def _paint(events, mbs, chunks, h: int, s: int, kind: int, c: int, m: int,
+           cost: int) -> None:
+    """Write one weighted event's ``cost`` consecutive cells."""
+    events[h:h + cost, s] = kind
+    mbs[h:h + cost, s] = m
+    chunks[h:h + cost, s] = c
+
+
+def fill_drain_timetable(S: int, M: int, V: int = 1,
+                         costs: Optional[CostVectors] = None) -> Timetable:
     """GPipe: the forward scan's timetable (chunk c = v*S + s runs
     microbatch m = g*S + r at tick t = g*S*V + v*S + s + r — the same
     closed form parallel/gpipe.py compiles), followed by the reversed
     combined backward: forward tick t replays as B then W at half-ticks
-    T + 2*(T-1-t) and T + 2*(T-1-t) + 1 (jax.grad reverses the scan)."""
-    T = M * V + S - 1
-    H = 3 * T
-    events, mbs, chunks = _empty(H, S)
-    for t in range(T):
+    T + 2*(T-1-t) and T + 2*(T-1-t) + 1 (jax.grad reverses the scan).
+
+    With ``costs``, the same STRUCTURE priced by per-chunk weights: every
+    device runs its forwards in the identical (g, v, r) order, each
+    starting at max(device free, input arrival = producer start + cost);
+    the backward replays the per-device forward order REVERSED after the
+    global forward flush, items glued B+W, cotangent arrival = the
+    producer's whole reversed-scan item (B+W) completing — the weighted
+    generalization of jax.grad's tick-reversed schedule. Unit costs
+    reproduce the closed form bitwise (tests/test_schedule_costs.py)."""
+    costs = normalize_costs(costs, S * V)
+    if costs is None:
+        T = M * V + S - 1
+        H = 3 * T
+        events, mbs, chunks = _empty(H, S)
+        for t in range(T):
+            for s in range(S):
+                u = t - s
+                if not 0 <= u < M * V:
+                    continue
+                g, rem = divmod(u, S * V)
+                v, r = divmod(rem, S)
+                m = g * S + r
+                if m >= M:
+                    continue
+                c = v * S + s
+                events[t, s] = EVENT_FWD
+                mbs[t, s], chunks[t, s] = m, c
+                tb = T + 2 * (T - 1 - t)
+                events[tb, s], events[tb + 1, s] = EVENT_BWD_IN, EVENT_BWD_W
+                mbs[tb, s] = mbs[tb + 1, s] = m
+                chunks[tb, s] = chunks[tb + 1, s] = c
+        return Timetable("fill-drain", S, V, M, events, mbs, chunks)
+
+    fc, bc, wc = costs
+    assert M % S == 0 or V == 1, "V > 1 needs M % S == 0"
+    F: Dict[Tuple[int, int], int] = {}
+    order: Dict[int, List[Tuple[int, int]]] = {s: [] for s in range(S)}
+    free = [0] * S
+    # forward: per device, (g, v, r) ascending — the closed form's order
+    for g in range(-(-M // S)):
+        for v in range(V):
+            for r in range(S):
+                m = g * S + r
+                if m >= M:
+                    continue
+                for s in range(S):
+                    c = v * S + s
+                    arrival = (0 if c == 0
+                               else F[(c - 1, m)] + fc[c - 1])
+                    h = max(free[s], arrival)
+                    F[(c, m)] = h
+                    free[s] = h + fc[c]
+                    order[s].append((c, m))
+    flush = max(free)  # the synchronous flush: no B before every F ends
+    B: Dict[Tuple[int, int], int] = {}
+    W: Dict[Tuple[int, int], int] = {}
+    free = [flush] * S
+    # backward: per device, the forward order reversed, B+W glued; the
+    # cotangent arrives when the producer's whole reversed-scan item
+    # (its B and its glued W) has completed
+    done = [0] * S  # per-device position in the reversed order
+    pending = sum(len(order[s]) for s in range(S))
+    while pending:
+        progressed = False
         for s in range(S):
-            u = t - s
-            if not 0 <= u < M * V:
-                continue
-            g, rem = divmod(u, S * V)
-            v, r = divmod(rem, S)
-            m = g * S + r
-            if m >= M:
-                continue
-            c = v * S + s
-            events[t, s] = EVENT_FWD
-            mbs[t, s], chunks[t, s] = m, c
-            tb = T + 2 * (T - 1 - t)
-            events[tb, s], events[tb + 1, s] = EVENT_BWD_IN, EVENT_BWD_W
-            mbs[tb, s] = mbs[tb + 1, s] = m
-            chunks[tb, s] = chunks[tb + 1, s] = c
-    return Timetable("fill-drain", S, V, M, events, mbs, chunks)
+            while done[s] < len(order[s]):
+                c, m = order[s][len(order[s]) - 1 - done[s]]
+                if c == S * V - 1:
+                    arrival = F[(c, m)] + fc[c]
+                elif (c + 1, m) not in B:
+                    break  # producer not placed yet; try other devices
+                else:
+                    arrival = B[(c + 1, m)] + bc[c + 1] + wc[c + 1]
+                h = max(free[s], arrival)
+                B[(c, m)] = h
+                W[(c, m)] = h + bc[c]
+                free[s] = h + bc[c] + wc[c]
+                done[s] += 1
+                pending -= 1
+                progressed = True
+        assert progressed, "fill-drain backward deadlocked (internal bug)"
+    H = max(free)
+    events, mbs, chunks = _empty(H, S)
+    for (c, m), h in F.items():
+        _paint(events, mbs, chunks, h, c % S, EVENT_FWD, c, m, fc[c])
+    for (c, m), h in B.items():
+        _paint(events, mbs, chunks, h, c % S, EVENT_BWD_IN, c, m, bc[c])
+    for (c, m), h in W.items():
+        _paint(events, mbs, chunks, h, c % S, EVENT_BWD_W, c, m, wc[c])
+    tt = Timetable("fill-drain", S, V, M, events, mbs, chunks, costs)
+    tt.validate()
+    return tt
 
 
 @functools.lru_cache(maxsize=64)
 def _greedy_timetable(name: str, S: int, M: int, V: int,
-                      defer_weight_grads: bool) -> Timetable:
+                      defer_weight_grads: bool,
+                      costs: Optional[CostVectors] = None) -> Timetable:
     """Event-driven greedy generator for the synchronous 1F1B family.
 
     Closed-form rule set (this IS the schedule description; the dense table
@@ -301,45 +481,58 @@ def _greedy_timetable(name: str, S: int, M: int, V: int,
     * chunk c runs a warmup of ``C - 1 - c`` forwards, i.e. at most
       ``C - c`` microbatches may be in flight (F done, B not) — the classic
       1F1B in-flight cap over C = S*V chunks;
-    * readiness: F(c, m) one half-tick after F(c-1, m); B(c, m) one after
-      B(c+1, m) (one after F(c, m) on the last chunk); W(c, m) any time
-      after B(c, m);
-    * per half-tick each device runs its highest-priority ready event:
-      B first (drain the pipe), then — 1f1b — W (the legacy combined
-      backward, W glued behind B) or — zero-bubble — F (ZB-H1: W is
-      deferred into half-ticks where nothing else is ready, filling the
-      bubbles). Ties go to the earliest microbatch, then the deepest chunk.
+    * readiness: F(c, m) one half-tick after F(c-1, m) ENDS; B(c, m) one
+      after B(c+1, m) ends (after F(c, m) ends on the last chunk); W(c, m)
+      any time after B(c, m) ends;
+    * per half-tick each FREE device (weighted events keep it busy for
+      their whole cost) runs its highest-priority ready event: B first
+      (drain the pipe), then — 1f1b — W (the legacy combined backward, W
+      glued behind B) or — zero-bubble — F (ZB-H1: W is deferred into
+      half-ticks where nothing else is ready, filling the bubbles). Ties
+      go to the earliest microbatch, then the deepest chunk.
+
+    With unit costs (``costs is None``) every end is start + 1 and the
+    busy-until bookkeeping is a no-op, so the emitted grid is bitwise the
+    PR 7 table.
     """
     C = S * V
+    fc, bc, wc = costs if costs is not None else ((1,) * C,) * 3
     F: Dict[Tuple[int, int], int] = {}
     B: Dict[Tuple[int, int], int] = {}
     W: Dict[Tuple[int, int], int] = {}
-    rows: List[Tuple[int, int, int, int]] = []  # (h, s, event, c, m)
+    rows: List[Tuple[int, int, int, int, int, int]] = []
+    # per-chunk microbatches in flight (F done, B not), maintained
+    # incrementally — the O(M) scan per readiness probe made large-M
+    # advisory builds (recommend_virtual_stages) a visible startup stall
+    inflight = [0] * C
 
     def ready_f(c, m, h):
         if (c, m) in F or m >= M:
             return False
-        if c > 0 and F.get((c - 1, m), h) >= h:
+        if c > 0 and F.get((c - 1, m), h) + fc[c - 1] > h:
             return False
-        inflight = sum(1 for mm in range(M)
-                       if (c, mm) in F and (c, mm) not in B)
-        return inflight < C - c
+        return inflight[c] < C - c
 
     def ready_b(c, m, h):
         if (c, m) in B or (c, m) not in F:
             return False
         if c == C - 1:
-            return F[(c, m)] < h
-        return B.get((c + 1, m), h) < h
+            return F[(c, m)] + fc[c] <= h
+        return B.get((c + 1, m), h) + bc[c + 1] <= h
 
     def ready_w(c, m, h):
-        return (c, m) in B and (c, m) not in W and B[(c, m)] < h
+        return ((c, m) in B and (c, m) not in W
+                and B[(c, m)] + bc[c] <= h)
 
     h = 0
     total = 3 * C * M
     done = 0
+    busy = [0] * S  # device s is mid-event until half-tick busy[s]
+    max_cost = max(fc + bc + wc)
     while done < total:
         for s in range(S):
+            if busy[s] > h:
+                continue
             # candidate (priority, m, -c, event, c) rows; lowest wins
             cand = []
             for v in range(V):
@@ -357,55 +550,161 @@ def _greedy_timetable(name: str, S: int, M: int, V: int,
                 continue
             _, m, _, ev, c = min(cand)
             {EVENT_FWD: F, EVENT_BWD_IN: B, EVENT_BWD_W: W}[ev][(c, m)] = h
-            rows.append((h, s, ev, c, m))
+            if ev == EVENT_FWD:
+                inflight[c] += 1
+            elif ev == EVENT_BWD_IN:
+                inflight[c] -= 1
+            cost = {EVENT_FWD: fc, EVENT_BWD_IN: bc, EVENT_BWD_W: wc}[ev][c]
+            busy[s] = h + cost
+            rows.append((h, s, ev, c, m, cost))
             done += 1
         h += 1
-        assert h <= 6 * C * M + 6 * C + 16, (
+        assert h <= (6 * C * M + 6 * C + 16) * max_cost, (
             f"{name}: greedy schedule did not converge (S={S}, V={V}, "
             f"M={M})")
-    events, mbs, chunks = _empty(h, S)
-    for hh, s, ev, c, m in rows:
-        events[hh, s], mbs[hh, s], chunks[hh, s] = ev, m, c
-    tt = Timetable(name, S, V, M, events, mbs, chunks)
+    events, mbs, chunks = _empty(max(busy), S)
+    for hh, s, ev, c, m, cost in rows:
+        _paint(events, mbs, chunks, hh, s, ev, c, m, cost)
+    tt = Timetable(name, S, V, M, events, mbs, chunks, costs)
     tt.validate()
     return tt
 
 
-def sync_1f1b_timetable(S: int, M: int, V: int = 1) -> Timetable:
+def sync_1f1b_timetable(S: int, M: int, V: int = 1,
+                        costs: Optional[CostVectors] = None) -> Timetable:
     """Synchronous 1F1B (V=1) / interleaved 1F1B (V>1): same step-start
     weights for every microbatch, grads accumulated, ONE optimizer update
     per step — unlike parallel/pipedream.py's async engine."""
     return _greedy_timetable("1f1b" if V == 1 else "interleaved",
-                             S, M, V, defer_weight_grads=False)
+                             S, M, V, defer_weight_grads=False,
+                             costs=normalize_costs(costs, S * V))
 
 
-def zero_bubble_timetable(S: int, M: int) -> Timetable:
+def zero_bubble_timetable(S: int, M: int,
+                          costs: Optional[CostVectors] = None) -> Timetable:
     """ZB-H1-style: weight-grad events deferred to fill the drain bubble
     (same in-flight cap as 1F1B, so activation memory is 1F1B-equal)."""
     return _greedy_timetable("zero-bubble", S, M, 1,
-                             defer_weight_grads=True)
+                             defer_weight_grads=True,
+                             costs=normalize_costs(costs, S))
 
 
-def make_timetable(schedule: str, S: int, M: int, V: int = 1) -> Timetable:
-    """Factory keyed by the ``--pipe-schedule`` flag value."""
+def make_timetable(schedule: str, S: int, M: int, V: int = 1,
+                   costs: Optional[CostVectors] = None) -> Timetable:
+    """Factory keyed by the ``--pipe-schedule`` flag value. ``costs`` are
+    per-chunk (f, b, w) half-tick vectors (None / all-unit = the PR 7
+    unit-cost tables, reproduced bitwise).
+
+    For weighted EVENT schedules the factory builds two candidates — the
+    cost-aware greedy table and the unit-cost table's event order
+    repriced under the true costs (:func:`reprice_timetable`) — and
+    returns the lower-bubble one: the greedy is a heuristic that can
+    commit early where the unit order happens to interleave better, so
+    taking the min guarantees a weighted timetable never packs WORSE
+    than executing the classic schedule on the same uneven chunks."""
+    costs = normalize_costs(costs, S * V)
     if schedule == "fill-drain":
-        return fill_drain_timetable(S, M, V)
-    if schedule == "1f1b":
-        if V != 1:
-            raise ValueError("1f1b is the V=1 schedule; use "
-                             "--pipe-schedule interleaved with "
-                             "--virtual-stages for V > 1")
-        return sync_1f1b_timetable(S, M, 1)
-    if schedule == "interleaved":
-        return sync_1f1b_timetable(S, M, V)
-    if schedule == "zero-bubble":
-        if V != 1:
-            raise ValueError("zero-bubble (ZB-H1) is scoped to V = 1; "
-                             "combine interleaving and W-deferral in a "
-                             "future schedule")
-        return zero_bubble_timetable(S, M)
-    raise ValueError(f"unknown pipe schedule {schedule!r} "
-                     f"(choose from {', '.join(PIPE_SCHEDULES)})")
+        return fill_drain_timetable(S, M, V, costs)
+    if schedule == "1f1b" and V != 1:
+        raise ValueError("1f1b is the V=1 schedule; use "
+                         "--pipe-schedule interleaved with "
+                         "--virtual-stages for V > 1")
+    if schedule == "zero-bubble" and V != 1:
+        raise ValueError("zero-bubble (ZB-H1) is scoped to V = 1; "
+                         "combine interleaving and W-deferral in a "
+                         "future schedule")
+    if schedule in ("1f1b", "interleaved"):
+        gen = lambda c: sync_1f1b_timetable(S, M, V, c)
+    elif schedule == "zero-bubble":
+        gen = lambda c: zero_bubble_timetable(S, M, c)
+    else:
+        raise ValueError(f"unknown pipe schedule {schedule!r} "
+                         f"(choose from {', '.join(PIPE_SCHEDULES)})")
+    if costs is None:
+        return gen(None)
+    aware = gen(costs)
+    repriced = reprice_timetable(gen(None), costs)
+    return (aware if aware.bubble_fraction() <= repriced.bubble_fraction()
+            else repriced)
+
+
+def reprice_timetable(tt: Timetable, costs: CostVectors) -> Timetable:
+    """Re-simulate ``tt``'s event ORDER under per-chunk ``costs``: each
+    device runs its events in the original start order, each starting at
+    max(device free, producer end) — what executing a unit-cost schedule
+    on genuinely uneven stages would actually cost. The cost-aware
+    generator's table must beat (or match) this table's bubble; the
+    uneven-cost acceptance fixture pins strictly-lower for 1f1b."""
+    costs = normalize_costs(costs, tt.num_chunks)
+    if costs is None:
+        return tt
+    fc, bc, wc = costs
+    C = tt.num_chunks
+    F0 = tt.event_times(EVENT_FWD)
+    B0 = tt.event_times(EVENT_BWD_IN)
+    W0 = tt.event_times(EVENT_BWD_W)
+    # global original start order; producers always precede consumers
+    seq = sorted(
+        [(h, c % tt.num_stages, EVENT_FWD, c, m) for (c, m), h in F0.items()]
+        + [(h, c % tt.num_stages, EVENT_BWD_IN, c, m)
+           for (c, m), h in B0.items()]
+        + [(h, c % tt.num_stages, EVENT_BWD_W, c, m)
+           for (c, m), h in W0.items()])
+    F: Dict[Tuple[int, int], int] = {}
+    B: Dict[Tuple[int, int], int] = {}
+    W: Dict[Tuple[int, int], int] = {}
+    free = [0] * tt.num_stages
+    for _h0, s, kind, c, m in seq:
+        if kind == EVENT_FWD:
+            arrival = 0 if c == 0 else F[(c - 1, m)] + fc[c - 1]
+            start = max(free[s], arrival)
+            F[(c, m)] = start
+            free[s] = start + fc[c]
+        elif kind == EVENT_BWD_IN:
+            arrival = (F[(c, m)] + fc[c] if c == C - 1
+                       else B[(c + 1, m)] + bc[c + 1])
+            start = max(free[s], arrival, F[(c, m)] + fc[c])
+            B[(c, m)] = start
+            free[s] = start + bc[c]
+        else:
+            start = max(free[s], B[(c, m)] + bc[c])
+            W[(c, m)] = start
+            free[s] = start + wc[c]
+    H = max(free)
+    events, mbs, chunks = _empty(H, tt.num_stages)
+    for table, kind, cv in ((F, EVENT_FWD, fc), (B, EVENT_BWD_IN, bc),
+                            (W, EVENT_BWD_W, wc)):
+        for (c, m), h in table.items():
+            _paint(events, mbs, chunks, h, c % tt.num_stages, kind, c, m,
+                   cv[c])
+    out = Timetable(tt.name, tt.num_stages, tt.virtual_stages,
+                    tt.num_microbatches, events, mbs, chunks, costs)
+    out.validate()
+    return out
+
+
+def quantize_cost_vectors(f_ms, b_ms, w_ms=None,
+                          max_units: int = 8) -> CostVectors:
+    """Per-chunk profiled milliseconds -> integer half-tick cost vectors.
+
+    The cheapest event maps to one half-tick; everything else scales
+    relative to it, rounded, capped at ``max_units`` (bounding the
+    weighted grid's height). ``w_ms=None`` splits the combined backward
+    evenly into B and W — the profiler measures fwd and fwd+bwd only, and
+    dL/dx vs dL/dw each cost about one forward (the same 2x heuristic
+    profiler/profile.py's flops mode uses)."""
+    f_ms = [float(v) for v in f_ms]
+    if w_ms is None:
+        b_ms = [float(v) / 2.0 for v in b_ms]
+        w_ms = list(b_ms)
+    else:
+        b_ms = [float(v) for v in b_ms]
+        w_ms = [float(v) for v in w_ms]
+    lo = min(v for v in f_ms + b_ms + w_ms if v > 0) if any(
+        v > 0 for v in f_ms + b_ms + w_ms) else 1.0
+    q = lambda v: max(1, min(max_units, int(round(v / lo))))
+    return (tuple(q(v) for v in f_ms), tuple(q(v) for v in b_ms),
+            tuple(q(v) for v in w_ms))
 
 
 # -- analytic bubble fractions (module docstring's closed forms) -----------
@@ -424,18 +723,23 @@ def pipeline_bubble_fraction(num_stages: int, num_microbatches: int,
 
 def schedule_bubble_fraction(schedule: str, num_stages: int,
                              num_microbatches: int,
-                             virtual_stages: int = 1) -> float:
+                             virtual_stages: int = 1,
+                             costs: Optional[CostVectors] = None) -> float:
     """Analytic bubble fraction for one shipped schedule at (S, M, V).
 
     fill-drain / 1f1b / zero-bubble use the closed forms (module
     docstring); interleaved is measured from its table (its fill/drain
     compression depends on how the greedy packer interleaves chunk rows).
     Closed forms are pinned against table-derived fractions by the
-    ``pipesched`` suite.
+    ``pipesched`` suite. With ``costs`` the WEIGHTED bubble is measured
+    from the cost-aware table (no closed forms exist for uneven chunks).
     """
     S, M, V = num_stages, num_microbatches, virtual_stages
     if S <= 1:
         return 0.0
+    costs = normalize_costs(costs, S * V)
+    if costs is not None:
+        return make_timetable(schedule, S, M, V, costs).bubble_fraction()
     if schedule == "fill-drain":
         return pipeline_bubble_fraction(S, M, V)
     if schedule == "1f1b" or (schedule == "interleaved" and V == 1):
@@ -468,10 +772,21 @@ def bubble_is_estimate(schedule: str, num_stages: int,
 
 
 def recommend_schedule(num_stages: int, num_microbatches: int,
-                       virtual_stages: int = 1) -> List[dict]:
+                       virtual_stages: int = 1,
+                       costs: Optional[CostVectors] = None,
+                       measured: Optional[Dict[str, float]] = None,
+                       ) -> List[dict]:
     """Feasible schedules at (S, M, V) with their analytic bubbles, best
     first — what --auto-partition's advisor now reports alongside the best
-    V. zero-bubble/1f1b rows appear only where their constraints hold."""
+    V. zero-bubble/1f1b rows appear only where their constraints hold.
+
+    ``costs``: per-chunk (f, b, w) half-tick vectors — rows then carry the
+    WEIGHTED analytic bubble of each schedule's cost-aware table.
+    ``measured``: {schedule: bubble} fractions reduced from a real trace
+    (telemetry/bubble.py) — a schedule with a measured figure ranks by it
+    (reality outranks the model; ROADMAP item 2c), keeping the analytic
+    value alongside as ``bubble``.
+    """
     S, M, V = num_stages, num_microbatches, virtual_stages
     rows = []
     for name in PIPE_SCHEDULES:
@@ -479,13 +794,18 @@ def recommend_schedule(num_stages: int, num_microbatches: int,
             continue
         if name == "interleaved" and V > 1 and M % S:
             continue  # interleaved groups microbatches in rounds of S
-        rows.append({
+        row = {
             "schedule": name,
-            "bubble": round(schedule_bubble_fraction(name, S, M, V), 4),
+            "bubble": round(
+                schedule_bubble_fraction(name, S, M, V, costs), 4),
             "virtual_stages": V if name in ("fill-drain", "interleaved")
             else 1,
-        })
-    rows.sort(key=lambda r: (r["bubble"], r["schedule"]))
+        }
+        if measured and name in measured:
+            row["bubble_measured"] = round(float(measured[name]), 4)
+        rows.append(row)
+    rows.sort(key=lambda r: (r.get("bubble_measured", r["bubble"]),
+                             r["schedule"]))
     return rows
 
 

@@ -354,6 +354,21 @@ def packed_chain_graph(graph: Graph, model, batch_size: int,
     return Graph.chain(chain_nodes)
 
 
+def chunk_cost_ms(graph: Graph, bounds: Sequence[int]):
+    """Per-chunk (forward_ms, backward_ms) sums of a profile graph over
+    chosen stage/chunk bounds — the raw material for cost-weighted
+    timetables (partition/schedule.quantize_cost_vectors): chunk c owns
+    graph nodes [bounds[c], bounds[c+1]) in topological order, exactly
+    the spans the partitioner chose and the pipeline runtime executes."""
+    order = graph.topological_sort()
+    f_ms, b_ms = [], []
+    for c in range(len(bounds) - 1):
+        span = order[bounds[c]:bounds[c + 1]]
+        f_ms.append(sum(n.forward_compute_time for n in span))
+        b_ms.append(sum(n.backward_compute_time for n in span))
+    return f_ms, b_ms
+
+
 def profile_and_partition(
     model: LayerModel,
     batch_size: int,

@@ -54,34 +54,41 @@ TICK_PREFIXES = ("pipe_tick",)
 def emit_tick_spans(tracer, timetable, t0_ns: int, t1_ns: int,
                     step: Optional[int] = None) -> int:
     """Project ``timetable`` onto the measured step window as ``pipe_tick``
-    marker spans (one per busy half-tick per stage) — the host-trace food
-    for :func:`bubble_fraction`. The projection divides [t0_ns, t1_ns)
-    into H equal half-ticks; the reduced fraction is timeline-scale
-    invariant, so the wall window only sets the display scale. Returns the
-    number of spans emitted (0 when the tracer is disabled)."""
+    marker spans (one per EVENT per stage, spanning the event's whole
+    half-tick cost — for unit-cost tables that is one span per busy
+    half-tick, the original behavior; for cost-weighted tables one span
+    covers the event's ``cost`` consecutive cells instead of splintering
+    into per-cell spans) — the host-trace food for
+    :func:`bubble_fraction`. The projection divides [t0_ns, t1_ns) into H
+    equal half-ticks; the reduced fraction is timeline-scale invariant, so
+    the wall window only sets the display scale. Returns the number of
+    spans emitted (0 when the tracer is disabled)."""
     if not getattr(tracer, "enabled", False):
         return 0
-    import numpy as np
+    from ddlbench_tpu.partition.schedule import (EVENT_BWD_IN, EVENT_BWD_W,
+                                                 EVENT_FWD)
 
-    H, S = timetable.half_ticks, timetable.num_stages
+    H = timetable.half_ticks
+    S = timetable.num_stages
     tick_ns = max(1, (t1_ns - t0_ns)) / H
     n = 0
-    hs, ss = np.nonzero(timetable.events)
-    for h, s in zip(hs.tolist(), ss.tolist()):
-        a = int(t0_ns + h * tick_ns)
-        b = int(t0_ns + (h + 1) * tick_ns)
-        args = {
-            "stage": int(s),
-            "chunk": int(timetable.chunks[h, s]),
-            "mb": int(timetable.mbs[h, s]),
-            "event": int(timetable.events[h, s]),
-            "half_tick": int(h),
-            "schedule": timetable.name,
-        }
-        if step is not None:
-            args["step"] = step
-        tracer.complete("pipe_tick", a, b, args)
-        n += 1
+    for kind in (EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W):
+        for (c, m), h in sorted(timetable.event_times(kind).items()):
+            cost = timetable.cost_of(kind, c)
+            a = int(t0_ns + h * tick_ns)
+            b = int(t0_ns + (h + cost) * tick_ns)
+            args = {
+                "stage": int(c % S),
+                "chunk": int(c),
+                "mb": int(m),
+                "event": int(kind),
+                "half_tick": int(h),
+                "schedule": timetable.name,
+            }
+            if step is not None:
+                args["step"] = step
+            tracer.complete("pipe_tick", a, b, args)
+            n += 1
     return n
 
 

@@ -144,11 +144,29 @@ def comm_stats(strategy) -> Dict[str, float]:
         out["boundary_bytes"] = boundary * dp  # per replica column
         if dp > 1:
             grad_bytes = sum(
-                4.0 * strategy._p_lens[s] for s in range(S)
-            )  # f32 packed grads
-            per_sync = _ring_allreduce_bytes(grad_bytes, dp)
-            syncs = M if name == "PipeDreamStrategy" else 1
-            out["allreduce_bytes"] = per_sync * syncs
+                4.0 * strategy._p_lens[c]
+                for c in range(len(strategy._p_lens))
+            )  # f32 packed grads (all chunks)
+            if getattr(strategy, "pipe_shard", False):
+                # hybrid PP x ZeRO-1 (--dp-shard-update on gpipe): the
+                # per-step gradient pmean decomposes into its RS half —
+                # gradient wire HALVES vs the replicated ring allreduce —
+                # plus the params' just-in-time per-bucket all-gather at
+                # the next forward (f32 master weights). physical_* twins
+                # price the PADDED device-major rows actually shipped.
+                meta = strategy._row_meta
+                C = strategy.num_chunks
+                out["reduce_scatter_bytes"] = (dp - 1) / dp * grad_bytes
+                out["all_gather_bytes"] = (dp - 1) / dp * grad_bytes
+                out["physical_reduce_scatter_bytes"] = (
+                    (dp - 1) / dp * C * meta.padded * 4.0)
+                out["physical_all_gather_bytes"] = (
+                    (dp - 1) / dp * C * meta.padded * 4.0)
+                out["comm_buckets"] = float(meta.num_buckets)
+            else:
+                per_sync = _ring_allreduce_bytes(grad_bytes, dp)
+                syncs = M if name == "PipeDreamStrategy" else 1
+                out["allreduce_bytes"] = per_sync * syncs
     out["total_bytes"] = (out["boundary_bytes"] + out["allreduce_bytes"]
                           + out["reduce_scatter_bytes"]
                           + out["all_gather_bytes"])

@@ -1,0 +1,59 @@
+#!/usr/bin/env bash
+# Round-11 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the hybrid PP x ZeRO-1 + cost-aware-timetable round:
+#
+#   * hybrid on/off A/B on the 2-D pipe mesh (-g 4 = 2 stages x 2 data
+#     replicas; --dp-shard-update shards each stage's packed rows +
+#     optimizer state over the 'data' axis, bucketed RS in the drain +
+#     per-bucket JIT all-gather in the fill) x {fill-drain, 1f1b};
+#   * weighted-vs-unit timetables on a DELIBERATELY uneven auto-partition
+#     (--auto-partition --pipe-costs profile vs unit at the same plan);
+#   * scalebench columns carrying opt_state_bytes_per_chip so the memory
+#     win is countable next to the step-time columns;
+#   * a --schedule-trace advisory rerun feeding the measured bubble of
+#     the 1f1b trace back into the schedule advice (ROADMAP item 2c).
+#
+# Expectations in PERF.md § round 11.
+#
+# Usage: scripts/tpu_round11.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task chaosbench_stability_r8 python -m ddlbench_tpu.tools.chaosbench --kills 1 --preempts 2 -b mnist -m resnet18 -e 3 --steps-per-epoch 30 --batch-size 32 --checkpoint-every-steps 10 --keep-checkpoints 4 --workdir perf_runs/chaosbench_r8_work --keep-workdir --json perf_runs/chaosbench_r8.json -- --anomaly-policy skip --inject nan-grad@2:7
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+
+# -- round-11a: hybrid PP x ZeRO-1 on/off A/B (2 stages x 2 replicas) -------
+# transformer_m/synthtext on the 2-D pipe mesh; the ONLY difference inside
+# each pair is --dp-shard-update (+ buckets). Watch step time (RS+JIT-AG vs
+# pmean) and the checkpointed opt-state size; scalebench columns below
+# carry opt_state_bytes_per_chip explicitly.
+HYB_COMMON="-b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30"
+add_task pipe_rep_filldrain_r11 python -m ddlbench_tpu.cli $HYB_COMMON --pipe-schedule fill-drain --jsonl perf_runs/pipe_rep_filldrain_r11.jsonl --trace perf_runs/trace_rep_filldrain_r11.json
+add_task pipe_hyb_filldrain_r11 python -m ddlbench_tpu.cli $HYB_COMMON --pipe-schedule fill-drain --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_filldrain_r11.jsonl --trace perf_runs/trace_hyb_filldrain_r11.json
+add_task pipe_rep_1f1b_r11      python -m ddlbench_tpu.cli $HYB_COMMON --pipe-schedule 1f1b --jsonl perf_runs/pipe_rep_1f1b_r11.jsonl --trace perf_runs/trace_rep_1f1b_r11.json
+add_task pipe_hyb_1f1b_r11      python -m ddlbench_tpu.cli $HYB_COMMON --pipe-schedule 1f1b --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_1f1b_r11.jsonl --trace perf_runs/trace_hyb_1f1b_r11.json --trace-dir perf_runs/xla_hyb_1f1b_r11 --xla-trace-steps 10:14
+
+# -- round-11b: weighted vs unit timetables on an uneven auto-partition -----
+# resnet152's stages are genuinely uneven under the flops profile; the pair
+# differs ONLY in --pipe-costs. Bubble comparison via the pipe_tick traces:
+#   python -m ddlbench_tpu.telemetry.bubble perf_runs/trace_{unit,weighted}_r11.json
+WEI_COMMON="-b imagenet -m resnet152 -f gpipe -g 4 --stages 4 --micro-batch-size 8 --num-microbatches 16 -e 1 --steps-per-epoch 20 --auto-partition --pipe-schedule 1f1b"
+add_task pipe_unit_r11     python -m ddlbench_tpu.cli $WEI_COMMON --pipe-costs unit    --jsonl perf_runs/pipe_unit_r11.jsonl     --trace perf_runs/trace_unit_r11.json
+add_task pipe_weighted_r11 python -m ddlbench_tpu.cli $WEI_COMMON --pipe-costs profile --jsonl perf_runs/pipe_weighted_r11.jsonl --trace perf_runs/trace_weighted_r11.json
+# measured-bubble feedback into the advisor (ROADMAP 2c): rerun the unit
+# advice with the 1f1b trace supplied; the advisor line should rank 1f1b by
+# its MEASURED fraction
+add_task pipe_advice_r11   python -m ddlbench_tpu.cli $WEI_COMMON --pipe-costs unit --schedule-trace perf_runs/trace_unit_r11.json --steps-per-epoch 2 --jsonl perf_runs/pipe_advice_r11.jsonl
+
+# -- round-11c: scalebench columns (memory win countable in JSON) ----------
+add_task scalebench_hyb_on_r11  python -m ddlbench_tpu.tools.scalebench -b synthtext -m transformer_m --strategies gpipe --devices 4 --dp-replicas 2 --dp-shard-update --comm-buckets 4 --steps 20 --repeats 3
+add_task scalebench_hyb_off_r11 python -m ddlbench_tpu.tools.scalebench -b synthtext -m transformer_m --strategies gpipe --devices 4 --dp-replicas 2 --steps 20 --repeats 3
+
+window_loop "${1:-11}"

@@ -40,6 +40,13 @@ from tests.tiny_models import TINY_LM, tiny_moe, tiny_transformer
 FEATURES = ("prefetch", "device_metrics", "spans", "guard",
             "checkpoint_resume")
 
+# Flag-selected engine variants beyond the strategy registry that earn
+# their own conformance rows: "pipeshard" is the hybrid PP x ZeRO-1
+# pipeline (--dp-shard-update on gpipe, ISSUE 8) — sharded stage rows +
+# optimizer state on the pipe mesh's 'data' axis through the event-mode
+# schedule runtime.
+EXTRA_ENGINES = ("pipeshard",)
+
 # engine x feature cells expected to fail, with the reason the matrix
 # exists to surface. Keys are (engine, feature); values are the named gap.
 XFAIL = {
@@ -98,6 +105,19 @@ def _build(engine: str, **cfg_kw):
                         num_microbatches=2, **base)
         strat = cls(_dense_model(), cfg, stage_bounds=[0, 2, 4])
         return strat, _image_batch(8), jnp.float32(0.1)
+    if engine == "pipeshard":
+        # hybrid PP x ZeRO-1: event-mode 1f1b on the 2-D pipe mesh with
+        # --dp-shard-update + 2 comm buckets (sharded rows, JIT AG, RS)
+        from ddlbench_tpu.parallel.pipeline_rt import (
+            ScheduledPipelineStrategy)
+
+        cfg = RunConfig(strategy="gpipe", benchmark="mnist", num_devices=4,
+                        num_stages=2, dp_replicas=2, micro_batch_size=4,
+                        num_microbatches=2, pipe_schedule="1f1b",
+                        dp_shard_update=True, comm_buckets=2, **base)
+        strat = ScheduledPipelineStrategy(_dense_model(), cfg,
+                                          stage_bounds=[0, 2, 4])
+        return strat, _image_batch(cfg.global_batch()), jnp.float32(0.1)
     if engine == "sp":
         from ddlbench_tpu.parallel.sp import SPStrategy
 
@@ -148,7 +168,7 @@ def _apply_xfail(engine, feature):
         pytest.xfail(reason)
 
 
-@pytest.fixture(params=STRATEGIES)
+@pytest.fixture(params=STRATEGIES + EXTRA_ENGINES)
 def engine(request):
     return request.param
 
@@ -158,9 +178,10 @@ def test_registry_is_covered():
     up here as missing cells, not as silence."""
     assert set(STRATEGIES) == {"single", "dp", "gpipe", "pipedream", "sp",
                                "tp", "fsdp", "ep"}
+    assert set(EXTRA_ENGINES) == {"pipeshard"}
     # every xfail names a registry engine and a real feature
     for (s, f) in XFAIL:
-        assert s in STRATEGIES and f in FEATURES
+        assert s in STRATEGIES + EXTRA_ENGINES and f in FEATURES
 
 
 def test_prefetch_cell(devices, engine):

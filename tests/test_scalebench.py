@@ -32,3 +32,37 @@ def test_scalebench_emits_curve(devices, capsys):
         assert d["samples_per_sec"] > 0
         assert d["per_chip"] == pytest.approx(
             d["samples_per_sec"] / d["devices"], rel=1e-3)
+        # every point carries the resident optimizer bytes of one chip —
+        # the ZeRO-on-pipe memory win is countable in the JSON (ISSUE 8)
+        assert d["opt_state_bytes_per_chip"] > 0
+    gpipe = [d for d in lines if d["strategy"] == "gpipe"]
+    assert all(d["dp_shard_update"] is False for d in gpipe)
+
+
+def test_scalebench_hybrid_point_shards_opt_state(devices, capsys):
+    """--dp-replicas 2 --dp-shard-update gpipe point: the hybrid
+    PP x ZeRO-1 engine's opt_state_bytes_per_chip is strictly below the
+    replicated point's at the same shape."""
+    from ddlbench_tpu.tools.scalebench import main
+
+    def run(extra):
+        rc = main(["-b", "mnist", "-m", "lenet", "--devices", "4",
+                   "--strategies", "gpipe", "--steps", "2", "--warmup", "1",
+                   "--dtype", "float32", "--batch-size", "4",
+                   "--dp-replicas", "2", "--platform", "cpu"] + extra)
+        assert rc == 0
+        docs = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+                if l.startswith("{")]
+        (pt,) = [d for d in docs if d.get("strategy") == "gpipe"]
+        assert "error" not in pt, pt
+        return pt
+
+    rep = run([])
+    hyb = run(["--dp-shard-update", "--comm-buckets", "2"])
+    assert rep["dp_shard_update"] is False
+    assert hyb["dp_shard_update"] is True and hyb["comm_buckets"] == 2
+    assert rep["dp_replicas"] == hyb["dp_replicas"] == 2
+    # m (sgd momentum) shards /dp; padding keeps it within a few %
+    assert hyb["opt_state_bytes_per_chip"] < rep["opt_state_bytes_per_chip"]
+    assert hyb["opt_state_bytes_per_chip"] == pytest.approx(
+        rep["opt_state_bytes_per_chip"] / 2, rel=0.05)

@@ -1,0 +1,324 @@
+"""Cost-aware timetables (ISSUE 8 tentpole, partition/schedule.py).
+
+Generators accept per-chunk (f, b, w) half-tick cost vectors; weighted
+grids validate, the engine executes them unchanged, and the advisor ranks
+by weighted (and measured) bubbles. Acceptance pinned here:
+
+* unit-cost vectors reproduce the PR 7 timetables BITWISE;
+* an uneven-cost fixture yields a strictly lower weighted analytic bubble
+  than the unit-cost table's event order repriced under the same costs,
+  for 1f1b;
+* measured-vs-analytic stays within the existing 10% pin on weighted
+  tables too.
+
+Tier-1-fast (host-side table math + tiny CPU-mesh runs): ``pipesched``
+marker like the rest of the schedule-runtime suite.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.pipesched
+
+from ddlbench_tpu.config import RunConfig
+from ddlbench_tpu.partition.schedule import (
+    EVENT_BWD_IN, EVENT_BWD_W, EVENT_FWD, PIPE_SCHEDULES, make_timetable,
+    normalize_costs, quantize_cost_vectors, recommend_schedule,
+    reprice_timetable, schedule_bubble_fraction)
+
+# the acceptance fixture: genuinely uneven chunks where cost-aware packing
+# strictly beats executing the unit-cost event order (found by sweep)
+UNEVEN = dict(S=3, M=6, costs=((1, 2, 1), (2, 3, 1), (2, 3, 1)))
+
+
+def _uniform(C, k=1):
+    return ((k,) * C,) * 3
+
+
+# -- unit-cost reproduction ------------------------------------------------
+
+
+@pytest.mark.parametrize("name,V", [("fill-drain", 1), ("1f1b", 1),
+                                    ("zero-bubble", 1), ("interleaved", 2)])
+def test_unit_cost_vectors_reproduce_tables_bitwise(name, V):
+    S, M = 2, 4
+    base = make_timetable(name, S, M, V)
+    unit = make_timetable(name, S, M, V, costs=_uniform(S * V))
+    assert unit.costs is None  # all-unit normalizes to the unit model
+    np.testing.assert_array_equal(base.events, unit.events)
+    np.testing.assert_array_equal(base.mbs, unit.mbs)
+    np.testing.assert_array_equal(base.chunks, unit.chunks)
+
+
+@pytest.mark.parametrize("S,M,V", [(2, 4, 1), (3, 6, 1), (2, 4, 2),
+                                   (4, 8, 1)])
+def test_weighted_fill_drain_recurrence_scales_unit_schedule(S, M, V):
+    """The weighted fill-drain recurrence at UNIFORM cost k is the closed
+    form with every start scaled by k — the recurrence really is the
+    closed-form structure, generalized."""
+    k = 3
+    u = make_timetable("fill-drain", S, M, V)
+    w = make_timetable("fill-drain", S, M, V, costs=_uniform(S * V, k))
+    for kind in (EVENT_FWD, EVENT_BWD_IN):
+        ut, wt = u.event_times(kind), w.event_times(kind)
+        assert {key: k * h for key, h in ut.items()} == wt
+    for key, h in w.event_times(EVENT_BWD_W).items():
+        assert h == w.event_times(EVENT_BWD_IN)[key] + k  # W glued to B
+
+
+# -- weighted generation + validate ----------------------------------------
+
+
+def test_randomized_validate_sweep():
+    """Randomized (S, M, V, cost-vector) grid: every generated weighted
+    table is dependency-correct (Timetable.validate) with busy cells
+    exactly covering the summed event costs."""
+    rng = np.random.default_rng(0xC057)
+    trials = 0
+    for _ in range(40):
+        S = int(rng.integers(2, 5))
+        V = int(rng.choice([1, 2]))
+        M = int(S * rng.integers(1, 4)) if V > 1 else int(rng.integers(2, 9))
+        C = S * V
+        costs = tuple(tuple(int(v) for v in rng.integers(1, 5, C))
+                      for _ in range(3))
+        for name in PIPE_SCHEDULES:
+            if name in ("1f1b", "zero-bubble") and V != 1:
+                continue
+            tt = make_timetable(name, S, M, V, costs=costs)
+            tt.validate()  # also checks the busy-cell/cost invariant
+            assert tt.max_inflight() >= 1
+            trials += 1
+    assert trials >= 100
+
+
+def test_weighted_engine_arrays_compress_to_event_count():
+    """The execution grid carries one START cell per event (idle duration
+    cells compressed out), with every (chunk, mb) F/B/W exactly once."""
+    S, M = UNEVEN["S"], UNEVEN["M"]
+    tt = make_timetable("1f1b", S, M, 1, costs=UNEVEN["costs"])
+    ea = tt.engine_arrays()
+    assert ea["ev"].shape[0] < tt.half_ticks  # genuinely compressed
+    assert int((ea["ev"] != 0).sum()) == 3 * S * M
+    assert int(ea["fa_valid"].sum()) == (S - 1) * M  # interior handoffs
+    assert int(ea["ba_valid"].sum()) == (S - 1) * M
+
+
+# -- acceptance: uneven costs beat the unit-order table --------------------
+
+
+def test_uneven_costs_beat_repriced_unit_1f1b():
+    S, M, costs = UNEVEN["S"], UNEVEN["M"], UNEVEN["costs"]
+    aware = make_timetable("1f1b", S, M, 1, costs=costs)
+    repriced = reprice_timetable(make_timetable("1f1b", S, M, 1), costs)
+    assert aware.bubble_fraction() < repriced.bubble_fraction()
+    assert schedule_bubble_fraction("1f1b", S, M, 1, costs) == \
+        pytest.approx(aware.bubble_fraction())
+
+
+def test_cost_aware_never_loses_to_unit_order():
+    """The cost-aware GREEDY is a heuristic (its B>W>F priority can
+    commit early where the unit order happens to interleave better), so
+    make_timetable takes the min over {greedy, repriced-unit-order} —
+    the weighted table it returns never packs worse than executing the
+    classic schedule on the same uneven chunks."""
+    rng = np.random.default_rng(7)
+    for _ in range(20):
+        S = int(rng.integers(2, 5))
+        M = int(rng.integers(3, 9))
+        costs = tuple(tuple(int(v) for v in rng.integers(1, 4, S))
+                      for _ in range(3))
+        tt = make_timetable("1f1b", S, M, 1, costs=costs)
+        tt.validate()
+        rep = reprice_timetable(make_timetable("1f1b", S, M, 1), costs)
+        assert tt.bubble_fraction() <= rep.bubble_fraction() + 1e-12
+
+
+# -- measured vs analytic (10% pin, weighted) ------------------------------
+
+
+@pytest.mark.parametrize("schedule", ["1f1b", "zero-bubble"])
+def test_weighted_bubble_reducer_matches_analytic(schedule):
+    from ddlbench_tpu.telemetry import Tracer
+    from ddlbench_tpu.telemetry.bubble import bubble_fraction, emit_tick_spans
+    from ddlbench_tpu.telemetry.export import chrome_trace_dict
+
+    S, M = 4, 8
+    costs = ((2, 1, 3, 1), (2, 1, 3, 1), (1, 1, 2, 1))
+    tt = make_timetable(schedule, S, M, 1, costs=costs)
+    tracer = Tracer(100_000).enable()
+    n = emit_tick_spans(tracer, tt, 1_000_000, 5_000_000, step=3)
+    assert n == 3 * S * M  # ONE span per event, covering its whole cost
+    got = bubble_fraction(chrome_trace_dict(tracer))
+    analytic = tt.bubble_fraction()
+    assert abs(got["bubble_fraction"] - analytic) <= 0.1 * analytic
+
+
+# -- engine executes weighted tables unchanged -----------------------------
+
+
+def test_weighted_table_trajectory_pinned(devices):
+    """A cost-weighted 1f1b table through the event runtime is the same
+    synchronous computation: trajectory-pinned against fill-drain."""
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+    from ddlbench_tpu.parallel.pipeline_rt import ScheduledPipelineStrategy
+
+    def tiny():
+        layers = [flatten(), dense("fc1", 24, relu=True),
+                  dense("fc2", 24, relu=True), dense("fc3", 24, relu=True),
+                  dense("fc4", 10)]
+        return LayerModel("tiny", layers, (8, 8, 1), 10)
+
+    def run(schedule, costs=None):
+        cfg = RunConfig(strategy="gpipe", num_devices=2, num_stages=2,
+                        micro_batch_size=4, num_microbatches=4,
+                        pipe_schedule=schedule, pipe_cost_vectors=costs,
+                        compute_dtype="float32", momentum=0.0,
+                        weight_decay=0.0)
+        cls = (GPipeStrategy if schedule == "fill-drain"
+               else ScheduledPipelineStrategy)
+        strat = cls(tiny(), cfg, stage_bounds=[0, 3, 5])
+        ts = strat.init(jax.random.key(0))
+        losses = []
+        for step in range(3):
+            B = cfg.global_batch()
+            x = jax.random.normal(jax.random.key(10 + step), (B, 8, 8, 1))
+            y = jax.random.randint(jax.random.key(50 + step), (B,), 0, 10)
+            ts, m = strat.train_step(ts, *strat.shard_batch(x, y),
+                                     jnp.float32(0.1))
+            losses.append(float(m["loss"]))
+        return np.asarray(losses), strat
+
+    lo_ref, _ = run("fill-drain")
+    lo_w, strat = run("1f1b", costs=((2, 1), (2, 1), (1, 1)))
+    assert strat.timetable.costs is not None  # genuinely weighted
+    # this weighted table still glues W behind B (W = B + b_cost), so the
+    # engine must keep the ONE-vjp fused backward — the cost model must
+    # not silently force the split-recompute tax on weighted runs
+    assert strat._fused_bw
+    np.testing.assert_allclose(lo_w, lo_ref, rtol=1e-6, atol=1e-7)
+
+
+# -- quantization + advice -------------------------------------------------
+
+
+def test_quantize_cost_vectors():
+    f, b, w = quantize_cost_vectors([1.0, 0.5], [2.0, 1.0])
+    assert f == (2, 1) and b == (2, 1) and w == (2, 1)  # b split in half
+    # cheapest event -> 1 unit; cap respected
+    f, b, w = quantize_cost_vectors([0.1, 100.0], [0.2, 200.0],
+                                    max_units=4)
+    assert f == (1, 4) and b == (1, 4)
+    # uniform chunks collapse to the unit model end to end
+    uni = quantize_cost_vectors([3.0, 3.0], [6.0, 6.0])
+    assert normalize_costs(uni, 2) is None
+
+
+def test_chunk_cost_ms_sums_graph_spans():
+    from ddlbench_tpu.graph.graph import Graph, Node
+    from ddlbench_tpu.profiler.profile import chunk_cost_ms
+
+    nodes = [Node(str(i), node_desc=f"l{i}", forward_compute_time=1.0 + i,
+                  backward_compute_time=2.0 * (1.0 + i))
+             for i in range(4)]
+    g = Graph.chain(nodes)
+    f_ms, b_ms = chunk_cost_ms(g, [0, 1, 4])
+    assert f_ms == [1.0, 2.0 + 3.0 + 4.0]
+    assert b_ms == [2.0, 2.0 * (2.0 + 3.0 + 4.0)]
+
+
+def test_recommend_schedule_weighted_and_measured():
+    costs = ((2, 1, 1, 1), (1, 1, 1, 1), (1, 1, 1, 1))
+    rows = recommend_schedule(4, 8, 1, costs=costs)
+    # weighted bubbles (table-derived), still ranked ascending
+    assert [r["bubble"] for r in rows] == sorted(r["bubble"] for r in rows)
+    assert rows[0]["bubble"] == pytest.approx(
+        schedule_bubble_fraction(rows[0]["schedule"], 4, 8, 1, costs))
+    # a measured figure outranks the analytic one for its schedule
+    analytic = recommend_schedule(4, 8, 1)
+    best = analytic[0]["schedule"]
+    other = analytic[-1]["schedule"]
+    rows = recommend_schedule(4, 8, 1, measured={other: 0.0})
+    assert rows[0]["schedule"] == other
+    assert rows[0]["bubble_measured"] == 0.0
+    assert rows[0]["bubble"] > 0  # analytic kept alongside
+    assert best in [r["schedule"] for r in rows[1:]]
+
+
+def test_measured_bubbles_from_trace(tmp_path):
+    """_measured_bubbles reduces a --trace JSON (pipe_tick projections)
+    back to {schedule: fraction} for the advisor."""
+    from ddlbench_tpu.parallel.api import _measured_bubbles
+    from ddlbench_tpu.telemetry import Tracer
+    from ddlbench_tpu.telemetry.bubble import emit_tick_spans
+    from ddlbench_tpu.telemetry.export import export_chrome_trace
+
+    tt = make_timetable("zero-bubble", 3, 6)
+    tracer = Tracer(50_000).enable()
+    emit_tick_spans(tracer, tt, 0, 900_000, step=4)
+    path = tmp_path / "trace.json"
+    export_chrome_trace(tracer, str(path))
+    cfg = RunConfig(strategy="gpipe", num_devices=3, num_stages=3,
+                    schedule_trace=str(path))
+    got = _measured_bubbles(cfg)
+    assert set(got) == {"zero-bubble"}
+    assert got["zero-bubble"] == pytest.approx(tt.bubble_fraction(),
+                                               abs=0.1 * tt.bubble_fraction())
+    # unreadable / span-free traces degrade to analytic-only (None)
+    bad = tmp_path / "missing.json"
+    assert _measured_bubbles(cfg.replace(schedule_trace=str(bad))) is None
+
+
+# -- config surface --------------------------------------------------------
+
+
+def test_pipe_costs_validation():
+    base = dict(strategy="gpipe", num_devices=2, num_stages=2,
+                micro_batch_size=4, num_microbatches=4)
+    with pytest.raises(ValueError, match="unknown pipe_costs"):
+        RunConfig(pipe_costs="magic", **base).validate()
+    with pytest.raises(ValueError, match="auto-partition"):
+        RunConfig(pipe_costs="profile", pipe_schedule="1f1b",
+                  **base).validate()
+    with pytest.raises(ValueError, match="event schedule"):
+        RunConfig(pipe_costs="profile", auto_partition=True,
+                  **base).validate()
+    with pytest.raises(ValueError, match="1f1b"):
+        RunConfig(pipe_cost_vectors=((1, 2), (1, 1), (1, 1)),
+                  **base).validate()
+    with pytest.raises(ValueError, match="length"):
+        RunConfig(pipe_schedule="1f1b",
+                  pipe_cost_vectors=((1,), (1,), (1,)), **base).validate()
+    with pytest.raises(ValueError, match=">= 1"):
+        RunConfig(pipe_schedule="1f1b",
+                  pipe_cost_vectors=((0, 1), (1, 1), (1, 1)),
+                  **base).validate()
+    RunConfig(pipe_schedule="1f1b",
+              pipe_cost_vectors=((2, 1), (1, 1), (1, 1)), **base).validate()
+    # --schedule-trace without the advisor it feeds is an error, not a
+    # silent no-op
+    with pytest.raises(ValueError, match="schedule_trace"):
+        RunConfig(schedule_trace="t.json", **base).validate()
+    with pytest.raises(ValueError, match="schedule_trace"):
+        RunConfig(schedule_trace="t.json", auto_partition=True,
+                  **{**base, "strategy": "pipedream"}).validate()
+    RunConfig(schedule_trace="t.json", auto_partition=True,
+              **base).validate()
+
+
+def test_plan_key_carries_schedule_and_cost_provenance():
+    """A plan solved under one schedule/cost model must never be reused
+    by another: both fields live in the persisted plan's key."""
+    from ddlbench_tpu.parallel.api import _plan_key
+
+    base = dict(strategy="gpipe", num_devices=2, num_stages=2,
+                micro_batch_size=4, num_microbatches=4, auto_partition=True)
+    k1 = _plan_key(RunConfig(**base))
+    k2 = _plan_key(RunConfig(pipe_schedule="1f1b", **base))
+    k3 = _plan_key(RunConfig(pipe_schedule="1f1b", pipe_costs="profile",
+                             **base))
+    assert k1["pipe_schedule"] == "fill-drain" and k1["pipe_costs"] == "unit"
+    assert k1 != k2 != k3 and k1 != k3
