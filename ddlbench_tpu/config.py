@@ -912,3 +912,72 @@ class RunConfig:
 
     def replace(self, **kw: Any) -> "RunConfig":
         return dataclasses.replace(self, **kw)
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Shape/policy configuration of the continuous-batching serving engine
+    (serve/engine.py). Frozen + validated like :class:`RunConfig` — one
+    config surface, constructible from servebench flags or a dict.
+
+    The static-shape contract: every decode step is a [max_batch, 1] model
+    call and every prefill chunk a [1, prefill_chunk] call, so the jit
+    cache holds at most ``max_len / page`` variants of each (one per live
+    page count) regardless of traffic.
+    """
+
+    max_batch: int = 8  # engine rows = concurrent requests per replica
+    pool_pages: int = 64  # shared KV pool slots (slot 0 = scratch)
+    page: int = 16  # positions per page (ops/paged_decode.py PAGE analog)
+    max_len: int = 256  # per-request stream capacity (prompt + output)
+    # tokens a step may process: active decode rows count 1 each, the
+    # remainder is packed with prefill chunks. 0 = max_batch + 2 chunks.
+    token_budget: int = 0
+    # tokens per prefill call (page multiple); 0 = whole prompt in ONE
+    # padded call ("unchunked admission" — one compile, more padding)
+    prefill_chunk: int = 16
+    policy: str = "continuous"  # "continuous" | "static" (the A/B baseline)
+    replicas: int = 1  # data-parallel serving replicas (mesh 'data' axis)
+
+    def npg_max(self) -> int:
+        return -(-self.max_len // self.page)
+
+    def resolved_token_budget(self) -> int:
+        if self.token_budget:
+            return self.token_budget
+        return self.max_batch + 2 * self.resolved_prefill_chunk()
+
+    def resolved_prefill_chunk(self) -> int:
+        if self.prefill_chunk:
+            return self.prefill_chunk
+        return self.npg_max() * self.page  # whole-stream padded chunk
+
+    def validate(self) -> None:
+        if self.policy not in ("continuous", "static"):
+            raise ValueError(
+                f"policy must be continuous|static, got {self.policy!r}")
+        if min(self.max_batch, self.page, self.max_len, self.replicas) < 1:
+            raise ValueError(
+                "max_batch, page, max_len, and replicas must be positive")
+        if self.prefill_chunk < 0 or self.token_budget < 0:
+            # 0 means "resolve a default" for both; negatives would pass
+            # the modulo/starvation checks and crash the engine mid-run
+            raise ValueError(
+                "prefill_chunk and token_budget must be >= 0")
+        if self.prefill_chunk and self.prefill_chunk % self.page:
+            raise ValueError(
+                f"prefill_chunk {self.prefill_chunk} must be a multiple of "
+                f"the page size {self.page} (chunks are page-aligned)")
+        if self.pool_pages < self.npg_max() + 1:
+            raise ValueError(
+                f"pool_pages {self.pool_pages} cannot hold one max-length "
+                f"request ({self.npg_max()} pages) plus the scratch slot — "
+                "a request that can never fit would evict itself forever")
+        if self.resolved_token_budget() < self.resolved_prefill_chunk():
+            raise ValueError(
+                "token_budget below one prefill chunk starves admission "
+                f"({self.resolved_token_budget()} < "
+                f"{self.resolved_prefill_chunk()})")
+
+    def replace(self, **kw: Any) -> "ServeConfig":
+        return dataclasses.replace(self, **kw)

@@ -183,6 +183,11 @@ class Layer:
     # (init_cache) may also provide a PagedOps; cache-free decode layers
     # participate through their ordinary ``decode``.
     paged: Any = None
+    # Optional continuous-batching serving protocol (serve/engine.py): a
+    # ServeOps whose ops take per-ROW stream positions and go through a
+    # shared free-list page pool. Pointwise layers participate through
+    # ``apply``; everything else needs a ServeOps to be servable.
+    serve: Any = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -198,6 +203,32 @@ class PagedOps:
     prefill: Callable  # (params, state, cache, x, start) -> (y, cache)
     decode: Callable  # (params, state, cache, x, pos) -> (y, cache)
     reorder: Callable  # (cache, parent, pos) -> cache
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeOps:
+    """Continuous-batching serving protocol (serve/engine.py).
+
+    Unlike :class:`PagedOps` — whose rows march in lockstep through one
+    shared position — serving rows are independent requests at per-row
+    stream positions, borrowing K/V slots from a SHARED free-list pool
+    (ops/paged_decode.py serve primitives). The engine owns ONE page table
+    ([max_batch, n_pages] int32, slot 0 = scratch) shared by every layer:
+    slot allocation is per-request across all layers at once, vLLM-style,
+    so each layer indexes its own pool with the same table.
+
+    * ``pool_init(params, n_pages, page, dtype) -> pool`` — the layer's
+      slice of the shared pool ({} / None for cache-free layers).
+    * ``prefill(p, s, pool, table, x, start, npl, page) -> (y, pool)`` —
+      one page-aligned prompt chunk x [R, C] at positions
+      [start, start + C) (``start`` dynamic, ``npl``/``page``/C static).
+    * ``decode(p, s, pool, table, x, pos, npl, page) -> (y, pool)`` —
+      one token per row, x [B, 1] at per-row positions ``pos`` [B].
+    """
+
+    pool_init: Any  # None for cache-free layers (e.g. the embedding)
+    prefill: Callable
+    decode: Callable
 
 
 @dataclasses.dataclass(frozen=True)

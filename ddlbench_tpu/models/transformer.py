@@ -161,7 +161,22 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
         pe = lax.dynamic_slice_in_dim(p["pos"], pos, 1, axis=0)
         return jnp.take(p["tok"], x, axis=0) + pe, cache
 
-    return Layer(name, init, apply, decode=decode)
+    def serve_prefill(p, s, pool, table, x, start, npl, page):
+        # x: [R, C] chunk at positions [start, start + C); padded positions
+        # past the position table are clipped (their outputs are discarded)
+        C = x.shape[1]
+        pe = jnp.take(p["pos"], start + jnp.arange(C), axis=0)
+        return jnp.take(p["tok"], x, axis=0) + pe, pool
+
+    def serve_decode(p, s, pool, table, x, pos, npl, page):
+        # x: [B, 1] at PER-ROW positions pos [B] (each row its own request)
+        pe = jnp.take(p["pos"], pos, axis=0)[:, None]
+        return jnp.take(p["tok"], x, axis=0) + pe, pool
+
+    from ddlbench_tpu.models.layers import ServeOps
+
+    return Layer(name, init, apply, decode=decode,
+                 serve=ServeOps(None, serve_prefill, serve_decode))
 
 
 # Attention backend: "auto" uses the Pallas flash kernel on TPU and the jnp
@@ -500,13 +515,29 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
         x, cache = attn_paged_decode_op(p, x, cache, n_heads, pos)
         return mlp(p, x), cache
 
-    from ddlbench_tpu.models.layers import PagedOps
+    def serve_prefill(p, s, pool, table, x, start, npl, page):
+        x, pool = attn_serve_prefill_op(p, x, pool, table, n_heads, start,
+                                        npl, page)
+        return mlp(p, x), pool
 
+    def serve_decode(p, s, pool, table, x, pos, npl, page):
+        x, pool = attn_serve_decode_op(p, x, pool, table, n_heads, pos,
+                                       npl, page)
+        return mlp(p, x), pool
+
+    from ddlbench_tpu.models.layers import PagedOps, ServeOps
+
+    # serving is causal-LM only: the prefix-LM mask (seq2seq) would need the
+    # per-request source length threaded through every chunk's mask
+    serve = (None if prefix_len else
+             ServeOps(attn_serve_pool_init(n_heads, dh),
+                      serve_prefill, serve_decode))
     return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
                  prefill=prefill, decode=decode,
                  paged=PagedOps(attn_paged_cache_init(n_heads, dh),
                                 paged_prefill, paged_decode,
-                                attn_paged_reorder))
+                                attn_paged_reorder),
+                 serve=serve)
 
 
 # ---------------------------------------------------------------------------
@@ -600,6 +631,55 @@ def attn_paged_reorder(cache, parent, pos):
     from ddlbench_tpu.ops.paged_decode import paged_reorder
 
     return paged_reorder(cache, parent, pos)
+
+
+def attn_serve_pool_init(n_heads: int, dh: int):
+    def pool_init(p, n_pages, page, dtype):
+        from ddlbench_tpu.ops.paged_decode import serve_pool_init
+
+        return serve_pool_init(n_pages, page, n_heads, dh, dtype)
+
+    return pool_init
+
+
+def attn_serve_prefill_op(p, x, pool, table, n_heads: int, start, npl: int,
+                          page: int):
+    """Chunked-prefill attention sublayer for the serving engine: write the
+    page-aligned chunk's K/V through the shared table, then attend the
+    chunk queries against the live pages (which the table already exposes
+    for positions < start). ``start`` is dynamic — the same compiled chunk
+    serves every request at the same page depth."""
+    from ddlbench_tpu.ops.paged_decode import (paged_chunk_attention,
+                                               paged_table_chunk_write)
+
+    B, C, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)  # [B, H, C, dh]
+    cache = {**pool, "table": table}
+    cache = paged_table_chunk_write(cache, k.transpose(0, 2, 1, 3),
+                                    v.transpose(0, 2, 1, 3), start, page)
+    o = paged_chunk_attention(q, cache, start, npl, page)  # [B, H, C, dh]
+    x = x + o.transpose(0, 2, 1, 3).reshape(B, C, d) @ p["wo"].astype(x.dtype)
+    return x, {"pool_k": cache["pool_k"], "pool_v": cache["pool_v"]}
+
+
+def attn_serve_decode_op(p, x, pool, table, n_heads: int, pos, npl: int,
+                         page: int):
+    """attn_paged_decode_op for the serving engine: per-ROW positions and
+    table-indirected writes into the shared pool (rows borrow free-list
+    slots instead of owning a stripe). Inactive rows are routed to the
+    scratch slot by the table the engine passes in."""
+    from ddlbench_tpu.ops.paged_decode import (paged_attention,
+                                               paged_table_write)
+
+    B, _, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)  # [B, H, 1, dh]
+    cache = {**pool, "table": table}
+    cache = paged_table_write(cache, k.transpose(0, 2, 1, 3),
+                              v.transpose(0, 2, 1, 3), pos, page)
+    o = paged_attention(q[:, :, 0].astype(x.dtype), cache, pos, npl,
+                        page)  # [B, H, dh]
+    x = x + o.reshape(B, 1, d) @ p["wo"].astype(x.dtype)
+    return x, {"pool_k": cache["pool_k"], "pool_v": cache["pool_v"]}
 
 
 def attn_decode_op(p, x, cache, n_heads: int, pos):

@@ -24,11 +24,16 @@ The paged design eliminates that:
   grid. The jnp reference path (``_paged_attention_ref``) materializes the
   gathered pages and is used on CPU and as the numerics oracle.
 
-vLLM's PagedAttention introduced page tables for serving (PAPERS.md);
-here the copy-on-write table doubles as the beam-search ancestry structure,
-which is what removes the reference-style cache reshuffle
-(GNMT reorders its recurrent decoder state per expansion — SURVEY.md §2
-C13; the transformer analog is the cache gather this module deletes).
+The lineage is vLLM's PagedAttention (Kwon et al., SOSP'23 — the serving
+engine that introduced page tables for KV caches; not among the training
+papers in PAPERS.md): here the copy-on-write table doubles as the
+beam-search ancestry structure, which is what removes the reference-style
+cache reshuffle (GNMT reorders its recurrent decoder state per expansion —
+SURVEY.md §2 C13; the transformer analog is the cache gather this module
+deletes). The serving half of that lineage — a SHARED pool whose slots are
+free-list-allocated per request instead of statically owned per row — is
+the ``serve_*``/``paged_table_*`` primitives below, driven by the
+continuous-batching engine in ``serve/engine.py``.
 
 The page count walked per step must be static under jit: callers run the
 decode loop in SEGMENTS of one page (models/decode.py paged loops), so each
@@ -223,7 +228,13 @@ def paged_reorder(cache, parent, pos, page: int | None = None):
 
 def _paged_attention_ref(q, cache, pos, npages_live: int,
                          page: int | None = None):
-    """jnp oracle: gather the live pages, mask, softmax. [rows, H, dh]."""
+    """jnp oracle: gather the live pages, mask, softmax. [rows, H, dh].
+
+    ``pos`` is a scalar (every row at the same position — the beam/greedy
+    decode loops) or a per-row [rows] vector (the continuous-batching
+    serving engine, where every row is a different request at its own
+    stream position).
+    """
     page = page or PAGE
     rows, H, dh = q.shape
     tbl = cache["table"][:, :npages_live]  # [rows, np]
@@ -234,7 +245,9 @@ def _paged_attention_ref(q, cache, pos, npages_live: int,
     vc = vc.reshape(rows, L, H, dh).astype(q.dtype)
     scores = jnp.einsum("rhd,rkhd->rhk", q, kc) / math.sqrt(dh)
     k_pos = jnp.arange(L)[None, None, :]
-    scores = jnp.where(k_pos <= pos, scores, -jnp.inf)
+    pos = jnp.asarray(pos)
+    posb = pos[:, None, None] if pos.ndim == 1 else pos
+    scores = jnp.where(k_pos <= posb, scores, -jnp.inf)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
     return jnp.einsum("rhk,rkhd->rhd", probs, vc)
 
@@ -302,7 +315,10 @@ def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32)  # [H, dh]
     k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
     v = pv_ref[0].astype(jnp.float32)
-    s = _attn_page_math(q, k, v, j * page, t_ref[0], scale, elementwise)
+    # t is per-row: the decode loops broadcast one scalar position to every
+    # row; the serving engine hands each row its own stream position.
+    s = _attn_page_math(q, k, v, j * page, t_ref[pl.program_id(0)], scale,
+                        elementwise)
 
     m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
     m_new = jnp.maximum(m_prev, jnp.max(s, axis=1, keepdims=True))
@@ -325,11 +341,12 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
     """Single-query attention of q [rows, H, dh] against the live pages.
 
     ``npages_live`` must be static (callers segment the decode loop by
-    page); ``pos`` is the dynamic query position (mask: key pos <= pos).
-    ``use_kernel=None`` picks the Pallas kernel on TPU, the jnp reference
-    elsewhere. ``kernel_style`` ("dots" | "elementwise") overrides the
-    module default set by ``set_paged_kernel_style``; both are resolved at
-    trace time.
+    page); ``pos`` is the dynamic query position (mask: key pos <= pos),
+    either a scalar (all rows at one position) or a per-row [rows] vector
+    (continuous-batching serving). ``use_kernel=None`` picks the Pallas
+    kernel on TPU, the jnp reference elsewhere. ``kernel_style`` ("dots" |
+    "elementwise") overrides the module default set by
+    ``set_paged_kernel_style``; both are resolved at trace time.
     """
     from ddlbench_tpu.distributed import is_tpu_backend
 
@@ -345,7 +362,7 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
     rows, H, dh = q.shape
     scale = 1.0 / math.sqrt(dh)
     tbl = cache["table"][:, :npages_live]
-    t32 = jnp.asarray(pos, jnp.int32).reshape(1)
+    t32 = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (rows,))
 
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # table, t
@@ -374,3 +391,102 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
         interpret=interpret,
     )(tbl, t32, q[:, None], cache["pool_k"], cache["pool_v"])
     return out[:, 0]
+
+
+# ---------------------------------------------------------------------------
+# Shared-pool (serving) primitives. The beam structures above give every row
+# a statically OWNED stripe of the pool; a serving engine instead allocates
+# pool slots per request from a free list (serve/allocator.py), so rows
+# borrow arbitrary slots and every access goes THROUGH the table. The cache
+# dict shape is the same ({pool_k, pool_v, table}) — only the pool's leading
+# dim is the total page budget rather than rows * n_pages — so
+# ``paged_attention`` (and its Pallas kernel) reads a serving cache
+# unchanged. Pool slot 0 is reserved as the SCRATCH page by convention:
+# inactive rows' table entries point at it, so their masked writes land
+# somewhere harmless instead of clobbering a live request's history.
+# ---------------------------------------------------------------------------
+
+SCRATCH_SLOT = 0
+
+
+def serve_pool_init(n_pages: int, page: int, n_heads: int, dh: int, dtype):
+    """A shared K/V pool of ``n_pages`` free-list-managed slots (slot 0 is
+    the scratch page — serve/allocator.py never hands it out)."""
+    shape = (n_pages, page, n_heads, dh)
+    return {"pool_k": jnp.zeros(shape, dtype), "pool_v": jnp.zeros(shape, dtype)}
+
+
+def paged_table_write(cache, k1, v1, pos, page: int | None = None):
+    """Write one token's K/V [rows, 1, H, dh] at per-row positions ``pos``
+    ([rows] int32, or a scalar) through the TABLE: row r's token lands in
+    pool slot ``table[r, pos_r // page]`` at offset ``pos_r % page``.
+    Rows whose table row points at the scratch slot write garbage there
+    harmlessly (the serving engine masks inactive rows this way)."""
+    page = page or PAGE
+    rows = cache["table"].shape[0]
+    pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (rows,))
+    slots = jnp.take_along_axis(
+        cache["table"], (pos // page)[:, None], axis=1)[:, 0]
+    off = pos % page
+
+    def write(pool, x):
+        return pool.at[slots, off].set(x[:, 0].astype(pool.dtype))
+
+    return {**cache, "pool_k": write(cache["pool_k"], k1),
+            "pool_v": write(cache["pool_v"], v1)}
+
+
+def paged_table_chunk_write(cache, k, v, start, page: int | None = None):
+    """Write a prefill chunk's K/V [rows, C, H, dh] at positions
+    [start, start + C) through the table. ``start`` may be a traced scalar
+    but MUST be page-aligned and C a page multiple (the serving engine
+    prefills in page-aligned chunks, padding the last one — padded
+    positions are either overwritten by decode before any query can attend
+    them, or land on un-allocated table entries, i.e. the scratch slot)."""
+    page = page or PAGE
+    rows, C, H, dh = k.shape
+    assert C % page == 0, (
+        f"chunk length {C} must be a multiple of the page size {page}")
+    npg_c = C // page
+    # scratch-extend the table before slicing: a multi-page chunk whose
+    # padded tail runs past the last table column would otherwise be
+    # CLAMPED by dynamic_slice onto earlier (live) pages of the same row,
+    # silently corrupting the request's own KV history — with the pad,
+    # overflow pages resolve to the scratch slot and the padded writes
+    # land there harmlessly
+    tbl = jnp.pad(cache["table"], ((0, 0), (0, npg_c)),
+                  constant_values=SCRATCH_SLOT)
+    slots = lax.dynamic_slice_in_dim(
+        tbl, start // page, npg_c, axis=1)  # [rows, npg_c]
+
+    def write(pool, x):
+        x5 = x.reshape(rows, npg_c, page, H, dh).astype(pool.dtype)
+        return pool.at[slots].set(x5)
+
+    return {**cache, "pool_k": write(cache["pool_k"], k),
+            "pool_v": write(cache["pool_v"], v)}
+
+
+def paged_chunk_attention(q, cache, start, npages_live: int,
+                          page: int | None = None):
+    """Causal attention of chunk queries q [rows, H, C, dh] at absolute
+    positions ``start + [0, C)`` against the live pages (which must already
+    contain the chunk's own K/V — write first, then attend, exactly like
+    the single-token path). jnp/XLA path only: serving prefill chunks are
+    ordinary dense attention over a gathered [rows, L, H, dh] view, which
+    XLA fuses well; the Pallas flash-decode kernel is single-query."""
+    page = page or PAGE
+    rows, H, C, dh = q.shape
+    tbl = cache["table"][:, :npages_live]
+    L = npages_live * page
+    kc = (cache["pool_k"][tbl].reshape(rows, L, H, dh)
+          .astype(q.dtype).transpose(0, 2, 1, 3))  # [rows, H, L, dh]
+    vc = (cache["pool_v"][tbl].reshape(rows, L, H, dh)
+          .astype(q.dtype).transpose(0, 2, 1, 3))
+    scores = jnp.einsum("rhqd,rhkd->rhqk", q, kc) / math.sqrt(dh)
+    q_pos = start + jnp.arange(C)
+    k_pos = jnp.arange(L)
+    ok = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    scores = jnp.where(ok, scores, -jnp.inf)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
+    return jnp.einsum("rhqk,rhkd->rhqd", probs, vc)

@@ -1,0 +1,32 @@
+"""Continuous-batching serving over the paged decoder.
+
+The serving-side counterpart of the training benchmark: a scheduler that
+packs chunked prefill next to in-flight decode under a token budget
+(:mod:`serve.engine`), a free-list page allocator over the shared KV pool
+(:mod:`serve.allocator`), deterministic open/closed-loop traffic
+(:mod:`serve.workload`), and — through ``tools/servebench.py`` — TTFT /
+inter-token-latency percentiles and goodput-under-SLO reporting.
+
+Import discipline: :mod:`serve.allocator` and :mod:`serve.workload` are
+jax-free (numpy + stdlib), so workload synthesis and allocation logic are
+importable from jax-free hosts; the engine (which traces models) is
+imported lazily via PEP 562 — the same laziness train/__init__ applies for
+the chaosbench supervisor.
+"""
+
+from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: F401
+from ddlbench_tpu.serve.workload import (  # noqa: F401
+    ServeRequest,
+    make_workload,
+)
+
+_ENGINE_NAMES = ("ReplicatedServer", "ServeEngine", "StepReport",
+                 "make_server", "supports_serve")
+
+
+def __getattr__(name):  # PEP 562: engine (and with it jax) loads on demand
+    if name in _ENGINE_NAMES:
+        from ddlbench_tpu.serve import engine
+
+        return getattr(engine, name)
+    raise AttributeError(name)

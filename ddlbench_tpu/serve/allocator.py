@@ -1,0 +1,92 @@
+"""Free-list page allocator for the shared serving KV pool.
+
+The beam-search paged cache (ops/paged_decode.py) statically stripes the
+pool: row r owns slots ``r * n_pages + [0, n_pages)`` forever. A serving
+engine cannot afford that — a request's KV history lives exactly as long as
+the request, and "pool exhausted" must mean *the chip's cache memory is
+genuinely full*, not *some row's private stripe ran out*. This allocator is
+the host-side free list that turns the pool into per-request page-granular
+memory: requests allocate pages as their streams grow, free them all on
+completion or eviction, and admission backpressure falls out of
+``alloc`` returning ``None``.
+
+All decisions are plain Python on the host (the device only ever sees the
+resulting page TABLE as an int32 input), so allocation order — and with it
+every downstream scheduling decision — is deterministic: slots are handed
+out lowest-first and freed slots are reused LIFO.
+
+Slot 0 is reserved as the SCRATCH page (ops/paged_decode.SCRATCH_SLOT):
+inactive rows' table entries point at it so their masked writes land
+somewhere harmless. It is never handed out and never counted as capacity.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional
+
+# ops/paged_decode.SCRATCH_SLOT, duplicated so this module stays jax-free
+# (the supervisor-side import discipline of train/__init__)
+SCRATCH_SLOT = 0
+
+
+class PageAllocator:
+    """All-or-nothing page allocation with exact occupancy accounting."""
+
+    def __init__(self, n_pages: int):
+        if n_pages < 2:
+            raise ValueError(
+                f"pool needs >= 2 pages (1 scratch + 1 usable), got {n_pages}")
+        self.n_pages = int(n_pages)
+        # descending so .pop() hands out the lowest slot first; freed slots
+        # are appended (LIFO reuse) — both choices only matter for
+        # determinism, which they guarantee
+        self._free: List[int] = [s for s in range(self.n_pages - 1, 0, -1)]
+        self._owned: Dict[int, List[int]] = {}  # rid -> slots, alloc order
+        self.allocs = 0
+        self.frees = 0
+        self.peak_in_use = 0
+
+    @property
+    def capacity(self) -> int:
+        """Usable pages (the scratch slot is not capacity)."""
+        return self.n_pages - 1
+
+    @property
+    def in_use(self) -> int:
+        return self.capacity - len(self._free)
+
+    def occupancy(self) -> float:
+        return self.in_use / self.capacity
+
+    def owned(self, rid: int) -> List[int]:
+        return list(self._owned.get(rid, ()))
+
+    def alloc(self, rid: int, n: int = 1) -> Optional[List[int]]:
+        """Allocate ``n`` pages for request ``rid``; all-or-nothing.
+
+        Returns the slot list, or None when the pool cannot supply ``n``
+        pages (admission/step backpressure — nothing is allocated).
+        """
+        if n <= 0:
+            raise ValueError(f"alloc n must be positive, got {n}")
+        if n > len(self._free):
+            return None
+        slots = [self._free.pop() for _ in range(n)]
+        assert SCRATCH_SLOT not in slots
+        self._owned.setdefault(rid, []).extend(slots)
+        self.allocs += n
+        self.peak_in_use = max(self.peak_in_use, self.in_use)
+        return slots
+
+    def free_request(self, rid: int) -> int:
+        """Free every page owned by ``rid`` (completion or eviction).
+
+        Freeing a request that owns nothing is a double-free — the engine
+        frees exactly once per retirement — and raises.
+        """
+        slots = self._owned.pop(rid, None)
+        if slots is None:
+            raise ValueError(f"double free: request {rid} owns no pages")
+        self._free.extend(slots)
+        self.frees += len(slots)
+        return len(slots)

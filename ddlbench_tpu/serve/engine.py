@@ -1,0 +1,605 @@
+"""Continuous-batching serving engine over the paged decoder.
+
+DDLBench measures training; this engine is the serving half of the north
+star ("serve heavy traffic from millions of users"). It is the same
+"keep the device busy" move the training side made with prefetch (PR 1)
+and comm overlap (PR 6), applied to inference: instead of decoding a fixed
+batch to completion and idling drained rows, the engine runs ITERATION-
+level scheduling (Orca/vLLM lineage) — every step packs, under a token
+budget, chunked-prefill segments of newly admitted requests next to
+single-token decode for the requests already in flight, so a finishing
+request's row is refilled on the very next step.
+
+Structure (host schedules, device computes):
+
+* The host owns the admission queue, the per-request bookkeeping, ONE page
+  table ``[max_batch, npg_max] int32`` shared by every layer, and the
+  free-list :class:`~ddlbench_tpu.serve.allocator.PageAllocator` over the
+  shared K/V pool (ops/paged_decode.py serve primitives; slot 0 scratch).
+  Every scheduling decision is plain deterministic Python; the device only
+  ever sees the table as an int32 input.
+* Two jitted programs cover all traffic, shape-stable by construction:
+  a ``[max_batch, 1]`` decode step at per-row positions (inactive rows are
+  masked by routing their table row to the scratch slot) and a
+  ``[1, prefill_chunk]`` page-aligned prefill chunk. Each compiles per
+  live-page count ``npl`` — the one-page-segment static-shape idiom of
+  models/decode.py — so the jit cache is bounded by ``max_len / page``
+  variants regardless of traffic.
+* Eviction closes the loop on pool exhaustion: when a growing request
+  needs a page and the free list is empty, the NEWEST-admitted request is
+  evicted (pages freed immediately, request re-queued at the front for
+  recomputation — greedy decode regenerates the same tokens), so the
+  oldest requests always make progress and livelock is impossible.
+* ``policy="static"`` is the built-in A/B baseline: requests are admitted
+  only when every row is free (whole-batch fill), with full worst-case
+  page reservation, and the batch drains to completion before the next is
+  admitted — classic static batching on identical numerics, so servebench
+  measures pure scheduling effect.
+
+Virtual time: one unit = one model pass (a decode step over max_batch rows
+or one prefill chunk), the cost model under which batch parallelism is
+free and wasted passes are what continuous batching eliminates. All
+latency/goodput metrics are in these units — fully deterministic, which is
+what makes servebench's JSON bitwise-reproducible under a fixed seed.
+
+Multi-replica serving (:class:`ReplicatedServer`) runs N independent
+engines — the serving analog of the mesh's 'data' axis: replicas share
+nothing, and a least-loaded dispatcher routes each arrival. Replicas step
+in lockstep; a global step costs the maximum over replica step costs.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+from ddlbench_tpu.config import ServeConfig
+from ddlbench_tpu.models.layers import LayerModel
+from ddlbench_tpu.serve.allocator import PageAllocator
+from ddlbench_tpu.serve.workload import ServeRequest
+
+
+def supports_serve(model: LayerModel) -> bool:
+    """True if every layer is servable (ServeOps or pointwise)."""
+    return all(l.serve is not None or l.pointwise for l in model.layers)
+
+
+def _require_serve_support(model: LayerModel) -> None:
+    if not supports_serve(model):
+        missing = [l.name for l in model.layers
+                   if l.serve is None and not l.pointwise]
+        raise NotImplementedError(
+            f"{model.name} has layers without serving support: {missing}; "
+            "the serving engine is wired for causal-LM transformer stacks")
+
+
+@dataclasses.dataclass
+class _Active:
+    """Host-side bookkeeping for one in-flight request on one engine row."""
+
+    req: ServeRequest
+    row: int
+    admit_seq: int  # admission order; eviction victims are newest-first
+    state: str = "prefill"  # "prefill" -> "decode"
+    prefill_done: int = 0  # prompt positions already processed
+    n_pages: int = 0  # table[row, :n_pages] hold this request's slots
+    pending_tok: int = -1  # next decode input token (= last emitted)
+    first_token_t: Optional[float] = None
+    out: List[int] = dataclasses.field(default_factory=list)
+    token_times: List[float] = dataclasses.field(default_factory=list)
+
+    @property
+    def decode_pos(self) -> int:
+        """Stream position of the pending decode input token."""
+        return self.req.prompt_len + len(self.out) - 1
+
+
+@dataclasses.dataclass
+class StepReport:
+    """What one engine step did (host-observable; drives the load gen)."""
+
+    cost: int = 0  # virtual time units = model passes this step
+    prefill_calls: int = 0
+    decode_rows: int = 0
+    admitted: int = 0
+    evicted: int = 0
+    backpressure: int = 0
+    completed: List[int] = dataclasses.field(default_factory=list)
+
+    def merge(self, other: "StepReport") -> None:
+        self.cost = max(self.cost, other.cost)
+        self.prefill_calls += other.prefill_calls
+        self.decode_rows += other.decode_rows
+        self.admitted += other.admitted
+        self.evicted += other.evicted
+        self.backpressure += other.backpressure
+        self.completed.extend(other.completed)
+
+
+class ServeEngine:
+    """One serving replica: scheduler + allocator + the two jitted steps."""
+
+    def __init__(self, model: LayerModel, params, state, cfg: ServeConfig,
+                 dtype=None, device=None, shared_fns=None):
+        import jax
+        import jax.numpy as jnp
+
+        _require_serve_support(model)
+        cfg.validate()
+        if cfg.max_len > model.in_shape[0]:
+            raise ValueError(
+                f"max_len {cfg.max_len} exceeds the model's stream length "
+                f"{model.in_shape[0]}")
+        self.model = model
+        self.cfg = cfg
+        self.page = cfg.page
+        self.npg_max = cfg.npg_max()
+        self.dtype = dtype or jnp.float32
+        self._put = (lambda t: jax.device_put(t, device)) if device \
+            else (lambda t: t)
+        self.params = self._put(params)
+        self.state = self._put(state)
+        self.pools = self._put([
+            l.serve.pool_init(p, cfg.pool_pages, cfg.page, self.dtype)
+            if (l.serve is not None and l.serve.pool_init is not None)
+            else None
+            for l, p in zip(model.layers, params)
+        ])
+        self.table = np.zeros((cfg.max_batch, self.npg_max), np.int32)
+        self.allocator = PageAllocator(cfg.pool_pages)
+        self.queue: deque = deque()
+        self.rows: List[Optional[_Active]] = [None] * cfg.max_batch
+        self.finished: List[Dict[str, Any]] = []
+        self._admit_seq = 0
+        self._filling = False  # static policy: whole-batch fill phase
+        self.stats: Dict[str, float] = {
+            "steps": 0, "model_calls": 0, "prefill_calls": 0,
+            "decode_calls": 0, "decode_row_slots": 0, "admitted": 0,
+            "completed": 0, "evicted": 0, "backpressure": 0,
+            "peak_occupancy": 0.0, "frag_sum": 0.0, "frag_samples": 0,
+        }
+        if shared_fns is not None:
+            # replicas of one server share the jitted callables (same model
+            # and shapes), so same-device replicas share the compile cache
+            # instead of re-tracing every npl variant per engine
+            self._decode_jit, self._prefill_jit = shared_fns
+        else:
+            self._make_fns()
+
+    def jit_fns(self):
+        """The (decode, prefill) jitted callables, shareable with sibling
+        replicas built from the same model/config."""
+        return self._decode_jit, self._prefill_jit
+
+    # -- jitted model programs ---------------------------------------------
+
+    def _make_fns(self) -> None:
+        import jax
+        import jax.numpy as jnp
+
+        layers = self.model.layers
+        page = self.page
+
+        def walk(params, states, pools, table, h, op_name, *op_args):
+            out_pools = []
+            for layer, p, s, pool in zip(layers, params, states, pools):
+                if layer.serve is not None:
+                    op = getattr(layer.serve, op_name)
+                    h, pool = op(p, s, pool, table, h, *op_args)
+                else:  # pointwise (the LM head)
+                    h, _ = layer.apply(p, s, h, False)
+                out_pools.append(pool)
+            return h, out_pools
+
+        def decode_fn(params, states, pools, table, toks, pos, npl):
+            logits, pools = walk(params, states, pools, table, toks,
+                                 "decode", pos, npl, page)
+            nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
+            return nxt, pools
+
+        # trailing pointwise layers (the LM head) need only the ONE chunk
+        # position whose next token the scheduler wants — applying them to
+        # all C positions would spend C head matmuls per chunk for 1 (or,
+        # on non-last chunks, 0) useful rows
+        n_body = len(layers)
+        while n_body and layers[n_body - 1].serve is None \
+                and layers[n_body - 1].pointwise:
+            n_body -= 1
+
+        def prefill_fn(params, states, pools, table, chunk, start, want, npl):
+            from jax import lax
+
+            h, out_pools = walk(params[:n_body], states[:n_body],
+                                pools[:n_body], table, chunk,
+                                "prefill", start, npl, page)
+            h = lax.dynamic_slice_in_dim(h, want, 1, axis=1)  # [1, 1, d]
+            for layer, p, s in zip(layers[n_body:], params[n_body:],
+                                   states[n_body:]):
+                h, _ = layer.apply(p, s, h, False)
+            nxt = jnp.argmax(h[0, 0, :], axis=-1).astype(jnp.int32)
+            return nxt, out_pools + list(pools[n_body:])
+
+        self._decode_jit = jax.jit(decode_fn, static_argnums=(6,),
+                                   donate_argnums=(2,))
+        self._prefill_jit = jax.jit(prefill_fn, static_argnums=(7,),
+                                    donate_argnums=(2,))
+
+    # -- request lifecycle -------------------------------------------------
+
+    def _pages_for(self, n_positions: int) -> int:
+        """Pages that hold stream positions [0, n_positions)."""
+        return (n_positions - 1) // self.page + 1 if n_positions else 0
+
+    def _written_positions(self, req: ServeRequest) -> int:
+        # prompt S + decode writes (max_new - 1): the final emitted token
+        # is never fed back, so its K/V is never written
+        return req.prompt_len + req.max_new - 1
+
+    def submit(self, req: ServeRequest) -> None:
+        if req.prompt_len < 1 or req.max_new < 1:
+            raise ValueError("request needs a non-empty prompt and "
+                             "max_new >= 1")
+        if req.prompt_len + req.max_new > self.cfg.max_len:
+            raise ValueError(
+                f"request {req.rid}: prompt {req.prompt_len} + max_new "
+                f"{req.max_new} exceeds max_len {self.cfg.max_len}")
+        if self._pages_for(self._written_positions(req)) > \
+                self.allocator.capacity:
+            raise ValueError(
+                f"request {req.rid} can never fit the pool "
+                f"({self.allocator.capacity} usable pages)")
+        self.queue.append(req)
+
+    def has_work(self) -> bool:
+        return bool(self.queue) or any(a is not None for a in self.rows)
+
+    def load(self) -> int:
+        """Remaining token work (queued + in flight) — the least-loaded
+        dispatch key."""
+        tot = sum(r.prompt_len + r.max_new for r in self.queue)
+        for a in self.rows:
+            if a is not None:
+                tot += (a.req.prompt_len - a.prefill_done) \
+                    + (a.req.max_new - len(a.out))
+        return tot
+
+    def _free_row(self) -> Optional[int]:
+        for i, a in enumerate(self.rows):
+            if a is None:
+                return i
+        return None
+
+    def _active(self) -> List[_Active]:
+        return [a for a in self.rows if a is not None]
+
+    def _evict(self, victim: _Active, rep: StepReport) -> None:
+        """Free the victim's pages and re-queue it (front) for
+        recomputation — greedy decode regenerates the same tokens."""
+        self.allocator.free_request(victim.req.rid)
+        self.table[victim.row, :] = 0
+        self.rows[victim.row] = None
+        self.queue.appendleft(victim.req)
+        rep.evicted += 1
+        self.stats["evicted"] += 1
+
+    def _evict_newest(self, rep: StepReport) -> Optional[_Active]:
+        active = self._active()
+        if not active:
+            return None
+        victim = max(active, key=lambda a: a.admit_seq)
+        self._evict(victim, rep)
+        return victim
+
+    def _complete(self, a: _Active, t: float, rep: StepReport) -> None:
+        self.allocator.free_request(a.req.rid)
+        self.table[a.row, :] = 0
+        self.rows[a.row] = None
+        # static policy: a completion ends the fill phase — otherwise a
+        # short-output workload whose completions keep freeing rows while
+        # the queue is nonempty would leave the phase open forever and the
+        # "static" baseline would degenerate into budget-paced continuous
+        # admission (no drain barrier, biasing the A/B)
+        self._filling = False
+        self.finished.append({
+            "rid": a.req.rid,
+            "arrival": a.req.arrival,
+            "prompt_len": a.req.prompt_len,
+            "tokens": list(a.out),
+            "n_tokens": len(a.out),
+            "first_token_t": a.first_token_t,
+            "token_times": list(a.token_times),
+            "completed_t": t,
+        })
+        rep.completed.append(a.req.rid)
+        self.stats["completed"] += 1
+
+    # -- the step: ensure pages -> pack -> prefill/decode -> retire --------
+
+    def _ensure_decode_pages(self, rep: StepReport) -> List[_Active]:
+        """Give every decode row the page its next write needs, evicting
+        newest-first when the pool is exhausted. Returns the surviving
+        decode set."""
+        out = []
+        for a in [x for x in self.rows
+                  if x is not None and x.state == "decode"]:
+            if self.rows[a.row] is not a:  # evicted by an earlier victim hunt
+                continue
+            pgi = a.decode_pos // self.page
+            alive = True
+            while pgi >= a.n_pages:
+                slots = self.allocator.alloc(a.req.rid, 1)
+                if slots is not None:
+                    self.table[a.row, a.n_pages] = slots[0]
+                    a.n_pages += 1
+                    continue
+                victim = self._evict_newest(rep)
+                assert victim is not None
+                if victim is a:
+                    alive = False
+                    break
+            if alive:
+                out.append(a)
+        # a victim can sit at a LOWER row index than its evictor (rows are
+        # reused, so admission order and row order diverge): a row already
+        # appended here may be evicted by a later iteration's victim hunt.
+        # Running it anyway would decode against a zeroed table row and —
+        # at its final token — double-free its already-freed pages.
+        return [a for a in out if self.rows[a.row] is a]
+
+    def _ensure_prefill_pages(self, a: _Active, end_real: int,
+                              rep: StepReport, can_evict: bool) -> bool:
+        need = self._pages_for(end_real) - a.n_pages
+        while True:
+            if need <= 0:
+                return True
+            slots = self.allocator.alloc(a.req.rid, need)
+            if slots is not None:
+                self.table[a.row, a.n_pages:a.n_pages + need] = slots
+                a.n_pages += need
+                return True
+            if not can_evict:
+                rep.backpressure += 1
+                self.stats["backpressure"] += 1
+                return False
+            victim = self._evict_newest(rep)
+            if victim is a:
+                return False  # evicted ourselves; the queue will retry
+
+    def _admission_open(self) -> bool:
+        if self.cfg.policy == "continuous":
+            return True
+        # static: admit only during a whole-batch fill phase
+        if not self._filling and not self._active():
+            self._filling = True
+        return self._filling
+
+    def step(self, now: float = 0.0) -> StepReport:
+        """One engine step. Returns what ran; emission/completion times are
+        stamped at ``now + cost`` (the step's end in virtual time)."""
+        rep = StepReport()
+        C = self.cfg.resolved_prefill_chunk()
+
+        # 1) decode set: every decode row gets its next page (evictions may
+        #    shrink the set — or free rows the packer then refills)
+        decode_set = self._ensure_decode_pages(rep)
+        budget = self.cfg.resolved_token_budget() - len(decode_set)
+
+        # 2) continue in-flight prefills, admission order
+        prefill_calls: List[_Active] = []
+        for a in sorted((x for x in self.rows
+                         if x is not None and x.state == "prefill"),
+                        key=lambda x: x.admit_seq):
+            if self.rows[a.row] is not a:
+                continue  # evicted by an earlier iteration's victim hunt
+            if budget < C:
+                break
+            end_real = min(a.prefill_done + C, a.req.prompt_len)
+            # waiting only helps if running requests will free pages;
+            # with no decode rows in flight, evict to guarantee progress
+            if self._ensure_prefill_pages(a, end_real, rep,
+                                          can_evict=not decode_set):
+                prefill_calls.append(a)
+                budget -= C
+            # (prefill eviction only runs when decode_set is empty, so it
+            # can never remove a decode row scheduled this step)
+
+        # 3) admit new requests while the packer has budget
+        while (budget >= C and self.queue
+               and self._free_row() is not None and self._admission_open()):
+            req = self.queue[0]
+            if self.cfg.policy == "static":
+                # static baseline reserves the full worst case up front
+                need = self._pages_for(self._written_positions(req))
+            else:
+                need = self._pages_for(min(C, req.prompt_len))
+            slots = self.allocator.alloc(req.rid, need)
+            if slots is None:
+                rep.backpressure += 1
+                self.stats["backpressure"] += 1
+                self._filling = False  # static: close the fill phase
+                break
+            self.queue.popleft()
+            row = self._free_row()
+            a = _Active(req=req, row=row, admit_seq=self._admit_seq)
+            self._admit_seq += 1
+            self.table[row, :] = 0
+            self.table[row, :need] = slots
+            a.n_pages = need
+            self.rows[row] = a
+            prefill_calls.append(a)
+            budget -= C
+            rep.admitted += 1
+            self.stats["admitted"] += 1
+        if self.cfg.policy == "static" and (
+                self._free_row() is None or not self.queue):
+            self._filling = False
+
+        # 4) price the step, then run it
+        cost = len(prefill_calls) + (1 if decode_set else 0)
+        t_end = now + cost
+        for a in prefill_calls:
+            self._run_prefill_chunk(a, C, t_end, rep)
+        if decode_set:
+            self._run_decode(decode_set, t_end, rep)
+
+        # 5) occupancy / fragmentation accounting
+        self.stats["steps"] += 1
+        self.stats["model_calls"] += cost
+        self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"],
+                                           self.allocator.occupancy())
+        live = cap = 0
+        for a in self._active():
+            live += a.prefill_done + max(0, len(a.out) - 1)
+            cap += a.n_pages * self.page
+        if cap:
+            self.stats["frag_sum"] += 1.0 - live / cap
+            self.stats["frag_samples"] += 1
+        rep.cost = cost
+        return rep
+
+    def _run_prefill_chunk(self, a: _Active, C: int, t_end: float,
+                           rep: StepReport) -> None:
+        import jax.numpy as jnp
+
+        assert self.rows[a.row] is a, "scheduled a dead (evicted) row"
+        S = a.req.prompt_len
+        start = a.prefill_done
+        end_real = min(start + C, S)
+        chunk = np.zeros((1, C), np.int32)
+        chunk[0, :end_real - start] = a.req.prompt[start:end_real]
+        last = end_real == S
+        want = (S - 1 - start) if last else 0
+        npl = self._pages_for(end_real)
+        nxt, self.pools = self._prefill_jit(
+            self.params, self.state, self.pools,
+            jnp.asarray(self.table[a.row:a.row + 1]), jnp.asarray(chunk),
+            np.int32(start), np.int32(want), npl)
+        a.prefill_done = end_real
+        rep.prefill_calls += 1
+        self.stats["prefill_calls"] += 1
+        if last:
+            tok = int(nxt)
+            a.out.append(tok)
+            a.token_times.append(t_end)
+            a.first_token_t = t_end
+            if len(a.out) >= a.req.max_new:
+                self._complete(a, t_end, rep)
+            else:
+                a.state = "decode"
+                a.pending_tok = tok
+
+    def _run_decode(self, decode_set: List[_Active], t_end: float,
+                    rep: StepReport) -> None:
+        import jax.numpy as jnp
+
+        assert all(self.rows[a.row] is a for a in decode_set), \
+            "scheduled a dead (evicted) row"
+        B = self.cfg.max_batch
+        toks = np.zeros((B, 1), np.int32)
+        pos = np.zeros((B,), np.int32)
+        mask = np.zeros((B,), bool)
+        for a in decode_set:
+            toks[a.row, 0] = a.pending_tok
+            pos[a.row] = a.decode_pos
+            mask[a.row] = True
+        # inactive rows (free, or mid-prefill) are routed to the scratch
+        # slot so their masked writes cannot touch a live page
+        dec_table = np.where(mask[:, None], self.table, 0)
+        npl = max(int(a.decode_pos) // self.page + 1 for a in decode_set)
+        nxt, self.pools = self._decode_jit(
+            self.params, self.state, self.pools, jnp.asarray(dec_table),
+            jnp.asarray(toks), jnp.asarray(pos), npl)
+        nxt = np.asarray(nxt)
+        rep.decode_rows = len(decode_set)
+        self.stats["decode_calls"] += 1
+        self.stats["decode_row_slots"] += len(decode_set)
+        for a in decode_set:
+            tok = int(nxt[a.row])
+            a.out.append(tok)
+            a.token_times.append(t_end)
+            if len(a.out) >= a.req.max_new:
+                self._complete(a, t_end, rep)
+            else:
+                a.pending_tok = tok
+
+    def stats_summary(self) -> Dict[str, float]:
+        s = dict(self.stats)
+        calls = s.pop("decode_calls")
+        slots = s.pop("decode_row_slots")
+        frag_sum, frag_n = s.pop("frag_sum"), s.pop("frag_samples")
+        s["decode_calls"] = calls
+        s["decode_batch_util"] = (
+            slots / (calls * self.cfg.max_batch) if calls else 0.0)
+        s["mean_page_fragmentation"] = frag_sum / frag_n if frag_n else 0.0
+        return s
+
+
+class ReplicatedServer:
+    """N independent replicas over the serving mesh's 'data' axis with a
+    least-loaded dispatcher. Replicas step in lockstep; a global step
+    costs the max over replica costs (they run in parallel)."""
+
+    def __init__(self, engines: List[ServeEngine]):
+        if not engines:
+            raise ValueError("need at least one engine")
+        self.engines = list(engines)
+
+    def submit(self, req: ServeRequest) -> None:
+        eng = min(enumerate(self.engines), key=lambda ie: (ie[1].load(),
+                                                           ie[0]))[1]
+        eng.submit(req)
+
+    def has_work(self) -> bool:
+        return any(e.has_work() for e in self.engines)
+
+    def step(self, now: float = 0.0) -> StepReport:
+        rep = StepReport()
+        for e in self.engines:
+            if e.has_work():
+                rep.merge(e.step(now))
+        return rep
+
+    @property
+    def finished(self) -> List[Dict[str, Any]]:
+        out = []
+        for e in self.engines:
+            out.extend(e.finished)
+        return out
+
+    def stats_summary(self) -> Dict[str, float]:
+        sums: Dict[str, float] = {}
+        for e in self.engines:
+            for k, v in e.stats_summary().items():
+                sums[k] = sums.get(k, 0) + v
+        for k in ("decode_batch_util", "mean_page_fragmentation"):
+            sums[k] /= len(self.engines)
+        # peak occupancy is a saturation signal: averaging would hide one
+        # evicting, pool-bound replica behind its idle siblings
+        sums["peak_occupancy"] = max(
+            e.stats["peak_occupancy"] for e in self.engines)
+        return sums
+
+
+def make_server(model: LayerModel, params, state, cfg: ServeConfig,
+                dtype=None, devices=None) -> ReplicatedServer:
+    """Build a (possibly multi-replica) server. ``devices=None`` places
+    replica i on ``jax.devices()[i]`` when there are enough devices — the
+    serving analog of laying replicas along the mesh's 'data' axis — and
+    shares the default device otherwise."""
+    import jax
+
+    n = cfg.replicas
+    if devices is None:
+        devs = jax.devices()
+        devices = [devs[i] if n > 1 and i < len(devs) else None
+                   for i in range(n)]
+    rep_cfg = cfg.replace(replicas=1)
+    engines = []
+    for d in devices:
+        engines.append(ServeEngine(
+            model, params, state, rep_cfg, dtype=dtype, device=d,
+            shared_fns=engines[0].jit_fns() if engines else None))
+    return ReplicatedServer(engines)

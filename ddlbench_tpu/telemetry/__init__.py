@@ -38,4 +38,5 @@ from ddlbench_tpu.telemetry.bubble import bubble_fraction  # noqa: F401
 from ddlbench_tpu.telemetry.stats import (  # noqa: F401
     StepLatencyStats,
     percentile,
+    serve_summary,
 )

@@ -58,6 +58,58 @@ def latency_summary(samples_s: List[float]) -> Dict[str, float]:
     }
 
 
+def serve_summary(records: List[Dict], *, duration: float,
+                  slo_ttft: Optional[float] = None,
+                  slo_itl: Optional[float] = None) -> Dict[str, float]:
+    """Serving-side latency/goodput aggregation over completed requests.
+
+    ``records`` are the engine's ``finished`` entries
+    (serve/engine.py: arrival, first_token_t, token_times, n_tokens).
+    Times are in whatever unit the caller measured — the engine's virtual
+    model-pass units by default — and the SLOs are in the same unit.
+
+    Reported through the same percentile machinery as the training step
+    stats: TTFT (arrival -> first token) and ITL (gap between consecutive
+    tokens of one request, pooled over all requests) p50/p95/p99, plus the
+    serving headline — **goodput under SLO**: output tokens per time unit
+    counting ONLY requests that met BOTH SLOs (TTFT <= slo_ttft and mean
+    ITL a.k.a. TPOT <= slo_itl; an omitted SLO always passes). Throughput
+    counts every completed token; the goodput/throughput gap is the
+    capacity wasted on requests served too late to matter.
+    """
+    ttfts, itls, good_tokens, total_tokens, n_ok = [], [], 0, 0, 0
+    for r in records:
+        ttft = r["first_token_t"] - r["arrival"]
+        ttfts.append(ttft)
+        times = r["token_times"]
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        itls.extend(gaps)
+        tpot = sum(gaps) / len(gaps) if gaps else 0.0
+        total_tokens += r["n_tokens"]
+        ok = ((slo_ttft is None or ttft <= slo_ttft)
+              and (slo_itl is None or tpot <= slo_itl))
+        if ok:
+            n_ok += 1
+            good_tokens += r["n_tokens"]
+    dur = max(duration, 1e-12)
+    out = {
+        "completed": len(records),
+        "output_tokens": total_tokens,
+        "duration": duration,
+        "throughput_tokens_per_unit": total_tokens / dur,
+        "goodput_tokens_per_unit": good_tokens / dur,
+        "slo_attainment": n_ok / len(records) if records else 0.0,
+    }
+    for name, samples in (("ttft", ttfts), ("itl", itls)):
+        for q in (50.0, 95.0, 99.0):
+            out[f"{name}_p{q:.0f}"] = percentile(samples, q)
+    if slo_ttft is not None:
+        out["slo_ttft"] = slo_ttft
+    if slo_itl is not None:
+        out["slo_itl"] = slo_itl
+    return out
+
+
 class StepLatencyStats:
     """Per-epoch step-duration collector for one run (single-threaded:
     only the train loop records)."""

@@ -65,9 +65,17 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
-    from ddlbench_tpu.distributed import enable_compilation_cache
+    from ddlbench_tpu.distributed import (backend_provenance,
+                                          enable_compilation_cache,
+                                          warn_cpu_fallback)
 
     enable_compilation_cache()
+    # actual-backend record on every row + loud cpu-fallback banner (shared
+    # classification — distributed.backend_provenance): without it a hung
+    # TPU init would silently report cpu decode numbers as if on-chip,
+    # exactly the poisoning bench.py/scalebench already guard against
+    prov = backend_provenance(args.platform)
+    warn_cpu_fallback(prov, "decodebench")
 
     from ddlbench_tpu.config import DATASETS
     from ddlbench_tpu.models import init_model
@@ -106,7 +114,8 @@ def main(argv=None) -> int:
         if variant == "paged" and not dec.supports_paged(model):
             print(json.dumps({"tool": "decodebench", "mode": mode,
                               "variant": "paged",
-                              "skipped": f"{args.model} lacks paged support"}),
+                              "skipped": f"{args.model} lacks paged support",
+                              **prov}),
                   flush=True)
             continue
         if causal and variant == "full":
@@ -114,7 +123,8 @@ def main(argv=None) -> int:
             # causal cached path is pinned against it in tests instead
             print(json.dumps({"tool": "decodebench", "mode": mode,
                               "variant": "full",
-                              "skipped": "full-forward loop is seq2seq-only"}),
+                              "skipped": "full-forward loop is seq2seq-only",
+                              **prov}),
                   flush=True)
             continue
         if variant == "paged" or causal:
@@ -150,11 +160,13 @@ def main(argv=None) -> int:
             print(json.dumps({
                 "tool": "decodebench", "mode": mode, "variant": variant,
                 "error": f"{type(e).__name__}: {str(e).splitlines()[0][:200]}",
+                **prov,
             }), flush=True)
             continue
         print(json.dumps({
             "tool": "decodebench",
             "platform": jax.devices()[0].platform,
+            **prov,
             "model": args.model,
             "benchmark": args.benchmark,
             "mode": mode,

@@ -51,9 +51,17 @@ def main(argv=None) -> int:
     import jax
     import jax.numpy as jnp
 
-    from ddlbench_tpu.distributed import enable_compilation_cache
+    from ddlbench_tpu.distributed import (backend_provenance,
+                                          enable_compilation_cache,
+                                          warn_cpu_fallback)
 
     enable_compilation_cache()
+    # actual-backend record on every row + loud cpu-fallback banner (shared
+    # classification — distributed.backend_provenance), matching
+    # bench.py/scalebench: a silent cpu fallback must never read as a chip
+    # number in the PERF.md trail
+    prov = backend_provenance(args.platform)
+    warn_cpu_fallback(prov, "lmbench")
 
     from ddlbench_tpu.config import DATASETS, RunConfig
     from ddlbench_tpu.data.synthetic import make_synthetic
@@ -131,6 +139,7 @@ def main(argv=None) -> int:
             "remat": remat,
             "tokens_per_sec": round(tokens / dt, 1),
             "ms_per_step": round(1000 * dt / args.steps, 2),
+            **prov,
         }), flush=True)
 
     def is_oom(e: BaseException) -> bool:
@@ -162,6 +171,7 @@ def main(argv=None) -> int:
                     "benchmark": args.benchmark, "remat": remat,
                     "error": "hbm-oom",
                     "detail": str(e).splitlines()[0][:200],
+                    **prov,
                 }), flush=True)
             finally:
                 # reset the backend override for the next config

@@ -1,0 +1,225 @@
+"""Serving benchmark: continuous batching vs static batching under load.
+
+Drives the continuous-batching engine (serve/engine.py) with a seeded
+open- or closed-loop workload (serve/workload.py) and reports the serving
+metrics that matter for "heavy traffic from millions of users": TTFT and
+inter-token-latency p50/p95/p99 plus **goodput under SLO** — output tokens
+per time unit counting only requests whose TTFT and mean ITL met their
+SLOs (telemetry/stats.serve_summary). One JSON line per configuration,
+like every other tool:
+
+    {"tool": "servebench", "policy": "continuous", "arrival": "poisson",
+     "goodput_tokens_per_unit": G, "ttft_p95": T, ...}
+
+Time is VIRTUAL by default: one unit = one model pass (a [max_batch, 1]
+decode step or one prefill chunk — the engine's cost model, under which
+batch parallelism is free and wasted passes are what scheduling policies
+differ on). That makes every reported number bitwise-reproducible under a
+fixed seed — the same repro discipline as every other tool — while
+``--wall-clock`` adds real elapsed seconds for on-chip runs.
+
+The default sweep runs each requested policy (continuous, then the
+static whole-batch baseline) over the SAME workload at the SAME pool
+size, so the goodput delta is pure scheduling effect.
+
+Usage:
+    python -m ddlbench_tpu.tools.servebench [-m transformer_s]
+        [-b synthtext] [--arrival poisson|bursty|closed] [--rate 0.5]
+        [--requests 64] [--max-batch 8] [--pool-pages 64] [--page 16]
+        [--max-len 256] [--slo-ttft 16] [--slo-itl 2.0] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def run_open_loop(server, reqs):
+    """Release requests at their arrival times; returns the final clock."""
+    clock, i = 0.0, 0
+    pend = sorted(reqs, key=lambda r: (r.arrival, r.rid))
+    while i < len(pend) or server.has_work():
+        while i < len(pend) and pend[i].arrival <= clock:
+            server.submit(pend[i])
+            i += 1
+        if not server.has_work():
+            clock = pend[i].arrival  # idle: jump to the next arrival
+            continue
+        rep = server.step(clock)
+        clock += rep.cost
+    return clock
+
+
+def run_closed_loop(server, reqs, concurrency: int):
+    """Keep ``concurrency`` requests in flight; each completion releases
+    the next. Returns the final clock."""
+    clock, nxt = 0.0, 0
+    for _ in range(min(concurrency, len(reqs))):
+        reqs[nxt].arrival = clock
+        server.submit(reqs[nxt])
+        nxt += 1
+    done = 0
+    while done < len(reqs):
+        rep = server.step(clock)
+        clock += rep.cost
+        done += len(rep.completed)
+        for _ in rep.completed:
+            if nxt < len(reqs):
+                reqs[nxt].arrival = clock
+                server.submit(reqs[nxt])
+                nxt += 1
+    return clock
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", default="transformer_s")
+    p.add_argument("-b", "--benchmark", default="synthtext")
+    p.add_argument("--policies", default="continuous,static",
+                   help="comma list among continuous,static — each runs "
+                        "the same workload at the same pool size")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--pool-pages", type=int, default=64)
+    p.add_argument("--page", type=int, default=16)
+    p.add_argument("--max-len", type=int, default=256)
+    p.add_argument("--prefill-chunk", type=int, default=None,
+                   help="tokens per prefill call (page multiple; default: "
+                        "one page; 0 = whole prompt in one padded call)")
+    p.add_argument("--token-budget", type=int, default=0,
+                   help="tokens one step may pack (0 = max_batch + 2 "
+                        "prefill chunks)")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="independent data-parallel serving replicas "
+                        "(least-loaded dispatch)")
+    p.add_argument("--arrival", default="poisson",
+                   choices=("poisson", "bursty", "closed"))
+    p.add_argument("--rate", type=float, default=0.5,
+                   help="open-loop arrival rate (requests per model pass)")
+    p.add_argument("--burst-size", type=int, default=8)
+    p.add_argument("--burst-factor", type=float, default=4.0)
+    p.add_argument("--concurrency", type=int, default=16,
+                   help="closed-loop in-flight request count")
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--prompt-lens", default="4,16,64",
+                   help="lo,typical,hi of the heavy-tail prompt mixture")
+    p.add_argument("--out-lens", default="2,16,64",
+                   help="lo,typical,hi of the heavy-tail output mixture")
+    p.add_argument("--tail-frac", type=float, default=0.25)
+    p.add_argument("--slo-ttft", type=float, default=16.0,
+                   help="TTFT SLO in time units (model passes)")
+    p.add_argument("--slo-itl", type=float, default=2.0,
+                   help="mean inter-token-latency SLO in time units")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--paged-kernel", default="dots",
+                   choices=("dots", "elementwise"),
+                   help="paged-kernel math formulation (ops/paged_decode)")
+    p.add_argument("--wall-clock", action="store_true",
+                   help="also report real elapsed seconds (off by default "
+                        "so the JSON stays bitwise-reproducible)")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    import jax
+
+    from ddlbench_tpu.distributed import (backend_provenance,
+                                          enable_compilation_cache,
+                                          warn_cpu_fallback)
+
+    enable_compilation_cache()
+    prov = backend_provenance(args.platform)
+    warn_cpu_fallback(prov, "servebench")
+
+    from ddlbench_tpu.config import DATASETS, ServeConfig
+    from ddlbench_tpu.models import init_model
+    from ddlbench_tpu.models.zoo import get_model
+    from ddlbench_tpu.ops.paged_decode import set_paged_kernel_style
+    from ddlbench_tpu.serve.engine import make_server, supports_serve
+    from ddlbench_tpu.serve.workload import make_workload
+    from ddlbench_tpu.telemetry.stats import serve_summary
+
+    spec = DATASETS[args.benchmark]
+    if spec.kind != "tokens":
+        p.error(f"-b {args.benchmark!r} is not a causal-LM token workload; "
+                "the serving engine serves causal LMs (pick a 'tokens' "
+                "benchmark, e.g. synthtext)")
+    model = get_model(args.model, spec)
+    if not supports_serve(model):
+        p.error(f"{args.model} has layers without serving support")
+    set_paged_kernel_style(args.paged_kernel)
+    params, state, _ = init_model(model, jax.random.key(0))
+
+    plo, ptyp, phi = (int(x) for x in args.prompt_lens.split(","))
+    olo, otyp, ohi = (int(x) for x in args.out_lens.split(","))
+    policies = [s.strip() for s in args.policies.split(",") if s.strip()]
+    base = ServeConfig(
+        max_batch=args.max_batch, pool_pages=args.pool_pages,
+        page=args.page, max_len=min(args.max_len, spec.seq_len),
+        token_budget=args.token_budget,
+        prefill_chunk=(args.page if args.prefill_chunk is None
+                       else args.prefill_chunk),
+        replicas=args.replicas)
+
+    for policy in policies:
+        cfg = base.replace(policy=policy)
+        cfg.validate()
+        # fresh workload per policy: ServeRequest.arrival is stamped by the
+        # closed-loop driver, and both policies must see identical traffic
+        reqs = make_workload(
+            seed=args.seed, n_requests=args.requests,
+            vocab=spec.num_classes, arrival=args.arrival, rate=args.rate,
+            burst_size=args.burst_size, burst_factor=args.burst_factor,
+            prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
+            out_lo=olo, out_typical=otyp, out_hi=ohi,
+            tail_frac=args.tail_frac, max_len=cfg.max_len)
+        server = make_server(model, params, state, cfg)
+        t0 = time.perf_counter()
+        if args.arrival == "closed":
+            duration = run_closed_loop(server, reqs, args.concurrency)
+        else:
+            duration = run_open_loop(server, reqs)
+        wall = time.perf_counter() - t0
+        rec = {
+            "tool": "servebench",
+            "model": args.model,
+            "benchmark": args.benchmark,
+            "policy": policy,
+            "arrival": args.arrival,
+            "rate": args.rate if args.arrival != "closed" else None,
+            "concurrency": (args.concurrency if args.arrival == "closed"
+                            else None),
+            "requests": args.requests,
+            "seed": args.seed,
+            "max_batch": cfg.max_batch,
+            "pool_pages": cfg.pool_pages,
+            "page": cfg.page,
+            "max_len": cfg.max_len,
+            "prefill_chunk": cfg.resolved_prefill_chunk(),
+            "token_budget": cfg.resolved_token_budget(),
+            "replicas": cfg.replicas,
+            "time_unit": "model_pass",
+            **{k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in serve_summary(
+                   server.finished, duration=duration,
+                   slo_ttft=args.slo_ttft, slo_itl=args.slo_itl).items()},
+            **{k: (round(v, 6) if isinstance(v, float) else v)
+               for k, v in server.stats_summary().items()
+               if k != "completed"},  # serve_summary already reports it
+            # actual backend record (shared classification —
+            # distributed.backend_provenance); cpu-fallback rows must be
+            # identifiable as harness validation, not chip numbers
+            **prov,
+        }
+        if args.wall_clock:
+            rec["wall_s"] = round(wall, 3)
+        print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,57 @@
+#!/usr/bin/env bash
+# Round-12 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the serving round:
+#
+#   * continuous vs static batching A/B over the SAME seeded workload at
+#     the SAME pool size (servebench runs both policies per invocation);
+#   * an open-loop Poisson rate sweep (goodput-vs-load curve: continuous
+#     should stay ahead up to saturation);
+#   * a bursty-arrival run (queue-building bursts — the TTFT tail case);
+#   * an undersized-pool run (evictions > 0; goodput degrades gracefully
+#     via recomputation, not collapse);
+#   * 4-replica data-parallel serving on the v5e-8 slice (least-loaded
+#     dispatch; expect ~4x goodput at equal per-replica load);
+#   * decodebench with the new provenance fields (the satellite: rows now
+#     carry jax_backend/cpu_fallback like bench.py/scalebench).
+#
+# servebench JSON is bitwise-deterministic in virtual model-pass units;
+# --wall-clock adds real seconds next to them for the on-chip record.
+# Expectations in PERF.md § round 12.
+#
+# Usage: scripts/tpu_round12.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+add_task pipe_hyb_1f1b_r11      python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30 --pipe-schedule 1f1b --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_1f1b_r11.jsonl --trace perf_runs/trace_hyb_1f1b_r11.json --trace-dir perf_runs/xla_hyb_1f1b_r11 --xla-trace-steps 10:14
+add_task pipe_rep_1f1b_r11      python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30 --pipe-schedule 1f1b --jsonl perf_runs/pipe_rep_1f1b_r11.jsonl --trace perf_runs/trace_rep_1f1b_r11.json
+
+# -- round-12a: continuous vs static A/B + rate sweep ----------------------
+# transformer_s/synthtext on one chip; each invocation emits BOTH policy
+# rows over the identical seeded workload at the identical pool size, so
+# the goodput delta is pure scheduling effect. Virtual-unit metrics are
+# deterministic; --wall-clock records real seconds alongside.
+SRV_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu"
+add_task serve_poisson_lo_r12  python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival poisson --rate 0.25
+add_task serve_poisson_mid_r12 python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival poisson --rate 0.5
+add_task serve_poisson_hi_r12  python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival poisson --rate 1.0
+add_task serve_closed_r12      python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival closed --concurrency 24
+
+# -- round-12b: bursty traffic + undersized pool (eviction economics) ------
+add_task serve_bursty_r12      python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 6
+add_task serve_smallpool_r12   python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 40 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --arrival poisson --rate 0.5 --wall-clock --platform tpu
+
+# -- round-12c: multi-replica serving on the v5e-8 slice -------------------
+add_task serve_rep4_r12        python -m ddlbench_tpu.tools.servebench $SRV_COMMON --arrival poisson --rate 2.0 --replicas 4 --requests 192
+
+# -- round-12d: decodebench provenance satellite (rows now self-identify) --
+add_task decodebench_prov_r12  python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu
+
+window_loop "${1:-12}"

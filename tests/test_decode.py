@@ -147,7 +147,7 @@ def test_decodebench_tool(capsys):
     with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched):
         rc = decodebench.main(["-m", "seq2seq_bench_t", "-b", "tinymtb",
                                "--batch", "2", "--beam", "2",
-                               "--repeats", "1"])
+                               "--repeats", "1", "--platform", "cpu"])
     assert rc == 0
     lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()]
     assert len(lines) == 6
@@ -156,6 +156,10 @@ def test_decodebench_tool(capsys):
                      ("greedy", "cached"), ("beam", "cached"),
                      ("greedy", "full"), ("beam", "full")}
     assert all(l["tokens_per_sec"] > 0 for l in lines)
+    # provenance rides every row (distributed.backend_provenance), so a
+    # cpu-fallback run can never masquerade as an on-chip measurement
+    assert all(l["jax_backend"] == "cpu" for l in lines)
+    assert all(l["cpu_fallback"] is False for l in lines)  # cpu was pinned
 
 
 def test_moe_cached_decode_matches_full_forward():

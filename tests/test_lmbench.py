@@ -19,7 +19,8 @@ def test_lmbench_runs(capsys):
     config.DEFAULT_BATCH["single"]["tinylm"] = 2
     try:
         rc = main(["-m", "transformer_t", "-b", "tinylm", "--steps", "2",
-                   "--warmup", "1", "--dtype", "float32"])
+                   "--warmup", "1", "--dtype", "float32",
+                   "--platform", "cpu"])
     finally:
         del config.DATASETS["tinylm"]
         del config.DEFAULT_BATCH["single"]["tinylm"]
@@ -31,3 +32,7 @@ def test_lmbench_runs(capsys):
     for l in lines:
         assert l["tokens_per_sec"] > 0 and l["ms_per_step"] > 0
         assert l["seq_len"] == TINY_LM.seq_len
+        # provenance rides every row (distributed.backend_provenance): a
+        # cpu run must be identifiable as such, not read as a chip number
+        assert l["jax_backend"] == "cpu"
+        assert l["cpu_fallback"] is False  # tests pin cpu explicitly

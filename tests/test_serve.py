@@ -1,0 +1,461 @@
+"""Continuous-batching serving engine (serve/) coverage.
+
+The binding contract is the acceptance pin: tokens emitted by the serving
+engine for a request must EQUAL the standalone models/decode.py greedy
+stream for the same model and prompt — through chunked and unchunked
+admission, mixed batches, evictions (recompute), and replicas. Everything
+else (allocator invariants, packer behavior, goodput A/B) is scaffolding
+that keeps the scheduler honest.
+
+Tier-1 keeps the cheap pins (allocator/workload are pure host code; the
+engine pins use one tiny-model engine each); the mixed-workload and
+multi-config sweeps are slow-marked to protect the 870 s gate.
+"""
+
+import json
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+import jax  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+
+from tiny_models import TINY_LM, tiny_transformer  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.models.layers import init_model  # noqa: E402
+from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: E402
+from ddlbench_tpu.serve.workload import (ServeRequest,  # noqa: E402
+                                         make_workload)
+
+VOCAB = TINY_LM.num_classes
+T_MODEL = TINY_LM.seq_len  # 32
+
+
+@pytest.fixture(scope="module")
+def lm():
+    model = tiny_transformer()
+    params, state, _ = init_model(model, jax.random.key(0))
+    return model, params, state
+
+
+def _standalone_stream(lm, prompt, max_new):
+    """Oracle: the standalone KV-cached greedy continuation."""
+    import ddlbench_tpu.models.decode as dec
+
+    model, params, state = lm
+    total = prompt.shape[0] + max_new
+    out = dec.greedy_decode(model, params, state,
+                            jnp.asarray(prompt)[None], total)
+    return np.asarray(out)[0, prompt.shape[0]:]
+
+
+def _drain(engine_or_server, reqs=None, now=0.0):
+    """Submit ``reqs`` (arrival-ordered release) and run to completion.
+    Returns (final clock, list of StepReports)."""
+    reps = []
+    pend = sorted(reqs or [], key=lambda r: (r.arrival or 0.0, r.rid))
+    i = 0
+    while i < len(pend) or engine_or_server.has_work():
+        while i < len(pend) and (pend[i].arrival or 0.0) <= now:
+            engine_or_server.submit(pend[i])
+            i += 1
+        if not engine_or_server.has_work():
+            now = pend[i].arrival
+            continue
+        rep = engine_or_server.step(now)
+        reps.append(rep)
+        now += rep.cost
+    return now, reps
+
+
+# ---------------------------------------------------------------------------
+# Page allocator invariants (pure host code).
+# ---------------------------------------------------------------------------
+
+
+def test_allocator_roundtrip_and_occupancy():
+    al = PageAllocator(9)  # 8 usable + scratch
+    assert al.capacity == 8 and al.in_use == 0
+    a = al.alloc(rid=1, n=3)
+    b = al.alloc(rid=2, n=2)
+    assert 0 not in a + b  # scratch is never handed out
+    assert len(set(a + b)) == 5  # distinct slots
+    assert al.in_use == 5 and al.occupancy() == 5 / 8
+    assert al.free_request(1) == 3
+    assert al.in_use == 2
+    assert al.free_request(2) == 2
+    assert al.in_use == 0 and al.allocs == 5 and al.frees == 5
+
+
+def test_allocator_backpressure_and_reuse():
+    al = PageAllocator(5)  # 4 usable
+    got = al.alloc(rid=1, n=4)
+    assert got is not None
+    # exhaustion: all-or-nothing None, nothing leaks
+    assert al.alloc(rid=2, n=1) is None
+    assert al.in_use == 4
+    # freed pages are immediately reusable (eviction -> readmission path)
+    al.free_request(1)
+    again = al.alloc(rid=2, n=4)
+    assert again is not None and set(again) == set(got)
+    assert al.peak_in_use == 4
+
+
+def test_allocator_double_free_raises():
+    al = PageAllocator(4)
+    al.alloc(rid=7, n=1)
+    al.free_request(7)
+    with pytest.raises(ValueError, match="double free"):
+        al.free_request(7)
+    with pytest.raises(ValueError, match="double free"):
+        al.free_request(99)  # never allocated
+    with pytest.raises(ValueError):
+        al.alloc(rid=1, n=0)
+
+
+# ---------------------------------------------------------------------------
+# Load-generator determinism (the bitwise-repro discipline).
+# ---------------------------------------------------------------------------
+
+
+def _workload(seed, arrival="poisson"):
+    return make_workload(seed=seed, n_requests=32, vocab=VOCAB,
+                         arrival=arrival, rate=0.7, prompt_lo=2,
+                         prompt_typical=8, prompt_hi=24, out_lo=2,
+                         out_typical=8, out_hi=24, max_len=T_MODEL)
+
+
+@pytest.mark.parametrize("arrival", ["poisson", "bursty", "closed"])
+def test_workload_identical_seed_identical_traffic(arrival):
+    a = _workload(3, arrival)
+    b = _workload(3, arrival)
+    assert [r.arrival for r in a] == [r.arrival for r in b]
+    assert [r.max_new for r in a] == [r.max_new for r in b]
+    for x, y in zip(a, b):
+        np.testing.assert_array_equal(x.prompt, y.prompt)
+    if arrival == "closed":
+        assert all(r.arrival is None for r in a)
+    else:
+        assert all(r.arrival is not None for r in a)
+        assert [r.arrival for r in a] == sorted(r.arrival for r in a)
+
+
+def test_workload_seed_changes_traffic():
+    a, b = _workload(3), _workload(4)
+    assert ([r.prompt_len for r in a] != [r.prompt_len for r in b]
+            or [r.arrival for r in a] != [r.arrival for r in b])
+    # heavy tail actually present: some request well past the typical body
+    assert max(r.prompt_len for r in a) > 8
+
+
+def test_serve_config_validation():
+    with pytest.raises(ValueError, match="policy"):
+        ServeConfig(policy="adaptive").validate()
+    with pytest.raises(ValueError, match="multiple"):
+        ServeConfig(page=8, prefill_chunk=12).validate()
+    with pytest.raises(ValueError, match="cannot hold"):
+        ServeConfig(page=8, max_len=256, pool_pages=16).validate()
+    with pytest.raises(ValueError, match="starves"):
+        ServeConfig(page=8, max_len=64, pool_pages=16, prefill_chunk=16,
+                    token_budget=8).validate()
+    # negatives must fail validation, not crash the engine mid-run
+    # (-16 % 16 == 0 would pass the page-multiple check)
+    with pytest.raises(ValueError, match=">= 0"):
+        ServeConfig(page=16, prefill_chunk=-16,
+                    token_budget=100).validate()
+    with pytest.raises(ValueError, match=">= 0"):
+        ServeConfig(token_budget=-1).validate()
+    ServeConfig().validate()
+
+
+# ---------------------------------------------------------------------------
+# Engine pins (tiny model; shapes chosen to keep the jit cache small).
+# ---------------------------------------------------------------------------
+
+
+def test_chunked_serve_matches_standalone_and_packs(lm):
+    """The acceptance pin (chunked admission) + scheduler packing: steps
+    mix prefill chunks with decode, within the token budget."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=16,
+                      prefill_chunk=4, token_budget=10)
+    eng = ServeEngine(model, params, state, cfg)
+    rng = np.random.default_rng(11)
+    # staggered prompt lengths: r0 finishes prefill first and decodes
+    # while r1 is still prefilling -> a genuinely mixed step
+    prompts = [rng.integers(0, VOCAB, size=(3,)).astype(np.int32),
+               rng.integers(0, VOCAB, size=(9,)).astype(np.int32)]
+    reqs = [ServeRequest(rid=i, prompt=pr, max_new=4, arrival=0.0)
+            for i, pr in enumerate(prompts)]
+    _, reps = _drain(eng, reqs)
+
+    for i, f in enumerate(sorted(eng.finished, key=lambda f: f["rid"])):
+        np.testing.assert_array_equal(
+            np.array(f["tokens"]), _standalone_stream(lm, prompts[i], 4))
+    # the packer honored the budget every step and mixed at least once
+    C = cfg.resolved_prefill_chunk()
+    assert all(r.prefill_calls * C + r.decode_rows
+               <= cfg.resolved_token_budget() for r in reps)
+    assert any(r.prefill_calls > 0 and r.decode_rows > 0 for r in reps)
+    # cost model: one unit per model pass
+    assert all(r.cost == r.prefill_calls + (1 if r.decode_rows else 0)
+               for r in reps)
+    st = eng.stats_summary()
+    assert st["completed"] == 2 and st["evicted"] == 0
+    # pages were genuinely freed on completion
+    assert eng.allocator.in_use == 0
+
+
+def test_unchunked_serve_matches_standalone(lm):
+    """The acceptance pin, unchunked admission: the whole prompt in ONE
+    padded prefill call (prefill_chunk=0)."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    cfg = ServeConfig(max_batch=2, pool_pages=17, page=4, max_len=16,
+                      prefill_chunk=0)
+    eng = ServeEngine(model, params, state, cfg)
+    rng = np.random.default_rng(12)
+    prompt = rng.integers(0, VOCAB, size=(7,)).astype(np.int32)
+    eng.submit(ServeRequest(rid=0, prompt=prompt, max_new=5, arrival=0.0))
+    _drain(eng)
+    assert eng.stats_summary()["prefill_calls"] == 1  # one padded call
+    np.testing.assert_array_equal(np.array(eng.finished[0]["tokens"]),
+                                  _standalone_stream(lm, prompt, 5))
+
+
+@pytest.mark.slow
+def test_multipage_chunk_overflow_matches_standalone(lm):
+    """Regression pin: a multi-page prefill chunk whose padded tail runs
+    past the last table column must NOT clamp onto the request's own live
+    pages (paged_table_chunk_write scratch-extends the table). max_len 12
+    (3 pages), chunk 8 (2 pages): the last chunk of an 11-token prompt
+    starts at page 2 and its pad page overflows the table."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    cfg = ServeConfig(max_batch=1, pool_pages=5, page=4, max_len=12,
+                      prefill_chunk=8)
+    eng = ServeEngine(model, params, state, cfg)
+    rng = np.random.default_rng(14)
+    prompt = rng.integers(0, VOCAB, size=(11,)).astype(np.int32)
+    eng.submit(ServeRequest(rid=0, prompt=prompt, max_new=1, arrival=0.0))
+    _drain(eng)
+    np.testing.assert_array_equal(np.array(eng.finished[0]["tokens"]),
+                                  _standalone_stream(lm, prompt, 1))
+
+
+def test_static_policy_drains_before_refilling(lm):
+    """Regression pin: the static baseline must hold a drain BARRIER — once
+    any request of a fill phase completes, no admission may happen until
+    every row is free. Pre-fix, short-output traffic kept the fill phase
+    open forever (completions kept freeing rows with the queue nonempty)
+    and 'static' degenerated into budget-paced continuous admission."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    # one-chunk prompts, max_new=2, budget of 3 admissions/step against
+    # max_batch=4: the fill trickles, completions overlap the tail of it
+    cfg = ServeConfig(max_batch=4, pool_pages=17, page=4, max_len=16,
+                      prefill_chunk=4, token_budget=12, policy="static")
+    eng = ServeEngine(model, params, state, cfg)
+    rng = np.random.default_rng(15)
+    for i in range(8):
+        eng.submit(ServeRequest(
+            rid=i, prompt=rng.integers(0, VOCAB, size=(3,)).astype(np.int32),
+            max_new=2, arrival=0.0))
+    now, barrier_seen = 0.0, False
+    while eng.has_work():
+        active = any(a is not None for a in eng.rows)
+        free = any(a is None for a in eng.rows)
+        rep = eng.step(now)
+        now += rep.cost
+        # the barrier: rows free + queue waiting, but no admission because
+        # the current batch has not fully drained
+        if active and free and eng.queue and rep.admitted == 0:
+            barrier_seen = True
+    assert barrier_seen
+    assert len(eng.finished) == 8
+
+
+def _harsh_pool_run(lm, seed):
+    """10 Poisson requests through a 6-usable-page pool at page=2: constant
+    page-boundary crossings and evictions, with row reuse scrambling row
+    order vs admission order."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    reqs = make_workload(seed=seed, n_requests=10, vocab=VOCAB,
+                         arrival="poisson", rate=1.5, prompt_lo=1,
+                         prompt_typical=4, prompt_hi=8, out_lo=1,
+                         out_typical=5, out_hi=9, max_len=12, tail_frac=0.4)
+    cfg = ServeConfig(max_batch=4, pool_pages=7, page=2, max_len=12,
+                      prefill_chunk=2, token_budget=8)
+    eng = ServeEngine(model, params, state, cfg)
+    _drain(eng, reqs)
+    return eng, reqs
+
+
+@pytest.mark.slow
+def test_eviction_across_row_reuse_no_double_free(lm):
+    """Regression pin: a victim can sit at a LOWER row index than its
+    evictor (rows are reused, so row order diverges from admission order)
+    — the scheduler must drop rows evicted mid-scheduling instead of
+    running them dead (which decoded against a zeroed table row and
+    double-freed the victim's pages at its final token)."""
+    eng, reqs = _harsh_pool_run(lm, seed=4)  # this seed crashed pre-fix
+    assert len(eng.finished) == len(reqs)
+    assert eng.stats["evicted"] > 0
+    assert eng.allocator.in_use == 0
+
+
+@pytest.mark.slow
+def test_harsh_pool_streams_match_standalone(lm):
+    """The harsh-pool run's streams still equal the standalone greedy
+    continuation — eviction/recompute under row reuse is numerics-clean."""
+    eng, reqs = _harsh_pool_run(lm, seed=4)
+    by_rid = {r.rid: r for r in reqs}
+    for f in eng.finished:
+        rq = by_rid[f["rid"]]
+        np.testing.assert_array_equal(
+            np.array(f["tokens"]),
+            _standalone_stream(lm, rq.prompt, rq.max_new))
+
+
+@pytest.mark.slow
+def test_eviction_recompute_matches_standalone(lm):
+    """Pool exhaustion evicts the newest request; recomputation after
+    readmission regenerates the same stream (greedy determinism), and the
+    freed pages were genuinely reusable."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    # 8 usable pages, two requests needing ~6 pages each at full length:
+    # the second must be evicted at least once
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=24,
+                      prefill_chunk=4)
+    eng = ServeEngine(model, params, state, cfg)
+    rng = np.random.default_rng(13)
+    prompts = [rng.integers(0, VOCAB, size=(9,)).astype(np.int32),
+               rng.integers(0, VOCAB, size=(9,)).astype(np.int32)]
+    reqs = [ServeRequest(rid=i, prompt=pr, max_new=12, arrival=0.0)
+            for i, pr in enumerate(prompts)]
+    _, reps = _drain(eng, reqs)
+    assert sum(r.evicted for r in reps) > 0
+    assert len(eng.finished) == 2
+    for f in eng.finished:
+        np.testing.assert_array_equal(
+            np.array(f["tokens"]),
+            _standalone_stream(lm, prompts[f["rid"]], 12))
+    assert eng.allocator.in_use == 0
+
+
+@pytest.mark.slow
+def test_mixed_open_loop_workload_matches_standalone(lm):
+    """Poisson arrivals, heavy-tail lengths, an undersized pool (evictions
+    + backpressure), staggered admission — every completed stream still
+    equals its standalone greedy continuation."""
+    from ddlbench_tpu.serve.engine import ServeEngine
+
+    model, params, state = lm
+    reqs = make_workload(seed=3, n_requests=8, vocab=VOCAB,
+                         arrival="poisson", rate=0.5, prompt_lo=2,
+                         prompt_typical=6, prompt_hi=14, out_lo=2,
+                         out_typical=6, out_hi=12, max_len=28)
+    cfg = ServeConfig(max_batch=4, pool_pages=9, page=4, max_len=28,
+                      prefill_chunk=4)
+    eng = ServeEngine(model, params, state, cfg)
+    _, reps = _drain(eng, reqs)
+    assert len(eng.finished) == len(reqs)
+    by_rid = {r.rid: r for r in reqs}
+    for f in eng.finished:
+        np.testing.assert_array_equal(
+            np.array(f["tokens"]),
+            _standalone_stream(lm, by_rid[f["rid"]].prompt,
+                               by_rid[f["rid"]].max_new))
+
+
+@pytest.mark.slow
+def test_replicated_server_matches_standalone(lm):
+    """Least-loaded dispatch over 2 replicas: same streams, work spread
+    across both engines."""
+    from ddlbench_tpu.serve.engine import make_server
+
+    model, params, state = lm
+    reqs = make_workload(seed=9, n_requests=6, vocab=VOCAB,
+                         arrival="closed", prompt_lo=2, prompt_typical=6,
+                         prompt_hi=10, out_lo=2, out_typical=5, out_hi=8,
+                         max_len=16)
+    for r in reqs:
+        r.arrival = 0.0
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=16,
+                      prefill_chunk=4, replicas=2)
+    srv = make_server(model, params, state, cfg)
+    _drain(srv, reqs)
+    assert len(srv.finished) == len(reqs)
+    assert all(e.stats["admitted"] > 0 for e in srv.engines)
+    by_rid = {r.rid: r for r in reqs}
+    for f in srv.finished:
+        np.testing.assert_array_equal(
+            np.array(f["tokens"]),
+            _standalone_stream(lm, by_rid[f["rid"]].prompt,
+                               by_rid[f["rid"]].max_new))
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: servebench on CPU — continuous > static goodput, bitwise repro.
+# ---------------------------------------------------------------------------
+
+SERVEBENCH_ARGS = [
+    "-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+    "--concurrency", "4", "--requests", "8", "--max-batch", "2",
+    "--pool-pages", "9", "--page", "4", "--max-len", "16",
+    "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+    "--slo-ttft", "8", "--slo-itl", "2.5", "--seed", "5",
+    "--platform", "cpu",
+]
+
+
+def _run_servebench(capsys, extra=()):
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servebench
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched):
+        rc = servebench.main(SERVEBENCH_ARGS + list(extra))
+    assert rc == 0
+    out = capsys.readouterr().out
+    return [l for l in out.splitlines() if l.startswith("{")]
+
+
+def test_servebench_continuous_beats_static_and_reproduces(capsys):
+    """The acceptance A/B: at equal pool size, continuous batching wins
+    goodput-under-SLO strictly on a mixed-length workload, and the whole
+    JSON is bitwise-reproducible under the fixed seed."""
+    lines = _run_servebench(capsys)
+    rows = {json.loads(l)["policy"]: json.loads(l) for l in lines}
+    cont, stat = rows["continuous"], rows["static"]
+    assert cont["completed"] == stat["completed"] == 8
+    assert cont["goodput_tokens_per_unit"] > stat["goodput_tokens_per_unit"]
+    assert cont["duration"] <= stat["duration"]
+    assert cont["ttft_p95"] <= stat["ttft_p95"]
+    for row in rows.values():
+        assert row["time_unit"] == "model_pass"
+        assert row["jax_backend"] == "cpu"
+        assert row["cpu_fallback"] is False
+        assert row["output_tokens"] > 0
+        assert 0.0 <= row["slo_attainment"] <= 1.0
+        assert row["itl_p50"] <= row["itl_p99"]
+
+    # bitwise reproducibility: identical seed => identical JSON (the
+    # repro run re-executes one policy to keep the tier-1 budget)
+    again = _run_servebench(capsys, extra=("--policies", "continuous"))
+    assert again == lines[:1]
