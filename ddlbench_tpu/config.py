@@ -938,6 +938,19 @@ class ServeConfig:
     prefill_chunk: int = 16
     policy: str = "continuous"  # "continuous" | "static" (the A/B baseline)
     replicas: int = 1  # data-parallel serving replicas (mesh 'data' axis)
+    # cross-request prefix cache (serve/prefix.py): admissions bind the
+    # already-resident immutable KV pages of their longest cached prefix
+    # and chunk-prefill only the uncached tail. Continuous policy only —
+    # the static baseline measures cache-off scheduling by definition.
+    prefix_cache: bool = False
+    # sampling (0.0 = greedy argmax, the default — all greedy pins are
+    # bitwise untouched). temperature > 0 samples from softmax(logits / T)
+    # on the host with counter-based per-request seeds (fold sample_seed +
+    # request id + token index), so streams are bitwise-reproducible per
+    # seed and eviction/recompute regenerates identical tokens.
+    temperature: float = 0.0
+    top_k: int = 0  # 0 = full vocab; > 0 restricts sampling to the k best
+    sample_seed: int = 0
 
     def npg_max(self) -> int:
         return -(-self.max_len // self.page)
@@ -978,6 +991,21 @@ class ServeConfig:
                 "token_budget below one prefill chunk starves admission "
                 f"({self.resolved_token_budget()} < "
                 f"{self.resolved_prefill_chunk()})")
+        if self.prefix_cache and self.policy != "continuous":
+            raise ValueError(
+                "prefix_cache requires the continuous policy — the static "
+                "baseline measures cache-off scheduling (run it cache-off)")
+        if self.temperature < 0.0:
+            raise ValueError(
+                f"temperature must be >= 0 (0 = greedy), got "
+                f"{self.temperature}")
+        if self.top_k < 0:
+            raise ValueError(f"top_k must be >= 0 (0 = full vocab), got "
+                             f"{self.top_k}")
+        if self.top_k and self.temperature == 0.0:
+            raise ValueError(
+                "top_k without temperature has no sampling to restrict "
+                "(greedy already takes the argmax)")
 
     def replace(self, **kw: Any) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

@@ -404,6 +404,18 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
 # unchanged. Pool slot 0 is reserved as the SCRATCH page by convention:
 # inactive rows' table entries point at it, so their masked writes land
 # somewhere harmless instead of clobbering a live request's history.
+#
+# Write/refcount contract under CROSS-REQUEST PREFIX SHARING
+# (serve/prefix.py): a slot may appear in MULTIPLE table rows at once —
+# refcounted by the host allocator — and a shared slot is IMMUTABLE: the
+# engine only ever binds fully-prefilled prompt pages (positions the
+# request never writes again, since positions only grow), and any path
+# that would write into a bound page (the full-hit fast path re-deriving
+# the last prompt position through the decode program) must
+# ``serve_page_copy`` it into a private slot first. ``paged_table_write``
+# / ``paged_table_chunk_write`` therefore assume the table entries they
+# resolve are PRIVATE to (or scratch for) their row; keeping that true is
+# the allocator's refcount discipline, not a device-side check.
 # ---------------------------------------------------------------------------
 
 SCRATCH_SLOT = 0
@@ -467,14 +479,28 @@ def paged_table_chunk_write(cache, k, v, start, page: int | None = None):
             "pool_v": write(cache["pool_v"], v)}
 
 
-def paged_chunk_attention(q, cache, start, npages_live: int,
-                          page: int | None = None):
-    """Causal attention of chunk queries q [rows, H, C, dh] at absolute
-    positions ``start + [0, C)`` against the live pages (which must already
-    contain the chunk's own K/V — write first, then attend, exactly like
-    the single-token path). jnp/XLA path only: serving prefill chunks are
-    ordinary dense attention over a gathered [rows, L, H, dh] view, which
-    XLA fuses well; the Pallas flash-decode kernel is single-query."""
+def serve_page_copy(pool, src, dst):
+    """Copy-on-write: physically copy pool slot ``src`` into slot ``dst``
+    ({pool_k, pool_v} or any same-shaped pool dict; ``src``/``dst`` may be
+    traced scalars, so ONE compiled program serves every copy).
+
+    This is the serving analog of ``paged_reorder``'s partial-page copy:
+    the prefix cache binds immutable shared pages into a new request's
+    table row, and before the engine ever writes INTO a shared page (the
+    full-hit fast path re-derives the last prompt position's K/V through
+    the decode program) it must copy the page into a private slot — the
+    two token streams would otherwise couple through last-ulp drift
+    between the chunked and single-token K/V computations."""
+    return {k: v.at[dst].set(v[src]) for k, v in pool.items()}
+
+
+def _paged_chunk_attention_ref(q, cache, start, npages_live: int,
+                               page: int | None = None):
+    """jnp/XLA oracle for chunk-prefill attention: gather the live pages,
+    mask causally at absolute positions, softmax. [rows, H, C, dh].
+    Serving prefill chunks are ordinary dense attention over a gathered
+    [rows, L, H, dh] view, which XLA fuses well — this is the CPU path
+    and the numerics reference the Pallas kernel is pinned against."""
     page = page or PAGE
     rows, H, C, dh = q.shape
     tbl = cache["table"][:, :npages_live]
@@ -484,9 +510,124 @@ def paged_chunk_attention(q, cache, start, npages_live: int,
     vc = (cache["pool_v"][tbl].reshape(rows, L, H, dh)
           .astype(q.dtype).transpose(0, 2, 1, 3))
     scores = jnp.einsum("rhqd,rhkd->rhqk", q, kc) / math.sqrt(dh)
-    q_pos = start + jnp.arange(C)
+    start = jnp.asarray(start, jnp.int32).reshape(-1)  # scalar or [rows]
+    q_pos = start[:, None] + jnp.arange(C)[None, :]  # [rows or 1, C]
     k_pos = jnp.arange(L)
-    ok = k_pos[None, None, None, :] <= q_pos[None, None, :, None]
+    ok = k_pos[None, None, None, :] <= q_pos[:, None, :, None]
     scores = jnp.where(ok, scores, -jnp.inf)
     probs = jax.nn.softmax(scores.astype(jnp.float32), -1).astype(q.dtype)
     return jnp.einsum("rhqk,rhkd->rhqd", probs, vc)
+
+
+def _paged_chunk_attn_kernel(table_ref, s_ref, q_ref, pk_ref, pv_ref, o_ref,
+                             m_sc, l_sc, acc_sc, *, scale, page, npages,
+                             elementwise):
+    """Multi-query analog of ``_paged_attn_kernel``: one grid step attends
+    ALL C chunk queries of row r against one live page j, accumulating an
+    online softmax per (head, query). The causal mask is absolute — query
+    c sits at stream position ``start_r + c`` (``s_ref`` is the per-row
+    chunk start the scheduler prefetches) — so within-chunk causality and
+    full visibility of earlier pages fall out of one comparison."""
+    r, j = pl.program_id(0), pl.program_id(1)
+
+    @pl.when(j == 0)
+    def _init():
+        m_sc[:] = jnp.full(m_sc.shape, NEG_INF, jnp.float32)
+        l_sc[:] = jnp.zeros(l_sc.shape, jnp.float32)
+        acc_sc[:] = jnp.zeros(acc_sc.shape, jnp.float32)
+
+    q = q_ref[0].astype(jnp.float32)  # [H, C, dh]
+    k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
+    v = pv_ref[0].astype(jnp.float32)
+    if elementwise:
+        # s[h, c, p] = sum_d q[h, c, d] * k[p, h, d]
+        s = jnp.sum(q[:, :, None, :] * k.transpose(1, 0, 2)[:, None, :, :],
+                    axis=3) * scale  # [H, C, page]
+    else:
+        s = jax.lax.dot_general(  # contract dh per head (batched over H)
+            q, k, (((2,), (2,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        ) * scale
+    k_pos = j * page + jax.lax.broadcasted_iota(jnp.int32, s.shape, 2)
+    q_pos = s_ref[r] + jax.lax.broadcasted_iota(jnp.int32, s.shape, 1)
+    s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+
+    m_prev, l_prev, acc_prev = m_sc[:], l_sc[:], acc_sc[:]
+    m_new = jnp.maximum(m_prev, jnp.max(s, axis=2))  # [H, C]
+    alpha = jnp.exp(m_prev - m_new)
+    p_blk = jnp.exp(s - m_new[:, :, None])  # [H, C, page]
+    l_new = alpha * l_prev + jnp.sum(p_blk, axis=2)
+    if elementwise:
+        # pv[h, c, d] = sum_p p[h, c, p] * v[p, h, d]
+        pv = jnp.sum(p_blk[:, :, :, None]
+                     * v.transpose(1, 0, 2)[:, None, :, :], axis=2)
+    else:
+        pv = jax.lax.dot_general(
+            p_blk, v, (((2,), (0,)), ((0,), (1,))),
+            preferred_element_type=jnp.float32,
+        )  # [H, C, dh]
+    m_sc[:], l_sc[:] = m_new, l_new
+    acc_sc[:] = acc_prev * alpha[:, :, None] + pv
+
+    @pl.when(j == npages - 1)
+    def _fini():
+        l_safe = jnp.maximum(l_sc[:], 1e-20)
+        o_ref[0] = (acc_sc[:] / l_safe[:, :, None]).astype(o_ref.dtype)
+
+
+def paged_chunk_attention(q, cache, start, npages_live: int,
+                          page: int | None = None, interpret: bool = False,
+                          use_kernel: bool | None = None,
+                          kernel_style: str | None = None):
+    """Causal attention of chunk queries q [rows, H, C, dh] at absolute
+    positions ``start + [0, C)`` against the live pages (which must already
+    contain the chunk's own K/V — write first, then attend, exactly like
+    the single-token path). ``start`` is a dynamic scalar or a per-row
+    [rows] vector (each serving row is its own request at its own chunk
+    start). ``use_kernel=None`` picks the Pallas kernel on TPU — the
+    multi-query analog of the flash-decode kernel, replacing the
+    gathered-page XLA einsum on the chunk-prefill hot path — and the jnp
+    reference elsewhere. ``kernel_style`` as in :func:`paged_attention`."""
+    from ddlbench_tpu.distributed import is_tpu_backend
+
+    assert kernel_style in (None, "dots", "elementwise"), kernel_style
+    page = page or PAGE
+    if use_kernel is None:
+        use_kernel = is_tpu_backend()
+    if not (use_kernel or interpret):
+        return _paged_chunk_attention_ref(q, cache, start, npages_live, page)
+
+    from jax.experimental.pallas import tpu as pltpu
+
+    rows, H, C, dh = q.shape
+    scale = 1.0 / math.sqrt(dh)
+    tbl = cache["table"][:, :npages_live]
+    s32 = jnp.broadcast_to(jnp.asarray(start, jnp.int32).reshape(-1), (rows,))
+
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,  # table, per-row chunk start
+        grid=(rows, npages_live),
+        in_specs=[
+            pl.BlockSpec((1, H, C, dh), lambda r, j, tab, s: (r, 0, 0, 0)),
+            pl.BlockSpec((1, page, H, dh),
+                         lambda r, j, tab, s: (tab[r, j], 0, 0, 0)),
+            pl.BlockSpec((1, page, H, dh),
+                         lambda r, j, tab, s: (tab[r, j], 0, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, H, C, dh),
+                               lambda r, j, tab, s: (r, 0, 0, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((H, C), jnp.float32),
+            pltpu.VMEM((H, C), jnp.float32),
+            pltpu.VMEM((H, C, dh), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _paged_chunk_attn_kernel, scale=scale, page=page,
+            npages=npages_live,
+            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise"),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((rows, H, C, dh), q.dtype),
+        interpret=interpret,
+    )(tbl, s32, q, cache["pool_k"], cache["pool_v"])

@@ -2,19 +2,22 @@
 
 The serving-side counterpart of the training benchmark: a scheduler that
 packs chunked prefill next to in-flight decode under a token budget
-(:mod:`serve.engine`), a free-list page allocator over the shared KV pool
-(:mod:`serve.allocator`), deterministic open/closed-loop traffic
+(:mod:`serve.engine`), a refcounted free-list page allocator over the
+shared KV pool (:mod:`serve.allocator`), a cross-request prefix cache over
+page-aligned prompt blocks (:mod:`serve.prefix`), deterministic
+open/closed-loop traffic incl. shared-prefix groups
 (:mod:`serve.workload`), and — through ``tools/servebench.py`` — TTFT /
 inter-token-latency percentiles and goodput-under-SLO reporting.
 
-Import discipline: :mod:`serve.allocator` and :mod:`serve.workload` are
-jax-free (numpy + stdlib), so workload synthesis and allocation logic are
-importable from jax-free hosts; the engine (which traces models) is
-imported lazily via PEP 562 — the same laziness train/__init__ applies for
-the chaosbench supervisor.
+Import discipline: :mod:`serve.allocator`, :mod:`serve.prefix` and
+:mod:`serve.workload` are jax-free (numpy + stdlib), so workload synthesis
+and allocation logic are importable from jax-free hosts; the engine (which
+traces models) is imported lazily via PEP 562 — the same laziness
+train/__init__ applies for the chaosbench supervisor.
 """
 
 from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: F401
+from ddlbench_tpu.serve.prefix import PrefixIndex  # noqa: F401
 from ddlbench_tpu.serve.workload import (  # noqa: F401
     ServeRequest,
     make_workload,

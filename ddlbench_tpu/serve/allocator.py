@@ -1,4 +1,4 @@
-"""Free-list page allocator for the shared serving KV pool.
+"""Refcounted free-list page allocator for the shared serving KV pool.
 
 The beam-search paged cache (ops/paged_decode.py) statically stripes the
 pool: row r owns slots ``r * n_pages + [0, n_pages)`` forever. A serving
@@ -9,6 +9,24 @@ the host-side free list that turns the pool into per-request page-granular
 memory: requests allocate pages as their streams grow, free them all on
 completion or eviction, and admission backpressure falls out of
 ``alloc`` returning ``None``.
+
+Cross-request PREFIX CACHING (serve/prefix.py) adds shared ownership: one
+pool slot may hold the KV of a prompt prefix that several requests (and the
+prefix index itself) reference at once. Ownership is therefore a REFCOUNT
+per slot:
+
+* ``alloc`` hands out fresh slots at refcount 1 (private to the request);
+* ``bind`` lets a request take a reference on already-resident slots (the
+  prefix-cache hit path) — shared slots are immutable by the engine's
+  write discipline (ops/paged_decode.py shared-pool contract);
+* ``free_request``/``decref`` drop references; the slot returns to the
+  free list only when the LAST reference drops, so freeing a request whose
+  prefix is shared never yanks pages out from under its siblings;
+* the prefix index holds its own reference (``incref``) on every page it
+  caches, which is what keeps a completed request's prompt pages resident
+  for future hits — reclaiming the cache (eviction under pool pressure)
+  only ever takes pages whose sole remaining reference IS the cache, i.e.
+  pages no live request holds.
 
 All decisions are plain Python on the host (the device only ever sees the
 resulting page TABLE as an int32 input), so allocation order — and with it
@@ -30,7 +48,8 @@ SCRATCH_SLOT = 0
 
 
 class PageAllocator:
-    """All-or-nothing page allocation with exact occupancy accounting."""
+    """All-or-nothing page allocation with per-slot refcounts and exact
+    occupancy accounting."""
 
     def __init__(self, n_pages: int):
         if n_pages < 2:
@@ -42,6 +61,7 @@ class PageAllocator:
         # determinism, which they guarantee
         self._free: List[int] = [s for s in range(self.n_pages - 1, 0, -1)]
         self._owned: Dict[int, List[int]] = {}  # rid -> slots, alloc order
+        self._ref: Dict[int, int] = {}  # slot -> refcount (live slots only)
         self.allocs = 0
         self.frees = 0
         self.peak_in_use = 0
@@ -55,17 +75,32 @@ class PageAllocator:
     def in_use(self) -> int:
         return self.capacity - len(self._free)
 
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def shared_pages(self) -> int:
+        """Slots referenced more than once right now (cross-request prefix
+        sharing; the cache's own reference counts, so a cached page bound
+        by one live request shows as shared)."""
+        return sum(1 for c in self._ref.values() if c >= 2)
+
     def occupancy(self) -> float:
         return self.in_use / self.capacity
 
     def owned(self, rid: int) -> List[int]:
         return list(self._owned.get(rid, ()))
 
-    def alloc(self, rid: int, n: int = 1) -> Optional[List[int]]:
-        """Allocate ``n`` pages for request ``rid``; all-or-nothing.
+    def refcount(self, slot: int) -> int:
+        return self._ref.get(slot, 0)
 
-        Returns the slot list, or None when the pool cannot supply ``n``
-        pages (admission/step backpressure — nothing is allocated).
+    def alloc(self, rid: int, n: int = 1) -> Optional[List[int]]:
+        """Allocate ``n`` fresh pages for request ``rid``; all-or-nothing.
+
+        Returns the slot list (each at refcount 1), or None when the pool
+        cannot supply ``n`` pages (admission/step backpressure — nothing
+        is allocated).
         """
         if n <= 0:
             raise ValueError(f"alloc n must be positive, got {n}")
@@ -74,12 +109,49 @@ class PageAllocator:
         slots = [self._free.pop() for _ in range(n)]
         assert SCRATCH_SLOT not in slots
         self._owned.setdefault(rid, []).extend(slots)
+        for s in slots:
+            self._ref[s] = 1
         self.allocs += n
         self.peak_in_use = max(self.peak_in_use, self.in_use)
         return slots
 
+    def bind(self, rid: int, slots: List[int]) -> None:
+        """Take a reference on already-resident ``slots`` for request
+        ``rid`` (the prefix-cache hit path). Binding a dead slot is a
+        bookkeeping bug and raises."""
+        for s in slots:
+            if self._ref.get(s, 0) < 1:
+                raise ValueError(f"bind of dead slot {s} for request {rid}")
+        self._owned.setdefault(rid, []).extend(slots)
+        for s in slots:
+            self._ref[s] += 1
+
+    def incref(self, slot: int) -> None:
+        """Extra reference on a live slot (the prefix index pinning a page
+        it caches — request-side references go through ``bind``)."""
+        if self._ref.get(slot, 0) < 1:
+            raise ValueError(f"incref of dead slot {slot}")
+        self._ref[slot] += 1
+
+    def decref(self, slot: int) -> bool:
+        """Drop one reference; returns True when the slot actually
+        returned to the free list (last reference dropped). Dropping a
+        reference a holder does not have is a double-free and raises."""
+        c = self._ref.get(slot, 0)
+        if c < 1:
+            raise ValueError(f"double free: slot {slot} has no references")
+        if c == 1:
+            del self._ref[slot]
+            self._free.append(slot)
+            self.frees += 1
+            return True
+        self._ref[slot] = c - 1
+        return False
+
     def free_request(self, rid: int) -> int:
-        """Free every page owned by ``rid`` (completion or eviction).
+        """Drop ``rid``'s reference on every page it holds (completion or
+        eviction). Returns how many pages physically returned to the free
+        list — shared pages survive until their last holder lets go.
 
         Freeing a request that owns nothing is a double-free — the engine
         frees exactly once per retirement — and raises.
@@ -87,6 +159,4 @@ class PageAllocator:
         slots = self._owned.pop(rid, None)
         if slots is None:
             raise ValueError(f"double free: request {rid} owns no pages")
-        self._free.extend(slots)
-        self.frees += len(slots)
-        return len(slots)
+        return sum(1 for s in slots if self.decref(s))

@@ -25,11 +25,30 @@ Structure (host schedules, device computes):
   live-page count ``npl`` — the one-page-segment static-shape idiom of
   models/decode.py — so the jit cache is bounded by ``max_len / page``
   variants regardless of traffic.
+* Cross-request PREFIX CACHING (``cfg.prefix_cache``; serve/prefix.py):
+  fully-prefilled prompt pages are registered in a host-side prefix index
+  as they complete, and a newly admitted request BINDS the already-
+  resident pages of its longest cached prefix into its table row
+  (allocator refcounts) instead of re-prefilling them — only the uncached
+  tail is chunk-prefilled. A full page-aligned hit skips prefill entirely:
+  the last cached page is copy-on-write copied into a private slot
+  (ops/paged_decode.serve_page_copy — shared pages are immutable) and the
+  request enters decode directly, re-deriving the last prompt position's
+  K/V and first-token logits through the decode program.
+* Sampling (``cfg.temperature``): greedy argmax stays the default and its
+  compiled programs are bitwise-untouched; with temperature > 0 the
+  programs return logits and the host samples with counter-based
+  per-request seeds (fold of sample_seed + request id + token index — no
+  wall-clock nondeterminism), so streams are bitwise-reproducible per
+  seed and eviction/recompute regenerates identical tokens.
 * Eviction closes the loop on pool exhaustion: when a growing request
-  needs a page and the free list is empty, the NEWEST-admitted request is
-  evicted (pages freed immediately, request re-queued at the front for
-  recomputation — greedy decode regenerates the same tokens), so the
-  oldest requests always make progress and livelock is impossible.
+  needs a page and the free list is empty, the engine first RECLAIMS
+  prefix-cache pages no live request references (newest-registered
+  first), then evicts the NEWEST-admitted request (its refs dropped —
+  shared pages survive for their other holders — request re-queued at
+  the front for recomputation, which the seeded sampling/greedy streams
+  regenerate identically), so the oldest requests always make progress
+  and livelock is impossible.
 * ``policy="static"`` is the built-in A/B baseline: requests are admitted
   only when every row is free (whole-batch fill), with full worst-case
   page reservation, and the batch drains to completion before the next is
@@ -51,6 +70,7 @@ in lockstep; a global step costs the maximum over replica step costs.
 from __future__ import annotations
 
 import dataclasses
+import random
 from collections import deque
 from typing import Any, Dict, List, Optional
 
@@ -59,7 +79,32 @@ import numpy as np
 from ddlbench_tpu.config import ServeConfig
 from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.serve.allocator import PageAllocator
+from ddlbench_tpu.serve.prefix import PrefixIndex
 from ddlbench_tpu.serve.workload import ServeRequest
+
+
+def sample_token(logits: np.ndarray, temperature: float, top_k: int,
+                 sample_seed: int, rid: int, token_index: int) -> int:
+    """Temperature/top-k sampling with a counter-based seed: one uniform
+    from ``random.Random(f"{sample_seed}:{rid}:{token_index}")`` (CPython
+    seeds strings through SHA-512 — stable by language guarantee),
+    inverse-transformed over the f64 softmax CDF. Keyed by TOKEN INDEX,
+    not engine step, so eviction/recompute re-draws the identical stream.
+    Pure host arithmetic — deterministic given the logits bytes."""
+    scaled = logits.astype(np.float64) / temperature
+    if top_k:
+        # ties broken by vocab index (stable sort) — deterministic
+        order = np.argsort(-scaled, kind="stable")
+        mask = np.full_like(scaled, -np.inf)
+        keep = order[:top_k]
+        mask[keep] = scaled[keep]
+        scaled = mask
+    scaled -= scaled.max()
+    probs = np.exp(scaled)
+    probs /= probs.sum()
+    u = random.Random(f"{sample_seed}:{rid}:{token_index}").random()
+    idx = int(np.searchsorted(np.cumsum(probs), u, side="right"))
+    return min(idx, len(probs) - 1)
 
 
 def supports_serve(model: LayerModel) -> bool:
@@ -86,6 +131,9 @@ class _Active:
     state: str = "prefill"  # "prefill" -> "decode"
     prefill_done: int = 0  # prompt positions already processed
     n_pages: int = 0  # table[row, :n_pages] hold this request's slots
+    # prompt blocks already in the prefix index (bound blocks at admission,
+    # then private blocks registered as their prefill completes)
+    registered_blocks: int = 0
     pending_tok: int = -1  # next decode input token (= last emitted)
     first_token_t: Optional[float] = None
     out: List[int] = dataclasses.field(default_factory=list)
@@ -107,6 +155,7 @@ class StepReport:
     admitted: int = 0
     evicted: int = 0
     backpressure: int = 0
+    prefix_hits: int = 0  # admissions that bound >= 1 cached prefix page
     completed: List[int] = dataclasses.field(default_factory=list)
 
     def merge(self, other: "StepReport") -> None:
@@ -116,6 +165,7 @@ class StepReport:
         self.admitted += other.admitted
         self.evicted += other.evicted
         self.backpressure += other.backpressure
+        self.prefix_hits += other.prefix_hits
         self.completed.extend(other.completed)
 
 
@@ -150,29 +200,41 @@ class ServeEngine:
         ])
         self.table = np.zeros((cfg.max_batch, self.npg_max), np.int32)
         self.allocator = PageAllocator(cfg.pool_pages)
+        self.prefix: Optional[PrefixIndex] = (
+            PrefixIndex(self.allocator, self.page)
+            if cfg.prefix_cache else None)
+        self._sampling = cfg.temperature > 0.0
         self.queue: deque = deque()
         self.rows: List[Optional[_Active]] = [None] * cfg.max_batch
         self.finished: List[Dict[str, Any]] = []
         self._admit_seq = 0
         self._filling = False  # static policy: whole-batch fill phase
+        # prompt tokens served from the cache per request, accumulated
+        # across re-admissions (eviction/recompute) — attached to the
+        # finished record for telemetry/stats.serve_summary
+        self._cached_tokens: Dict[int, int] = {}
         self.stats: Dict[str, float] = {
             "steps": 0, "model_calls": 0, "prefill_calls": 0,
             "decode_calls": 0, "decode_row_slots": 0, "admitted": 0,
             "completed": 0, "evicted": 0, "backpressure": 0,
             "peak_occupancy": 0.0, "frag_sum": 0.0, "frag_samples": 0,
+            # prefix-cache counters (always present — cache-off and the
+            # static baseline report 0, keeping the JSON schema stable)
+            "prefix_hits": 0, "prefix_tokens_saved": 0, "cow_copies": 0,
+            "shared_pages": 0, "prefill_tokens": 0,
         }
         if shared_fns is not None:
             # replicas of one server share the jitted callables (same model
             # and shapes), so same-device replicas share the compile cache
             # instead of re-tracing every npl variant per engine
-            self._decode_jit, self._prefill_jit = shared_fns
+            self._decode_jit, self._prefill_jit, self._cow_jit = shared_fns
         else:
             self._make_fns()
 
     def jit_fns(self):
-        """The (decode, prefill) jitted callables, shareable with sibling
-        replicas built from the same model/config."""
-        return self._decode_jit, self._prefill_jit
+        """The (decode, prefill, cow) jitted callables, shareable with
+        sibling replicas built from the same model/config."""
+        return self._decode_jit, self._prefill_jit, self._cow_jit
 
     # -- jitted model programs ---------------------------------------------
 
@@ -194,9 +256,13 @@ class ServeEngine:
                 out_pools.append(pool)
             return h, out_pools
 
+        sampling = self._sampling
+
         def decode_fn(params, states, pools, table, toks, pos, npl):
             logits, pools = walk(params, states, pools, table, toks,
                                  "decode", pos, npl, page)
+            if sampling:  # host samples; greedy keeps the on-device argmax
+                return logits[:, 0, :].astype(jnp.float32), pools
             nxt = jnp.argmax(logits[:, 0, :], axis=-1).astype(jnp.int32)
             return nxt, pools
 
@@ -219,13 +285,48 @@ class ServeEngine:
             for layer, p, s in zip(layers[n_body:], params[n_body:],
                                    states[n_body:]):
                 h, _ = layer.apply(p, s, h, False)
+            if sampling:
+                return h[0, 0, :].astype(jnp.float32), \
+                    out_pools + list(pools[n_body:])
             nxt = jnp.argmax(h[0, 0, :], axis=-1).astype(jnp.int32)
             return nxt, out_pools + list(pools[n_body:])
+
+        def cow_fn(pools, src, dst):
+            # prefix-cache copy-on-write: clone pool slot src into the
+            # request's private slot dst in every layer's pool (one traced
+            # program — src/dst are dynamic scalars)
+            from ddlbench_tpu.ops.paged_decode import serve_page_copy
+
+            return [serve_page_copy(pool, src, dst)
+                    if pool is not None else None for pool in pools]
 
         self._decode_jit = jax.jit(decode_fn, static_argnums=(6,),
                                    donate_argnums=(2,))
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(7,),
                                     donate_argnums=(2,))
+        self._cow_jit = jax.jit(cow_fn, donate_argnums=(0,))
+
+    def _emit_token(self, raw, rid: int, token_index: int) -> int:
+        """One emitted token from a program output: the argmax'd int32 in
+        greedy mode, a host-sampled draw from the logits otherwise."""
+        if self._sampling:
+            return sample_token(np.asarray(raw), self.cfg.temperature,
+                                self.cfg.top_k, self.cfg.sample_seed,
+                                rid, token_index)
+        return int(raw)
+
+    # -- allocation under pool pressure ------------------------------------
+
+    def _alloc(self, rid: int, n: int) -> Optional[List[int]]:
+        """``allocator.alloc`` preceded, on exhaustion, by reclaiming
+        prefix-cache pages no live request references (newest-registered
+        first) — cached-but-unbound pages are free capacity, and spending
+        them beats evicting a live request."""
+        slots = self.allocator.alloc(rid, n)
+        if slots is None and self.prefix is not None:
+            self.prefix.reclaim(n - self.allocator.free_pages)
+            slots = self.allocator.alloc(rid, n)
+        return slots
 
     # -- request lifecycle -------------------------------------------------
 
@@ -312,6 +413,9 @@ class ServeEngine:
             "first_token_t": a.first_token_t,
             "token_times": list(a.token_times),
             "completed_t": t,
+            # prompt tokens served from the prefix cache (all admissions
+            # of this request — telemetry/stats.serve_summary aggregates)
+            "cached_tokens": self._cached_tokens.pop(a.req.rid, 0),
         })
         rep.completed.append(a.req.rid)
         self.stats["completed"] += 1
@@ -330,7 +434,7 @@ class ServeEngine:
             pgi = a.decode_pos // self.page
             alive = True
             while pgi >= a.n_pages:
-                slots = self.allocator.alloc(a.req.rid, 1)
+                slots = self._alloc(a.req.rid, 1)
                 if slots is not None:
                     self.table[a.row, a.n_pages] = slots[0]
                     a.n_pages += 1
@@ -355,7 +459,7 @@ class ServeEngine:
         while True:
             if need <= 0:
                 return True
-            slots = self.allocator.alloc(a.req.rid, need)
+            slots = self._alloc(a.req.rid, need)
             if slots is not None:
                 self.table[a.row, a.n_pages:a.n_pages + need] = slots
                 a.n_pages += need
@@ -367,6 +471,65 @@ class ServeEngine:
             victim = self._evict_newest(rep)
             if victim is a:
                 return False  # evicted ourselves; the queue will retry
+
+    def _admit_full_hit(self, req: ServeRequest, hit: List[int],
+                        rep: StepReport) -> Optional[_Active]:
+        """Admit a request whose WHOLE (page-aligned) prompt is cached:
+        bind every cached page, copy-on-write the last one into a private
+        slot — the decode program is about to re-derive position S-1's K/V
+        into it, and writing into a shared page would couple the sibling
+        streams through last-ulp drift between the chunked and
+        single-token computations — and enter decode directly with the
+        last prompt token pending. Zero prefill calls; the first output
+        token costs one decode pass."""
+        S = req.prompt_len
+        nblk = S // self.page
+        # pin every matched page (including the COW source) before
+        # allocating: _alloc's cache reclaim frees index-only pages, and
+        # the hit slots are exactly that once their owner completed — see
+        # the partial-hit pin in step() (regression-pinned)
+        for s in hit[:nblk]:
+            self.allocator.incref(s)
+        priv = self._alloc(req.rid, 1)
+        if priv is None:
+            for s in hit[:nblk]:
+                self.allocator.decref(s)
+            rep.backpressure += 1
+            self.stats["backpressure"] += 1
+            return None
+        self.allocator.bind(req.rid, hit[:nblk - 1])
+        self.queue.popleft()
+        row = self._free_row()
+        a = _Active(req=req, row=row, admit_seq=self._admit_seq)
+        self._admit_seq += 1
+        self.table[row, :] = 0
+        self.table[row, :nblk - 1] = hit[:nblk - 1]
+        self.table[row, nblk - 1] = priv[0]
+        a.n_pages = nblk
+        a.prefill_done = S
+        a.registered_blocks = nblk  # every block is already in the index
+        a.state = "decode"
+        a.pending_tok = int(req.prompt[S - 1])
+        self.rows[row] = a
+        # device-side COW: the source page is pinned above, so the alloc's
+        # reclaim cannot have freed it between match and this copy
+        self.pools = self._cow_jit(self.pools, np.int32(hit[nblk - 1]),
+                                   np.int32(priv[0]))
+        # release the admission pins (the bind above keeps its own refs on
+        # the shared blocks; the COW source drops back to its cache ref)
+        for s in hit[:nblk]:
+            self.allocator.decref(s)
+        rep.admitted += 1
+        rep.prefix_hits += 1
+        self.stats["admitted"] += 1
+        self.stats["prefix_hits"] += 1
+        self.stats["cow_copies"] += 1
+        # S - 1 prompt positions never recomputed (the last one re-runs
+        # through the decode program to produce the first-token logits)
+        self.stats["prefix_tokens_saved"] += S - 1
+        self._cached_tokens[req.rid] = \
+            self._cached_tokens.get(req.rid, 0) + S - 1
+        return a
 
     def _admission_open(self) -> bool:
         if self.cfg.policy == "continuous":
@@ -406,29 +569,73 @@ class ServeEngine:
             # (prefill eviction only runs when decode_set is empty, so it
             # can never remove a decode row scheduled this step)
 
-        # 3) admit new requests while the packer has budget
-        while (budget >= C and self.queue
-               and self._free_row() is not None and self._admission_open()):
+        # 3) admit new requests while the packer has budget. With the
+        #    prefix cache on, an admission binds the pages of its longest
+        #    cached prefix and prefills only the tail; a FULL page-aligned
+        #    hit skips prefill entirely (COW the last cached page, enter
+        #    decode directly — budget 1, the bookkeeping slot).
+        while (self.queue and self._free_row() is not None
+               and self._admission_open()):
             req = self.queue[0]
+            hit = self.prefix.match(req.prompt) if self.prefix else []
+            S = req.prompt_len
+            full_hit = bool(hit) and len(hit) * self.page >= S
+            if budget < (1 if full_hit else C):
+                break
+            if full_hit:
+                a = self._admit_full_hit(req, hit, rep)
+                if a is None:
+                    break  # backpressure — even one COW page unavailable
+                budget -= 1
+                continue
+            # partial hit: never bind the page holding position S-1 — the
+            # first-token logits need at least the last prompt position to
+            # run through a (page-aligned) prefill chunk anyway
+            nbind = min(len(hit), (S - 1) // self.page)
+            cached = nbind * self.page
+            end0 = min(cached + C, S)  # first tail chunk's frontier
             if self.cfg.policy == "static":
                 # static baseline reserves the full worst case up front
+                # (prefix_cache is continuous-only, so nbind == 0 here)
                 need = self._pages_for(self._written_positions(req))
             else:
-                need = self._pages_for(min(C, req.prompt_len))
-            slots = self.allocator.alloc(req.rid, need)
+                need = self._pages_for(end0) - nbind
+            # pin the matched pages BEFORE allocating the tail: _alloc's
+            # cache reclaim frees exactly the index-only (refcount-1)
+            # pages, which the not-yet-bound hit slots ARE once their
+            # original owner completed — unpinned, reclaim could free a
+            # hit page and alloc recycle it as this request's own tail
+            # slot, aliasing an "immutable cached block" with a writable
+            # page (silent KV corruption; regression-pinned)
+            for s in hit[:nbind]:
+                self.allocator.incref(s)
+            slots = self._alloc(req.rid, need) if need else []
+            for s in hit[:nbind]:
+                self.allocator.decref(s)
             if slots is None:
                 rep.backpressure += 1
                 self.stats["backpressure"] += 1
                 self._filling = False  # static: close the fill phase
                 break
+            if nbind:
+                self.allocator.bind(req.rid, hit[:nbind])
             self.queue.popleft()
             row = self._free_row()
             a = _Active(req=req, row=row, admit_seq=self._admit_seq)
             self._admit_seq += 1
             self.table[row, :] = 0
-            self.table[row, :need] = slots
-            a.n_pages = need
+            self.table[row, :nbind] = hit[:nbind]
+            self.table[row, nbind:nbind + need] = slots
+            a.n_pages = nbind + need
+            a.prefill_done = cached
+            a.registered_blocks = nbind
             self.rows[row] = a
+            if nbind:
+                rep.prefix_hits += 1
+                self.stats["prefix_hits"] += 1
+                self.stats["prefix_tokens_saved"] += cached
+                self._cached_tokens[req.rid] = \
+                    self._cached_tokens.get(req.rid, 0) + cached
             prefill_calls.append(a)
             budget -= C
             rep.admitted += 1
@@ -450,6 +657,8 @@ class ServeEngine:
         self.stats["model_calls"] += cost
         self.stats["peak_occupancy"] = max(self.stats["peak_occupancy"],
                                            self.allocator.occupancy())
+        self.stats["shared_pages"] = max(self.stats["shared_pages"],
+                                         self.allocator.shared_pages)
         live = cap = 0
         for a in self._active():
             live += a.prefill_done + max(0, len(a.out) - 1)
@@ -480,8 +689,17 @@ class ServeEngine:
         a.prefill_done = end_real
         rep.prefill_calls += 1
         self.stats["prefill_calls"] += 1
+        self.stats["prefill_tokens"] += end_real - start
+        if self.prefix is not None:
+            # register newly completed prompt pages (every byte prompt
+            # content — positions the request will never write again)
+            for b in range(a.registered_blocks, end_real // self.page):
+                self.prefix.register(a.req.prompt, b,
+                                     int(self.table[a.row, b]))
+            a.registered_blocks = max(a.registered_blocks,
+                                      end_real // self.page)
         if last:
-            tok = int(nxt)
+            tok = self._emit_token(nxt, a.req.rid, len(a.out))
             a.out.append(tok)
             a.token_times.append(t_end)
             a.first_token_t = t_end
@@ -517,9 +735,13 @@ class ServeEngine:
         self.stats["decode_calls"] += 1
         self.stats["decode_row_slots"] += len(decode_set)
         for a in decode_set:
-            tok = int(nxt[a.row])
+            tok = self._emit_token(nxt[a.row], a.req.rid, len(a.out))
             a.out.append(tok)
             a.token_times.append(t_end)
+            if a.first_token_t is None:
+                # full-hit admissions skip prefill entirely — their first
+                # token comes from this decode pass
+                a.first_token_t = t_end
             if len(a.out) >= a.req.max_new:
                 self._complete(a, t_end, rep)
             else:
@@ -577,18 +799,25 @@ class ReplicatedServer:
         for k in ("decode_batch_util", "mean_page_fragmentation"):
             sums[k] /= len(self.engines)
         # peak occupancy is a saturation signal: averaging would hide one
-        # evicting, pool-bound replica behind its idle siblings
+        # evicting, pool-bound replica behind its idle siblings — the
+        # shared-page peak is the same kind of signal
         sums["peak_occupancy"] = max(
             e.stats["peak_occupancy"] for e in self.engines)
+        sums["shared_pages"] = max(
+            e.stats["shared_pages"] for e in self.engines)
         return sums
 
 
 def make_server(model: LayerModel, params, state, cfg: ServeConfig,
-                dtype=None, devices=None) -> ReplicatedServer:
+                dtype=None, devices=None,
+                shared_fns=None) -> ReplicatedServer:
     """Build a (possibly multi-replica) server. ``devices=None`` places
     replica i on ``jax.devices()[i]`` when there are enough devices — the
     serving analog of laying replicas along the mesh's 'data' axis — and
-    shares the default device otherwise."""
+    shares the default device otherwise. ``shared_fns`` (a prior server's
+    ``engines[0].jit_fns()``) seeds the jitted callables: servers built
+    from the same model and shapes — e.g. servebench's per-policy rows —
+    reuse one compile instead of re-tracing every npl variant."""
     import jax
 
     n = cfg.replicas
@@ -601,5 +830,5 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
     for d in devices:
         engines.append(ServeEngine(
             model, params, state, rep_cfg, dtype=dtype, device=d,
-            shared_fns=engines[0].jit_fns() if engines else None))
+            shared_fns=engines[0].jit_fns() if engines else shared_fns))
     return ReplicatedServer(engines)

@@ -1,0 +1,120 @@
+"""Cross-request prefix cache: a host-side index over page-aligned prompt
+blocks (the PagedAttention/COW lineage of Kwon et al., SOSP'23, applied to
+the admission hot path).
+
+Hundreds of requests sharing a system-prompt prefix each re-prefill the
+same tokens through the same weights — pure redundant FLOPs on the path
+that determines TTFT. This index lets a newly admitted request CLAIM the
+already-resident immutable KV pages of its longest cached prefix instead:
+the engine binds those pool slots straight into the request's table row
+(allocator refcounts make the sharing safe) and chunk-prefills only the
+uncached tail.
+
+Structure: one entry per fully-prefilled PAGE of a prompt, keyed by the
+exact bytes of the prompt up to and including that page —
+``prompt[: (b + 1) * page].tobytes()`` — so a key identifies both the
+block's content AND its whole left context (a hash-chain with zero
+collision risk; prompts at benchmark scale make the O(prefix) key cost
+irrelevant). ``match`` walks keys block by block and stops at the first
+miss, which is exactly the longest-cached-prefix semantics a trie would
+give.
+
+Residency: the index holds its own allocator reference (``incref``) on
+every page it caches, so a completed request's prompt pages survive the
+request. Under pool pressure the engine reclaims the cache before evicting
+live requests — ``reclaim`` drops entries newest-registered-first (the
+same newest-first rule as request eviction) and only ever frees pages
+whose sole remaining reference is the cache itself, i.e. refcount-0 from
+any live request's point of view; pages bound by in-flight requests are
+skipped (dropping their entry would lose the cache hit without freeing a
+byte). Children (longer prefixes) are always registered after their
+parents, so newest-first reclaim can never strand an unreachable chain
+suffix.
+
+Immutability: only pages every byte of which is prompt content get
+registered — a page that will still receive decode writes (the partial
+tail page of an unaligned prompt) never enters the index, and the engine
+copy-on-writes before its one write into a bound page (the full-hit fast
+path). See the shared-pool contract in ops/paged_decode.py.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List
+
+import numpy as np
+
+from ddlbench_tpu.serve.allocator import PageAllocator
+
+
+def _block_key(prompt: np.ndarray, block: int, page: int) -> bytes:
+    """Key of prompt block ``block``: the exact token bytes of the whole
+    prefix through that block (content + left context in one key)."""
+    return np.ascontiguousarray(
+        prompt[: (block + 1) * page], dtype=np.int32).tobytes()
+
+
+class PrefixIndex:
+    """Host-side prefix index over one engine's shared pool."""
+
+    def __init__(self, allocator: PageAllocator, page: int):
+        self.allocator = allocator
+        self.page = int(page)
+        # block key -> pool slot; dict insertion order IS registration
+        # order (children always register after their parents), which is
+        # all reclaim's newest-first walk needs
+        self._slots: Dict[bytes, int] = {}
+        self.lookups = 0
+        self.hit_blocks = 0
+        self.reclaimed = 0
+
+    def __len__(self) -> int:
+        return len(self._slots)
+
+    def match(self, prompt: np.ndarray) -> List[int]:
+        """Pool slots of the longest cached prefix of ``prompt`` (leading
+        full pages only), in block order. Empty list = miss."""
+        self.lookups += 1
+        slots: List[int] = []
+        for b in range(len(prompt) // self.page):
+            slot = self._slots.get(_block_key(prompt, b, self.page))
+            if slot is None:
+                break
+            slots.append(slot)
+        self.hit_blocks += len(slots)
+        return slots
+
+    def register(self, prompt: np.ndarray, block: int, slot: int) -> bool:
+        """Index ``slot`` as holding block ``block`` of ``prompt``; the
+        index takes its own reference so the page outlives the request.
+        Returns False (and takes nothing) if the key is already cached —
+        two requests racing the same prefix keep the first copy, and the
+        second's page stays private to it."""
+        key = _block_key(prompt, block, self.page)
+        if key in self._slots:
+            return False
+        self.allocator.incref(slot)
+        self._slots[key] = slot
+        return True
+
+    def reclaim(self, n_pages: int) -> int:
+        """Free up to ``n_pages`` pool pages by dropping cache entries,
+        newest-registered-first, skipping entries some live request still
+        has bound (their pages would not free anyway and the hit would be
+        lost for nothing). Returns how many pages were actually freed."""
+        freed = 0
+        for key in list(reversed(self._slots)):
+            if freed >= n_pages:
+                break
+            slot = self._slots[key]
+            if self.allocator.refcount(slot) != 1:
+                continue  # a live request still holds this page
+            del self._slots[key]
+            self.allocator.decref(slot)
+            self.reclaimed += 1
+            freed += 1
+        return freed
+
+    def drop_all(self) -> int:
+        """Release every entry the cache can release (shutdown/tests)."""
+        return self.reclaim(len(self._slots))

@@ -99,6 +99,11 @@ def serve_summary(records: List[Dict], *, duration: float,
         "throughput_tokens_per_unit": total_tokens / dur,
         "goodput_tokens_per_unit": good_tokens / dur,
         "slo_attainment": n_ok / len(records) if records else 0.0,
+        # prompt tokens served from the cross-request prefix cache
+        # (serve/prefix.py) over all completed requests — 0 with the cache
+        # off or on the static baseline, keeping the schema stable
+        "prefix_cached_tokens": sum(
+            r.get("cached_tokens", 0) for r in records),
     }
     for name, samples in (("ttft", ttfts), ("itl", itls)):
         for q in (50.0, 95.0, 99.0):

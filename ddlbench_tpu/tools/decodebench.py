@@ -56,6 +56,19 @@ def main(argv=None) -> int:
                         "hedge — ops/paged_decode.py)")
     p.add_argument("--skip-uncached", action="store_true",
                    help="skip the slow full-forward reference path")
+    p.add_argument("--chunk-prefill", action="store_true",
+                   help="also bench the serving chunk-prefill attention "
+                        "(ops/paged_decode.paged_chunk_attention): Pallas "
+                        "kernel vs gathered-page XLA rows over "
+                        "--chunk-sizes x --chunk-pages")
+    p.add_argument("--chunk-sizes", default="64,128",
+                   help="chunk-prefill query lengths C to sweep")
+    p.add_argument("--chunk-pages", default="4,16",
+                   help="live page counts to sweep for the chunk rows")
+    p.add_argument("--chunk-heads", type=int, default=8)
+    p.add_argument("--chunk-dh", type=int, default=64)
+    p.add_argument("--chunk-page-size", type=int, default=64,
+                   help="positions per page for the chunk rows")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -182,7 +195,84 @@ def main(argv=None) -> int:
             "tokens_per_sec": round(new_tokens / dt, 2),
             "ms_per_token": round(1000.0 * dt / max(1, T - S), 3),
         }), flush=True)
+
+    if args.chunk_prefill:
+        _chunk_prefill_rows(args, prov)
     return 0
+
+
+def _chunk_prefill_rows(args, prov) -> None:
+    """Kernel-vs-XLA rows for the serving chunk-prefill attention: one row
+    per (chunk size C, live page count) x {chunk-kernel, chunk-xla} over a
+    synthetic serving pool (shuffled free-list table, the layout the
+    engine produces). The kernel variant is the Pallas multi-query
+    flash-decode analog and only compiles on TPU — elsewhere the row is
+    recorded as skipped, with the same backend provenance as every other
+    row, so a cpu-fallback run can never masquerade as a chip number."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddlbench_tpu.distributed import is_tpu_backend
+    from ddlbench_tpu.ops.paged_decode import paged_chunk_attention
+
+    H, dh, page = args.chunk_heads, args.chunk_dh, args.chunk_page_size
+    chunks = [int(x) for x in args.chunk_sizes.split(",")]
+    pages = [int(x) for x in args.chunk_pages.split(",")]
+    for C in chunks:
+        for npl in pages:
+            pool_pages = npl + 2  # slot 0 scratch + headroom
+            kk = jax.random.normal(jax.random.key(10),
+                                   (pool_pages, page, H, dh), jnp.float32)
+            vv = jax.random.normal(jax.random.key(11),
+                                   (pool_pages, page, H, dh), jnp.float32)
+            perm = np.random.default_rng(0).permutation(
+                np.arange(1, pool_pages))[:npl]
+            cache = {"pool_k": kk, "pool_v": vv,
+                     "table": jnp.asarray(perm[None, :], jnp.int32)}
+            q = jax.random.normal(jax.random.key(12), (1, H, C, dh),
+                                  jnp.float32)
+            # chunk start = the last page (the serving frontier shape)
+            start = jnp.int32((npl - 1) * page)
+            for variant, use_kernel in (("chunk-kernel", True),
+                                        ("chunk-xla", False)):
+                base = {"tool": "decodebench", "variant": variant,
+                        "chunk": C, "pages": npl, "page": page,
+                        "heads": H, "dh": dh, **prov}
+                if use_kernel and not is_tpu_backend():
+                    print(json.dumps({
+                        **base,
+                        "skipped": "Pallas chunk kernel needs a TPU "
+                                   "backend (XLA row is the CPU path)",
+                    }), flush=True)
+                    continue
+                fn = jax.jit(lambda q=q, cache=cache, start=start,
+                             uk=use_kernel: paged_chunk_attention(
+                                 q, cache, start, npl, page=page,
+                                 use_kernel=uk,
+                                 kernel_style=args.paged_kernel))
+                out = [None]
+
+                def run():
+                    out[0] = fn()
+
+                def sync():
+                    float(jnp.sum(out[0]))
+
+                try:
+                    dt = _bench(run, sync, args.repeats)
+                except Exception as e:  # Mosaic shape rejection etc.
+                    print(json.dumps({
+                        **base,
+                        "error": f"{type(e).__name__}: "
+                                 f"{str(e).splitlines()[0][:200]}",
+                    }), flush=True)
+                    continue
+                print(json.dumps({
+                    **base,
+                    "tokens_per_sec": round(C / dt, 2),
+                    "us_per_chunk": round(1e6 * dt, 2),
+                }), flush=True)
 
 
 if __name__ == "__main__":

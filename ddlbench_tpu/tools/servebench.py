@@ -26,7 +26,15 @@ Usage:
     python -m ddlbench_tpu.tools.servebench [-m transformer_s]
         [-b synthtext] [--arrival poisson|bursty|closed] [--rate 0.5]
         [--requests 64] [--max-batch 8] [--pool-pages 64] [--page 16]
-        [--max-len 256] [--slo-ttft 16] [--slo-itl 2.0] [--platform cpu]
+        [--max-len 256] [--slo-ttft 16] [--slo-itl 2.0]
+        [--shared-prefix 4:64] [--prefix-cache]
+        [--sample temperature:0.8,top-k:40] [--platform cpu]
+
+The prefix-cache A/B: ``--shared-prefix G:P`` synthesizes G groups of
+requests sharing a P-token prompt head, and ``--prefix-cache`` lets the
+continuous engine serve cached heads from resident KV pages — compare the
+``prefill_tokens`` / ``ttft_p50`` / ``prefix_*`` fields against the same
+invocation without the flag (identical token streams, pinned).
 """
 
 from __future__ import annotations
@@ -108,6 +116,23 @@ def main(argv=None) -> int:
     p.add_argument("--out-lens", default="2,16,64",
                    help="lo,typical,hi of the heavy-tail output mixture")
     p.add_argument("--tail-frac", type=float, default=0.25)
+    p.add_argument("--shared-prefix", default=None, metavar="G:P",
+                   help="shared-prefix traffic: G prefix groups of P "
+                        "tokens each; every prompt = one group's prefix + "
+                        "a unique heavy-tail tail (the prefix-cache A/B "
+                        "workload)")
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the cross-request prefix cache on the "
+                        "continuous policy (admissions bind cached prompt "
+                        "pages and prefill only the tail; the static "
+                        "baseline always runs cache-off and reports the "
+                        "cache counters as 0)")
+    p.add_argument("--sample", default=None, metavar="temperature:T[,top-k:K]",
+                   help="sample instead of greedy argmax: softmax(logits/T)"
+                        " with optional top-k restriction, counter-based "
+                        "per-request seeds (run seed + request id + token "
+                        "index) so streams stay bitwise-reproducible; "
+                        "default greedy")
     p.add_argument("--slo-ttft", type=float, default=16.0,
                    help="TTFT SLO in time units (model passes)")
     p.add_argument("--slo-itl", type=float, default=2.0,
@@ -157,16 +182,45 @@ def main(argv=None) -> int:
     plo, ptyp, phi = (int(x) for x in args.prompt_lens.split(","))
     olo, otyp, ohi = (int(x) for x in args.out_lens.split(","))
     policies = [s.strip() for s in args.policies.split(",") if s.strip()]
+    groups = prefix_len = 0
+    if args.shared_prefix:
+        try:
+            groups, prefix_len = (int(x)
+                                  for x in args.shared_prefix.split(":"))
+        except ValueError:
+            p.error("--shared-prefix wants G:P (groups:prefix_tokens), "
+                    f"got {args.shared_prefix!r}")
+    temperature, top_k = 0.0, 0
+    if args.sample:
+        for part in args.sample.split(","):
+            key, _, val = part.partition(":")
+            if key == "temperature":
+                temperature = float(val)
+            elif key == "top-k":
+                top_k = int(val)
+            else:
+                p.error(f"--sample parts are temperature:T and top-k:K, "
+                        f"got {part!r}")
+        if temperature <= 0.0:
+            p.error("--sample needs temperature:T with T > 0 "
+                    "(omit --sample for greedy)")
     base = ServeConfig(
         max_batch=args.max_batch, pool_pages=args.pool_pages,
         page=args.page, max_len=min(args.max_len, spec.seq_len),
         token_budget=args.token_budget,
         prefill_chunk=(args.page if args.prefill_chunk is None
                        else args.prefill_chunk),
-        replicas=args.replicas)
+        replicas=args.replicas, temperature=temperature, top_k=top_k,
+        sample_seed=args.seed)
 
+    shared_fns = None
     for policy in policies:
-        cfg = base.replace(policy=policy)
+        # the static baseline is cache-off by definition (it measures
+        # whole-batch scheduling); its JSON rows still carry the prefix
+        # counters — as zeros — so the schema is stable across policies
+        cfg = base.replace(
+            policy=policy,
+            prefix_cache=args.prefix_cache and policy == "continuous")
         cfg.validate()
         # fresh workload per policy: ServeRequest.arrival is stamped by the
         # closed-loop driver, and both policies must see identical traffic
@@ -176,8 +230,14 @@ def main(argv=None) -> int:
             burst_size=args.burst_size, burst_factor=args.burst_factor,
             prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
             out_lo=olo, out_typical=otyp, out_hi=ohi,
-            tail_frac=args.tail_frac, max_len=cfg.max_len)
-        server = make_server(model, params, state, cfg)
+            tail_frac=args.tail_frac, prefix_groups=groups,
+            prefix_len=prefix_len, max_len=cfg.max_len)
+        # policy rows share the compiled programs (identical model and
+        # shapes — policy/prefix_cache are host-side decisions), so only
+        # the first row pays the trace
+        server = make_server(model, params, state, cfg,
+                             shared_fns=shared_fns)
+        shared_fns = server.engines[0].jit_fns()
         t0 = time.perf_counter()
         if args.arrival == "closed":
             duration = run_closed_loop(server, reqs, args.concurrency)
@@ -202,6 +262,9 @@ def main(argv=None) -> int:
             "prefill_chunk": cfg.resolved_prefill_chunk(),
             "token_budget": cfg.resolved_token_budget(),
             "replicas": cfg.replicas,
+            "prefix_cache": cfg.prefix_cache,
+            "shared_prefix": args.shared_prefix,
+            "sample": args.sample,
             "time_unit": "model_pass",
             **{k: (round(v, 6) if isinstance(v, float) else v)
                for k, v in serve_summary(

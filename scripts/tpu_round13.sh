@@ -1,0 +1,66 @@
+#!/usr/bin/env bash
+# Round-13 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the prefix-cache round:
+#
+#   * prefix-cache ON vs OFF over IDENTICAL shared-prefix traffic at the
+#     IDENTICAL pool size (one invocation per cache setting; token streams
+#     are pinned bitwise-identical, so the delta is pure recompute
+#     elimination) at low and high prefix share;
+#   * a plain-Poisson control (no shared content): counters must read 0
+#     and the cache must be inert;
+#   * a small-pool run (reclaim-before-evict economics: evictions <= the
+#     cache-off run, shared_pages > 0);
+#   * a sampling run (temperature/top-k; virtual units identical, the
+#     logits transfer is the wall-clock cost);
+#   * decodebench chunk-prefill rows: the new Pallas multi-query kernel
+#     vs the gathered-page XLA einsum over chunk sizes x page counts,
+#     both kernel math styles (Mosaic-rejection hedge).
+#
+# servebench JSON is bitwise-deterministic in virtual model-pass units;
+# --wall-clock adds real seconds next to them for the on-chip record.
+# Expectations in PERF.md § round 13.
+#
+# Usage: scripts/tpu_round13.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+add_task pipe_hyb_1f1b_r11      python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30 --pipe-schedule 1f1b --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_1f1b_r11.jsonl --trace perf_runs/trace_hyb_1f1b_r11.json --trace-dir perf_runs/xla_hyb_1f1b_r11 --xla-trace-steps 10:14
+add_task serve_poisson_mid_r12 python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 0.5
+add_task serve_rep4_r12        python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 2.0 --replicas 4 --requests 192
+add_task decodebench_prov_r12  python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu
+
+# -- round-13a: prefix-cache on/off x {shared-prefix lo, hi} ---------------
+# transformer_s/synthtext on one chip; the SAME seeded shared-prefix
+# workload per pair (token streams pinned bitwise identical cache-on vs
+# off) — the delta is pure recompute elimination. lo = 64-token prefix
+# (one chunk's worth), hi = 384-token prefix (the system-prompt regime).
+PFX_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 128 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu"
+add_task serve_pfx_on_lo_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache
+add_task serve_pfx_off_lo_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64
+add_task serve_pfx_on_hi_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384 --prefix-cache
+add_task serve_pfx_off_hi_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384
+
+# -- round-13b: plain-Poisson control (cache inert on misses) --------------
+add_task serve_pfx_ctl_r13     python -m ddlbench_tpu.tools.servebench $PFX_COMMON --prefix-cache
+
+# -- round-13c: small pool (reclaim-before-evict economics) ----------------
+PFX_SMALL="-m transformer_s -b synthtext --max-batch 8 --pool-pages 48 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu --shared-prefix 4:64"
+add_task serve_pfx_smallpool_r13     python -m ddlbench_tpu.tools.servebench $PFX_SMALL --prefix-cache
+add_task serve_pfx_smallpool_off_r13 python -m ddlbench_tpu.tools.servebench $PFX_SMALL
+
+# -- round-13d: sampling overhead ------------------------------------------
+add_task serve_sample_r13      python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache --sample temperature:0.8,top-k:40
+
+# -- round-13e: chunk-prefill kernel vs XLA (both math styles) -------------
+add_task decodebench_chunk_r13    python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16
+add_task decodebench_chunk_ew_r13 python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16 --paged-kernel elementwise
+
+window_loop "${1:-12}"

@@ -142,6 +142,106 @@ def test_paged_attention_kernel_matches_ref(pos, npl, style):
                                rtol=1e-5, atol=1e-5)
 
 
+def _serve_chunk_cache(npages_pool, rows, npl, seed=30):
+    """A serving-style shared pool + table: ``rows`` table rows borrowing
+    arbitrary (non-contiguous, shuffled) slots — the free-list layout the
+    chunk kernel must walk through the table."""
+    from ddlbench_tpu.ops.paged_decode import serve_pool_init
+
+    pool = serve_pool_init(npages_pool, PAGE, H, DH, jnp.float32)
+    pool = {
+        "pool_k": _rand(seed, npages_pool, PAGE, H, DH),
+        "pool_v": _rand(seed + 1, npages_pool, PAGE, H, DH),
+    }
+    rng = np.random.default_rng(seed)
+    slots = rng.permutation(np.arange(1, npages_pool))[: rows * npl]
+    table = jnp.asarray(slots.reshape(rows, npl), jnp.int32)
+    return {**pool, "table": table}
+
+
+@pytest.mark.parametrize("start,npl,C,style", [
+    (0, 1, 4, "dots"), (8, 3, 4, "dots"), (4, 3, 8, "dots"),
+    (8, 3, 4, "elementwise"),  # the Mosaic hedge shares one shape's pin
+])
+def test_paged_chunk_attention_kernel_matches_ref(start, npl, C, style):
+    """The chunked-prefill kernel (multi-query flash-decode analog) matches
+    the gathered-page XLA reference through a shuffled serving table, for
+    both math formulations, within the flash-decode pin's tolerance."""
+    from ddlbench_tpu.ops.paged_decode import (_paged_chunk_attention_ref,
+                                               paged_chunk_attention)
+
+    rows = 2
+    cache = _serve_chunk_cache(16, rows, npl)
+    q = _rand(33, rows, H, C, DH)
+    ref = _paged_chunk_attention_ref(q, cache, start, npl, page=PAGE)
+    out = paged_chunk_attention(q, cache, start, npl, page=PAGE,
+                                interpret=True, use_kernel=True,
+                                kernel_style=style)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_paged_chunk_attention_per_row_start():
+    """Per-row chunk starts (each serving row is its own request at its own
+    prefill frontier): kernel and reference agree row-by-row with rows at
+    DIFFERENT absolute positions."""
+    from ddlbench_tpu.ops.paged_decode import (_paged_chunk_attention_ref,
+                                               paged_chunk_attention)
+
+    rows, C, npl = 3, 4, 3
+    cache = _serve_chunk_cache(16, rows, npl, seed=44)
+    q = _rand(45, rows, H, C, DH)
+    starts = jnp.asarray([0, 4, 8], jnp.int32)
+    ref = _paged_chunk_attention_ref(q, cache, starts, npl, page=PAGE)
+    out = paged_chunk_attention(q, cache, starts, npl, page=PAGE,
+                                interpret=True, use_kernel=True)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+    # and each row equals a rows=1 reference at its own scalar start — the
+    # per-row vector is not silently broadcasting row 0's start
+    for r, s in enumerate([0, 4, 8]):
+        one = _paged_chunk_attention_ref(
+            q[r:r + 1], {**cache, "table": cache["table"][r:r + 1]},
+            s, npl, page=PAGE)
+        np.testing.assert_allclose(np.asarray(out[r:r + 1]),
+                                   np.asarray(one), rtol=1e-5, atol=1e-5)
+
+
+def test_paged_chunk_attention_ref_matches_dense_chunk():
+    """The XLA chunk reference itself is pinned to a dense causal oracle:
+    every query position c attends exactly keys [0, start + c]."""
+    from ddlbench_tpu.ops.paged_decode import _paged_chunk_attention_ref
+
+    rows, C, npl, start = 2, 4, 3, 6
+    cache = _serve_chunk_cache(16, rows, npl, seed=50)
+    q = _rand(51, rows, H, C, DH)
+    out = _paged_chunk_attention_ref(q, cache, start, npl, page=PAGE)
+    L = npl * PAGE
+    kd = cache["pool_k"][cache["table"]].reshape(rows, L, H, DH)
+    vd = cache["pool_v"][cache["table"]].reshape(rows, L, H, DH)
+    for c in range(C):
+        exp = _dense_attention(q[:, :, c], kd.transpose(0, 2, 1, 3),
+                               vd.transpose(0, 2, 1, 3), start + c)
+        np.testing.assert_allclose(np.asarray(out[:, :, c]), np.asarray(exp),
+                                   rtol=1e-5, atol=1e-5)
+
+
+def test_serve_page_copy():
+    """COW primitive: dst slot becomes a bitwise copy of src, nothing else
+    moves, and the op is jit-stable with traced slot indices."""
+    from ddlbench_tpu.ops.paged_decode import serve_page_copy
+
+    pool = {"pool_k": _rand(60, 8, PAGE, H, DH),
+            "pool_v": _rand(61, 8, PAGE, H, DH)}
+    out = jax.jit(serve_page_copy)(pool, jnp.int32(3), jnp.int32(6))
+    for key in ("pool_k", "pool_v"):
+        np.testing.assert_array_equal(np.asarray(out[key][6]),
+                                      np.asarray(pool[key][3]))
+        keep = np.array([i for i in range(8) if i != 6])
+        np.testing.assert_array_equal(np.asarray(out[key][keep]),
+                                      np.asarray(pool[key][keep]))
+
+
 def test_cow_reorder_matches_physical_gather():
     """Random beam-parent chains: after every reorder+write, the table view
     must equal a physically gathered dense cache."""
