@@ -951,6 +951,25 @@ class ServeConfig:
     temperature: float = 0.0
     top_k: int = 0  # 0 = full vocab; > 0 restricts sampling to the k best
     sample_seed: int = 0
+    # request-lifecycle tracing (telemetry/): when True the engine emits
+    # submit/queue_wait/admit/prefill_chunk/first_token/decode/evict/
+    # recompute/finish events (one Chrome-trace track per request per
+    # replica) plus per-step counter tracks into the process-global
+    # tracer, stamped in VIRTUAL model-pass units. Metrics-neutral by
+    # construction on AND off: tracing only records what the scheduler
+    # already decided — token streams and virtual-time JSON are bitwise
+    # identical either way (pinned, tests/test_serve_trace.py).
+    trace: bool = False
+    # flight recorder: ring of the most recent per-step engine states
+    # (occupancy, queue depth, packer fill, ...) kept for
+    # ``ServeEngine.snapshot()`` — the live-debug window into a serving
+    # replica. 0 disables the ring; snapshot() still reports live state.
+    flight_recorder: int = 64
+    # SLOs in virtual time units, used by snapshot()'s
+    # attainment-so-far (telemetry/stats.request_slo_ok). 0 = no SLO.
+    # Scheduling NEVER reads these — they are observability-only.
+    slo_ttft: float = 0.0
+    slo_itl: float = 0.0
 
     def npg_max(self) -> int:
         return -(-self.max_len // self.page)
@@ -1006,6 +1025,13 @@ class ServeConfig:
             raise ValueError(
                 "top_k without temperature has no sampling to restrict "
                 "(greedy already takes the argmax)")
+        if self.flight_recorder < 0:
+            raise ValueError(
+                f"flight_recorder must be >= 0 (0 disables the ring), "
+                f"got {self.flight_recorder}")
+        if self.slo_ttft < 0 or self.slo_itl < 0:
+            raise ValueError(
+                "slo_ttft and slo_itl must be >= 0 (0 = no SLO)")
 
     def replace(self, **kw: Any) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

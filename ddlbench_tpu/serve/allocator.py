@@ -40,7 +40,7 @@ somewhere harmless. It is never handed out and never counted as capacity.
 
 from __future__ import annotations
 
-from typing import Dict, List, Optional
+from typing import Callable, Dict, List, Optional
 
 # ops/paged_decode.SCRATCH_SLOT, duplicated so this module stays jax-free
 # (the supervisor-side import discipline of train/__init__)
@@ -65,6 +65,11 @@ class PageAllocator:
         self.allocs = 0
         self.frees = 0
         self.peak_in_use = 0
+        # optional (name, **args) sink for pool lifecycle instants — the
+        # engine wires it to the virtual-time tracer when cfg.trace is on
+        # (this module stays jax- and telemetry-free; the hook is how the
+        # allocator shows up on the trace without knowing virtual time)
+        self.on_event: Optional[Callable[..., None]] = None
 
     @property
     def capacity(self) -> int:
@@ -113,6 +118,9 @@ class PageAllocator:
             self._ref[s] = 1
         self.allocs += n
         self.peak_in_use = max(self.peak_in_use, self.in_use)
+        if self.on_event is not None:
+            self.on_event("pool_alloc", rid=rid, pages=n,
+                          free=len(self._free))
         return slots
 
     def bind(self, rid: int, slots: List[int]) -> None:
@@ -159,4 +167,8 @@ class PageAllocator:
         slots = self._owned.pop(rid, None)
         if slots is None:
             raise ValueError(f"double free: request {rid} owns no pages")
-        return sum(1 for s in slots if self.decref(s))
+        freed = sum(1 for s in slots if self.decref(s))
+        if self.on_event is not None:
+            self.on_event("pool_release", rid=rid, held=len(slots),
+                          freed=freed, free=len(self._free))
+        return freed

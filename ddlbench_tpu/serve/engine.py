@@ -61,6 +61,20 @@ free and wasted passes are what continuous batching eliminates. All
 latency/goodput metrics are in these units — fully deterministic, which is
 what makes servebench's JSON bitwise-reproducible under a fixed seed.
 
+Observability (``cfg.trace``, PR 11): the engine emits request-lifecycle
+events into the process-global telemetry tracer, stamped in VIRTUAL time —
+``submit``/``queue_wait``/``admit``/``prefill_chunk``/``first_token``/
+``decode``/``evict``/``recompute``/``finish`` on one Chrome-trace track
+per request per replica, pool/prefix instants on a pool track, and
+per-step counter tracks (occupancy, free pages, decode-batch utilization,
+token-budget fill, prefix hits, shared pages, queue depth).
+``telemetry/serveview.py`` reduces the trace to TTFT/ITL component
+decompositions and the windowed SLO/goodput time series. Tracing is
+metrics-neutral on AND off — it only records decisions already made, so
+virtual-time JSON and token streams are bitwise identical (pinned). A
+bounded flight recorder (``cfg.flight_recorder`` recent per-step states)
+plus ``snapshot()`` expose live state without any tracer at all.
+
 Multi-replica serving (:class:`ReplicatedServer`) runs N independent
 engines — the serving analog of the mesh's 'data' axis: replicas share
 nothing, and a least-loaded dispatcher routes each arrival. Replicas step
@@ -81,6 +95,16 @@ from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.serve.allocator import PageAllocator
 from ddlbench_tpu.serve.prefix import PrefixIndex
 from ddlbench_tpu.serve.workload import ServeRequest
+from ddlbench_tpu.telemetry.stats import request_slo_ok
+from ddlbench_tpu.telemetry.tracer import get_tracer
+
+
+def _vns(t: float) -> int:
+    """Virtual time -> trace 'nanoseconds': one model pass scales to 1000
+    ns so the exporter's /1e3 renders one virtual unit as exactly 1 µs,
+    and every timestamp is an exact integer — serveview's TTFT/ITL
+    decomposition tiles these intervals with no float drift."""
+    return int(round(t * 1000.0))
 
 
 def sample_token(logits: np.ndarray, temperature: float, top_k: int,
@@ -173,7 +197,7 @@ class ServeEngine:
     """One serving replica: scheduler + allocator + the two jitted steps."""
 
     def __init__(self, model: LayerModel, params, state, cfg: ServeConfig,
-                 dtype=None, device=None, shared_fns=None):
+                 dtype=None, device=None, shared_fns=None, replica: int = 0):
         import jax
         import jax.numpy as jnp
 
@@ -209,6 +233,27 @@ class ServeEngine:
         self.finished: List[Dict[str, Any]] = []
         self._admit_seq = 0
         self._filling = False  # static policy: whole-batch fill phase
+        # -- observability state (tentpole, PR 11). All of it is host-side
+        # bookkeeping the scheduler never reads: with cfg.trace off OR on,
+        # scheduling decisions and token streams are bitwise identical.
+        self.replica = replica
+        self._trk = f"r{replica}"  # per-replica trace-track prefix
+        self._now = 0.0  # current step's start (for mid-schedule instants)
+        self._last_t = 0.0  # last step's end — snapshot()'s clock
+        # when each queued request entered the queue (arrival, or the
+        # eviction instant on recompute) — the queue_wait span's left edge
+        # and the queued-request age in snapshot()
+        self._queued_at: Dict[int, float] = {}
+        # rids evicted and not yet re-admitted (the `recompute` instant)
+        self._evicted_rids: set = set()
+        self._flight: Optional[deque] = (
+            deque(maxlen=cfg.flight_recorder) if cfg.flight_recorder
+            else None)
+        if cfg.trace:
+            # pool/prefix lifecycle instants ride the same virtual clock
+            self.allocator.on_event = self._pool_event
+            if self.prefix is not None:
+                self.prefix.on_event = self._pool_event
         # prompt tokens served from the cache per request, accumulated
         # across re-admissions (eviction/recompute) — attached to the
         # finished record for telemetry/stats.serve_summary
@@ -235,6 +280,53 @@ class ServeEngine:
         """The (decode, prefill, cow) jitted callables, shareable with
         sibling replicas built from the same model/config."""
         return self._decode_jit, self._prefill_jit, self._cow_jit
+
+    # -- request-lifecycle tracing (virtual-time, metrics-neutral) ---------
+
+    def _tr(self):
+        """The live tracer, or None. Both gates — ``cfg.trace`` off and a
+        disabled process tracer — collapse every emission site to one
+        attribute check, the same disabled-path contract the train loop
+        holds (telemetry/tracer.py)."""
+        if not self.cfg.trace:
+            return None
+        tr = get_tracer()
+        return tr if tr.enabled else None
+
+    def _req_track(self, rid: int) -> str:
+        """One Chrome-trace track per request per replica."""
+        return f"{self._trk}/req{rid}"
+
+    def _pool_event(self, name: str, **args: Any) -> None:
+        """Allocator/prefix hook target: pool lifecycle instants on the
+        replica's pool track, stamped at the current step's start."""
+        tr = self._tr()
+        if tr is not None:
+            tr.emit("i", name, _vns(self._now), track=f"{self._trk}/pool",
+                    args=args)
+
+    def _trace_admit(self, a: "_Active", cached: int) -> None:
+        """Close the request's queue_wait span and mark the admission
+        (plus the recompute marker when this is a re-admission after
+        eviction). Also runs the queue bookkeeping the snapshot ages use,
+        so it is called on EVERY admission, traced or not."""
+        rid = a.req.rid
+        q0 = self._queued_at.pop(rid, self._now)
+        recompute = rid in self._evicted_rids
+        self._evicted_rids.discard(rid)
+        tr = self._tr()
+        if tr is None:
+            return
+        trk = self._req_track(rid)
+        t_ns = _vns(self._now)
+        tr.emit("X", "queue_wait", _vns(q0), t_ns - _vns(q0), track=trk,
+                args={"rid": rid,
+                      "reason": "recompute" if recompute else "arrival"})
+        if recompute:
+            tr.emit("i", "recompute", t_ns, track=trk, args={"rid": rid})
+        tr.emit("i", "admit", t_ns, track=trk,
+                args={"rid": rid, "row": a.row, "seq": a.admit_seq,
+                      "cached_tokens": cached})
 
     # -- jitted model programs ---------------------------------------------
 
@@ -353,6 +445,13 @@ class ServeEngine:
                 f"request {req.rid} can never fit the pool "
                 f"({self.allocator.capacity} usable pages)")
         self.queue.append(req)
+        t0 = req.arrival if req.arrival is not None else 0.0
+        self._queued_at[req.rid] = t0
+        tr = self._tr()
+        if tr is not None:
+            tr.emit("i", "submit", _vns(t0), track=self._req_track(req.rid),
+                    args={"rid": req.rid, "prompt_len": req.prompt_len,
+                          "max_new": req.max_new})
 
     def has_work(self) -> bool:
         return bool(self.queue) or any(a is not None for a in self.rows)
@@ -385,6 +484,14 @@ class ServeEngine:
         self.queue.appendleft(victim.req)
         rep.evicted += 1
         self.stats["evicted"] += 1
+        rid = victim.req.rid
+        self._queued_at[rid] = self._now  # requeued: the wait restarts now
+        self._evicted_rids.add(rid)
+        tr = self._tr()
+        if tr is not None:
+            tr.emit("i", "evict", _vns(self._now), track=self._req_track(rid),
+                    args={"rid": rid, "prefill_done": victim.prefill_done,
+                          "out_tokens": len(victim.out)})
 
     def _evict_newest(self, rep: StepReport) -> Optional[_Active]:
         active = self._active()
@@ -419,6 +526,14 @@ class ServeEngine:
         })
         rep.completed.append(a.req.rid)
         self.stats["completed"] += 1
+        tr = self._tr()
+        if tr is not None:
+            f = self.finished[-1]
+            tr.emit("i", "finish", _vns(t), track=self._req_track(a.req.rid),
+                    args={"rid": a.req.rid, "n_tokens": f["n_tokens"],
+                          "arrival": f["arrival"],
+                          "first_token_t": f["first_token_t"],
+                          "cached_tokens": f["cached_tokens"]})
 
     # -- the step: ensure pages -> pack -> prefill/decode -> retire --------
 
@@ -529,6 +644,7 @@ class ServeEngine:
         self.stats["prefix_tokens_saved"] += S - 1
         self._cached_tokens[req.rid] = \
             self._cached_tokens.get(req.rid, 0) + S - 1
+        self._trace_admit(a, S - 1)
         return a
 
     def _admission_open(self) -> bool:
@@ -543,6 +659,7 @@ class ServeEngine:
         """One engine step. Returns what ran; emission/completion times are
         stamped at ``now + cost`` (the step's end in virtual time)."""
         rep = StepReport()
+        self._now = now  # mid-schedule instants (evict, pool, admit)
         C = self.cfg.resolved_prefill_chunk()
 
         # 1) decode set: every decode row gets its next page (evictions may
@@ -640,6 +757,7 @@ class ServeEngine:
             budget -= C
             rep.admitted += 1
             self.stats["admitted"] += 1
+            self._trace_admit(a, cached if nbind else 0)
         if self.cfg.policy == "static" and (
                 self._free_row() is None or not self.queue):
             self._filling = False
@@ -667,6 +785,40 @@ class ServeEngine:
             self.stats["frag_sum"] += 1.0 - live / cap
             self.stats["frag_samples"] += 1
         rep.cost = cost
+
+        # 6) flight recorder + counter tracks (host-only observability —
+        #    nothing below feeds back into scheduling)
+        self._last_t = t_end
+        occ = self.allocator.occupancy()
+        if self._flight is not None:
+            self._flight.append({
+                "step": int(self.stats["steps"]), "t": t_end, "cost": cost,
+                "occupancy": occ, "free_pages": self.allocator.free_pages,
+                "queue_depth": len(self.queue),
+                "active": sum(1 for x in self.rows if x is not None),
+                "decode_rows": len(decode_set),
+                "prefill_calls": len(prefill_calls),
+                "admitted": rep.admitted, "evicted": rep.evicted,
+                "backpressure": rep.backpressure,
+            })
+        tr = self._tr()
+        if tr is not None:
+            t_ns = _vns(t_end)
+            trk = f"{self._trk}/engine"
+            B = self.cfg.resolved_token_budget()
+            used = B - budget  # decode rows + admitted/continued chunks
+            for cname, v in (
+                    ("pool_occupancy", occ),
+                    ("free_pages", float(self.allocator.free_pages)),
+                    ("decode_batch_util",
+                     len(decode_set) / self.cfg.max_batch),
+                    ("token_budget_fill", min(1.0, max(0.0, used / B))),
+                    ("prefix_hits", float(self.stats["prefix_hits"])),
+                    ("shared_pages", float(self.allocator.shared_pages)),
+                    ("queue_depth", float(len(self.queue))),
+            ):
+                tr.emit("C", f"{cname}[{self._trk}]", t_ns, track=trk,
+                        args={"value": v})
         return rep
 
     def _run_prefill_chunk(self, a: _Active, C: int, t_end: float,
@@ -690,6 +842,20 @@ class ServeEngine:
         rep.prefill_calls += 1
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += end_real - start
+        tr = self._tr()
+        if tr is not None:
+            # the span covers the WHOLE step window [now, t_end): in the
+            # virtual cost model the request is "being prefilled" for the
+            # step it is packed into — serveview's TTFT decomposition
+            # counts that full window as prefill time
+            tr.emit("X", "prefill_chunk", _vns(self._now),
+                    _vns(t_end) - _vns(self._now),
+                    track=self._req_track(a.req.rid),
+                    args={"rid": a.req.rid, "chunk": start // max(C, 1),
+                          "start": start, "tokens": end_real - start,
+                          "cached_tokens":
+                              self._cached_tokens.get(a.req.rid, 0),
+                          "step": int(self.stats["steps"])})
         if self.prefix is not None:
             # register newly completed prompt pages (every byte prompt
             # content — positions the request will never write again)
@@ -703,6 +869,10 @@ class ServeEngine:
             a.out.append(tok)
             a.token_times.append(t_end)
             a.first_token_t = t_end
+            if tr is not None:
+                tr.emit("i", "first_token", _vns(t_end),
+                        track=self._req_track(a.req.rid),
+                        args={"rid": a.req.rid, "t": t_end})
             if len(a.out) >= a.req.max_new:
                 self._complete(a, t_end, rep)
             else:
@@ -715,6 +885,19 @@ class ServeEngine:
 
         assert all(self.rows[a.row] is a for a in decode_set), \
             "scheduled a dead (evicted) row"
+        tr = self._tr()
+        if tr is not None:
+            # one span per participating request, covering the step window
+            # — `tok` is the index of the token this pass emits, so
+            # serveview can reconstruct per-token times (last emission
+            # wins across eviction/recompute replays)
+            d0, d1 = _vns(self._now), _vns(t_end)
+            for a in decode_set:
+                tr.emit("X", "decode", d0, d1 - d0,
+                        track=self._req_track(a.req.rid),
+                        args={"rid": a.req.rid, "tok": len(a.out),
+                              "pos": int(a.decode_pos),
+                              "step": int(self.stats["steps"])})
         B = self.cfg.max_batch
         toks = np.zeros((B, 1), np.int32)
         pos = np.zeros((B,), np.int32)
@@ -742,6 +925,10 @@ class ServeEngine:
                 # full-hit admissions skip prefill entirely — their first
                 # token comes from this decode pass
                 a.first_token_t = t_end
+                if tr is not None:
+                    tr.emit("i", "first_token", _vns(t_end),
+                            track=self._req_track(a.req.rid),
+                            args={"rid": a.req.rid, "t": t_end})
             if len(a.out) >= a.req.max_new:
                 self._complete(a, t_end, rep)
             else:
@@ -757,6 +944,54 @@ class ServeEngine:
             slots / (calls * self.cfg.max_batch) if calls else 0.0)
         s["mean_page_fragmentation"] = frag_sum / frag_n if frag_n else 0.0
         return s
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Live state of this replica, O(rows + queue + finished) host
+        work and zero device traffic — the flight-recorder window an
+        operator (or the ROADMAP-2c autoscaler) polls mid-run: occupancy,
+        queue depth, per-request ages at the engine's current virtual
+        clock, SLO attainment so far (``cfg.slo_ttft``/``slo_itl``; 0 =
+        no SLO, always-attained), and the ring of recent per-step states
+        (``cfg.flight_recorder`` entries)."""
+        now = self._last_t
+        reqs: List[Dict[str, Any]] = []
+        for a in sorted(self._active(), key=lambda x: x.admit_seq):
+            reqs.append({
+                "rid": a.req.rid, "state": a.state,
+                "age": now - (a.req.arrival if a.req.arrival is not None
+                              else 0.0),
+                "prefill_done": a.prefill_done,
+                "out_tokens": len(a.out), "pages": a.n_pages,
+            })
+        for r in self.queue:
+            # queued age = time since (re)enqueue, from _queued_at — for a
+            # never-evicted request that IS the arrival; for a requeued
+            # victim it is the current wait, matching the queue_wait span
+            q0 = self._queued_at.get(
+                r.rid, r.arrival if r.arrival is not None else 0.0)
+            reqs.append({
+                "rid": r.rid, "state": "queued", "age": now - q0,
+                "prefill_done": 0, "out_tokens": 0, "pages": 0,
+            })
+        slo_t = self.cfg.slo_ttft or None
+        slo_i = self.cfg.slo_itl or None
+        ok = sum(1 for f in self.finished
+                 if request_slo_ok(f, slo_t, slo_i))
+        return {
+            "t": now, "replica": self.replica,
+            "occupancy": self.allocator.occupancy(),
+            "free_pages": self.allocator.free_pages,
+            "shared_pages": self.allocator.shared_pages,
+            "queue_depth": len(self.queue),
+            "active": len(self._active()),
+            "completed": len(self.finished),
+            "evicted": int(self.stats["evicted"]),
+            "slo_attainment": ok / len(self.finished)
+            if self.finished else 0.0,
+            "requests": reqs,
+            "recent_steps": (list(self._flight)
+                             if self._flight is not None else []),
+        }
 
 
 class ReplicatedServer:
@@ -790,6 +1025,27 @@ class ReplicatedServer:
         for e in self.engines:
             out.extend(e.finished)
         return out
+
+    def snapshot(self) -> Dict[str, Any]:
+        """Fleet snapshot: per-replica snapshots plus the aggregates a
+        dispatcher/autoscaler reads — total queue depth and active count,
+        the WORST replica's occupancy (saturation is a max signal, same
+        reasoning as stats_summary's peak), and fleet-wide SLO attainment
+        so far."""
+        snaps = [e.snapshot() for e in self.engines]
+        fin = self.finished
+        slo_t = self.engines[0].cfg.slo_ttft or None
+        slo_i = self.engines[0].cfg.slo_itl or None
+        ok = sum(1 for f in fin if request_slo_ok(f, slo_t, slo_i))
+        return {
+            "t": max(s["t"] for s in snaps),
+            "replicas": snaps,
+            "queue_depth": sum(s["queue_depth"] for s in snaps),
+            "active": sum(s["active"] for s in snaps),
+            "completed": len(fin),
+            "occupancy": max(s["occupancy"] for s in snaps),
+            "slo_attainment": ok / len(fin) if fin else 0.0,
+        }
 
     def stats_summary(self) -> Dict[str, float]:
         sums: Dict[str, float] = {}
@@ -830,5 +1086,6 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
     for d in devices:
         engines.append(ServeEngine(
             model, params, state, rep_cfg, dtype=dtype, device=d,
-            shared_fns=engines[0].jit_fns() if engines else shared_fns))
+            shared_fns=engines[0].jit_fns() if engines else shared_fns,
+            replica=len(engines)))
     return ReplicatedServer(engines)

@@ -40,7 +40,7 @@ path). See the shared-pool contract in ops/paged_decode.py.
 
 from __future__ import annotations
 
-from typing import Dict, List
+from typing import Callable, Dict, List, Optional
 
 import numpy as np
 
@@ -67,6 +67,10 @@ class PrefixIndex:
         self.lookups = 0
         self.hit_blocks = 0
         self.reclaimed = 0
+        # optional (name, **args) sink for hit/reclaim instants — wired by
+        # the engine to the virtual-time tracer when cfg.trace is on (same
+        # hook discipline as PageAllocator.on_event)
+        self.on_event: Optional[Callable[..., None]] = None
 
     def __len__(self) -> int:
         return len(self._slots)
@@ -82,6 +86,9 @@ class PrefixIndex:
                 break
             slots.append(slot)
         self.hit_blocks += len(slots)
+        if slots and self.on_event is not None:
+            self.on_event("prefix_hit", blocks=len(slots),
+                          tokens=len(slots) * self.page)
         return slots
 
     def register(self, prompt: np.ndarray, block: int, slot: int) -> bool:
@@ -113,6 +120,9 @@ class PrefixIndex:
             self.allocator.decref(slot)
             self.reclaimed += 1
             freed += 1
+        if self.on_event is not None:
+            self.on_event("prefix_reclaim", asked=n_pages, freed=freed,
+                          entries=len(self._slots))
         return freed
 
     def drop_all(self) -> int:

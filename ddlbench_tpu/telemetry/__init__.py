@@ -16,6 +16,12 @@ This package is the decomposition layer the ROADMAP north star needs:
 * :mod:`telemetry.stats` — step-latency aggregation: p50/p95/p99/max per
   epoch plus explicit warmup/compile-time accounting, feeding the epoch
   log lines, JSONL, ``summary()``, and ``bench.py`` JSON.
+* :mod:`telemetry.serveview` — the serving-side reducer: request-
+  lifecycle traces (serve/engine.py under ``ServeConfig.trace``, stamped
+  in virtual model-pass units on one track per request per replica)
+  reduce to exact TTFT queue/prefill/decode/sched-gap decompositions,
+  ITL decode/preempted splits, and the windowed SLO-attainment + goodput
+  time series ROADMAP item 2c's autoscaler consumes.
 
 Host spans align with device traces through
 ``jax.profiler.StepTraceAnnotation`` wrapping in ``train/loop.py`` and the
@@ -32,11 +38,17 @@ from ddlbench_tpu.telemetry.tracer import (  # noqa: F401
     get_tracer,
     set_tracer,
 )
-from ddlbench_tpu.telemetry.export import export_chrome_trace  # noqa: F401
+from ddlbench_tpu.telemetry.export import (  # noqa: F401
+    export_chrome_trace,
+    trace_truncation,
+    warn_if_truncated,
+)
 from ddlbench_tpu.telemetry.overlap import overlap_fraction  # noqa: F401
 from ddlbench_tpu.telemetry.bubble import bubble_fraction  # noqa: F401
+from ddlbench_tpu.telemetry.serveview import breakdown  # noqa: F401
 from ddlbench_tpu.telemetry.stats import (  # noqa: F401
     StepLatencyStats,
     percentile,
+    request_slo_ok,
     serve_summary,
 )

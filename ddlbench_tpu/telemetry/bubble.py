@@ -138,11 +138,14 @@ def bubble_fraction(trace: Any,
         tracks.setdefault(_track_key(e), []).append((t0, t0 + float(e["dur"])))
         schedule = (e.get("args") or {}).get("schedule", schedule)
         spans += 1
+    from ddlbench_tpu.telemetry.export import trace_truncation
+
+    dropped = trace_truncation(trace)
     merged = {k: _merge(iv) for k, iv in tracks.items()}
     if not merged:
         return {"bubble_fraction": 0.0, "stages": 0, "tick_spans": 0,
                 "total_s": 0.0, "idle_s": 0.0, "per_stage": {},
-                "schedule": schedule}
+                "schedule": schedule, "dropped_events": dropped}
     lo = min(iv[0][0] for iv in merged.values() if iv)
     hi = max(iv[-1][1] for iv in merged.values() if iv)
     per_stage: Dict[str, float] = {}
@@ -165,6 +168,8 @@ def bubble_fraction(trace: Any,
         "idle_s": idle_us / 1e6,
         "per_stage": per_stage,
         "schedule": schedule,
+        # > 0 = the ring dropped events: the fraction under-counts idle
+        "dropped_events": dropped,
     }
 
 
@@ -187,6 +192,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     with open(args.trace) as f:
         doc = json.load(f)
+    from ddlbench_tpu.telemetry.export import warn_if_truncated
+
+    warn_if_truncated(doc, "bubble")
     prefixes = (tuple(s for s in args.spans.split(",") if s) if args.spans
                 else TICK_PREFIXES)
     print(json.dumps(bubble_fraction(doc, prefixes,

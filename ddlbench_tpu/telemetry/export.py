@@ -7,9 +7,19 @@ Emits the JSON Object Format of the Trace Event spec (the format
   MICROSECONDS (float; the spec's unit),
 * ``"C"`` counter samples and ``"i"`` instants pass through,
 * one ``"M"`` ``thread_name`` metadata event per thread, so the main
-  loop, every prefetch producer, and the watchdog each get a named track,
-* a top-level ``metadata`` object recording the tracer's drop count (the
-  ring keeps the newest window when a run outlives its capacity).
+  loop, every prefetch producer, and the watchdog each get a named track
+  (the serving engine's virtual-time events carry synthetic track names
+  instead — one track per request per replica, laid out the same way),
+* a top-level ``metadata`` object recording the tracer's drop count AND
+  ring capacity (the ring keeps the newest window when a run outlives
+  its capacity), plus any caller-supplied metadata (servebench embeds
+  its SLOs/time unit so ``serveview`` can default from the file).
+
+Truncation discipline: a reducer that silently under-counts on a
+truncated trace is worse than no reducer — :func:`trace_truncation`
+reads the drop count back out of any trace dict and
+:func:`warn_if_truncated` is the shared loud path every CLI reducer
+(``overlap``/``bubble``/``serveview``) goes through.
 
 All events share one ``pid`` (this is a single-process host trace; device
 timelines come from the ``jax.profiler`` capture next to it, aligned via
@@ -19,14 +29,17 @@ timelines come from the ``jax.profiler`` capture next to it, aligned via
 from __future__ import annotations
 
 import json
-from typing import Any, Dict, List
+import sys
+from typing import Any, Dict, List, Optional
 
 from ddlbench_tpu.telemetry.tracer import Tracer
 
 _PID = 1  # single host process; one pid keeps Perfetto's track grouping flat
 
 
-def chrome_trace_dict(tracer: Tracer) -> Dict[str, Any]:
+def chrome_trace_dict(tracer: Tracer,
+                      extra_metadata: Optional[Dict[str, Any]] = None,
+                      ) -> Dict[str, Any]:
     """Build the trace-event dict (separated from file I/O for tests)."""
     events: List[Dict[str, Any]] = []
     # Track key is (os thread id, thread name), mapped to a synthetic tid:
@@ -53,20 +66,58 @@ def chrome_trace_dict(tracer: Tracer) -> Dict[str, Any]:
         if args:
             evt["args"] = dict(args)
         events.append(evt)
+    metadata = {
+        "producer": "ddlbench_tpu.telemetry",
+        "dropped_events": tracer.dropped_events,
+        "capacity": tracer.capacity,
+    }
+    if extra_metadata:
+        metadata.update(extra_metadata)
     return {
         "traceEvents": events,
         "displayTimeUnit": "ms",
-        "metadata": {
-            "producer": "ddlbench_tpu.telemetry",
-            "dropped_events": tracer.dropped_events,
-        },
+        "metadata": metadata,
     }
 
 
-def export_chrome_trace(tracer: Tracer, path: str) -> int:
+def export_chrome_trace(tracer: Tracer, path: str,
+                        extra_metadata: Optional[Dict[str, Any]] = None,
+                        ) -> int:
     """Write the trace to ``path``; returns the number of span/counter
     events written (metadata events excluded)."""
-    doc = chrome_trace_dict(tracer)
+    doc = chrome_trace_dict(tracer, extra_metadata)
     with open(path, "w") as f:
         json.dump(doc, f)
     return sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
+
+
+def trace_truncation(doc: Any) -> int:
+    """Drop count recorded in a trace's metadata block: > 0 means the ring
+    overflowed and the OLDEST events are gone. 0 for bare event lists and
+    device traces (no metadata — nothing to claim either way)."""
+    if hasattr(doc, "dropped_events"):  # a live telemetry.Tracer
+        return int(doc.dropped_events)
+    if isinstance(doc, dict):
+        meta = doc.get("metadata") or {}
+        try:
+            return int(meta.get("dropped_events", 0) or 0)
+        except (TypeError, ValueError):
+            return 0
+    return 0
+
+
+def warn_if_truncated(doc: Any, reducer: str) -> int:
+    """Loud stderr banner when ``doc`` is a truncated trace — every CLI
+    reducer calls this so a windowed ring can never silently shrink the
+    figures it reports. Returns the drop count."""
+    n = trace_truncation(doc)
+    if n:
+        cap = ""
+        if isinstance(doc, dict):
+            c = (doc.get("metadata") or {}).get("capacity")
+            cap = f" (ring capacity {c})" if c else ""
+        print(f"{reducer}: WARNING: trace is TRUNCATED — {n} oldest events "
+              f"were dropped by the ring buffer{cap}; reduced figures "
+              "under-count the run. Re-capture with a larger "
+              "--trace-capacity.", file=sys.stderr, flush=True)
+    return n

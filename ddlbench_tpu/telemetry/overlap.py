@@ -142,6 +142,8 @@ def overlap_fraction(trace: Any,
     compute = _merge(compute_iv)
     comm_us = _total(comm)
     overlapped_us = _intersection(comm, compute)
+    from ddlbench_tpu.telemetry.export import trace_truncation
+
     return {
         "comm_s": comm_us / 1e6,  # trace ts/dur are microseconds
         "overlapped_s": overlapped_us / 1e6,
@@ -149,6 +151,8 @@ def overlap_fraction(trace: Any,
         "comm_spans": comm_spans,
         "compute_spans": compute_spans,
         "wire_bytes": wire_bytes,
+        # > 0 = the ring dropped events: the fractions under-count
+        "dropped_events": trace_truncation(trace),
     }
 
 
@@ -167,6 +171,9 @@ def main(argv=None) -> int:
     args = p.parse_args(argv)
     with open(args.trace) as f:
         doc = json.load(f)
+    from ddlbench_tpu.telemetry.export import warn_if_truncated
+
+    warn_if_truncated(doc, "overlap")
     comm = (tuple(s for s in args.comm.split(",") if s) if args.comm
             else COMM_PREFIXES)
     compute = (tuple(s for s in args.compute.split(",") if s)

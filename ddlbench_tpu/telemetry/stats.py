@@ -58,6 +58,24 @@ def latency_summary(samples_s: List[float]) -> Dict[str, float]:
     }
 
 
+def request_slo_ok(rec: Dict, slo_ttft: Optional[float] = None,
+                   slo_itl: Optional[float] = None) -> bool:
+    """One finished record's SLO verdict: TTFT <= slo_ttft AND mean ITL
+    (TPOT) <= slo_itl; an omitted SLO always passes. One home for the
+    predicate — serve_summary's goodput, the engine's
+    ``snapshot()['slo_attainment']``, and serveview's windowed attainment
+    must never disagree on what "met the SLO" means. ``arrival`` may be
+    None (a request submitted without a stamp — the engine treats that as
+    time 0 everywhere else, so the predicate does too)."""
+    arrival = rec["arrival"]
+    ttft = rec["first_token_t"] - (arrival if arrival is not None else 0.0)
+    times = rec["token_times"]
+    gaps = [b - a for a, b in zip(times, times[1:])]
+    tpot = sum(gaps) / len(gaps) if gaps else 0.0
+    return ((slo_ttft is None or ttft <= slo_ttft)
+            and (slo_itl is None or tpot <= slo_itl))
+
+
 def serve_summary(records: List[Dict], *, duration: float,
                   slo_ttft: Optional[float] = None,
                   slo_itl: Optional[float] = None) -> Dict[str, float]:
@@ -72,32 +90,34 @@ def serve_summary(records: List[Dict], *, duration: float,
     stats: TTFT (arrival -> first token) and ITL (gap between consecutive
     tokens of one request, pooled over all requests) p50/p95/p99, plus the
     serving headline — **goodput under SLO**: output tokens per time unit
-    counting ONLY requests that met BOTH SLOs (TTFT <= slo_ttft and mean
-    ITL a.k.a. TPOT <= slo_itl; an omitted SLO always passes). Throughput
-    counts every completed token; the goodput/throughput gap is the
-    capacity wasted on requests served too late to matter.
+    counting ONLY requests that met BOTH SLOs (:func:`request_slo_ok`).
+    Throughput counts every completed token; the goodput/throughput gap
+    is the capacity wasted on requests served too late to matter.
+
+    Degenerate inputs are schema-stable by contract: zero finished
+    requests and/or zero duration (a run that admitted nothing, a
+    snapshot taken at t=0) return the SAME key set with all-zero values —
+    never a ZeroDivisionError, never a dropped field (consumers scrape
+    these keys; tests/test_telemetry.py pins the edge paths).
     """
     ttfts, itls, good_tokens, total_tokens, n_ok = [], [], 0, 0, 0
     for r in records:
-        ttft = r["first_token_t"] - r["arrival"]
-        ttfts.append(ttft)
+        ttfts.append(r["first_token_t"] - r["arrival"])
         times = r["token_times"]
-        gaps = [b - a for a, b in zip(times, times[1:])]
-        itls.extend(gaps)
-        tpot = sum(gaps) / len(gaps) if gaps else 0.0
+        itls.extend(b - a for a, b in zip(times, times[1:]))
         total_tokens += r["n_tokens"]
-        ok = ((slo_ttft is None or ttft <= slo_ttft)
-              and (slo_itl is None or tpot <= slo_itl))
-        if ok:
+        if request_slo_ok(r, slo_ttft, slo_itl):
             n_ok += 1
             good_tokens += r["n_tokens"]
-    dur = max(duration, 1e-12)
     out = {
         "completed": len(records),
         "output_tokens": total_tokens,
         "duration": duration,
-        "throughput_tokens_per_unit": total_tokens / dur,
-        "goodput_tokens_per_unit": good_tokens / dur,
+        # zero-duration guard: rates are 0, not a divide blow-up
+        "throughput_tokens_per_unit": (total_tokens / duration
+                                       if duration > 0 else 0.0),
+        "goodput_tokens_per_unit": (good_tokens / duration
+                                    if duration > 0 else 0.0),
         "slo_attainment": n_ok / len(records) if records else 0.0,
         # prompt tokens served from the cross-request prefix cache
         # (serve/prefix.py) over all completed requests — 0 with the cache

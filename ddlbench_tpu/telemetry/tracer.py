@@ -124,6 +124,21 @@ class Tracer:
         self._append(("i", name, time.perf_counter_ns(), 0, th.ident or 0,
                       th.name, args or None))
 
+    def emit(self, phase: str, name: str, t0_ns: int, dur_ns: int = 0,
+             track: str = "virtual",
+             args: Optional[Dict[str, Any]] = None) -> None:
+        """Record an event on a named SYNTHETIC track with caller-supplied
+        timestamps — the virtual-time entry point. The serving engine
+        stamps request-lifecycle events in model-pass units scaled by
+        1000, so one virtual unit renders as 1 µs in the exported trace
+        and every timestamp stays an exact integer (serveview's TTFT
+        decomposition tiles without float drift). Synthetic tracks use
+        thread id 0, which no started thread carries, so they can never
+        alias a real thread's track in the exporter."""
+        if not self.enabled:
+            return
+        self._append((phase, name, int(t0_ns), int(dur_ns), 0, track, args))
+
     def _append(self, evt: Event) -> None:
         with self._lock:
             if len(self._events) == self._capacity:
@@ -139,6 +154,12 @@ class Tracer:
     def disable(self) -> "Tracer":
         self.enabled = False
         return self
+
+    @property
+    def capacity(self) -> int:
+        """Ring size — exported in the trace metadata so reducers can say
+        how big a --trace-capacity would have kept everything."""
+        return self._capacity
 
     def clear(self) -> None:
         with self._lock:

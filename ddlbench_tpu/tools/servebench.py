@@ -35,6 +35,17 @@ requests sharing a P-token prompt head, and ``--prefix-cache`` lets the
 continuous engine serve cached heads from resident KV pages — compare the
 ``prefill_tokens`` / ``ttft_p50`` / ``prefix_*`` fields against the same
 invocation without the flag (identical token streams, pinned).
+
+Observability (PR 11): ``--trace PATH`` records the request-lifecycle
+trace (serve/engine.py events in virtual time, one Chrome-trace track per
+request per replica plus counter tracks) and writes it Perfetto-loadable
+to PATH (``PATH.<policy>`` when several policies run) with the SLOs
+embedded in the metadata. Tracing is metrics-neutral: the JSON line and
+the token streams are bitwise identical with or without it (pinned).
+``--timeline`` additionally reduces the trace in-process
+(telemetry/serveview.py) and embeds the per-window SLO/goodput table +
+TTFT/ITL component breakdowns in the JSON line (``--window`` sets the
+bucket width).
 """
 
 from __future__ import annotations
@@ -43,6 +54,18 @@ import argparse
 import json
 import sys
 import time
+
+
+def _round6(v):
+    """round(_, 6) through nested timeline/breakdown structures so the
+    JSON stays bitwise-reproducible and diff-friendly."""
+    if isinstance(v, float):
+        return round(v, 6)
+    if isinstance(v, dict):
+        return {k: _round6(x) for k, x in v.items()}
+    if isinstance(v, list):
+        return [_round6(x) for x in v]
+    return v
 
 
 def run_open_loop(server, reqs):
@@ -137,6 +160,24 @@ def main(argv=None) -> int:
                    help="TTFT SLO in time units (model passes)")
     p.add_argument("--slo-itl", type=float, default=2.0,
                    help="mean inter-token-latency SLO in time units")
+    p.add_argument("--trace", default=None, metavar="PATH",
+                   help="record the request-lifecycle trace (virtual-time "
+                        "spans/counters, one track per request per replica)"
+                        " and write Chrome trace-event JSON here — "
+                        "PATH.<policy> when several policies run. Metrics-"
+                        "neutral: the JSON line is bitwise identical with "
+                        "or without this flag")
+    p.add_argument("--trace-capacity", type=int, default=200_000,
+                   help="trace ring size in events (the ring keeps the "
+                        "newest window and the metadata records drops)")
+    p.add_argument("--timeline", action="store_true",
+                   help="with --trace: reduce the trace via telemetry/"
+                        "serveview and embed the windowed SLO/goodput "
+                        "table + TTFT/ITL component breakdowns in the "
+                        "JSON line")
+    p.add_argument("--window", type=float, default=32.0,
+                   help="timeline bucket width in time units "
+                        "(with --timeline)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--paged-kernel", default="dots",
                    choices=("dots", "elementwise"),
@@ -148,6 +189,10 @@ def main(argv=None) -> int:
 
     add_platform_arg(p)
     args = p.parse_args(argv)
+    if args.timeline and not args.trace:
+        p.error("--timeline reduces a recorded trace; pass --trace PATH")
+    if args.window <= 0:
+        p.error("--window must be > 0 time units")
     apply_platform(args.platform)
 
     import jax
@@ -211,7 +256,8 @@ def main(argv=None) -> int:
         prefill_chunk=(args.page if args.prefill_chunk is None
                        else args.prefill_chunk),
         replicas=args.replicas, temperature=temperature, top_k=top_k,
-        sample_seed=args.seed)
+        sample_seed=args.seed, trace=bool(args.trace),
+        slo_ttft=args.slo_ttft, slo_itl=args.slo_itl)
 
     shared_fns = None
     for policy in policies:
@@ -238,12 +284,57 @@ def main(argv=None) -> int:
         server = make_server(model, params, state, cfg,
                              shared_fns=shared_fns)
         shared_fns = server.engines[0].jit_fns()
+        # one fresh bounded ring per policy row, installed process-global
+        # (the engines look it up lazily) and restored afterwards —
+        # recording never reorders the scheduler, so the run below is
+        # bitwise identical traced or not (pinned)
+        tracer = prev_tracer = None
+        if args.trace:
+            from ddlbench_tpu.telemetry.tracer import (Tracer, get_tracer,
+                                                       set_tracer)
+
+            prev_tracer = get_tracer()
+            tracer = set_tracer(Tracer(args.trace_capacity)).enable()
         t0 = time.perf_counter()
-        if args.arrival == "closed":
-            duration = run_closed_loop(server, reqs, args.concurrency)
-        else:
-            duration = run_open_loop(server, reqs)
+        try:
+            if args.arrival == "closed":
+                duration = run_closed_loop(server, reqs, args.concurrency)
+            else:
+                duration = run_open_loop(server, reqs)
+        finally:
+            if tracer is not None:
+                tracer.disable()
+                set_tracer(prev_tracer)
         wall = time.perf_counter() - t0
+        timeline_fields = {}
+        if tracer is not None:
+            from ddlbench_tpu.telemetry.export import export_chrome_trace
+
+            if args.timeline:
+                from ddlbench_tpu.telemetry.serveview import breakdown
+
+                bd = breakdown(tracer, slo_ttft=args.slo_ttft,
+                               slo_itl=args.slo_itl, window=args.window,
+                               per_request=False)
+                timeline_fields = {
+                    "window": args.window,
+                    "timeline": _round6(bd["timeline"]),
+                    "ttft_breakdown": _round6(bd["ttft"]),
+                    "itl_breakdown": _round6(bd["itl"]),
+                    "decomp_exact": bd["decomp_exact"],
+                }
+            path = (args.trace if len(policies) == 1
+                    else f"{args.trace}.{policy}")
+            n = export_chrome_trace(tracer, path, extra_metadata={
+                "serve": {"tool": "servebench", "policy": policy,
+                          "slo_ttft": args.slo_ttft,
+                          "slo_itl": args.slo_itl,
+                          "time_unit": "model_pass",
+                          "seed": args.seed}})
+            print(f"servebench: {n} trace events written to {path}"
+                  + (f" ({tracer.dropped_events} dropped: ring full)"
+                     if tracer.dropped_events else ""),
+                  file=sys.stderr, flush=True)
         rec = {
             "tool": "servebench",
             "model": args.model,
@@ -273,6 +364,10 @@ def main(argv=None) -> int:
             **{k: (round(v, 6) if isinstance(v, float) else v)
                for k, v in server.stats_summary().items()
                if k != "completed"},  # serve_summary already reports it
+            # --timeline only: windowed SLO/goodput series + TTFT/ITL
+            # component breakdowns (absent otherwise so a plain row stays
+            # bitwise identical traced or untraced)
+            **timeline_fields,
             # actual backend record (shared classification —
             # distributed.backend_provenance); cpu-fallback rows must be
             # identifiable as harness validation, not chip numbers

@@ -44,3 +44,44 @@ def devices():
     devs = jax.devices()
     assert len(devs) == 8, f"expected 8 virtual CPU devices, got {len(devs)}"
     return devs
+
+
+@pytest.fixture(scope="session")
+def serve_factory():
+    """Session-shared serving fixture (tier-1 budget, ROADMAP item 5):
+    ONE tiny LM plus a jitted-callable cache keyed by (page, sampling) —
+    the only two things the engine's traced programs close over — so
+    every serve test that builds an engine at the same page size reuses
+    the compiled decode/prefill/COW programs instead of re-tracing them
+    per test (``shared_fns``, the same mechanism servebench's policy rows
+    already use).
+
+    Call it with a ServeConfig to get a ServeEngine; pass ``server=True``
+    for a ReplicatedServer (make_server). ``.model``/``.params``/
+    ``.state`` expose the underlying LM for standalone-decode oracles.
+    """
+    from tiny_models import tiny_transformer
+
+    from ddlbench_tpu.models.layers import init_model
+
+    model = tiny_transformer()
+    params, state, _ = init_model(model, jax.random.key(0))
+    fns = {}
+
+    def make(cfg, *, server=False, **kw):
+        from ddlbench_tpu.serve.engine import ServeEngine, make_server
+
+        key = (cfg.page, cfg.temperature > 0.0)
+        shared = fns.get(key)
+        if server:
+            out = make_server(model, params, state, cfg,
+                              shared_fns=shared, **kw)
+            fns.setdefault(key, out.engines[0].jit_fns())
+        else:
+            out = ServeEngine(model, params, state, cfg,
+                              shared_fns=shared, **kw)
+            fns.setdefault(key, out.jit_fns())
+        return out
+
+    make.model, make.params, make.state = model, params, state
+    return make

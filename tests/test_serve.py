@@ -19,13 +19,11 @@ import pytest
 
 pytestmark = pytest.mark.serve
 
-import jax  # noqa: E402
 import jax.numpy as jnp  # noqa: E402
 
-from tiny_models import TINY_LM, tiny_transformer  # noqa: E402
+from tiny_models import TINY_LM  # noqa: E402
 
 from ddlbench_tpu.config import ServeConfig  # noqa: E402
-from ddlbench_tpu.models.layers import init_model  # noqa: E402
 from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: E402
 from ddlbench_tpu.serve.workload import (ServeRequest,  # noqa: E402
                                          make_workload)
@@ -35,10 +33,12 @@ T_MODEL = TINY_LM.seq_len  # 32
 
 
 @pytest.fixture(scope="module")
-def lm():
-    model = tiny_transformer()
-    params, state, _ = init_model(model, jax.random.key(0))
-    return model, params, state
+def lm(serve_factory):
+    """The session LM triple (standalone-oracle input). Engines are built
+    through ``serve_factory`` (tests/conftest.py) so every suite at the
+    same page size shares ONE set of compiled serve programs — the tier-1
+    budget refactor of ROADMAP item 5."""
+    return serve_factory.model, serve_factory.params, serve_factory.state
 
 
 def _standalone_stream(lm, prompt, max_new):
@@ -176,15 +176,12 @@ def test_serve_config_validation():
 # ---------------------------------------------------------------------------
 
 
-def test_chunked_serve_matches_standalone_and_packs(lm):
+def test_chunked_serve_matches_standalone_and_packs(lm, serve_factory):
     """The acceptance pin (chunked admission) + scheduler packing: steps
     mix prefill chunks with decode, within the token budget."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=16,
                       prefill_chunk=4, token_budget=10)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     rng = np.random.default_rng(11)
     # staggered prompt lengths: r0 finishes prefill first and decodes
     # while r1 is still prefilling -> a genuinely mixed step
@@ -211,15 +208,12 @@ def test_chunked_serve_matches_standalone_and_packs(lm):
     assert eng.allocator.in_use == 0
 
 
-def test_unchunked_serve_matches_standalone(lm):
+def test_unchunked_serve_matches_standalone(lm, serve_factory):
     """The acceptance pin, unchunked admission: the whole prompt in ONE
     padded prefill call (prefill_chunk=0)."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     cfg = ServeConfig(max_batch=2, pool_pages=17, page=4, max_len=16,
                       prefill_chunk=0)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     rng = np.random.default_rng(12)
     prompt = rng.integers(0, VOCAB, size=(7,)).astype(np.int32)
     eng.submit(ServeRequest(rid=0, prompt=prompt, max_new=5, arrival=0.0))
@@ -230,18 +224,15 @@ def test_unchunked_serve_matches_standalone(lm):
 
 
 @pytest.mark.slow
-def test_multipage_chunk_overflow_matches_standalone(lm):
+def test_multipage_chunk_overflow_matches_standalone(lm, serve_factory):
     """Regression pin: a multi-page prefill chunk whose padded tail runs
     past the last table column must NOT clamp onto the request's own live
     pages (paged_table_chunk_write scratch-extends the table). max_len 12
     (3 pages), chunk 8 (2 pages): the last chunk of an 11-token prompt
     starts at page 2 and its pad page overflows the table."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     cfg = ServeConfig(max_batch=1, pool_pages=5, page=4, max_len=12,
                       prefill_chunk=8)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     rng = np.random.default_rng(14)
     prompt = rng.integers(0, VOCAB, size=(11,)).astype(np.int32)
     eng.submit(ServeRequest(rid=0, prompt=prompt, max_new=1, arrival=0.0))
@@ -250,20 +241,17 @@ def test_multipage_chunk_overflow_matches_standalone(lm):
                                   _standalone_stream(lm, prompt, 1))
 
 
-def test_static_policy_drains_before_refilling(lm):
+def test_static_policy_drains_before_refilling(lm, serve_factory):
     """Regression pin: the static baseline must hold a drain BARRIER — once
     any request of a fill phase completes, no admission may happen until
     every row is free. Pre-fix, short-output traffic kept the fill phase
     open forever (completions kept freeing rows with the queue nonempty)
     and 'static' degenerated into budget-paced continuous admission."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     # one-chunk prompts, max_new=2, budget of 3 admissions/step against
     # max_batch=4: the fill trickles, completions overlap the tail of it
     cfg = ServeConfig(max_batch=4, pool_pages=17, page=4, max_len=16,
                       prefill_chunk=4, token_budget=12, policy="static")
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     rng = np.random.default_rng(15)
     for i in range(8):
         eng.submit(ServeRequest(
@@ -283,42 +271,40 @@ def test_static_policy_drains_before_refilling(lm):
     assert len(eng.finished) == 8
 
 
-def _harsh_pool_run(lm, seed):
+def _harsh_pool_run(serve_factory, seed):
     """10 Poisson requests through a 6-usable-page pool at page=2: constant
     page-boundary crossings and evictions, with row reuse scrambling row
     order vs admission order."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     reqs = make_workload(seed=seed, n_requests=10, vocab=VOCAB,
                          arrival="poisson", rate=1.5, prompt_lo=1,
                          prompt_typical=4, prompt_hi=8, out_lo=1,
                          out_typical=5, out_hi=9, max_len=12, tail_frac=0.4)
     cfg = ServeConfig(max_batch=4, pool_pages=7, page=2, max_len=12,
                       prefill_chunk=2, token_budget=8)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     _drain(eng, reqs)
     return eng, reqs
 
 
 @pytest.mark.slow
-def test_eviction_across_row_reuse_no_double_free(lm):
+def test_eviction_across_row_reuse_no_double_free(serve_factory):
     """Regression pin: a victim can sit at a LOWER row index than its
     evictor (rows are reused, so row order diverges from admission order)
     — the scheduler must drop rows evicted mid-scheduling instead of
     running them dead (which decoded against a zeroed table row and
     double-freed the victim's pages at its final token)."""
-    eng, reqs = _harsh_pool_run(lm, seed=4)  # this seed crashed pre-fix
+    # this seed crashed pre-fix
+    eng, reqs = _harsh_pool_run(serve_factory, seed=4)
     assert len(eng.finished) == len(reqs)
     assert eng.stats["evicted"] > 0
     assert eng.allocator.in_use == 0
 
 
 @pytest.mark.slow
-def test_harsh_pool_streams_match_standalone(lm):
+def test_harsh_pool_streams_match_standalone(lm, serve_factory):
     """The harsh-pool run's streams still equal the standalone greedy
     continuation — eviction/recompute under row reuse is numerics-clean."""
-    eng, reqs = _harsh_pool_run(lm, seed=4)
+    eng, reqs = _harsh_pool_run(serve_factory, seed=4)
     by_rid = {r.rid: r for r in reqs}
     for f in eng.finished:
         rq = by_rid[f["rid"]]
@@ -328,18 +314,15 @@ def test_harsh_pool_streams_match_standalone(lm):
 
 
 @pytest.mark.slow
-def test_eviction_recompute_matches_standalone(lm):
+def test_eviction_recompute_matches_standalone(lm, serve_factory):
     """Pool exhaustion evicts the newest request; recomputation after
     readmission regenerates the same stream (greedy determinism), and the
     freed pages were genuinely reusable."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     # 8 usable pages, two requests needing ~6 pages each at full length:
     # the second must be evicted at least once
     cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=24,
                       prefill_chunk=4)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     rng = np.random.default_rng(13)
     prompts = [rng.integers(0, VOCAB, size=(9,)).astype(np.int32),
                rng.integers(0, VOCAB, size=(9,)).astype(np.int32)]
@@ -356,20 +339,17 @@ def test_eviction_recompute_matches_standalone(lm):
 
 
 @pytest.mark.slow
-def test_mixed_open_loop_workload_matches_standalone(lm):
+def test_mixed_open_loop_workload_matches_standalone(lm, serve_factory):
     """Poisson arrivals, heavy-tail lengths, an undersized pool (evictions
     + backpressure), staggered admission — every completed stream still
     equals its standalone greedy continuation."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     reqs = make_workload(seed=3, n_requests=8, vocab=VOCAB,
                          arrival="poisson", rate=0.5, prompt_lo=2,
                          prompt_typical=6, prompt_hi=14, out_lo=2,
                          out_typical=6, out_hi=12, max_len=28)
     cfg = ServeConfig(max_batch=4, pool_pages=9, page=4, max_len=28,
                       prefill_chunk=4)
-    eng = ServeEngine(model, params, state, cfg)
+    eng = serve_factory(cfg)
     _, reps = _drain(eng, reqs)
     assert len(eng.finished) == len(reqs)
     by_rid = {r.rid: r for r in reqs}
@@ -381,12 +361,9 @@ def test_mixed_open_loop_workload_matches_standalone(lm):
 
 
 @pytest.mark.slow
-def test_replicated_server_matches_standalone(lm):
+def test_replicated_server_matches_standalone(lm, serve_factory):
     """Least-loaded dispatch over 2 replicas: same streams, work spread
     across both engines."""
-    from ddlbench_tpu.serve.engine import make_server
-
-    model, params, state = lm
     reqs = make_workload(seed=9, n_requests=6, vocab=VOCAB,
                          arrival="closed", prompt_lo=2, prompt_typical=6,
                          prompt_hi=10, out_lo=2, out_typical=5, out_hi=8,
@@ -395,7 +372,7 @@ def test_replicated_server_matches_standalone(lm):
         r.arrival = 0.0
     cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=16,
                       prefill_chunk=4, replicas=2)
-    srv = make_server(model, params, state, cfg)
+    srv = serve_factory(cfg, server=True)
     _drain(srv, reqs)
     assert len(srv.finished) == len(reqs)
     assert all(e.stats["admitted"] > 0 for e in srv.engines)

@@ -25,12 +25,9 @@ import pytest
 
 pytestmark = pytest.mark.serve
 
-import jax  # noqa: E402
-
-from tiny_models import TINY_LM, tiny_transformer  # noqa: E402
+from tiny_models import TINY_LM  # noqa: E402
 
 from ddlbench_tpu.config import ServeConfig  # noqa: E402
-from ddlbench_tpu.models.layers import init_model  # noqa: E402
 from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: E402
 from ddlbench_tpu.serve.prefix import PrefixIndex  # noqa: E402
 from ddlbench_tpu.serve.workload import (ServeRequest,  # noqa: E402
@@ -40,10 +37,11 @@ VOCAB = TINY_LM.num_classes
 
 
 @pytest.fixture(scope="module")
-def lm():
-    model = tiny_transformer()
-    params, state, _ = init_model(model, jax.random.key(0))
-    return model, params, state
+def lm(serve_factory):
+    """The session LM triple (standalone-oracle input); engines come from
+    ``serve_factory`` so the whole serve suite shares compiled programs
+    (tier-1 budget, ROADMAP item 5)."""
+    return serve_factory.model, serve_factory.params, serve_factory.state
 
 
 def _standalone_stream(lm, prompt, max_new):
@@ -73,16 +71,14 @@ def _drain(engine, reqs=None, now=0.0):
     return now
 
 
-def _engine(lm, prefix_cache, shared_from=None, **cfg_kw):
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
+def _engine(serve_factory, prefix_cache, **cfg_kw):
+    # the factory's (page, sampling)-keyed cache supersedes the old
+    # per-test shared_from plumbing: cache-on/off pairs — and every other
+    # suite at page=4 — reuse one set of compiled programs
     kw = dict(max_batch=2, pool_pages=17, page=4, max_len=24,
               prefill_chunk=4)
     kw.update(cfg_kw)
-    return ServeEngine(
-        model, params, state, ServeConfig(prefix_cache=prefix_cache, **kw),
-        shared_fns=shared_from.jit_fns() if shared_from else None)
+    return serve_factory(ServeConfig(prefix_cache=prefix_cache, **kw))
 
 
 def _tokens(eng):
@@ -224,7 +220,7 @@ def _prompts_sharing_prefix(rng, n_tail=(3, 5)):
     ]
 
 
-def test_prefix_hit_and_cow_stream_equals_cache_off(lm):
+def test_prefix_hit_and_cow_stream_equals_cache_off(serve_factory):
     """The tier-1 acceptance pin at the smallest real shape: a PARTIAL hit
     (B = A's one-page head + a tail binds the cached page, prefills only
     the tail) and a FULL page-aligned hit (C = A's prompt exactly — zero
@@ -240,8 +236,7 @@ def test_prefix_hit_and_cow_stream_equals_cache_off(lm):
     prompts = [head.copy(), np.concatenate([head, tail]), head.copy()]
     runs = {}
     for cache_on in (True, False):
-        eng = _engine(lm, cache_on, max_len=16, pool_pages=13,
-                      shared_from=runs.get(True))
+        eng = _engine(serve_factory, cache_on, max_len=16, pool_pages=13)
         for rid, pr in enumerate(prompts):
             # sequential so A's page is registered before B/C admit
             eng.submit(ServeRequest(rid=rid, prompt=pr, max_new=2,
@@ -261,7 +256,7 @@ def test_prefix_hit_and_cow_stream_equals_cache_off(lm):
 
 
 @pytest.mark.slow
-def test_prefix_full_hit_cow_multipage(lm):
+def test_prefix_full_hit_cow_multipage(serve_factory):
     """Full page-aligned hit at two pages: B's prompt IS A's (8 tokens) —
     B skips prefill entirely, COWs the LAST cached page (the first page
     stays shared), and decodes the identical stream. The COW matters: B's
@@ -270,7 +265,7 @@ def test_prefix_full_hit_cow_multipage(lm):
     prefix, _ = _prompts_sharing_prefix(rng)
     runs = {}
     for cache_on in (True, False):
-        eng = _engine(lm, cache_on, shared_from=runs.get(True))
+        eng = _engine(serve_factory, cache_on)
         for rid in (0, 1):
             eng.submit(ServeRequest(rid=rid, prompt=prefix.copy(),
                                     max_new=3, arrival=0.0))
@@ -294,7 +289,7 @@ def test_prefix_full_hit_cow_multipage(lm):
 
 
 @pytest.mark.slow
-def test_prefix_miss_is_bitwise_inert(lm):
+def test_prefix_miss_is_bitwise_inert(serve_factory):
     """No shared content: the cache must change NOTHING — same streams,
     same step reports, zero counters (cache-on == cache-off behavior, not
     just output)."""
@@ -303,7 +298,7 @@ def test_prefix_miss_is_bitwise_inert(lm):
                for n in (5, 9)]
     runs = {}
     for cache_on in (True, False):
-        eng = _engine(lm, cache_on)
+        eng = _engine(serve_factory, cache_on)
         reqs = [ServeRequest(rid=i, prompt=p, max_new=3, arrival=0.0)
                 for i, p in enumerate(prompts)]
         _drain(eng, reqs)
@@ -317,7 +312,7 @@ def test_prefix_miss_is_bitwise_inert(lm):
 
 
 @pytest.mark.slow
-def test_prefix_unchunked_admission_hits_too(lm):
+def test_prefix_unchunked_admission_hits_too(lm, serve_factory):
     """prefill_chunk=0 (whole-prompt-in-one-padded-call): the tail chunk
     starts at the bound frontier, so hits compose with unchunked
     admission as well."""
@@ -325,7 +320,8 @@ def test_prefix_unchunked_admission_hits_too(lm):
     _, prompts = _prompts_sharing_prefix(rng)
     runs = {}
     for cache_on in (True, False):
-        eng = _engine(lm, cache_on, prefill_chunk=0, token_budget=26)
+        eng = _engine(serve_factory, cache_on, prefill_chunk=0,
+                      token_budget=26)
         for rid, pr in enumerate(prompts):
             eng.submit(ServeRequest(rid=rid, prompt=pr, max_new=3,
                                     arrival=0.0))
@@ -342,7 +338,7 @@ def test_prefix_unchunked_admission_hits_too(lm):
 
 
 @pytest.mark.slow
-def test_cow_divergence_neither_stream_corrupts(lm):
+def test_cow_divergence_neither_stream_corrupts(lm, serve_factory):
     """The COW-divergence pin: two requests share a full cached prompt
     then diverge through their own sampled-free greedy continuations IN
     FLIGHT TOGETHER — B's COW'd page takes B's decode writes while A's
@@ -350,7 +346,7 @@ def test_cow_divergence_neither_stream_corrupts(lm):
     the prefix afterwards still gets the uncorrupted history."""
     rng = np.random.default_rng(25)
     prefix, _ = _prompts_sharing_prefix(rng)
-    eng = _engine(lm, True)
+    eng = _engine(serve_factory, True)
     # A prefills + caches, then A and B decode concurrently (A resubmitted
     # with a longer continuation so both are in flight)
     eng.submit(ServeRequest(rid=0, prompt=prefix.copy(), max_new=8,
@@ -382,7 +378,7 @@ def test_cow_divergence_neither_stream_corrupts(lm):
 
 
 @pytest.mark.slow
-def test_reclaim_cannot_recycle_matched_hit_pages(lm):
+def test_reclaim_cannot_recycle_matched_hit_pages(lm, serve_factory):
     """Regression pin (review): admission must PIN its matched prefix
     pages before allocating the tail — _alloc's cache reclaim frees
     exactly the index-only (refcount-1) pages, which the matched-but-not-
@@ -401,8 +397,7 @@ def test_reclaim_cannot_recycle_matched_hit_pages(lm):
     for cache_on in (True, False):
         # 4 usable pages: E (2 blocks) then A (2 blocks) fill the pool
         # completely as cache-resident pages before B arrives
-        eng = _engine(lm, cache_on, pool_pages=5, max_len=16,
-                      shared_from=runs.get(True))
+        eng = _engine(serve_factory, cache_on, pool_pages=5, max_len=16)
         for rid, (pr, mn) in enumerate([(pr_e, 1), (pr_a, 1), (pr_b, 2)]):
             eng.submit(ServeRequest(rid=rid, prompt=pr, max_new=mn,
                                     arrival=0.0))
@@ -415,7 +410,7 @@ def test_reclaim_cannot_recycle_matched_hit_pages(lm):
 
 
 @pytest.mark.slow
-def test_refcounted_eviction_shared_pages_survive(lm):
+def test_refcounted_eviction_shared_pages_survive(lm, serve_factory):
     """Refcounted eviction pin: under a pool too small for everyone, the
     engine reclaims cache-only pages and evicts requests — but pages a
     live request still references are never freed under it, streams stay
@@ -429,7 +424,8 @@ def test_refcounted_eviction_shared_pages_survive(lm):
     for cache_on in (True, False):
         # 10 usable pages; four 10-13 token requests + outputs cannot all
         # fit: evictions + cache reclaim both fire
-        eng = _engine(lm, cache_on, max_batch=4, pool_pages=11, max_len=20)
+        eng = _engine(serve_factory, cache_on, max_batch=4, pool_pages=11,
+                      max_len=20)
         reqs = [ServeRequest(rid=i, prompt=p, max_new=6,
                              arrival=float(i))
                 for i, p in enumerate(prompts)]
@@ -452,7 +448,7 @@ def test_refcounted_eviction_shared_pages_survive(lm):
 
 
 @pytest.mark.slow
-def test_shared_prefix_open_loop_cache_on_off_bitwise(lm):
+def test_shared_prefix_open_loop_cache_on_off_bitwise(lm, serve_factory):
     """The acceptance pin at workload scale: seeded shared-prefix Poisson
     traffic, cache on vs off — bitwise-identical token streams, strictly
     fewer prefill tokens, hits > 0."""
@@ -460,7 +456,7 @@ def test_shared_prefix_open_loop_cache_on_off_bitwise(lm):
     reqs_b = _shared_workload(7)
     runs = {}
     for cache_on, reqs in ((True, reqs_a), (False, reqs_b)):
-        eng = _engine(lm, cache_on, max_batch=4, pool_pages=33)
+        eng = _engine(serve_factory, cache_on, max_batch=4, pool_pages=33)
         _drain(eng, reqs)
         runs[cache_on] = eng
         assert len(eng.finished) == len(reqs)
@@ -481,8 +477,9 @@ def test_shared_prefix_open_loop_cache_on_off_bitwise(lm):
 # ---------------------------------------------------------------------------
 
 
-def _sampled_run(lm, temperature, top_k, seed, prefix_cache=False):
-    eng = _engine(lm, prefix_cache, pool_pages=9, max_len=16,
+def _sampled_run(serve_factory, temperature, top_k, seed,
+                 prefix_cache=False):
+    eng = _engine(serve_factory, prefix_cache, pool_pages=9, max_len=16,
                   token_budget=10, temperature=temperature, top_k=top_k,
                   sample_seed=seed)
     rng = np.random.default_rng(31)
@@ -523,36 +520,33 @@ def test_sample_token_host_determinism():
 
 
 @pytest.mark.slow
-def test_sampling_reproducible_and_not_argmax(lm):
+def test_sampling_reproducible_and_not_argmax(serve_factory):
     """Identical seed => bitwise-identical sampled streams through the
     engine, and sampling is not secretly argmax."""
-    a = _sampled_run(lm, 1.0, 0, seed=0)
-    b = _sampled_run(lm, 1.0, 0, seed=0)
-    g = _sampled_run(lm, 0.0, 0, seed=0)
+    a = _sampled_run(serve_factory, 1.0, 0, seed=0)
+    b = _sampled_run(serve_factory, 1.0, 0, seed=0)
+    g = _sampled_run(serve_factory, 0.0, 0, seed=0)
     assert a == b  # bitwise per seed
     assert a != g  # and sampling is not secretly argmax
 
 
 @pytest.mark.slow
-def test_sampling_seed_and_topk_variants(lm):
-    a = _sampled_run(lm, 1.0, 0, seed=0)
-    c = _sampled_run(lm, 1.0, 0, seed=1)
-    k = _sampled_run(lm, 1.0, 5, seed=0)
-    g = _sampled_run(lm, 0.0, 0, seed=0)
+def test_sampling_seed_and_topk_variants(serve_factory):
+    a = _sampled_run(serve_factory, 1.0, 0, seed=0)
+    c = _sampled_run(serve_factory, 1.0, 0, seed=1)
+    k = _sampled_run(serve_factory, 1.0, 5, seed=0)
+    g = _sampled_run(serve_factory, 0.0, 0, seed=0)
     assert a != c  # the seed is live
     assert a != k  # top-k restricts the support
     # top-k=1 IS argmax (the distribution collapses onto the best token)
-    assert _sampled_run(lm, 1.0, 1, seed=0) == g
+    assert _sampled_run(serve_factory, 1.0, 1, seed=0) == g
 
 
 @pytest.mark.slow
-def test_sampling_eviction_recompute_identical(lm):
+def test_sampling_eviction_recompute_identical(serve_factory):
     """Token-index-keyed seeds: a sampled request evicted mid-decode and
     recomputed must re-draw the IDENTICAL stream (seeding by engine step
     would fork it)."""
-    from ddlbench_tpu.serve.engine import ServeEngine
-
-    model, params, state = lm
     rng = np.random.default_rng(32)
     prompts = [rng.integers(0, VOCAB, size=(9,)).astype(np.int32)
                for _ in range(2)]
@@ -560,7 +554,7 @@ def test_sampling_eviction_recompute_identical(lm):
     for pool in (9, 33):  # harsh pool (evictions) vs roomy pool (none)
         cfg = ServeConfig(max_batch=2, pool_pages=pool, page=4, max_len=24,
                           prefill_chunk=4, temperature=1.0, sample_seed=5)
-        eng = ServeEngine(model, params, state, cfg)
+        eng = serve_factory(cfg)
         reqs = [ServeRequest(rid=i, prompt=p, max_new=12, arrival=0.0)
                 for i, p in enumerate(prompts)]
         _drain(eng, reqs)

@@ -1,0 +1,572 @@
+"""Request-lifecycle tracing + SLO flight recorder (PR 11) coverage.
+
+The binding contracts:
+
+* **Metrics neutrality** — servebench's virtual-time JSON and the engine's
+  token streams are BITWISE identical with ``trace`` on vs off (the same
+  discipline as the train loop's ``--trace`` pin): tracing records
+  decisions, never makes them.
+* **Exact decomposition** — serveview's per-request TTFT components
+  (queue / prefill / decode / sched_gap) tile the reported TTFT exactly
+  in virtual time; ``decomp_exact`` is a live invariant, not a rounding
+  statement.
+* **The windowed SLO series is a signal** — on a trickle→burst→trickle
+  fixture, attainment sits at 1.0 before the burst, dips while the burst's
+  queue drains, and recovers to 1.0 after (pinned ordering, not values).
+
+Engine tests build through the session ``serve_factory`` (conftest) so
+the tracing pins reuse the serve suites' compiled programs instead of
+adding compile bill to the tier-1 gate (ROADMAP item 5 down-payment).
+"""
+
+import contextlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: E402
+from ddlbench_tpu.serve.prefix import PrefixIndex  # noqa: E402
+from ddlbench_tpu.serve.workload import ServeRequest  # noqa: E402
+from ddlbench_tpu.telemetry import (Tracer, get_tracer,  # noqa: E402
+                                    set_tracer)
+from ddlbench_tpu.telemetry.export import (chrome_trace_dict,  # noqa: E402
+                                           export_chrome_trace,
+                                           trace_truncation)
+from ddlbench_tpu.telemetry.serveview import breakdown  # noqa: E402
+from ddlbench_tpu.telemetry.stats import (request_slo_ok,  # noqa: E402
+                                          serve_summary)
+
+VOCAB = TINY_LM.num_classes
+
+
+@pytest.fixture(autouse=True)
+def _restore_global_tracer():
+    before = get_tracer()
+    yield
+    set_tracer(before)
+
+
+def _drain(engine_or_server, reqs=None, now=0.0):
+    pend = sorted(reqs or [], key=lambda r: (r.arrival or 0.0, r.rid))
+    i = 0
+    while i < len(pend) or engine_or_server.has_work():
+        while i < len(pend) and (pend[i].arrival or 0.0) <= now:
+            engine_or_server.submit(pend[i])
+            i += 1
+        if not engine_or_server.has_work():
+            now = pend[i].arrival
+            continue
+        rep = engine_or_server.step(now)
+        now += rep.cost
+    return now
+
+
+def _reqs(rng, spec):
+    """[(rid, prompt_len, max_new, arrival), ...] -> ServeRequests."""
+    return [ServeRequest(
+        rid=rid,
+        prompt=rng.integers(0, VOCAB, size=(s,)).astype(np.int32),
+        max_new=m, arrival=float(t)) for rid, s, m, t in spec]
+
+
+# ---------------------------------------------------------------------------
+# Tracer/export plumbing (pure host code).
+# ---------------------------------------------------------------------------
+
+
+def test_emit_synthetic_tracks_and_export():
+    """emit() lays events on named synthetic tracks with caller-supplied
+    virtual timestamps; the exporter gives each track its own tid."""
+    tr = Tracer().enable()
+    tr.emit("X", "queue_wait", 0, 3000, track="r0/req1", args={"rid": 1})
+    tr.emit("X", "decode", 3000, 1000, track="r0/req2", args={"rid": 2})
+    tr.emit("C", "queue_depth[r0]", 4000, track="r0/engine",
+            args={"value": 2.0})
+    doc = chrome_trace_dict(tr)
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert names == {"r0/req1", "r0/req2", "r0/engine"}
+    spans = {e["name"]: e for e in doc["traceEvents"] if e["ph"] == "X"}
+    # virtual scaling: 1000 trace-ns = 1 exported µs = 1 model pass
+    assert spans["queue_wait"]["ts"] == 0.0
+    assert spans["queue_wait"]["dur"] == 3.0
+    assert spans["decode"]["ts"] == 3.0
+    # disabled: emit is a no-op like every other recording call
+    tr.disable()
+    tr.emit("X", "x", 0, 1)
+    assert len(tr) == 3
+
+
+def test_export_metadata_capacity_and_extra():
+    tr = Tracer(capacity=4).enable()
+    for i in range(9):
+        tr.complete(f"e{i}", i, i + 1)
+    doc = chrome_trace_dict(tr, extra_metadata={"serve": {"slo_ttft": 8.0}})
+    assert doc["metadata"]["capacity"] == 4
+    assert doc["metadata"]["dropped_events"] == 5
+    assert doc["metadata"]["serve"] == {"slo_ttft": 8.0}
+    assert trace_truncation(doc) == 5
+    assert trace_truncation(tr) == 5
+    assert trace_truncation({"traceEvents": []}) == 0
+    assert trace_truncation([]) == 0  # bare event lists have no metadata
+
+
+def test_reducers_warn_loudly_on_truncated_traces(tmp_path, capsys):
+    """overlap/bubble/serveview CLIs must not silently under-count a
+    ring-truncated trace."""
+    from ddlbench_tpu.telemetry.bubble import main as bubble_main
+    from ddlbench_tpu.telemetry.overlap import main as overlap_main
+    from ddlbench_tpu.telemetry.serveview import main as serveview_main
+
+    tr = Tracer(capacity=2).enable()
+    for i in range(6):
+        tr.complete(f"rs_bucket{i}", i * 10, i * 10 + 5)
+    path = tmp_path / "trunc.trace.json"
+    export_chrome_trace(tr, str(path))
+    for main, name in ((overlap_main, "overlap"), (bubble_main, "bubble"),
+                       (serveview_main, "serveview")):
+        assert main([str(path)]) == 0
+        err = capsys.readouterr().err
+        assert "TRUNCATED" in err and name in err
+    # the library reductions carry the count too
+    from ddlbench_tpu.telemetry import bubble_fraction, overlap_fraction
+
+    doc = json.load(open(path))
+    assert overlap_fraction(doc)["dropped_events"] == 4
+    assert bubble_fraction(doc)["dropped_events"] == 4
+    assert breakdown(doc)["dropped_events"] == 4
+
+
+def test_allocator_and_prefix_on_event_hooks():
+    """The jax-free pool/prefix modules surface lifecycle instants through
+    an optional callback — the engine's bridge onto the trace."""
+    seen = []
+    al = PageAllocator(9)
+    al.on_event = lambda name, **kw: seen.append((name, kw))
+    slots = al.alloc(rid=1, n=2)
+    assert seen[-1] == ("pool_alloc", {"rid": 1, "pages": 2, "free": 6})
+    idx = PrefixIndex(al, page=4)
+    idx.on_event = al.on_event
+    prompt = np.arange(8, dtype=np.int32)
+    for b, s in enumerate(slots):
+        idx.register(prompt, b, s)
+    idx.match(prompt)
+    assert seen[-1] == ("prefix_hit", {"blocks": 2, "tokens": 8})
+    al.free_request(1)
+    assert seen[-1][0] == "pool_release"
+    assert seen[-1][1]["freed"] == 0  # the index still pins both pages
+    idx.reclaim(2)
+    assert seen[-1] == ("prefix_reclaim",
+                        {"asked": 2, "freed": 2, "entries": 0})
+    # hook removed -> silent again (the trace-off path)
+    al.on_event = None
+    al.alloc(rid=2, n=1)
+    assert seen[-1][0] == "prefix_reclaim"
+
+
+def test_serveview_decomposition_on_synthetic_trace():
+    """serveview's interval math pinned without an engine: hand-laid
+    events with known queue/prefill/gap/decode tiling."""
+    tr = Tracer().enable()
+    t = lambda u: int(u * 1000)  # noqa: E731 — virtual units -> trace ns
+
+    def req_events(rid, submit, admit, chunks, ft, toks, finish):
+        trk = f"r0/req{rid}"
+        tr.emit("i", "submit", t(submit), track=trk, args={"rid": rid})
+        tr.emit("X", "queue_wait", t(submit), t(admit) - t(submit),
+                track=trk, args={"rid": rid})
+        tr.emit("i", "admit", t(admit), track=trk,
+                args={"rid": rid, "cached_tokens": 0})
+        for c0, c1 in chunks:
+            tr.emit("X", "prefill_chunk", t(c0), t(c1) - t(c0), track=trk,
+                    args={"rid": rid})
+        tr.emit("i", "first_token", t(ft), track=trk, args={"rid": rid})
+        for k, (d0, d1) in enumerate(toks):
+            tr.emit("X", "decode", t(d0), t(d1) - t(d0), track=trk,
+                    args={"rid": rid, "tok": k + 1})
+        tr.emit("i", "finish", t(finish), track=trk,
+                args={"rid": rid, "n_tokens": 1 + len(toks)})
+
+    # rid 0: queue 2, prefill [2,5)+[6,8) = 5, gap [5,6) = 1 -> ttft 8;
+    # then decode gaps: tok1 at 10 (decode [9,10): 1 decode + 1 preempted)
+    req_events(0, submit=0, admit=2, chunks=[(2, 5), (6, 8)], ft=8,
+               toks=[(9, 10)], finish=10)
+    out = breakdown(tr, slo_ttft=8.0, slo_itl=2.5, window=8.0)
+    assert out["requests"] == 1 and out["decomp_exact"]
+    d = out["per_request"][0]
+    assert (d["queue"], d["prefill"], d["sched_gap"], d["decode"],
+            d["ttft"]) == (2.0, 5.0, 1.0, 0.0, 8.0)
+    assert out["itl"]["decode"]["p50"] == 1.0
+    assert out["itl"]["preempted"]["p50"] == 1.0
+    # timeline: finish at 10 -> bucket [8, 16); SLO met exactly (ttft 8)
+    tl = out["timeline"]
+    assert [b["completed"] for b in tl] == [0, 1]
+    assert tl[1]["attainment"] == 1.0
+    assert tl[1]["good_tokens"] == 2
+
+
+def test_serve_summary_zero_paths_schema_stable():
+    """The degenerate inputs return the SAME key set, all-zero — consumers
+    scrape these keys (satellite pin)."""
+    full = serve_summary(
+        [{"rid": 0, "arrival": 0.0, "first_token_t": 2.0, "n_tokens": 2,
+          "token_times": [2.0, 3.0], "cached_tokens": 0}],
+        duration=3.0, slo_ttft=8.0, slo_itl=2.5)
+    empty = serve_summary([], duration=0.0, slo_ttft=8.0, slo_itl=2.5)
+    assert set(empty) == set(full)
+    assert empty["completed"] == 0 and empty["output_tokens"] == 0
+    assert empty["throughput_tokens_per_unit"] == 0.0
+    assert empty["goodput_tokens_per_unit"] == 0.0
+    assert empty["slo_attainment"] == 0.0
+    assert empty["ttft_p99"] == 0.0 and empty["itl_p50"] == 0.0
+    # zero duration with nonzero tokens must not blow up either
+    zd = serve_summary(
+        [{"rid": 0, "arrival": 0.0, "first_token_t": 0.0, "n_tokens": 1,
+          "token_times": [0.0], "cached_tokens": 0}], duration=0.0)
+    assert zd["throughput_tokens_per_unit"] == 0.0
+    assert zd["completed"] == 1
+    # single-token request: no gaps -> TPOT 0 passes any ITL SLO
+    assert request_slo_ok({"arrival": 0.0, "first_token_t": 1.0,
+                           "token_times": [1.0]}, 2.0, 0.5)
+
+
+# ---------------------------------------------------------------------------
+# Engine pins (session serve_factory — shared compiled programs).
+# ---------------------------------------------------------------------------
+
+
+_TRACE_CFG = dict(max_batch=2, pool_pages=9, page=4, max_len=16,
+                  prefill_chunk=4, token_budget=10)
+
+
+def _mixed_spec():
+    # staggered prompts: chunked prefill, mixed steps, queueing under
+    # max_batch=2 — every lifecycle event class fires except eviction
+    return [(0, 3, 4, 0), (1, 9, 4, 0), (2, 5, 3, 4), (3, 4, 2, 6)]
+
+
+def test_tracing_is_metrics_neutral_engine(serve_factory):
+    """The tier-1 neutrality pin: identical finished records (tokens AND
+    virtual times) and identical stats with trace off vs on."""
+    runs = {}
+    for trace_on in (False, True):
+        tracer = set_tracer(Tracer()).enable() if trace_on else None
+        cfg = ServeConfig(trace=trace_on, **_TRACE_CFG)
+        eng = serve_factory(cfg)
+        _drain(eng, _reqs(np.random.default_rng(3), _mixed_spec()))
+        runs[trace_on] = eng
+    assert runs[False].finished == runs[True].finished  # tokens + times
+    assert runs[False].stats == runs[True].stats
+    assert tracer is not None and len(tracer) > 0
+    names = {e[1] for e in tracer.events()}
+    assert {"submit", "queue_wait", "admit", "prefill_chunk",
+            "first_token", "decode", "finish", "pool_alloc",
+            "pool_release"} <= names
+    # counter tracks sampled every step
+    steps = runs[True].stats["steps"]
+    depth = [e for e in tracer.events() if e[1] == "queue_depth[r0]"]
+    assert len(depth) == steps
+    # trace-off engines must not have touched the tracer at all
+    tr_off = Tracer().enable()
+    set_tracer(tr_off)
+    cfg = ServeConfig(trace=False, **_TRACE_CFG)
+    eng = serve_factory(cfg)
+    _drain(eng, _reqs(np.random.default_rng(3), _mixed_spec()))
+    assert len(tr_off) == 0
+
+
+def test_ttft_decomposition_sums_exact_closed_fixture(serve_factory):
+    """The acceptance pin: per-request TTFT components from the trace sum
+    to the engine-reported TTFT exactly, in virtual time, and the
+    sched_gap is computed independently (interval complement), so the
+    equality is an instrumentation invariant — not arithmetic."""
+    tracer = set_tracer(Tracer()).enable()
+    cfg = ServeConfig(trace=True, **_TRACE_CFG)
+    eng = serve_factory(cfg)
+    _drain(eng, _reqs(np.random.default_rng(3), _mixed_spec()))
+    bd = breakdown(tracer, window=8.0)
+    assert bd["requests"] == 4 and bd["incomplete"] == 0
+    assert bd["decomp_exact"]
+    fin = {f["rid"]: f for f in eng.finished}
+    for d in bd["per_request"]:
+        assert d["queue"] + d["prefill"] + d["decode"] + d["sched_gap"] \
+            == d["ttft"]
+        assert d["ttft"] == (fin[d["rid"]]["first_token_t"]
+                             - fin[d["rid"]]["arrival"])
+        assert d["exact"]
+    # queueing is real here: rid 2/3 waited for a free row
+    assert any(d["queue"] > 0 for d in bd["per_request"])
+    # all emitted tokens land in the timeline buckets
+    assert sum(b["tokens"] for b in bd["timeline"]) \
+        == sum(f["n_tokens"] for f in eng.finished)
+
+
+@pytest.mark.slow
+def test_eviction_recompute_trace_decomposes_exactly(serve_factory):
+    """Evictions replay work; the decomposition must still tile exactly
+    (last emission wins) and the evict/recompute markers must land.
+    Slow-marked: the max_len-24 shapes compile programs no tier-1 test
+    shares (the exact-tiling + bursty pins above stay tier-1)."""
+    tracer = set_tracer(Tracer()).enable()
+    # the pool-starved shape of test_serve's eviction pin, traced
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=24,
+                      prefill_chunk=4, trace=True)
+    eng = serve_factory(cfg)
+    _drain(eng, _reqs(np.random.default_rng(13),
+                      [(0, 9, 12, 0), (1, 9, 12, 0)]))
+    assert eng.stats["evicted"] > 0
+    names = [e[1] for e in tracer.events()]
+    assert "evict" in names and "recompute" in names
+    bd = breakdown(tracer)
+    assert bd["decomp_exact"] and bd["requests"] == 2
+    fin = {f["rid"]: f for f in eng.finished}
+    for d in bd["per_request"]:
+        assert d["ttft"] == (fin[d["rid"]]["first_token_t"]
+                             - fin[d["rid"]]["arrival"])
+    ev = next(d for d in bd["per_request"] if d["evictions"] > 0)
+    ok = next(d for d in bd["per_request"] if d["evictions"] == 0)
+    # the recompute waste is DECOMPOSED, not hidden: the evicted request
+    # prefilled its prompt twice (replay) and its discarded pre-eviction
+    # decode passes surface as pre-first-token decode time
+    assert ev["prefill"] > ok["prefill"]
+    assert ev["decode"] > 0 and ok["decode"] == 0
+
+
+def test_bursty_windowed_slo_dip_and_recovery(serve_factory):
+    """The acceptance pin: a trickle -> burst -> trickle fixture shows
+    attainment 1.0 before the burst, a dip while the burst queue drains,
+    and recovery to 1.0 after (pinned ordering, not exact values)."""
+    tracer = set_tracer(Tracer()).enable()
+    cfg = ServeConfig(trace=True, **_TRACE_CFG)
+    eng = serve_factory(cfg)
+    rng = np.random.default_rng(42)
+    spec = [(0, 4, 4, 0), (1, 4, 4, 20)]  # pre-burst trickle
+    spec += [(2 + i, 4, 4, 40) for i in range(8)]  # the burst
+    spec += [(10, 4, 4, 120), (11, 4, 4, 140)]  # post-burst trickle
+    _drain(eng, _reqs(rng, spec))
+    bd = breakdown(tracer, slo_ttft=8.0, slo_itl=2.5, window=20.0)
+    assert bd["decomp_exact"] and bd["requests"] == 12
+    att = [b["attainment"] for b in bd["timeline"] if b["completed"]]
+    # pinned ordering: full attainment on the leading trickle, a genuine
+    # dip while the burst drains, full attainment again at the tail
+    assert att[0] == 1.0 and att[1] == 1.0
+    assert min(att) < 1.0
+    assert min(att[2:-2] or [0.0]) < 1.0  # the dip is IN the burst window
+    assert att[-1] == 1.0 and att[-2] == 1.0
+    # the burst is visible on the arrival side of the series too
+    subs = [b["submitted"] for b in bd["timeline"]]
+    assert max(subs) == 8
+    # and the queue actually built: some burst request's TTFT is dominated
+    # by queueing, not prefill
+    worst = max(bd["per_request"], key=lambda d: d["ttft"])
+    assert worst["queue"] > worst["prefill"]
+
+
+def test_snapshot_and_flight_recorder(serve_factory):
+    """snapshot(): live occupancy/queue/ages + SLO-attainment-so-far and
+    the bounded ring of recent step states — no tracer required."""
+    cfg = ServeConfig(flight_recorder=8, slo_ttft=8.0, slo_itl=2.5,
+                      **_TRACE_CFG)
+    eng = serve_factory(cfg)
+    reqs = _reqs(np.random.default_rng(3), _mixed_spec())
+    for r in reqs:
+        r.arrival = 0.0
+        eng.submit(r)
+    now = 0.0
+    mid = None
+    while eng.has_work():
+        rep = eng.step(now)
+        now += rep.cost
+        if mid is None and eng.queue:
+            mid = eng.snapshot()
+    # mid-run: queued requests visible with ages at the engine clock
+    assert mid is not None and mid["queue_depth"] > 0
+    states = {r["state"] for r in mid["requests"]}
+    assert "queued" in states and states <= {"queued", "prefill", "decode"}
+    assert all(r["age"] >= 0 for r in mid["requests"])
+    assert 0.0 < mid["occupancy"] <= 1.0
+    end = eng.snapshot()
+    assert end["completed"] == 4 and end["active"] == 0
+    assert end["t"] == eng._last_t
+    # ring bounded at cfg.flight_recorder, newest window, schema stable
+    assert 0 < len(end["recent_steps"]) <= 8
+    assert end["recent_steps"][-1]["t"] == end["t"]
+    assert {"step", "t", "cost", "occupancy", "free_pages", "queue_depth",
+            "active", "decode_rows", "prefill_calls", "admitted",
+            "evicted", "backpressure"} == set(end["recent_steps"][-1])
+    # attainment-so-far agrees with the stats predicate
+    ok = sum(1 for f in eng.finished if request_slo_ok(f, 8.0, 2.5))
+    assert end["slo_attainment"] == ok / 4
+    # flight_recorder=0 disables the ring but snapshot still works
+    eng0 = serve_factory(ServeConfig(flight_recorder=0, **_TRACE_CFG))
+    _drain(eng0, _reqs(np.random.default_rng(4), [(0, 4, 2, 0)]))
+    s = eng0.snapshot()
+    assert s["recent_steps"] == [] and s["completed"] == 1
+
+
+def test_replicated_server_snapshot(serve_factory):
+    # both replicas on the default device: snapshot aggregation is
+    # host-side, and same-device replicas share every compiled program
+    cfg = ServeConfig(replicas=2, slo_ttft=8.0, slo_itl=2.5, **_TRACE_CFG)
+    srv = serve_factory(cfg, server=True, devices=[None, None])
+    reqs = _reqs(np.random.default_rng(9),
+                 [(i, 4, 3, 0) for i in range(6)])
+    _drain(srv, reqs)
+    snap = srv.snapshot()
+    assert len(snap["replicas"]) == 2
+    assert [s["replica"] for s in snap["replicas"]] == [0, 1]
+    assert snap["completed"] == 6 and snap["active"] == 0
+    assert snap["occupancy"] == max(s["occupancy"]
+                                    for s in snap["replicas"])
+    assert 0.0 <= snap["slo_attainment"] <= 1.0
+    assert snap["t"] == max(s["t"] for s in snap["replicas"])
+
+
+def test_serve_config_observability_validation():
+    with pytest.raises(ValueError, match="flight_recorder"):
+        ServeConfig(flight_recorder=-1).validate()
+    with pytest.raises(ValueError, match="slo"):
+        ServeConfig(slo_ttft=-0.5).validate()
+    ServeConfig(trace=True, flight_recorder=0, slo_ttft=8.0,
+                slo_itl=2.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# End-to-end: servebench --trace/--timeline on CPU + the serveview CLI.
+# ---------------------------------------------------------------------------
+
+SERVEBENCH_ARGS = [
+    "-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+    "--concurrency", "4", "--requests", "8", "--max-batch", "2",
+    "--pool-pages", "9", "--page", "4", "--max-len", "16",
+    "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+    "--slo-ttft", "8", "--slo-itl", "2.5", "--seed", "5",
+    "--platform", "cpu", "--policies", "continuous",
+]
+
+# the exact servebench report-line schema: consumers (PERF scripts, the
+# round-12..14 collectors, accmerge-style scrapers) scrape these keys —
+# a PR that drops one must fail HERE, not in a dashboard
+PLAIN_ROW_KEYS = {
+    "tool", "model", "benchmark", "policy", "arrival", "rate",
+    "concurrency", "requests", "seed", "max_batch", "pool_pages", "page",
+    "max_len", "prefill_chunk", "token_budget", "replicas", "prefix_cache",
+    "shared_prefix", "sample", "time_unit",
+    # serve_summary
+    "completed", "output_tokens", "duration",
+    "throughput_tokens_per_unit", "goodput_tokens_per_unit",
+    "slo_attainment", "prefix_cached_tokens", "ttft_p50", "ttft_p95",
+    "ttft_p99", "itl_p50", "itl_p95", "itl_p99", "slo_ttft", "slo_itl",
+    # engine stats_summary
+    "steps", "model_calls", "prefill_calls", "admitted", "evicted",
+    "backpressure", "peak_occupancy", "prefix_hits",
+    "prefix_tokens_saved", "cow_copies", "shared_pages", "prefill_tokens",
+    "decode_calls", "decode_batch_util", "mean_page_fragmentation",
+    # backend provenance
+    "jax_backend", "jax_device_count", "cpu_requested", "cpu_fallback",
+}
+TIMELINE_ROW_KEYS = PLAIN_ROW_KEYS | {
+    "window", "timeline", "ttft_breakdown", "itl_breakdown",
+    "decomp_exact",
+}
+
+
+def _run_servebench(extra=()):
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servebench
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    buf = io.StringIO()
+    with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+            contextlib.redirect_stdout(buf):
+        rc = servebench.main(SERVEBENCH_ARGS + list(extra))
+    assert rc == 0
+    return [l for l in buf.getvalue().splitlines() if l.startswith("{")]
+
+
+@pytest.fixture(scope="module")
+def servebench_rows(tmp_path_factory):
+    """ONE servebench triple for every e2e pin here: plain, --trace, and
+    --trace --timeline (in-process compile cache keeps this affordable)."""
+    d = tmp_path_factory.mktemp("sbtrace")
+    plain = _run_servebench()
+    traced = _run_servebench(("--trace", str(d / "t.json")))
+    timeline = _run_servebench(("--trace", str(d / "tl.json"),
+                                "--timeline", "--window", "8"))
+    return {"plain": plain, "traced": traced, "timeline": timeline,
+            "trace_path": str(d / "t.json"),
+            "timeline_path": str(d / "tl.json")}
+
+
+def test_servebench_trace_is_bitwise_neutral(servebench_rows):
+    """The acceptance pin: --trace changes the JSON line by NOTHING —
+    byte-for-byte, not just field-for-field."""
+    assert servebench_rows["plain"] == servebench_rows["traced"]
+
+
+def test_servebench_report_schema_pinned(servebench_rows):
+    plain = json.loads(servebench_rows["plain"][0])
+    timeline = json.loads(servebench_rows["timeline"][0])
+    assert set(plain) == PLAIN_ROW_KEYS
+    assert set(timeline) == TIMELINE_ROW_KEYS
+    assert timeline["decomp_exact"] is True
+    assert timeline["window"] == 8.0
+    for b in timeline["timeline"]:
+        assert {"t0", "t1", "submitted", "completed", "slo_ok",
+                "attainment", "tokens", "good_tokens",
+                "goodput_tokens_per_unit"} == set(b)
+    # the windowed series accounts for every completed token
+    assert sum(b["tokens"] for b in timeline["timeline"]) \
+        == timeline["output_tokens"]
+    for comp in ("ttft", "queue", "prefill", "decode", "sched_gap"):
+        assert set(timeline["ttft_breakdown"][comp]) \
+            == {"p50", "p95", "p99", "mean"}
+    assert set(timeline["itl_breakdown"]) == {"decode", "preempted"}
+
+
+def test_serveview_cli_on_servebench_trace(servebench_rows, capsys):
+    """The acceptance pin: the serveview CLI runs end-to-end on a
+    servebench-emitted trace file, defaulting SLOs from its metadata."""
+    from ddlbench_tpu.telemetry.serveview import main as serveview_main
+
+    rc = serveview_main([servebench_rows["timeline_path"], "--window", "8",
+                         "--per-request"])
+    assert rc == 0
+    out = json.loads(capsys.readouterr().out)
+    row = json.loads(servebench_rows["timeline"][0])
+    assert out["requests"] == row["completed"] == 8
+    assert out["decomp_exact"] is True
+    assert out["slo_ttft"] == 8.0 and out["slo_itl"] == 2.5  # metadata
+    assert out["dropped_events"] == 0
+    # the CLI reduction agrees with the in-process one servebench
+    # embedded (servebench rounds floats to 6 digits for the JSON line)
+    from ddlbench_tpu.tools.servebench import _round6
+
+    assert _round6(out["timeline"]) == row["timeline"]
+    for d in out["per_request"]:
+        assert d["queue"] + d["prefill"] + d["decode"] + d["sched_gap"] \
+            == d["ttft"]
+    # the trace file itself is Perfetto-loadable JSON with request tracks
+    doc = json.load(open(servebench_rows["timeline_path"]))
+    tracks = {e["args"]["name"] for e in doc["traceEvents"]
+              if e["ph"] == "M"}
+    assert any(t.startswith("r0/req") for t in tracks)
+    assert "r0/engine" in tracks
+    assert doc["metadata"]["serve"]["time_unit"] == "model_pass"
+
+
+def test_servebench_timeline_requires_trace():
+    from ddlbench_tpu.tools import servebench
+
+    with pytest.raises(SystemExit):
+        servebench.main(SERVEBENCH_ARGS + ["--timeline"])

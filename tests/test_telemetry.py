@@ -182,7 +182,11 @@ def test_latency_summary_and_step_stats():
 
 
 def _tiny_cfg(**kw):
-    base = dict(benchmark="mnist", strategy="single", arch="resnet18",
+    # lenet, not resnet18: these tests pin TELEMETRY plumbing (span
+    # taxonomy, JSONL/scraper round-trip, tracing neutrality), which is
+    # arch-independent — the smallest conv net halves the compile bill of
+    # the two heaviest tier-1 telemetry tests (ROADMAP item 5 budget)
+    base = dict(benchmark="mnist", strategy="single", arch="lenet",
                 epochs=2, steps_per_epoch=2, batch_size=8, log_interval=1,
                 compute_dtype="float32")
     base.update(kw)
