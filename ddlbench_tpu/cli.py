@@ -187,12 +187,30 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--keep-checkpoints", type=int, default=None, metavar="N",
                    help="retain only the newest N committed checkpoints "
                         "(older ones + stale .tmp dirs are GC'd)")
+    p.add_argument("--elastic-resume", action="store_true",
+                   help="topology-portable resume (train/reshard.py): when "
+                        "the checkpoint's recorded world shape mismatches "
+                        "the current mesh, reshard the ZeRO-1 flat state "
+                        "between world sizes (pure permutation, f32 "
+                        "bitwise) instead of raising CheckpointShapeError; "
+                        "lr world-scaling stays pinned to the launch world")
+    p.add_argument("--elastic-slices", type=int, default=None, metavar="E",
+                   help="world-invariant reduction order for -f dp "
+                        "--dp-shard-update: gradients computed in E fixed "
+                        "slices of the global batch and reduced over a "
+                        "canonical balanced tree (+ butterfly allreduce), "
+                        "so a run checkpointed at world N resumes at world "
+                        "M with BITWISE-identical f32 trajectories (E a "
+                        "power of two divisible by every world it runs on)")
     p.add_argument("--inject", action="append", default=[],
                    metavar="KIND@EPOCH:STEP",
                    help="deterministic fault injection (repeatable): kill | "
-                        "preempt | ckpt-corrupt | prefetch-die | nan-loss | "
-                        "nan-grad | grad-spike | slow-host at the given "
-                        "1-based epoch / 0-based step (ddlbench_tpu/faults/)")
+                        "preempt | shrink | grow | ckpt-corrupt | "
+                        "prefetch-die | nan-loss | nan-grad | grad-spike | "
+                        "slow-host at the given 1-based epoch / 0-based "
+                        "step (ddlbench_tpu/faults/; shrink/grow = the "
+                        "graceful-checkpoint half of a chaosbench world "
+                        "reshape — the supervisor restarts at the new -g)")
     from ddlbench_tpu.guard.policy import ANOMALY_POLICIES
     from ddlbench_tpu.train.watchdog import NAN_POLICIES
 
@@ -292,6 +310,8 @@ def config_from_args(args) -> RunConfig:
         resume=args.resume,
         checkpoint_every_steps=args.checkpoint_every_steps,
         keep_checkpoints=args.keep_checkpoints,
+        elastic_resume=args.elastic_resume,
+        elastic_slices=args.elastic_slices,
         inject=tuple(args.inject),
         nan_policy=args.nan_policy if args.nan_policy is not None else "abort",
         anomaly_policy=args.anomaly_policy,

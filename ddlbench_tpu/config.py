@@ -357,6 +357,26 @@ class RunConfig:
     # Retention: keep only the newest N committed checkpoints (older ones
     # and stale .tmp dirs are GC'd after each commit). None = keep all.
     keep_checkpoints: Optional[int] = None
+    # Elastic world-size resume (train/reshard.py): when the checkpoint's
+    # recorded world shape mismatches the current mesh, reshard the ZeRO-1
+    # flat state between world sizes (a pure permutation — f32 bitwise)
+    # instead of raising CheckpointShapeError. The lr world-scaling factor
+    # stays pinned to the LAUNCH world recorded in the checkpoint, and the
+    # global batch must be preserved across the reshape for the
+    # (epoch, step)-addressed data streams to line up.
+    elastic_resume: bool = False
+    # World-invariant reduction order for the dp ZeRO-1 engine: compute
+    # gradients in E fixed slices of the GLOBAL batch and reduce them over
+    # a canonical balanced binary tree (local fold over each device's
+    # contiguous slices + butterfly allreduce across devices) instead of
+    # local-sum + psum_scatter. The reduction tree is then a function of E
+    # alone, so an elastic run checkpointed at world N and resumed at
+    # world M (both dividing E, powers of two) replays the SAME f32 bits —
+    # the numerical contract behind chaosbench's shrink/grow
+    # trajectory_match. Costs log2(world) full-vector exchange rounds vs
+    # the ring reduce-scatter's (world-1)/world. None = off (the default
+    # wire path, bitwise-pinned vs GSPMD at a fixed world).
+    elastic_slices: Optional[int] = None
     # Deterministic fault injection (ddlbench_tpu/faults/): repeatable
     # KIND@EPOCH:STEP specs, e.g. ("kill@2:5", "nan-loss@1:3"). Empty =
     # disarmed; the hooks then cost one falsy check each.
@@ -640,6 +660,40 @@ class RunConfig:
             raise ValueError(
                 "keep_checkpoints must be >= 1 (the newest checkpoint is "
                 "never dropped)")
+        if self.elastic_resume and self.checkpoint_dir is None:
+            raise ValueError(
+                "elastic_resume resharding needs --checkpoint-dir (there "
+                "is no checkpoint to reshard without one)")
+        if self.elastic_slices is not None:
+            E = self.elastic_slices
+            if E < 1 or (E & (E - 1)):
+                raise ValueError(
+                    f"elastic_slices must be a positive power of two (the "
+                    f"canonical balanced reduction tree over E leaves must "
+                    f"decompose at any world cut); got {E}")
+            if self.strategy != "dp" or not self.dp_shard_update:
+                raise ValueError(
+                    "elastic_slices (world-invariant reduction order) runs "
+                    "on the dp ZeRO-1 engine (-f dp --dp-shard-update)")
+            w = self.num_devices
+            if w & (w - 1) or E % w:
+                raise ValueError(
+                    f"elastic_slices ({E}) needs a power-of-two device "
+                    f"count dividing it (got {w}): device boundaries must "
+                    f"align with subtrees of the canonical reduction tree")
+            if self.global_batch() % E:
+                raise ValueError(
+                    f"global batch ({self.global_batch()}) must divide "
+                    f"into elastic_slices ({E}) equal slices")
+            if self.grad_accum_steps > 1:
+                raise ValueError(
+                    "elastic_slices already slices the global batch; "
+                    "grad_accum_steps > 1 is not composed with it")
+            if self.resolved_allreduce_dtype() != "float32":
+                raise ValueError(
+                    "elastic_slices is the exact-replay mode: quantized "
+                    "wire dtypes fold device indices into their rounding "
+                    "streams and can never be world-invariant (use f32)")
         if self.inject:
             from ddlbench_tpu.faults import parse_injections
 

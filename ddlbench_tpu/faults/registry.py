@@ -44,6 +44,17 @@ uses, so injections are deterministic and reproducible. Kinds:
                        containing (EPOCH, STEP) by ``DDLB_FAULT_SPIKE``
                        (default 1000.0): drives the EWMA spike detector and
                        its policy path without perturbing device state.
+* ``shrink``/``grow`` — the in-process half of an elastic world RESHAPE
+                       (ISSUE 12): SIGTERM at the (EPOCH, STEP) boundary,
+                       exactly like ``preempt`` — the loop commits a
+                       step-granular checkpoint (now carrying the logical
+                       world-shape metadata) and exits gracefully. A
+                       process cannot change its own device count; the
+                       chaosbench supervisor (``--reshape``) matches the
+                       distinct ``fault-inject: shrink/grow`` line and
+                       relaunches the child at the new ``--devices`` with
+                       ``--elastic-resume``, which is where the world
+                       actually changes.
 
 Each armed spec fires at most once per process. The registry is module
 state: ``arm()`` installs specs (idempotent re-arm with the same specs is a
@@ -61,7 +72,8 @@ import time
 from typing import List, Optional, Sequence, Tuple
 
 FAULT_KINDS = ("kill", "ckpt-corrupt", "prefetch-die", "nan-loss",
-               "slow-host", "preempt", "nan-grad", "grad-spike")
+               "slow-host", "preempt", "nan-grad", "grad-spike",
+               "shrink", "grow")
 
 # Armed specs; empty = disarmed. Every hook checks this first.
 _SPECS: List["FaultSpec"] = []
@@ -151,14 +163,18 @@ def step_boundary(epoch: int, step: int) -> None:
         sys.stdout.flush()
         sys.stderr.flush()
         os.kill(os.getpid(), signal.SIGKILL)
-    if _take("preempt", epoch, step):
-        # SIGTERM, not an exception: the graceful path under test IS the
-        # signal handler -> flag -> boundary-check -> checkpoint chain.
-        # Python delivers the signal before the next bytecode, so the flag
-        # is visible to the check right after this hook.
-        print(f"fault-inject: preempt (SIGTERM) at epoch {epoch} step "
-              f"{step}", flush=True)
-        os.kill(os.getpid(), signal.SIGTERM)
+    for kind in ("preempt", "shrink", "grow"):
+        if _take(kind, epoch, step):
+            # SIGTERM, not an exception: the graceful path under test IS
+            # the signal handler -> flag -> boundary-check -> checkpoint
+            # chain. Python delivers the signal before the next bytecode,
+            # so the flag is visible to the check right after this hook.
+            # shrink/grow print their own kind: the chaosbench supervisor
+            # keys the world reshape off this exact line.
+            print(f"fault-inject: {kind} (SIGTERM) at epoch {epoch} step "
+                  f"{step}", flush=True)
+            os.kill(os.getpid(), signal.SIGTERM)
+            break
 
 
 def poison_loss(epoch: int, step: int) -> bool:

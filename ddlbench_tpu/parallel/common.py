@@ -430,6 +430,23 @@ def unpack_flat(flat: jax.Array, meta: FlatMeta):
     return jax.tree.unflatten(meta.treedef, out)
 
 
+def bucket_content_lengths(meta: FlatMeta):
+    """Unpadded element count of each bucket — the piece of the LOGICAL
+    (concatenated-leaf, pad-free) vector that bucket b carries.
+
+    Leaf-aligned metas (dp ``flat_meta``) sum their leaf sizes; row metas
+    (``row_flat_meta``, empty ``sizes``) tile the contiguous [0, length)
+    row, so a bucket's content is its overlap with that range. In both
+    layouts ``flat = concat_b(logical[c_b:c_b+len_b] + zeros(pad_b))``
+    with ``c_b = cumsum(len_b)`` — the invariant train/reshard.py's
+    world-size permutation is built on.
+    """
+    if meta.sizes:
+        return [int(sum(meta.sizes[l0:l1])) for l0, l1 in meta.bucket_leaves]
+    return [max(0, min(meta.length, off + bp) - off)
+            for off, bp in zip(meta.bucket_offsets, meta.bucket_padded)]
+
+
 def bucket_slice(flat: jax.Array, meta: FlatMeta, b: int) -> jax.Array:
     """Bucket b's [bucket_padded[b]] stretch of a packed flat vector."""
     return flat[meta.bucket_offsets[b]:

@@ -60,6 +60,26 @@ zeros between leaves and never splits or reorders a reduction, so the f32
 bucketed path is bitwise-pinned to the monolithic PR 3 engine and
 ``--comm-buckets 1`` compiles the exact PR 3 program.
 
+Elastic world-invariant numerics (``--elastic-slices E``, ISSUE 12): the
+local-sum + psum_scatter reduction above ties the f32 bits of every loss
+and gradient to the WORLD SIZE (different batch partitions contract and
+reduce in different orders), so an elastic run that shrinks 4 -> 2 chips
+could never replay bitwise. With E set, the engine instead computes
+gradients in E fixed slices of the GLOBAL batch (contiguous, E/world per
+device) and reduces them over a canonical balanced binary tree: a
+pairwise fold over each device's contiguous slices composes with a
+recursive-doubling butterfly allreduce (log2(world) ppermute+add rounds;
+IEEE addition is commutative, so every device lands on the SAME bits)
+into one tree whose shape depends on E alone. Save at world N, reshard
+(train/reshard.py), resume at world M: per-slice programs, tree, and
+elementwise optimizer are all world-independent, so per-step losses and
+materialized params are bitwise equal to the uninterrupted N-run
+(tests/test_elastic.py). Exact-replay mode, not a fast path: the
+butterfly ships log2(world) full vectors vs the ring's (world-1)/world,
+and it is scoped to f32 wire, stateless (non-BN) models, and the sharded
+update. Eval runs the same canonical reduction so validation losses
+match across worlds too.
+
 int8 wire (``--allreduce-dtype int8``, EQuARX-lite): per-bucket GLOBAL
 absmax (lax.pmax) -> shared scale absmax/qmax with qmax = 127 // world
 (the collective sums IN int8; see common.sum_safe_qmax) -> stochastic
@@ -168,6 +188,7 @@ class DPStrategy:
                                     ts.model_state, x, y, self.compute_dtype)
 
         self._overlap = False  # _build_explicit_engine may flip it
+        self.eval_step = None  # the elastic engine installs its own
         if self._explicit:
             self._build_explicit_engine(smooth)
         else:
@@ -178,10 +199,12 @@ class DPStrategy:
                               self._batch_sharding, None),
                 out_shardings=None,
             )
-        self.eval_step = jax.jit(
-            eval_step,
-            in_shardings=(None, self._batch_sharding, self._batch_sharding),
-        )
+        if self.eval_step is None:
+            self.eval_step = jax.jit(
+                eval_step,
+                in_shardings=(None, self._batch_sharding,
+                              self._batch_sharding),
+            )
         self._materialize = jax.jit(self._params_pytree,
                                     out_shardings=self._replicated)
 
@@ -276,8 +299,8 @@ class DPStrategy:
         overlap = self._overlap = cfg.dp_overlap_engine()
         int8_wire = wire == jnp.dtype(jnp.int8)
 
-        abs_params = jax.eval_shape(
-            lambda k: init_model(model, k)[0], jax.random.key(0))
+        abs_params, abs_state = jax.eval_shape(
+            lambda k: init_model(model, k)[:2], jax.random.key(0))
         # Layer-aligned buckets: abs_params is the per-layer params list, so
         # each layer's leaves form one alignment group and bucket boundaries
         # fall on layer boundaries — the backward finishes a bucket's
@@ -286,7 +309,16 @@ class DPStrategy:
         meta = flat_meta(abs_params, n, buckets=cfg.comm_buckets,
                          leaf_groups=leaf_groups)
         self._flat_meta = meta
+        self._abs_params = abs_params
+        self._leaf_groups = leaf_groups
         shard_len = meta.padded // n
+        elastic = self._elastic = cfg.elastic_slices
+        if elastic and jax.tree.leaves(abs_state):
+            raise NotImplementedError(
+                "elastic_slices (world-invariant reduction order) supports "
+                "stateless (non-BN) models: batch statistics computed over "
+                "per-slice sub-batches cannot be made world-invariant "
+                f"({model.name} carries model state)")
         qmax = sum_safe_qmax(n) if int8_wire else None
         # int8 stochastic-rounding key root: run seed + a fixed tag keeping
         # the stream disjoint from data/init keys; the step counter
@@ -348,6 +380,108 @@ class DPStrategy:
                 for b in range(meta.num_buckets)
             ]
             return unpack_buckets(stretches, meta)
+
+        # -- elastic world-invariant reduction (--elastic-slices E) --------
+        # The canonical tree: pairwise fold over each device's E/world
+        # contiguous slice partials, then a recursive-doubling butterfly
+        # across devices. Both halves compose into ONE balanced binary
+        # tree over the E slice partials whose shape depends on E alone —
+        # the property that makes f32 trajectories bitwise across world
+        # sizes (module docstring; pinned by tests/test_elastic.py).
+
+        def _stack_fold(v):
+            """Balanced pairwise fold over the leading (slice) axis of a
+            stacked array — the local half of the canonical tree. The
+            slice count is a power of two (validate gates E and world)."""
+            while v.shape[0] > 1:
+                v = v[0::2] + v[1::2]
+            return v[0]
+
+        def _butterfly(tree):
+            """Recursive-doubling allreduce: after log2(world) XOR-partner
+            exchange rounds every device holds the balanced-tree sum —
+            with IDENTICAL bits on every device, because a + b and b + a
+            round identically (IEEE addition is commutative; only
+            associativity fails)."""
+            r = 1
+            out = tree
+            while r < n:
+                perm = [(d, d ^ r) for d in range(n)]
+                out = jax.tree.map(
+                    lambda a: a + lax.ppermute(a, "data", perm), out)
+                r <<= 1
+            return out
+
+        def _replicate0(x):
+            """Force replicated VMA typing on a value the butterfly already
+            made device-uniform, without perturbing its bits: psum of
+            (x on device 0, zeros elsewhere) — adding zeros is exact in
+            any association order."""
+            keep = lax.axis_index("data") == 0
+            return lax.psum(jnp.where(keep, x, jnp.zeros_like(x)), "data")
+
+        def _own_shard(vec):
+            """This device's device-major shard of a full bucket-layout
+            vector (the butterfly leaves the FULL reduced vector on every
+            device; the optimizer wants its 1/world slice of each
+            bucket)."""
+            d = lax.axis_index("data")
+            parts = [lax.dynamic_slice_in_dim(
+                vec, meta.bucket_offsets[b] + d * (meta.bucket_padded[b]
+                                                   // n),
+                meta.bucket_padded[b] // n)
+                for b in range(meta.num_buckets)]
+            return jnp.concatenate(parts) if len(parts) > 1 else parts[0]
+
+        def _canonical_denom(y):
+            # valid-label counts are small exact integers: their psum is
+            # bitwise order-free, so the loss normalizer needs no tree
+            return jnp.maximum(1.0, lax.psum(
+                jnp.sum((y >= 0).astype(jnp.int32)),
+                "data").astype(jnp.float32))
+
+        def elastic_grads(params, state, x, y, smul):
+            """(ce, correct, valid, new_state, grad_shard) with every f32
+            reduction on the canonical E-leaf tree. Per-slice programs are
+            shape-identical across world sizes (each slice is global_B/E
+            rows), so save@N -> resume@M replays the same bits. The slices
+            run under ONE lax.scan body — program size stays O(1) in E
+            instead of unrolling E/world backward passes — and the scan
+            only STACKS per-slice partials; the cross-slice reduction is
+            the balanced fold below, never the scan's left-to-right carry."""
+            k_local = elastic // n
+            b = x.shape[0] // k_local
+            denom = _canonical_denom(y)
+            xs = x.reshape(k_local, b, *x.shape[1:])
+            ys = y.reshape(k_local, b, *y.shape[1:])
+
+            def slice_body(st, xy):
+                xk, yk = xy
+
+                def f(p):
+                    from ddlbench_tpu.ops.util import sharded_jit_tracing
+
+                    with sharded_jit_tracing():
+                        obj_sum, ce_sum, correct, valid, _norm, new_st = \
+                            self._local_loss_sums(p, st, xk, yk, smooth)
+                    obj = obj_sum / denom
+                    if smul is not None:  # guard: loss scale / poison
+                        obj = obj * smul
+                    return obj, (ce_sum, correct, valid, new_st)
+
+                (_, (ce_sum, correct, valid, new_st)), g = \
+                    jax.value_and_grad(f, has_aux=True)(params)
+                return new_st, (pack_flat(g, meta), ce_sum, correct, valid)
+
+            st, (gstack, ces, corrs, valids) = lax.scan(
+                slice_body, state, (xs, ys))
+            g_local, ce_local = _stack_fold(gstack), _stack_fold(ces)
+            g_full, ce_tot = _butterfly((g_local, ce_local))
+            ce = _replicate0(ce_tot) / denom
+            # int sums are exact in any order — no tree needed
+            return (ce, lax.psum(jnp.sum(corrs), "data"),
+                    lax.psum(jnp.sum(valids), "data"), st,
+                    _own_shard(g_full))
 
         def local_grads(params, state, x, y, smul, qkey=None):
             """(ce, correct, valid, new_state, g_reduced): psum'd metrics
@@ -450,9 +584,15 @@ class DPStrategy:
                 # per-bucket all-gather rebuilds the pytree for the forward
                 pshard = params
                 params = gather_params(pshard)
-            with batch_parallel("data", n):
-                ce, correct, valid, new_state, gr = local_grads(
-                    params, state, x, y, smul, qkey)
+            if elastic:
+                # world-invariant canonical-tree path (no BN — validated
+                # at build, so no batch_parallel context is needed)
+                ce, correct, valid, new_state, gr = elastic_grads(
+                    params, state, x, y, smul)
+            else:
+                with batch_parallel("data", n):
+                    ce, correct, valid, new_state, gr = local_grads(
+                        params, state, x, y, smul, qkey)
             if guard is not None:
                 # unscale AFTER the (wire-dtype) collective — the scaled
                 # values are what rides the wire — then fuse the health
@@ -610,6 +750,81 @@ class DPStrategy:
             return out
 
         self.train_step = train_step
+
+        if elastic:
+            # eval on the same canonical tree: validation losses of an
+            # elastic run are world-invariant too (chaosbench's trajectory
+            # check compares the per-epoch valid records bitwise)
+            def elastic_eval_local(params, state, x, y):
+                k_local = elastic // n
+                b = x.shape[0] // k_local
+                xs = x.reshape(k_local, b, *x.shape[1:])
+                ys = y.reshape(k_local, b, *y.shape[1:])
+
+                def slice_body(_, xy):
+                    ce_sum, c, c5, v = self._local_eval_sums(
+                        params, state, *xy)
+                    return 0, (ce_sum, c, c5, v)
+
+                _, (ces, corrs, corr5s, cnts) = lax.scan(
+                    slice_body, 0, (xs, ys))
+                corr = jnp.sum(corrs)
+                corr5 = jnp.sum(corr5s)
+                ce_tot = _replicate0(_butterfly(_stack_fold(ces)))
+                count = lax.psum(jnp.sum(cnts), "data")
+                return {
+                    "loss": ce_tot
+                    / jnp.maximum(1.0, count.astype(jnp.float32)),
+                    "correct": lax.psum(corr, "data"),
+                    "correct5": lax.psum(corr5, "data"),
+                    "count": count,
+                }
+
+            sharded_eval = _shard_map(
+                elastic_eval_local, mesh=mesh,
+                in_specs=(P(), P(), P("data"), P("data")), out_specs=P())
+
+            def elastic_eval_step(ts, x, y):
+                return sharded_eval(self._params_pytree(ts), ts.model_state,
+                                    x, y)
+
+            self.eval_step = jax.jit(
+                elastic_eval_step,
+                in_shardings=(None, self._batch_sharding,
+                              self._batch_sharding))
+
+    def _local_eval_sums(self, params, state, x, y):
+        """Per-slice eval sums (ce_sum, correct, correct5, count) —
+        common.eval_metrics' computation before normalization, so the
+        elastic eval can reduce them on the canonical tree."""
+        from ddlbench_tpu.models.layers import apply_model
+        from ddlbench_tpu.parallel.common import (cast_input, cast_params,
+                                                  correct_and_count,
+                                                  correct_topk,
+                                                  fused_head_eval_sums)
+
+        cfg = self.cfg
+        p = cast_params(params, self.compute_dtype)
+        xc = cast_input(x, self.compute_dtype)
+        if cfg.fused_head_loss and self.model.layers[-1].fused_eval \
+                is not None:
+            return fused_head_eval_sums(self.model, p, state, xc, y)
+        logits, _ = apply_model(self.model, p, state, xc, False)
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        maskf = (y >= 0).astype(jnp.float32)
+        safe = jnp.maximum(y, 0)
+        nll = -jnp.take_along_axis(logp, safe[..., None], axis=-1)[..., 0]
+        correct, valid = correct_and_count(logits, y)
+        return jnp.sum(nll * maskf), correct, correct_topk(logits, y), valid
+
+    def flat_meta_for_world(self, world: int, buckets: int):
+        """The packed flat layout this MODEL would have at another world
+        size — what train/reshard.py permutes an elastic checkpoint
+        through (and verifies against the recorded layout)."""
+        from ddlbench_tpu.parallel.common import flat_meta
+
+        return flat_meta(self._abs_params, world, buckets=max(1, buckets),
+                         leaf_groups=self._leaf_groups)
 
     def init(self, key) -> TrainState:
         from ddlbench_tpu.distributed import put_global_tree

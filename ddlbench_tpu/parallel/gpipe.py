@@ -114,6 +114,9 @@ class GPipeStrategy:
         # bucket just-in-time and the backward reduce-scatters per bucket
         # — optimizer bytes/chip drop /dp, the grad wire halves vs the
         # replicated pmean, and late buckets overlap the drain.
+        # Elastic resume (train/reshard.py) reads pipe_shard/_row_meta/dp
+        # off this strategy to reshard a checkpoint's rows between dp
+        # replica counts (same stage split) — keep those names stable.
         self.pipe_shard = cfg.pipe_shard_engine()
         self.mesh = mesh or make_pipe_mesh(self.num_stages, self.dp, devices)
         self.compute_dtype = jnp.dtype(cfg.compute_dtype)

@@ -151,7 +151,11 @@ class ScheduledPipelineStrategy(GPipeStrategy):
     pipeline runtime (module docstring). Inherits gpipe's mesh, stage
     packing, balanced partitioning, eval pipeline (the synchronous
     fill-drain eval is schedule-independent), checkpointing surface and
-    state layout; only the TRAIN step is compiled from the timetable."""
+    state layout — including the hybrid PP x ZeRO-1 row layout and with
+    it the elastic-resume reshard surface (train/reshard.py reads
+    ``pipe_shard``/``_row_meta``/``dp`` off the strategy, so a
+    dp-replica reshape restores event-schedule checkpoints too); only
+    the TRAIN step is compiled from the timetable."""
 
     def __init__(self, *args, **kw):
         super().__init__(*args, **kw)

@@ -934,6 +934,33 @@ class ServeEngine:
             else:
                 a.pending_tok = tok
 
+    def drain(self, now: float = 0.0):
+        """Retire this replica under live load (ReplicatedServer.resize
+        scale-down): every in-flight request is EVICTED onto the existing
+        recompute path (pages freed — shared prefix pages survive for the
+        index until the pools are dropped with the engine — tokens
+        regenerate identically on whichever replica re-admits it, greedy
+        and seeded sampling both being pure functions of (params, prompt,
+        rid, token index)), and the whole queue is handed back for
+        least-loaded redistribution. Finished records stay on the engine;
+        the server keeps draining engines in its retired list so nothing
+        drops out of ``finished``/``stats_summary``. Returns (requests,
+        evicted_count, handoff) — ``handoff[rid] = (queued_at, evicted)``
+        lets the receiving engine keep the queue-wait baseline and the
+        recompute marker, so resized requests trace like engine-local
+        evictions instead of resetting to their original arrival."""
+        self._now = now
+        rep = StepReport()
+        for a in sorted(self._active(), key=lambda x: x.admit_seq):
+            if self.rows[a.row] is a:
+                self._evict(a, rep)
+        reqs = list(self.queue)
+        self.queue.clear()
+        handoff = {r.rid: (self._queued_at.get(r.rid, now),
+                           r.rid in self._evicted_rids) for r in reqs}
+        self._queued_at.clear()
+        return reqs, rep.evicted, handoff
+
     def stats_summary(self) -> Dict[str, float]:
         s = dict(self.stats)
         calls = s.pop("decode_calls")
@@ -997,17 +1024,39 @@ class ServeEngine:
 class ReplicatedServer:
     """N independent replicas over the serving mesh's 'data' axis with a
     least-loaded dispatcher. Replicas step in lockstep; a global step
-    costs the max over replica costs (they run in parallel)."""
+    costs the max over replica costs (they run in parallel).
 
-    def __init__(self, engines: List[ServeEngine]):
+    LIVE RESIZE (:meth:`resize`, ISSUE 12): the serving half of the
+    elastic world-size story. Scale-down drains the highest-index
+    replicas — in-flight requests are evicted onto the existing recompute
+    path and the drained queues redistribute least-loaded over the
+    survivors — so no request is ever lost, and token streams stay
+    bitwise (greedy and seeded sampling are pure functions of (params,
+    prompt, rid, token index), the same invariant eviction/recompute
+    already relies on; pinned vs an un-resized control by
+    tests/test_elastic.py). Scale-up spawns fresh replicas through the
+    ``engine_factory`` make_server installs, SHARING the jitted callables
+    — a new replica costs zero compiles. Drained engines are retired, not
+    discarded: their finished records and counters stay in ``finished``
+    and ``stats_summary``.
+    """
+
+    def __init__(self, engines: List[ServeEngine], engine_factory=None):
         if not engines:
             raise ValueError("need at least one engine")
         self.engines = list(engines)
+        self._factory = engine_factory
+        self._retired: List[ServeEngine] = []
+        self._next_replica = len(engines)
+        # (t, from, to, evicted, redistributed) — servebench embeds these
+        self.resize_events: List[Dict[str, Any]] = []
+
+    def _least_loaded(self) -> ServeEngine:
+        return min(enumerate(self.engines), key=lambda ie: (ie[1].load(),
+                                                            ie[0]))[1]
 
     def submit(self, req: ServeRequest) -> None:
-        eng = min(enumerate(self.engines), key=lambda ie: (ie[1].load(),
-                                                           ie[0]))[1]
-        eng.submit(req)
+        self._least_loaded().submit(req)
 
     def has_work(self) -> bool:
         return any(e.has_work() for e in self.engines)
@@ -1019,10 +1068,62 @@ class ReplicatedServer:
                 rep.merge(e.step(now))
         return rep
 
+    def resize(self, n: int, now: float = 0.0) -> Dict[str, Any]:
+        """Scale the live replica fleet to ``n`` under load. Scale-down
+        drains the highest-index replicas first (lowest replica indices —
+        the oldest trace tracks — are the stable ones) and resubmits every
+        displaced request least-loaded; scale-up appends factory-built
+        replicas sharing the compiled programs. Returns a report dict."""
+        if n < 1:
+            raise ValueError(f"resize needs >= 1 replica, got {n}")
+        before = len(self.engines)
+        drained: List[ServeEngine] = []
+        while len(self.engines) > n:
+            drained.append(self.engines.pop())
+        reqs: List[ServeRequest] = []
+        evicted = 0
+        handoff: Dict[int, Any] = {}
+        # drain in ascending replica order for a deterministic resubmit
+        # sequence; within one engine: evicted actives NEWEST-first (the
+        # eviction requeue stacks them at the queue's front), then the
+        # waiting queue in arrival order
+        for eng in reversed(drained):
+            r, ev, h = eng.drain(now)
+            reqs.extend(r)
+            evicted += ev
+            handoff.update(h)
+        self._retired.extend(reversed(drained))
+        for r in reqs:
+            eng = self._least_loaded()
+            eng.submit(r)
+            # keep the queue-wait baseline + recompute marker across the
+            # replica move: a request evicted by the drain must trace as
+            # a recompute whose wait restarts at the resize instant, not
+            # as a fresh arrival waiting since t=0
+            q0, was_evicted = handoff[r.rid]
+            eng._queued_at[r.rid] = q0
+            if was_evicted:
+                eng._evicted_rids.add(r.rid)
+        while len(self.engines) < n:
+            if self._factory is None:
+                raise RuntimeError(
+                    "resize: scale-up needs the engine factory make_server "
+                    "installs (this server was built from bare engines)")
+            # replica id is monotonic (unique trace tracks); the device
+            # SLOT is the fleet position, so a re-grown fleet reuses the
+            # devices its drained predecessors vacated
+            self.engines.append(
+                self._factory(self._next_replica, n, len(self.engines)))
+            self._next_replica += 1
+        report = {"t": now, "from": before, "to": n, "evicted": evicted,
+                  "redistributed": len(reqs)}
+        self.resize_events.append(report)
+        return report
+
     @property
     def finished(self) -> List[Dict[str, Any]]:
         out = []
-        for e in self.engines:
+        for e in self.engines + self._retired:
             out.extend(e.finished)
         return out
 
@@ -1049,18 +1150,19 @@ class ReplicatedServer:
 
     def stats_summary(self) -> Dict[str, float]:
         sums: Dict[str, float] = {}
-        for e in self.engines:
+        fleet = self.engines + self._retired  # resize never loses counters
+        for e in fleet:
             for k, v in e.stats_summary().items():
                 sums[k] = sums.get(k, 0) + v
         for k in ("decode_batch_util", "mean_page_fragmentation"):
-            sums[k] /= len(self.engines)
+            sums[k] /= len(fleet)
         # peak occupancy is a saturation signal: averaging would hide one
         # evicting, pool-bound replica behind its idle siblings — the
         # shared-page peak is the same kind of signal
         sums["peak_occupancy"] = max(
-            e.stats["peak_occupancy"] for e in self.engines)
+            e.stats["peak_occupancy"] for e in fleet)
         sums["shared_pages"] = max(
-            e.stats["shared_pages"] for e in self.engines)
+            e.stats["shared_pages"] for e in fleet)
         return sums
 
 
@@ -1073,7 +1175,12 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
     shares the default device otherwise. ``shared_fns`` (a prior server's
     ``engines[0].jit_fns()``) seeds the jitted callables: servers built
     from the same model and shapes — e.g. servebench's per-policy rows —
-    reuse one compile instead of re-tracing every npl variant."""
+    reuse one compile instead of re-tracing every npl variant.
+
+    The returned server carries an ENGINE FACTORY so ``resize`` can scale
+    the fleet up under live load: a new replica shares the first engine's
+    jitted callables (zero compiles) and follows the same device-placement
+    rule at its new fleet size."""
     import jax
 
     n = cfg.replicas
@@ -1088,4 +1195,18 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
             model, params, state, rep_cfg, dtype=dtype, device=d,
             shared_fns=engines[0].jit_fns() if engines else shared_fns,
             replica=len(engines)))
-    return ReplicatedServer(engines)
+    fns = engines[0].jit_fns()
+
+    def factory(replica: int, fleet_size: int, slot: int) -> ServeEngine:
+        # placement by fleet SLOT, not replica id: replica ids grow
+        # monotonically across resizes (unique trace tracks), while slots
+        # are fleet positions — a grow after a shrink reuses the devices
+        # the drained replicas vacated instead of stacking new replicas
+        # on the default device
+        devs = jax.devices()
+        device = (devs[slot] if fleet_size > 1 and slot < len(devs)
+                  else None)
+        return ServeEngine(model, params, state, rep_cfg, dtype=dtype,
+                           device=device, shared_fns=fns, replica=replica)
+
+    return ReplicatedServer(engines, engine_factory=factory)

@@ -9,7 +9,12 @@ the train CLI as a child process under a supervisor that
 1. schedules ``--kills N`` deterministic SIGKILL injections and
    ``--preempts N`` graceful SIGTERM preemptions (``--inject kill@E:S`` /
    ``preempt@E:S``, one per attempt, spread evenly over the run's global
-   steps),
+   steps), plus explicit ``--reshape shrink@E:S:M`` / ``grow@E:S:M``
+   world RESHAPES: the child is gracefully preempted at (E, S) (``--inject
+   shrink@E:S`` — a checkpoint carrying the logical world-shape metadata
+   commits) and every later attempt runs at ``--devices M`` with the
+   per-device batch rescaled so the GLOBAL batch is preserved and
+   ``--elastic-resume`` reshards the ZeRO-1 flat state (train/reshard.py),
 2. relaunches the child with ``--resume`` after every death, with
    exponential backoff and a bounded restart budget (a crash-looping run
    must not spin forever; an exhausted budget exits nonzero),
@@ -18,13 +23,28 @@ the train CLI as a child process under a supervisor that
    JSONL records and per-epoch validation loss/accuracy — synthetic data is
    (epoch, step)-addressed, so any divergence means state was lost), and
 4. emits a bench.py-style JSON line: recoveries, MTTR (child death -> the
-   resumed child's "resumed from" line) split between SIGKILL deaths and
+   resumed child's "resumed from" line) split between SIGKILL deaths,
    graceful preemptions (exit code guard/preempt.py PREEMPT_EXIT_CODE with
-   a committed checkpoint — counted separately from hard crashes), steps
-   lost per kill, checkpoint write overhead (telemetry spans from each
-   attempt's ``--trace``), and the stability-guard event counts scraped
-   from the children's ``guard:`` lines (anomalies detected / steps
-   skipped / rewinds / loss-scale backoffs).
+   a committed checkpoint — counted separately from hard crashes), and
+   world reshapes (``mttr_reshape_s`` — death at world N to resumed at
+   world M, the reshape recovery time), steps lost per kill, checkpoint
+   write overhead (telemetry spans from each attempt's ``--trace``),
+   post-reshape trajectory divergence (max |loss delta| vs the baseline
+   over the records at/after the first reshape — 0.0 for f32 elastic
+   runs), and the stability-guard event counts scraped from the
+   children's ``guard:`` lines (anomalies detected / steps skipped /
+   rewinds / loss-scale backoffs).
+
+Elastic example (dp ZeRO-1, shrink 4 -> 2 mid-run)::
+
+    python -m ddlbench_tpu.tools.chaosbench --kills 0 \
+        --reshape shrink@2:1:2 --platform cpu -b mnist -m lenet \
+        -f dp -g 4 --batch-size 2 --steps-per-epoch 4 -e 2 \
+        --checkpoint-every-steps 2 -- --dp-shard-update --elastic-slices 4
+
+   The baseline runs uninterrupted at world 4; trajectory_match pins the
+   reshaped run's per-step losses to it bitwise (--elastic-slices is what
+   makes the f32 reduction order world-invariant — parallel/dp.py).
 
 Usage (CPU smoke)::
 
@@ -63,9 +83,19 @@ def _parse_args(argv=None):
                         "schedule (interleaved with the kills; the child "
                         "commits a checkpoint and exits with the distinct "
                         "graceful code)")
+    p.add_argument("--reshape", action="append", default=[],
+                   metavar="KIND@E:S:M",
+                   help="elastic world reshape (repeatable): shrink@E:S:M "
+                        "or grow@E:S:M gracefully preempts the child at "
+                        "epoch E step S and restarts it (and every later "
+                        "attempt) at --devices M with --elastic-resume, "
+                        "per-device batch rescaled so the global batch is "
+                        "preserved (requires -f dp; pass --dp-shard-update "
+                        "--elastic-slices E after -- for the bitwise "
+                        "trajectory pin)")
     p.add_argument("--restart-budget", type=int, default=None,
                    help="max child relaunches (default: kills + preempts "
-                        "+ 3)")
+                        "+ reshapes + 3)")
     p.add_argument("--backoff-base-s", type=float, default=0.5,
                    help="restart backoff base (doubles per consecutive "
                         "restart, capped by --backoff-max-s)")
@@ -146,6 +176,47 @@ def _global_step(epoch: int, step: int, steps_per_epoch: int) -> int:
     return (epoch - 1) * steps_per_epoch + step
 
 
+def parse_reshapes(specs: List[str]) -> List[Tuple[str, int, int, int]]:
+    """``shrink@E:S:M`` / ``grow@E:S:M`` -> (kind, epoch, step, devices)."""
+    out = []
+    for raw in specs:
+        try:
+            kind, rest = raw.split("@", 1)
+            e_s, s_s, m_s = rest.split(":")
+            e, s, m = int(e_s), int(s_s), int(m_s)
+        except ValueError:
+            raise ValueError(
+                f"bad --reshape spec {raw!r}: expected shrink@E:S:M or "
+                f"grow@E:S:M (e.g. shrink@2:1:2)")
+        if kind not in ("shrink", "grow"):
+            raise ValueError(
+                f"--reshape kind must be shrink or grow, got {kind!r}")
+        if e < 1 or s < 0 or m < 1:
+            raise ValueError(f"--reshape {raw!r}: E >= 1, S >= 0, M >= 1")
+        out.append((kind, e, s, m))
+    return out
+
+
+def merge_schedule(events: List[Tuple[str, int, int]],
+                   reshapes: List[Tuple[str, int, int, int]],
+                   steps_per_epoch: int) -> List[Tuple]:
+    """One pending list, ordered by global step (kills/preempts keep their
+    relative order; a reshape at the same boundary as a kill would race
+    the SIGKILL against the SIGTERM, so duplicates are rejected)."""
+    merged = list(events) + list(reshapes)
+    merged.sort(key=lambda t: _global_step(t[1], t[2], steps_per_epoch))
+    seen = set()
+    for t in merged:
+        pt = (t[1], t[2])
+        if pt in seen:
+            raise ValueError(
+                f"disruption schedule collision at epoch {t[1]} step "
+                f"{t[2]}: move the --reshape point off the kill/preempt "
+                f"grid")
+        seen.add(pt)
+    return merged
+
+
 # Stability-guard event lines (train/loop.py + guard/policy.py print these
 # with stable prefixes precisely so the supervisor can aggregate them).
 _GUARD_COUNTED = {
@@ -179,15 +250,21 @@ def guard_events(lines: List[str]) -> Dict[str, int]:
 
 def _train_argv(args, ckpt_dir: Optional[str], jsonl: str,
                 trace: Optional[str], inject: List[str],
-                resume: bool) -> List[str]:
+                resume: bool, devices: Optional[int] = None,
+                batch_size: Optional[int] = None,
+                elastic: bool = False) -> List[str]:
     argv = [sys.executable, "-m", "ddlbench_tpu.cli",
             "-b", args.benchmark, "-m", args.model, "-f", args.framework,
-            "-g", str(args.devices), "-e", str(args.epochs),
+            "-g", str(devices if devices is not None else args.devices),
+            "-e", str(args.epochs),
             "--steps-per-epoch", str(args.steps_per_epoch),
-            "--batch-size", str(args.batch_size),
+            "--batch-size",
+            str(batch_size if batch_size is not None else args.batch_size),
             "--log-interval", str(args.log_interval),
             "--dtype", args.dtype, "--seed", str(args.seed),
             "--jsonl", jsonl]
+    if elastic:
+        argv += ["--elastic-resume"]
     if args.platform:
         argv += ["--platform", args.platform]
     if ckpt_dir:
@@ -290,8 +367,14 @@ def verify_trajectory(baseline_jsonl: str, chaos_jsonl: str
                       ) -> Tuple[bool, List[str]]:
     """Bit-for-bit comparison (exact float equality — no tolerance: the
     commit protocol's claim is bitwise resume, not approximate resume)."""
-    b_train, b_valid = _jsonl_trajectory(baseline_jsonl)
-    c_train, c_valid = _jsonl_trajectory(chaos_jsonl)
+    return _verify_maps(_jsonl_trajectory(baseline_jsonl),
+                        _jsonl_trajectory(chaos_jsonl))
+
+
+def _verify_maps(baseline: Tuple[Dict, Dict], chaos: Tuple[Dict, Dict]
+                 ) -> Tuple[bool, List[str]]:
+    b_train, b_valid = baseline
+    c_train, c_valid = chaos
     mismatches = []
     for key, loss in sorted(b_train.items()):
         if key not in c_train:
@@ -312,8 +395,37 @@ def run_chaos(args) -> Dict[str, Any]:
     workdir = args.workdir or os.path.join("chaosbench_runs", str(os.getpid()))
     os.makedirs(workdir, exist_ok=True)
     ckpt_dir = os.path.join(workdir, "ckpt")
-    schedule = event_schedule(args.kills, getattr(args, "preempts", 0),
-                              args.epochs, args.steps_per_epoch)
+    reshapes = parse_reshapes(getattr(args, "reshape", []))
+    if reshapes and args.framework != "dp":
+        raise ValueError(
+            "--reshape changes the dp world size; run it with -f dp "
+            "(--dp-shard-update after -- for the ZeRO-1 reshard path)")
+    # the GLOBAL batch is the invariant across a reshape: the data stream
+    # is (epoch, step)-addressed at that batch, so the per-device batch
+    # rescales with each new world
+    global_batch = args.batch_size * args.devices
+    elastic_slices = None
+    if "--elastic-slices" in args.train_args:
+        # the child's elastic gates must hold at EVERY scheduled world, or
+        # each post-reshape relaunch dies in RunConfig.validate and the
+        # supervisor burns the whole restart budget on a usage error
+        elastic_slices = int(args.train_args[
+            args.train_args.index("--elastic-slices") + 1])
+    for kind, e, s, m in reshapes:
+        if global_batch % m:
+            raise ValueError(
+                f"--reshape {kind}@{e}:{s}:{m}: global batch "
+                f"{global_batch} must divide by the new device count {m}")
+        if elastic_slices is not None and \
+                (m & (m - 1) or elastic_slices % m):
+            raise ValueError(
+                f"--reshape {kind}@{e}:{s}:{m}: the child's "
+                f"--elastic-slices {elastic_slices} needs a power-of-two "
+                f"device count dividing it; {m} fails that gate")
+    schedule = merge_schedule(
+        event_schedule(args.kills, getattr(args, "preempts", 0),
+                       args.epochs, args.steps_per_epoch),
+        reshapes, args.steps_per_epoch)
     budget = (args.restart_budget if args.restart_budget is not None
               else len(schedule) + 3)
 
@@ -323,10 +435,12 @@ def run_chaos(args) -> Dict[str, Any]:
         "framework": args.framework,
         "epochs": args.epochs, "steps_per_epoch": args.steps_per_epoch,
         "checkpoint_every_steps": args.checkpoint_every_steps,
-        "kills_scheduled": [f"{k}@{e}:{s}" for k, e, s in schedule
-                            if k == "kill"],
-        "preempts_scheduled": [f"{k}@{e}:{s}" for k, e, s in schedule
-                               if k == "preempt"],
+        "kills_scheduled": [f"{t[0]}@{t[1]}:{t[2]}" for t in schedule
+                            if t[0] == "kill"],
+        "preempts_scheduled": [f"{t[0]}@{t[1]}:{t[2]}" for t in schedule
+                               if t[0] == "preempt"],
+        "reshapes_scheduled": [f"{k}@{e}:{s}:{m}"
+                               for k, e, s, m in reshapes],
         "restart_budget": budget,
     }
 
@@ -351,9 +465,10 @@ def run_chaos(args) -> Dict[str, Any]:
     attempts: List[AttemptResult] = []
     mttr_s: List[float] = []  # hard-kill MTTRs (legacy field name)
     mttr_preempt_s: List[float] = []  # graceful-preemption MTTRs
+    mttr_reshape_s: List[float] = []  # world-reshape recovery times
     steps_lost: List[int] = []
     recoveries = restarts = 0
-    kills_fired = preempts_fired = graceful_exits = 0
+    kills_fired = preempts_fired = reshapes_fired = graceful_exits = 0
     consecutive_failures = 0
     save_s = restore_s = 0.0
     last_death: Optional[float] = None
@@ -361,14 +476,17 @@ def run_chaos(args) -> Dict[str, Any]:
     killed_at: Optional[Tuple[int, int]] = None
     guard_totals: Dict[str, int] = {}
     completed = False
+    cur_devices, cur_batch = args.devices, args.batch_size
+    elastic = bool(reshapes)  # harmless on non-reshaped attempts
 
     while True:
         attempt_no = len(attempts)
-        inject = [f"{k}@{e}:{s}" for k, e, s in pending[:1]]
+        inject = [f"{pt[0]}@{pt[1]}:{pt[2]}" for pt in pending[:1]]
         trace = os.path.join(workdir, f"attempt_{attempt_no}.trace.json")
         argv = _train_argv(args, ckpt_dir, chaos_jsonl, trace, inject,
-                           resume=True)
-        print(f"chaosbench: attempt {attempt_no}"
+                           resume=True, devices=cur_devices,
+                           batch_size=cur_batch, elastic=elastic)
+        print(f"chaosbench: attempt {attempt_no} (devices {cur_devices})"
               + (f" (pending {inject[0]})" if inject
                  else " (no more disruptions)"),
               flush=True)
@@ -385,6 +503,7 @@ def run_chaos(args) -> Dict[str, Any]:
         if res.resumed_at is not None and last_death is not None:
             mttr = res.resumed_at - last_death
             (mttr_preempt_s if death_kind == "preempt"
+             else mttr_reshape_s if death_kind == "reshape"
              else mttr_s).append(mttr)
             recoveries += 1
             resumed_g = _parse_resumed_global(res.resumed_line,
@@ -416,13 +535,28 @@ def run_chaos(args) -> Dict[str, Any]:
             # actually fired (kill-branch parity): a stray external SIGTERM
             # also exits 75 with a committed line, but must not consume the
             # scheduled disruption point
-            if pending and pending[0][0] == "preempt" and \
-                    any(l.startswith("fault-inject: preempt")
+            if pending and pending[0][0] in ("shrink", "grow") and \
+                    any(l.startswith(f"fault-inject: {pending[0][0]}")
                         for l in res.lines):
-                pending.pop(0)
-                preempts_fired += 1
+                # world RESHAPE: the child committed its logical-metadata
+                # checkpoint; every attempt from here runs at the new
+                # world, per-device batch rescaled so the global batch —
+                # the (epoch, step) data-addressing invariant — holds
+                kind, e, s, m = pending.pop(0)
+                reshapes_fired += 1
+                cur_devices, cur_batch = m, global_batch // m
+                print(f"chaosbench: reshape {kind}@{e}:{s} -> devices "
+                      f"{m} (batch {cur_batch}/device, elastic resume)",
+                      flush=True)
+                last_death, death_kind = res.died_at, "reshape"
+            else:
+                if pending and pending[0][0] == "preempt" and \
+                        any(l.startswith("fault-inject: preempt")
+                            for l in res.lines):
+                    pending.pop(0)
+                    preempts_fired += 1
+                last_death, death_kind = res.died_at, "preempt"
             graceful_exits += 1
-            last_death, death_kind = res.died_at, "preempt"
             consecutive_failures = 0
         else:
             consecutive_failures += 1
@@ -447,6 +581,8 @@ def run_chaos(args) -> Dict[str, Any]:
         # disruption points, and the report must agree with mttr/steps_lost
         "kills": kills_fired,
         "preempts": preempts_fired,
+        "reshapes": reshapes_fired,
+        "final_devices": cur_devices,
         "graceful_exits": graceful_exits,
         "recoveries": recoveries,
         "mttr_s": [round(t, 3) for t in mttr_s],
@@ -455,6 +591,10 @@ def run_chaos(args) -> Dict[str, Any]:
         "mttr_preempt_s_mean": (round(sum(mttr_preempt_s)
                                       / len(mttr_preempt_s), 3)
                                 if mttr_preempt_s else None),
+        "mttr_reshape_s": [round(t, 3) for t in mttr_reshape_s],
+        "mttr_reshape_s_mean": (round(sum(mttr_reshape_s)
+                                      / len(mttr_reshape_s), 3)
+                                if mttr_reshape_s else None),
         "steps_lost_per_kill": steps_lost,
         "guard": guard_totals,
         "chaos_wall_s": round(chaos_wall, 3),
@@ -465,10 +605,31 @@ def run_chaos(args) -> Dict[str, Any]:
     })
 
     if not args.skip_verify and completed:
-        match, mismatches = verify_trajectory(baseline_jsonl, chaos_jsonl)
+        b_train, b_valid = _jsonl_trajectory(baseline_jsonl)
+        c_train, c_valid = _jsonl_trajectory(chaos_jsonl)
+        match, mismatches = _verify_maps((b_train, b_valid),
+                                         (c_train, c_valid))
         report["trajectory_match"] = match
         if not match:
             report["trajectory_mismatches"] = mismatches[:20]
+        if reshapes:
+            # post-reshape trajectory divergence: max |loss delta| vs the
+            # baseline over records at/after the FIRST reshape point —
+            # 0.0 for f32 elastic runs (the headline reshape number next
+            # to mttr_reshape_s; nonzero quantifies drift when a run
+            # reshapes without --elastic-slices)
+            spe = args.steps_per_epoch
+            e0, s0 = reshapes[0][1], reshapes[0][2]
+            g0 = _global_step(e0, s0, spe)
+            div = 0.0
+            for (ep, prog), loss in b_train.items():
+                g = (ep - 1) * spe + round(prog * spe / 100.0) - 1
+                if g >= g0 and (ep, prog) in c_train:
+                    div = max(div, abs(c_train[(ep, prog)] - loss))
+            for ep, (l, _a) in b_valid.items():
+                if ep >= e0 and ep in c_valid:
+                    div = max(div, abs(c_valid[ep][0] - l))
+            report["post_reshape_divergence"] = div
 
     print(json.dumps(report), flush=True)
     if args.json:
@@ -481,7 +642,13 @@ def run_chaos(args) -> Dict[str, Any]:
 
 def main(argv=None) -> int:
     args = _parse_args(argv)
-    report = run_chaos(args)
+    try:
+        report = run_chaos(args)
+    except ValueError as e:
+        # schedule-construction errors (--reshape grammar, batch/world
+        # divisibility, kill-point collisions) are usage errors, not bugs
+        print(f"chaosbench: {e}", file=sys.stderr, flush=True)
+        return 2
     # nonzero whenever no run COMPLETED (e.g. the restart budget was
     # exhausted on a crash-looping child), an error was recorded, or the
     # recovered trajectory diverged — supervisor callers key off this

@@ -68,11 +68,24 @@ def _round6(v):
     return v
 
 
-def run_open_loop(server, reqs):
+def _apply_resizes(server, clock: float, resizes):
+    """Fire every due ``(at, n)`` resize (a sorted list the caller
+    consumes): the live-fleet scale-up/down under load (engine.resize)."""
+    while resizes and clock >= resizes[0][0]:
+        at, n = resizes.pop(0)
+        rep = server.resize(n, now=clock)
+        print(f"servebench: resize @ {clock:g} -> {n} replicas "
+              f"(evicted {rep['evicted']}, redistributed "
+              f"{rep['redistributed']})", file=sys.stderr, flush=True)
+
+
+def run_open_loop(server, reqs, resizes=None):
     """Release requests at their arrival times; returns the final clock."""
     clock, i = 0.0, 0
+    resizes = list(resizes or [])
     pend = sorted(reqs, key=lambda r: (r.arrival, r.rid))
     while i < len(pend) or server.has_work():
+        _apply_resizes(server, clock, resizes)
         while i < len(pend) and pend[i].arrival <= clock:
             server.submit(pend[i])
             i += 1
@@ -84,16 +97,18 @@ def run_open_loop(server, reqs):
     return clock
 
 
-def run_closed_loop(server, reqs, concurrency: int):
+def run_closed_loop(server, reqs, concurrency: int, resizes=None):
     """Keep ``concurrency`` requests in flight; each completion releases
     the next. Returns the final clock."""
     clock, nxt = 0.0, 0
+    resizes = list(resizes or [])
     for _ in range(min(concurrency, len(reqs))):
         reqs[nxt].arrival = clock
         server.submit(reqs[nxt])
         nxt += 1
     done = 0
     while done < len(reqs):
+        _apply_resizes(server, clock, resizes)
         rep = server.step(clock)
         clock += rep.cost
         done += len(rep.completed)
@@ -125,6 +140,16 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=1,
                    help="independent data-parallel serving replicas "
                         "(least-loaded dispatch)")
+    p.add_argument("--resize", action="append", default=[], metavar="AT:N",
+                   help="live replica resize schedule (repeatable): at "
+                        "virtual time AT scale the fleet to N replicas "
+                        "under load — scale-down drains replicas (in-"
+                        "flight requests evicted onto the recompute path, "
+                        "queues redistributed least-loaded), scale-up "
+                        "shares the jitted callables. No request is lost "
+                        "and token streams stay bitwise vs an un-resized "
+                        "control (pinned); the JSON row gains "
+                        "resize_events/requests_lost fields")
     p.add_argument("--arrival", default="poisson",
                    choices=("poisson", "bursty", "closed"))
     p.add_argument("--rate", type=float, default=0.5,
@@ -235,6 +260,18 @@ def main(argv=None) -> int:
         except ValueError:
             p.error("--shared-prefix wants G:P (groups:prefix_tokens), "
                     f"got {args.shared_prefix!r}")
+    resizes = []
+    for rspec in args.resize:
+        try:
+            at_s, n_s = rspec.split(":")
+            at, nrep = float(at_s), int(n_s)
+        except ValueError:
+            p.error(f"--resize wants AT:N (virtual_time:replicas), "
+                    f"got {rspec!r}")
+        if at < 0 or nrep < 1:
+            p.error(f"--resize {rspec!r}: AT >= 0 and N >= 1")
+        resizes.append((at, nrep))
+    resizes.sort()
     temperature, top_k = 0.0, 0
     if args.sample:
         for part in args.sample.split(","):
@@ -298,14 +335,22 @@ def main(argv=None) -> int:
         t0 = time.perf_counter()
         try:
             if args.arrival == "closed":
-                duration = run_closed_loop(server, reqs, args.concurrency)
+                duration = run_closed_loop(server, reqs, args.concurrency,
+                                           resizes=resizes)
             else:
-                duration = run_open_loop(server, reqs)
+                duration = run_open_loop(server, reqs, resizes=resizes)
         finally:
             if tracer is not None:
                 tracer.disable()
                 set_tracer(prev_tracer)
         wall = time.perf_counter() - t0
+        if resizes and len(server.resize_events) < len(resizes):
+            unfired = [f"{at:g}:{n}" for at, n in
+                       resizes[len(server.resize_events):]]
+            print(f"servebench: WARNING {len(unfired)} --resize event(s) "
+                  f"dated past the end of work never fired "
+                  f"({', '.join(unfired)}); the run drained at "
+                  f"{duration:g}", file=sys.stderr, flush=True)
         timeline_fields = {}
         if tracer is not None:
             from ddlbench_tpu.telemetry.export import export_chrome_trace
@@ -368,6 +413,20 @@ def main(argv=None) -> int:
             # component breakdowns (absent otherwise so a plain row stays
             # bitwise identical traced or untraced)
             **timeline_fields,
+            # --resize only (plain rows keep the schema-pinned key set):
+            # the resize schedule, what each event displaced, the final
+            # fleet size, and the no-request-lost invariant made explicit
+            **({"resize": args.resize,
+                "resize_events": server.resize_events,
+                # schedule entries dated past the end of work never fire
+                # (the drivers only resize while work remains) — surfaced
+                # rather than silently compared against a fleet that
+                # never reached its scheduled size
+                "resizes_unfired": len(resizes) - len(server.resize_events),
+                "final_replicas": len(server.engines),
+                "requests_lost":
+                    args.requests - len(server.finished)}
+               if args.resize else {}),
             # actual backend record (shared classification —
             # distributed.backend_provenance); cpu-fallback rows must be
             # identifiable as harness validation, not chip numbers

@@ -12,9 +12,13 @@ pytree, wrapped in an explicit **atomic commit protocol**:
 
 1. orbax writes the state under ``<name>.tmp/state``;
 2. ``resume.json`` (epoch, interior step, global step, metric-logger
-   counters, seed) is written next to it;
+   counters, seed) is written next to it, and — when the caller supplies
+   it — ``logical.json``, the topology-portable metadata (leaf
+   shapes/dtypes, flat-meta bucket layout, the world/dp/stage shape the
+   state was saved under; see train/reshard.py) that lets an N-chip
+   checkpoint resume on M chips;
 3. a ``COMMIT.json`` marker — carrying a manifest of every file's size and
-   SHA-256 — is written + fsynced *last*;
+   SHA-256, metadata files included — is written + fsynced *last*;
 4. the ``.tmp`` directory is atomically renamed to its final name and the
    parent directory fsynced.
 
@@ -56,6 +60,10 @@ from ddlbench_tpu import faults
 
 COMMIT_MARKER = "COMMIT.json"
 RESUME_META = "resume.json"
+# topology-portable logical metadata (train/reshard.py): leaf shapes,
+# flat-meta bucket layout, and the world/dp/stage shape the state was saved
+# under — what lets an N-chip checkpoint resume on M chips
+LOGICAL_META = "logical.json"
 _STATE_SUBDIR = "state"
 _NAME_RE = re.compile(r"^epoch_(\d+)(?:_step_(\d+))?$")
 
@@ -139,7 +147,8 @@ def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
                     logger_state: Optional[Dict[str, Any]] = None,
                     seed: Optional[int] = None,
                     keep: Optional[int] = None,
-                    pin: Optional[str] = None) -> str:
+                    pin: Optional[str] = None,
+                    logical: Optional[Dict[str, Any]] = None) -> str:
     """Atomically commit ``train_state`` under ``<ckpt_dir>/<name>``.
 
     ``step`` (interior, 0-based index of the last completed step) selects the
@@ -174,6 +183,16 @@ def save_checkpoint(ckpt_dir: str, epoch: int, train_state: Any,
         json.dump(meta, f)
         f.flush()
         os.fsync(f.fileno())
+
+    if logical is not None:
+        # topology-portable metadata (train/reshard.logical_meta) — written
+        # INSIDE the tmp dir before the marker, so the manifest below
+        # covers it exactly like resume.json and the orbax payload: a torn
+        # metadata file fails verification and latest_valid falls back
+        with open(os.path.join(tmp, LOGICAL_META), "w") as f:
+            json.dump(logical, f)
+            f.flush()
+            os.fsync(f.fileno())
 
     # COMMIT marker last: its presence asserts every other byte is durable
     # and its manifest (size + sha256 per file) is what latest_valid verifies
@@ -281,6 +300,18 @@ def latest_valid(ckpt_dir: str) -> Optional[CheckpointInfo]:
             meta = {"epoch": epoch, "step": step}
         return CheckpointInfo(epoch, step, path, meta)
     return None
+
+
+def load_logical(path: str) -> Optional[Dict[str, Any]]:
+    """The checkpoint's logical (topology-portable) metadata, or None for
+    pre-elastic checkpoints. ``latest_valid`` has already verified the
+    file against the commit manifest by the time a resume reads it, so an
+    unreadable file here is a programming error, not media corruption."""
+    p = os.path.join(path, LOGICAL_META)
+    if not os.path.exists(p):
+        return None
+    with open(p) as f:
+        return json.load(f)
 
 
 def gc_checkpoints(ckpt_dir: str, keep: int,

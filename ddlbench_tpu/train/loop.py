@@ -160,12 +160,14 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
                   f"wiring, so no grad-norm stream feeds the detector)",
                   file=sys.stderr, flush=True)
     if preempt is None and \
-            any(s.kind == "preempt" for s in faults.armed_specs()):
+            any(s.kind in ("preempt", "shrink", "grow")
+                for s in faults.armed_specs()):
         # the graceful path needs somewhere to commit; without it the
-        # injected SIGTERM is just an uncheckpointed death (rc -15)
-        print("WARNING: --inject preempt without --checkpoint-dir kills "
-              "the run uncheckpointed (graceful preemption needs a commit "
-              "target)", file=sys.stderr, flush=True)
+        # injected SIGTERM is just an uncheckpointed death (rc -15) —
+        # and for shrink/grow there is then no checkpoint to reshape from
+        print("WARNING: --inject preempt/shrink/grow without "
+              "--checkpoint-dir kills the run uncheckpointed (the graceful "
+              "path needs a commit target)", file=sys.stderr, flush=True)
     try:
         while True:
             try:
@@ -285,21 +287,29 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     mb, chunks = cfg.resolved_batches()
     global_batch = cfg.global_batch()
 
-    base_lr = cfg.resolved_lr()
-    # The gradual warmup ramps away exactly the world-scaling factor
-    # (imagenet_horovod.py:258-275), so it only does something where that
-    # scaling is applied — warmup_world stays 1 elsewhere and
-    # gradual_warmup_lr is then the identity.
-    warmup_world = 1
-    if (cfg.strategy == "dp" and cfg.scale_lr_by_world
-            and cfg.resolved_optimizer() == "sgd"):
-        # Horovod parity: lr scaled by world size (mnist_horovod.py:226) and
-        # by the accumulation count (lr * batches_per_allreduce * hvd.size(),
-        # imagenet_horovod.py:131). SGD only — linear scaling is the SGD
-        # heuristic; the reference never scales its Adam (translation) lr by
-        # replica count.
-        base_lr = base_lr * strategy.world_size * cfg.grad_accum_steps
-        warmup_world = strategy.world_size
+    def _scaled_lr(lr_world: int):
+        lr = cfg.resolved_lr()
+        # The gradual warmup ramps away exactly the world-scaling factor
+        # (imagenet_horovod.py:258-275), so it only does something where
+        # that scaling is applied — warmup_world stays 1 elsewhere and
+        # gradual_warmup_lr is then the identity.
+        w = 1
+        if (cfg.strategy == "dp" and cfg.scale_lr_by_world
+                and cfg.resolved_optimizer() == "sgd"):
+            # Horovod parity: lr scaled by world size (mnist_horovod.py:226)
+            # and by the accumulation count (lr * batches_per_allreduce *
+            # hvd.size(), imagenet_horovod.py:131). SGD only — linear
+            # scaling is the SGD heuristic; the reference never scales its
+            # Adam (translation) lr by replica count. ``lr_world`` is
+            # normally the mesh world, but an ELASTIC resume pins it to
+            # the LAUNCH world recorded in the checkpoint — shrinking a
+            # fleet must never silently change the learning rate.
+            lr = lr * lr_world * cfg.grad_accum_steps
+            w = lr_world
+        return lr, w
+
+    lr_world = getattr(strategy, "world_size", cfg.num_devices)
+    base_lr, warmup_world = _scaled_lr(lr_world)
 
     # Step-latency accounting (telemetry/stats.py): every loop iteration's
     # wall time is recorded (two monotonic clock reads — stays on even with
@@ -368,7 +378,10 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
     ckpt_pin: Optional[str] = None
     start_epoch, resume_step, global_step = 1, 0, 0
     if cfg.checkpoint_dir and cfg.resume:
-        from ddlbench_tpu.train.checkpoint import latest_valid, restore_info
+        from ddlbench_tpu.train import reshard
+        from ddlbench_tpu.train.checkpoint import (latest_valid,
+                                                   load_logical,
+                                                   restore_info)
 
         info = latest_valid(cfg.checkpoint_dir)
         if wd:
@@ -382,8 +395,50 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
             print(f"resume: no valid checkpoint under {cfg.checkpoint_dir}; "
                   f"starting fresh", flush=True)
         else:
-            with tracer.span("checkpoint_restore"):
-                ts = restore_info(info, ts)
+            # Topology check BEFORE touching orbax: a world-shape mismatch
+            # either routes through the reshard pass (--elastic-resume) or
+            # raises the named CheckpointShapeError instead of dying on a
+            # cryptic orbax shape assert (train/reshard.py).
+            saved_logical = load_logical(info.path)
+            cur_logical = reshard.logical_meta(strategy, cfg, ts, lr_world)
+            decision = reshard.compare(saved_logical, cur_logical,
+                                       cfg.elastic_resume)
+            with tracer.span("checkpoint_restore",
+                             reshard=decision == "reshard"):
+                if decision == "reshard":
+                    print(f"elastic resume: resharding checkpoint from "
+                          f"world {saved_logical['world']} to "
+                          f"{cur_logical['world']} "
+                          f"(buckets {saved_logical.get('buckets')} -> "
+                          f"{cur_logical.get('buckets')})", flush=True)
+                    ts = reshard.elastic_restore(info, ts, saved_logical,
+                                                 strategy, cfg)
+                else:
+                    ts = restore_info(info, ts)
+            if saved_logical is not None:
+                if saved_logical.get("global_batch") != cfg.global_batch():
+                    print(f"resume: WARNING checkpoint was written at "
+                          f"global batch {saved_logical.get('global_batch')}"
+                          f", run uses {cfg.global_batch()} — the "
+                          f"(epoch, step)-addressed data streams will not "
+                          f"match the original trajectory", flush=True)
+                saved_lr_world = saved_logical.get("lr_world")
+                if saved_lr_world and saved_lr_world != lr_world:
+                    # pin the lr world-scaling to the LAUNCH world: the
+                    # run's hyperparameters were fixed at launch, and a
+                    # reshaped fleet must replay the same schedule
+                    lr_world = saved_lr_world
+                    base_lr, warmup_world = _scaled_lr(lr_world)
+                    print(f"elastic resume: lr world-scaling pinned to the "
+                          f"launch world ({lr_world})", flush=True)
+                if saved_logical.get("elastic_slices") != \
+                        cfg.elastic_slices:
+                    print(f"resume: WARNING checkpoint recorded "
+                          f"--elastic-slices "
+                          f"{saved_logical.get('elastic_slices')}, run "
+                          f"uses {cfg.elastic_slices} — reduction orders "
+                          f"differ, the trajectory will not be bitwise",
+                          flush=True)
             ckpt_pin = info.path
             meta = info.meta
             if meta.get("seed") is not None and meta["seed"] != cfg.seed:
@@ -424,6 +479,15 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                               prefetcher=prefetch, guard=guard)
                 logger.valid_epoch(info.epoch, ev["loss"], ev["accuracy"],
                                    top5=ev.get("top5"))
+
+    # Topology-portable metadata written beside every commit from here on:
+    # the recorded shape is what lets the NEXT resume detect a world-size
+    # mismatch and reshard instead of crashing (train/reshard.py).
+    ckpt_logical = None
+    if cfg.checkpoint_dir:
+        from ddlbench_tpu.train import reshard as _reshard
+
+        ckpt_logical = _reshard.logical_meta(strategy, cfg, ts, lr_world)
 
     # Activation/gradient deep-dive logging (torchlogger analog, §5.5).
     # Works on the flat per-layer param structure; pipeline strategies pack
@@ -526,7 +590,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     # state that gets committed.
                     guard.flush(epoch, step)
                     _commit_preemption(cfg, ts, epoch, step, global_step,
-                                       logger, tracer, wd, ckpt_pin)
+                                       logger, tracer, wd, ckpt_pin,
+                                       ckpt_logical)
                 if faults.poison_grad(epoch, step):
                     # `nan-grad`: a NaN lr rides into the backward through
                     # the guard-armed engines' objective multiplier
@@ -635,7 +700,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                             cfg.checkpoint_dir, epoch, ts, step=step,
                             global_step=global_step,
                             logger_state=logger.state_dict(), seed=cfg.seed,
-                            keep=cfg.keep_checkpoints, pin=ckpt_pin)
+                            keep=cfg.keep_checkpoints, pin=ckpt_pin,
+                            logical=ckpt_logical)
                     if wd:
                         wd.kick()
         finally:
@@ -667,7 +733,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
                     cfg.checkpoint_dir, epoch, ts,
                     global_step=global_step,
                     logger_state=logger.state_dict(),
-                    seed=cfg.seed, keep=cfg.keep_checkpoints, pin=ckpt_pin)
+                    seed=cfg.seed, keep=cfg.keep_checkpoints, pin=ckpt_pin,
+                    logical=ckpt_logical)
             if wd:
                 wd.kick()
 
@@ -684,7 +751,8 @@ def _run_benchmark(cfg: RunConfig, strategy, data, logger: MetricLogger,
 
 def _commit_preemption(cfg: RunConfig, ts, epoch: int, step: int,
                        global_step: int, logger: MetricLogger, tracer, wd,
-                       pin: Optional[str]) -> None:
+                       pin: Optional[str],
+                       logical: Optional[Dict[str, Any]] = None) -> None:
     """Graceful preemption at the (epoch, step) boundary: commit the state
     as of the last COMPLETED step through the atomic protocol, then raise
     :class:`GracefulPreemption` (cli.py maps it to PREEMPT_EXIT_CODE)."""
@@ -717,7 +785,8 @@ def _commit_preemption(cfg: RunConfig, ts, epoch: int, step: int,
         path = save_checkpoint(
             cfg.checkpoint_dir, ck_epoch, ts, step=ck_step,
             global_step=global_step, logger_state=logger.state_dict(),
-            seed=cfg.seed, keep=cfg.keep_checkpoints, pin=pin)
+            seed=cfg.seed, keep=cfg.keep_checkpoints, pin=pin,
+            logical=logical)
     where = (f"epoch {ck_epoch} step {ck_step}" if ck_step is not None
              else f"epoch {ck_epoch}")
     print(f"preempt: checkpoint committed at {where}", flush=True)

@@ -1,0 +1,431 @@
+"""Topology-portable checkpoints: world-size detection + the reshard pass.
+
+Crash consistency (train/checkpoint.py) assumed the replacement pod has
+the SAME shape as the one that died; real fleets hand back fewer or more
+chips, and an N-chip ZeRO-1 checkpoint restored on M chips used to die
+deep inside orbax with a cryptic shape assert (the packed flat vectors are
+world-padded: ``padded_N != padded_M``). This module makes the mismatch a
+first-class event:
+
+* every checkpoint now carries LOGICAL (unsharded, world-agnostic)
+  metadata — leaf shapes/dtypes, the flat-meta bucket layout, and the
+  world/dp/stage shape it was saved under (``logical_meta``, written as
+  ``logical.json`` inside the commit and covered by the manifest);
+* at resume, :func:`compare` detects the mismatch. Without
+  ``--elastic-resume`` it raises the named :class:`CheckpointShapeError`
+  (both shapes in the message, warn-once pointer at the flag); with it,
+  :func:`elastic_restore` restores the checkpoint at its SAVED shapes and
+  converts the flat state between world sizes.
+
+The conversion is a pure PERMUTATION, never a gather: the
+weight-update-sharding layout (PAPERS.md 2004.13336) keeps every logical
+element's value independent of the world size — world padding only moves
+zeros between buckets, and the device-major relayout is an index
+permutation (``parallel/common.py to_device_major``/``device_major_perm``).
+So for f32 state the round trip save@N -> reshard -> M is bitwise: strip
+each bucket's pad, re-pad for the new world, re-permute. Covered layouts:
+
+* the dp ZeRO-1 engine's packed flat optimizer state (``--dp-shard-update``,
+  sgd momentum and adam m/v, any ``--comm-buckets K`` on either side) and
+  the overlapped engine's flat device-major parameter vector;
+* the PR 8 pipe-mesh ``row_flat_meta`` stage rows (params + optimizer
+  state sharded over the pipe mesh's 'data' axis), for a changed dp
+  replica count at the SAME stage split. A changed stage count is a
+  re-planning problem, not a permutation — the auto-partition path
+  (``--auto-partition``) owns the stage split, so S/V changes raise
+  :class:`CheckpointShapeError` directing the run there.
+
+Exact data/RNG fast-forward needs nothing new: batches are (epoch, step)-
+addressed at the GLOBAL batch and per-step RNG streams are pure
+(seed, epoch, step) fold-ins, so the bitwise-resume machinery carries over
+unchanged — provided the global batch is preserved across the reshape
+(checked here, loud warning on mismatch). Trajectory bitwiseness across
+world sizes additionally needs the world-invariant reduction order of
+``--elastic-slices`` (parallel/dp.py elastic engine); the lr world-scaling
+factor is pinned to the LAUNCH world recorded in the metadata so shrinking
+a fleet never silently changes the learning rate.
+"""
+
+from __future__ import annotations
+
+import sys
+from typing import Any, Dict, List, Optional
+
+import numpy as np
+
+LOGICAL_SCHEMA = 1
+
+_warned_flag = False  # warn-once pointer at --elastic-resume
+
+
+class CheckpointShapeError(RuntimeError):
+    """A checkpoint's recorded world shape mismatches the current mesh and
+    the elastic reshard path is not enabled (or cannot cover the change)."""
+
+
+def _leaf_meta(ts) -> List[Dict[str, Any]]:
+    import jax
+
+    return [{"shape": list(getattr(l, "shape", ())),
+             "dtype": str(np.dtype(getattr(l, "dtype", np.float32)))}
+            for l in jax.tree.leaves(ts)]
+
+
+def logical_meta(strategy, cfg, ts, lr_world: int) -> Dict[str, Any]:
+    """World-agnostic description of ``ts``'s sharded layout, written next
+    to every checkpoint (``logical.json``). ``lr_world`` is the world size
+    the run's lr scaling was computed with (the LAUNCH world — carried
+    through elastic resumes so a reshape never changes the lr)."""
+    meta: Dict[str, Any] = {
+        "schema": LOGICAL_SCHEMA,
+        "strategy": cfg.strategy,
+        "world": int(getattr(strategy, "world_size", cfg.num_devices)),
+        "global_batch": int(cfg.global_batch()),
+        "lr_world": int(lr_world),
+        "elastic_slices": cfg.elastic_slices,
+        "kind": "replicated",
+        "leaves": _leaf_meta(ts),
+    }
+    if getattr(strategy, "pipe_shard", False):
+        rm = strategy._row_meta
+        meta.update(
+            kind="pipe_shard", dp=int(strategy.dp),
+            stages=int(strategy.num_stages), vstages=int(strategy.vstages),
+            buckets=int(max(1, cfg.comm_buckets)),
+            length=int(rm.length), padded=int(rm.padded),
+            bucket_padded=[int(b) for b in rm.bucket_padded])
+    elif getattr(strategy, "shard_update", False) and \
+            getattr(strategy, "_flat_meta", None) is not None:
+        fm = strategy._flat_meta
+        meta.update(
+            kind="dp_shard", buckets=int(max(1, cfg.comm_buckets)),
+            overlap=bool(getattr(strategy, "_overlap", False)),
+            length=int(fm.length), padded=int(fm.padded),
+            bucket_padded=[int(b) for b in fm.bucket_padded])
+    return meta
+
+
+def compare(saved: Optional[Dict[str, Any]], cur: Dict[str, Any],
+            elastic: bool) -> Optional[str]:
+    """None = shapes agree (plain restore); "reshard" = world-size mismatch
+    the permutation pass covers. Raises :class:`CheckpointShapeError` when
+    the mismatch is not covered, or is covered but ``elastic`` is False
+    (with a warn-once pointer at --elastic-resume)."""
+    global _warned_flag
+    if saved is None:
+        # pre-elastic checkpoint: no recorded shape to compare — restore as
+        # before (a genuine mismatch still fails inside orbax, as it always
+        # did for un-annotated checkpoints)
+        return None
+    schema = saved.get("schema")
+    if schema != LOGICAL_SCHEMA:
+        # a NEWER schema must fail loudly, not silently skip the shape
+        # check and die in the orbax assert this module exists to remove
+        raise CheckpointShapeError(
+            f"checkpoint logical metadata has schema {schema!r}; this "
+            f"build understands schema {LOGICAL_SCHEMA} — resume with a "
+            f"build at least as new as the one that wrote the checkpoint")
+    if saved.get("strategy") != cur["strategy"]:
+        raise CheckpointShapeError(
+            f"checkpoint was saved by the {saved.get('strategy')!r} strategy "
+            f"but this run uses {cur['strategy']!r}; resharding converts "
+            f"world sizes, not engines")
+    if saved.get("kind") != cur["kind"]:
+        raise CheckpointShapeError(
+            f"checkpoint engine layout {saved.get('kind')!r} != current "
+            f"{cur['kind']!r} (e.g. --dp-shard-update toggled between save "
+            f"and resume); rerun with the saving run's engine flags")
+    kind = cur["kind"]
+    if kind == "pipe_shard" and (saved["stages"] != cur["stages"]
+                                 or saved["vstages"] != cur["vstages"]):
+        raise CheckpointShapeError(
+            f"checkpoint stage split S={saved['stages']} V={saved['vstages']}"
+            f" != current S={cur['stages']} V={cur['vstages']}: a changed "
+            f"stage count is a re-planning problem, not a permutation — "
+            f"re-plan via --auto-partition at the new topology and restart "
+            f"(elastic resume covers the 'data'-axis world only)")
+    if kind != "replicated" and saved.get("length") != cur.get("length"):
+        raise CheckpointShapeError(
+            f"checkpoint packed length {saved.get('length')} != current "
+            f"{cur.get('length')}: the MODEL differs, not just the world")
+    same = (saved.get("world") == cur["world"]
+            and saved.get("padded") == cur.get("padded")
+            and saved.get("bucket_padded") == cur.get("bucket_padded")
+            and saved.get("dp", saved.get("world")) ==
+            cur.get("dp", cur["world"])
+            and bool(saved.get("overlap")) == bool(cur.get("overlap")))
+    if same:
+        return None
+    if kind == "replicated":
+        if saved.get("leaves") == cur.get("leaves"):
+            # every leaf really is world-agnostic (the recorded shapes
+            # equal the live strategy's): a changed world restores
+            # cleanly — worth a note, not an error
+            print(f"elastic resume: world changed {saved.get('world')} -> "
+                  f"{cur['world']} (state shapes world-agnostic; no "
+                  f"reshard needed)", flush=True)
+            return None
+        # "replicated" is the catch-all kind, and some engines under it
+        # DO shape their state by the topology (hetero's [N, L] packed
+        # rows, stage-packed matrices at a different split): claiming the
+        # restore is safe would just move the crash into orbax
+        raise CheckpointShapeError(
+            f"checkpoint state shapes (saved at world {saved.get('world')})"
+            f" differ from the live strategy's (world {cur['world']}) and "
+            f"the {cur['strategy']!r} engine's layout has no reshard path "
+            f"— elastic resume covers the dp ZeRO-1 and pipe-mesh hybrid "
+            f"flat layouts; restart at the saved topology (or re-plan)")
+    shapes = (f"saved world {saved.get('world')} "
+              f"(dp {saved.get('dp', saved.get('world'))}, "
+              f"buckets {saved.get('buckets')}, padded {saved.get('padded')})"
+              f" vs current world {cur['world']} "
+              f"(dp {cur.get('dp', cur['world'])}, buckets "
+              f"{cur.get('buckets')}, padded {cur.get('padded')})")
+    if not elastic:
+        if not _warned_flag:
+            print("WARNING: checkpoint world shape mismatches the current "
+                  "mesh; pass --elastic-resume to reshard the ZeRO-1 flat "
+                  "state through the topology-portable permutation path",
+                  file=sys.stderr, flush=True)
+            _warned_flag = True
+        raise CheckpointShapeError(
+            f"checkpoint/mesh world-shape mismatch: {shapes}; enable "
+            f"--elastic-resume to reshard instead of crashing in orbax")
+    return "reshard"
+
+
+# ---- the permutation itself (pure numpy, f32-bitwise) ----------------------
+
+
+def _content_lengths(meta):
+    from ddlbench_tpu.parallel.common import bucket_content_lengths
+
+    return bucket_content_lengths(meta)
+
+
+def to_logical(flat: np.ndarray, meta) -> np.ndarray:
+    """Padded bucket-layout vector -> the [length] logical vector (pads
+    stripped). Inverse of :func:`from_logical`."""
+    lens = _content_lengths(meta)
+    parts = [flat[off:off + bl]
+             for off, bl in zip(meta.bucket_offsets, lens)]
+    return (np.concatenate(parts) if parts
+            else flat[:0])
+
+
+def from_logical(vec: np.ndarray, meta) -> np.ndarray:
+    """[length] logical vector -> the padded bucket layout of ``meta``."""
+    lens = _content_lengths(meta)
+    parts: List[np.ndarray] = []
+    c = 0
+    for bp, bl in zip(meta.bucket_padded, lens):
+        parts.append(vec[c:c + bl])
+        c += bl
+        if bp > bl:
+            parts.append(np.zeros((bp - bl,), vec.dtype))
+    return np.concatenate(parts) if parts else vec[:0]
+
+
+def reshard_flat(vec: np.ndarray, meta_src, world_src: int, meta_dst,
+                 world_dst: int, dm_src: bool = False,
+                 dm_dst: bool = False) -> np.ndarray:
+    """Convert one packed flat vector between world layouts along its LAST
+    axis: (optional) undo the source device-major permutation, strip each
+    source bucket's world padding, re-pad for the destination buckets, and
+    (optionally) apply the destination device-major permutation. A pure
+    index permutation plus zero pads — bitwise for any dtype."""
+    from ddlbench_tpu.parallel.common import device_major_perm
+
+    lead = vec.shape[:-1]
+    flat = vec.reshape(-1, vec.shape[-1])
+    if dm_src:
+        _, inv = device_major_perm(meta_src, world_src)
+        flat = flat[:, inv]
+    out = np.stack([from_logical(to_logical(row, meta_src), meta_dst)
+                    for row in flat])
+    if dm_dst:
+        perm, _ = device_major_perm(meta_dst, world_dst)
+        out = out[:, perm]
+    return out.reshape(*lead, meta_dst.padded)
+
+
+# ---- the end-to-end elastic restore ---------------------------------------
+
+
+def _abstract_saved(ts, saved: Dict[str, Any], strategy, mesh):
+    """Abstract target mirroring ``ts``'s structure at the SAVED shapes
+    (flat leaves resized to the saved padded length), replicated over the
+    current mesh so orbax can restore it on any world size."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    rep = NamedSharding(mesh, P())
+    padded_n = saved["padded"]
+
+    def remap(leaf, flat: bool, axis_last: bool = False):
+        shape = tuple(leaf.shape)
+        if flat:
+            shape = (shape[:-1] + (padded_n,)) if axis_last else (padded_n,)
+        return jax.ShapeDtypeStruct(shape, leaf.dtype, sharding=rep)
+
+    kind = saved["kind"]
+    params, model_state, opt = ts.params, ts.model_state, ts.opt
+    if kind == "dp_shard":
+        overlap = bool(saved.get("overlap"))
+        if overlap:
+            # saved params = the flat device-major [padded_N] vector
+            abs_params = jax.ShapeDtypeStruct((saved["padded"],),
+                                              np.float32, sharding=rep)
+        else:
+            # saved params = the per-layer pytree. When the CURRENT engine
+            # is overlapped, ts.params is flat — rebuild the pytree
+            # structure from the (model-identical) flat meta instead.
+            fm = strategy._flat_meta
+            if getattr(strategy, "_overlap", False):
+                leaves = [jax.ShapeDtypeStruct(s, d, sharding=rep)
+                          for s, d in zip(fm.shapes, fm.dtypes)]
+                abs_params = jax.tree.unflatten(fm.treedef, leaves)
+            else:
+                abs_params = jax.tree.map(lambda l: remap(l, False), params)
+        abs_opt = {k: (remap(v, True) if k in ("m", "v")
+                       else jax.tree.map(lambda l: remap(l, False), v))
+                   for k, v in opt.items()}
+    else:  # pipe_shard: flat row axis is the LAST axis of every row leaf
+        abs_params = remap(params, True, axis_last=True)
+        abs_opt = {k: (remap(v, True, axis_last=True) if k in ("m", "v")
+                       else jax.tree.map(lambda l: remap(l, False), v))
+                   for k, v in opt.items()}
+    abs_state = jax.tree.map(lambda l: remap(l, False), model_state)
+    return type(ts)(abs_params, abs_state, abs_opt)
+
+
+def _dp_metas(strategy, saved: Dict[str, Any]):
+    meta_src = strategy.flat_meta_for_world(saved["world"], saved["buckets"])
+    if list(meta_src.bucket_padded) != list(saved["bucket_padded"]) or \
+            meta_src.padded != saved["padded"]:
+        raise CheckpointShapeError(
+            f"reconstructed flat layout for world {saved['world']} x "
+            f"{saved['buckets']} buckets (padded {meta_src.padded}, "
+            f"{list(meta_src.bucket_padded)}) disagrees with the recorded "
+            f"one (padded {saved['padded']}, {saved['bucket_padded']}): "
+            f"the model or packing changed since the save")
+    return meta_src, strategy._flat_meta
+
+
+def _pipe_metas(strategy, saved: Dict[str, Any]):
+    from ddlbench_tpu.parallel.common import row_flat_meta
+
+    meta_src = row_flat_meta(saved["length"], saved["dp"], saved["buckets"])
+    if list(meta_src.bucket_padded) != list(saved["bucket_padded"]) or \
+            meta_src.padded != saved["padded"]:
+        raise CheckpointShapeError(
+            f"reconstructed row layout for dp {saved['dp']} x "
+            f"{saved['buckets']} buckets disagrees with the recorded one: "
+            f"the stage packing changed since the save")
+    return meta_src, strategy._row_meta
+
+
+def elastic_restore(info, ts, saved: Dict[str, Any], strategy, cfg):
+    """Restore ``info``'s checkpoint (written at the saved world shape)
+    into the CURRENT strategy's layout: orbax-restore at the saved shapes,
+    permute every flat leaf between world layouts on the host, and
+    device_put the result with the live target's shardings."""
+    import jax
+
+    from ddlbench_tpu.train.checkpoint import restore_info
+
+    kind = saved["kind"]
+    mesh = strategy.mesh
+    abs_target = _abstract_saved(ts, saved, strategy, mesh)
+    restored = restore_info(info, abs_target)
+
+    if kind == "dp_shard":
+        meta_src, meta_dst = _dp_metas(strategy, saved)
+        world_src, world_dst = saved["world"], strategy.world_size
+        overlap_src = bool(saved.get("overlap"))
+        overlap_dst = bool(getattr(strategy, "_overlap", False))
+
+        def conv(v, dm_s, dm_d):
+            return reshard_flat(np.asarray(v), meta_src, world_src,
+                                meta_dst, world_dst, dm_src=dm_s,
+                                dm_dst=dm_d)
+
+        params = restored.params
+        if overlap_src and overlap_dst:
+            params = conv(params, True, True)
+        elif overlap_src and not overlap_dst:
+            # flat device-major -> per-layer pytree (the saved run ran the
+            # overlapped engine, this one does not)
+            logical = to_logical(
+                _undo_dm(np.asarray(restored.params), meta_src, world_src),
+                meta_src)
+            params = _unpack_logical(logical, meta_dst)
+        elif not overlap_src and overlap_dst:
+            logical = _pack_logical(restored.params)
+            flat = from_logical(logical, meta_dst)
+            params = flat[_dm_perm(meta_dst, world_dst)]
+        # m/v live in the layout the per-device shard concatenation
+        # produces — device-major (identity at one bucket, since the
+        # shard engine only runs multi-bucket in overlap mode)
+        opt = dict(restored.opt)
+        for k in ("m", "v"):
+            if k in opt:
+                opt[k] = conv(opt[k], True, True)
+        out = type(ts)(params, restored.model_state, opt)
+    else:  # pipe_shard: every row leaf converts along its last axis,
+        #       device-major on both sides (the rows live permuted)
+        meta_src, meta_dst = _pipe_metas(strategy, saved)
+        world_src, world_dst = saved["dp"], strategy.dp
+
+        def conv(v):
+            return reshard_flat(np.asarray(v), meta_src, world_src,
+                                meta_dst, world_dst, dm_src=True,
+                                dm_dst=True)
+
+        opt = dict(restored.opt)
+        for k in ("m", "v"):
+            if k in opt:
+                opt[k] = conv(opt[k])
+        out = type(ts)(conv(restored.params), restored.model_state, opt)
+
+    # land every leaf on the LIVE target's shardings (the converted values
+    # are plain host arrays at this point)
+    return jax.tree.map(
+        lambda v, t: jax.device_put(np.asarray(v), t.sharding), out, ts)
+
+
+def _dm_perm(meta, world):
+    from ddlbench_tpu.parallel.common import device_major_perm
+
+    return device_major_perm(meta, world)[0]
+
+
+def _undo_dm(vec: np.ndarray, meta, world) -> np.ndarray:
+    from ddlbench_tpu.parallel.common import device_major_perm
+
+    _, inv = device_major_perm(meta, world)
+    return vec[inv]
+
+
+def _pack_logical(params) -> np.ndarray:
+    """Per-layer params pytree -> the [length] logical f32 vector (the
+    concatenated raveled leaves — pack_flat without the pads)."""
+    import jax
+
+    leaves = jax.tree.leaves(params)
+    if not leaves:
+        return np.zeros((0,), np.float32)
+    return np.concatenate([np.asarray(l).astype(np.float32).ravel()
+                           for l in leaves])
+
+
+def _unpack_logical(vec: np.ndarray, meta):
+    """[length] logical vector -> the per-layer pytree of ``meta``."""
+    import jax
+
+    out = []
+    off = 0
+    for size, shape, dtype in zip(meta.sizes, meta.shapes, meta.dtypes):
+        out.append(vec[off:off + size].reshape(shape).astype(dtype))
+        off += size
+    return jax.tree.unflatten(meta.treedef, out)

@@ -1,0 +1,82 @@
+#!/usr/bin/env bash
+# Round-15 opportunistic TPU collector. Carries the still-unlanded earlier
+# queue (same task names, so any .ok marker earned in a previous window
+# sticks), then adds the ELASTIC WORLD-SIZE round (ISSUE 12):
+#
+#   * chaosbench shrink 4->2 / grow 2->4 on the dp ZeRO-1 engine with
+#     --elastic-slices (world-invariant f32 reductions): trajectory_match
+#     must hold bitwise, post_reshape_divergence must be exactly 0.0, and
+#     mttr_reshape_s lands next to a same-shape kill run's mttr_s — the
+#     "cost of coming back DIFFERENT vs coming back the same" number;
+#   * the elastic-slices tax: step-time A/B at world 4 with and without
+#     the canonical-tree reduction (butterfly ships log2(w) full vectors
+#     vs the ring's (w-1)/w — record the price of exact replay honestly);
+#   * servebench --resize under bursty load: 4 replicas down to 2 through
+#     the burst and back — zero requests lost, streams bitwise vs the
+#     un-resized control, TTFT hump + attainment recovery in the timeline.
+#
+# Expectations in PERF.md § round 15.
+#
+# Usage: scripts/tpu_round15.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+add_task pipe_hyb_1f1b_r11      python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30 --pipe-schedule 1f1b --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_1f1b_r11.jsonl --trace perf_runs/trace_hyb_1f1b_r11.json --trace-dir perf_runs/xla_hyb_1f1b_r11 --xla-trace-steps 10:14
+add_task serve_poisson_mid_r12 python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 0.5
+add_task serve_rep4_r12        python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 2.0 --replicas 4 --requests 192
+add_task decodebench_prov_r12  python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu
+PFX_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 128 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu"
+add_task serve_pfx_on_lo_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache
+add_task serve_pfx_off_lo_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64
+add_task serve_pfx_on_hi_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384 --prefix-cache
+add_task serve_pfx_off_hi_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384
+add_task serve_pfx_ctl_r13     python -m ddlbench_tpu.tools.servebench $PFX_COMMON --prefix-cache
+PFX_SMALL="-m transformer_s -b synthtext --max-batch 8 --pool-pages 48 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu --shared-prefix 4:64"
+add_task serve_pfx_smallpool_r13     python -m ddlbench_tpu.tools.servebench $PFX_SMALL --prefix-cache
+add_task serve_pfx_smallpool_off_r13 python -m ddlbench_tpu.tools.servebench $PFX_SMALL
+add_task serve_sample_r13      python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache --sample temperature:0.8,top-k:40
+add_task decodebench_chunk_r13    python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16
+add_task decodebench_chunk_ew_r13 python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16 --paged-kernel elementwise
+
+# -- round-14a: tracing overhead gate (bitwise JSON, wall_s within noise) --
+# SAME seeded bursty heavy-tail traffic, traced vs untraced. Virtual-time
+# fields must match bit for bit; wall_s delta is the tracing cost.
+TRC_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 14 --wall-clock --platform tpu --policies continuous"
+add_task serve_trace_off_r14   python -m ddlbench_tpu.tools.servebench $TRC_COMMON
+add_task serve_trace_on_r14    python -m ddlbench_tpu.tools.servebench $TRC_COMMON --trace perf_runs/serve_trace_r14.json --timeline --window 64
+
+# -- round-14b: serveview reduction of the traced bursty run ---------------
+# (runs after 14a writes the trace; windowed attainment should dip through
+# the burst and recover; decomp_exact must be true)
+add_task serveview_bursty_r14  python -m ddlbench_tpu.telemetry.serveview perf_runs/serve_trace_r14.json --window 64 --per-request
+
+# -- round-14c: eviction waste decomposed (small pool, traced) -------------
+add_task serve_trace_evict_r14 python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 40 --page 16 --max-len 512 --requests 64 --arrival poisson --rate 0.6 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 14 --wall-clock --platform tpu --policies continuous --trace perf_runs/serve_trace_evict_r14.json --timeline --window 64
+
+
+# -- round-15a: elastic chaos A/B (dp ZeRO-1, shrink then grow) ------------
+# trajectory_match + post_reshape_divergence==0.0 are the gates; the MTTR
+# split (mttr_reshape_s vs the kill run's mttr_s) is the measurement.
+CHAOS_R15="-b mnist -m lenet -f dp --steps-per-epoch 30 -e 2 --checkpoint-every-steps 10 --log-interval 1"
+add_task chaos_reshape_r15 python -m ddlbench_tpu.tools.chaosbench --kills 0 --reshape shrink@1:20:2 --reshape grow@2:10:4 $CHAOS_R15 -g 4 --batch-size 8 --json perf_runs/chaos_reshape_r15.json --platform tpu -- --dp-shard-update --elastic-slices 4
+add_task chaos_kill_r15    python -m ddlbench_tpu.tools.chaosbench --kills 2 $CHAOS_R15 -g 4 --batch-size 8 --json perf_runs/chaos_kill_r15.json --platform tpu -- --dp-shard-update --elastic-slices 4
+
+# -- round-15b: the elastic-slices tax (step-time A/B at a fixed world) ----
+# (non-BN arch: the canonical-tree mode is scoped to stateless models)
+ELX_R15="-b synthtext -m transformer_s -f dp -g 4 --batch-size 4 -e 1 --steps-per-epoch 60 --dp-shard-update"
+add_task dp_elastic_off_r15 python -m ddlbench_tpu.cli $ELX_R15 --dtype float32 --jsonl perf_runs/dp_elastic_off_r15.jsonl
+add_task dp_elastic_on_r15  python -m ddlbench_tpu.cli $ELX_R15 --dtype float32 --elastic-slices 4 --jsonl perf_runs/dp_elastic_on_r15.jsonl
+
+# -- round-15c: live serving resize under bursty load ----------------------
+RSZ_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 128 --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 15 --wall-clock --platform tpu --policies continuous --replicas 4"
+add_task serve_resize_r15     python -m ddlbench_tpu.tools.servebench $RSZ_COMMON --resize 120:2 --resize 360:4 --trace perf_runs/serve_resize_r15.json --timeline --window 64
+add_task serve_resize_ctl_r15 python -m ddlbench_tpu.tools.servebench $RSZ_COMMON
+
+window_loop "${1:-12}"

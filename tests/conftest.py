@@ -47,6 +47,35 @@ def devices():
 
 
 @pytest.fixture(scope="session")
+def train_factory():
+    """Session-shared TRAIN-strategy cache (tier-1 budget, ROADMAP item 5
+    — the training-side sibling of ``serve_factory``): strategies carry
+    their compiled train/eval steps, so two tests (or two phases of one
+    resume test) that need the same (model, config) engine should reuse
+    ONE instance instead of paying the trace+compile again. Strategies
+    are stateless between runs — ``init()`` returns a fresh TrainState —
+    which is what makes the sharing sound.
+
+    Call it with a hashable key and a zero-arg builder::
+
+        strat = train_factory(("dpshard", "dense", cfg),
+                              lambda: DPStrategy(_dense_model(), cfg))
+
+    Frozen RunConfigs are hashable and belong in the key: anything that
+    changes the compiled program must change the key.
+    """
+    cache = {}
+
+    def make(key, builder):
+        if key not in cache:
+            cache[key] = builder()
+        return cache[key]
+
+    make.cache = cache
+    return make
+
+
+@pytest.fixture(scope="session")
 def serve_factory():
     """Session-shared serving fixture (tier-1 budget, ROADMAP item 5):
     ONE tiny LM plus a jitted-callable cache keyed by (page, sampling) —

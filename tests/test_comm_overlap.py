@@ -40,11 +40,9 @@ from ddlbench_tpu.train.comm_stats import comm_stats
 pytestmark = pytest.mark.comm
 
 
-def _dense_model(num_classes=4):
-    layers = [flatten(), dense("fc1", 9, relu=True), dense("fc2", 8,
-                                                           relu=True),
-              dense("fc3", num_classes)]
-    return LayerModel("tinydense", layers, (4, 4, 1), num_classes)
+from tiny_models import tiny_dense_model as _dense_model  # noqa: E402
+# (one home for the model the two dp suites' shared train_factory cache
+# keys compile — see tests/tiny_models.py)
 
 
 def _cfg(**kw):
@@ -63,8 +61,13 @@ def _batch(B, step, num_classes=4, shape=(4, 4, 1)):
             jax.random.randint(ky, (B,), 0, num_classes))
 
 
-def _run(model, cfg, steps, lr=0.2):
-    strat = DPStrategy(model, cfg)
+def _run(factory, cfg, steps, lr=0.2):
+    # session-shared compiled-strategy cache (conftest train_factory);
+    # the key namespace matches test_dp_shard's, so the engines the two
+    # suites share (same tiny model, same config base) compile ONCE
+    strat = factory(("dpshard", "dense", cfg),
+                    lambda: DPStrategy(_dense_model(), cfg))
+    model = strat.model
     ts = strat.init(jax.random.key(cfg.seed))
     losses = []
     for s in range(steps):
@@ -198,13 +201,12 @@ def test_device_major_layout_roundtrip():
 # ---- acceptance: f32 bucketed/overlapped pinned bitwise vs monolithic ------
 
 
-def test_overlapped_bitwise_trajectory_16_steps(devices):
+def test_overlapped_bitwise_trajectory_16_steps(devices, train_factory):
     """The fully overlapped engine (bucketed RS + just-in-time AG, params
     sharded between steps) must reproduce the monolithic PR 3 sharded
     update BITWISE over >= 16 steps: per-step losses AND final params."""
-    model = _dense_model()
-    la, tsa, sa = _run(model, _cfg(dp_shard_update=True), steps=16)
-    lb, tsb, sb = _run(model, _cfg(dp_shard_update=True, comm_buckets=4),
+    la, tsa, sa = _run(train_factory, _cfg(dp_shard_update=True), steps=16)
+    lb, tsb, sb = _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=4),
                        steps=16)
     assert sb._overlap and not sa._overlap
     np.testing.assert_array_equal(la, lb)
@@ -215,53 +217,49 @@ def test_overlapped_bitwise_trajectory_16_steps(devices):
 @pytest.mark.parametrize("kw", [dict(optimizer="adam"),
                                 dict(grad_accum_steps=2),
                                 dict(comm_buckets=8)])
-def test_overlapped_bitwise_variants(devices, kw):
+def test_overlapped_bitwise_variants(devices, train_factory, kw):
     """Bitwise parity holds across Adam, gradient accumulation (per-bucket
     RS inside the micro-step scan), and deeper bucketing."""
-    model = _dense_model()
     kw = dict(kw)
     buckets = kw.pop("comm_buckets", 4)
-    la, tsa, sa = _run(model, _cfg(dp_shard_update=True, **kw), steps=4)
-    lb, tsb, sb = _run(model, _cfg(dp_shard_update=True,
+    la, tsa, sa = _run(train_factory, _cfg(dp_shard_update=True, **kw), steps=4)
+    lb, tsb, sb = _run(train_factory, _cfg(dp_shard_update=True,
                                    comm_buckets=buckets, **kw), steps=4)
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(sa, tsa),
                                   _flat_params(sb, tsb))
 
 
-def test_bucketed_replicated_update_bitwise(devices):
+def test_bucketed_replicated_update_bitwise(devices, train_factory):
     """Buckets WITHOUT the sharded update (replicated explicit engine,
     per-bucket psum in the wire dtype): the f32-equivalent check uses bf16
     wire on both sides so only bucketing varies."""
-    model = _dense_model()
-    la, tsa, sa = _run(model, _cfg(allreduce_dtype="bf16"), steps=4)
-    lb, tsb, sb = _run(model, _cfg(allreduce_dtype="bf16", comm_buckets=3),
+    la, tsa, sa = _run(train_factory, _cfg(allreduce_dtype="bf16"), steps=4)
+    lb, tsb, sb = _run(train_factory, _cfg(allreduce_dtype="bf16", comm_buckets=3),
                        steps=4)
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(sa, tsa),
                                   _flat_params(sb, tsb))
 
 
-def test_standalone_f32_buckets_bitwise_vs_gspmd_dp(devices):
+def test_standalone_f32_buckets_bitwise_vs_gspmd_dp(devices, train_factory):
     """--comm-buckets alone (f32, no sharded update) is a valid dp knob:
     it routes through the explicit replicated engine (one psum per
     bucket) and stays BITWISE on the GSPMD dp trajectory."""
-    model = _dense_model()
-    la, tsa, sa = _run(model, _cfg(), steps=4)  # GSPMD dp
+    la, tsa, sa = _run(train_factory, _cfg(), steps=4)  # GSPMD dp
     cfg = _cfg(comm_buckets=3)
     assert cfg.dp_explicit_collectives() and not cfg.dp_overlap_engine()
-    lb, tsb, sb = _run(model, cfg, steps=4)
+    lb, tsb, sb = _run(train_factory, cfg, steps=4)
     assert sb._flat_meta.num_buckets > 1
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(sa, tsa),
                                   _flat_params(sb, tsb))
 
 
-def test_comm_buckets_1_routes_to_monolithic_engine(devices):
+def test_comm_buckets_1_routes_to_monolithic_engine(devices, train_factory):
     """--comm-buckets 1 must not even enter the overlapped engine: params
     stay the replicated pytree and the meta is the single-bucket layout."""
-    model = _dense_model()
-    _, ts, strat = _run(model, _cfg(dp_shard_update=True, comm_buckets=1),
+    _, ts, strat = _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=1),
                         steps=1)
     assert not strat._overlap
     assert strat._flat_meta.num_buckets == 1
@@ -271,10 +269,9 @@ def test_comm_buckets_1_routes_to_monolithic_engine(devices):
 # ---- overlapped-engine state: eval / checkpoint / materialize --------------
 
 
-def test_overlapped_eval_and_materialize_match_monolithic(devices):
-    model = _dense_model()
-    _, tsa, sa = _run(model, _cfg(dp_shard_update=True), steps=3)
-    _, tsb, sb = _run(model, _cfg(dp_shard_update=True, comm_buckets=4),
+def test_overlapped_eval_and_materialize_match_monolithic(devices, train_factory):
+    _, tsa, sa = _run(train_factory, _cfg(dp_shard_update=True), steps=3)
+    _, tsb, sb = _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=4),
                       steps=3)
     assert tsb.params.ndim == 1  # flat sharded vector between steps
     np.testing.assert_array_equal(_flat_params(sa, tsa),
@@ -286,12 +283,11 @@ def test_overlapped_eval_and_materialize_match_monolithic(devices):
         np.testing.assert_array_equal(np.asarray(ma[k]), np.asarray(mb[k]))
 
 
-def test_overlapped_checkpoint_roundtrip(devices, tmp_path):
+def test_overlapped_checkpoint_roundtrip(devices, train_factory, tmp_path):
     from ddlbench_tpu.train.checkpoint import (restore_checkpoint,
                                                save_checkpoint)
 
-    model = _dense_model()
-    _, ts, strat = _run(model, _cfg(dp_shard_update=True, comm_buckets=4),
+    _, ts, strat = _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=4),
                         steps=2)
     save_checkpoint(str(tmp_path), 1, ts, seed=1)
     target = strat.init(jax.random.key(1))
@@ -303,17 +299,16 @@ def test_overlapped_checkpoint_roundtrip(devices, tmp_path):
 # ---- per-bucket spans + wire-byte accounting -------------------------------
 
 
-def test_bucket_spans_and_exact_wire_bytes(devices):
+def test_bucket_spans_and_exact_wire_bytes(devices, train_factory):
     """rs_bucket/ag_bucket spans appear under --trace with wire-byte args
     that sum EXACTLY to comm_stats' physical accounting, per dtype."""
     from ddlbench_tpu.telemetry import Tracer, get_tracer, set_tracer
 
-    model = _dense_model()
     prev = get_tracer()
     tracer = set_tracer(Tracer())
     tracer.enable()
     try:
-        _, _, strat = _run(model, _cfg(dp_shard_update=True, comm_buckets=4),
+        _, _, strat = _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=4),
                            steps=2)
     finally:
         tracer.disable()
@@ -432,16 +427,15 @@ def test_quantized_values_respect_sum_safe_qmax():
     assert 8 * 15 <= 127  # the sum bound itself
 
 
-def test_int8_trains_and_replays_bitwise(devices):
+def test_int8_trains_and_replays_bitwise(devices, train_factory):
     """End-to-end: the int8 wire trains (losses finite, loosely tracking
     f32 — the range loss is the accuracy gate's business, accparity
     dp-int8), and two runs under the same seed replay BITWISE."""
-    model = _dense_model()
-    lref, _, _ = _run(model, _cfg(dp_shard_update=True), steps=4)
-    l1, ts1, s1 = _run(model, _cfg(dp_shard_update=True,
+    lref, _, _ = _run(train_factory, _cfg(dp_shard_update=True), steps=4)
+    l1, ts1, s1 = _run(train_factory, _cfg(dp_shard_update=True,
                                    allreduce_dtype="int8", comm_buckets=2),
                        steps=4)
-    l2, ts2, s2 = _run(model, _cfg(dp_shard_update=True,
+    l2, ts2, s2 = _run(train_factory, _cfg(dp_shard_update=True,
                                    allreduce_dtype="int8", comm_buckets=2),
                        steps=4)
     assert np.all(np.isfinite(l1))
@@ -453,9 +447,8 @@ def test_int8_trains_and_replays_bitwise(devices):
     assert int(np.asarray(ts1.opt["qstep"])) == 4
 
 
-def test_int8_replicated_update_trains(devices):
-    model = _dense_model()
-    lq, ts, strat = _run(model, _cfg(allreduce_dtype="int8"), steps=3)
+def test_int8_replicated_update_trains(devices, train_factory):
+    lq, ts, strat = _run(train_factory, _cfg(allreduce_dtype="int8"), steps=3)
     assert np.all(np.isfinite(lq))
     assert int(np.asarray(ts.opt["qstep"])) == 3
 
@@ -489,19 +482,18 @@ def test_overlap_fraction_interval_math():
     assert r2["overlap_fraction"] == 0.0
 
 
-def test_overlap_cli_on_exported_trace(devices, tmp_path):
+def test_overlap_cli_on_exported_trace(devices, train_factory, tmp_path):
     """--trace output -> export -> CLI reducer: the engine's marker spans
     are found and their wire bytes aggregated."""
     from ddlbench_tpu.telemetry import Tracer, export_chrome_trace, \
         get_tracer, set_tracer
     from ddlbench_tpu.telemetry.overlap import main as overlap_main
 
-    model = _dense_model()
     prev = get_tracer()
     tracer = set_tracer(Tracer())
     tracer.enable()
     try:
-        _run(model, _cfg(dp_shard_update=True, comm_buckets=2), steps=1)
+        _run(train_factory, _cfg(dp_shard_update=True, comm_buckets=2), steps=1)
     finally:
         tracer.disable()
         set_tracer(prev)

@@ -11,7 +11,10 @@ because GSPMD places the BN-backward cross-replica reductions around
 linear ops at its own discretion.
 
 All cases here are tier-1-fast: tiny dense models, 2-6 steps for the
-non-bitwise checks, one 24-step bitwise trajectory.
+non-bitwise checks, one 24-step bitwise trajectory. Strategies ride the
+session-scoped ``train_factory`` compiled-strategy cache (conftest.py) so
+repeated (model, config) engines compile once per session — the tier-1
+budget refactor of ROADMAP item 5.
 """
 
 import jax
@@ -28,11 +31,9 @@ from ddlbench_tpu.train.comm_stats import comm_stats
 pytestmark = pytest.mark.dpshard
 
 
-def _dense_model(num_classes=4):
-    layers = [flatten(), dense("fc1", 9, relu=True), dense("fc2", 8,
-                                                           relu=True),
-              dense("fc3", num_classes)]
-    return LayerModel("tinydense", layers, (4, 4, 1), num_classes)
+from tiny_models import tiny_dense_model as _dense_model  # noqa: E402
+# (one home for the model the two dp suites' shared train_factory cache
+# keys compile — see tests/tiny_models.py)
 
 
 def _bn_model(num_classes=4):
@@ -57,8 +58,17 @@ def _batch(B, step, num_classes=4, shape=(4, 4, 1)):
             jax.random.randint(ky, (B,), 0, num_classes))
 
 
-def _run(model, cfg, steps, lr=0.2):
-    strat = DPStrategy(model, cfg)
+_MODELS = {"dense": _dense_model, "bn": _bn_model}
+
+
+def _strategy(factory, mname, cfg):
+    return factory(("dpshard", mname, cfg),
+                   lambda: DPStrategy(_MODELS[mname](), cfg))
+
+
+def _run(factory, mname, cfg, steps, lr=0.2):
+    strat = _strategy(factory, mname, cfg)
+    model = strat.model
     ts = strat.init(jax.random.key(cfg.seed))
     B = cfg.global_batch()
     losses = []
@@ -78,48 +88,51 @@ def _flat_params(ts):
 # ---- acceptance: bitwise f32 parity + optimizer-state memory --------------
 
 
-def test_sharded_update_bitwise_trajectory_20_steps(devices):
+def test_sharded_update_bitwise_trajectory_20_steps(devices, train_factory):
     """--dp-shard-update must reproduce replicated dp's f32 loss trajectory
     BITWISE over >= 20 steps on the 8-virtual-device mesh (and end with
     bitwise-identical params)."""
-    model = _dense_model()
-    la, tsa, _ = _run(model, _cfg(), steps=24)
-    lb, tsb, _ = _run(model, _cfg(dp_shard_update=True), steps=24)
+    la, tsa, _ = _run(train_factory, "dense", _cfg(), steps=24)
+    lb, tsb, _ = _run(train_factory, "dense", _cfg(dp_shard_update=True),
+                      steps=24)
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
 
 
 @pytest.mark.parametrize("opt", ["sgd", "adam"])
 @pytest.mark.parametrize("accum", [1, 2])
-def test_sharded_update_bitwise_variants(devices, opt, accum):
+def test_sharded_update_bitwise_variants(devices, train_factory, opt,
+                                         accum):
     """Bitwise parity holds across the optimizer family and gradient
     accumulation (the K-microstep scan mirrors the replicated weighting)."""
-    model = _dense_model()
     kw = dict(optimizer=opt, grad_accum_steps=accum)
-    la, tsa, _ = _run(model, _cfg(**kw), steps=4)
-    lb, tsb, _ = _run(model, _cfg(dp_shard_update=True, **kw), steps=4)
+    la, tsa, _ = _run(train_factory, "dense", _cfg(**kw), steps=4)
+    lb, tsb, _ = _run(train_factory, "dense",
+                      _cfg(dp_shard_update=True, **kw), steps=4)
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
 
 
-def test_sharded_update_bitwise_label_smoothing(devices):
+def test_sharded_update_bitwise_label_smoothing(devices, train_factory):
     """The smoothed-objective path (separate obj/ce sums) stays bitwise."""
-    model = _dense_model()
-    la, tsa, _ = _run(model, _cfg(label_smoothing=0.1), steps=4)
-    lb, tsb, _ = _run(model, _cfg(label_smoothing=0.1,
-                                  dp_shard_update=True), steps=4)
+    la, tsa, _ = _run(train_factory, "dense", _cfg(label_smoothing=0.1),
+                      steps=4)
+    lb, tsb, _ = _run(train_factory, "dense",
+                      _cfg(label_smoothing=0.1, dp_shard_update=True),
+                      steps=4)
     np.testing.assert_array_equal(la, lb)
     np.testing.assert_array_equal(_flat_params(tsa), _flat_params(tsb))
 
 
-def test_optimizer_state_bytes_shrink_by_world(devices):
+def test_optimizer_state_bytes_shrink_by_world(devices, train_factory):
     """ZeRO-1 memory criterion: per-device optimizer-state bytes must be
     ~world x smaller than replicated dp's (exactly total/world here — the
     flat packed vector shards into equal contiguous slices)."""
-    model = _dense_model()
-    _, ts_rep, _ = _run(model, _cfg(optimizer="adam"), steps=1)
-    _, ts_sh, strat = _run(model, _cfg(optimizer="adam",
-                                       dp_shard_update=True), steps=1)
+    _, ts_rep, _ = _run(train_factory, "dense", _cfg(optimizer="adam"),
+                        steps=1)
+    _, ts_sh, strat = _run(train_factory, "dense",
+                           _cfg(optimizer="adam", dp_shard_update=True),
+                           steps=1)
     world = strat.world_size
 
     def per_device_bytes(opt):
@@ -137,13 +150,13 @@ def test_optimizer_state_bytes_shrink_by_world(devices):
         assert leaf.addressable_shards[0].data.nbytes * world == leaf.nbytes
 
 
-def test_compiled_memory_analysis_reflects_sharding(devices):
+def test_compiled_memory_analysis_reflects_sharding(devices, train_factory):
     """Cost-analysis cross-check (soft: not every backend reports it): the
     sharded-update executable's argument bytes per device shrink vs
     replicated — the optimizer state enters as 1/world slices."""
-    model = _dense_model()
-    _, ts, strat = _run(model, _cfg(optimizer="adam",
-                                    dp_shard_update=True), steps=1)
+    _, ts, strat = _run(train_factory, "dense",
+                        _cfg(optimizer="adam", dp_shard_update=True),
+                        steps=1)
     jit_step = strat._jit_train_step
     B = strat.cfg.global_batch()
     x, y = _batch(B, 0)
@@ -166,14 +179,13 @@ def test_compiled_memory_analysis_reflects_sharding(devices):
 # ---- sync-BN: semantics preserved, rounding-level agreement ---------------
 
 
-def test_bn_sync_statistics_close_to_replicated(devices):
+def test_bn_sync_statistics_close_to_replicated(devices, train_factory):
     """BN models: the explicit sync-BN engine must track replicated dp's
     global-batch statistics and trajectory to float rounding (bitwise is
     out of reach: GSPMD re-associates the BN-backward reductions)."""
-    model = _bn_model()
-    la, tsa, _ = _run(model, _cfg(batch_size=4), steps=6)
-    lb, tsb, _ = _run(model, _cfg(batch_size=4, dp_shard_update=True),
-                      steps=6)
+    la, tsa, _ = _run(train_factory, "bn", _cfg(batch_size=4), steps=6)
+    lb, tsb, _ = _run(train_factory, "bn",
+                      _cfg(batch_size=4, dp_shard_update=True), steps=6)
     np.testing.assert_allclose(la, lb, rtol=2e-4, atol=1e-6)
     for sa, sb in zip(jax.tree.leaves(tsa.model_state),
                       jax.tree.leaves(tsb.model_state)):
@@ -183,14 +195,13 @@ def test_bn_sync_statistics_close_to_replicated(devices):
                                rtol=5e-3, atol=1e-5)
 
 
-def test_bn_first_step_forward_is_bitwise(devices):
+def test_bn_first_step_forward_is_bitwise(devices, train_factory):
     """The sync-BN FORWARD mirrors GSPMD exactly (only the backward's
     reduction placement differs): step-1 loss and running stats match
     bitwise."""
-    model = _bn_model()
-    la, tsa, _ = _run(model, _cfg(batch_size=4), steps=1)
-    lb, tsb, _ = _run(model, _cfg(batch_size=4, dp_shard_update=True),
-                      steps=1)
+    la, tsa, _ = _run(train_factory, "bn", _cfg(batch_size=4), steps=1)
+    lb, tsb, _ = _run(train_factory, "bn",
+                      _cfg(batch_size=4, dp_shard_update=True), steps=1)
     np.testing.assert_array_equal(la, lb)
     for sa, sb in zip(jax.tree.leaves(tsa.model_state),
                       jax.tree.leaves(tsb.model_state)):
@@ -200,17 +211,18 @@ def test_bn_first_step_forward_is_bitwise(devices):
 # ---- fused LM head path ---------------------------------------------------
 
 
-def test_fused_head_bitwise(devices):
+def test_fused_head_bitwise(devices, train_factory):
     """The fused projection+CE head (token workloads) keeps bitwise parity
     under the sharded update."""
     from tests.tiny_models import TINY_LM, tiny_transformer
 
-    model = tiny_transformer()
     cfg_rep = _cfg(batch_size=2, optimizer="adam")
     cfg_sh = _cfg(batch_size=2, optimizer="adam", dp_shard_update=True)
     losses = {}
     for name, cfg in (("rep", cfg_rep), ("sh", cfg_sh)):
-        strat = DPStrategy(model, cfg)
+        strat = train_factory(("dpshard", "tinylm", cfg),
+                              lambda cfg=cfg: DPStrategy(tiny_transformer(),
+                                                         cfg))
         ts = strat.init(jax.random.key(0))
         B = cfg.global_batch()
         ls = []
@@ -231,14 +243,14 @@ def test_fused_head_bitwise(devices):
 
 
 @pytest.mark.parametrize("shard", [False, True])
-def test_bf16_allreduce_trains(devices, shard):
+def test_bf16_allreduce_trains(devices, train_factory, shard):
     """--allreduce-dtype bf16 (with and without the sharded update) must
     train: finite losses tracking the f32 trajectory loosely (the gradient
     sum carries bf16 rounding)."""
-    model = _dense_model()
-    lref, _, _ = _run(model, _cfg(), steps=4)
-    lq, _, _ = _run(model, _cfg(allreduce_dtype="bf16",
-                                dp_shard_update=shard), steps=4)
+    lref, _, _ = _run(train_factory, "dense", _cfg(), steps=4)
+    lq, _, _ = _run(train_factory, "dense",
+                    _cfg(allreduce_dtype="bf16", dp_shard_update=shard),
+                    steps=4)
     assert np.all(np.isfinite(lq))
     np.testing.assert_allclose(lq, lref, rtol=0.05)
 

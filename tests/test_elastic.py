@@ -1,0 +1,526 @@
+"""Elastic world-size: topology-portable checkpoints + live serving resize
+(ISSUE 12 tentpole).
+
+Three layers, `elastic` marker:
+
+* reshard units — the world-size conversion is a pure permutation: flat
+  vectors round-trip bitwise between any (world, buckets) layouts (dp
+  leaf-aligned metas, pipe row metas, device-major on either side), and
+  the shape comparison raises the named CheckpointShapeError for every
+  uncovered mismatch;
+* f32 elastic-resume pins THROUGH THE REAL LOOP — a ``--dp-shard-update``
+  run checkpointed at world N resumes at world M (both directions, sgd +
+  adam, plus a multi-bucket overlapped-engine variant) with per-step
+  losses, per-epoch validation records, and materialized params BITWISE
+  equal to the uninterrupted N-world run. The numerical contract is
+  ``--elastic-slices`` (parallel/dp.py): gradients reduce over a
+  canonical balanced tree whose shape depends on the slice count alone,
+  so the reduction order — and with it every f32 bit — is
+  world-invariant;
+* serving resize pins — ``ReplicatedServer.resize(n)`` under live load
+  loses no request and keeps token streams bitwise vs an un-resized
+  control (scale-down evicts onto the recompute path + redistributes
+  least-loaded; scale-up shares the jitted callables).
+
+The chaosbench shrink/grow and servebench --resize subprocess e2e runs are
+slow-marked (they relaunch real CLIs); everything above is tier-1 on the
+session-scoped compiled-strategy fixtures (conftest train_factory /
+serve_factory — ROADMAP item 5).
+"""
+
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.elastic
+
+from ddlbench_tpu.config import RunConfig, ServeConfig
+from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+from ddlbench_tpu.parallel.common import (device_major_perm, flat_meta,
+                                          row_flat_meta)
+from ddlbench_tpu.parallel.dp import DPStrategy
+from ddlbench_tpu.train import reshard
+from ddlbench_tpu.train.loop import run_benchmark
+from ddlbench_tpu.train.metrics import MetricLogger
+from ddlbench_tpu.train.reshard import CheckpointShapeError
+
+
+def _dense_model():
+    # mnist-shaped so run_benchmark's synthetic stream feeds it directly
+    return LayerModel("tinydense", [flatten(), dense("fc1", 9, relu=True),
+                                    dense("fc2", 10)], (28, 28, 1), 10)
+
+
+def _cfg(world, bs, **kw):
+    base = dict(benchmark="mnist", strategy="dp", num_devices=world,
+                compute_dtype="float32", batch_size=bs, steps_per_epoch=2,
+                log_interval=1, dp_shard_update=True, elastic_slices=4,
+                momentum=0.5)
+    base.update(kw)
+    cfg = RunConfig(**base)
+    cfg.validate()
+    return cfg
+
+
+def _strategy(train_factory, cfg):
+    key = ("elastic-dp", cfg.replace(checkpoint_dir=None, epochs=3,
+                                     resume=False, elastic_resume=False))
+    return train_factory(key, lambda: DPStrategy(_dense_model(), cfg))
+
+
+def _run(cfg, strat, jsonl):
+    logger = MetricLogger(cfg.epochs, cfg.log_interval, jsonl_path=jsonl)
+    try:
+        return run_benchmark(cfg, strategy=strat, logger=logger,
+                             warmup_steps=0)
+    finally:
+        logger.close()
+
+
+def _traj(path):
+    # the trajectory maps chaosbench itself compares — one parser, so a
+    # record-schema change cannot silently empty these assertions
+    from ddlbench_tpu.tools.chaosbench import _jsonl_trajectory
+
+    return _jsonl_trajectory(path)
+
+
+def _pvec(strat, ts):
+    return np.concatenate([np.asarray(l).ravel() for l in
+                           jax.tree.leaves(strat.materialize_params(ts))])
+
+
+# ---- reshard units: the conversion is a pure permutation ------------------
+
+
+@pytest.mark.parametrize("meta_kind", ["dp", "row"])
+@pytest.mark.parametrize("src,dst", [((4, 1), (2, 1)), ((2, 1), (4, 3)),
+                                     ((4, 3), (2, 2)), ((8, 2), (1, 1))])
+def test_reshard_flat_roundtrip_bitwise(meta_kind, src, dst):
+    """Any (world, buckets) -> (world', buckets') -> back is the identity,
+    and the logical content is preserved through one hop — for both the
+    dp leaf-aligned layout and the pipe row layout, device-major or not."""
+    params = [{"w": jnp.arange(23.0).reshape(23), "b": jnp.ones((3,))},
+              {"w": jnp.arange(11.0) * 0.5}]
+
+    def meta_for(world, buckets):
+        if meta_kind == "dp":
+            return flat_meta(params, world, buckets=buckets,
+                             leaf_groups=[2, 1])
+        return row_flat_meta(37, world, buckets)
+
+    (wn, kn), (wm, km) = src, dst
+    mn, mm = meta_for(wn, kn), meta_for(wm, km)
+    rng = np.random.default_rng(0)
+    logical = rng.standard_normal(mn.length).astype(np.float32)
+    for dm_src in (False, True):
+        for dm_dst in (False, True):
+            vec = reshard.from_logical(logical, mn)
+            if dm_src:
+                vec = vec[device_major_perm(mn, wn)[0]]
+            out = reshard.reshard_flat(vec, mn, wn, mm, wm,
+                                       dm_src=dm_src, dm_dst=dm_dst)
+            assert out.shape == (mm.padded,)
+            back = out
+            if dm_dst:
+                back = back[device_major_perm(mm, wm)[1]]
+            np.testing.assert_array_equal(reshard.to_logical(back, mm),
+                                          logical)
+            # and the round trip back to the source layout is the identity
+            rt = reshard.reshard_flat(out, mm, wm, mn, wn,
+                                      dm_src=dm_dst, dm_dst=dm_src)
+            np.testing.assert_array_equal(rt, vec)
+
+
+def test_reshard_rows_last_axis():
+    """Pipe-mesh stage rows convert along the LAST axis with leading
+    dims untouched (the [V, S, L] / [S, L] packed matrices)."""
+    mn, mm = row_flat_meta(10, 4, 1), row_flat_meta(10, 2, 2)
+    logical = np.arange(2 * 3 * 10, dtype=np.float32).reshape(2, 3, 10)
+    perm_n = device_major_perm(mn, 4)[0]
+    rows = np.stack([np.stack([reshard.from_logical(r, mn)[perm_n]
+                               for r in v]) for v in logical])
+    out = reshard.reshard_flat(rows, mn, 4, mm, 2, dm_src=True, dm_dst=True)
+    assert out.shape == (2, 3, mm.padded)
+    back = reshard.reshard_flat(out, mm, 2, mn, 4, dm_src=True, dm_dst=True)
+    np.testing.assert_array_equal(back, rows)
+
+
+def test_compare_raises_named_errors():
+    base = {"schema": reshard.LOGICAL_SCHEMA, "strategy": "dp",
+            "kind": "dp_shard", "world": 4, "dp": 4, "buckets": 1,
+            "overlap": False, "length": 100, "padded": 100,
+            "bucket_padded": [100], "global_batch": 8, "lr_world": 4}
+    cur = dict(base, world=2, dp=2, padded=102, bucket_padded=[102])
+    # covered mismatch, elastic off -> named error naming both shapes +
+    # the --elastic-resume pointer (warn-once)
+    with pytest.raises(CheckpointShapeError, match="elastic-resume"):
+        reshard.compare(base, cur, elastic=False)
+    assert reshard.compare(base, cur, elastic=True) == "reshard"
+    # same shape -> plain restore; missing metadata -> legacy restore
+    assert reshard.compare(base, dict(base), elastic=False) is None
+    assert reshard.compare(None, cur, elastic=False) is None
+    # engine-kind / strategy / model mismatches are never reshardable
+    with pytest.raises(CheckpointShapeError, match="engine layout"):
+        reshard.compare(dict(base, kind="replicated"), cur, elastic=True)
+    with pytest.raises(CheckpointShapeError, match="strategy"):
+        reshard.compare(dict(base, strategy="gpipe"), cur, elastic=True)
+    with pytest.raises(CheckpointShapeError, match="MODEL"):
+        reshard.compare(dict(base, length=64), cur, elastic=True)
+    # a changed stage split routes to re-planning, not the permutation
+    pn = dict(base, kind="pipe_shard", stages=4, vstages=1, dp=2)
+    pm = dict(pn, stages=2)
+    with pytest.raises(CheckpointShapeError, match="auto-partition"):
+        reshard.compare(pn, pm, elastic=True)
+
+
+# ---- f32 elastic-resume pins through the real loop ------------------------
+
+
+def _elastic_roundtrip(train_factory, tmp_path, n_world, n_bs, m_world,
+                       m_bs, **kw):
+    """save@N (1 epoch) -> elastic resume@M (epoch 2) vs the uninterrupted
+    N-world control; returns (control_result, resumed_result, strategies,
+    jsonl paths)."""
+    sN = _strategy(train_factory, _cfg(n_world, n_bs, **kw))
+    sM = _strategy(train_factory, _cfg(m_world, m_bs, **kw))
+    ck = str(tmp_path / "ck")
+    c_jsonl = str(tmp_path / "control.jsonl")
+    r_jsonl = str(tmp_path / "resumed.jsonl")
+    res_c = _run(_cfg(n_world, n_bs, epochs=2, **kw), sN, c_jsonl)
+    _run(_cfg(n_world, n_bs, epochs=1, checkpoint_dir=ck, **kw), sN,
+         str(tmp_path / "phase1.jsonl"))
+    res_r = _run(_cfg(m_world, m_bs, epochs=2, checkpoint_dir=ck,
+                      resume=True, elastic_resume=True, **kw), sM, r_jsonl)
+    return res_c, res_r, (sN, sM), (c_jsonl, r_jsonl)
+
+
+def _assert_bitwise(res_c, res_r, strats, jsonls):
+    sN, sM = strats
+    c_jsonl, r_jsonl = jsonls
+    tc, vc = _traj(c_jsonl)
+    tr, vr = _traj(r_jsonl)
+    assert any(ep == 2 for ep, _ in tr), "no post-resume train records"
+    for key, loss in tr.items():
+        assert key in tc and tc[key] == loss, (key, loss, tc.get(key))
+    for ep, lv in vr.items():
+        assert vc[ep] == lv, (ep, lv, vc[ep])
+    np.testing.assert_array_equal(_pvec(sN, res_c["train_state"]),
+                                  _pvec(sM, res_r["train_state"]))
+
+
+def test_elastic_resume_shrink_bitwise_sgd(train_factory, tmp_path, capsys):
+    """save@4 -> resume@2 (sgd): losses, valid records, and materialized
+    params bitwise vs the uninterrupted world-4 run — acceptance pin."""
+    out = _elastic_roundtrip(train_factory, tmp_path, 4, 2, 2, 4)
+    _assert_bitwise(*out)
+    text = capsys.readouterr().out
+    assert "elastic resume: resharding checkpoint from world 4 to 2" in text
+    assert "lr world-scaling pinned to the launch world (4)" in text
+
+
+def test_elastic_resume_grow_bitwise_adam(train_factory, tmp_path):
+    """save@2 -> resume@4 (adam: m/v flat slices reshard too) — the grow
+    direction of the acceptance pin."""
+    out = _elastic_roundtrip(train_factory, tmp_path, 2, 4, 4, 2,
+                             optimizer="adam")
+    _assert_bitwise(*out)
+
+
+def test_elastic_resume_multibucket_overlap_bitwise(train_factory,
+                                                    tmp_path):
+    """save@4 -> resume@2 with --comm-buckets 3 + --dp-shard-update: the
+    OVERLAPPED engine's between-steps params are the flat device-major
+    vector, so the parameter vector itself rides the permutation."""
+    out = _elastic_roundtrip(train_factory, tmp_path, 4, 2, 2, 4,
+                             comm_buckets=3)
+    _assert_bitwise(*out)
+    sN, sM = out[2]
+    assert sN._overlap and sM._overlap  # the variant really ran overlapped
+
+
+def test_shape_mismatch_without_flag_raises(train_factory, tmp_path,
+                                            capsys):
+    """The satellite regression pin: a world-shape mismatch without
+    --elastic-resume raises the NAMED error carrying both shapes and the
+    flag pointer — not a cryptic orbax assert."""
+    sN = _strategy(train_factory, _cfg(4, 2))
+    sM = _strategy(train_factory, _cfg(2, 4))
+    ck = str(tmp_path / "ck")
+    _run(_cfg(4, 2, epochs=1, checkpoint_dir=ck), sN,
+         str(tmp_path / "a.jsonl"))
+    with pytest.raises(CheckpointShapeError) as ei:
+        _run(_cfg(2, 4, epochs=2, checkpoint_dir=ck, resume=True), sM,
+             str(tmp_path / "b.jsonl"))
+    msg = str(ei.value)
+    assert "saved world 4" in msg and "current world 2" in msg
+    assert "--elastic-resume" in msg
+
+
+def test_logical_meta_recorded_and_validate_gates(train_factory, tmp_path):
+    """Every commit carries logical.json (covered by the manifest), and
+    the config gates reject malformed elastic settings."""
+    from ddlbench_tpu.train.checkpoint import latest_valid, load_logical
+
+    sN = _strategy(train_factory, _cfg(4, 2))
+    ck = str(tmp_path / "ck")
+    _run(_cfg(4, 2, epochs=1, checkpoint_dir=ck), sN,
+         str(tmp_path / "a.jsonl"))
+    info = latest_valid(ck)
+    logical = load_logical(info.path)
+    assert logical["kind"] == "dp_shard" and logical["world"] == 4
+    assert logical["global_batch"] == 8 and logical["lr_world"] == 4
+    assert logical["elastic_slices"] == 4
+    assert logical["bucket_padded"] and logical["leaves"]
+    # the manifest covers it: verify_checkpoint hashed logical.json
+    with open(os.path.join(info.path, "COMMIT.json")) as f:
+        assert "logical.json" in json.load(f)["files"]
+
+    with pytest.raises(ValueError, match="power of two"):
+        _cfg(4, 2, elastic_slices=6)
+    with pytest.raises(ValueError, match="dp ZeRO-1"):
+        RunConfig(benchmark="mnist", strategy="single",
+                  elastic_slices=4).validate()
+    with pytest.raises(ValueError, match="device count dividing"):
+        _cfg(8, 2, elastic_slices=4)
+    with pytest.raises(ValueError, match="f32"):
+        _cfg(4, 2, allreduce_dtype="bf16")
+    with pytest.raises(ValueError, match="checkpoint-dir"):
+        RunConfig(benchmark="mnist", elastic_resume=True).validate()
+
+
+def test_pipe_shard_rows_reshard_bitwise(train_factory, tmp_path):
+    """The PR 8 pipe-mesh hybrid (PP x ZeRO-1): a checkpoint whose packed
+    stage rows + adam m/v were saved sharded over dp=2 restores at dp=4
+    (same stage split) with materialized params and optimizer rows
+    bitwise — the row_flat_meta leg of the reshard pass."""
+    from ddlbench_tpu.parallel.gpipe import GPipeStrategy
+    from ddlbench_tpu.train import checkpoint as ck
+
+    def pipe_cfg(world, dp):
+        cfg = RunConfig(benchmark="mnist", strategy="gpipe", arch="lenet",
+                        num_devices=world, dp_replicas=dp, num_stages=2,
+                        micro_batch_size=4, num_microbatches=2,
+                        compute_dtype="float32", optimizer="adam",
+                        dp_shard_update=True, comm_buckets=2)
+        cfg.validate()
+        return cfg
+
+    def pipe_strat(cfg):
+        return train_factory(("elastic-pipe", cfg),
+                             lambda: GPipeStrategy(_dense_model(), cfg))
+
+    cfg2, cfg4 = pipe_cfg(4, 2), pipe_cfg(8, 4)
+    s2, s4 = pipe_strat(cfg2), pipe_strat(cfg4)
+    ts2 = s2.init(jax.random.key(7))
+    # perturb m so the optimizer rows carry non-init values too
+    ts2 = ts2._replace(opt={**ts2.opt,
+                            "m": ts2.opt["m"] + 0.25 * ts2.params})
+    d = str(tmp_path)
+    meta2 = reshard.logical_meta(s2, cfg2, ts2, lr_world=4)
+    assert meta2["kind"] == "pipe_shard" and meta2["dp"] == 2
+    ck.save_checkpoint(d, 1, ts2, logical=meta2)
+    info = ck.latest_valid(d)
+    saved = ck.load_logical(info.path)
+
+    ts4 = s4.init(jax.random.key(3))  # different init: must be overwritten
+    meta4 = reshard.logical_meta(s4, cfg4, ts4, lr_world=8)
+    assert reshard.compare(saved, meta4, elastic=True) == "reshard"
+    restored = reshard.elastic_restore(info, ts4, saved, s4, cfg4)
+    np.testing.assert_array_equal(
+        np.asarray(s2.materialize_params(ts2)),
+        np.asarray(s4.materialize_params(restored)))
+    np.testing.assert_array_equal(
+        np.asarray(s2.materialize_params(ts2._replace(params=ts2.opt["m"]))),
+        np.asarray(s4.materialize_params(
+            restored._replace(params=restored.opt["m"]))))
+    # the step counter and model state pass through untouched
+    np.testing.assert_array_equal(np.asarray(ts2.opt["step"]),
+                                  np.asarray(restored.opt["step"]))
+
+
+# ---- chaosbench reshape schedule units ------------------------------------
+
+
+def test_reshape_spec_parsing_and_merge():
+    from ddlbench_tpu.tools.chaosbench import (event_schedule,
+                                               merge_schedule,
+                                               parse_reshapes)
+
+    assert parse_reshapes(["shrink@2:1:2", "grow@1:3:8"]) == \
+        [("shrink", 2, 1, 2), ("grow", 1, 3, 8)]
+    for bad in ("shrink@2:1", "melt@1:1:2", "shrink@0:0:2", "shrink@1:1:0",
+                "shrink@a:b:c"):
+        with pytest.raises(ValueError):
+            parse_reshapes([bad])
+    # reshapes interleave into the kill schedule ordered by global step
+    events = event_schedule(1, 0, 2, 6)
+    merged = merge_schedule(events, [("shrink", 1, 1, 2)], 6)
+    assert merged[0] == ("shrink", 1, 1, 2)
+    assert merged[1][0] == "kill"
+    # a collision with a kill point is rejected, not silently raced
+    with pytest.raises(ValueError, match="collision"):
+        merge_schedule(events, [("shrink",) + events[0][1:] + (2,)], 6)
+    # shrink/grow are real registry kinds (the in-process SIGTERM half)
+    from ddlbench_tpu.faults import parse_injections
+
+    specs = parse_injections(["shrink@1:2", "grow@2:0"])
+    assert [s.kind for s in specs] == ["shrink", "grow"]
+
+
+# ---- serving: live replica resize under load ------------------------------
+
+
+def _serve_cfg(**kw):
+    # page 4 / max_len 16 match the serve suites' dominant shapes, so the
+    # session serve_factory's compiled npl variants are shared, not paid
+    # again here (tier-1 budget)
+    base = dict(max_batch=4, pool_pages=20, page=4, max_len=16,
+                prefill_chunk=4, replicas=2)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def test_resize_no_request_lost_streams_bitwise(serve_factory):
+    """Shrink 2 -> 1 mid-run (in-flight requests evicted + queue
+    redistributed), then grow 1 -> 3: every request completes and every
+    token stream equals the un-resized control's, bitwise — acceptance
+    pin for the serving half."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    vocab = serve_factory.model.num_classes
+
+    def run(resizes):
+        from ddlbench_tpu.serve.workload import make_workload
+
+        reqs = make_workload(seed=3, n_requests=12, vocab=vocab,
+                             arrival="closed", prompt_lo=2,
+                             prompt_typical=5, prompt_hi=9, out_lo=2,
+                             out_typical=4, out_hi=6, max_len=16)
+        srv = serve_factory(_serve_cfg(), server=True)
+        run_closed_loop(srv, reqs, 6, resizes=list(resizes))
+        return srv
+
+    ctrl = run([])
+    rsz = run([(6.0, 1), (14.0, 3)])
+    fc = {f["rid"]: f["tokens"] for f in ctrl.finished}
+    fr = {f["rid"]: f["tokens"] for f in rsz.finished}
+    assert set(fc) == set(fr) == set(range(12))  # zero requests lost
+    for rid in fc:
+        assert fc[rid] == fr[rid], f"stream diverged for rid {rid}"
+    assert len(rsz.engines) == 3
+    assert [e["to"] for e in rsz.resize_events] == [1, 3]
+    assert rsz.resize_events[0]["from"] == 2
+    # the drained replica's counters survive retirement in the summary
+    assert rsz.stats_summary()["completed"] == 12
+
+
+def test_resize_scale_up_shares_fns_and_guards(serve_factory):
+    """Scale-up engines share the compiled callables; a bare-engine
+    server (no factory) refuses scale-up loudly; n < 1 is rejected."""
+    from ddlbench_tpu.serve.engine import ReplicatedServer
+
+    srv = serve_factory(_serve_cfg(replicas=1), server=True)
+    srv.resize(2)
+    assert len(srv.engines) == 2
+    assert srv.engines[1].jit_fns() == srv.engines[0].jit_fns()
+    with pytest.raises(ValueError, match=">= 1"):
+        srv.resize(0)
+    bare = ReplicatedServer([serve_factory(_serve_cfg(replicas=1)),
+                             serve_factory(_serve_cfg(replicas=1))])
+    with pytest.raises(RuntimeError, match="factory"):
+        bare.resize(3)
+    # scale-down on the bare server still works (drain needs no factory)
+    bare.resize(1)
+    assert len(bare.engines) == 1
+
+
+def test_engine_drain_requeues_everything(serve_factory):
+    """drain(): every active request is evicted (pages freed) and the
+    queue handed back; finished records stay for the retired summary."""
+    from ddlbench_tpu.serve.workload import ServeRequest
+
+    eng = serve_factory(_serve_cfg(replicas=1))
+    vocab = serve_factory.model.num_classes
+    for rid in range(6):
+        prompt = np.arange(1, 6, dtype=np.int32) % vocab
+        eng.submit(ServeRequest(rid=rid, prompt=prompt, max_new=4,
+                                arrival=0.0))
+    t = 0.0
+    for _ in range(3):
+        t += eng.step(t).cost
+    active_before = sum(1 for a in eng.rows if a is not None)
+    queued_before = len(eng.queue)
+    assert active_before > 0  # the drain really interrupts live work
+    reqs, evicted, handoff = eng.drain(t)
+    assert evicted == active_before
+    assert len(reqs) == active_before + queued_before
+    # the handoff carries each displaced request's queue-wait baseline +
+    # recompute marker: evicted actives restart their wait at the drain
+    # instant, never-admitted queue entries keep their original arrival
+    assert sum(1 for _, ev in handoff.values() if ev) == active_before
+    for r in reqs:
+        q0, was_evicted = handoff[r.rid]
+        assert q0 == (t if was_evicted else 0.0)
+    assert not eng.has_work()
+    done = {f["rid"] for f in eng.finished}
+    assert done | {r.rid for r in reqs} == set(range(6))
+    assert eng.allocator.in_use == 0  # every page went back
+
+
+# ---- subprocess e2e (slow): chaosbench reshape + servebench resize --------
+
+
+@pytest.mark.slow
+def test_chaosbench_shrink_grow_roundtrip(tmp_path):
+    """Supervised shrink 4->2 then grow 2->4 on the dp ZeRO-1 engine:
+    completes, reports mttr_reshape_s, and the recovered trajectory
+    matches the uninterrupted world-4 baseline bit-for-bit
+    (post_reshape_divergence == 0.0) — the capstone acceptance run."""
+    from ddlbench_tpu.tools import chaosbench
+
+    args = chaosbench._parse_args([
+        "--kills", "0", "--reshape", "shrink@1:2:2",
+        "--reshape", "grow@2:1:4", "--platform", "cpu",
+        "-b", "mnist", "-m", "lenet", "-f", "dp", "-g", "4",
+        "--steps-per-epoch", "4", "-e", "2", "--batch-size", "2",
+        "--log-interval", "1", "--checkpoint-every-steps", "2",
+        "--workdir", str(tmp_path / "w"), "--keep-workdir",
+        "--", "--dp-shard-update", "--elastic-slices", "4"])
+    report = chaosbench.run_chaos(args)
+    assert report["completed"], report
+    assert report["reshapes"] == 2
+    assert report["final_devices"] == 4
+    assert len(report["mttr_reshape_s"]) == 2
+    assert report["mttr_reshape_s_mean"] > 0
+    assert report["trajectory_match"], report.get("trajectory_mismatches")
+    assert report["post_reshape_divergence"] == 0.0
+
+
+@pytest.mark.slow
+def test_servebench_resize_e2e(tmp_path, capsys):
+    """servebench --resize: the JSON row pins zero lost requests and
+    carries the resize events; the no-resize control row from the same
+    invocation shape is the bitwise stream reference (covered at engine
+    level tier-1)."""
+    from ddlbench_tpu.tools import servebench
+
+    rc = servebench.main([
+        "-m", "transformer_s", "-b", "synthtext", "--policies",
+        "continuous", "--arrival", "closed", "--concurrency", "6",
+        "--requests", "16", "--max-batch", "4", "--pool-pages", "24",
+        "--page", "8", "--max-len", "64", "--prompt-lens", "2,6,12",
+        "--out-lens", "2,4,8", "--replicas", "2", "--resize", "8:1",
+        "--resize", "24:3", "--platform", "cpu"])
+    assert rc == 0
+    line = [l for l in capsys.readouterr().out.splitlines()
+            if l.startswith("{")][-1]
+    rec = json.loads(line)
+    assert rec["requests_lost"] == 0
+    assert rec["final_replicas"] == 3
+    assert [e["to"] for e in rec["resize_events"]] == [1, 3]
+    assert rec["completed"] == 16

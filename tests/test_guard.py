@@ -462,6 +462,10 @@ def test_preempt_cli_exit_code(tmp_path):
     assert "preempt: checkpoint committed" in proc.stdout
 
 
+@pytest.mark.slow  # three CLI children on the 1-core mesh (~30 s); the
+# preempt-vs-kill classification it pins also runs under --runslow with
+# tests/test_elastic.py's shrink/grow supervision e2e (tier-1 budget,
+# ROADMAP item 5 — the in-process preempt handler pins above stay tier-1)
 def test_chaosbench_counts_graceful_exits_separately(tmp_path):
     from ddlbench_tpu.tools import chaosbench
 

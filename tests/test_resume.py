@@ -8,6 +8,12 @@ deterministic in (epoch, step), so the only way the trajectories agree is if
 params/optimizer state — hetero's packed [N, L] rows included — survived the
 round trip). Post-resume validation runs BEFORE training continues
 (reference semantics, main_with_runtime.py:374-376).
+
+All three runs of each round trip (phase 1, resume, uninterrupted control)
+share ONE compiled strategy through the session-scoped ``train_factory``
+cache (conftest.py): strategies are stateless between runs — ``init()``
+returns a fresh TrainState — so the sharing is sound and cuts the
+compile bill of the suite to a third (ROADMAP item 5).
 """
 
 import jax
@@ -39,15 +45,24 @@ def _params_vec(ts):
                        micro_batch_size=4, num_microbatches=2,
                        batch_size=None)),
 ])
-def test_resume_matches_uninterrupted(tmp_path, capsys, strategy, extra):
+def test_resume_matches_uninterrupted(tmp_path, capsys, train_factory,
+                                      strategy, extra):
+    from ddlbench_tpu.parallel.api import make_strategy
+
     ck_a = str(tmp_path / "interrupted")
     ck_b = str(tmp_path / "straight")
+    # ONE compiled strategy serves all three runs (epochs/checkpoint flags
+    # never change the compiled programs)
+    strat_key = _cfg(None, strategy, epochs=2, **extra)
+    strat = train_factory(("resume", strat_key),
+                          lambda: make_strategy(strat_key))
 
     # phase 1: one epoch, checkpointed, then "killed"
-    run_benchmark(_cfg(ck_a, strategy, epochs=1, **extra), warmup_steps=0)
+    run_benchmark(_cfg(ck_a, strategy, epochs=1, **extra), strategy=strat,
+                  warmup_steps=0)
     # phase 2: resume and finish epoch 2
     res = run_benchmark(_cfg(ck_a, strategy, epochs=2, resume=True, **extra),
-                        warmup_steps=0)
+                        strategy=strat, warmup_steps=0)
     out = capsys.readouterr().out
     assert "resumed from" in out and "epoch 1" in out
     # post-resume validation line appears BEFORE epoch 2's training output
@@ -57,7 +72,7 @@ def test_resume_matches_uninterrupted(tmp_path, capsys, strategy, extra):
 
     # control: uninterrupted 2 epochs
     res_u = run_benchmark(_cfg(ck_b, strategy, epochs=2, **extra),
-                          warmup_steps=0)
+                          strategy=strat, warmup_steps=0)
     np.testing.assert_allclose(
         _params_vec(res["train_state"]), _params_vec(res_u["train_state"]),
         rtol=1e-6, atol=1e-7)

@@ -33,3 +33,15 @@ def tiny_moe(capacity_factor=float(N_EXPERTS)):
         "transformer_moe_t", TINY_LM.image_size, TINY_LM.num_classes,
         capacity_factor=capacity_factor,
     )
+
+
+def tiny_dense_model(num_classes=4):
+    """The dp suites' shared tiny MLP (test_dp_shard + test_comm_overlap
+    deliberately share train_factory cache keys, so the model definition
+    must have ONE home — editing a per-file copy would poison whichever
+    suite ran second with the other's cached engine)."""
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+
+    layers = [flatten(), dense("fc1", 9, relu=True),
+              dense("fc2", 8, relu=True), dense("fc3", num_classes)]
+    return LayerModel("tinydense", layers, (4, 4, 1), num_classes)
