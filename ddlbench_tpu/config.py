@@ -1024,9 +1024,34 @@ class ServeConfig:
     # Scheduling NEVER reads these — they are observability-only.
     slo_ttft: float = 0.0
     slo_itl: float = 0.0
+    # KV-pool storage dtype (ops/paged_decode.py serve pool). "float32" is
+    # the bitwise-pinned default; "bfloat16" halves pool bytes; "int8"
+    # quarters them — pages quantize at the write boundary with a stored
+    # per-page scale sidecar (unbiased stochastic rounding, counter-based
+    # seeds, PR 6's EQuARX-lite machinery) and dequantization is fused
+    # into the attention kernels/references. Output quality is pinned by
+    # an accparity-style digits gate (tests/test_serve_quant.py).
+    kv_dtype: str = "float32"
+    # self-drafting speculative decoding: "none" (every decode pass emits
+    # one token per row) or "ngram:N:K" — a host-side N-gram drafter
+    # proposes up to K tokens per decode row from the row's own emitted
+    # prefix, and ONE verify pass (a K+1-wide chunk call at per-row
+    # starts) scores them all; the longest prefix matching greedy argmax
+    # is accepted, rejected tail pages roll back like eviction. Greedy
+    # only (acceptance compares argmaxes); spec-on greedy streams are
+    # pinned BITWISE identical to spec-off (tests/test_serve_spec.py).
+    speculative: str = "none"
 
     def npg_max(self) -> int:
         return -(-self.max_len // self.page)
+
+    def spec_params(self) -> Optional[tuple]:
+        """(ngram_n, draft_k) when speculative decoding is on, else None.
+        ``validate`` rejects malformed specs; this parses a valid one."""
+        if self.speculative == "none":
+            return None
+        _, n, k = self.speculative.split(":")
+        return int(n), int(k)
 
     def resolved_token_budget(self) -> int:
         if self.token_budget:
@@ -1086,6 +1111,35 @@ class ServeConfig:
         if self.slo_ttft < 0 or self.slo_itl < 0:
             raise ValueError(
                 "slo_ttft and slo_itl must be >= 0 (0 = no SLO)")
+        if self.kv_dtype not in ("float32", "bfloat16", "int8"):
+            raise ValueError(
+                f"kv_dtype must be float32|bfloat16|int8, got "
+                f"{self.kv_dtype!r}")
+        if self.speculative != "none":
+            parts = self.speculative.split(":")
+            if len(parts) != 3 or parts[0] != "ngram":
+                raise ValueError(
+                    f"speculative must be 'none' or 'ngram:N:K', got "
+                    f"{self.speculative!r}")
+            try:
+                n, k = int(parts[1]), int(parts[2])
+            except ValueError:
+                raise ValueError(
+                    f"speculative ngram wants integer N:K, got "
+                    f"{self.speculative!r}") from None
+            if n < 1 or k < 1:
+                raise ValueError(
+                    f"speculative ngram needs N >= 1 and K >= 1, got "
+                    f"N={n} K={k}")
+            if self.temperature > 0.0:
+                raise ValueError(
+                    "speculative decoding is greedy-only (acceptance "
+                    "compares draft tokens against greedy argmax); drop "
+                    "temperature or speculative")
+            if k + 1 > self.max_len:
+                raise ValueError(
+                    f"speculative draft width K+1 ({k + 1}) exceeds "
+                    f"max_len {self.max_len}")
 
     def replace(self, **kw: Any) -> "ServeConfig":
         return dataclasses.replace(self, **kw)

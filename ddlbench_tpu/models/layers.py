@@ -224,11 +224,20 @@ class ServeOps:
       [start, start + C) (``start`` dynamic, ``npl``/``page``/C static).
     * ``decode(p, s, pool, table, x, pos, npl, page) -> (y, pool)`` —
       one token per row, x [B, 1] at per-row positions ``pos`` [B].
+    * ``verify`` (optional) — the speculative-decoding scoring pass:
+      x [B, W] token spans at page-UNALIGNED per-row positions
+      [pos0_r, pos0_r + W) (each row's pending token + its drafts). Same
+      contract as ``decode`` — write the span's K/V through the table,
+      then causal attention at absolute positions — but W positions per
+      row in one call (ops/paged_decode.paged_table_span_write +
+      per-row-start chunk attention). None = the layer cannot serve
+      speculative traffic (the engine rejects the config at build).
     """
 
     pool_init: Any  # None for cache-free layers (e.g. the embedding)
     prefill: Callable
     decode: Callable
+    verify: Any = None
 
 
 @dataclasses.dataclass(frozen=True)

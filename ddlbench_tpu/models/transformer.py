@@ -173,10 +173,18 @@ def embed(name: str, vocab: int, d_model: int, max_len: int) -> Layer:
         pe = jnp.take(p["pos"], pos, axis=0)[:, None]
         return jnp.take(p["tok"], x, axis=0) + pe, pool
 
+    def serve_verify(p, s, pool, table, x, pos0, npl, page):
+        # x: [B, W] draft spans at per-row positions [pos0, pos0 + W);
+        # pad positions past the table clip (their outputs are discarded)
+        W = x.shape[1]
+        pe = jnp.take(p["pos"], pos0[:, None] + jnp.arange(W), axis=0)
+        return jnp.take(p["tok"], x, axis=0) + pe, pool
+
     from ddlbench_tpu.models.layers import ServeOps
 
     return Layer(name, init, apply, decode=decode,
-                 serve=ServeOps(None, serve_prefill, serve_decode))
+                 serve=ServeOps(None, serve_prefill, serve_decode,
+                                serve_verify))
 
 
 # Attention backend: "auto" uses the Pallas flash kernel on TPU and the jnp
@@ -525,13 +533,18 @@ def transformer_block(name: str, d_model: int, n_heads: int, mlp_ratio: int = 4,
                                        npl, page)
         return mlp(p, x), pool
 
+    def serve_verify(p, s, pool, table, x, pos0, npl, page):
+        x, pool = attn_serve_verify_op(p, x, pool, table, n_heads, pos0,
+                                       npl, page)
+        return mlp(p, x), pool
+
     from ddlbench_tpu.models.layers import PagedOps, ServeOps
 
     # serving is causal-LM only: the prefix-LM mask (seq2seq) would need the
     # per-request source length threaded through every chunk's mask
     serve = (None if prefix_len else
              ServeOps(attn_serve_pool_init(n_heads, dh),
-                      serve_prefill, serve_decode))
+                      serve_prefill, serve_decode, serve_verify))
     return Layer(name, init, apply, init_cache=attn_cache_init(n_heads, dh),
                  prefill=prefill, decode=decode,
                  paged=PagedOps(attn_paged_cache_init(n_heads, dh),
@@ -642,6 +655,14 @@ def attn_serve_pool_init(n_heads: int, dh: int):
     return pool_init
 
 
+def _serve_pool_out(cache):
+    """The pool dict back out of a write's cache (everything but the
+    table — quantized pools carry scale sidecars + the layer's kv_seed
+    alongside pool_k/pool_v, and all of it must round-trip through the
+    engine's donated pool pytree)."""
+    return {k: v for k, v in cache.items() if k != "table"}
+
+
 def attn_serve_prefill_op(p, x, pool, table, n_heads: int, start, npl: int,
                           page: int):
     """Chunked-prefill attention sublayer for the serving engine: write the
@@ -659,7 +680,7 @@ def attn_serve_prefill_op(p, x, pool, table, n_heads: int, start, npl: int,
                                     v.transpose(0, 2, 1, 3), start, page)
     o = paged_chunk_attention(q, cache, start, npl, page)  # [B, H, C, dh]
     x = x + o.transpose(0, 2, 1, 3).reshape(B, C, d) @ p["wo"].astype(x.dtype)
-    return x, {"pool_k": cache["pool_k"], "pool_v": cache["pool_v"]}
+    return x, _serve_pool_out(cache)
 
 
 def attn_serve_decode_op(p, x, pool, table, n_heads: int, pos, npl: int,
@@ -679,7 +700,29 @@ def attn_serve_decode_op(p, x, pool, table, n_heads: int, pos, npl: int,
     o = paged_attention(q[:, :, 0].astype(x.dtype), cache, pos, npl,
                         page)  # [B, H, dh]
     x = x + o.reshape(B, 1, d) @ p["wo"].astype(x.dtype)
-    return x, {"pool_k": cache["pool_k"], "pool_v": cache["pool_v"]}
+    return x, _serve_pool_out(cache)
+
+
+def attn_serve_verify_op(p, x, pool, table, n_heads: int, pos0, npl: int,
+                         page: int):
+    """Speculative-decoding verify pass: write a W-token span's K/V at
+    page-UNALIGNED per-row positions [pos0, pos0 + W) through the table
+    (ops/paged_decode.paged_table_span_write), then attend all W queries
+    causally at their absolute positions — the multi-query chunk
+    attention with per-row starts, which the chunk-prefill path already
+    compiles. One call scores the pending token plus every draft; the
+    engine accepts the longest prefix whose drafts match greedy argmax."""
+    from ddlbench_tpu.ops.paged_decode import (paged_chunk_attention,
+                                               paged_table_span_write)
+
+    B, W, d = x.shape
+    q, k, v = _qkv_heads(p, x, n_heads)  # [B, H, W, dh]
+    cache = {**pool, "table": table}
+    cache = paged_table_span_write(cache, k.transpose(0, 2, 1, 3),
+                                   v.transpose(0, 2, 1, 3), pos0, page)
+    o = paged_chunk_attention(q, cache, pos0, npl, page)  # [B, H, W, dh]
+    x = x + o.transpose(0, 2, 1, 3).reshape(B, W, d) @ p["wo"].astype(x.dtype)
+    return x, _serve_pool_out(cache)
 
 
 def attn_decode_op(p, x, cache, n_heads: int, pos):

@@ -226,6 +226,20 @@ def paged_reorder(cache, parent, pos, page: int | None = None):
 # ---------------------------------------------------------------------------
 
 
+def _gather_dequant(cache, name: str, tbl, dtype):
+    """Gather the live pages of ``pool_k``/``pool_v`` through the table
+    and return them in ``dtype`` — dequantizing an int8 pool with its
+    per-page scale sidecar (q.astype(f32) * scale per position row, the
+    SAME per-element math the fused Pallas kernels apply inside the
+    online-softmax walk)."""
+    pages = cache[name][tbl]  # [rows, np, page, H, dh]
+    if pool_quantized(cache):
+        scale = cache["scale_" + name[-1]][tbl]  # [rows, np, page]
+        return (pages.astype(jnp.float32)
+                * scale[..., None, None]).astype(dtype)
+    return pages.astype(dtype)
+
+
 def _paged_attention_ref(q, cache, pos, npages_live: int,
                          page: int | None = None):
     """jnp oracle: gather the live pages, mask, softmax. [rows, H, dh].
@@ -238,11 +252,11 @@ def _paged_attention_ref(q, cache, pos, npages_live: int,
     page = page or PAGE
     rows, H, dh = q.shape
     tbl = cache["table"][:, :npages_live]  # [rows, np]
-    kc = cache["pool_k"][tbl]  # [rows, np, page, H, dh]
-    vc = cache["pool_v"][tbl]
+    kc = _gather_dequant(cache, "pool_k", tbl, q.dtype)
+    vc = _gather_dequant(cache, "pool_v", tbl, q.dtype)
     L = npages_live * page
-    kc = kc.reshape(rows, L, H, dh).astype(q.dtype)
-    vc = vc.reshape(rows, L, H, dh).astype(q.dtype)
+    kc = kc.reshape(rows, L, H, dh)
+    vc = vc.reshape(rows, L, H, dh)
     scores = jnp.einsum("rhd,rkhd->rhk", q, kc) / math.sqrt(dh)
     k_pos = jnp.arange(L)[None, None, :]
     pos = jnp.asarray(pos)
@@ -301,9 +315,15 @@ def set_paged_kernel_style(style: str) -> None:
     _KERNEL_STYLE[0] = style
 
 
-def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
-                       m_sc, l_sc, acc_sc, *, scale, page, npages,
-                       elementwise):
+def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, *refs,
+                       scale, page, npages, elementwise, quantized=False):
+    # quantized pools carry two extra per-page scale blocks; dequant is
+    # FUSED here (q.astype(f32) * per-position scale) so the f32 pool is
+    # never materialized — the int8 page is what rides the DMA
+    if quantized:
+        sk_ref, sv_ref, o_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        o_ref, m_sc, l_sc, acc_sc = refs
     j = pl.program_id(1)
 
     @pl.when(j == 0)
@@ -315,6 +335,9 @@ def _paged_attn_kernel(table_ref, t_ref, q_ref, pk_ref, pv_ref, o_ref,
     q = q_ref[0, 0].astype(jnp.float32)  # [H, dh]
     k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
     v = pv_ref[0].astype(jnp.float32)
+    if quantized:
+        k = k * sk_ref[0][:, None, None]
+        v = v * sv_ref[0][:, None, None]
     # t is per-row: the decode loops broadcast one scalar position to every
     # row; the serving engine hands each row its own stream position.
     s = _attn_page_math(q, k, v, j * page, t_ref[pl.program_id(0)], scale,
@@ -363,17 +386,24 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
     scale = 1.0 / math.sqrt(dh)
     tbl = cache["table"][:, :npages_live]
     t32 = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (rows,))
+    quantized = pool_quantized(cache)
 
+    page_spec = pl.BlockSpec((1, page, H, dh),
+                             lambda r, j, tab, t: (tab[r, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, 1, H, dh), lambda r, j, tab, t: (r, 0, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [tbl, t32, q[:, None], cache["pool_k"], cache["pool_v"]]
+    if quantized:  # per-page scale sidecar rows ride their page's block
+        scale_spec = pl.BlockSpec((1, page),
+                                  lambda r, j, tab, t: (tab[r, j], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [cache["scale_k"], cache["scale_v"]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # table, t
         grid=(rows, npages_live),
-        in_specs=[
-            pl.BlockSpec((1, 1, H, dh), lambda r, j, tab, t: (r, 0, 0, 0)),
-            pl.BlockSpec((1, page, H, dh),
-                         lambda r, j, tab, t: (tab[r, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, H, dh),
-                         lambda r, j, tab, t: (tab[r, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, 1, H, dh),
                                lambda r, j, tab, t: (r, 0, 0, 0)),
         scratch_shapes=[
@@ -385,11 +415,12 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
     out = pl.pallas_call(
         functools.partial(
             _paged_attn_kernel, scale=scale, page=page, npages=npages_live,
-            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise"),
+            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise",
+            quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, 1, H, dh), q.dtype),
         interpret=interpret,
-    )(tbl, t32, q[:, None], cache["pool_k"], cache["pool_v"])
+    )(*operands)
     return out[:, 0]
 
 
@@ -420,12 +451,95 @@ def paged_attention(q, cache, pos, npages_live: int, page: int | None = None,
 
 SCRATCH_SLOT = 0
 
+# int8 KV pages (EQuARX-lite at the page-write boundary, PAPERS.md
+# 2506.17615 — the PR 6 gradient-wire machinery applied to the serving
+# pool). A quantized pool stores pool_k/pool_v as int8 plus a SCALE
+# SIDECAR ``scale_k``/``scale_v`` [n_pages, page] f32 — one absmax/127
+# scale per written position ROW of each page, stored page-structured so
+# a page's scales travel with it verbatim through ``serve_page_copy`` and
+# the prefix-cache bind path, and so incremental decode writes never
+# requantize resident tokens (requant noise would otherwise accumulate
+# every step). Rounding is unbiased stochastic
+# (parallel/common.stochastic_round_int8 math) with COUNTER-BASED keys —
+# fold(kv_seed, k/v tag, stream position) — so the quantized bytes of a
+# position are a pure function of its values and its stream position:
+# runs replay bitwise, and eviction/recompute regenerates identical
+# pages. Dequantization is FUSED into the attention kernels/references
+# (scale applied per page row inside the online-softmax walk — an f32
+# pool is never materialized). The sidecar costs 8 bytes per position
+# per layer (<2% of payload at H*dh >= 256) and is excluded from the
+# ``bytes_per_page`` payload accounting (documented in ARCHITECTURE.md).
+
+KV_QMAX = 127.0
+
+
+def pool_quantized(cache_or_pool) -> bool:
+    """True for an int8 serve pool (the scale sidecar is the marker)."""
+    return "scale_k" in cache_or_pool
+
 
 def serve_pool_init(n_pages: int, page: int, n_heads: int, dh: int, dtype):
     """A shared K/V pool of ``n_pages`` free-list-managed slots (slot 0 is
-    the scratch page — serve/allocator.py never hands it out)."""
+    the scratch page — serve/allocator.py never hands it out). ``dtype``
+    int8 builds the QUANTIZED layout: int8 payload + the per-page scale
+    sidecar (zeros: an unwritten position dequantizes to exactly 0, same
+    as the f32 zero init)."""
     shape = (n_pages, page, n_heads, dh)
-    return {"pool_k": jnp.zeros(shape, dtype), "pool_v": jnp.zeros(shape, dtype)}
+    pool = {"pool_k": jnp.zeros(shape, dtype),
+            "pool_v": jnp.zeros(shape, dtype)}
+    if jnp.dtype(dtype) == jnp.int8:
+        pool["scale_k"] = jnp.zeros((n_pages, page), jnp.float32)
+        pool["scale_v"] = jnp.zeros((n_pages, page), jnp.float32)
+    return pool
+
+
+def _kv_quantize(x, pos, kv_seed, tag: int):
+    """Quantize K or V rows ``x`` [..., H, dh] (one leading axis per
+    position) to (q int8 same shape, scale f32 [...]).
+
+    Per-position absmax scale (the largest element maps to exactly
+    +-127), unbiased stochastic rounding with a counter-based key
+    ``fold(fold(PRNGKey(kv_seed), tag), position)`` — ``pos`` carries the
+    absolute stream position of every row of x (same leading shape), so
+    the quantized bytes depend only on (values, layer seed, k/v tag,
+    position): recompute and prefix-cache re-derivations replay bitwise.
+    """
+    lead = x.shape[:-2]
+    absmax = jnp.max(jnp.abs(x).astype(jnp.float32), axis=(-2, -1))
+    scale = jnp.where(absmax > 0, absmax / KV_QMAX, jnp.float32(1.0))
+    v = x.astype(jnp.float32) / scale[..., None, None]
+
+    base = jax.random.fold_in(jax.random.PRNGKey(kv_seed), tag)
+
+    def u_for(p):
+        return jax.random.uniform(jax.random.fold_in(base, p),
+                                  x.shape[-2:], jnp.float32)
+
+    u = jax.vmap(u_for)(pos.reshape(-1)).reshape(x.shape)
+    lo = jnp.floor(v)
+    q = lo + (u < (v - lo)).astype(jnp.float32)
+    return jnp.clip(q, -KV_QMAX, KV_QMAX).astype(jnp.int8), scale
+
+
+def _pool_write(cache, k, v, pos, write_payload, write_scale):
+    """Shared quantize-or-passthrough dispatch for the three table-write
+    primitives: ``write_payload(pool, x)`` scatters value rows,
+    ``write_scale(scales, s)`` scatters the matching scale rows (only
+    called on a quantized pool). ``pos`` is the per-row absolute position
+    tensor matching x's leading shape."""
+    out = dict(cache)
+    if pool_quantized(cache):
+        seed = cache.get("kv_seed", 0)
+        qk, sk = _kv_quantize(k, pos, seed, 0)
+        qv, sv = _kv_quantize(v, pos, seed, 1)
+        out["pool_k"] = write_payload(cache["pool_k"], qk)
+        out["pool_v"] = write_payload(cache["pool_v"], qv)
+        out["scale_k"] = write_scale(cache["scale_k"], sk)
+        out["scale_v"] = write_scale(cache["scale_v"], sv)
+    else:
+        out["pool_k"] = write_payload(cache["pool_k"], k)
+        out["pool_v"] = write_payload(cache["pool_v"], v)
+    return out
 
 
 def paged_table_write(cache, k1, v1, pos, page: int | None = None):
@@ -433,7 +547,9 @@ def paged_table_write(cache, k1, v1, pos, page: int | None = None):
     ([rows] int32, or a scalar) through the TABLE: row r's token lands in
     pool slot ``table[r, pos_r // page]`` at offset ``pos_r % page``.
     Rows whose table row points at the scratch slot write garbage there
-    harmlessly (the serving engine masks inactive rows this way)."""
+    harmlessly (the serving engine masks inactive rows this way). On a
+    quantized pool the token quantizes at the write boundary and its
+    scale lands in the page's sidecar row."""
     page = page or PAGE
     rows = cache["table"].shape[0]
     pos = jnp.broadcast_to(jnp.asarray(pos, jnp.int32).reshape(-1), (rows,))
@@ -444,8 +560,10 @@ def paged_table_write(cache, k1, v1, pos, page: int | None = None):
     def write(pool, x):
         return pool.at[slots, off].set(x[:, 0].astype(pool.dtype))
 
-    return {**cache, "pool_k": write(cache["pool_k"], k1),
-            "pool_v": write(cache["pool_v"], v1)}
+    def write_scale(scales, s):
+        return scales.at[slots, off].set(s[:, 0])
+
+    return _pool_write(cache, k1, v1, pos[:, None], write, write_scale)
 
 
 def paged_table_chunk_write(cache, k, v, start, page: int | None = None):
@@ -475,14 +593,49 @@ def paged_table_chunk_write(cache, k, v, start, page: int | None = None):
         x5 = x.reshape(rows, npg_c, page, H, dh).astype(pool.dtype)
         return pool.at[slots].set(x5)
 
-    return {**cache, "pool_k": write(cache["pool_k"], k),
-            "pool_v": write(cache["pool_v"], v)}
+    def write_scale(scales, s):
+        return scales.at[slots].set(s.reshape(rows, npg_c, page))
+
+    pos = (jnp.asarray(start, jnp.int32)
+           + jnp.arange(C, dtype=jnp.int32))[None, :]  # [1, C] -> broadcast
+    return _pool_write(cache, k, v, jnp.broadcast_to(pos, (rows, C)),
+                       write, write_scale)
+
+
+def paged_table_span_write(cache, k, v, pos0, page: int | None = None):
+    """Write a SPAN of W tokens' K/V [rows, W, H, dh] at per-row positions
+    [pos0_r, pos0_r + W) through the table — page-UNALIGNED, the write
+    shape of the speculative-decoding verify pass (the pending token plus
+    the drafts start mid-page). Each position scatters independently by
+    (page, offset); positions whose page index runs past the table's
+    columns resolve to the scratch slot, so a row's padded draft tail
+    lands harmlessly exactly like the chunk write's padded tail."""
+    page = page or PAGE
+    rows, W, H, dh = k.shape
+    npg = cache["table"].shape[1]
+    pos = (jnp.asarray(pos0, jnp.int32).reshape(-1)[:, None]
+           + jnp.arange(W, dtype=jnp.int32)[None, :])  # [rows, W]
+    pg, off = pos // page, pos % page
+    slots = jnp.take_along_axis(cache["table"],
+                                jnp.clip(pg, 0, npg - 1), axis=1)
+    slots = jnp.where(pg < npg, slots, SCRATCH_SLOT)
+
+    def write(pool, x):
+        return pool.at[slots, off].set(x.astype(pool.dtype))
+
+    def write_scale(scales, s):
+        return scales.at[slots, off].set(s)
+
+    return _pool_write(cache, k, v, pos, write, write_scale)
 
 
 def serve_page_copy(pool, src, dst):
     """Copy-on-write: physically copy pool slot ``src`` into slot ``dst``
     ({pool_k, pool_v} or any same-shaped pool dict; ``src``/``dst`` may be
-    traced scalars, so ONE compiled program serves every copy).
+    traced scalars, so ONE compiled program serves every copy). On a
+    quantized pool the page's scale sidecar rows copy verbatim with the
+    payload — a copied page dequantizes bit-identically to its source —
+    and scalar entries (the layer's ``kv_seed``) pass through untouched.
 
     This is the serving analog of ``paged_reorder``'s partial-page copy:
     the prefix cache binds immutable shared pages into a new request's
@@ -491,7 +644,8 @@ def serve_page_copy(pool, src, dst):
     the decode program) it must copy the page into a private slot — the
     two token streams would otherwise couple through last-ulp drift
     between the chunked and single-token K/V computations."""
-    return {k: v.at[dst].set(v[src]) for k, v in pool.items()}
+    return {k: (v.at[dst].set(v[src]) if jnp.ndim(v) else v)
+            for k, v in pool.items()}
 
 
 def _paged_chunk_attention_ref(q, cache, start, npages_live: int,
@@ -505,10 +659,10 @@ def _paged_chunk_attention_ref(q, cache, start, npages_live: int,
     rows, H, C, dh = q.shape
     tbl = cache["table"][:, :npages_live]
     L = npages_live * page
-    kc = (cache["pool_k"][tbl].reshape(rows, L, H, dh)
-          .astype(q.dtype).transpose(0, 2, 1, 3))  # [rows, H, L, dh]
-    vc = (cache["pool_v"][tbl].reshape(rows, L, H, dh)
-          .astype(q.dtype).transpose(0, 2, 1, 3))
+    kc = (_gather_dequant(cache, "pool_k", tbl, q.dtype)
+          .reshape(rows, L, H, dh).transpose(0, 2, 1, 3))  # [rows, H, L, dh]
+    vc = (_gather_dequant(cache, "pool_v", tbl, q.dtype)
+          .reshape(rows, L, H, dh).transpose(0, 2, 1, 3))
     scores = jnp.einsum("rhqd,rhkd->rhqk", q, kc) / math.sqrt(dh)
     start = jnp.asarray(start, jnp.int32).reshape(-1)  # scalar or [rows]
     q_pos = start[:, None] + jnp.arange(C)[None, :]  # [rows or 1, C]
@@ -519,15 +673,21 @@ def _paged_chunk_attention_ref(q, cache, start, npages_live: int,
     return jnp.einsum("rhqk,rhkd->rhqd", probs, vc)
 
 
-def _paged_chunk_attn_kernel(table_ref, s_ref, q_ref, pk_ref, pv_ref, o_ref,
-                             m_sc, l_sc, acc_sc, *, scale, page, npages,
-                             elementwise):
+def _paged_chunk_attn_kernel(table_ref, s_ref, q_ref, pk_ref, pv_ref, *refs,
+                             scale, page, npages, elementwise,
+                             quantized=False):
     """Multi-query analog of ``_paged_attn_kernel``: one grid step attends
     ALL C chunk queries of row r against one live page j, accumulating an
     online softmax per (head, query). The causal mask is absolute — query
     c sits at stream position ``start_r + c`` (``s_ref`` is the per-row
     chunk start the scheduler prefetches) — so within-chunk causality and
-    full visibility of earlier pages fall out of one comparison."""
+    full visibility of earlier pages fall out of one comparison. A
+    quantized pool's per-page scale blocks dequantize the page in-kernel,
+    exactly like the flash-decode variant."""
+    if quantized:
+        sk_ref, sv_ref, o_ref, m_sc, l_sc, acc_sc = refs
+    else:
+        o_ref, m_sc, l_sc, acc_sc = refs
     r, j = pl.program_id(0), pl.program_id(1)
 
     @pl.when(j == 0)
@@ -539,6 +699,9 @@ def _paged_chunk_attn_kernel(table_ref, s_ref, q_ref, pk_ref, pv_ref, o_ref,
     q = q_ref[0].astype(jnp.float32)  # [H, C, dh]
     k = pk_ref[0].astype(jnp.float32)  # [page, H, dh]
     v = pv_ref[0].astype(jnp.float32)
+    if quantized:
+        k = k * sk_ref[0][:, None, None]
+        v = v * sv_ref[0][:, None, None]
     if elementwise:
         # s[h, c, p] = sum_d q[h, c, d] * k[p, h, d]
         s = jnp.sum(q[:, :, None, :] * k.transpose(1, 0, 2)[:, None, :, :],
@@ -603,17 +766,24 @@ def paged_chunk_attention(q, cache, start, npages_live: int,
     scale = 1.0 / math.sqrt(dh)
     tbl = cache["table"][:, :npages_live]
     s32 = jnp.broadcast_to(jnp.asarray(start, jnp.int32).reshape(-1), (rows,))
+    quantized = pool_quantized(cache)
 
+    page_spec = pl.BlockSpec((1, page, H, dh),
+                             lambda r, j, tab, s: (tab[r, j], 0, 0, 0))
+    in_specs = [
+        pl.BlockSpec((1, H, C, dh), lambda r, j, tab, s: (r, 0, 0, 0)),
+        page_spec, page_spec,
+    ]
+    operands = [tbl, s32, q, cache["pool_k"], cache["pool_v"]]
+    if quantized:
+        scale_spec = pl.BlockSpec((1, page),
+                                  lambda r, j, tab, s: (tab[r, j], 0))
+        in_specs += [scale_spec, scale_spec]
+        operands += [cache["scale_k"], cache["scale_v"]]
     grid_spec = pltpu.PrefetchScalarGridSpec(
         num_scalar_prefetch=2,  # table, per-row chunk start
         grid=(rows, npages_live),
-        in_specs=[
-            pl.BlockSpec((1, H, C, dh), lambda r, j, tab, s: (r, 0, 0, 0)),
-            pl.BlockSpec((1, page, H, dh),
-                         lambda r, j, tab, s: (tab[r, j], 0, 0, 0)),
-            pl.BlockSpec((1, page, H, dh),
-                         lambda r, j, tab, s: (tab[r, j], 0, 0, 0)),
-        ],
+        in_specs=in_specs,
         out_specs=pl.BlockSpec((1, H, C, dh),
                                lambda r, j, tab, s: (r, 0, 0, 0)),
         scratch_shapes=[
@@ -626,8 +796,9 @@ def paged_chunk_attention(q, cache, start, npages_live: int,
         functools.partial(
             _paged_chunk_attn_kernel, scale=scale, page=page,
             npages=npages_live,
-            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise"),
+            elementwise=(kernel_style or _KERNEL_STYLE[0]) == "elementwise",
+            quantized=quantized),
         grid_spec=grid_spec,
         out_shape=jax.ShapeDtypeStruct((rows, H, C, dh), q.dtype),
         interpret=interpret,
-    )(tbl, s32, q, cache["pool_k"], cache["pool_v"])
+    )(*operands)

@@ -9,14 +9,16 @@ open/closed-loop traffic incl. shared-prefix groups
 (:mod:`serve.workload`), and — through ``tools/servebench.py`` — TTFT /
 inter-token-latency percentiles and goodput-under-SLO reporting.
 
-Import discipline: :mod:`serve.allocator`, :mod:`serve.prefix` and
-:mod:`serve.workload` are jax-free (numpy + stdlib), so workload synthesis
-and allocation logic are importable from jax-free hosts; the engine (which
-traces models) is imported lazily via PEP 562 — the same laziness
-train/__init__ applies for the chaosbench supervisor.
+Import discipline: :mod:`serve.allocator`, :mod:`serve.draft`,
+:mod:`serve.prefix` and :mod:`serve.workload` are jax-free (numpy +
+stdlib), so workload synthesis, drafting, and allocation logic are
+importable from jax-free hosts; the engine (which traces models) is
+imported lazily via PEP 562 — the same laziness train/__init__ applies
+for the chaosbench supervisor.
 """
 
 from ddlbench_tpu.serve.allocator import PageAllocator  # noqa: F401
+from ddlbench_tpu.serve.draft import NgramDrafter  # noqa: F401
 from ddlbench_tpu.serve.prefix import PrefixIndex  # noqa: F401
 from ddlbench_tpu.serve.workload import (  # noqa: F401
     ServeRequest,

@@ -156,6 +156,30 @@ class PageAllocator:
         self._ref[slot] = c - 1
         return False
 
+    def release(self, rid: int, slots: List[int]) -> int:
+        """Drop ``rid``'s reference on a SUBSET of its pages — the
+        speculative-decoding rollback: pages allocated ahead for draft
+        writes whose drafts were rejected return to the pool without
+        retiring the request (eviction's partial sibling). Releasing a
+        slot the request does not hold is a double-free and raises; the
+        all-or-nothing alloc discipline is unaffected (these pages were
+        granted normally). Returns how many pages physically freed."""
+        owned = self._owned.get(rid)
+        freed = 0
+        for s in slots:
+            if owned is None or s not in owned:
+                raise ValueError(
+                    f"double free: request {rid} does not hold slot {s}")
+            owned.remove(s)
+            freed += self.decref(s)
+        # a fully-released rid keeps its (empty) ownership entry: the
+        # request is still live and its eventual free_request must not
+        # read as a double-free
+        if slots and self.on_event is not None:
+            self.on_event("pool_rollback", rid=rid, held=len(slots),
+                          freed=freed, free=len(self._free))
+        return freed
+
     def free_request(self, rid: int) -> int:
         """Drop ``rid``'s reference on every page it holds (completion or
         eviction). Returns how many pages physically returned to the free

@@ -1,0 +1,81 @@
+"""Self-drafting n-gram proposer for speculative decoding.
+
+Speculative decoding (Leviathan et al. 2022, "Fast Inference from
+Transformers via Speculative Decoding" — cited directly, like the Kwon et
+al. PagedAttention lineage of PR 10; not among the training papers in
+PAPERS.md) splits token generation into a cheap DRAFT and an exact
+VERIFY: a proposer guesses the next K tokens, the target model scores all
+K+1 positions in ONE pass, and the longest prefix of drafts matching the
+model's own (greedy) choices is accepted — every accepted draft turns a
+would-be decode pass into a free token, and a rejected draft costs
+nothing the plain pass would not have spent (the verify pass still emits
+its one guaranteed token).
+
+This drafter is the SELF-drafting variant (prompt-lookup style): instead
+of a second model it proposes from the request's OWN token stream — find
+the most recent earlier occurrence of the last N emitted/prompt tokens
+and propose the continuation that followed it. Natural-language and code
+traffic repeat themselves (boilerplate, copied spans, templated phrasing);
+an N-gram that recurred once tends to continue the same way. The proposer
+is pure host arithmetic over the tokens the engine already holds:
+
+* deterministic — same context, same proposal (no RNG at all), which is
+  what lets eviction/recompute replay identical speculative schedules;
+* bounded — it reads ONLY the request's prompt + emitted tokens (the
+  engine drafts only for rows whose prefill is complete, so the context
+  never reaches past ``prefill_done``), and proposes at most ``k`` tokens;
+* cheap — one backwards scan per decode row per step, O(len(context) * n)
+  worst case on token counts that are at most ``max_len``.
+
+The engine (serve/engine.py) owns acceptance: drafts are scored by the
+K+1-wide verify program and accepted while they match greedy argmax, so
+the emitted stream is BITWISE the non-speculative stream regardless of
+what this module proposes — a bad proposal costs acceptance rate, never
+correctness (pinned, tests/test_serve_spec.py).
+"""
+
+from __future__ import annotations
+
+from typing import List, Sequence
+
+
+class NgramDrafter:
+    """Propose up to ``k`` continuation tokens by matching the context's
+    trailing ``n``-gram against its own history."""
+
+    def __init__(self, n: int, k: int):
+        if n < 1 or k < 1:
+            raise ValueError(f"ngram drafter needs n >= 1 and k >= 1, "
+                             f"got n={n} k={k}")
+        self.n = int(n)
+        self.k = int(k)
+
+    def propose(self, context: Sequence[int], k_max: int | None = None
+                ) -> List[int]:
+        """Drafts for the token stream ``context`` (prompt + emitted
+        tokens, most recent last): the continuation that followed the most
+        recent PRIOR occurrence of the trailing n-gram, truncated to
+        ``min(k, k_max)`` tokens and to what the history actually
+        contains. Empty when the n-gram never recurred or the context is
+        shorter than n + 1."""
+        k = self.k if k_max is None else min(self.k, int(k_max))
+        n = self.n
+        L = len(context)
+        if k < 1 or L < n + 1:
+            return []
+        tail = list(context[L - n:])
+        # j is the index AFTER a match (the first proposed token), scanned
+        # right-to-left: the most recent occurrence that can supply all k
+        # tokens wins (freshest full-width continuation); when every match
+        # sits too close to the end — the periodic-stream case, where
+        # matches overlap the tail itself — fall back to the earliest
+        # match, whose continuation is the longest available
+        fallback = None
+        for j in range(L - 1, n - 1, -1):
+            if list(context[j - n:j]) == tail:
+                if L - j >= k:
+                    return [int(t) for t in context[j:j + k]]
+                fallback = j
+        if fallback is not None:
+            return [int(t) for t in context[fallback:fallback + k]]
+        return []

@@ -41,6 +41,29 @@ Structure (host schedules, device computes):
   per-request seeds (fold of sample_seed + request id + token index — no
   wall-clock nondeterminism), so streams are bitwise-reproducible per
   seed and eviction/recompute regenerates identical tokens.
+* Quantized KV pages (``cfg.kv_dtype``): the shared pool stores K/V in
+  f32, bf16, or int8 — int8 quantizes at the page-WRITE boundary
+  (per-page scale sidecar, unbiased stochastic rounding with counter-
+  based seeds: ops/paged_decode.py) and dequantizes inside the attention
+  kernels/references, so pool bytes per token drop 4x vs f32 (2x vs
+  bf16) and concurrent capacity at equal HBM doubles. Output quality is
+  pinned by a digits gate; scales travel with pages through COW and
+  prefix binds, so caching composes for free.
+* Self-drafting SPECULATIVE DECODING (``cfg.speculative = ngram:N:K``;
+  Leviathan et al. 2022): a host-side n-gram drafter proposes up to K
+  tokens per decode row from the row's own emitted prefix, and ONE
+  verify pass — a [max_batch, K+1] span program built from the existing
+  per-row-start chunk attention — scores all K+1 positions at the price
+  of one model pass. The longest draft prefix matching greedy argmax is
+  accepted, so spec-on streams equal the spec-off streams token for
+  token — pinned BITWISE on the CPU fixtures (the correctness pin; exact
+  equality also needs the verify program's argmax to agree with the
+  decode program's, whose reduction orders differ in the last ulp — the
+  on-chip round-16 A/B re-checks agreement), accepted K/V is already in
+  place from the span
+  write, and pages past the accepted frontier roll back to the pool like
+  eviction's frees. Speculation never evicts anyone: a page shortfall
+  truncates drafts instead.
 * Eviction closes the loop on pool exhaustion: when a growing request
   needs a page and the free list is empty, the engine first RECLAIMS
   prefix-cache pages no live request references (newest-registered
@@ -93,6 +116,7 @@ import numpy as np
 from ddlbench_tpu.config import ServeConfig
 from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.serve.allocator import PageAllocator
+from ddlbench_tpu.serve.draft import NgramDrafter
 from ddlbench_tpu.serve.prefix import PrefixIndex
 from ddlbench_tpu.serve.workload import ServeRequest
 from ddlbench_tpu.telemetry.stats import request_slo_ok
@@ -211,17 +235,46 @@ class ServeEngine:
         self.cfg = cfg
         self.page = cfg.page
         self.npg_max = cfg.npg_max()
-        self.dtype = dtype or jnp.float32
+        # pool storage dtype: cfg.kv_dtype unless the caller overrides —
+        # int8 builds the quantized pool layout (payload + per-page scale
+        # sidecar; ops/paged_decode.serve_pool_init)
+        kv_map = {"float32": jnp.float32, "bfloat16": jnp.bfloat16,
+                  "int8": jnp.int8}
+        self.dtype = dtype if dtype is not None else kv_map[cfg.kv_dtype]
         self._put = (lambda t: jax.device_put(t, device)) if device \
             else (lambda t: t)
         self.params = self._put(params)
         self.state = self._put(state)
-        self.pools = self._put([
-            l.serve.pool_init(p, cfg.pool_pages, cfg.page, self.dtype)
-            if (l.serve is not None and l.serve.pool_init is not None)
-            else None
-            for l, p in zip(model.layers, params)
-        ])
+        pools = []
+        self.bytes_per_page = 0  # K/V payload bytes per pool slot, summed
+        for li, (l, p) in enumerate(zip(model.layers, params)):
+            if l.serve is None or l.serve.pool_init is None:
+                pools.append(None)
+                continue
+            pool = l.serve.pool_init(p, cfg.pool_pages, cfg.page, self.dtype)
+            if "scale_k" in pool:
+                # per-layer counter seed for the write-boundary stochastic
+                # rounding: quantized bytes become a pure function of
+                # (values, layer, k/v tag, stream position) — recompute
+                # and prefix re-derivations replay bitwise
+                pool["kv_seed"] = jnp.int32(li)
+            for name in ("pool_k", "pool_v"):
+                arr = pool[name]
+                self.bytes_per_page += int(
+                    arr.dtype.itemsize * np.prod(arr.shape[1:]))
+            pools.append(pool)
+        self.pools = self._put(pools)
+        # self-drafting speculative decoding (cfg.speculative: ngram:N:K)
+        self._spec = cfg.spec_params()
+        self._drafter = NgramDrafter(*self._spec) if self._spec else None
+        if self._spec is not None:
+            missing = [l.name for l in model.layers
+                       if l.serve is not None and l.serve.verify is None]
+            if missing:
+                raise NotImplementedError(
+                    f"{model.name}: speculative decoding needs a "
+                    f"ServeOps.verify on every serving layer; missing: "
+                    f"{missing}")
         self.table = np.zeros((cfg.max_batch, self.npg_max), np.int32)
         self.allocator = PageAllocator(cfg.pool_pages)
         self.prefix: Optional[PrefixIndex] = (
@@ -267,19 +320,27 @@ class ServeEngine:
             # static baseline report 0, keeping the JSON schema stable)
             "prefix_hits": 0, "prefix_tokens_saved": 0, "cow_copies": 0,
             "shared_pages": 0, "prefill_tokens": 0,
+            # speculative-decoding counters (always present — spec-off
+            # reports 0, keeping the schema stable like the prefix set).
+            # decode_tokens = tokens emitted by decode/verify passes (the
+            # tokens-per-pass numerator; prefill first tokens excluded)
+            "spec_passes": 0, "spec_drafted": 0, "spec_accepted": 0,
+            "decode_tokens": 0,
         }
         if shared_fns is not None:
             # replicas of one server share the jitted callables (same model
             # and shapes), so same-device replicas share the compile cache
             # instead of re-tracing every npl variant per engine
-            self._decode_jit, self._prefill_jit, self._cow_jit = shared_fns
+            (self._decode_jit, self._prefill_jit, self._cow_jit,
+             self._verify_jit) = shared_fns
         else:
             self._make_fns()
 
     def jit_fns(self):
-        """The (decode, prefill, cow) jitted callables, shareable with
-        sibling replicas built from the same model/config."""
-        return self._decode_jit, self._prefill_jit, self._cow_jit
+        """The (decode, prefill, cow, verify) jitted callables, shareable
+        with sibling replicas built from the same model/config."""
+        return (self._decode_jit, self._prefill_jit, self._cow_jit,
+                self._verify_jit)
 
     # -- request-lifecycle tracing (virtual-time, metrics-neutral) ---------
 
@@ -392,11 +453,23 @@ class ServeEngine:
             return [serve_page_copy(pool, src, dst)
                     if pool is not None else None for pool in pools]
 
+        def verify_fn(params, states, pools, table, toks, pos0, npl):
+            # speculative verify: ONE [max_batch, W] pass scores every
+            # row's pending token + drafts at per-row span positions
+            # [pos0, pos0 + W) — the K-wide chunk variant (the span write
+            # + the chunk-prefill attention program with per-row starts).
+            # Greedy only: the host accepts drafts against these argmaxes
+            logits, pools = walk(params, states, pools, table, toks,
+                                 "verify", pos0, npl, page)
+            return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+
         self._decode_jit = jax.jit(decode_fn, static_argnums=(6,),
                                    donate_argnums=(2,))
         self._prefill_jit = jax.jit(prefill_fn, static_argnums=(7,),
                                     donate_argnums=(2,))
         self._cow_jit = jax.jit(cow_fn, donate_argnums=(0,))
+        self._verify_jit = jax.jit(verify_fn, static_argnums=(6,),
+                                   donate_argnums=(2,))
 
     def _emit_token(self, raw, rid: int, token_index: int) -> int:
         """One emitted token from a program output: the argmax'd int32 in
@@ -665,7 +738,16 @@ class ServeEngine:
         # 1) decode set: every decode row gets its next page (evictions may
         #    shrink the set — or free rows the packer then refills)
         decode_set = self._ensure_decode_pages(rep)
-        budget = self.cfg.resolved_token_budget() - len(decode_set)
+        # 1b) speculative drafts, planned BEFORE the budget so the packer
+        #     charges a verify pass at its true token width (1 + drafts
+        #     per row); nothing later in a step with live decode rows can
+        #     evict, so the plan cannot go stale
+        draft_plan = (self._plan_drafts(decode_set)
+                      if self._spec is not None and decode_set else None)
+        spec_tokens = (sum(len(d) for _, d, _ in draft_plan)
+                       if draft_plan else 0)
+        budget = (self.cfg.resolved_token_budget() - len(decode_set)
+                  - spec_tokens)
 
         # 2) continue in-flight prefills, admission order
         prefill_calls: List[_Active] = []
@@ -762,13 +844,18 @@ class ServeEngine:
                 self._free_row() is None or not self.queue):
             self._filling = False
 
-        # 4) price the step, then run it
+        # 4) price the step, then run it. A verify pass is ONE model pass
+        #    (the same price as the decode step it replaces — the honest
+        #    virtual-cost accounting the goodput A/B rides on)
         cost = len(prefill_calls) + (1 if decode_set else 0)
         t_end = now + cost
         for a in prefill_calls:
             self._run_prefill_chunk(a, C, t_end, rep)
         if decode_set:
-            self._run_decode(decode_set, t_end, rep)
+            if draft_plan is not None and any(d for _, d, _ in draft_plan):
+                self._run_verify(draft_plan, t_end, rep)
+            else:
+                self._run_decode(decode_set, t_end, rep)
 
         # 5) occupancy / fragmentation accounting
         self.stats["steps"] += 1
@@ -820,6 +907,152 @@ class ServeEngine:
                 tr.emit("C", f"{cname}[{self._trk}]", t_ns, track=trk,
                         args={"value": v})
         return rep
+
+    def _plan_drafts(self, decode_set: List[_Active]):
+        """Per decode row: self-draft up to K tokens from the row's own
+        stream (the n-gram drafter reads prompt + emitted tokens only —
+        decode rows are fully prefilled, so it never reads past
+        ``prefill_done``) and opportunistically pre-allocate the pages the
+        span write needs. Speculation NEVER evicts — and never reclaims
+        prefix-cache pages either: draft headroom comes straight off the
+        free list (``allocator.alloc``, not ``_alloc``), since spending a
+        hot shared-prefix page on K/V that is likely rolled back the same
+        step would erode the cache the run is measuring. A shortfall
+        truncates the drafts to what the row's pages can hold — a bad
+        pool day degrades acceptance, not residency. Plan entries are
+        ``(active, drafts, pre_pages)``; ``pre_pages`` (the row's page
+        count BEFORE planning) bounds the rollback so it only ever
+        returns pages this planner added — the static policy's up-front
+        worst-case reservation must survive a verify pass untouched."""
+        plan = []
+        for a in decode_set:
+            pre_pages = a.n_pages
+            # never draft past the request's own max_new: the verify pass
+            # emits at most 1 + len(drafts) tokens, and the final token's
+            # K/V is never written — the page math stays inside the
+            # non-speculative worst case
+            k_max = a.req.max_new - len(a.out) - 1
+            drafts: List[int] = []
+            if k_max > 0:
+                ctx = list(a.req.prompt.tolist()) + a.out
+                drafts = self._drafter.propose(ctx, k_max)
+            if drafts:
+                need = self._pages_for(
+                    a.decode_pos + len(drafts) + 1) - a.n_pages
+                while need > 0:
+                    slots = self.allocator.alloc(a.req.rid, need)
+                    if slots is not None:
+                        self.table[a.row,
+                                   a.n_pages:a.n_pages + need] = slots
+                        a.n_pages += need
+                        break
+                    need -= 1
+                # positions [decode_pos, n_pages * page) are writable
+                fit = a.n_pages * self.page - 1 - a.decode_pos
+                drafts = drafts[:max(0, fit)]
+            if drafts:
+                self.stats["spec_drafted"] += len(drafts)
+                tr = self._tr()
+                if tr is not None:
+                    tr.emit("i", "draft", _vns(self._now),
+                            track=self._req_track(a.req.rid),
+                            args={"rid": a.req.rid,
+                                  "proposed": len(drafts),
+                                  "tok": len(a.out)})
+            plan.append((a, drafts, pre_pages))
+        return plan
+
+    def _run_verify(self, plan, t_end: float, rep: StepReport) -> None:
+        """One speculative verify pass over the decode set: score every
+        row's pending token + drafts at span positions
+        [decode_pos, decode_pos + W) in ONE [max_batch, W] program call,
+        accept the longest draft prefix matching greedy argmax (so the
+        emitted stream is BITWISE the non-speculative stream), write
+        accepted K/V in place (the span write already put it there), and
+        roll back pages past the accepted frontier like eviction does."""
+        import jax.numpy as jnp
+
+        assert all(self.rows[a.row] is a for a, _, _ in plan), \
+            "scheduled a dead (evicted) row"
+        W = self._spec[1] + 1
+        B = self.cfg.max_batch
+        toks = np.zeros((B, W), np.int32)
+        pos0 = np.zeros((B,), np.int32)
+        mask = np.zeros((B,), bool)
+        for a, drafts, _ in plan:
+            toks[a.row, 0] = a.pending_tok
+            if drafts:
+                toks[a.row, 1:1 + len(drafts)] = drafts
+            pos0[a.row] = a.decode_pos
+            mask[a.row] = True
+        # inactive rows route to scratch exactly like the decode pass;
+        # a row's own padded draft tail lands in its page headroom (never
+        # attended: key pos > every live query pos) or on scratch
+        ver_table = np.where(mask[:, None], self.table, 0)
+        npl = max((int(a.decode_pos) + len(d)) // self.page + 1
+                  for a, d, _ in plan)
+        nxt, self.pools = self._verify_jit(
+            self.params, self.state, self.pools, jnp.asarray(ver_table),
+            jnp.asarray(toks), jnp.asarray(pos0), npl)
+        nxt = np.asarray(nxt)
+        rep.decode_rows = len(plan)
+        self.stats["spec_passes"] += 1
+        self.stats["decode_row_slots"] += len(plan)
+        tr = self._tr()
+        d0, d1 = _vns(self._now), _vns(t_end)
+        for a, drafts, pre_pages in plan:
+            y = nxt[a.row]  # y[j] = greedy token after span slot j
+            emitted = [int(y[0])]  # slot 0 (the pending token) is exact
+            for j in range(1, len(drafts) + 1):
+                # draft j-1 occupies slot j; it was the RIGHT input iff it
+                # equals the token the model emitted after slot j-1
+                if int(drafts[j - 1]) != emitted[j - 1]:
+                    break
+                emitted.append(int(y[j]))
+            accepted = len(emitted) - 1
+            self.stats["spec_accepted"] += accepted
+            self.stats["decode_tokens"] += len(emitted)
+            if tr is not None:
+                trk = self._req_track(a.req.rid)
+                tr.emit("X", "verify", d0, d1 - d0, track=trk,
+                        args={"rid": a.req.rid, "tok": len(a.out),
+                              "pos": int(a.decode_pos),
+                              "drafted": len(drafts),
+                              "emitted": len(emitted),
+                              "step": int(self.stats["steps"])})
+                tr.emit("i", "accept", d1, track=trk,
+                        args={"rid": a.req.rid, "accepted": accepted,
+                              "drafted": len(drafts)})
+            first = a.first_token_t is None
+            for tok in emitted:
+                a.out.append(tok)
+                a.token_times.append(t_end)
+            if first:
+                # full-hit admissions reach their first token through a
+                # decode/verify pass, exactly like _run_decode
+                a.first_token_t = t_end
+                if tr is not None:
+                    tr.emit("i", "first_token", d1,
+                            track=self._req_track(a.req.rid),
+                            args={"rid": a.req.rid, "t": t_end})
+            if len(a.out) >= a.req.max_new:
+                self._complete(a, t_end, rep)
+            else:
+                a.pending_tok = emitted[-1]
+                # rollback: pages past the new frontier (rejected-draft
+                # territory) return to the pool — the partial sibling of
+                # eviction's free_request; their stale K/V is never
+                # attended (mask) and re-writes overwrite it. Bounded
+                # below by pre_pages: only pages _plan_drafts added are
+                # ever released, so a policy that reserves ahead (static's
+                # worst-case admission grant) keeps its reservation
+                keep = max(self._pages_for(a.decode_pos + 1), pre_pages)
+                if a.n_pages > keep:
+                    extra = [int(s)
+                             for s in self.table[a.row, keep:a.n_pages]]
+                    self.allocator.release(a.req.rid, extra)
+                    self.table[a.row, keep:a.n_pages] = 0
+                    a.n_pages = keep
 
     def _run_prefill_chunk(self, a: _Active, C: int, t_end: float,
                            rep: StepReport) -> None:
@@ -917,6 +1150,7 @@ class ServeEngine:
         rep.decode_rows = len(decode_set)
         self.stats["decode_calls"] += 1
         self.stats["decode_row_slots"] += len(decode_set)
+        self.stats["decode_tokens"] += len(decode_set)
         for a in decode_set:
             tok = self._emit_token(nxt[a.row], a.req.rid, len(a.out))
             a.out.append(tok)
@@ -967,9 +1201,28 @@ class ServeEngine:
         slots = s.pop("decode_row_slots")
         frag_sum, frag_n = s.pop("frag_sum"), s.pop("frag_samples")
         s["decode_calls"] = calls
+        # verify passes fill batch rows exactly like decode passes — the
+        # utilization denominator counts both
+        passes = calls + s["spec_passes"]
         s["decode_batch_util"] = (
-            slots / (calls * self.cfg.max_batch) if calls else 0.0)
+            slots / (passes * self.cfg.max_batch) if passes else 0.0)
         s["mean_page_fragmentation"] = frag_sum / frag_n if frag_n else 0.0
+        # HBM accounting: peak_occupancy * pool_bytes = peak cache bytes.
+        # bytes_per_page is K/V PAYLOAD per slot summed over layers (the
+        # int8 scale sidecar — 8 B/position/layer — is excluded so the
+        # dtype capacity ratios are exact; documented in ARCHITECTURE.md)
+        s["bytes_per_page"] = self.bytes_per_page
+        s["pool_bytes"] = self.bytes_per_page * self.cfg.pool_pages
+        # speculative-decoding headline rates (0-guarded; spec-off runs
+        # report accept_rate 0 and tokens_per_pass exactly 1.0).
+        # tokens_per_pass is PER ROW-pass — tokens a request gains per
+        # decode/verify slot it occupies — so it isolates the speculative
+        # multiplier from batch-width effects (1 + mean accepted drafts)
+        s["spec_accept_rate"] = (
+            s["spec_accepted"] / s["spec_drafted"]
+            if s["spec_drafted"] else 0.0)
+        s["tokens_per_pass"] = (
+            s["decode_tokens"] / slots if slots else 0.0)
         return s
 
     def snapshot(self) -> Dict[str, Any]:
@@ -1163,6 +1416,21 @@ class ReplicatedServer:
             e.stats["peak_occupancy"] for e in fleet)
         sums["shared_pages"] = max(
             e.stats["shared_pages"] for e in fleet)
+        # per-slot layout is identical across the fleet (one model/config);
+        # pool_bytes is the LIVE fleet's total cache HBM — a drained
+        # (retired) engine's pool is released with it, so summing the
+        # whole fleet would over-report capacity after every scale-down
+        sums["bytes_per_page"] = fleet[0].bytes_per_page
+        sums["pool_bytes"] = sum(
+            e.bytes_per_page * e.cfg.pool_pages for e in self.engines)
+        # rates re-derive from the summed counters (a mean of per-replica
+        # ratios would weight an idle replica like a loaded one)
+        row_passes = sum(e.stats["decode_row_slots"] for e in fleet)
+        sums["spec_accept_rate"] = (
+            sums["spec_accepted"] / sums["spec_drafted"]
+            if sums["spec_drafted"] else 0.0)
+        sums["tokens_per_pass"] = (
+            sums["decode_tokens"] / row_passes if row_passes else 0.0)
         return sums
 
 

@@ -120,11 +120,16 @@ def collect_requests(trace: Any) -> Dict[Any, Dict[str, Any]]:
             r["queue"].append((t0, t1))
         elif name == "prefill_chunk":
             r["prefill"].append((t0, t1))
-        elif name == "decode":
+        elif name in ("decode", "verify"):
+            # a speculative verify span IS the request's decode time for
+            # that pass; it may emit several tokens at once (args.emitted)
+            # — all stamped at the pass end, matching the engine's
+            # token_times
             r["decode"].append((t0, t1))
             tok = args.get("tok")
             if tok is not None:
-                r["tok_end"][int(tok)] = t1  # last emission wins (replays)
+                for i in range(int(args.get("emitted", 1))):
+                    r["tok_end"][int(tok) + i] = t1  # last emission wins
         elif name == "first_token":
             r["first_token"] = t0  # last wins across recompute replays
             r["tok_end"][0] = t0

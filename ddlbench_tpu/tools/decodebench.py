@@ -69,6 +69,12 @@ def main(argv=None) -> int:
     p.add_argument("--chunk-dh", type=int, default=64)
     p.add_argument("--chunk-page-size", type=int, default=64,
                    help="positions per page for the chunk rows")
+    p.add_argument("--kv-dtype", default=None,
+                   help="comma list among float32,bfloat16,int8: serving-"
+                        "pool dtype sweep rows — paged flash-decode and "
+                        "chunk-prefill attention, Pallas fused-dequant "
+                        "kernel vs XLA reference per dtype (kernel rows "
+                        "skipped-with-provenance off-TPU)")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -198,6 +204,8 @@ def main(argv=None) -> int:
 
     if args.chunk_prefill:
         _chunk_prefill_rows(args, prov)
+    if args.kv_dtype:
+        _kv_dtype_rows(args, prov)
     return 0
 
 
@@ -272,6 +280,102 @@ def _chunk_prefill_rows(args, prov) -> None:
                     **base,
                     "tokens_per_sec": round(C / dt, 2),
                     "us_per_chunk": round(1e6 * dt, 2),
+                }), flush=True)
+
+
+def _kv_dtype_rows(args, prov) -> None:
+    """KV-pool dtype sweep for the serving attention hot path: one row per
+    (dtype, op in {decode, chunk}, variant in {kernel, xla}) over a
+    synthetic shuffled-free-list pool at the ``--chunk-*`` shapes. The
+    int8 pool is built through the real write primitive
+    (paged_table_chunk_write — per-page scale sidecar + stochastic
+    rounding), so the kernel rows measure the FUSED-dequant read path the
+    serving engine compiles, not a hand-rolled stand-in. Kernel rows off
+    TPU record skipped-with-provenance, the same contract as every other
+    decodebench row."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ddlbench_tpu.distributed import is_tpu_backend
+    from ddlbench_tpu.ops.paged_decode import (paged_attention,
+                                               paged_chunk_attention,
+                                               paged_table_chunk_write,
+                                               serve_pool_init)
+
+    H, dh, page = args.chunk_heads, args.chunk_dh, args.chunk_page_size
+    C = int(args.chunk_sizes.split(",")[0])
+    npl = max(int(x) for x in args.chunk_pages.split(","))
+    dtypes = [s.strip() for s in args.kv_dtype.split(",") if s.strip()]
+    for name in dtypes:
+        if name not in ("float32", "bfloat16", "int8"):
+            print(json.dumps({"tool": "decodebench", "variant": "kv-dtype",
+                              "kv_dtype": name,
+                              "error": "unknown dtype (float32|bfloat16|"
+                                       "int8)", **prov}), flush=True)
+            continue
+        dt = jnp.dtype(name)
+        pool_pages = npl + 2  # slot 0 scratch + headroom
+        perm = np.random.default_rng(0).permutation(
+            np.arange(1, pool_pages))[:npl]
+        table = jnp.asarray(perm[None, :], jnp.int32)
+        pool = serve_pool_init(pool_pages, page, H, dh, dt)
+        cache = {**pool, "table": table}
+        # fill the live pages through the real page-aligned write path
+        kk = jax.random.normal(jax.random.key(20), (1, npl * page, H, dh),
+                               jnp.float32)
+        vv = jax.random.normal(jax.random.key(21), (1, npl * page, H, dh),
+                               jnp.float32)
+        cache = jax.jit(lambda c, k, v: paged_table_chunk_write(
+            c, k, v, jnp.int32(0), page))(cache, kk, vv)
+        q1 = jax.random.normal(jax.random.key(22), (1, H, dh), jnp.float32)
+        qC = jax.random.normal(jax.random.key(23), (1, H, C, dh),
+                               jnp.float32)
+        pos = jnp.asarray([npl * page - 1], jnp.int32)
+        start = jnp.asarray([(npl - 1) * page], jnp.int32)
+        ops = [
+            ("decode", 1, lambda uk: paged_attention(
+                q1, cache, pos, npl, page=page, use_kernel=uk,
+                kernel_style=args.paged_kernel)),
+            ("chunk", C, lambda uk: paged_chunk_attention(
+                qC, cache, start, npl, page=page, use_kernel=uk,
+                kernel_style=args.paged_kernel)),
+        ]
+        for op_name, toks, fn0 in ops:
+            for variant, use_kernel in (("kernel", True), ("xla", False)):
+                base = {"tool": "decodebench", "variant": "kv-dtype",
+                        "op": op_name, "kv_dtype": name,
+                        "kernel": use_kernel, "chunk": C, "pages": npl,
+                        "page": page, "heads": H, "dh": dh, **prov}
+                if use_kernel and not is_tpu_backend():
+                    print(json.dumps({
+                        **base,
+                        "skipped": "Pallas fused-dequant kernel needs a "
+                                   "TPU backend (XLA row is the CPU "
+                                   "path)"}), flush=True)
+                    continue
+                fn = jax.jit(lambda uk=use_kernel, f=fn0: f(uk))
+                out = [None]
+
+                def run():
+                    out[0] = fn()
+
+                def sync():
+                    float(jnp.sum(out[0]))
+
+                try:
+                    dt_s = _bench(run, sync, args.repeats)
+                except Exception as e:  # Mosaic shape rejection etc.
+                    print(json.dumps({
+                        **base,
+                        "error": f"{type(e).__name__}: "
+                                 f"{str(e).splitlines()[0][:200]}",
+                    }), flush=True)
+                    continue
+                print(json.dumps({
+                    **base,
+                    "tokens_per_sec": round(toks / dt_s, 2),
+                    "us_per_call": round(1e6 * dt_s, 2),
                 }), flush=True)
 
 

@@ -28,7 +28,16 @@ Usage:
         [--requests 64] [--max-batch 8] [--pool-pages 64] [--page 16]
         [--max-len 256] [--slo-ttft 16] [--slo-itl 2.0]
         [--shared-prefix 4:64] [--prefix-cache]
-        [--sample temperature:0.8,top-k:40] [--platform cpu]
+        [--sample temperature:0.8,top-k:40] [--kv-dtype int8]
+        [--speculative ngram:3:4] [--platform cpu]
+
+Raw-speed levers (ISSUE 13): ``--kv-dtype`` stores the shared KV pool in
+bf16 (half the f32 bytes) or int8 (a quarter — quantize-at-write with
+per-page scales, dequant fused in-kernel; the row's ``pool_bytes`` makes
+the capacity claim a number), and ``--speculative ngram:N:K`` turns the
+decode step into a drafted verify pass (token streams bitwise identical
+to greedy; ``spec_accept_rate``/``tokens_per_pass`` report whether the
+traffic's self-similarity paid for it).
 
 The prefix-cache A/B: ``--shared-prefix G:P`` synthesizes G groups of
 requests sharing a P-token prompt head, and ``--prefix-cache`` lets the
@@ -54,6 +63,14 @@ import argparse
 import json
 import sys
 import time
+
+
+# engine stats keys that only carry signal under --speculative: excluded
+# from plain rows so the schema-pinned key set is unchanged when the flag
+# is off (the --resize pattern)
+_SPEC_FIELDS = frozenset((
+    "spec_passes", "spec_drafted", "spec_accepted", "decode_tokens",
+    "spec_accept_rate", "tokens_per_pass"))
 
 
 def _round6(v):
@@ -175,6 +192,22 @@ def main(argv=None) -> int:
                         "pages and prefill only the tail; the static "
                         "baseline always runs cache-off and reports the "
                         "cache counters as 0)")
+    p.add_argument("--kv-dtype", default=None,
+                   choices=("float32", "bfloat16", "int8"),
+                   help="KV-pool storage dtype: bfloat16 halves pool "
+                        "bytes, int8 quarters them (pages quantize at the "
+                        "write boundary with per-page scales + stochastic "
+                        "rounding; dequant fused into the attention "
+                        "kernels). The row gains a kv_dtype field; "
+                        "default float32 keeps the pinned schema")
+    p.add_argument("--speculative", default=None, metavar="ngram:N:K",
+                   help="self-drafting speculative decoding: an N-gram "
+                        "drafter proposes up to K tokens per decode row "
+                        "from the row's own stream, verified in one "
+                        "K+1-wide pass priced as ONE model pass; greedy "
+                        "acceptance keeps token streams bitwise identical "
+                        "to non-speculative. The row gains speculative/"
+                        "spec_*/tokens_per_pass fields")
     p.add_argument("--sample", default=None, metavar="temperature:T[,top-k:K]",
                    help="sample instead of greedy argmax: softmax(logits/T)"
                         " with optional top-k restriction, counter-based "
@@ -294,7 +327,9 @@ def main(argv=None) -> int:
                        else args.prefill_chunk),
         replicas=args.replicas, temperature=temperature, top_k=top_k,
         sample_seed=args.seed, trace=bool(args.trace),
-        slo_ttft=args.slo_ttft, slo_itl=args.slo_itl)
+        slo_ttft=args.slo_ttft, slo_itl=args.slo_itl,
+        kv_dtype=args.kv_dtype or "float32",
+        speculative=args.speculative or "none")
 
     shared_fns = None
     for policy in policies:
@@ -408,7 +443,16 @@ def main(argv=None) -> int:
                    slo_ttft=args.slo_ttft, slo_itl=args.slo_itl).items()},
             **{k: (round(v, 6) if isinstance(v, float) else v)
                for k, v in server.stats_summary().items()
-               if k != "completed"},  # serve_summary already reports it
+               # serve_summary already reports completed; the speculative
+               # fields are flag-gated (all zero when spec is off) so a
+               # plain row keeps the schema-pinned key set
+               if k != "completed" and (args.speculative
+                                        or k not in _SPEC_FIELDS)},
+            # --kv-dtype / --speculative only (plain rows keep the
+            # schema-pinned key set): the A/B axis made explicit
+            **({"kv_dtype": cfg.kv_dtype} if args.kv_dtype else {}),
+            **({"speculative": cfg.speculative}
+               if args.speculative else {}),
             # --timeline only: windowed SLO/goodput series + TTFT/ITL
             # component breakdowns (absent otherwise so a plain row stays
             # bitwise identical traced or untraced)

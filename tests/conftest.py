@@ -78,8 +78,9 @@ def train_factory():
 @pytest.fixture(scope="session")
 def serve_factory():
     """Session-shared serving fixture (tier-1 budget, ROADMAP item 5):
-    ONE tiny LM plus a jitted-callable cache keyed by (page, sampling) —
-    the only two things the engine's traced programs close over — so
+    ONE tiny LM plus a jitted-callable cache keyed by (page, sampling,
+    kv_dtype, speculative) — the things the engine's traced programs
+    close over — so
     every serve test that builds an engine at the same page size reuses
     the compiled decode/prefill/COW programs instead of re-tracing them
     per test (``shared_fns``, the same mechanism servebench's policy rows
@@ -100,7 +101,11 @@ def serve_factory():
     def make(cfg, *, server=False, **kw):
         from ddlbench_tpu.serve.engine import ServeEngine, make_server
 
-        key = (cfg.page, cfg.temperature > 0.0)
+        # kv_dtype changes the pool layout every program closes over, and
+        # the speculative draft width K sets the verify program's span
+        # shape — both belong in the shared-callable key
+        key = (cfg.page, cfg.temperature > 0.0, cfg.kv_dtype,
+               cfg.speculative)
         shared = fns.get(key)
         if server:
             out = make_server(model, params, state, cfg,

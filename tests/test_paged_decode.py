@@ -242,6 +242,168 @@ def test_serve_page_copy():
                                       np.asarray(pool[key][keep]))
 
 
+# ---------------------------------------------------------------------------
+# Quantized (int8) serving pool: write-boundary quantization, the span
+# write, and the fused-dequant kernels (ISSUE 13).
+# ---------------------------------------------------------------------------
+
+
+def _quant_cache(rows=2, npl=3, fill=True, seed=70):
+    """An int8 serving pool + shuffled table, filled through the REAL
+    page-aligned chunk-write path (per-page scale sidecar + stochastic
+    rounding) so every pin below reads the layout the engine produces."""
+    from ddlbench_tpu.ops.paged_decode import (paged_table_chunk_write,
+                                               serve_pool_init)
+
+    pool = serve_pool_init(16, PAGE, H, DH, jnp.int8)
+    pool["kv_seed"] = jnp.int32(1)
+    rng = np.random.default_rng(seed)
+    slots = rng.permutation(np.arange(1, 16))[: rows * npl]
+    cache = {**pool, "table": jnp.asarray(slots.reshape(rows, npl),
+                                          jnp.int32)}
+    k = v = None
+    if fill:
+        k = _rand(seed + 1, rows, npl * PAGE, H, DH)
+        v = _rand(seed + 2, rows, npl * PAGE, H, DH)
+        cache = paged_table_chunk_write(cache, k, v, jnp.int32(0), PAGE)
+    return cache, k, v
+
+
+def _dequant_rows(cache, npl):
+    """Densify an int8 pool through the table + scale sidecar."""
+    rows = cache["table"].shape[0]
+    out = []
+    for name in ("pool_k", "pool_v"):
+        pages = np.asarray(cache[name], np.float32)[
+            np.asarray(cache["table"])]
+        scale = np.asarray(cache["scale_" + name[-1]])[
+            np.asarray(cache["table"])]
+        out.append((pages * scale[..., None, None])
+                   .reshape(rows, npl * PAGE, H, DH))
+    return out
+
+
+def test_quantized_chunk_write_roundtrip_and_determinism():
+    """int8 page writes: dequantized error bounded by one scale step per
+    element (absmax/127 — ~1%), an all-zero position stays exactly zero,
+    and the identical write replays bitwise (counter-based seeds)."""
+    cache, k, v = _quant_cache()
+    kd, vd = _dequant_rows(cache, 3)
+    for got, ref in ((kd, k), (vd, v)):
+        ref = np.asarray(ref)
+        step = np.max(np.abs(ref), axis=(2, 3), keepdims=True) / 127.0
+        assert np.max(np.abs(got - ref) / np.maximum(step, 1e-9)) <= 1.0 + 1e-5
+    again, _, _ = _quant_cache()
+    for key in ("pool_k", "pool_v", "scale_k", "scale_v"):
+        np.testing.assert_array_equal(np.asarray(cache[key]),
+                                      np.asarray(again[key]))
+
+
+def test_quantized_span_write_matches_chunk_and_single_writes():
+    """The three write paths agree byte-for-byte where their domains
+    overlap: a page-aligned span write equals the chunk write, and an
+    UNALIGNED span write equals the equivalent sequence of single-token
+    writes — quantized bytes are a pure function of (values, position),
+    never of which program wrote them."""
+    from ddlbench_tpu.ops.paged_decode import (paged_table_span_write,
+                                               paged_table_write)
+
+    chunked, k, v = _quant_cache(seed=75)
+    aligned, _, _ = _quant_cache(seed=75, fill=False)
+    aligned = paged_table_span_write(
+        aligned, k, v, jnp.zeros((2,), jnp.int32), PAGE)
+    for key in ("pool_k", "pool_v", "scale_k", "scale_v"):
+        np.testing.assert_array_equal(np.asarray(chunked[key]),
+                                      np.asarray(aligned[key]))
+    # unaligned span [5, 8) == single-token writes at 5, 6, 7
+    spanned, _, _ = _quant_cache(seed=75)
+    spanned = paged_table_span_write(
+        spanned, k[:, 5:8], v[:, 5:8],
+        jnp.full((2,), 5, jnp.int32), PAGE)
+    single, _, _ = _quant_cache(seed=75)
+    for t in range(5, 8):
+        single = paged_table_write(single, k[:, t:t + 1], v[:, t:t + 1],
+                                   jnp.full((2,), t, jnp.int32), PAGE)
+    for key in ("pool_k", "pool_v", "scale_k", "scale_v"):
+        np.testing.assert_array_equal(np.asarray(spanned[key]),
+                                      np.asarray(single[key]))
+
+
+def test_span_write_f32_and_overflow_to_scratch():
+    """The span write on an UNQUANTIZED pool: values land verbatim at
+    (page, offset) through the table, and positions past the table's
+    columns resolve to the scratch slot (the padded-draft-tail contract,
+    mirroring the chunk write's scratch extension)."""
+    from ddlbench_tpu.ops.paged_decode import (paged_table_span_write,
+                                               serve_pool_init)
+
+    pool = serve_pool_init(8, PAGE, H, DH, jnp.float32)
+    table = jnp.asarray([[3, 5]], jnp.int32)  # 2 pages -> capacity 8
+    cache = {**pool, "table": table}
+    W = 4
+    k = _rand(80, 1, W, H, DH)
+    v = _rand(81, 1, W, H, DH)
+    # start at 6: positions 6, 7 live in page 1; 8, 9 overflow the table
+    out = paged_table_span_write(cache, k, v,
+                                 jnp.asarray([6], jnp.int32), PAGE)
+    pk = np.asarray(out["pool_k"])
+    np.testing.assert_array_equal(pk[5, 2], np.asarray(k)[0, 0])
+    np.testing.assert_array_equal(pk[5, 3], np.asarray(k)[0, 1])
+    # overflow went to scratch (slot 0), not into a live page
+    np.testing.assert_array_equal(pk[3], np.zeros((PAGE, H, DH)))
+    assert np.any(np.asarray(out["pool_k"])[0] != 0)
+
+
+@pytest.mark.parametrize("style", ["dots", "elementwise"])
+def test_quantized_flash_decode_kernel_matches_ref(style):
+    """Fused-dequant flash-decode kernel (interpret mode) vs the XLA
+    reference on an int8 pool, both math formulations, within the
+    existing flash-decode tolerance."""
+    cache, _, _ = _quant_cache(seed=85)
+    q = _rand(86, 2, H, DH)
+    pos = jnp.asarray([11, 7], jnp.int32)
+    ref = _paged_attention_ref(q, cache, pos, 3, page=PAGE)
+    out = paged_attention(q, cache, pos, 3, page=PAGE, interpret=True,
+                          use_kernel=True, kernel_style=style)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+@pytest.mark.parametrize("style", ["dots", "elementwise"])
+def test_quantized_chunk_kernel_matches_ref(style):
+    """Fused-dequant chunk-prefill kernel vs the XLA reference on an int8
+    pool at per-row starts (the speculative verify read path)."""
+    from ddlbench_tpu.ops.paged_decode import (_paged_chunk_attention_ref,
+                                               paged_chunk_attention)
+
+    cache, _, _ = _quant_cache(seed=90)
+    C = 4
+    q = _rand(91, 2, H, C, DH)
+    starts = jnp.asarray([4, 7], jnp.int32)
+    ref = _paged_chunk_attention_ref(q, cache, starts, 3, page=PAGE)
+    out = paged_chunk_attention(q, cache, starts, 3, page=PAGE,
+                                interpret=True, use_kernel=True,
+                                kernel_style=style)
+    np.testing.assert_allclose(np.asarray(out), np.asarray(ref),
+                               rtol=1e-5, atol=1e-5)
+
+
+def test_quantized_page_copy_carries_scales():
+    """serve_page_copy on a quantized pool: payload AND scale sidecar
+    rows copy verbatim (a COW'd page dequantizes bit-identically), and
+    the scalar kv_seed passes through untouched."""
+    from ddlbench_tpu.ops.paged_decode import serve_page_copy
+
+    cache, _, _ = _quant_cache(seed=95)
+    pool = {k2: v2 for k2, v2 in cache.items() if k2 != "table"}
+    src = int(np.asarray(cache["table"])[0, 1])
+    out = jax.jit(serve_page_copy)(pool, jnp.int32(src), jnp.int32(15))
+    for key in ("pool_k", "pool_v", "scale_k", "scale_v"):
+        np.testing.assert_array_equal(np.asarray(out[key][15]),
+                                      np.asarray(pool[key][src]))
+    assert int(out["kv_seed"]) == int(pool["kv_seed"])
+
+
 def test_cow_reorder_matches_physical_gather():
     """Random beam-parent chains: after every reorder+write, the table view
     must equal a physically gathered dense cache."""

@@ -1,0 +1,227 @@
+"""Quantized KV-cache pages (ServeConfig.kv_dtype) — engine-level pins.
+
+Two contracts. CAPACITY: ``pool_bytes``/``bytes_per_page`` are exact
+dtype ratios — bf16 is half of f32 and int8 half of bf16 (quarter of
+f32), which is the "double the concurrent requests per chip at equal
+HBM" claim as a reported number. QUALITY: the accparity-style digits
+gate — greedy token streams at bf16/int8 against the f32 streams on the
+pinned fixtures, with the divergence budget recorded here (bf16/int8 KV
+perturbs logits, so argmax MAY flip; what must hold exactly is
+self-consistency: quantized runs are bitwise-reproducible, recompute
+replays them, COW/prefix-bind copies scales with pages).
+
+Ops-level pins (write/dequant roundtrip, fused-dequant kernels vs the
+XLA reference, span-vs-chunk byte identity) live in test_paged_decode.py.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve.workload import ServeRequest  # noqa: E402
+
+VOCAB = TINY_LM.num_classes
+
+_CFG = dict(max_batch=2, pool_pages=17, page=4, max_len=16,
+            prefill_chunk=4)
+
+# the digits gate: minimum positional token agreement vs the f32 stream
+# on the pinned fixture (recorded budget — a quality regression must
+# trip HERE, not in a dashboard). bf16 KV rounds half the mantissa,
+# int8 adds ~1% stochastic rounding noise; on the tiny fixture both
+# stay argmax-stable in practice, but the gate budgets real headroom.
+DIGITS_GATE = {"bfloat16": 0.9, "int8": 0.75}
+
+
+def _drain(eng, reqs, now=0.0):
+    for r in reqs:
+        eng.submit(r)
+    while eng.has_work():
+        rep = eng.step(now)
+        now += rep.cost
+    return now
+
+
+def _tokens(eng):
+    return {f["rid"]: list(f["tokens"]) for f in eng.finished}
+
+
+def _reqs(prompts, max_new):
+    return [ServeRequest(rid=i, prompt=np.asarray(p, np.int32),
+                         max_new=max_new, arrival=0.0)
+            for i, p in enumerate(prompts)]
+
+
+def _run(serve_factory, cfg_kw, prompts, max_new):
+    eng = serve_factory(ServeConfig(**cfg_kw))
+    _drain(eng, _reqs(prompts, max_new))
+    return eng
+
+
+@pytest.fixture(scope="module")
+def dtype_runs(serve_factory):
+    """One fixture workload through all three pool dtypes (module-scoped:
+    every pin below reads these engines)."""
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, VOCAB, size=(6,)),
+               rng.integers(0, VOCAB, size=(4,))]
+    return {dt: _run(serve_factory, dict(_CFG, kv_dtype=dt), prompts, 8)
+            for dt in ("float32", "bfloat16", "int8")}
+
+
+def test_pool_bytes_exact_dtype_ratios(dtype_runs):
+    """The capacity claim as a number: int8 pool bytes are exactly half
+    of bf16 and a quarter of f32 (payload accounting; the int8 scale
+    sidecar is metadata, excluded and documented)."""
+    s = {dt: e.stats_summary() for dt, e in dtype_runs.items()}
+    for key in ("pool_bytes", "bytes_per_page"):
+        f32, bf16, i8 = (s[d][key] for d in ("float32", "bfloat16",
+                                             "int8"))
+        assert bf16 * 2 == f32
+        assert i8 * 2 == bf16
+        assert i8 * 4 == f32
+        assert i8 > 0
+    # and the keys are present on every row, quantized or not (schema)
+    assert {"pool_bytes", "bytes_per_page"} <= set(s["float32"])
+
+
+def test_digits_gate_quantized_streams(dtype_runs):
+    """The quality gate: quantized greedy streams track the f32 streams
+    positionwise within the recorded budget, at identical lengths (the
+    engine's scheduling — completions, counts — is dtype-independent)."""
+    base = _tokens(dtype_runs["float32"])
+    for dt, gate in DIGITS_GATE.items():
+        qt = _tokens(dtype_runs[dt])
+        assert set(qt) == set(base)
+        total = agree = 0
+        for rid in base:
+            assert len(qt[rid]) == len(base[rid])
+            total += len(base[rid])
+            agree += sum(a == b for a, b in zip(base[rid], qt[rid]))
+        assert agree / total >= gate, (
+            f"{dt} digits gate: {agree}/{total} tokens match f32, "
+            f"budget {gate}")
+
+
+def test_int8_is_bitwise_reproducible(serve_factory, dtype_runs):
+    """Stochastic rounding is counter-seeded, not wall-clock-seeded: the
+    identical int8 run replays bitwise."""
+    rng = np.random.default_rng(41)
+    prompts = [rng.integers(0, VOCAB, size=(6,)),
+               rng.integers(0, VOCAB, size=(4,))]
+    again = _run(serve_factory, dict(_CFG, kv_dtype="int8"), prompts, 8)
+    assert _tokens(again) == _tokens(dtype_runs["int8"])
+
+
+def test_int8_eviction_recompute_bitwise(serve_factory):
+    """Eviction/recompute on a quantized pool: position-keyed rounding
+    seeds regenerate the identical quantized pages, so the recomputed
+    stream is bitwise the uninterrupted one."""
+    rng = np.random.default_rng(42)
+    prompts = [rng.integers(0, VOCAB, size=(4,)),
+               rng.integers(0, VOCAB, size=(4,))]
+    big = _run(serve_factory, dict(_CFG, kv_dtype="int8"), prompts, 9)
+    small = _run(serve_factory,
+                 dict(_CFG, kv_dtype="int8", pool_pages=6), prompts, 9)
+    assert small.stats["evicted"] >= 1  # the fixture really evicts
+    assert _tokens(small) == _tokens(big)
+    assert small.allocator.in_use == 0
+
+
+def test_int8_prefix_cache_cow_and_bind(serve_factory):
+    """Prefix caching composes with quantized pages for free: the scale
+    sidecar travels with bound pages and the COW copy, so cache-on
+    streams equal cache-off streams AT int8, identical prompts emit
+    identical streams through the shared/COW pages, and the hit/copy
+    counters fire exactly as at f32."""
+    rng = np.random.default_rng(43)
+    head = rng.integers(0, VOCAB, size=(4,)).astype(np.int32)  # one page
+    tail = rng.integers(0, VOCAB, size=(2,)).astype(np.int32)
+    prompts = [head.copy(), np.concatenate([head, tail]), head.copy()]
+    runs = {}
+    for cache_on in (True, False):
+        eng = serve_factory(ServeConfig(**dict(
+            _CFG, pool_pages=13, kv_dtype="int8",
+            prefix_cache=cache_on)))
+        for rid, pr in enumerate(prompts):
+            # sequential so A's page registers before B/C admit
+            eng.submit(ServeRequest(rid=rid, prompt=pr, max_new=2,
+                                    arrival=0.0))
+            _drain(eng, [])
+        runs[cache_on] = eng
+    assert _tokens(runs[True]) == _tokens(runs[False])
+    on = runs[True].stats
+    assert on["prefix_hits"] == 2  # B partial, C full
+    assert on["cow_copies"] == 1  # C's decode-entry COW
+    toks = _tokens(runs[True])
+    assert toks[0] == toks[2]  # identical prompts, identical streams
+
+
+@pytest.mark.slow
+def test_int8_cow_sibling_divergence(serve_factory):
+    """The COW-divergence pin re-run at int8: two concurrent full-hit
+    siblings of the same prompt decode through PRIVATE copies of the
+    last cached page (quantized payload + scales copied verbatim) and
+    their streams match each other and the cache-off streams — sibling
+    streams never couple through a shared quantized page."""
+    rng = np.random.default_rng(44)
+    prefix = rng.integers(0, VOCAB, size=(8,)).astype(np.int32)  # 2 pages
+    kw = dict(max_batch=2, pool_pages=17, page=4, max_len=24,
+              prefill_chunk=4, kv_dtype="int8")
+    warm = serve_factory(ServeConfig(**kw, prefix_cache=True))
+    _drain(warm, _reqs([prefix], 3))  # register the prompt pages
+    # two siblings admitted together, both full hits on the cached pages
+    sib = [ServeRequest(rid=10, prompt=prefix.copy(), max_new=3,
+                        arrival=0.0),
+           ServeRequest(rid=11, prompt=prefix.copy(), max_new=3,
+                        arrival=0.0)]
+    for r in sib:
+        warm.submit(r)
+    _drain(warm, [])
+    toks = _tokens(warm)
+    assert warm.stats["cow_copies"] >= 2
+    assert toks[10] == toks[11] == toks[0]
+    off = serve_factory(ServeConfig(**kw))
+    _drain(off, _reqs([prefix], 3))
+    assert toks[10] == _tokens(off)[0]
+
+
+@pytest.mark.slow
+def test_servebench_kv_dtype_field_flag_gated():
+    """--kv-dtype stamps the row; plain rows carry no kv_dtype key but
+    DO always carry pool_bytes/bytes_per_page (the schema satellite)."""
+    import contextlib
+    import io
+    import json
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servebench
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    args = ["-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+            "--concurrency", "2", "--requests", "4", "--max-batch", "2",
+            "--pool-pages", "9", "--page", "4", "--max-len", "16",
+            "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+            "--seed", "5", "--platform", "cpu",
+            "--policies", "continuous"]
+
+    def run(extra):
+        buf = io.StringIO()
+        with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+                contextlib.redirect_stdout(buf):
+            assert servebench.main(args + extra) == 0
+        return [json.loads(l) for l in buf.getvalue().splitlines()
+                if l.startswith("{")]
+
+    plain = run([])[0]
+    i8 = run(["--kv-dtype", "int8"])[0]
+    assert "kv_dtype" not in plain
+    assert i8["kv_dtype"] == "int8"
+    assert i8["pool_bytes"] * 4 == plain["pool_bytes"]
+    assert i8["completed"] == plain["completed"]

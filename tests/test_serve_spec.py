@@ -1,0 +1,403 @@
+"""Self-drafting speculative decoding (serve/draft.py + engine verify).
+
+The binding contract is the acceptance pin: with greedy acceptance, the
+speculative engine's token streams are BITWISE identical to the
+non-speculative engine's — no matter what the drafter proposes, through
+eviction/recompute, and composed with the prefix cache. Speculation may
+only change WHEN tokens arrive (tokens per pass), never WHICH tokens.
+
+The n-gram drafter itself is pure host code (tier-1 unit pins); the
+engine pins ride the session ``serve_factory`` shapes (page 4,
+max_len 16/24) so the non-spec programs reuse the session compiles and
+only the K-wide verify variants are new.
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.serve
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve.draft import NgramDrafter  # noqa: E402
+from ddlbench_tpu.serve.workload import ServeRequest  # noqa: E402
+
+VOCAB = TINY_LM.num_classes
+
+
+def _drain(eng, reqs, now=0.0):
+    for r in reqs:
+        eng.submit(r)
+    while eng.has_work():
+        rep = eng.step(now)
+        now += rep.cost
+    return now
+
+
+def _tokens(eng):
+    return {f["rid"]: list(f["tokens"]) for f in eng.finished}
+
+
+def _reqs(prompts, max_new):
+    return [ServeRequest(rid=i, prompt=np.asarray(p, np.int32),
+                         max_new=max_new, arrival=0.0)
+            for i, p in enumerate(prompts)]
+
+
+class _ScriptedDrafter:
+    """Test drafter proposing from a fixed per-request oracle stream (the
+    single-request case: the context's prompt prefix identifies the
+    stream). ``offset`` shifts proposals off the true stream to exercise
+    rejection."""
+
+    def __init__(self, prompt, stream, k, offset=0):
+        self.prompt = list(int(t) for t in prompt)
+        self.stream = list(stream)
+        self.k = k
+        self.offset = offset
+        self.contexts = []
+
+    def propose(self, context, k_max=None):
+        self.contexts.append(list(context))
+        done = len(context) - len(self.prompt)
+        k = self.k if k_max is None else min(self.k, k_max)
+        out = self.stream[done:done + k]
+        if self.offset:
+            out = [(t + self.offset) % VOCAB for t in out]
+        return out
+
+
+# ---------------------------------------------------------------------------
+# N-gram drafter unit pins (pure host code).
+# ---------------------------------------------------------------------------
+
+
+def test_drafter_proposes_recent_continuation():
+    d = NgramDrafter(2, 3)
+    # trailing (7, 8) recurred at positions 1-2; continuation 9, 1, 7
+    assert d.propose([5, 7, 8, 9, 1, 7, 8]) == [9, 1, 7]
+    # most RECENT prior occurrence wins: (1, 2) appears twice, the later
+    # one continues with 5
+    assert d.propose([1, 2, 3, 1, 2, 5, 9, 1, 2]) == [5, 9, 1]
+
+
+def test_drafter_truncation_and_misses():
+    d = NgramDrafter(2, 4)
+    # continuation truncated by history end
+    assert d.propose([7, 8, 9, 7, 8]) == [9, 7, 8]
+    # k_max truncates further
+    assert d.propose([7, 8, 9, 7, 8], k_max=1) == [9]
+    assert d.propose([7, 8, 9, 7, 8], k_max=0) == []
+    # no recurrence / too-short context
+    assert d.propose([1, 2, 3, 4, 5]) == []
+    assert d.propose([1, 2]) == []
+    assert d.propose([]) == []
+
+
+def test_drafter_periodic_overlap_and_determinism():
+    d = NgramDrafter(2, 3)
+    ctx = [4, 4, 4, 4, 4]  # overlapping matches are legitimate
+    assert d.propose(ctx) == [4, 4, 4]
+    assert d.propose(ctx) == d.propose(ctx)  # no RNG anywhere
+    with pytest.raises(ValueError):
+        NgramDrafter(0, 3)
+    with pytest.raises(ValueError):
+        NgramDrafter(2, 0)
+
+
+def test_spec_config_validation():
+    ServeConfig(speculative="ngram:2:3").validate()
+    for bad in ("ngram:2", "foo:2:3", "ngram:a:3", "ngram:0:3",
+                "ngram:2:0"):
+        with pytest.raises(ValueError):
+            ServeConfig(speculative=bad).validate()
+    with pytest.raises(ValueError, match="greedy-only"):
+        ServeConfig(speculative="ngram:2:3", temperature=0.8).validate()
+
+
+# ---------------------------------------------------------------------------
+# Engine: the bitwise acceptance pin + the speculative mechanics.
+# ---------------------------------------------------------------------------
+
+_CFG = dict(max_batch=2, pool_pages=17, page=4, max_len=16,
+            prefill_chunk=4)
+
+
+def _streams(serve_factory, cfg_kw, prompts, max_new, drafter=None):
+    eng = serve_factory(ServeConfig(**cfg_kw))
+    if drafter is not None:
+        eng._drafter = drafter
+    _drain(eng, _reqs(prompts, max_new))
+    return eng
+
+
+def test_spec_streams_bitwise_with_real_drafter(serve_factory):
+    """The acceptance pin at its weakest drafter: whatever the n-gram
+    proposer does (including proposing nothing), spec-on streams equal
+    spec-off streams exactly."""
+    rng = np.random.default_rng(31)
+    prompts = [rng.integers(0, VOCAB, size=(6,)), np.tile(
+        rng.integers(0, VOCAB, size=(3,)), 3)]  # one periodic prompt
+    base = _streams(serve_factory, _CFG, prompts, 6)
+    spec = _streams(serve_factory, dict(_CFG, speculative="ngram:2:3"),
+                    prompts, 6)
+    assert _tokens(spec) == _tokens(base)
+    s = spec.stats_summary()
+    # no drafts accepted -> exactly one token per row-pass, like base
+    assert s["tokens_per_pass"] >= 1.0
+    assert base.stats_summary()["tokens_per_pass"] == 1.0
+    assert base.stats_summary()["spec_passes"] == 0
+
+
+def test_spec_oracle_drafter_accepts_and_saves_passes(serve_factory):
+    """A perfect drafter: acceptance rate 1.0, tokens-per-pass > 1, and
+    strictly fewer model passes — with the stream still bitwise."""
+    rng = np.random.default_rng(32)
+    prompt = rng.integers(0, VOCAB, size=(4,))
+    base = _streams(serve_factory, _CFG, [prompt], 10)
+    stream = _tokens(base)[0]
+    oracle = _ScriptedDrafter(prompt, stream, k=3)
+    spec = _streams(serve_factory, dict(_CFG, speculative="ngram:2:3"),
+                    [prompt], 10, drafter=oracle)
+    assert _tokens(spec) == {0: stream}
+    s, b = spec.stats_summary(), base.stats_summary()
+    assert s["spec_drafted"] > 0
+    assert s["spec_accept_rate"] == 1.0
+    assert s["tokens_per_pass"] > 1.0
+    assert s["model_calls"] < b["model_calls"]
+    # the virtual clock advanced less: same tokens, fewer passes
+    assert spec.finished[0]["completed_t"] < base.finished[0]["completed_t"]
+
+
+def test_spec_wrong_drafter_rejects_without_corruption(serve_factory):
+    """An adversarial drafter (every proposal off by one): zero
+    acceptance, zero extra model passes vs non-spec (a verify pass costs
+    ONE pass and still emits its guaranteed token), bitwise stream, and
+    the rejected-draft pages roll back (no leak: the pool drains empty)."""
+    rng = np.random.default_rng(33)
+    prompt = rng.integers(0, VOCAB, size=(4,))
+    base = _streams(serve_factory, _CFG, [prompt], 10)
+    stream = _tokens(base)[0]
+    wrong = _ScriptedDrafter(prompt, stream, k=3, offset=1)
+    spec = _streams(serve_factory, dict(_CFG, speculative="ngram:2:3"),
+                    [prompt], 10, drafter=wrong)
+    assert _tokens(spec) == {0: stream}
+    s = spec.stats_summary()
+    assert s["spec_drafted"] > 0 and s["spec_accepted"] == 0
+    assert s["spec_accept_rate"] == 0.0 and s["tokens_per_pass"] == 1.0
+    assert s["model_calls"] == base.stats_summary()["model_calls"]
+    assert spec.allocator.in_use == 0  # rollback + completion freed all
+
+
+def test_spec_drafter_reads_only_completed_streams(serve_factory):
+    """The drafter is consulted only for fully-prefilled rows and sees
+    exactly prompt + emitted tokens (never a partial prefill, never
+    another row's stream)."""
+    rng = np.random.default_rng(34)
+    prompt = rng.integers(0, VOCAB, size=(10,))  # 3 chunks of 4
+    base = _streams(serve_factory, _CFG, [prompt], 5)
+    stream = _tokens(base)[0]
+    rec = _ScriptedDrafter(prompt, stream, k=2)
+    _streams(serve_factory, dict(_CFG, speculative="ngram:2:2"),
+             [prompt], 5, drafter=rec)
+    assert rec.contexts  # drafting did happen
+    p = [int(t) for t in prompt]
+    for ctx in rec.contexts:
+        assert ctx[:len(p)] == p  # the row's own stream, from its start
+        assert len(ctx) > len(p)  # prefill complete + >= 1 emitted token
+
+
+def test_spec_eviction_mid_draft_recomputes_bitwise(serve_factory):
+    """Pool pressure mid-speculation: the newest request is evicted while
+    drafts are in flight (an all-rejected drafter keeps the spec pacing
+    identical to non-spec, so the same collision occurs); the recompute
+    (and the survivor) still emit the non-speculative streams bitwise,
+    and nothing leaks or double-frees."""
+    rng = np.random.default_rng(35)
+    prompts = [rng.integers(0, VOCAB, size=(4,)),
+               rng.integers(0, VOCAB, size=(4,))]
+    big = dict(_CFG, pool_pages=17)
+    small = dict(_CFG, pool_pages=6)  # 5 usable: two 3-page rows collide
+    base = _streams(serve_factory, big, prompts, 9)
+    streams = _tokens(base)
+    base_small = _streams(serve_factory, small, prompts, 9)
+    assert base_small.stats["evicted"] >= 1  # the fixture really collides
+    assert _tokens(base_small) == streams
+    wrong = _ScriptedDrafter(prompts[0], streams[0], k=3, offset=1)
+    spec = _streams(serve_factory, dict(small, speculative="ngram:2:3"),
+                    prompts, 9, drafter=wrong)
+    assert spec.stats["evicted"] >= 1  # the pressure survived speculation
+    assert spec.stats_summary()["spec_drafted"] > 0  # drafts were in flight
+    assert _tokens(spec) == streams
+    assert spec.allocator.in_use == 0
+
+
+def test_spec_trace_emits_draft_verify_accept(serve_factory):
+    """cfg.trace: speculative steps land draft/verify/accept events on
+    the request's track (metrics stay bitwise — the scheduler never reads
+    the tracer)."""
+    from ddlbench_tpu.telemetry.tracer import Tracer, get_tracer, set_tracer
+
+    rng = np.random.default_rng(36)
+    prompt = rng.integers(0, VOCAB, size=(4,))
+    base = _streams(serve_factory, _CFG, [prompt], 8)
+    stream = _tokens(base)[0]
+    prev = get_tracer()
+    tracer = set_tracer(Tracer(10_000)).enable()
+    try:
+        oracle = _ScriptedDrafter(prompt, stream, k=3)
+        spec = _streams(serve_factory,
+                        dict(_CFG, speculative="ngram:2:3", trace=True),
+                        [prompt], 8, drafter=oracle)
+    finally:
+        tracer.disable()
+        set_tracer(prev)
+    assert _tokens(spec) == {0: stream}
+    names = {e[1] for e in tracer.events()}
+    assert {"draft", "verify", "accept"} <= names
+    accepts = [e for e in tracer.events() if e[1] == "accept"]
+    assert sum(e[6]["accepted"] for e in accepts) \
+        == spec.stats["spec_accepted"]
+
+
+def test_spec_static_policy_keeps_reservation(serve_factory):
+    """Review hardening: the static baseline reserves its worst-case
+    pages at admission and never allocates (or evicts) again; the
+    speculative rollback must only return pages the draft planner itself
+    added, so every active row keeps its full reservation through every
+    verify pass (a released reservation would let queued admissions
+    steal it, re-introducing eviction into the no-realloc baseline)."""
+    rng = np.random.default_rng(38)
+    prompts = [rng.integers(0, VOCAB, size=(4,)),
+               rng.integers(0, VOCAB, size=(4,))]
+    kw = dict(_CFG, pool_pages=7, policy="static")
+    base = _streams(serve_factory, kw, prompts, 9)
+    streams = _tokens(base)
+    wrong = _ScriptedDrafter(prompts[0], streams[0], k=3, offset=1)
+    eng = serve_factory(ServeConfig(**dict(kw, speculative="ngram:2:3")))
+    eng._drafter = wrong
+    for r in _reqs(prompts, 9):
+        eng.submit(r)
+    full = eng._pages_for(4 + 9 - 1)  # the static worst-case grant
+    now = 0.0
+    while eng.has_work():
+        now += eng.step(now).cost
+        for a in eng.rows:
+            if a is not None and a.prefill_done >= 4:
+                assert a.n_pages == full, "rollback shrank the reservation"
+    assert _tokens(eng) == streams
+    assert eng.stats["evicted"] == 0
+    assert eng.stats_summary()["spec_drafted"] > 0
+
+
+def test_spec_draft_shortfall_truncates_without_prefix_reclaim(
+        serve_factory):
+    """Review hardening: opportunistic draft headroom comes straight off
+    the free list — a shortfall truncates the drafts rather than
+    reclaiming (deregistering) cached prefix pages, so speculation can
+    never spend a hot shared-prefix page on K/V it is likely to roll
+    back the same step."""
+    rng = np.random.default_rng(39)
+    head = rng.integers(0, VOCAB, size=(8,)).astype(np.int32)  # 2 blocks
+    bprompt = rng.integers(0, VOCAB, size=(4,))
+    kw = dict(_CFG, prefix_cache=True)
+    base = _streams(serve_factory, kw, [bprompt], 8)
+    bstream = _tokens(base)[0]
+    eng = serve_factory(ServeConfig(**dict(kw, speculative="ngram:2:3")))
+    eng._drafter = _ScriptedDrafter(bprompt, bstream, k=3, offset=1)
+    _drain(eng, [ServeRequest(rid=0, prompt=head, max_new=1,
+                              arrival=0.0)])  # registers 2 cached blocks
+    eng.submit(ServeRequest(rid=1, prompt=np.asarray(bprompt, np.int32),
+                            max_new=8, arrival=0.0))
+    # step rid 1 to a mid-page decode position, then seize the whole free
+    # list: its next draft wants a page beyond n_pages with free == 0
+    now = 0.0
+    while True:
+        now += eng.step(now).cost
+        a = next((r for r in eng.rows
+                  if r is not None and r.req.rid == 1), None)
+        assert a is not None, "rid 1 finished before the shortfall window"
+        if a.decode_pos == 5:
+            break
+    eng.allocator.alloc(999, eng.allocator.free_pages)
+    now += eng.step(now).cost  # drafting hits the empty free list here
+    assert eng.stats_summary()["spec_drafted"] > 0
+    eng.allocator.free_request(999)
+    while eng.has_work():
+        now += eng.step(now).cost
+    assert _tokens(eng)[1] == bstream  # truncation never costs tokens
+    # the cached head must still be FULLY resident: a follow-up request
+    # with the same prompt takes the full-hit path, saving S-1 = 7
+    # tokens (position S-1 re-derives through the COW'd decode entry); a
+    # reclaim would have dropped the newest block, leaving a 4-token
+    # partial hit
+    eng.submit(ServeRequest(rid=2, prompt=head, max_new=1, arrival=now))
+    while eng.has_work():
+        now += eng.step(now).cost
+    assert eng.stats["prefix_tokens_saved"] == 7
+
+
+@pytest.mark.slow
+def test_spec_composes_with_prefix_cache(serve_factory):
+    """Prefix cache + speculation together: shared-prefix siblings bind
+    cached pages AND speculate; streams equal the plain engine's."""
+    rng = np.random.default_rng(37)
+    head = rng.integers(0, VOCAB, size=(8,)).astype(np.int32)
+    prompts = [head.copy(),
+               np.concatenate([head, rng.integers(0, VOCAB, size=(2,))
+                               .astype(np.int32)]),
+               head.copy()]
+    kw = dict(max_batch=2, pool_pages=17, page=4, max_len=24,
+              prefill_chunk=4)
+    base_eng = serve_factory(ServeConfig(**kw))
+    _drain(base_eng, _reqs(prompts, 3))
+    both = serve_factory(ServeConfig(**kw, prefix_cache=True,
+                                     speculative="ngram:2:2"))
+    _drain(both, _reqs(prompts, 3))
+    assert _tokens(both) == _tokens(base_eng)
+    assert both.stats["prefix_hits"] >= 1  # the cache really engaged
+
+
+@pytest.mark.slow
+def test_servebench_speculative_fields_flag_gated(tmp_path):
+    """--speculative adds speculative/spec_*/tokens_per_pass to the row;
+    a plain row carries none of them (the 56-key schema pin's
+    counterpart lives in test_serve_trace.py)."""
+    import contextlib
+    import io
+    import json
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servebench
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    args = ["-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+            "--concurrency", "2", "--requests", "4", "--max-batch", "2",
+            "--pool-pages", "9", "--page", "4", "--max-len", "16",
+            "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+            "--seed", "5", "--platform", "cpu",
+            "--policies", "continuous"]
+
+    def run(extra):
+        buf = io.StringIO()
+        with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+                contextlib.redirect_stdout(buf):
+            assert servebench.main(args + extra) == 0
+        return [json.loads(l) for l in buf.getvalue().splitlines()
+                if l.startswith("{")]
+
+    plain = run([])[0]
+    spec = run(["--speculative", "ngram:2:2"])[0]
+    spec_keys = {"speculative", "spec_passes", "spec_drafted",
+                 "spec_accepted", "spec_accept_rate", "tokens_per_pass",
+                 "decode_tokens"}
+    assert not (spec_keys & set(plain))
+    assert spec_keys <= set(spec)
+    assert spec["speculative"] == "ngram:2:2"
+    # greedy acceptance: the streams (and so the token counts) are the
+    # non-speculative ones
+    assert spec["output_tokens"] == plain["output_tokens"]
+    assert spec["completed"] == plain["completed"]

@@ -464,11 +464,15 @@ PLAIN_ROW_KEYS = {
     "throughput_tokens_per_unit", "goodput_tokens_per_unit",
     "slo_attainment", "prefix_cached_tokens", "ttft_p50", "ttft_p95",
     "ttft_p99", "itl_p50", "itl_p95", "itl_p99", "slo_ttft", "slo_itl",
-    # engine stats_summary
+    # engine stats_summary (pool_bytes/bytes_per_page: the ISSUE-13 HBM
+    # accounting, always present so peak_occupancy converts to bytes; the
+    # spec_*/tokens_per_pass fields are flag-gated behind --speculative
+    # and must NOT appear here)
     "steps", "model_calls", "prefill_calls", "admitted", "evicted",
     "backpressure", "peak_occupancy", "prefix_hits",
     "prefix_tokens_saved", "cow_copies", "shared_pages", "prefill_tokens",
     "decode_calls", "decode_batch_util", "mean_page_fragmentation",
+    "pool_bytes", "bytes_per_page",
     # backend provenance
     "jax_backend", "jax_device_count", "cpu_requested", "cpu_fallback",
 }
