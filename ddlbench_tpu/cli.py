@@ -19,6 +19,7 @@ import sys
 from ddlbench_tpu.config import (
     ATTENTION_BACKENDS,
     DATASETS,
+    HardwareModel,
     RunConfig,
     STRATEGIES,
 )
@@ -157,6 +158,26 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--jsonl", default=None, help="also write structured metrics JSONL here")
     p.add_argument("--auto-partition", action="store_true",
                    help="profile + hierarchical partitioner choose stage bounds")
+    p.add_argument("--plan", default="manual", choices=("manual", "auto"),
+                   help="auto = solve the FULL dp/pp/tp mix + stage split "
+                        "+ schedule from the profile under the per-chip "
+                        "HBM cap (partition/planner.py) and run the "
+                        "winner on the existing engines (dp ZeRO-1, "
+                        "gpipe/pipeline_rt with --dp-shard-update, tp); "
+                        "pass -f gpipe and leave the mix flags unset — "
+                        "the decision (all candidates, predicted step "
+                        "time, peak bytes/chip, why the winner won) is "
+                        "recorded in partition.json")
+    p.add_argument("--plan-bounds", default=None, metavar="0,K,...,L",
+                   help="explicit per-stage layer bounds for the pipeline "
+                        "strategies (stages x virtual-stages + 1 comma "
+                        "ints from 0) — execute exactly the split a "
+                        "--plan auto run chose")
+    p.add_argument("--hbm-gb", type=float, default=None, metavar="G",
+                   help="per-chip HBM budget in GiB for the planner / "
+                        "auto-partition feasibility gates (default: the "
+                        "HardwareModel's 16 GiB v5e constant) — a tight "
+                        "cap provably flips --plan auto toward pp>1")
     p.add_argument("--profile-mode", default="flops", choices=("flops", "time"))
     p.add_argument("--trace-dir", default=None,
                    help="write a jax.profiler trace of the run here")
@@ -320,7 +341,12 @@ def config_from_args(args) -> RunConfig:
         grad_spike_factor=args.grad_spike_factor,
         hang_timeout_s=args.hang_timeout_s,
         auto_partition=args.auto_partition,
+        plan=args.plan,
+        plan_bounds=(tuple(int(b) for b in args.plan_bounds.split(","))
+                     if args.plan_bounds else None),
         profile_mode=args.profile_mode,
+        hardware=(HardwareModel(hbm_bytes=args.hbm_gb * 1024**3)
+                  if args.hbm_gb is not None else HardwareModel()),
         trace=args.trace,
         trace_capacity=args.trace_capacity,
         trace_dir=args.trace_dir,

@@ -306,6 +306,21 @@ class RunConfig:
     # (reference: the whole PipeDream phase 1-3 pipeline).
     auto_partition: bool = False
     profile_mode: str = "flops"  # "flops" (device-free) | "time" (measured)
+    # `--plan auto` (partition/planner.py): solve the FULL dp/pp/tp mix +
+    # stage split + schedule from the profile under the per-chip HBM cap,
+    # then rewrite this config onto the winning engines (dp ZeRO-1,
+    # gpipe/pipeline_rt with --dp-shard-update, tp) before anything runs.
+    # Resolved at run start (train/loop.py / parallel/api.py) via
+    # planner.resolve_auto_plan; the pre-plan config must leave every
+    # mix-shaping flag at its default — the planner owns them. "manual"
+    # (default) = the flags mean what they say.
+    plan: str = "manual"
+    # Explicit per-chunk stage bounds over the model's layer chain for the
+    # pipeline strategies (len = stages * virtual_stages + 1, starting at
+    # 0) — how a solved plan's split reaches the engine, and settable
+    # directly (--plan-bounds) so an explicitly-flagged run can execute
+    # the exact same split a --plan auto run chose (the bitwise pin).
+    plan_bounds: Optional[Tuple[int, ...]] = None
 
     # MoE (transformer_moe_* archs): Switch router load-balance loss weight
     # and static per-expert capacity = ceil(cf * tokens / experts).
@@ -574,6 +589,10 @@ class RunConfig:
             b = self.batch_size or DEFAULT_BATCH[key][self.benchmark]
             return int(b), 1
         if self.strategy == "gpipe":
+            if self.micro_batch_size and self.num_microbatches:
+                # fully explicit grammar: the default matrix is not
+                # consulted (benchmarks outside it work with both flags)
+                return int(self.micro_batch_size), int(self.num_microbatches)
             mb, chunks = DEFAULT_BATCH["gpipe"][self.benchmark]
             mb = self.micro_batch_size or mb
             if self.num_microbatches:
@@ -882,6 +901,57 @@ class RunConfig:
                     "dp_shard_update on gpipe needs the uniform 2-D mesh; "
                     "stage_replication (hetero pipeline) keeps the "
                     "replicated update")
+        if self.plan not in ("manual", "auto"):
+            raise ValueError(
+                f"unknown plan mode {self.plan!r} (choose manual or auto)")
+        if self.plan == "auto":
+            if self.strategy != "gpipe":
+                raise ValueError(
+                    "--plan auto solves the dp/pp/tp mix from the gpipe "
+                    "batch grammar (micro-batch x microbatches = the "
+                    "global batch the plan preserves); pass -f gpipe — "
+                    "the winner may rewrite the strategy to dp/tp/single")
+            if self.auto_partition:
+                raise ValueError(
+                    "--plan auto supersedes --auto-partition (it solves "
+                    "the stage split AND the mix); drop one")
+            owned = (
+                ("--stages", self.num_stages, None),
+                ("--dp-replicas", self.dp_replicas, 1),
+                ("--tp-size", self.tp_size, 1),
+                ("--stage-replication", self.stage_replication, None),
+                ("--virtual-stages", self.virtual_stages, 1),
+                ("--pipe-schedule", self.pipe_schedule, "fill-drain"),
+                ("--pipe-costs", self.pipe_costs, "unit"),
+                ("pipe_cost_vectors", self.pipe_cost_vectors, None),
+                ("--plan-bounds", self.plan_bounds, None),
+                ("--dp-shard-update", self.dp_shard_update, False),
+                ("--update-interval", self.update_interval, 1),
+            )
+            clash = [name for name, val, dflt in owned if val != dflt]
+            if clash:
+                raise ValueError(
+                    f"--plan auto owns the parallelism mix; leave "
+                    f"{', '.join(clash)} unset (the planner chooses and "
+                    f"records them in partition.json)")
+        if self.plan_bounds is not None:
+            if self.strategy not in ("gpipe", "pipedream"):
+                raise ValueError(
+                    "plan_bounds (explicit stage bounds) applies to the "
+                    "pipeline strategies")
+            if self.auto_partition:
+                raise ValueError(
+                    "--auto-partition solves the stage bounds; "
+                    "--plan-bounds pins them — pick one")
+            pb = tuple(int(x) for x in self.plan_bounds)
+            chunks_n = self.resolved_stages() * max(1, self.virtual_stages)
+            if len(pb) != chunks_n + 1:
+                raise ValueError(
+                    f"plan_bounds needs stages x virtual_stages + 1 = "
+                    f"{chunks_n + 1} entries; got {len(pb)}")
+            if pb[0] != 0 or any(a >= b for a, b in zip(pb, pb[1:])):
+                raise ValueError(
+                    f"plan_bounds must strictly increase from 0; got {pb}")
         if self.pipe_costs not in ("unit", "profile"):
             raise ValueError(
                 f"unknown pipe_costs {self.pipe_costs!r} (choose unit or "

@@ -68,11 +68,31 @@ def _load_plan(cfg: RunConfig, key: dict):
         print(f"auto-partition: ignoring unreadable plan {path} ({e}); "
               f"re-profiling", flush=True)
         return None, False
-    if plan.get("key") != key:
+    pkey = plan.get("key")
+    if _stale_pre_plan_key(pkey, key):
+        # migration shim: a stale pre-plan-mode partition.json (written
+        # before _plan_key carried the "plan" field) that otherwise
+        # matches this run must invalidate LOUDLY and re-solve — never
+        # KeyError on the missing field, and never count as a foreign
+        # config (keep_existing stays False so the re-solve overwrites it)
+        print(f"auto-partition: persisted plan {path} predates the "
+              f"--plan mode field; invalidating (re-profiling and "
+              f"re-writing)", flush=True)
+        return None, False
+    if pkey != key:
         print(f"auto-partition: persisted plan {path} was computed for "
               f"{plan.get('key')}, run is {key}; re-profiling (the "
               f"existing plan file is kept)", flush=True)
         return None, True
+    if plan.get("fingerprint") != _plan_fingerprint(cfg):
+        # same run identity but the COST MODEL changed (--hbm-gb /
+        # --profile-mode): the persisted bounds were solved under other
+        # feasibility gates — re-profile in place (missing fingerprint =
+        # a pre-fingerprint file, invalidated the same way)
+        print(f"auto-partition: persisted plan {path} was solved under a "
+              f"different cost model ({plan.get('fingerprint')}); "
+              f"re-profiling and re-writing", flush=True)
+        return None, False
     return plan, False
 
 
@@ -91,7 +111,69 @@ def _plan_key(cfg: RunConfig) -> dict:
             "num_hosts": cfg.num_hosts, "micro_batch_size": mb,
             "num_microbatches": chunks, "virtual_stages": cfg.virtual_stages,
             "pipe_schedule": cfg.pipe_schedule,
-            "pipe_costs": cfg.pipe_costs}
+            "pipe_costs": cfg.pipe_costs,
+            # the plan MODE is part of the identity: an --auto-partition
+            # bounds plan and a --plan auto full-mix plan live in the same
+            # file but mean different things (pre-plan-mode files are
+            # invalidated loudly by the migration shim in _load_plan /
+            # planner._load_cached, never KeyError'd)
+            "plan": cfg.plan}
+
+
+def _plan_fingerprint(cfg: RunConfig) -> dict:
+    """The cost-model half of a persisted plan's identity: the key names
+    WHAT was planned (model, topology, batch grammar, plan mode); a plan
+    additionally depends on HOW costs and feasibility were priced, so the
+    fingerprint pins the profile mode and the hardware constants
+    (--hbm-gb rides cfg.hardware). Shared by the --auto-partition bounds
+    plan here and the --plan auto record (partition/planner.py)."""
+    import dataclasses
+
+    return {"profile_mode": cfg.profile_mode,
+            "hardware": dataclasses.asdict(cfg.hardware)}
+
+
+def _stale_pre_plan_key(old_key, key: dict) -> bool:
+    """The migration shim's ONE match rule: ``old_key`` predates the
+    plan-mode field (no "plan" entry) but otherwise names exactly this
+    run's configuration — whatever mode is now looking at it. Shared by
+    the loader and writer here; planner._load_cached deliberately uses a
+    BROADER rule (any pre-plan-mode file invalidates a --plan auto read,
+    matching or not, since the old schema carries no plan_auto record)."""
+    return (isinstance(old_key, dict) and "plan" not in old_key
+            and {**old_key, "plan": key.get("plan")} == key)
+
+
+def _backup_foreign_plan(path: str, key: dict) -> None:
+    """A fresh (non-resume) run pointed at a checkpoint_dir holding a
+    DIFFERENT configuration's plan — e.g. a flag typo — must not silently
+    clobber it next to that run's checkpoints (ADVICE r3): keep a backup.
+    Shared by the --auto-partition bounds writer below and the --plan auto
+    full-mix writer (partition/planner.py). A stale pre-plan-mode file of
+    the SAME configuration is not foreign — the migration shim already
+    invalidated it, so the re-solve overwrites in place."""
+    if not os.path.exists(path):
+        return
+    try:
+        with open(path) as f:
+            old_key = json.load(f).get("key")
+    except (json.JSONDecodeError, OSError):
+        old_key = None
+    if _stale_pre_plan_key(old_key, key):
+        # pre-plan-mode file of this very config (whichever mode is now
+        # re-solving it): the migration shim already invalidated it
+        # loudly, so the re-solve overwrites in place
+        return
+    if old_key != key:
+        bak = path + ".bak"
+        n = 1
+        while os.path.exists(bak):  # never clobber an earlier backup
+            bak = f"{path}.bak{n}"
+            n += 1
+        os.replace(path, bak)
+        print(f"auto-partition: existing plan {path} belongs to a "
+              f"different configuration ({old_key}); backed up to {bak}",
+              flush=True)
 
 
 def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
@@ -99,28 +181,11 @@ def _save_plan(key: dict, cfg: RunConfig, graph_bounds) -> None:
     if path is None:
         return
     os.makedirs(cfg.checkpoint_dir, exist_ok=True)
-    # A fresh (non-resume) run pointed at a checkpoint_dir holding a
-    # DIFFERENT configuration's plan — e.g. a flag typo — must not silently
-    # clobber it next to that run's checkpoints (ADVICE r3): keep a backup.
-    if os.path.exists(path):
-        try:
-            with open(path) as f:
-                old_key = json.load(f).get("key")
-        except (json.JSONDecodeError, OSError):
-            old_key = None
-        if old_key != key:
-            bak = path + ".bak"
-            n = 1
-            while os.path.exists(bak):  # never clobber an earlier backup
-                bak = f"{path}.bak{n}"
-                n += 1
-            os.replace(path, bak)
-            print(f"auto-partition: existing plan {path} belongs to a "
-                  f"different configuration ({old_key}); backed up to {bak}",
-                  flush=True)
+    _backup_foreign_plan(path, key)
     repl = cfg.stage_replication
     payload = {
         "key": key,
+        "fingerprint": _plan_fingerprint(cfg),
         "graph_bounds": [int(b) for b in graph_bounds],
         "num_stages": cfg.num_stages,
         "dp_replicas": cfg.dp_replicas,
@@ -183,6 +248,13 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
     the caller) — with --auto-partition it becomes the profile graph's Input
     node, folded into layer 0's stage for the partitioning DP
     (profiler.fold_input_node; train/loop.py supplies it for the -s path)."""
+    if cfg.plan == "auto":
+        # normally already resolved at run start (train/loop.py), where the
+        # rewritten strategy also shapes the data stream and lr scaling;
+        # direct callers (tools, tests) get the same rewrite here
+        from ddlbench_tpu.partition.planner import resolve_auto_plan
+
+        cfg = resolve_auto_plan(cfg, input_time_ms=input_time_ms)
     cfg.validate()
     from ddlbench_tpu.models.transformer import set_attention_backend
 
@@ -407,6 +479,19 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                   f"({basis} bubble "
                   f"{best.get('bubble_measured', best['bubble'])})"
                   f"{tail}: {sched}", flush=True)
+    if stage_bounds is None and cfg.plan_bounds is not None and \
+            cfg.strategy in ("gpipe", "pipedream"):
+        # Explicit stage bounds (--plan-bounds, or a solved --plan auto
+        # rewrite): the engine executes exactly this split instead of its
+        # balanced default — the end of the profile -> graph -> plan loop.
+        # config.validate could not know the layer count; check it here
+        # (a named error, not the engine's bare assert)
+        if cfg.plan_bounds[-1] != len(model.layers):
+            raise ValueError(
+                f"--plan-bounds {list(cfg.plan_bounds)} must end at the "
+                f"model's layer count ({cfg.arch} has "
+                f"{len(model.layers)} layers)")
+        stage_bounds = [int(b) for b in cfg.plan_bounds]
     if (stage_bounds is None and cfg.strategy in ("gpipe", "pipedream")):
         # Manual (non-auto-partition) pipeline run on a branchy arch: the
         # articulation chain is hopeless to balance (nasnet's whole cell

@@ -1,6 +1,7 @@
 from ddlbench_tpu.partition.optimizer import (
     PartitionResult,
     StagePlan,
+    capped_balanced_split,
     partition_hierarchical,
     stage_bounds_from_graph,
 )
@@ -8,6 +9,7 @@ from ddlbench_tpu.partition.optimizer import (
 __all__ = [
     "PartitionResult",
     "StagePlan",
+    "capped_balanced_split",
     "partition_hierarchical",
     "stage_bounds_from_graph",
 ]

@@ -374,6 +374,53 @@ def partition_interleaved(
     return best
 
 
+def capped_balanced_split(n: int, num_stages: int, span_cost, edge_cost,
+                          span_ok) -> Optional[List[int]]:
+    """Contiguous split of nodes [0, n) into EXACTLY ``num_stages`` spans
+    minimizing the bottleneck ``max(span costs, cut-edge costs)`` subject to
+    a per-span feasibility predicate (the memory cap).
+
+    This is the fixed-replication specialization of :class:`_LevelDP`'s
+    recurrence — replication is decided OUTSIDE (the --plan auto solver
+    enumerates uniform (pp, dp, tp) factorizations, so every stage runs the
+    same unit count) which collapses the unit dimension and leaves the
+    classic O(n^2 * stages) min-max chain partition:
+
+        A[j][k] = min over i of max(A[i][k-1], edge_cost(i), span_cost(i, j))
+                  where span_ok(i, j)
+
+    ``span_cost(i, j)``/``span_ok(i, j)`` see the half-open node span
+    [i, j); ``edge_cost(i)`` prices the cut before node i. Returns the
+    ``num_stages + 1`` bounds, or None when no feasible split exists (some
+    span every split must contain violates ``span_ok``)."""
+    if num_stages < 1 or num_stages > n:
+        return None
+    A = [[INF] * (num_stages + 1) for _ in range(n + 1)]
+    choice = [[-1] * (num_stages + 1) for _ in range(n + 1)]
+    A[0][0] = 0.0
+    for k in range(1, num_stages + 1):
+        for j in range(k, n - (num_stages - k) + 1):
+            best, arg = INF, -1
+            for i in range(k - 1, j):
+                prev = A[i][k - 1]
+                if prev == INF or not span_ok(i, j):
+                    continue
+                t = max(prev, span_cost(i, j),
+                        edge_cost(i) if i > 0 else 0.0)
+                if t < best:
+                    best, arg = t, i
+            A[j][k], choice[j][k] = best, arg
+    if A[n][num_stages] == INF:
+        return None
+    bounds = [n]
+    j, k = n, num_stages
+    while k > 0:
+        j = choice[j][k]
+        bounds.append(j)
+        k -= 1
+    return bounds[::-1]
+
+
 def stage_bounds_from_graph(graph: Graph, num_stages: int) -> List[int]:
     """Uniform-mesh helper: contiguous min-max split of measured per-node
     times into num_stages (the profiled replacement for torchgpipe's

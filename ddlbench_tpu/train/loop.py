@@ -74,6 +74,36 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
                   warmup_steps: int = 1) -> Dict[str, Any]:
     """Run the full 3-epoch benchmark protocol; returns the summary dict."""
     cfg.validate()
+    if cfg.plan == "auto" and strategy is None:
+        # --plan auto resolves BEFORE anything reads the config: the
+        # rewritten strategy shapes the data stream's global batch, the
+        # lr world-scaling, and the checkpoint metadata exactly as the
+        # explicitly-flagged equivalent run would (the bitwise contract).
+        from ddlbench_tpu.partition.planner import resolve_auto_plan
+
+        def _probe_input_ms(cfg=cfg):
+            # real data: price the host loader into the solve exactly as
+            # --auto-partition prices it into stage 0 (fold_input_node).
+            # A throwaway probe stream keeps the real one unconsumed; the
+            # pre-plan global batch equals the post-plan one (the rewrite
+            # preserves it), so the per-microbatch scaling is exact. Only
+            # evaluated on a plan-cache MISS (resolve_auto_plan).
+            from ddlbench_tpu.profiler.profile import measure_input_ms
+
+            probe = _make_data(cfg)
+            try:
+                global_ms = measure_input_ms(probe)
+            finally:
+                getattr(probe, "close", lambda: None)()
+            mb_pre, _ = cfg.resolved_batches()
+            ms = global_ms * mb_pre / cfg.global_batch()
+            print(f"plan auto: measured input cost "
+                  f"{global_ms:.2f} ms/global-batch "
+                  f"({ms:.3f} ms/microbatch)", flush=True)
+            return ms
+
+        cfg = resolve_auto_plan(
+            cfg, input_time_ms=0.0 if cfg.synthetic else _probe_input_ms)
     data = _make_data(cfg)
     if strategy is None:
         input_ms = 0.0

@@ -142,8 +142,12 @@ def compare(saved: Optional[Dict[str, Any]], cur: Dict[str, Any],
             f"checkpoint stage split S={saved['stages']} V={saved['vstages']}"
             f" != current S={cur['stages']} V={cur['vstages']}: a changed "
             f"stage count is a re-planning problem, not a permutation — "
-            f"re-plan via --auto-partition at the new topology and restart "
-            f"(elastic resume covers the 'data'-axis world only)")
+            f"with --plan auto the resume re-plans automatically (the "
+            f"planner pins the stage count to the checkpoint's and "
+            f"re-solves dp for the new world, partition/planner.py); "
+            f"otherwise re-plan via --auto-partition at the new topology "
+            f"and restart (elastic resume covers the 'data'-axis world "
+            f"only)")
     if kind != "replicated" and saved.get("length") != cur.get("length"):
         raise CheckpointShapeError(
             f"checkpoint packed length {saved.get('length')} != current "

@@ -18,7 +18,11 @@ from ddlbench_tpu.profiler.actlog import ActivationLogger
 
 @pytest.fixture(scope="module")
 def small_model():
-    model = get_model("resnet18", "mnist")
+    # lenet, not resnet18: the npz-layout and forward/suffix-grad pins
+    # compare the logger against the model's OWN forward/grad, so they
+    # are arch-independent — the resnet compile cost ~14 s of tier-1
+    # wall (ROADMAP item 5)
+    model = get_model("lenet", "mnist")
     params, state, _ = init_model(model, jax.random.key(0))
     x = jax.random.normal(jax.random.key(1), (4, 28, 28, 1), jnp.float32)
     y = jnp.array([0, 1, 2, 3], jnp.int32)

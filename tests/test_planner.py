@@ -1,0 +1,512 @@
+"""`--plan auto` solver + cache + end-to-end pins (partition/planner.py).
+
+Solver unit pins run on hand-computable synthetic graphs (no devices);
+the end-to-end pins hold the planner to its contract: the resolved config
+EQUALS the explicitly-flagged equivalent mix, and the executed trajectory
+is bitwise-identical to it.
+"""
+
+import dataclasses
+import json
+import os
+
+import numpy as np
+import pytest
+
+from ddlbench_tpu.config import HardwareModel, RunConfig
+from ddlbench_tpu.graph.graph import Graph, Node
+from ddlbench_tpu.partition.optimizer import capped_balanced_split
+from ddlbench_tpu.partition.planner import (Candidate, _rewrite_fields,
+                                            resolve_auto_plan, solve_plan)
+
+pytestmark = pytest.mark.planner
+
+
+def chain_graph(times, params=None, acts=None):
+    """times are per-node fwd+bwd ms, split 1/3 : 2/3 like the profiler."""
+    params = params or [0.0] * len(times)
+    acts = acts or [0.0] * len(times)
+    nodes = [
+        Node(str(i), f"layer{i}", forward_compute_time=t / 3.0,
+             backward_compute_time=2.0 * t / 3.0, activation_size=a,
+             parameter_size=p)
+        for i, (t, p, a) in enumerate(zip(times, params, acts))
+    ]
+    return Graph.chain(nodes)
+
+
+# ---- solver unit pins ------------------------------------------------------
+
+
+def test_solver_prefers_dp_when_light():
+    """4 equal light layers on 4 chips: pure dp has no bubble and near-free
+    allreduce — hand check: step = M * (f+b)/dp = 8 * 12 / 4 = 24 ms plus
+    a sub-0.01 ms ring term."""
+    g = chain_graph([3.0] * 4, params=[1e4] * 4, acts=[1e5] * 4)
+    plan = solve_plan(g, 4, 8, 8)
+    w = plan.winner
+    assert (w.pp, w.dp, w.tp) == (1, 4, 1)
+    assert w.step_time_ms == pytest.approx(24.0, abs=0.1)
+    assert w.feasible and w.bounds == (0, 4)
+    # every enumerated mix is in the record, schedules included
+    mixes = {(c.pp, c.dp, c.tp, c.schedule) for c in plan.candidates}
+    assert (4, 1, 1, "zero-bubble") in mixes
+    assert (2, 2, 1, "1f1b") in mixes
+    assert "ms/step" in plan.reason
+
+
+def test_memory_cap_flips_mix():
+    """THE acceptance pin: a tight HBM cap provably flips the chosen mix.
+    4e7 param bytes total: pure dp wins with room (ring ~1.3 ms < the
+    ~3 ms pipeline bubble), but one chip must hold weights + grads +
+    sharded opt = 2.25 x 4e7 = 9e7 bytes, so a 6e7 cap kills every pp=1
+    candidate and a pipeline split (params spread across stages) wins."""
+    times, params, acts = [3.0] * 4, [1e7] * 4, [1e5] * 4
+    roomy = solve_plan(chain_graph(times, params, acts), 4, 8, 8,
+                       HardwareModel(hbm_bytes=64 * 1024**3))
+    assert roomy.winner.pp == 1 and roomy.winner.dp == 4
+
+    capped = solve_plan(chain_graph(times, params, acts), 4, 8, 8,
+                        HardwareModel(hbm_bytes=6e7))
+    assert capped.winner.pp > 1
+    dp_rows = [c for c in capped.candidates if c.pp == 1 and c.tp == 1]
+    assert dp_rows and all(not c.feasible for c in dp_rows)
+    assert all("HBM" in c.reason for c in dp_rows)
+    # peak bytes are recorded for the winner and stay under the cap
+    assert 0 < capped.winner.peak_bytes_per_chip <= 6e7
+
+
+def test_uneven_costs_force_unbalanced_split():
+    """Min-max split of [1, 1, 10, 1] into 2 stages isolates the heavy
+    layer: bounds (0, 2, 4) — max(2, 11) beats the balanced-count split's
+    max(12, 1)."""
+    g = chain_graph([1.0, 1.0, 10.0, 1.0], params=[1e4] * 4,
+                    acts=[1e5] * 4)
+    plan = solve_plan(g, 2, 8, 8, pin_pp=2)
+    assert plan.winner.pp == 2
+    assert plan.winner.bounds == (0, 2, 4)
+
+
+def test_capped_split_dp():
+    times = [1.0, 1.0, 10.0, 1.0]
+    pre = [0.0]
+    for t in times:
+        pre.append(pre[-1] + t)
+    span = lambda i, j: pre[j] - pre[i]
+    edge = lambda i: 0.0
+    # unconstrained: isolate the heavy layer
+    assert capped_balanced_split(4, 2, span, edge, lambda i, j: True) \
+        == [0, 2, 4]
+    # memory cap (span mem = node count except node 3 weighs 10) moves
+    # the cut: [0,2|2,4] needs mem 11 on the tail span, only [0,3|3,4] fits
+    mem = [1.0, 1.0, 1.0, 10.0]
+    prem = [0.0]
+    for m in mem:
+        prem.append(prem[-1] + m)
+    ok = lambda i, j: prem[j] - prem[i] <= 10.0
+    assert capped_balanced_split(4, 2, span, edge, ok) == [0, 3, 4]
+    # no feasible split at all -> None
+    assert capped_balanced_split(4, 2, span, edge,
+                                 lambda i, j: prem[j] - prem[i] <= 5.0) \
+        is None
+    # exact stage-count contract
+    assert capped_balanced_split(4, 5, span, edge, lambda i, j: True) is None
+
+
+def test_pipe_ms_reprices_true_costs():
+    """The timetable price must be the event order under TRUE float costs,
+    not half_ticks x cheapest event (quantize_cost_vectors caps events at
+    8 units, which would bill a 10x stage as 8). Hand check, fill-drain
+    S=2 M=2, F=[10,1], B=[20,2] (B splits 10+10 / 1+1):
+    dev0 F 0-10-20; dev1 F [10,11],[20,21]; dev1 B/W 21-25;
+    dev0 B00 waits B10@22 -> 32, W00 42, B01 (B11@24 done) 52, W01 62."""
+    from ddlbench_tpu.partition.planner import _pipe_ms
+
+    assert _pipe_ms("fill-drain", 2, 2, [10.0, 1.0], [20.0, 2.0]) \
+        == pytest.approx(62.0)
+    # the better schedules can only price lower on the same costs
+    assert _pipe_ms("1f1b", 2, 2, [10.0, 1.0], [20.0, 2.0]) <= 62.0
+    assert _pipe_ms("zero-bubble", 2, 2, [10.0, 1.0], [20.0, 2.0]) <= 62.0
+
+
+def test_tp_gated_to_token_models():
+    g = chain_graph([3.0] * 4, params=[1e4] * 4, acts=[1e5] * 4)
+    image = solve_plan(g, 4, 8, 8, token_model=False)
+    assert all(not c.feasible for c in image.candidates if c.tp > 1)
+    token = solve_plan(g, 4, 8, 8, token_model=True)
+    assert any(c.feasible and c.tp > 1 for c in token.candidates)
+
+
+def test_tp_widths_respect_model_divisibility():
+    """The planner must never emit a tp width the engine's trace-time
+    asserts reject: widths divide world AND n_heads/d_model/mlp."""
+    from ddlbench_tpu.partition.planner import _model_tp_widths
+
+    assert _model_tp_widths("transformer_s", 8) == [2, 4, 8]  # heads 8
+    assert _model_tp_widths("transformer_m", 8) == [2, 4]  # heads 12: no 8
+    assert _model_tp_widths("seq2seq_lstm_s", 8) == []  # no sliced blocks
+    assert _model_tp_widths("lenet", 8) == []  # not a token arch at all
+
+
+def test_solver_divisibility_feasibility():
+    """A dp that does not divide the micro-batch rows is recorded
+    infeasible, not silently skipped or crashed."""
+    g = chain_graph([3.0] * 4, params=[1e4] * 4, acts=[1e5] * 4)
+    plan = solve_plan(g, 4, 3, 8)  # mb=3: dp=2 cannot split a microbatch
+    rows = [c for c in plan.candidates if c.pp == 2 and c.dp == 2]
+    assert rows and all(not c.feasible for c in rows)
+    assert all("divisible" in c.reason for c in rows)
+
+
+# ---- the config rewrite ----------------------------------------------------
+
+
+def _base_cfg(**kw):
+    base = dict(strategy="gpipe", benchmark="mnist", num_devices=4,
+                plan="auto", micro_batch_size=4, num_microbatches=2,
+                compute_dtype="float32")
+    base.update(kw)
+    return RunConfig(**base)
+
+
+def test_rewrite_mapping_preserves_global_batch():
+    cfg = _base_cfg()
+    mb, chunks = 4, 2
+
+    def resolved(winner):
+        out = cfg.replace(**_rewrite_fields(cfg, winner, mb, chunks))
+        out.validate()
+        return out
+
+    dp = resolved(Candidate(1, 4, 1, "fill-drain", (0, 4), 1.0, 0.0, True))
+    assert dp.strategy == "dp" and dp.plan == "manual"
+    assert dp.dp_shard_update and dp.batch_size == 2
+    assert dp.global_batch() == cfg.global_batch() == 8
+
+    pipe = resolved(Candidate(2, 2, 1, "1f1b", (0, 2, 4), 1.0, 0.0, True))
+    assert pipe.strategy == "gpipe" and pipe.num_stages == 2
+    assert pipe.dp_replicas == 2 and pipe.dp_shard_update
+    assert pipe.pipe_schedule == "1f1b"
+    assert pipe.micro_batch_size == 2 and pipe.plan_bounds == (0, 2, 4)
+    assert pipe.global_batch() == 8
+
+    tp = resolved(Candidate(1, 1, 4, "fill-drain", (0, 4), 1.0, 0.0, True))
+    assert tp.strategy == "tp" and tp.batch_size == 8
+    assert not tp.dp_shard_update
+
+
+def test_rewrite_world1_elastic_keeps_dp_engine():
+    """Elastic resume of a dp ZeRO-1 checkpoint onto ONE device must map
+    to the dp engine (the recorded flat layout), not 'single' — reshard
+    converts world sizes, not engines."""
+    cfg = _base_cfg(num_devices=1)
+    w = Candidate(1, 1, 1, "fill-drain", (0, 4), 1.0, 0.0, True)
+    plain = cfg.replace(**_rewrite_fields(cfg, w, 4, 2))
+    assert plain.strategy == "single"
+    plain.validate()
+    pinned = cfg.replace(**_rewrite_fields(cfg, w, 4, 2, force_shard=True))
+    assert pinned.strategy == "dp" and pinned.dp_shard_update
+    pinned.validate()
+
+
+def test_validate_plan_flags():
+    with pytest.raises(ValueError, match="-f gpipe"):
+        _base_cfg(strategy="dp", micro_batch_size=None,
+                  num_microbatches=None, batch_size=8).validate()
+    with pytest.raises(ValueError, match="supersedes"):
+        _base_cfg(auto_partition=True).validate()
+    with pytest.raises(ValueError, match="owns the parallelism mix"):
+        _base_cfg(pipe_schedule="1f1b").validate()
+    with pytest.raises(ValueError, match="owns the parallelism mix"):
+        _base_cfg(num_stages=4).validate()
+    _base_cfg().validate()  # the clean pre-plan config is fine
+    # plan_bounds validation
+    ok = RunConfig(strategy="gpipe", benchmark="mnist", num_devices=2,
+                   num_stages=2, micro_batch_size=4, num_microbatches=2,
+                   plan_bounds=(0, 1, 3), compute_dtype="float32")
+    ok.validate()
+    with pytest.raises(ValueError, match="strictly increase"):
+        ok.replace(plan_bounds=(0, 3, 1)).validate()
+    with pytest.raises(ValueError, match="entries"):
+        ok.replace(plan_bounds=(0, 1, 2, 3)).validate()
+    with pytest.raises(ValueError, match="pipeline strategies"):
+        RunConfig(strategy="dp", plan_bounds=(0, 1)).validate()
+
+
+def test_plan_bounds_checked_against_model(devices):
+    """A --plan-bounds whose last cut is not the model's layer count gets
+    a NAMED error at make_strategy (config.validate cannot know n), not
+    the engine's bare assert."""
+    from ddlbench_tpu.parallel.api import make_strategy
+
+    cfg = RunConfig(strategy="gpipe", benchmark="mnist", arch="lenet",
+                    num_devices=2, num_stages=2, micro_batch_size=4,
+                    num_microbatches=2, plan_bounds=(0, 2, 5),
+                    compute_dtype="float32")
+    with pytest.raises(ValueError, match="layer count"):
+        make_strategy(cfg)
+
+
+# ---- plan cache / invalidation --------------------------------------------
+
+
+@pytest.fixture
+def tiny_world(monkeypatch):
+    """Patch the model + profile the planner and the engines see, counting
+    profile calls. Light params -> the dp winner; .graph is swappable."""
+    from ddlbench_tpu.models.layers import LayerModel, dense, flatten
+
+    import ddlbench_tpu.parallel.api as api
+    import ddlbench_tpu.partition.planner as planner
+    import ddlbench_tpu.profiler.profile as prof
+
+    model = LayerModel(
+        "tiny3", [flatten(), dense("fc1", 16, relu=True), dense("fc2", 10)],
+        (28, 28, 1), 10)  # mnist-shaped: the e2e pins run the real loop
+    state = {"model": model, "calls": 0,
+             "graph": chain_graph([3.0, 3.0, 3.0], params=[1e4] * 3,
+                                  acts=[1e5] * 3)}
+
+    def fake_profile(*a, **k):
+        state["calls"] += 1
+        return state["graph"]
+
+    monkeypatch.setattr(planner, "get_model", lambda *a, **k: model)
+    monkeypatch.setattr(api, "get_model", lambda *a, **k: model)
+    monkeypatch.setattr(prof, "profile_model", fake_profile)
+    return state
+
+
+def test_plan_cache_roundtrip(tiny_world, tmp_path):
+    cfg = _base_cfg(num_devices=2, checkpoint_dir=str(tmp_path))
+    r1 = resolve_auto_plan(cfg)
+    assert r1.strategy == "dp" and tiny_world["calls"] == 1
+    # the acceptance contract: partition.json records ALL candidates with
+    # predicted step time + peak bytes/chip, and why the winner won
+    doc = json.load(open(tmp_path / "partition.json"))
+    assert doc["key"]["plan"] == "auto"
+    rec = doc["plan_auto"]
+    assert rec["winner"]["pp"] == 1 and rec["winner"]["dp"] == 2
+    assert len(rec["candidates"]) >= 3
+    assert all("step_time_ms" in c and "peak_bytes_per_chip" in c
+               for c in rec["candidates"])
+    assert "ms/step" in rec["reason"]
+    # a --resume reuses the persisted plan instead of re-profiling
+    r2 = resolve_auto_plan(cfg.replace(resume=True))
+    assert tiny_world["calls"] == 1
+    assert r2 == r1.replace(resume=True)
+
+
+def test_plan_cache_key_mismatch_resolves(tiny_world, tmp_path):
+    cfg = _base_cfg(num_devices=2, checkpoint_dir=str(tmp_path))
+    resolve_auto_plan(cfg)
+    assert tiny_world["calls"] == 1
+    # a different topology must never silently reuse the plan
+    resolve_auto_plan(cfg.replace(num_devices=4, resume=True))
+    assert tiny_world["calls"] == 2
+
+
+def test_plan_cache_cost_model_mismatch_resolves(tiny_world, tmp_path):
+    cfg = _base_cfg(num_devices=2, checkpoint_dir=str(tmp_path))
+    resolve_auto_plan(cfg)
+    assert tiny_world["calls"] == 1
+    # same key, different hardware constants: the fingerprint invalidates
+    resolve_auto_plan(cfg.replace(
+        resume=True, hardware=HardwareModel(hbm_bytes=4 * 1024**3)))
+    assert tiny_world["calls"] == 2
+
+
+def test_stale_pre_plan_mode_key_migrates(tiny_world, tmp_path, capsys):
+    """Regression pin (the migration shim): a partition.json written
+    before _plan_key carried the plan-mode field must warn + re-solve and
+    be OVERWRITTEN — not KeyError, and not count as a foreign config whose
+    file is kept."""
+    from ddlbench_tpu.parallel.api import _plan_key, make_strategy
+
+    cfg = RunConfig(strategy="gpipe", benchmark="mnist", num_devices=2,
+                    auto_partition=True, micro_batch_size=4,
+                    num_microbatches=2, compute_dtype="float32",
+                    checkpoint_dir=str(tmp_path), resume=True)
+    old_key = {k: v for k, v in _plan_key(cfg).items() if k != "plan"}
+    stale = {"key": old_key, "graph_bounds": [0, 1, 3], "num_stages": 2,
+             "dp_replicas": 1, "stage_replication": None,
+             "micro_batch_size": 4, "num_microbatches": 2,
+             "virtual_stages": 1, "pipe_schedule": "fill-drain",
+             "pipe_costs": "unit", "pipe_cost_vectors": None}
+    (tmp_path / "partition.json").write_text(json.dumps(stale))
+    make_strategy(cfg)
+    out = capsys.readouterr().out
+    assert "predates the --plan mode field" in out
+    # re-solved and re-written under the migrated key; no .bak spawned
+    doc = json.load(open(tmp_path / "partition.json"))
+    assert doc["key"].get("plan") == "manual"
+    assert not list(tmp_path.glob("partition.json.bak*"))
+
+
+def test_stale_pre_plan_mode_key_migrates_auto(tiny_world, tmp_path, capsys):
+    """The same migration shim on the --plan auto side: a pre-plan-mode
+    partition.json matching this run on every other key field is warned
+    about, re-solved, and OVERWRITTEN in place — not backed up as a
+    foreign config's file."""
+    from ddlbench_tpu.parallel.api import _plan_key
+
+    cfg = _base_cfg(num_devices=2, checkpoint_dir=str(tmp_path),
+                    resume=True)
+    old_key = {k: v for k, v in _plan_key(cfg).items() if k != "plan"}
+    (tmp_path / "partition.json").write_text(
+        json.dumps({"key": old_key, "graph_bounds": [0, 1, 3]}))
+    resolved = resolve_auto_plan(cfg)
+    assert resolved.strategy == "dp"
+    assert "predates the --plan mode field" in capsys.readouterr().out
+    doc = json.load(open(tmp_path / "partition.json"))
+    assert doc["key"].get("plan") == "auto"
+    assert not list(tmp_path.glob("partition.json.bak*"))
+
+
+# ---- elastic cross-link ----------------------------------------------------
+
+
+def test_elastic_resume_pins_stage_split(tiny_world, tmp_path, monkeypatch):
+    """A --plan auto + --elastic-resume run whose recorded stage split no
+    longer matches what a fresh solve would pick re-plans CONSTRAINED to
+    the recorded split (the dp-axis reshard stays a permutation) instead
+    of raising at restore time."""
+    import ddlbench_tpu.train.checkpoint as ckpt
+
+    class FakeInfo:
+        path = str(tmp_path / "epoch_1")
+
+    saved = {"kind": "pipe_shard", "stages": 3, "vstages": 1, "world": 6,
+             "dp": 2}
+    monkeypatch.setattr(ckpt, "latest_valid", lambda d: FakeInfo())
+    monkeypatch.setattr(ckpt, "load_logical", lambda p: saved)
+
+    cfg = _base_cfg(num_devices=6, micro_batch_size=4, num_microbatches=6,
+                    checkpoint_dir=str(tmp_path), resume=True,
+                    elastic_resume=True)
+    pinned = resolve_auto_plan(cfg)
+    assert pinned.strategy == "gpipe" and pinned.num_stages == 3
+    assert pinned.dp_replicas == 2 and pinned.dp_shard_update
+    # the same run WITHOUT the elastic flag plans freely (light params ->
+    # pure dp) — and would then raise the reshard error at restore
+    free = resolve_auto_plan(cfg.replace(elastic_resume=False,
+                                         resume=False))
+    assert free.strategy == "dp"
+
+
+def test_elastic_resume_pins_recorded_cuts(tiny_world, tmp_path,
+                                           monkeypatch):
+    """The cut POSITIONS are pinned, not just the count: the prior run's
+    recorded (here deliberately unbalanced) split survives the world
+    change verbatim — per-stage packed rows must line up for the dp-axis
+    reshard to stay a permutation. A free re-solve of the equal-cost
+    3-node graph would cut at (0, 1, 3); the record says (0, 2, 3)."""
+    import ddlbench_tpu.train.checkpoint as ckpt
+
+    class FakeInfo:
+        path = str(tmp_path / "epoch_1")
+
+    saved = {"kind": "pipe_shard", "stages": 2, "vstages": 1, "world": 8,
+             "dp": 4}
+    monkeypatch.setattr(ckpt, "latest_valid", lambda d: FakeInfo())
+    monkeypatch.setattr(ckpt, "load_logical", lambda p: saved)
+    # the prior run's decision record; its key names the OLD world, only
+    # the winner's bounds matter to the pin
+    (tmp_path / "partition.json").write_text(json.dumps({
+        "key": {"num_devices": 8, "plan": "auto"},
+        "plan_auto": {"winner": {"pp": 2, "bounds": [0, 2, 3]}},
+    }))
+    cfg = _base_cfg(num_devices=4, micro_batch_size=4, num_microbatches=4,
+                    checkpoint_dir=str(tmp_path), resume=True,
+                    elastic_resume=True)
+    pinned = resolve_auto_plan(cfg)
+    assert pinned.strategy == "gpipe" and pinned.num_stages == 2
+    assert pinned.plan_bounds == (0, 2, 3)  # the recorded cuts, verbatim
+
+
+def test_reshard_error_points_at_plan_auto():
+    from ddlbench_tpu.train.reshard import CheckpointShapeError, compare
+
+    saved = {"schema": 1, "strategy": "gpipe", "kind": "pipe_shard",
+             "stages": 4, "vstages": 1, "world": 8, "dp": 2,
+             "length": 10, "padded": 16, "bucket_padded": [16],
+             "buckets": 1}
+    cur = dict(saved, stages=2, world=4)
+    with pytest.raises(CheckpointShapeError, match="--plan auto"):
+        compare(saved, cur, elastic=True)
+
+
+# ---- end-to-end: --plan auto == the explicit mix, bitwise ------------------
+
+
+def _leaves_equal(a, b):
+    import jax
+
+    la, lb = jax.tree.leaves(a), jax.tree.leaves(b)
+    assert len(la) == len(lb)
+    return all(np.array_equal(np.asarray(x), np.asarray(y))
+               for x, y in zip(la, lb))
+
+
+def _run(cfg):
+    from ddlbench_tpu.train.loop import run_benchmark
+
+    return run_benchmark(cfg, warmup_steps=0)
+
+
+def test_plan_auto_e2e_bitwise_dp(tiny_world, devices):
+    """Fixture 1 of the acceptance pin: the dp winner. The resolved config
+    equals the explicit `-f dp --dp-shard-update` config and the executed
+    trajectory is bitwise-identical."""
+    cfg = _base_cfg(num_devices=2, epochs=1, steps_per_epoch=2)
+    resolved = resolve_auto_plan(cfg)
+    explicit = cfg.replace(
+        plan="manual", strategy="dp", batch_size=4, dp_shard_update=True,
+        micro_batch_size=None, num_microbatches=None)
+    assert resolved == explicit
+    auto = _run(cfg)  # run_benchmark resolves --plan auto itself
+    manual = _run(explicit)
+    assert _leaves_equal(auto["train_state"].params,
+                         manual["train_state"].params)
+    assert auto["valid_accuracy"] == manual["valid_accuracy"]
+
+
+def test_plan_auto_e2e_bitwise_pipeline(tiny_world, devices):
+    """Fixture 2: heavy params under a tight cap force the pipeline winner;
+    the trajectory matches the explicit gpipe mix with the same schedule
+    and the same --plan-bounds."""
+    tiny_world["graph"] = chain_graph([3.0, 3.0, 3.0], params=[5e8] * 3,
+                                      acts=[1e5] * 3)
+    hw = HardwareModel(hbm_bytes=4 * 1024**3)
+    cfg = _base_cfg(num_devices=2, epochs=1, steps_per_epoch=2,
+                    hardware=hw)
+    resolved = resolve_auto_plan(cfg)
+    assert resolved.strategy == "gpipe" and resolved.num_stages == 2
+    explicit = cfg.replace(
+        plan="manual", num_stages=2, pipe_schedule=resolved.pipe_schedule,
+        plan_bounds=resolved.plan_bounds)
+    assert resolved == explicit
+    auto = _run(cfg)
+    manual = _run(explicit)
+    assert _leaves_equal(auto["train_state"].params,
+                         manual["train_state"].params)
+
+
+# ---- planbench -------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_planbench_smoke(capsys):
+    from ddlbench_tpu.tools import planbench
+
+    rc = planbench.main([
+        "--pairs", "lenet:mnist", "--worlds", "2", "--steps", "2",
+        "--warmup", "1", "--micro-batch", "2", "--num-microbatches", "2",
+        "--profile-mode", "flops"])
+    assert rc == 0
+    lines = [json.loads(l) for l in capsys.readouterr().out.splitlines()
+             if l.startswith("{")]
+    rows = [l for l in lines if "predicted_ms" in l]
+    assert rows and all("measured_ms" in r and "err_frac" in r
+                        for r in rows)
+    assert any("summary" in l for l in lines)

@@ -83,7 +83,7 @@ def test_tp_size_config_validation():
 
 
 @pytest.mark.pipesched
-def test_tpp_matches_gpipe_loss_trajectory():
+def test_tpp_matches_gpipe_loss_trajectory(monkeypatch):
     """2 stages x 2 TP shards == 2-stage plain gpipe, same init/batches:
     the loss trajectories must agree to f32 tolerance over several steps
     (this exercises the sliced-matmul math, the row-parallel psums, AND the
@@ -94,12 +94,17 @@ def test_tpp_matches_gpipe_loss_trajectory():
     0.4.37 — the pre-VMA rep re-checks rejected mixed-rep `pad` args
     (compat.py lenient standard check) — and now that it rides the
     schedule runtime's timetable the integration must stay green in the
-    commit gate, not hidden behind --runslow."""
+    commit gate, not hidden behind --runslow. Runs on the suite's shared
+    TINY_LM shapes (T=32, vocab 64): the sliced-matmul/psum math this
+    pins is shape-independent, and the synthtext T=1024 variant cost
+    ~95 s of the tier-1 wall (ROADMAP item 5) — the full-size shapes
+    stay covered by the --runslow 3-D/MoE/eval variants below."""
+    import ddlbench_tpu.config as config
     from ddlbench_tpu.parallel.api import make_strategy
+    from tests.tiny_models import TINY_LM  # registers transformer_t
 
-    _VARIANTS.setdefault("transformer_t", dict(d_model=32, n_layers=2,
-                                               n_heads=4))
-    base = dict(benchmark="synthtext", arch="transformer_t",
+    monkeypatch.setitem(config.DATASETS, "tinylm", TINY_LM)
+    base = dict(benchmark="tinylm", arch="transformer_t",
                 strategy="gpipe", micro_batch_size=2, num_microbatches=2,
                 compute_dtype="float32", fused_head_loss=False,
                 steps_per_epoch=2, attention_backend="xla")
@@ -117,11 +122,9 @@ def test_tpp_matches_gpipe_loss_trajectory():
     ts_r = ref.init(jax.random.key(0))
     ts_t = tpp.init(jax.random.key(0))
     losses_r, losses_t = [], []
-    # 2 steps, not more: at T=1024 each CPU-mesh pipeline step costs
-    # 15-25 s (XLA attention + collective rendezvous stalls dominate the
-    # tier-1 budget — ROADMAP item 5), and a missing psum diverges the
-    # trajectory within a step or two, so step 2 already discriminates;
-    # the 3-step/3-D variants stay under --runslow
+    # 2 steps, not more: a missing psum diverges the trajectory within a
+    # step or two, so step 2 already discriminates; the 3-step/3-D
+    # variants stay under --runslow
     for step in range(2):
         x = jax.random.randint(jax.random.key(10 + step),
                                (cfg_ref.global_batch(), T), 0,
