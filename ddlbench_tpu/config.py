@@ -1094,6 +1094,15 @@ class ServeConfig:
     # Scheduling NEVER reads these — they are observability-only.
     slo_ttft: float = 0.0
     slo_itl: float = 0.0
+    # serve-side heartbeat (ISSUE 15): a replica that HOLDS WORK but makes
+    # no scheduling progress for more than this many virtual time units is
+    # declared a straggler by ReplicatedServer and drained — its in-flight
+    # requests evict onto the recompute path and redistribute least-loaded
+    # over the survivors, exactly like a scale-down (train/watchdog.py's
+    # no-progress detector, re-used clockless via ProgressMonitor).
+    # 0 disables detection (the default — single-replica engines and all
+    # pre-chaos callers are bitwise unaffected).
+    heartbeat: float = 0.0
     # KV-pool storage dtype (ops/paged_decode.py serve pool). "float32" is
     # the bitwise-pinned default; "bfloat16" halves pool bytes; "int8"
     # quarters them — pages quantize at the write boundary with a stored
@@ -1181,6 +1190,10 @@ class ServeConfig:
         if self.slo_ttft < 0 or self.slo_itl < 0:
             raise ValueError(
                 "slo_ttft and slo_itl must be >= 0 (0 = no SLO)")
+        if self.heartbeat < 0:
+            raise ValueError(
+                f"heartbeat must be >= 0 time units (0 disables straggler "
+                f"detection), got {self.heartbeat}")
         if self.kv_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
                 f"kv_dtype must be float32|bfloat16|int8, got "

@@ -77,6 +77,23 @@ Structure (host schedules, device computes):
   page reservation, and the batch drains to completion before the next is
   admitted — classic static batching on identical numerics, so servebench
   measures pure scheduling effect.
+* FLEET FAULT TOLERANCE (ISSUE 15): :meth:`ReplicatedServer.fail` hard-
+  kills a replica (pool lost, finished records salvaged, held requests
+  resubmitted least-loaded onto survivors where recompute regenerates
+  BITWISE-identical streams — prompt + emitted tokens are host state),
+  :meth:`ReplicatedServer.stall` injects a straggler that holds its
+  requests without progressing, and a serve-side heartbeat
+  (``cfg.heartbeat``, train/watchdog.ProgressMonitor on the virtual
+  clock) drains a no-progress replica like a scale-down. Per-request
+  DEADLINES add admission control: a request whose projected completion
+  already misses its deadline is SHED at submit (named rejection, driver
+  retries with bounded backoff), and one that expires in place cancels
+  into the named ``timeout`` terminal state with every page freed. SLO
+  TIERS (ROADMAP 2c): ``ServeRequest.tier`` — interactive admits ahead
+  of batch, batch is the preemptible lane (evicted first under pool
+  pressure, riding eviction+recompute). All of it is inert for plain
+  traffic: no deadlines, one tier, no injections = the pre-chaos
+  scheduler, bitwise (pinned).
 
 Virtual time: one unit = one model pass (a decode step over max_batch rows
 or one prefill chunk), the cost model under which batch parallelism is
@@ -118,9 +135,10 @@ from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.serve.allocator import PageAllocator
 from ddlbench_tpu.serve.draft import NgramDrafter
 from ddlbench_tpu.serve.prefix import PrefixIndex
-from ddlbench_tpu.serve.workload import ServeRequest
+from ddlbench_tpu.serve.workload import TIERS, ServeRequest
 from ddlbench_tpu.telemetry.stats import request_slo_ok
 from ddlbench_tpu.telemetry.tracer import get_tracer
+from ddlbench_tpu.train.watchdog import ProgressMonitor
 
 
 def _vns(t: float) -> int:
@@ -205,6 +223,10 @@ class StepReport:
     backpressure: int = 0
     prefix_hits: int = 0  # admissions that bound >= 1 cached prefix page
     completed: List[int] = dataclasses.field(default_factory=list)
+    # rids cancelled into the `timeout` terminal state this step — a
+    # terminal event like completion (the closed-loop driver releases the
+    # next request on either, or it would wait forever on a dead rid)
+    timed_out: List[int] = dataclasses.field(default_factory=list)
 
     def merge(self, other: "StepReport") -> None:
         self.cost = max(self.cost, other.cost)
@@ -215,6 +237,7 @@ class StepReport:
         self.backpressure += other.backpressure
         self.prefix_hits += other.prefix_hits
         self.completed.extend(other.completed)
+        self.timed_out.extend(other.timed_out)
 
 
 class ServeEngine:
@@ -311,10 +334,36 @@ class ServeEngine:
         # across re-admissions (eviction/recompute) — attached to the
         # finished record for telemetry/stats.serve_summary
         self._cached_tokens: Dict[int, int] = {}
+        # -- chaos/robustness state (ISSUE 15). All defaults inert: with
+        # no deadlines submitted, no tiers in the traffic, and no
+        # stall()/fail() injected, scheduling is bitwise the pre-chaos
+        # engine.
+        # deadline bookkeeping: the expiry scan only runs once a
+        # deadlined request has ever been accepted
+        self._has_deadlines = False
+        # `timeout` terminal records (rid/t/deadline/state/out_tokens/
+        # tier) and `shed` admission rejections (rid/t/deadline/tier)
+        self.timed_out: List[Dict[str, Any]] = []
+        self.shed: List[Dict[str, Any]] = []
+        # every eviction (rid/t/tier) — the tier-preemption-order ledger
+        self.evicted_log: List[Dict[str, Any]] = []
+        # straggler injection: ReplicatedServer.stall sets this; while
+        # positive the server skips this replica's steps (it holds its
+        # requests but makes no progress) and decrements per global step
+        self._stall_ticks = 0
+        # serve-side heartbeat (cfg.heartbeat > 0): the server kicks this
+        # monitor every step it schedules the replica; an expired monitor
+        # on a replica that still holds work is the straggler verdict
+        self.monitor: Optional[ProgressMonitor] = (
+            ProgressMonitor(cfg.heartbeat) if cfg.heartbeat > 0 else None)
         self.stats: Dict[str, float] = {
             "steps": 0, "model_calls": 0, "prefill_calls": 0,
             "decode_calls": 0, "decode_row_slots": 0, "admitted": 0,
             "completed": 0, "evicted": 0, "backpressure": 0,
+            # deadline counters (always present — deadline-free runs
+            # report 0; servebench gates them out of plain rows so the
+            # pinned schema is unchanged)
+            "shed": 0, "timeouts": 0,
             "peak_occupancy": 0.0, "frag_sum": 0.0, "frag_samples": 0,
             # prefix-cache counters (always present — cache-off and the
             # static baseline report 0, keeping the JSON schema stable)
@@ -504,10 +553,79 @@ class ServeEngine:
         # is never fed back, so its K/V is never written
         return req.prompt_len + req.max_new - 1
 
-    def submit(self, req: ServeRequest) -> None:
+    def min_service_passes(self, req: ServeRequest) -> int:
+        """Lower bound on the model passes ``req`` needs end to end on an
+        IDLE engine: one prefill call per chunk of the UNCACHED prompt
+        tail (the first token rides the last chunk) plus one decode pass
+        per remaining token. With the prefix cache on, the currently
+        cached prefix is consulted — a full page-aligned hit admits with
+        zero prefill calls (decode-only: ``max_new`` passes), a partial
+        hit prefills only the tail — so a cached request is never shed
+        for prompt work it would not do (cache state can shift before
+        admission; the bound is exact as of the submission instant)."""
+        C = self.cfg.resolved_prefill_chunk()
+        S = req.prompt_len
+        if self.prefix is not None:
+            hit = self.prefix.match(req.prompt)
+            if hit and len(hit) * self.page >= S:
+                return req.max_new  # full hit: straight to decode
+            cached = min(len(hit), (S - 1) // self.page) * self.page
+            S -= cached
+        return -(-S // C) + req.max_new - 1
+
+    def projected_finish(self, req: ServeRequest, now: float) -> float:
+        """Deterministic completion projection for admission control:
+        ``now + max(congestion_delay, own_min_passes)``.
+
+        ``own_min_passes`` (:meth:`min_service_passes`) is an EXACT lower
+        bound, so the one hard guarantee is: a request that cannot meet
+        its deadline even alone on an idle engine is always shed, and a
+        request submitted to an idle engine is never shed unless truly
+        hopeless. ``congestion_delay`` — tokens that compete for budget
+        ahead of this request (remaining in-flight work, plus queued
+        requests that admit ahead of it: an interactive submission
+        outranks every queued batch request via
+        :meth:`_next_admission_index`, and a queued request already past
+        its own deadline will be cancelled before consuming budget, so
+        neither counts) over the per-step token budget — is a HEURISTIC:
+        continuous batching drains in-flight work concurrently with the
+        new request, so under contention the projection can over-shed a
+        request that would just have made it. That is a deterministic,
+        REPORTED policy choice (shed_rate; the driver's bounded retry is
+        the recourse), not a correctness claim — taking the max rather
+        than the sum of the two terms keeps the estimate as tight as a
+        one-pass host scan can be."""
+        ahead = 0
+        for a in self.rows:
+            if a is not None:
+                ahead += (a.req.prompt_len - a.prefill_done) \
+                    + (a.req.max_new - len(a.out))
+        for r in self.queue:
+            if r.deadline is not None and now >= r.deadline:
+                continue  # expires before it could consume budget
+            if req.tier != "batch" and r.tier == "batch":
+                continue  # this submission admits ahead of queued batch
+            ahead += r.prompt_len + r.max_new
+        congestion = ahead // self.cfg.resolved_token_budget()
+        return now + max(congestion, self.min_service_passes(req))
+
+    def submit(self, req: ServeRequest, now: Optional[float] = None) -> bool:
+        """Enqueue ``req``; returns True when accepted. A request with a
+        DEADLINE is subject to admission control: when its projected
+        completion (:meth:`projected_finish`) already exceeds the
+        deadline, the engine SHEDS it — the named ``shed`` rejection,
+        returned as False so the driver's bounded retry-with-backoff
+        policy (tools/servebench.py) owns what happens next. Deadline-free
+        requests are always accepted (the pre-deadline contract)."""
         if req.prompt_len < 1 or req.max_new < 1:
             raise ValueError("request needs a non-empty prompt and "
                              "max_new >= 1")
+        if req.tier not in TIERS:
+            # a typo'd tier would silently schedule as interactive while
+            # vanishing from both per-tier summary buckets
+            raise ValueError(
+                f"request {req.rid}: tier must be one of {TIERS}, got "
+                f"{req.tier!r}")
         if req.prompt_len + req.max_new > self.cfg.max_len:
             raise ValueError(
                 f"request {req.rid}: prompt {req.prompt_len} + max_new "
@@ -517,14 +635,30 @@ class ServeEngine:
             raise ValueError(
                 f"request {req.rid} can never fit the pool "
                 f"({self.allocator.capacity} usable pages)")
-        self.queue.append(req)
         t0 = req.arrival if req.arrival is not None else 0.0
+        if req.deadline is not None:
+            t_sub = now if now is not None else t0
+            if self.projected_finish(req, t_sub) > req.deadline:
+                self.stats["shed"] += 1
+                self.shed.append({"rid": req.rid, "t": t_sub,
+                                  "deadline": req.deadline,
+                                  "tier": req.tier})
+                tr = self._tr()
+                if tr is not None:
+                    tr.emit("i", "shed", _vns(t_sub),
+                            track=self._req_track(req.rid),
+                            args={"rid": req.rid, "deadline": req.deadline,
+                                  "tier": req.tier})
+                return False
+            self._has_deadlines = True
+        self.queue.append(req)
         self._queued_at[req.rid] = t0
         tr = self._tr()
         if tr is not None:
             tr.emit("i", "submit", _vns(t0), track=self._req_track(req.rid),
                     args={"rid": req.rid, "prompt_len": req.prompt_len,
                           "max_new": req.max_new})
+        return True
 
     def has_work(self) -> bool:
         return bool(self.queue) or any(a is not None for a in self.rows)
@@ -558,6 +692,15 @@ class ServeEngine:
         rep.evicted += 1
         self.stats["evicted"] += 1
         rid = victim.req.rid
+        # batch_active = co-resident batch-tier actives the victim hunt
+        # passed over: > 0 with an interactive victim would break the
+        # tier preemption order (the assertable invariant; 0 by
+        # construction of _evict_newest, regression-pinned)
+        self.evicted_log.append({
+            "rid": rid, "t": self._now, "tier": victim.req.tier,
+            "batch_active": sum(1 for a in self._active()
+                                if a is not victim
+                                and a.req.tier == "batch")})
         self._queued_at[rid] = self._now  # requeued: the wait restarts now
         self._evicted_rids.add(rid)
         tr = self._tr()
@@ -567,10 +710,17 @@ class ServeEngine:
                           "out_tokens": len(victim.out)})
 
     def _evict_newest(self, rep: StepReport) -> Optional[_Active]:
+        """Preemption order (ROADMAP 2c): BATCH-tier actives are evicted
+        first — newest-first within the tier — and only when no batch
+        request is in flight does an interactive one go (newest-first,
+        the pre-tier rule, which all-interactive traffic reduces to
+        bitwise). Batch is the preemptible background lane riding the
+        existing eviction+recompute machinery."""
         active = self._active()
         if not active:
             return None
-        victim = max(active, key=lambda a: a.admit_seq)
+        batch = [a for a in active if a.req.tier == "batch"]
+        victim = max(batch or active, key=lambda a: a.admit_seq)
         self._evict(victim, rep)
         return victim
 
@@ -596,6 +746,8 @@ class ServeEngine:
             # prompt tokens served from the prefix cache (all admissions
             # of this request — telemetry/stats.serve_summary aggregates)
             "cached_tokens": self._cached_tokens.pop(a.req.rid, 0),
+            # SLO tier — serve_summary's per-tier split keys on it
+            "tier": a.req.tier,
         })
         rep.completed.append(a.req.rid)
         self.stats["completed"] += 1
@@ -607,6 +759,56 @@ class ServeEngine:
                           "arrival": f["arrival"],
                           "first_token_t": f["first_token_t"],
                           "cached_tokens": f["cached_tokens"]})
+
+    # -- deadlines: expiry cancellation (the `timeout` terminal state) -----
+
+    def _record_timeout(self, rid: int, now: float, deadline: float,
+                        state: str, out_tokens: int, tier: str,
+                        rep: StepReport) -> None:
+        self.timed_out.append({"rid": rid, "t": now, "deadline": deadline,
+                               "state": state, "out_tokens": out_tokens,
+                               "tier": tier})
+        self.stats["timeouts"] += 1
+        rep.timed_out.append(rid)
+        self._queued_at.pop(rid, None)
+        self._evicted_rids.discard(rid)
+        self._cached_tokens.pop(rid, None)
+        tr = self._tr()
+        if tr is not None:
+            tr.emit("i", "timeout", _vns(now), track=self._req_track(rid),
+                    args={"rid": rid, "deadline": deadline, "state": state,
+                          "out_tokens": out_tokens})
+
+    def _cancel_expired(self, now: float, rep: StepReport) -> None:
+        """Deadline enforcement, observed at step boundaries: a request
+        whose deadline has passed can no longer complete in time (every
+        emission this step stamps at ``now + cost > deadline``), so it
+        cancels into the named ``timeout`` terminal state — queued
+        entries just leave the queue, in-flight ones free every page
+        (prefix-registered pages survive on the index's own refs, like
+        eviction). A request that completed LATE in an earlier step
+        stays completed — the SLO machinery judges it, the deadline only
+        governs work still pending when the expiry is observed."""
+        expired = [r for r in self.queue
+                   if r.deadline is not None and now >= r.deadline]
+        if expired:
+            dead = {id(r) for r in expired}  # identity, never dataclass ==
+            kept = [r for r in self.queue if id(r) not in dead]
+            self.queue.clear()
+            self.queue.extend(kept)
+            for r in expired:
+                self._record_timeout(r.rid, now, r.deadline, "queued", 0,
+                                     r.tier, rep)
+        for a in [a for a in self._active()
+                  if a.req.deadline is not None and now >= a.req.deadline]:
+            self.allocator.free_request(a.req.rid)
+            self.table[a.row, :] = 0
+            self.rows[a.row] = None
+            # static policy: a freed row ends the fill phase like a
+            # completion does (same drain-barrier reasoning)
+            self._filling = False
+            self._record_timeout(a.req.rid, now, a.req.deadline, a.state,
+                                 len(a.out), a.req.tier, rep)
 
     # -- the step: ensure pages -> pack -> prefill/decode -> retire --------
 
@@ -661,7 +863,7 @@ class ServeEngine:
                 return False  # evicted ourselves; the queue will retry
 
     def _admit_full_hit(self, req: ServeRequest, hit: List[int],
-                        rep: StepReport) -> Optional[_Active]:
+                        rep: StepReport, qi: int = 0) -> Optional[_Active]:
         """Admit a request whose WHOLE (page-aligned) prompt is cached:
         bind every cached page, copy-on-write the last one into a private
         slot — the decode program is about to re-derive position S-1's K/V
@@ -686,7 +888,7 @@ class ServeEngine:
             self.stats["backpressure"] += 1
             return None
         self.allocator.bind(req.rid, hit[:nblk - 1])
-        self.queue.popleft()
+        del self.queue[qi]
         row = self._free_row()
         a = _Active(req=req, row=row, admit_seq=self._admit_seq)
         self._admit_seq += 1
@@ -720,6 +922,17 @@ class ServeEngine:
         self._trace_admit(a, S - 1)
         return a
 
+    def _next_admission_index(self) -> int:
+        """Queue position of the next request to admit: INTERACTIVE
+        admits ahead of batch (FIFO within a tier — ROADMAP 2c's
+        priority lane); with no interactive request waiting, the head
+        batch request goes. All-interactive traffic always returns 0 —
+        the pre-tier FIFO order, bitwise."""
+        for i, r in enumerate(self.queue):
+            if r.tier != "batch":
+                return i
+        return 0
+
     def _admission_open(self) -> bool:
         if self.cfg.policy == "continuous":
             return True
@@ -733,6 +946,10 @@ class ServeEngine:
         stamped at ``now + cost`` (the step's end in virtual time)."""
         rep = StepReport()
         self._now = now  # mid-schedule instants (evict, pool, admit)
+        # deadline expiry first: freed pages/rows are capacity this very
+        # step (the scan arms only after a deadlined request ever arrived)
+        if self._has_deadlines:
+            self._cancel_expired(now, rep)
         C = self.cfg.resolved_prefill_chunk()
 
         # 1) decode set: every decode row gets its next page (evictions may
@@ -775,14 +992,15 @@ class ServeEngine:
         #    decode directly — budget 1, the bookkeeping slot).
         while (self.queue and self._free_row() is not None
                and self._admission_open()):
-            req = self.queue[0]
+            qi = self._next_admission_index()
+            req = self.queue[qi]
             hit = self.prefix.match(req.prompt) if self.prefix else []
             S = req.prompt_len
             full_hit = bool(hit) and len(hit) * self.page >= S
             if budget < (1 if full_hit else C):
                 break
             if full_hit:
-                a = self._admit_full_hit(req, hit, rep)
+                a = self._admit_full_hit(req, hit, rep, qi)
                 if a is None:
                     break  # backpressure — even one COW page unavailable
                 budget -= 1
@@ -818,7 +1036,7 @@ class ServeEngine:
                 break
             if nbind:
                 self.allocator.bind(req.rid, hit[:nbind])
-            self.queue.popleft()
+            del self.queue[qi]
             row = self._free_row()
             a = _Active(req=req, row=row, admit_seq=self._admit_seq)
             self._admit_seq += 1
@@ -1301,25 +1519,215 @@ class ReplicatedServer:
         self._factory = engine_factory
         self._retired: List[ServeEngine] = []
         self._next_replica = len(engines)
-        # (t, from, to, evicted, redistributed) — servebench embeds these
+        # (t, from, to, evicted, redistributed, shed) — servebench embeds
+        # these
         self.resize_events: List[Dict[str, Any]] = []
+        # chaos ledgers (ISSUE 15): hard kills, injected stalls, and
+        # heartbeat straggler drains — servechaos embeds all three
+        self.fail_events: List[Dict[str, Any]] = []
+        self.stall_events: List[Dict[str, Any]] = []
+        self.heartbeat_events: List[Dict[str, Any]] = []
 
     def _least_loaded(self) -> ServeEngine:
         return min(enumerate(self.engines), key=lambda ie: (ie[1].load(),
                                                             ie[0]))[1]
 
-    def submit(self, req: ServeRequest) -> None:
-        self._least_loaded().submit(req)
+    def _dispatch(self, req: ServeRequest,
+                  now: Optional[float] = None) -> Optional[ServeEngine]:
+        """Fleet dispatch returning the ACCEPTING engine (None = shed).
+        For a DEADLINED request the fleet sheds only when NO replica
+        projects the deadline as makeable — with tiers, a higher-load
+        replica whose queue is all batch can beat the least-loaded one's
+        projection for an interactive submission, so replicas are probed
+        in (load, index) order and the first whose projection fits takes
+        the request; if none fits, the least-loaded replica records the
+        ONE shed. Deadline-free requests go straight to the least-loaded
+        replica (the pre-chaos dispatch, bitwise). Every fleet-side
+        submission — driver traffic AND failover resubmission
+        (fail/heartbeat-drain/resize) — routes through here, so a
+        displaced request is never shed by a survivor when a sibling
+        could still meet its deadline."""
+        if req.deadline is not None:
+            order = sorted(enumerate(self.engines),
+                           key=lambda ie: (ie[1].load(), ie[0]))
+            t_sub = now if now is not None else (
+                req.arrival if req.arrival is not None else 0.0)
+            for _, e in order:
+                if e.projected_finish(req, t_sub) <= req.deadline:
+                    return e if e.submit(req, now=now) else None
+            order[0][1].submit(req, now=now)  # records the one shed
+            return None
+        e = self._least_loaded()
+        return e if e.submit(req, now=now) else None
+
+    def submit(self, req: ServeRequest, now: Optional[float] = None) -> bool:
+        """Least-loaded dispatch with the fleet-wide deadline probe
+        (:meth:`_dispatch`): False means the request was SHED."""
+        return self._dispatch(req, now=now) is not None
 
     def has_work(self) -> bool:
         return any(e.has_work() for e in self.engines)
 
     def step(self, now: float = 0.0) -> StepReport:
         rep = StepReport()
+        stalled_work = False
+        progressed: List[ServeEngine] = []
         for e in self.engines:
+            if e._stall_ticks > 0:
+                # straggler injection: the replica holds its requests but
+                # schedules nothing this global step — and its progress
+                # monitor is deliberately NOT kicked
+                e._stall_ticks -= 1
+                stalled_work = stalled_work or e.has_work()
+                continue
             if e.has_work():
                 rep.merge(e.step(now))
+            progressed.append(e)
+        if rep.cost == 0 and stalled_work:
+            # every replica holding work is stalled: the fleet still burns
+            # a virtual time unit doing nothing, or the straggler would be
+            # free (clock frozen => heartbeat could never fire)
+            rep.cost = 1
+        t_end = now + rep.cost
+        for e in progressed:
+            if e.monitor is not None:
+                # scheduled (or idle — an empty replica is healthy, not
+                # stuck) counts as progress AS OF THE STEP'S END: kicking
+                # at `now` instead would falsely expire every working
+                # replica on any global step whose cost exceeds the
+                # window (expiry below is evaluated at t_end)
+                e.monitor.kick(t_end)
+        hb = self.engines[0].cfg.heartbeat
+        if hb > 0:
+            for e in [x for x in self.engines
+                      if x.monitor is not None and x.has_work()
+                      and x.monitor.expired(t_end)]:
+                if len(self.engines) == 1:
+                    break  # no survivor to redistribute onto
+                self._drain_straggler(e, t_end)
         return rep
+
+    # -- serving-fleet chaos: hard kill, straggler stall, heartbeat --------
+
+    def fail(self, replica: int, now: float = 0.0) -> Dict[str, Any]:
+        """HARD-KILL the replica at fleet index ``replica``: the engine is
+        discarded — its device pool (all resident KV, prefix cache
+        included) is lost — and only host-side state survives: finished
+        records are SALVAGED (the killed engine retires into the summary,
+        so ``finished``/``stats_summary`` never lose them), and every
+        request it still held (in-flight and queued — prompt + emitted
+        tokens are host-side dispatcher state) is RESUBMITTED least-loaded
+        onto the survivors, where the eviction/recompute path regenerates
+        the token streams from scratch, bitwise identical (greedy and
+        seeded sampling are pure functions of (params, prompt, rid, token
+        index) — the PR 12 resize argument, now under UNCOORDINATED loss).
+        Resubmission is a re-admission: the survivors count ``admitted``
+        again and trace a ``recompute`` marker, mirroring eviction; the
+        ``completed`` counters and finished records stay exactly-once.
+        In-flight requests resubmit oldest-first (the oldest work gets the
+        least-loaded pick first), then the waiting queue in order. A
+        resubmission can still be SHED by deadline admission control on
+        the survivor — counted in the event's ``shed_on_failover`` (those
+        requests surface in servechaos's ``requests_lost``)."""
+        if not 0 <= replica < len(self.engines):
+            raise IndexError(
+                f"fail: no replica at fleet index {replica} "
+                f"(fleet size {len(self.engines)})")
+        if len(self.engines) == 1:
+            raise ValueError(
+                "cannot fail the last replica — no survivor to fail over "
+                "to (the fleet analog of losing the whole pod)")
+        eng = self.engines.pop(replica)
+        inflight = sorted(eng._active(), key=lambda a: a.admit_seq)
+        queued = list(eng.queue)
+        # queued requests' wait baselines + recompute markers are HOST
+        # state and survive the kill (the drain()/resize() handoff
+        # convention): a request evicted earlier and still queued at the
+        # kill keeps its restarted wait, not its original arrival
+        handoff = {r.rid: (eng._queued_at.get(r.rid, now),
+                           r.rid in eng._evicted_rids) for r in queued}
+        # the engine is dead: clear its live bookkeeping (the allocator/
+        # pool state is garbage with it) but keep finished + counters
+        eng.queue.clear()
+        for a in inflight:
+            eng.rows[a.row] = None
+        eng._queued_at.clear()
+        eng._evicted_rids.clear()
+        eng._cached_tokens.clear()
+        eng._stall_ticks = 0
+        self._retired.append(eng)
+        resubmitted = shed_n = 0
+        moves = [(a.req, True) for a in inflight] \
+            + [(r, False) for r in queued]
+        for r, was_active in moves:
+            tgt = self._dispatch(r, now=now)
+            if tgt is not None:
+                resubmitted += 1
+                if was_active:
+                    # the failover is the eviction analog: the wait
+                    # restarts at the kill instant and the re-admission
+                    # traces as a recompute
+                    tgt._queued_at[r.rid] = now
+                    tgt._evicted_rids.add(r.rid)
+                else:
+                    q0, was_evicted = handoff[r.rid]
+                    tgt._queued_at[r.rid] = q0
+                    if was_evicted:
+                        tgt._evicted_rids.add(r.rid)
+            else:
+                shed_n += 1
+        ev = {"t": now, "replica_id": eng.replica, "fleet_index": replica,
+              "salvaged": len(eng.finished),
+              "displaced_inflight": [a.req.rid for a in inflight],
+              "displaced_queued": len(queued),
+              "resubmitted": resubmitted, "shed_on_failover": shed_n}
+        self.fail_events.append(ev)
+        return ev
+
+    def stall(self, replica: int, ticks: int, now: float = 0.0) -> None:
+        """Inject a STRAGGLER: the replica at fleet index ``replica``
+        stops progressing for ``ticks`` global steps while holding its
+        requests (the grey-failure sibling of :meth:`fail` — nothing
+        died, it is just not answering). With ``cfg.heartbeat > 0`` the
+        no-progress detector drains it within the detection window; with
+        no heartbeat the stall simply delays its requests until the
+        replica recovers."""
+        if not 0 <= replica < len(self.engines):
+            raise IndexError(
+                f"stall: no replica at fleet index {replica} "
+                f"(fleet size {len(self.engines)})")
+        if ticks < 1:
+            raise ValueError(f"stall needs ticks >= 1, got {ticks}")
+        eng = self.engines[replica]
+        eng._stall_ticks = ticks
+        self.stall_events.append({"t": now, "replica_id": eng.replica,
+                                  "fleet_index": replica, "ticks": ticks})
+
+    def _drain_straggler(self, eng: ServeEngine, now: float) -> None:
+        """Heartbeat verdict: drain a no-progress replica exactly like a
+        scale-down — in-flight requests evict onto the recompute path,
+        the queue redistributes least-loaded, the engine retires with its
+        records (unlike :meth:`fail`, the replica's host state is intact,
+        so pages free cleanly)."""
+        idx = self.engines.index(eng)
+        self.engines.remove(eng)
+        reqs, evicted, handoff = eng.drain(now)
+        self._retired.append(eng)
+        shed_n = 0
+        for r in reqs:
+            tgt = self._dispatch(r, now=now)
+            if tgt is not None:
+                q0, was_evicted = handoff[r.rid]
+                tgt._queued_at[r.rid] = q0
+                if was_evicted:
+                    tgt._evicted_rids.add(r.rid)
+            else:
+                shed_n += 1
+        self.heartbeat_events.append({
+            "t": now, "replica_id": eng.replica, "fleet_index": idx,
+            "stalled_for": eng.monitor.stalled_for(now),
+            "evicted": evicted, "redistributed": len(reqs) - shed_n,
+            "shed": shed_n})
 
     def resize(self, n: int, now: float = 0.0) -> Dict[str, Any]:
         """Scale the live replica fleet to ``n`` under load. Scale-down
@@ -1346,9 +1754,12 @@ class ReplicatedServer:
             evicted += ev
             handoff.update(h)
         self._retired.extend(reversed(drained))
+        shed_n = 0
         for r in reqs:
-            eng = self._least_loaded()
-            eng.submit(r)
+            eng = self._dispatch(r, now=now)
+            if eng is None:
+                shed_n += 1  # deadline admission control shed the move
+                continue
             # keep the queue-wait baseline + recompute marker across the
             # replica move: a request evicted by the drain must trace as
             # a recompute whose wait restarts at the resize instant, not
@@ -1365,11 +1776,20 @@ class ReplicatedServer:
             # replica id is monotonic (unique trace tracks); the device
             # SLOT is the fleet position, so a re-grown fleet reuses the
             # devices its drained predecessors vacated
-            self.engines.append(
-                self._factory(self._next_replica, n, len(self.engines)))
+            eng = self._factory(self._next_replica, n, len(self.engines))
+            if eng.monitor is not None:
+                # the heartbeat baseline starts at the GROW instant — a
+                # fresh monitor's default 0.0 would read as `now` units
+                # of no progress and drain a brand-new replica on its
+                # first stalled (or merely unlucky) step
+                eng.monitor.kick(now)
+            self.engines.append(eng)
             self._next_replica += 1
+        # shed moves are NOT redistributed — same accounting convention
+        # as fail()'s resubmitted/shed_on_failover and the heartbeat
+        # drain's redistributed/shed split
         report = {"t": now, "from": before, "to": n, "evicted": evicted,
-                  "redistributed": len(reqs)}
+                  "redistributed": len(reqs) - shed_n, "shed": shed_n}
         self.resize_events.append(report)
         return report
 
@@ -1378,6 +1798,23 @@ class ReplicatedServer:
         out = []
         for e in self.engines + self._retired:
             out.extend(e.finished)
+        return out
+
+    @property
+    def timed_out(self) -> List[Dict[str, Any]]:
+        """Every ``timeout`` terminal record across the fleet (retired —
+        drained, failed, resized-away — replicas included)."""
+        out = []
+        for e in self.engines + self._retired:
+            out.extend(e.timed_out)
+        return out
+
+    @property
+    def shed_records(self) -> List[Dict[str, Any]]:
+        """Every ``shed`` admission rejection across the fleet."""
+        out = []
+        for e in self.engines + self._retired:
+            out.extend(e.shed)
         return out
 
     def snapshot(self) -> Dict[str, Any]:

@@ -40,6 +40,9 @@ import numpy as np
 ARRIVALS = ("closed", "poisson", "bursty")
 
 
+TIERS = ("interactive", "batch")
+
+
 @dataclasses.dataclass
 class ServeRequest:
     """One serving request: a prompt to continue by ``max_new`` tokens."""
@@ -50,6 +53,18 @@ class ServeRequest:
     # virtual arrival time; None for closed-loop (the driver stamps the
     # submission time when it releases the request)
     arrival: Optional[float] = None
+    # absolute virtual-time completion deadline. None (the default) means
+    # no deadline: the engine never sheds or times the request out, so
+    # plain traffic behaves exactly as before deadlines existed. With a
+    # deadline, admission control may SHED the request up front (projected
+    # completion already past the deadline) and the engine cancels it into
+    # the named ``timeout`` terminal state once the deadline passes.
+    deadline: Optional[float] = None
+    # SLO tier (ROADMAP 2c): "interactive" admits ahead of "batch", and
+    # batch requests are the preferred eviction victims under pool
+    # pressure (preemptible background lane riding eviction+recompute).
+    # All-interactive traffic reduces to the pre-tier scheduler, bitwise.
+    tier: str = "interactive"
 
     @property
     def prompt_len(self) -> int:
@@ -83,7 +98,9 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
                   prompt_hi: int = 64, out_lo: int = 2, out_typical: int = 16,
                   out_hi: int = 64, tail_frac: float = 0.25,
                   prefix_groups: int = 0, prefix_len: int = 0,
-                  max_len: Optional[int] = None) -> List[ServeRequest]:
+                  max_len: Optional[int] = None,
+                  deadline_slack: Optional[float] = None,
+                  batch_frac: float = 0.0) -> List[ServeRequest]:
     """Synthesize a deterministic request list for one benchmark run.
 
     ``max_len`` (the engine's stream capacity) caps prompt + output: the
@@ -98,11 +115,32 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
     whose length comes from the SAME bounded-Pareto mixture as plain
     traffic (the heavy tail rides on top of the shared head). Orthogonal
     to the arrival process — any of closed/poisson/bursty composes.
+
+    DEADLINES (``deadline_slack``): every open-loop request gets
+    ``deadline = arrival + deadline_slack`` (a flat virtual-time budget —
+    long requests really are harder to meet, which is the shed-vs-timeout
+    tradeoff the chaos harness measures). Closed-loop requests have no
+    arrival until the driver releases them, so the driver stamps
+    ``deadline = release + slack`` itself (servebench/servechaos do).
+
+    SLO TIERS (``batch_frac``): each request is drawn "batch" with this
+    probability from a SEPARATE seeded stream (``Random(f"{seed}:tier")``
+    — string seeding is SHA-512, platform-stable), so the tier mix bolts
+    onto the SAME prompts/arrivals as the untiered workload, bitwise: the
+    tiered-vs-plain A/B differs only in the labels. Interactive traffic
+    admits ahead of batch and batch is the preemptible lane
+    (serve/engine.py).
     """
     if arrival not in ARRIVALS:
         raise ValueError(f"arrival must be one of {ARRIVALS}, got {arrival!r}")
     if prefix_groups < 0 or prefix_len < 0:
         raise ValueError("prefix_groups and prefix_len must be >= 0")
+    if deadline_slack is not None and deadline_slack <= 0:
+        raise ValueError(
+            f"deadline_slack must be > 0 time units, got {deadline_slack}")
+    if not 0.0 <= batch_frac <= 1.0:
+        raise ValueError(
+            f"batch_frac is a probability in [0, 1], got {batch_frac}")
     if bool(prefix_groups) != bool(prefix_len):
         raise ValueError("shared-prefix traffic needs BOTH prefix_groups "
                          "and prefix_len (> 0)")
@@ -111,6 +149,9 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
             f"prefix_len {prefix_len} leaves no room for a tail + output "
             f"within max_len {max_len}")
     rng = random.Random(seed)
+    # tiers ride their own stream so a tier-mix A/B keeps the exact same
+    # prompts/arrivals (and batch_frac=0 consumes nothing anywhere)
+    trng = random.Random(f"{seed}:tier")
     prefixes = [
         np.array([rng.randrange(vocab) for _ in range(prefix_len)], np.int32)
         for _ in range(prefix_groups)
@@ -149,6 +190,12 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
             r = rate * burst_factor if in_burst else rate / burst_factor
             t += -math.log(1.0 - rng.random()) / r
             when = t
+        tier = "interactive"
+        if batch_frac and trng.random() < batch_frac:
+            tier = "batch"
+        deadline = (when + deadline_slack
+                    if deadline_slack is not None and when is not None
+                    else None)
         reqs.append(ServeRequest(rid=i, prompt=prompt, max_new=m,
-                                 arrival=when))
+                                 arrival=when, deadline=deadline, tier=tier))
     return reqs

@@ -78,7 +78,8 @@ def request_slo_ok(rec: Dict, slo_ttft: Optional[float] = None,
 
 def serve_summary(records: List[Dict], *, duration: float,
                   slo_ttft: Optional[float] = None,
-                  slo_itl: Optional[float] = None) -> Dict[str, float]:
+                  slo_itl: Optional[float] = None,
+                  per_tier: bool = False) -> Dict[str, float]:
     """Serving-side latency/goodput aggregation over completed requests.
 
     ``records`` are the engine's ``finished`` entries
@@ -99,16 +100,44 @@ def serve_summary(records: List[Dict], *, duration: float,
     snapshot taken at t=0) return the SAME key set with all-zero values —
     never a ZeroDivisionError, never a dropped field (consumers scrape
     these keys; tests/test_telemetry.py pins the edge paths).
+
+    ``per_tier=True`` (the SLO-tier split, ISSUE 15) additionally reports
+    ``{interactive,batch}_{completed, output_tokens, ttft_p50, ttft_p95,
+    itl_p50, slo_attainment, goodput_tokens_per_unit}`` — the same
+    definitions restricted to each tier's records (a record without a
+    ``tier`` field counts as interactive, the engine's default). The keys
+    are FLAG-GATED by this parameter so plain callers keep the pinned
+    schema; both tiers always appear (zeroes for an absent tier) so the
+    flagged schema is stable too.
     """
     ttfts, itls, good_tokens, total_tokens, n_ok = [], [], 0, 0, 0
+    # per-tier buckets fill in the SAME pass so the metric definitions
+    # (ttft, gap, SLO verdict, goodput) exist exactly once
+    by_tier = {t: {"ttft": [], "itl": [], "completed": 0, "tokens": 0,
+                   "ok": 0, "good": 0} for t in ("interactive", "batch")}
     for r in records:
-        ttfts.append(r["first_token_t"] - r["arrival"])
+        arrival = r["arrival"]
+        ttft = r["first_token_t"] - (arrival if arrival is not None
+                                     else 0.0)
         times = r["token_times"]
-        itls.extend(b - a for a, b in zip(times, times[1:]))
+        gaps = [b - a for a, b in zip(times, times[1:])]
+        ok = request_slo_ok(r, slo_ttft, slo_itl)
+        ttfts.append(ttft)
+        itls.extend(gaps)
         total_tokens += r["n_tokens"]
-        if request_slo_ok(r, slo_ttft, slo_itl):
+        if ok:
             n_ok += 1
             good_tokens += r["n_tokens"]
+        if per_tier:
+            b = by_tier.get(r.get("tier", "interactive"))
+            if b is not None:  # unknown tier labels fall in no bucket
+                b["ttft"].append(ttft)
+                b["itl"].extend(gaps)
+                b["completed"] += 1
+                b["tokens"] += r["n_tokens"]
+                if ok:
+                    b["ok"] += 1
+                    b["good"] += r["n_tokens"]
     out = {
         "completed": len(records),
         "output_tokens": total_tokens,
@@ -132,6 +161,17 @@ def serve_summary(records: List[Dict], *, duration: float,
         out["slo_ttft"] = slo_ttft
     if slo_itl is not None:
         out["slo_itl"] = slo_itl
+    if per_tier:
+        for tier, b in by_tier.items():
+            out[f"{tier}_completed"] = b["completed"]
+            out[f"{tier}_output_tokens"] = b["tokens"]
+            out[f"{tier}_ttft_p50"] = percentile(b["ttft"], 50.0)
+            out[f"{tier}_ttft_p95"] = percentile(b["ttft"], 95.0)
+            out[f"{tier}_itl_p50"] = percentile(b["itl"], 50.0)
+            out[f"{tier}_slo_attainment"] = (
+                b["ok"] / b["completed"] if b["completed"] else 0.0)
+            out[f"{tier}_goodput_tokens_per_unit"] = (
+                b["good"] / duration if duration > 0 else 0.0)
     return out
 
 

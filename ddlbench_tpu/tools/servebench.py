@@ -29,7 +29,19 @@ Usage:
         [--max-len 256] [--slo-ttft 16] [--slo-itl 2.0]
         [--shared-prefix 4:64] [--prefix-cache]
         [--sample temperature:0.8,top-k:40] [--kv-dtype int8]
-        [--speculative ngram:3:4] [--platform cpu]
+        [--speculative ngram:3:4] [--deadline-slack 64] [--retry 2:8]
+        [--tier-mix 0.5] [--heartbeat 16] [--platform cpu]
+
+Deadlines + SLO tiers (ISSUE 15): ``--deadline-slack S`` stamps every
+request with a completion deadline (arrival + S) — hopeless requests are
+SHED at admission (the driver retries with bounded backoff under
+``--retry N:B``, then rejects) and expired ones cancel into the named
+``timeout`` terminal state; ``--tier-mix F`` draws that fraction into
+the preemptible ``batch`` tier (interactive admits ahead, batch evicts
+first) with the per-tier TTFT/ITL/goodput split in the row. All the new
+counters are flag-gated; plain rows keep the pinned schema.
+tools/servechaos.py composes the same load with replica kill/stall
+injection.
 
 Raw-speed levers (ISSUE 13): ``--kv-dtype`` stores the shared KV pool in
 bf16 (half the f32 bytes) or int8 (a quarter — quantize-at-write with
@@ -60,6 +72,7 @@ bucket width).
 from __future__ import annotations
 
 import argparse
+import bisect
 import json
 import sys
 import time
@@ -71,6 +84,10 @@ import time
 _SPEC_FIELDS = frozenset((
     "spec_passes", "spec_drafted", "spec_accepted", "decode_tokens",
     "spec_accept_rate", "tokens_per_pass"))
+
+# engine stats keys that only carry signal under --deadline-slack
+# (admission shedding / timeout cancellation): same flag-gating pattern
+_CHAOS_FIELDS = frozenset(("shed", "timeouts"))
 
 
 def _round6(v):
@@ -85,55 +102,215 @@ def _round6(v):
     return v
 
 
-def _apply_resizes(server, clock: float, resizes):
-    """Fire every due ``(at, n)`` resize (a sorted list the caller
-    consumes): the live-fleet scale-up/down under load (engine.resize)."""
-    while resizes and clock >= resizes[0][0]:
-        at, n = resizes.pop(0)
+def parse_retry(spec, perr):
+    """Parse a ``--retry N:B`` spec (shared by servebench and servechaos
+    so the sibling tools cannot diverge on bounds): N >= 1 resubmissions,
+    base backoff B >= 0. Returns (N, B) or None for an absent spec."""
+    if not spec:
+        return None
+    try:
+        n_s, b_s = spec.split(":")
+        retry = (int(n_s), float(b_s))
+    except ValueError:
+        perr(f"--retry wants N:B (retries:base_backoff), got {spec!r}")
+    if retry[0] < 1 or retry[1] < 0:
+        perr(f"--retry {spec!r}: N >= 1 and B >= 0")
+    return retry
+
+
+def shed_accounting(requests, completed, shed, timeouts, driver_stats):
+    """Terminal-state accounting shared by servebench and servechaos —
+    the cross-tool no-loss gate must come from ONE formula: every request
+    ends completed, timed out, or rejected; anything else is lost
+    (``requests_lost == 0`` is the invariant the chaos gates pin)."""
+    retries = driver_stats.get("retries", 0)
+    rejected = driver_stats.get("rejected", 0)
+    submissions = requests + retries
+    return {
+        "retries": retries,
+        "rejected": rejected,
+        "requests_lost": requests - completed - timeouts - rejected,
+        # zero-requests guard: the degenerate row stays schema-stable
+        # with all-zero rates, never a ZeroDivisionError (the
+        # serve_summary contract)
+        "shed_rate": (round(shed / submissions, 6) if submissions else 0.0),
+        "timeout_rate": (round(timeouts / requests, 6)
+                         if requests else 0.0),
+        "retry_amplification": (round(submissions / requests, 6)
+                                if requests else 1.0),
+    }
+
+
+def _resize_fn(n: int):
+    def fire(server, clock):
         rep = server.resize(n, now=clock)
         print(f"servebench: resize @ {clock:g} -> {n} replicas "
               f"(evicted {rep['evicted']}, redistributed "
               f"{rep['redistributed']})", file=sys.stderr, flush=True)
+    return fire
 
 
-def run_open_loop(server, reqs, resizes=None):
-    """Release requests at their arrival times; returns the final clock."""
+def _merge_events(resizes, events):
+    """One sorted ``(at, fn(server, clock))`` schedule from the legacy
+    ``(at, n)`` resize specs plus arbitrary chaos injections (servechaos
+    passes kill/stall closures through ``events``)."""
+    ev = [(at, _resize_fn(n)) for at, n in (resizes or [])]
+    ev.extend(events or [])
+    ev.sort(key=lambda e: e[0])
+    return ev
+
+
+def _fire_events(server, clock: float, events):
+    """Fire every due ``(at, fn)`` event (a sorted list the caller
+    consumes) — resizes, replica kills, stalls."""
+    while events and clock >= events[0][0]:
+        at, fn = events.pop(0)
+        fn(server, clock)
+
+
+class _Submitter:
+    """Driver-side admission with the bounded retry-with-backoff policy
+    (ISSUE 15): a SHED submission (deadline admission control refused the
+    request) retries after ``backoff * 2**attempt`` time units, up to
+    ``retries`` times, then goes terminal as REJECTED — so shed rate and
+    retry amplification become reported numbers instead of silent driver
+    behavior. ``stats`` collects ``retries``/``rejected`` for the JSON
+    row. With no deadlines in the traffic nothing is ever shed and this
+    reduces to plain ``server.submit``."""
+
+    def __init__(self, server, retry=None, deadline_slack=None, stats=None):
+        self.server = server
+        self.retries, self.backoff = retry if retry else (0, 1.0)
+        self.slack = deadline_slack
+        self.pending = []  # (due, rid, attempt, req), sorted by due
+        self.stats = stats if stats is not None else {}
+        self.stats.setdefault("retries", 0)
+        self.stats.setdefault("rejected", 0)
+
+    def offer(self, req, clock: float, attempt: int = 0) -> str:
+        """One submission attempt -> "ok" | "retry" | "rejected"."""
+        if req.arrival is None:
+            req.arrival = clock  # closed loop stamps at release
+        if self.slack is not None and req.deadline is None:
+            # closed-loop deadline stamp: the workload could not know the
+            # release time (open-loop requests arrive pre-stamped)
+            req.deadline = req.arrival + self.slack
+        if self.server.submit(req, now=clock):
+            return "ok"
+        if attempt < self.retries:
+            self.stats["retries"] += 1
+            bisect.insort(self.pending,
+                          (clock + self.backoff * (2 ** attempt),
+                           req.rid, attempt + 1, req))
+            return "retry"
+        self.stats["rejected"] += 1
+        return "rejected"
+
+    def release_due(self, clock: float) -> int:
+        """Fire due retries; returns how many went terminal (rejected)."""
+        dead = 0
+        while self.pending and self.pending[0][0] <= clock:
+            _, _, attempt, req = self.pending.pop(0)
+            if self.offer(req, clock, attempt) == "rejected":
+                dead += 1
+        return dead
+
+    def next_due(self):
+        return self.pending[0][0] if self.pending else None
+
+
+def run_open_loop(server, reqs, resizes=None, events=None, retry=None,
+                  deadline_slack=None, driver_stats=None):
+    """Release requests at their arrival times; returns the final clock.
+    ``events`` is a list of timed ``(at, fn(server, clock))`` injections
+    (resizes are sugar for them); ``retry=(N, backoff)`` arms the shed
+    retry policy and ``driver_stats`` (a dict) receives its counters."""
     clock, i = 0.0, 0
-    resizes = list(resizes or [])
+    ev = _merge_events(resizes, events)
+    sub = _Submitter(server, retry, deadline_slack, driver_stats)
     pend = sorted(reqs, key=lambda r: (r.arrival, r.rid))
-    while i < len(pend) or server.has_work():
-        _apply_resizes(server, clock, resizes)
+    while i < len(pend) or sub.pending or server.has_work():
+        _fire_events(server, clock, ev)
+        sub.release_due(clock)
         while i < len(pend) and pend[i].arrival <= clock:
-            server.submit(pend[i])
+            sub.offer(pend[i], clock)
             i += 1
         if not server.has_work():
-            clock = pend[i].arrival  # idle: jump to the next arrival
+            # idle: jump to the next arrival, pending retry, or
+            # scheduled injection — skipping events here would fire a
+            # kill/stall/resize under DIFFERENT load than its schedule
+            # asked for (events dated past the end of all work still
+            # never fire; the loop exits first, surfaced by the caller)
+            nxts = [t for t in (
+                pend[i].arrival if i < len(pend) else None,
+                sub.next_due(),
+                ev[0][0] if ev else None) if t is not None]
+            if not nxts:
+                break
+            clock = max(clock, min(nxts))
             continue
         rep = server.step(clock)
         clock += rep.cost
     return clock
 
 
-def run_closed_loop(server, reqs, concurrency: int, resizes=None):
-    """Keep ``concurrency`` requests in flight; each completion releases
-    the next. Returns the final clock."""
-    clock, nxt = 0.0, 0
-    resizes = list(resizes or [])
-    for _ in range(min(concurrency, len(reqs))):
-        reqs[nxt].arrival = clock
-        server.submit(reqs[nxt])
-        nxt += 1
-    done = 0
-    while done < len(reqs):
-        _apply_resizes(server, clock, resizes)
+def run_closed_loop(server, reqs, concurrency: int, resizes=None,
+                    events=None, retry=None, deadline_slack=None,
+                    driver_stats=None):
+    """Keep ``concurrency`` requests in flight; each TERMINAL event —
+    completion, timeout, or a shed request exhausting its retries —
+    releases the next. Returns the final clock."""
+    clock, nxt, done = 0.0, 0, 0
+    ev = _merge_events(resizes, events)
+    sub = _Submitter(server, retry, deadline_slack, driver_stats)
+    n = len(reqs)
+    outstanding = 0  # released and not yet terminal (incl. pending retry)
+
+    def top_up():
+        nonlocal nxt, done, outstanding
+        while nxt < n and outstanding < concurrency:
+            st = sub.offer(reqs[nxt], clock)
+            nxt += 1
+            if st == "rejected":
+                done += 1
+            else:
+                outstanding += 1
+
+    top_up()
+    while done < n:
+        _fire_events(server, clock, ev)
+        dead = sub.release_due(clock)
+        done += dead
+        outstanding -= dead
+        top_up()
+        if not server.has_work():
+            # jump to the next retry or scheduled injection (same
+            # fire-at-the-scheduled-load contract as the open loop)
+            nxts = [t for t in (sub.next_due(),
+                                ev[0][0] if ev else None)
+                    if t is not None]
+            if nxts:
+                clock = max(clock, min(nxts))
+                continue
+            if outstanding:
+                # a server-INTERNAL shed (failover/drain/resize under
+                # deadlines retires a request without any driver-visible
+                # completion/timeout) would otherwise hold its
+                # concurrency slot forever and strand the rest of the
+                # workload — reconcile: the vanished requests are
+                # terminal (they surface in requests_lost) and their
+                # slots release the tail
+                done += outstanding
+                outstanding = 0
+                top_up()
+                continue
+            break  # everything released went terminal
         rep = server.step(clock)
         clock += rep.cost
-        done += len(rep.completed)
-        for _ in rep.completed:
-            if nxt < len(reqs):
-                reqs[nxt].arrival = clock
-                server.submit(reqs[nxt])
-                nxt += 1
+        term = len(rep.completed) + len(rep.timed_out)
+        done += term
+        outstanding -= term
+        top_up()
     return clock
 
 
@@ -218,6 +395,34 @@ def main(argv=None) -> int:
                    help="TTFT SLO in time units (model passes)")
     p.add_argument("--slo-itl", type=float, default=2.0,
                    help="mean inter-token-latency SLO in time units")
+    p.add_argument("--deadline-slack", type=float, default=None,
+                   metavar="S",
+                   help="per-request completion deadline = arrival + S "
+                        "time units: the engine SHEDS a request at "
+                        "admission when its projected completion already "
+                        "misses the deadline (named rejection; see "
+                        "--retry) and cancels an expired one into the "
+                        "named `timeout` terminal state with all pages "
+                        "freed. The row gains shed/timeouts/retries/"
+                        "rejected/requests_lost + rate fields; plain rows "
+                        "keep the pinned schema")
+    p.add_argument("--retry", default=None, metavar="N:B",
+                   help="bounded retry-with-backoff for SHED requests: up "
+                        "to N resubmissions, the k-th after B*2^k time "
+                        "units — after N the request is terminally "
+                        "rejected. Only meaningful with --deadline-slack")
+    p.add_argument("--tier-mix", type=float, default=None, metavar="F",
+                   help="SLO tiers (ROADMAP 2c): each request is drawn "
+                        "tier=batch with probability F (else interactive)."
+                        " Interactive admits ahead of batch and batch is "
+                        "the preemptible eviction lane; the row gains "
+                        "per-tier TTFT/ITL/goodput/attainment splits")
+    p.add_argument("--heartbeat", type=float, default=0.0, metavar="W",
+                   help="serve-side straggler heartbeat: a replica "
+                        "holding work with no progress for > W time units "
+                        "is drained and its requests redistribute to the "
+                        "survivors (0 = off; mostly exercised by "
+                        "servechaos stall injection)")
     p.add_argument("--trace", default=None, metavar="PATH",
                    help="record the request-lifecycle trace (virtual-time "
                         "spans/counters, one track per request per replica)"
@@ -293,6 +498,16 @@ def main(argv=None) -> int:
         except ValueError:
             p.error("--shared-prefix wants G:P (groups:prefix_tokens), "
                     f"got {args.shared_prefix!r}")
+    retry = parse_retry(args.retry, p.error)
+    if args.deadline_slack is not None and args.deadline_slack <= 0:
+        p.error("--deadline-slack must be > 0 time units")
+    if args.retry and args.deadline_slack is None:
+        p.error("--retry retries SHED submissions; nothing is ever shed "
+                "without --deadline-slack")
+    if args.tier_mix is not None and not 0.0 <= args.tier_mix <= 1.0:
+        p.error("--tier-mix is a probability in [0, 1]")
+    if args.heartbeat < 0:
+        p.error("--heartbeat must be >= 0 time units (0 = off)")
     resizes = []
     for rspec in args.resize:
         try:
@@ -328,6 +543,7 @@ def main(argv=None) -> int:
         replicas=args.replicas, temperature=temperature, top_k=top_k,
         sample_seed=args.seed, trace=bool(args.trace),
         slo_ttft=args.slo_ttft, slo_itl=args.slo_itl,
+        heartbeat=args.heartbeat,
         kv_dtype=args.kv_dtype or "float32",
         speculative=args.speculative or "none")
 
@@ -349,7 +565,9 @@ def main(argv=None) -> int:
             prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
             out_lo=olo, out_typical=otyp, out_hi=ohi,
             tail_frac=args.tail_frac, prefix_groups=groups,
-            prefix_len=prefix_len, max_len=cfg.max_len)
+            prefix_len=prefix_len, max_len=cfg.max_len,
+            deadline_slack=args.deadline_slack,
+            batch_frac=args.tier_mix or 0.0)
         # policy rows share the compiled programs (identical model and
         # shapes — policy/prefix_cache are host-side decisions), so only
         # the first row pays the trace
@@ -367,13 +585,19 @@ def main(argv=None) -> int:
 
             prev_tracer = get_tracer()
             tracer = set_tracer(Tracer(args.trace_capacity)).enable()
+        dstats = {}
         t0 = time.perf_counter()
         try:
             if args.arrival == "closed":
                 duration = run_closed_loop(server, reqs, args.concurrency,
-                                           resizes=resizes)
+                                           resizes=resizes, retry=retry,
+                                           deadline_slack=args.deadline_slack,
+                                           driver_stats=dstats)
             else:
-                duration = run_open_loop(server, reqs, resizes=resizes)
+                duration = run_open_loop(server, reqs, resizes=resizes,
+                                         retry=retry,
+                                         deadline_slack=args.deadline_slack,
+                                         driver_stats=dstats)
         finally:
             if tracer is not None:
                 tracer.disable()
@@ -415,6 +639,17 @@ def main(argv=None) -> int:
                   + (f" ({tracer.dropped_events} dropped: ring full)"
                      if tracer.dropped_events else ""),
                   file=sys.stderr, flush=True)
+        fin = server.finished
+        summary = serve_summary(fin, duration=duration,
+                                slo_ttft=args.slo_ttft,
+                                slo_itl=args.slo_itl,
+                                per_tier=args.tier_mix is not None)
+        eng_stats = server.stats_summary()
+        chaos = args.deadline_slack is not None
+        acct = shed_accounting(args.requests, len(fin),
+                               int(eng_stats["shed"]),
+                               int(eng_stats["timeouts"]), dstats)
+        lost = acct["requests_lost"]
         rec = {
             "tool": "servebench",
             "model": args.model,
@@ -438,16 +673,16 @@ def main(argv=None) -> int:
             "sample": args.sample,
             "time_unit": "model_pass",
             **{k: (round(v, 6) if isinstance(v, float) else v)
-               for k, v in serve_summary(
-                   server.finished, duration=duration,
-                   slo_ttft=args.slo_ttft, slo_itl=args.slo_itl).items()},
+               for k, v in summary.items()},
             **{k: (round(v, 6) if isinstance(v, float) else v)
-               for k, v in server.stats_summary().items()
+               for k, v in eng_stats.items()
                # serve_summary already reports completed; the speculative
-               # fields are flag-gated (all zero when spec is off) so a
-               # plain row keeps the schema-pinned key set
-               if k != "completed" and (args.speculative
-                                        or k not in _SPEC_FIELDS)},
+               # and deadline counters are flag-gated (all zero when the
+               # flags are off) so a plain row keeps the schema-pinned
+               # key set
+               if k != "completed"
+               and (args.speculative or k not in _SPEC_FIELDS)
+               and (chaos or k not in _CHAOS_FIELDS)},
             # --kv-dtype / --speculative only (plain rows keep the
             # schema-pinned key set): the A/B axis made explicit
             **({"kv_dtype": cfg.kv_dtype} if args.kv_dtype else {}),
@@ -457,6 +692,21 @@ def main(argv=None) -> int:
             # component breakdowns (absent otherwise so a plain row stays
             # bitwise identical traced or untraced)
             **timeline_fields,
+            # --deadline-slack only (plain rows keep the schema-pinned
+            # key set): the deadline knob, the driver's retry policy
+            # outcome, and the shed/timeout economics as rates
+            **({"deadline_slack": args.deadline_slack,
+                "retry": args.retry, **acct}
+               if chaos else {}),
+            # --tier-mix only: the per-tier summary split rides the
+            # serve_summary merge above; this records the mix itself
+            **({"tier_mix": args.tier_mix}
+               if args.tier_mix is not None else {}),
+            # --heartbeat only: straggler drains (servechaos's stall
+            # injections are where these fire)
+            **({"heartbeat": args.heartbeat,
+                "heartbeat_drains": len(server.heartbeat_events)}
+               if args.heartbeat else {}),
             # --resize only (plain rows keep the schema-pinned key set):
             # the resize schedule, what each event displaced, the final
             # fleet size, and the no-request-lost invariant made explicit
@@ -468,8 +718,7 @@ def main(argv=None) -> int:
                 # never reached its scheduled size
                 "resizes_unfired": len(resizes) - len(server.resize_events),
                 "final_replicas": len(server.engines),
-                "requests_lost":
-                    args.requests - len(server.finished)}
+                "requests_lost": lost}
                if args.resize else {}),
             # actual backend record (shared classification —
             # distributed.backend_provenance); cpu-fallback rows must be

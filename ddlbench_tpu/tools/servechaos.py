@@ -1,0 +1,413 @@
+"""Serving-fleet chaos benchmark: replica kills, stragglers, deadlines.
+
+chaosbench (tools/chaosbench.py) made TRAINING failure a benchmark
+dimension — kill/preempt/shrink/grow under a supervisor, with bitwise
+resume as the pass/fail gate. This is its SERVING sibling: it drives the
+continuous-batching fleet (serve/engine.py ReplicatedServer) with a
+seeded servebench workload while injecting replica faults, and reports
+recovery as numbers with the same repro discipline — one JSON line,
+bitwise-reproducible in virtual time (1 unit = 1 model pass).
+
+Faults (virtual-time schedule, repeatable flags):
+
+* ``--kill T:R``  — HARD-KILL the replica at fleet index R at time T:
+  its pool (all resident KV) is lost, finished records are salvaged, and
+  every request it held is resubmitted least-loaded onto the survivors,
+  where eviction/recompute regenerates the token streams from scratch.
+  The gates: ``requests_lost == 0`` (every request reaches a terminal
+  state) and ``streams_match`` — the failed-over streams are BITWISE
+  equal to an unfaulted control run of the same workload (greedy/seeded
+  sampling are pure functions of (params, prompt, rid, token index) —
+  the PR 12 resize argument, now under uncoordinated loss).
+* ``--stall T:R:D`` — STRAGGLER: the replica stops progressing for D
+  global steps while holding its requests (grey failure — nothing died).
+  With ``--heartbeat W`` the serve-side no-progress detector
+  (train/watchdog.ProgressMonitor on the virtual clock) drains it within
+  the detection window and redistributes its requests like a scale-down.
+* ``--deadline-slack S`` / ``--retry N:B`` / ``--tier-mix F`` — the
+  deadline + SLO-tier load shape (shared with servebench): expired
+  requests cancel into the named ``timeout`` terminal state, admission
+  SHEDS requests whose projected completion already misses the deadline,
+  the driver retries sheds with bounded backoff, and interactive traffic
+  admits ahead of (and preempts) the batch tier.
+
+Reported: ``mttr_replica_s`` — per kill, the virtual time from the kill
+until the LAST displaced in-flight request emits its first post-failover
+token (mean over kills; the ``_s`` suffix keeps chaosbench's field-naming
+symmetry, but the unit is model passes unless you read ``wall_s``) —
+plus ``requests_lost`` (gate: 0 for failover-covered kills),
+``streams_match``/``streams_diverged`` vs the unfaulted control,
+shed/timeout/retry rates, per-tier SLO attainment, heartbeat drains, and
+the final fleet size.
+
+Usage:
+    python -m ddlbench_tpu.tools.servechaos [-m transformer_s]
+        [-b synthtext] [--replicas 2] [--kill 12:1] [--stall 8:0:6]
+        [--heartbeat 4] [--deadline-slack 32] [--retry 2:4]
+        [--tier-mix 0.5] [--arrival poisson|bursty|closed] [--rate 0.5]
+        [--requests 64] [--no-control] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def _parse_kills(specs, perr):
+    out = []
+    for s in specs:
+        try:
+            t_s, r_s = s.split(":")
+            out.append((float(t_s), int(r_s)))
+        except ValueError:
+            perr(f"--kill wants T:R (virtual_time:fleet_index), got {s!r}")
+        if out[-1][0] < 0 or out[-1][1] < 0:
+            perr(f"--kill {s!r}: T >= 0 and R >= 0")
+    return out
+
+
+def _parse_stalls(specs, perr):
+    out = []
+    for s in specs:
+        try:
+            t_s, r_s, d_s = s.split(":")
+            out.append((float(t_s), int(r_s), int(d_s)))
+        except ValueError:
+            perr(f"--stall wants T:R:D (time:fleet_index:ticks), got {s!r}")
+        if out[-1][0] < 0 or out[-1][1] < 0 or out[-1][2] < 1:
+            perr(f"--stall {s!r}: T >= 0, R >= 0, D >= 1")
+    return out
+
+
+def _fault_events(kills, stalls):
+    """The drivers' timed-injection schedule: kills and stalls as
+    ``(at, fn(server, clock))`` closures (tools/servebench._fire_events).
+    Fleet indices are resolved AT FIRE TIME — a kill shrinks the fleet,
+    so later specs address the surviving fleet's positions."""
+    ev = []
+
+    def kill_fn(r):
+        def fire(server, clock):
+            rep = server.fail(r, now=clock)
+            print(f"servechaos: kill @ {clock:g} -> replica "
+                  f"{rep['replica_id']} (salvaged {rep['salvaged']}, "
+                  f"displaced {len(rep['displaced_inflight'])} in-flight "
+                  f"+ {rep['displaced_queued']} queued)",
+                  file=sys.stderr, flush=True)
+        return fire
+
+    def stall_fn(r, d):
+        def fire(server, clock):
+            server.stall(r, d, now=clock)
+            print(f"servechaos: stall @ {clock:g} -> replica index {r} "
+                  f"for {d} steps", file=sys.stderr, flush=True)
+        return fire
+
+    for t, r in kills:
+        ev.append((t, kill_fn(r)))
+    for t, r, d in stalls:
+        ev.append((t, stall_fn(r, d)))
+    ev.sort(key=lambda e: e[0])
+    return ev
+
+
+def _run(server, reqs, args, retry, events=None, driver_stats=None):
+    from ddlbench_tpu.tools.servebench import run_closed_loop, run_open_loop
+
+    if args.arrival == "closed":
+        return run_closed_loop(server, reqs, args.concurrency,
+                               events=events, retry=retry,
+                               deadline_slack=args.deadline_slack,
+                               driver_stats=driver_stats)
+    return run_open_loop(server, reqs, events=events, retry=retry,
+                         deadline_slack=args.deadline_slack,
+                         driver_stats=driver_stats)
+
+
+def mttr_from_events(fail_events, finished):
+    """Per-kill recovery: the virtual time from the kill instant until
+    the LAST displaced in-flight request emitted its first post-failover
+    token (its replay's ``first_token_t`` — the failover stream restarts
+    from scratch, so that IS the post-kill first emission). Displaced
+    requests that never completed (timed out / shed on failover) are
+    excluded from that kill's sample; a kill with no recoverable sample
+    reports None."""
+    fin = {f["rid"]: f for f in finished}
+    out = []
+    for ev in fail_events:
+        recov = [fin[rid]["first_token_t"] - ev["t"]
+                 for rid in ev["displaced_inflight"] if rid in fin]
+        out.append(max(recov) if recov else None)
+    return out
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("-m", "--model", default="transformer_s")
+    p.add_argument("-b", "--benchmark", default="synthtext")
+    p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--kill", action="append", default=[], metavar="T:R",
+                   help="hard-kill the replica at fleet index R at "
+                        "virtual time T (repeatable; pool lost, records "
+                        "salvaged, requests failed over bitwise)")
+    p.add_argument("--stall", action="append", default=[], metavar="T:R:D",
+                   help="straggler: replica at fleet index R makes no "
+                        "progress for D global steps starting at time T "
+                        "(repeatable; pairs with --heartbeat)")
+    p.add_argument("--heartbeat", type=float, default=0.0, metavar="W",
+                   help="no-progress detection window in time units: a "
+                        "stalled replica holding work is drained after W "
+                        "(0 = no detection; the stall just delays)")
+    p.add_argument("--deadline-slack", type=float, default=None, metavar="S",
+                   help="per-request completion deadline = arrival + S "
+                        "(expired -> named `timeout`; hopeless at "
+                        "admission -> named `shed`)")
+    p.add_argument("--retry", default=None, metavar="N:B",
+                   help="driver retry policy for shed requests: N "
+                        "retries, k-th after B*2^k time units")
+    p.add_argument("--tier-mix", type=float, default=None, metavar="F",
+                   help="fraction of requests in the preemptible `batch` "
+                        "tier (interactive admits ahead, batch evicts "
+                        "first; per-tier SLO split reported)")
+    p.add_argument("--max-batch", type=int, default=8)
+    p.add_argument("--pool-pages", type=int, default=64)
+    p.add_argument("--page", type=int, default=16)
+    p.add_argument("--max-len", type=int, default=256)
+    p.add_argument("--prefill-chunk", type=int, default=None)
+    p.add_argument("--token-budget", type=int, default=0)
+    p.add_argument("--arrival", default="poisson",
+                   choices=("poisson", "bursty", "closed"))
+    p.add_argument("--rate", type=float, default=0.5)
+    p.add_argument("--burst-size", type=int, default=8)
+    p.add_argument("--burst-factor", type=float, default=4.0)
+    p.add_argument("--concurrency", type=int, default=16)
+    p.add_argument("--requests", type=int, default=64)
+    p.add_argument("--prompt-lens", default="4,16,64")
+    p.add_argument("--out-lens", default="2,16,64")
+    p.add_argument("--tail-frac", type=float, default=0.25)
+    p.add_argument("--slo-ttft", type=float, default=16.0)
+    p.add_argument("--slo-itl", type=float, default=2.0)
+    p.add_argument("--kv-dtype", default=None,
+                   choices=("float32", "bfloat16", "int8"))
+    p.add_argument("--speculative", default=None, metavar="ngram:N:K")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--no-control", action="store_true",
+                   help="skip the unfaulted control run (streams_match "
+                        "reported as null)")
+    p.add_argument("--wall-clock", action="store_true")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    from ddlbench_tpu.tools.servebench import parse_retry
+
+    kills = _parse_kills(args.kill, p.error)
+    stalls = _parse_stalls(args.stall, p.error)
+    retry = parse_retry(args.retry, p.error)
+    if args.deadline_slack is not None and args.deadline_slack <= 0:
+        p.error("--deadline-slack must be > 0 time units")
+    if args.retry and args.deadline_slack is None:
+        p.error("--retry needs --deadline-slack (nothing else sheds)")
+    if args.tier_mix is not None and not 0.0 <= args.tier_mix <= 1.0:
+        p.error("--tier-mix is a probability in [0, 1]")
+    if args.heartbeat < 0:
+        p.error("--heartbeat must be >= 0 (0 = off)")
+    if args.replicas < 2 and kills:
+        p.error("--kill needs --replicas >= 2 (a survivor to fail over to)")
+    # statically hopeless schedules die HERE, not with an uncaught
+    # traceback after the control run already burned its compiles: every
+    # kill GUARANTEES the fleet shrinks by one, so walking the kill
+    # schedule in time order bounds each spec's valid indices exactly
+    # (heartbeat drains can still shrink the fleet below a later spec's
+    # index at runtime — fail() raises loudly in that case)
+    size = args.replicas
+    # sort by time ONLY (stable): equal-time kills fire in spec order at
+    # runtime, and tuple-sorting by (t, index) would walk a different
+    # order and falsely reject e.g. `--kill 5:2 --kill 5:0`
+    for t, r in sorted(kills, key=lambda k: k[0]):
+        if size <= 1:
+            p.error(f"--kill {t:g}:{r}: the fleet is already down to its "
+                    f"last replica by t={t:g} ({args.replicas} replicas, "
+                    f"{len(kills)} kills)")
+        if r >= size:
+            p.error(f"--kill {t:g}:{r}: fleet index {r} out of range — "
+                    f"at most {size} replicas remain by t={t:g}")
+        size -= 1
+    for t, r, d in stalls:
+        # a stall's valid indices also shrink with every kill that fires
+        # before (or, by the event sort's kill-first tie-break, at) it
+        size_at_t = args.replicas - sum(1 for kt, _ in kills if kt <= t)
+        if r >= size_at_t:
+            p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of range "
+                    f"— at most {size_at_t} replicas remain by t={t:g} "
+                    f"({args.replicas} replicas, kills before it)")
+    if stalls and not args.heartbeat:
+        print("servechaos: WARNING --stall without --heartbeat: the "
+              "straggler is never detected, its requests just wait it "
+              "out", file=sys.stderr, flush=True)
+    apply_platform(args.platform)
+
+    import jax
+
+    from ddlbench_tpu.distributed import (backend_provenance,
+                                          enable_compilation_cache,
+                                          warn_cpu_fallback)
+
+    enable_compilation_cache()
+    prov = backend_provenance(args.platform)
+    warn_cpu_fallback(prov, "servechaos")
+
+    from ddlbench_tpu.config import DATASETS, ServeConfig
+    from ddlbench_tpu.models import init_model
+    from ddlbench_tpu.models.zoo import get_model
+    from ddlbench_tpu.serve.engine import make_server, supports_serve
+    from ddlbench_tpu.serve.workload import make_workload
+    from ddlbench_tpu.telemetry.stats import serve_summary
+
+    spec = DATASETS[args.benchmark]
+    if spec.kind != "tokens":
+        p.error(f"-b {args.benchmark!r} is not a causal-LM token workload")
+    model = get_model(args.model, spec)
+    if not supports_serve(model):
+        p.error(f"{args.model} has layers without serving support")
+    params, state, _ = init_model(model, jax.random.key(0))
+
+    plo, ptyp, phi = (int(x) for x in args.prompt_lens.split(","))
+    olo, otyp, ohi = (int(x) for x in args.out_lens.split(","))
+    cfg = ServeConfig(
+        max_batch=args.max_batch, pool_pages=args.pool_pages,
+        page=args.page, max_len=min(args.max_len, spec.seq_len),
+        token_budget=args.token_budget,
+        prefill_chunk=(args.page if args.prefill_chunk is None
+                       else args.prefill_chunk),
+        replicas=args.replicas, slo_ttft=args.slo_ttft,
+        slo_itl=args.slo_itl, heartbeat=args.heartbeat,
+        kv_dtype=args.kv_dtype or "float32",
+        speculative=args.speculative or "none")
+    cfg.validate()
+
+    def workload():
+        # fresh per run: closed-loop drivers stamp arrivals/deadlines
+        return make_workload(
+            seed=args.seed, n_requests=args.requests,
+            vocab=spec.num_classes, arrival=args.arrival, rate=args.rate,
+            burst_size=args.burst_size, burst_factor=args.burst_factor,
+            prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
+            out_lo=olo, out_typical=otyp, out_hi=ohi,
+            tail_frac=args.tail_frac, max_len=cfg.max_len,
+            deadline_slack=args.deadline_slack,
+            batch_frac=args.tier_mix or 0.0)
+
+    t0 = time.perf_counter()
+    # -- control: the same workload, no faults — the bitwise stream
+    # reference and the unfaulted goodput baseline (skippable)
+    control = None
+    shared_fns = None
+    if not args.no_control:
+        control = make_server(model, params, state, cfg)
+        shared_fns = control.engines[0].jit_fns()
+        _run(control, workload(), args, retry)
+    # -- the chaos run
+    server = make_server(model, params, state, cfg, shared_fns=shared_fns)
+    dstats = {}
+    duration = _run(server, workload(), args, retry,
+                    events=_fault_events(kills, stalls),
+                    driver_stats=dstats)
+    wall = time.perf_counter() - t0
+
+    fin = server.finished
+    eng_stats = server.stats_summary()
+    summary = serve_summary(fin, duration=duration, slo_ttft=args.slo_ttft,
+                            slo_itl=args.slo_itl,
+                            per_tier=args.tier_mix is not None)
+    from ddlbench_tpu.tools.servebench import shed_accounting
+
+    acct = shed_accounting(args.requests, len(fin),
+                           int(eng_stats["shed"]),
+                           int(eng_stats["timeouts"]), dstats)
+    mttrs = mttr_from_events(server.fail_events, fin)
+    mttr_ok = [m for m in mttrs if m is not None]
+    # bitwise failover gate: every rid completed in BOTH runs must carry
+    # the identical token stream; the compared set is the intersection
+    # (deadline runs can legitimately time out different rids per run)
+    streams_match = None
+    streams_compared = streams_diverged = 0
+    if control is not None:
+        ctrl_fin = {f["rid"]: f["tokens"] for f in control.finished}
+        run_fin = {f["rid"]: f["tokens"] for f in fin}
+        both = sorted(set(ctrl_fin) & set(run_fin))
+        streams_compared = len(both)
+        streams_diverged = sum(1 for rid in both
+                               if ctrl_fin[rid] != run_fin[rid])
+        streams_match = streams_diverged == 0
+
+    rec = {
+        "tool": "servechaos",
+        "model": args.model,
+        "benchmark": args.benchmark,
+        "arrival": args.arrival,
+        "rate": args.rate if args.arrival != "closed" else None,
+        "concurrency": (args.concurrency if args.arrival == "closed"
+                        else None),
+        "requests": args.requests,
+        "seed": args.seed,
+        "replicas": args.replicas,
+        "max_batch": cfg.max_batch,
+        "pool_pages": cfg.pool_pages,
+        "page": cfg.page,
+        "max_len": cfg.max_len,
+        "time_unit": "model_pass",
+        # the injection schedule as given + what actually happened
+        "kill": args.kill,
+        "stall": args.stall,
+        "heartbeat": args.heartbeat,
+        "deadline_slack": args.deadline_slack,
+        "retry": args.retry,
+        "tier_mix": args.tier_mix,
+        "kv_dtype": cfg.kv_dtype,
+        "speculative": cfg.speculative,
+        "kills_fired": len(server.fail_events),
+        "stalls_fired": len(server.stall_events),
+        "heartbeat_drains": len(server.heartbeat_events),
+        "fail_events": server.fail_events,
+        "heartbeat_events": [
+            {k: (round(v, 6) if isinstance(v, float) else v)
+             for k, v in e.items()} for e in server.heartbeat_events],
+        # recovery: virtual time from each kill to the last displaced
+        # request's first post-failover token
+        "mttr_replica_s": [m if m is None else round(m, 6) for m in mttrs],
+        "mttr_replica_s_mean": (round(sum(mttr_ok) / len(mttr_ok), 6)
+                                if mttr_ok else None),
+        "mttr_replica_s_max": (round(max(mttr_ok), 6) if mttr_ok else None),
+        # terminal-state accounting (the no-loss gate) — ONE formula
+        # shared with servebench (shed_accounting)
+        **acct,
+        "timeouts": int(eng_stats["timeouts"]),
+        "shed": int(eng_stats["shed"]),
+        # bitwise failover gate vs the unfaulted control
+        "streams_match": streams_match,
+        "streams_compared": streams_compared,
+        "streams_diverged": streams_diverged,
+        "control_completed": (len(control.finished)
+                              if control is not None else None),
+        "final_replicas": len(server.engines),
+        **{k: (round(v, 6) if isinstance(v, float) else v)
+           for k, v in summary.items()},
+        # completed comes from serve_summary; timeouts/shed are already
+        # in the row as exact ints (the spread would re-insert floats)
+        **{k: (round(v, 6) if isinstance(v, float) else v)
+           for k, v in eng_stats.items()
+           if k not in ("completed", "timeouts", "shed")},
+        **prov,
+    }
+    if args.wall_clock:
+        rec["wall_s"] = round(wall, 3)
+    print(json.dumps(rec), flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

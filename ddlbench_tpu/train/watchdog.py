@@ -62,6 +62,44 @@ def check_finite(loss: float, epoch: int, step: int, policy: str = "abort",
     return False
 
 
+class ProgressMonitor:
+    """Clock-agnostic no-progress detector: the deadline logic of
+    :class:`HangWatchdog` with the wall clock factored OUT. ``kick(now)``
+    records progress on whatever monotone timeline the caller runs —
+    ``time.monotonic()``, a global step counter, or the serving engine's
+    virtual model-pass clock — and ``expired(now)`` is True once more
+    than ``window`` of that timeline has passed without a kick.
+
+    This is what lets the serving fleet reuse the training watchdog's
+    detection rule (SURVEY.md §5.3's answer) in VIRTUAL time, where a
+    thread + ``time.monotonic()`` would be meaningless: ReplicatedServer
+    kicks a replica's monitor every step it schedules work, and a replica
+    that holds requests while its monitor expires is a straggler to drain
+    (serve/engine.py). Pure host arithmetic — no threads, deterministic,
+    jax-free like the rest of this module.
+    """
+
+    def __init__(self, window: float, now: float = 0.0):
+        if window <= 0:
+            raise ValueError("window must be positive")
+        self.window = window
+        self._last = now
+
+    def kick(self, now: float) -> None:
+        """Record progress at ``now``; postpones expiry by ``window``."""
+        self._last = now
+
+    def expired(self, now: float) -> bool:
+        return now - self._last > self.window
+
+    @property
+    def last_progress(self) -> float:
+        return self._last
+
+    def stalled_for(self, now: float) -> float:
+        return now - self._last
+
+
 def _default_on_timeout(timeout_s: float) -> None:
     print(
         f"HANG: no progress for {timeout_s:.0f}s — dumping stacks and aborting",

@@ -1,0 +1,667 @@
+"""Serving-fleet chaos (ISSUE 15) coverage.
+
+The binding contracts:
+
+* **Bitwise failover** — a hard replica kill loses the pool but no
+  request: everything the dead replica held is resubmitted least-loaded
+  and the recompute path regenerates token streams BITWISE equal to an
+  unfaulted control (the PR 12 resize argument under uncoordinated loss).
+  ``requests_lost == 0`` and exactly-once finished records are the gates.
+* **Heartbeat straggler detection** — a stalled replica holding work is
+  drained within the detection window and its requests complete
+  elsewhere, streams bitwise.
+* **Deadlines** — hopeless requests SHED at admission (named rejection,
+  driver retry-with-backoff), expired ones cancel into the named
+  ``timeout`` terminal state with every page freed.
+* **SLO tiers (ROADMAP 2c)** — interactive admits ahead of batch, batch
+  is evicted first under pool pressure, preempted batch requests still
+  complete with bitwise streams, and interactive SLO attainment lands
+  strictly above batch on the overload fixture.
+
+Engine tests ride the session ``serve_factory`` at the serve suites'
+dominant (page 4, max_len 16) shapes so no new program variants compile
+(tier-1 budget); the servechaos e2e uses the same tiny LM the servebench
+e2e already compiles.
+"""
+
+import contextlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.servechaos
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve.workload import (ServeRequest,  # noqa: E402
+                                         make_workload)
+from ddlbench_tpu.telemetry.stats import serve_summary  # noqa: E402
+from ddlbench_tpu.train.watchdog import ProgressMonitor  # noqa: E402
+
+VOCAB = TINY_LM.num_classes
+
+
+def _serve_cfg(**kw):
+    # page 4 / max_len 16 / pool 20 / max_batch 4: test_elastic's resize
+    # shapes — the session serve_factory's compiled npl variants are
+    # shared, not paid again here (tier-1 budget)
+    base = dict(max_batch=4, pool_pages=20, page=4, max_len=16,
+                prefill_chunk=4, replicas=2)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _ecfg(**kw):
+    # test_serve's mixed-step shapes (max_batch 2, pool 9)
+    base = dict(max_batch=2, pool_pages=9, page=4, max_len=16,
+                prefill_chunk=4, token_budget=10)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _workload(seed=3, n=12):
+    return make_workload(seed=seed, n_requests=n, vocab=VOCAB,
+                         arrival="closed", prompt_lo=2, prompt_typical=5,
+                         prompt_hi=9, out_lo=2, out_typical=4, out_hi=6,
+                         max_len=16)
+
+
+def _drain(eng_or_srv, now=0.0):
+    while eng_or_srv.has_work():
+        now += eng_or_srv.step(now).cost
+    return now
+
+
+def _streams(server_or_engine):
+    return {f["rid"]: f["tokens"] for f in server_or_engine.finished}
+
+
+# ---------------------------------------------------------------------------
+# Hard kill + bitwise failover (the tentpole acceptance pin).
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture(scope="module")
+def fleet_ctrl(serve_factory):
+    """ONE unfaulted control run shared by every fleet-chaos pin here
+    (tier-1 budget): its token streams are the bitwise reference for the
+    kill, stall, and heartbeat runs alike — streams are pure functions
+    of (params, prompt), independent of faults and of the monitor — and
+    running it with the heartbeat ARMED also pins the no-false-positive
+    claim (a healthy fleet never drains anyone)."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_serve_cfg(heartbeat=4.0), server=True)
+    run_closed_loop(srv, _workload(), 6)
+    assert srv.heartbeat_events == []  # armed + healthy = no drains
+    assert srv.fail_events == [] and srv.stall_events == []
+    return _streams(srv)
+
+
+def test_fail_mid_decode_failover_bitwise(serve_factory, fleet_ctrl):
+    """Kill a replica mid-run: zero requests lost, every finished record
+    exactly once (salvaged vs resubmitted never double-counts), token
+    streams bitwise equal to the unfaulted control, and the MTTR sample
+    is reportable."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+    from ddlbench_tpu.tools.servechaos import mttr_from_events
+
+    def run(events):
+        srv = serve_factory(_serve_cfg(), server=True)
+        run_closed_loop(srv, _workload(), 6, events=events)
+        return srv
+
+    ctrl = fleet_ctrl
+    chaos = run([(6.0, lambda s, clock: s.fail(1, now=clock))])
+    assert len(chaos.fail_events) == 1
+    ev = chaos.fail_events[0]
+    # the kill struck live work — otherwise this pins nothing
+    assert ev["displaced_inflight"], ev
+    assert ev["shed_on_failover"] == 0
+    fc, fr = ctrl, _streams(chaos)
+    assert set(fc) == set(fr) == set(range(12))  # requests_lost == 0
+    for rid in fc:
+        assert fc[rid] == fr[rid], f"stream diverged for rid {rid}"
+    # exactly-once: the salvaged records and the failover copies never
+    # double-count (resubmission is a re-admission, not a re-completion)
+    rids = [f["rid"] for f in chaos.finished]
+    assert len(rids) == len(set(rids)) == 12
+    assert chaos.stats_summary()["completed"] == 12
+    assert len(chaos.engines) == 1
+    # recovery: every displaced request re-emitted after the kill
+    mttrs = mttr_from_events(chaos.fail_events, chaos.finished)
+    assert len(mttrs) == 1 and mttrs[0] is not None and mttrs[0] > 0
+
+
+def test_fail_salvages_finished_and_counters(serve_factory):
+    """Records finished on the dead replica BEFORE the kill are salvaged
+    (they are not resubmitted, not re-run) and the fleet summary keeps
+    the retired counters."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_serve_cfg(), server=True)
+    fired = {}
+
+    def kill(s, clock):
+        fired["salvaged_rids"] = {f["rid"] for f in s.engines[1].finished}
+        fired["ev"] = s.fail(1, now=clock)
+
+    run_closed_loop(srv, _workload(), 6, events=[(10.0, kill)])
+    ev = fired["ev"]
+    assert ev["salvaged"] == len(fired["salvaged_rids"])
+    # salvaged rids never show up among the displaced (no re-admission)
+    assert not (set(ev["displaced_inflight"]) & fired["salvaged_rids"])
+    assert {f["rid"] for f in srv.finished} == set(range(12))
+    # admitted counts the failover re-admissions (the eviction-recompute
+    # accounting convention); completed stays exactly-once
+    s = srv.stats_summary()
+    assert s["completed"] == 12
+    assert s["admitted"] >= 12 + len(ev["displaced_inflight"])
+
+
+def test_fail_guards(serve_factory):
+    srv = serve_factory(_serve_cfg(replicas=1), server=True)
+    with pytest.raises(ValueError, match="last replica"):
+        srv.fail(0)
+    with pytest.raises(IndexError, match="fleet index"):
+        srv.fail(3)
+    with pytest.raises(IndexError, match="fleet index"):
+        srv.stall(3, 5)
+    with pytest.raises(ValueError, match="ticks"):
+        srv.stall(0, 0)
+
+
+# ---------------------------------------------------------------------------
+# Straggler stall + heartbeat drain.
+# ---------------------------------------------------------------------------
+
+
+def test_stall_heartbeat_drains_within_window(serve_factory, fleet_ctrl):
+    """A stalled replica holding work is detected by the serve-side
+    heartbeat and drained within the detection window (+ at most one
+    global step of observation lag); its requests complete on the
+    survivor with bitwise streams. (The shared control pins the
+    no-false-positive half: armed + healthy = no drains.)"""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    HB = 4.0
+    srv = serve_factory(_serve_cfg(heartbeat=HB), server=True)
+    run_closed_loop(srv, _workload(), 6,
+                    events=[(5.0, lambda s, clock: s.stall(0, 50,
+                                                           now=clock))])
+    assert len(srv.stall_events) == 1
+    assert len(srv.heartbeat_events) == 1
+    hb = srv.heartbeat_events[0]
+    # drained after the window expired, within one observation step of it
+    assert hb["stalled_for"] > HB
+    assert hb["stalled_for"] <= HB + 8.0
+    assert hb["evicted"] + hb["redistributed"] >= hb["evicted"] > 0
+    fc, fr = fleet_ctrl, _streams(srv)
+    assert set(fc) == set(fr) == set(range(12))
+    for rid in fc:
+        assert fc[rid] == fr[rid]
+    assert len(srv.engines) == 1  # the straggler retired
+
+
+def test_stall_without_heartbeat_just_delays(serve_factory, fleet_ctrl):
+    """No heartbeat: the stall is invisible to the fleet — requests wait
+    it out, nothing is drained, streams still bitwise."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_serve_cfg(), server=True)
+    run_closed_loop(srv, _workload(), 6,
+                    events=[(5.0, lambda s, clock: s.stall(0, 6,
+                                                           now=clock))])
+    assert srv.heartbeat_events == []
+    assert len(srv.engines) == 2
+    fc, fr = fleet_ctrl, _streams(srv)
+    assert set(fc) == set(fr) == set(range(12))
+    for rid in fc:
+        assert fc[rid] == fr[rid]
+
+
+def test_progress_monitor_unit():
+    m = ProgressMonitor(4.0, now=10.0)
+    assert not m.expired(14.0)
+    assert m.expired(14.5)
+    m.kick(14.5)
+    assert not m.expired(18.0)
+    assert m.stalled_for(16.5) == 2.0
+    assert m.last_progress == 14.5
+    with pytest.raises(ValueError, match="positive"):
+        ProgressMonitor(0.0)
+    with pytest.raises(ValueError, match="heartbeat"):
+        ServeConfig(heartbeat=-1.0).validate()
+
+
+# ---------------------------------------------------------------------------
+# Deadlines: shed at admission, timeout in place, driver retry policy.
+# ---------------------------------------------------------------------------
+
+
+def test_deadline_shed_named_rejection(serve_factory):
+    """A request whose projected completion already misses its deadline
+    is shed at submit (False + a named record); without a deadline the
+    same request is always accepted."""
+    eng = serve_factory(_ecfg())
+    rng = np.random.default_rng(21)
+    for rid in range(2):  # load the engine so the projection is nonzero
+        assert eng.submit(ServeRequest(
+            rid=rid, prompt=rng.integers(0, VOCAB, size=(5,)).astype(
+                np.int32), max_new=8, arrival=0.0)) is True
+    hopeless = ServeRequest(
+        rid=9, prompt=rng.integers(0, VOCAB, size=(5,)).astype(np.int32),
+        max_new=8, arrival=0.0, deadline=3.0)  # min service alone is 9
+    assert eng.projected_finish(hopeless, 0.0) > 3.0
+    assert eng.submit(hopeless, now=0.0) is False
+    assert eng.stats["shed"] == 1
+    assert eng.shed == [{"rid": 9, "t": 0.0, "deadline": 3.0,
+                         "tier": "interactive"}]
+    assert all(r.rid != 9 for r in eng.queue)
+    _drain(eng)  # the accepted pair still completes
+    assert eng.stats_summary()["completed"] == 2
+    assert eng.stats_summary()["timeouts"] == 0
+
+
+def test_deadline_timeout_terminal_state_frees_pages(serve_factory):
+    """An accepted request whose deadline passes cancels into the named
+    `timeout` terminal state: queued entries leave the queue, in-flight
+    ones free every page; the engine drains clean (no leak, no
+    double-free) and never emits a finished record for the victim."""
+    eng = serve_factory(_ecfg())
+    rng = np.random.default_rng(22)
+    for rid in range(2):  # occupy both rows with long decodes
+        assert eng.submit(ServeRequest(
+            rid=rid, prompt=rng.integers(0, VOCAB, size=(5,)).astype(
+                np.int32), max_new=8, arrival=0.0))
+    # projection is a LOWER bound: accepted, but the row wait kills it
+    queued = ServeRequest(
+        rid=2, prompt=rng.integers(0, VOCAB, size=(4,)).astype(np.int32),
+        max_new=4, arrival=0.0, deadline=float(
+            eng.projected_finish(
+                ServeRequest(rid=2, prompt=np.zeros(4, np.int32),
+                             max_new=4), 0.0)))
+    assert eng.submit(queued, now=0.0) is True
+    t = _drain(eng)
+    assert eng.stats["timeouts"] == 1
+    rec = eng.timed_out[0]
+    assert rec["rid"] == 2 and rec["state"] == "queued"
+    assert rec["t"] >= rec["deadline"]
+    assert {f["rid"] for f in eng.finished} == {0, 1}
+    assert eng.allocator.in_use == 0
+    assert not eng.has_work()
+    # in-flight expiry: rid5 queues behind two deadline-free decodes,
+    # admits late, and its deadline passes MID-DECODE — pages freed, the
+    # partial output recorded on the terminal record, no finished entry
+    for rid in (3, 4):
+        assert eng.submit(ServeRequest(
+            rid=rid, prompt=rng.integers(0, VOCAB, size=(5,)).astype(
+                np.int32), max_new=8, arrival=t), now=t)
+    assert eng.submit(ServeRequest(
+        rid=5, prompt=rng.integers(0, VOCAB, size=(5,)).astype(np.int32),
+        max_new=8, arrival=t, deadline=t + 16.0), now=t) is True
+    _drain(eng, t)
+    mid = [r for r in eng.timed_out if r["rid"] == 5]
+    assert mid, "expected an in-flight timeout"
+    assert mid[0]["state"] in ("prefill", "decode")
+    assert mid[0]["out_tokens"] > 0
+    assert {f["rid"] for f in eng.finished} == {0, 1, 3, 4}
+    assert eng.allocator.in_use == 0  # pages all freed on cancel
+
+
+def test_driver_retry_backoff_accounting(serve_factory):
+    """The closed-loop driver's bounded retry-with-backoff: shed
+    submissions retry with doubling backoff, exhausted ones go terminal
+    as rejected, and every request reaches exactly one terminal state
+    (completed/timeout/rejected) — the no-hang, no-loss accounting."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_ecfg(replicas=1), server=True)
+    reqs = make_workload(seed=9, n_requests=14, vocab=VOCAB,
+                         arrival="closed", prompt_lo=4, prompt_typical=6,
+                         prompt_hi=8, out_lo=6, out_typical=8, out_hi=8,
+                         max_len=16)
+    st = {}
+    run_closed_loop(srv, reqs, 10, retry=(2, 2.0), deadline_slack=10.0,
+                    driver_stats=st)
+    eng = srv.engines[0]
+    completed = len(srv.finished)
+    timeouts = int(eng.stats["timeouts"])
+    assert eng.stats["shed"] > 0, "fixture never exercised shedding"
+    assert st["retries"] > 0
+    assert completed + timeouts + st["rejected"] == 14
+    assert not srv.has_work()
+    assert eng.allocator.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# SLO tiers: admission order, preemption order, per-tier split.
+# ---------------------------------------------------------------------------
+
+
+def test_tier_admission_interactive_first(serve_factory):
+    """With a batch request at the queue head, a later interactive one
+    admits first (FIFO within a tier; head-of-line only within batch)."""
+    eng = serve_factory(_ecfg())
+    rng = np.random.default_rng(23)
+
+    def req(rid, tier):
+        return ServeRequest(
+            rid=rid, prompt=rng.integers(0, VOCAB, size=(4,)).astype(
+                np.int32), max_new=3, arrival=0.0, tier=tier)
+
+    for r in (req(0, "batch"), req(1, "interactive"),
+              req(2, "interactive"), req(3, "batch")):
+        eng.submit(r)
+    eng.step(0.0)  # two rows: both interactive requests beat batch
+    admitted = {a.req.rid for a in eng.rows if a is not None}
+    assert admitted == {1, 2}
+    _drain(eng)
+    assert {f["rid"] for f in eng.finished} == {0, 1, 2, 3}
+    # finished records carry the tier for the per-tier summary split
+    tiers = {f["rid"]: f["tier"] for f in eng.finished}
+    assert tiers == {0: "batch", 1: "interactive", 2: "interactive",
+                     3: "batch"}
+
+
+def test_tier_eviction_batch_first_streams_bitwise(serve_factory):
+    """Under pool pressure the BATCH active is evicted even though it is
+    OLDER than the co-resident interactive one (tier outranks the
+    newest-first admission-age rule); the preempted batch request still
+    completes, stream bitwise vs its solo run."""
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=24,
+                      prefill_chunk=4)  # the serve suites' evict shapes
+    rng = np.random.default_rng(24)
+    prompts = {rid: rng.integers(0, VOCAB, size=(9,)).astype(np.int32)
+               for rid in (0, 1)}
+    # solo references (no contention — pure (params, prompt) functions)
+    solo = {}
+    for rid in (0, 1):
+        eng = serve_factory(cfg)
+        eng.submit(ServeRequest(rid=rid, prompt=prompts[rid], max_new=12,
+                                arrival=0.0))
+        _drain(eng)
+        solo[rid] = eng.finished[-1]["tokens"]
+    # contended: the batch request is admitted FIRST (one step alone, so
+    # its admit_seq is strictly older), the interactive one joins after —
+    # the pre-tier newest-first rule would evict the INTERACTIVE request
+    eng = serve_factory(cfg)
+    eng.submit(ServeRequest(rid=0, prompt=prompts[0], max_new=12,
+                            arrival=0.0, tier="batch"))
+    t = float(eng.step(0.0).cost)
+    assert eng.rows[0] is not None  # batch admitted, older
+    eng.submit(ServeRequest(rid=1, prompt=prompts[1], max_new=12,
+                            arrival=t, tier="interactive"))
+    _drain(eng, t)
+    assert eng.stats["evicted"] > 0, "fixture lost its pool pressure"
+    # preemption order: every eviction struck the batch tier, and the
+    # interactive request was never evicted despite being newest
+    assert all(e["tier"] == "batch" for e in eng.evicted_log), \
+        eng.evicted_log
+    got = _streams(eng)
+    assert got[0] == solo[0] and got[1] == solo[1]
+
+
+def test_tiered_overload_interactive_slo_strictly_above_batch(
+        serve_factory):
+    """The overload acceptance fixture: background batch load arrives
+    first, an interactive burst lands on top of a tight pool. Interactive
+    admits ahead of waiting batch, co-resident batch actives are the
+    eviction victims, every preempted request still completes — bitwise
+    vs its solo run — and interactive SLO attainment lands STRICTLY above
+    batch while batch pays the preemption (the goodput sacrifice
+    PERF.md round 18 measures)."""
+    cfg = ServeConfig(max_batch=2, pool_pages=9, page=4, max_len=24,
+                      prefill_chunk=4)
+    rng = np.random.default_rng(25)
+    reqs = [ServeRequest(
+        rid=rid, prompt=rng.integers(0, VOCAB, size=(6,)).astype(np.int32),
+        max_new=12, arrival=0.0 if rid < 3 else 6.0,
+        tier="batch" if rid < 3 else "interactive") for rid in range(6)]
+    solo = {}
+    for r in reqs:  # uncontended stream references
+        eng = serve_factory(cfg)
+        eng.submit(ServeRequest(rid=r.rid, prompt=r.prompt,
+                                max_new=r.max_new, arrival=0.0))
+        _drain(eng)
+        solo[r.rid] = eng.finished[-1]["tokens"]
+    eng = serve_factory(cfg)
+    pend, i, t = sorted(reqs, key=lambda r: (r.arrival, r.rid)), 0, 0.0
+    while i < len(pend) or eng.has_work():
+        while i < len(pend) and pend[i].arrival <= t:
+            eng.submit(pend[i])
+            i += 1
+        t += eng.step(t).cost
+    assert {f["rid"] for f in eng.finished} == set(range(6))
+    assert eng.stats["evicted"] > 0, "no overload pressure"
+    # the tier preemption invariant: an interactive victim only ever
+    # falls when NO batch request is co-resident to preempt instead
+    for e in eng.evicted_log:
+        if e["tier"] == "interactive":
+            assert e["batch_active"] == 0, e
+    assert any(e["tier"] == "batch" for e in eng.evicted_log)
+    # every preempted request still completed with its exact stream
+    got = _streams(eng)
+    for rid in {e["rid"] for e in eng.evicted_log}:
+        assert got[rid] == solo[rid], f"preempted rid {rid} diverged"
+    s = serve_summary(eng.finished, duration=1.0, slo_ttft=45.0,
+                      slo_itl=2.0, per_tier=True)
+    assert s["interactive_completed"] == 3 and s["batch_completed"] == 3
+    assert s["interactive_slo_attainment"] > s["batch_slo_attainment"]
+
+
+def test_serve_summary_per_tier_flag_gated():
+    """per_tier=False keeps the pinned key set; per_tier=True adds both
+    tiers' splits even when one tier is absent (schema-stable)."""
+    rec = {"rid": 0, "arrival": 0.0, "first_token_t": 2.0,
+           "token_times": [2.0, 3.0], "n_tokens": 2, "cached_tokens": 0,
+           "tier": "interactive"}
+    plain = serve_summary([rec], duration=4.0)
+    tiered = serve_summary([rec], duration=4.0, per_tier=True)
+    assert set(plain) < set(tiered)
+    extra = set(tiered) - set(plain)
+    assert extra == {f"{t}_{k}" for t in ("interactive", "batch")
+                     for k in ("completed", "output_tokens", "ttft_p50",
+                               "ttft_p95", "itl_p50", "slo_attainment",
+                               "goodput_tokens_per_unit")}
+    assert tiered["batch_completed"] == 0
+    assert tiered["batch_goodput_tokens_per_unit"] == 0.0
+    # a record without a tier field (pre-tier engine) counts interactive
+    del rec["tier"]
+    assert serve_summary([rec], duration=4.0,
+                         per_tier=True)["interactive_completed"] == 1
+
+
+# ---------------------------------------------------------------------------
+# Workload generation: deadlines + tier mix, gated bitwise.
+# ---------------------------------------------------------------------------
+
+
+def test_workload_deadline_and_tier_generation():
+    kw = dict(seed=7, n_requests=16, vocab=VOCAB, arrival="poisson",
+              rate=0.5, max_len=16)
+    base = make_workload(**kw)
+    dl = make_workload(**kw, deadline_slack=12.0)
+    # deadlines bolt onto the SAME traffic: prompts/arrivals bitwise
+    for b, d in zip(base, dl):
+        assert np.array_equal(b.prompt, d.prompt)
+        assert b.arrival == d.arrival and b.max_new == d.max_new
+        assert d.deadline == d.arrival + 12.0
+        assert b.deadline is None and b.tier == "interactive"
+    allb = make_workload(**kw, batch_frac=1.0)
+    for b, t in zip(base, allb):
+        assert np.array_equal(b.prompt, t.prompt)  # tier draw is gated
+        assert b.arrival == t.arrival
+        assert t.tier == "batch"
+    mixed = make_workload(**kw, batch_frac=0.5)
+    tiers = {r.tier for r in mixed}
+    assert tiers == {"interactive", "batch"}
+    # closed loop has no arrival to anchor a deadline — the driver stamps
+    closed = make_workload(seed=7, n_requests=4, vocab=VOCAB,
+                           arrival="closed", max_len=16,
+                           deadline_slack=8.0)
+    assert all(r.deadline is None for r in closed)
+    with pytest.raises(ValueError, match="deadline_slack"):
+        make_workload(**kw, deadline_slack=0.0)
+    with pytest.raises(ValueError, match="batch_frac"):
+        make_workload(**kw, batch_frac=1.5)
+
+
+# ---------------------------------------------------------------------------
+# PR 12 x PR 13: drain()/resize() with speculative pages in flight.
+# ---------------------------------------------------------------------------
+
+
+class _AlwaysDrafter:
+    """Proposes (mostly wrong) tokens every row, every step — maximal
+    draft-page pressure so the drain really strikes pre-allocated
+    speculative pages. Caps at its configured K like NgramDrafter (the
+    engine passes the remaining-output headroom, which can exceed K)."""
+
+    K = 3
+
+    def propose(self, ctx, k):
+        return [int(ctx[-1])] * min(k, self.K)
+
+
+def test_drain_mid_spec_rolls_back_draft_pages_no_leak(serve_factory):
+    """Satellite pin (previously untested): drain() on an engine with
+    speculative draft pages in flight — the verify rollback
+    (PageAllocator.release, bounded by the pre-plan count) plus the
+    drain's eviction must return EVERY page (no leak, no double-free),
+    and the displaced requests replay bitwise on a sibling engine."""
+    spec_cfg = ServeConfig(max_batch=2, pool_pages=17, page=4, max_len=16,
+                           prefill_chunk=4, speculative="ngram:2:3")
+    base_cfg = ServeConfig(max_batch=2, pool_pages=17, page=4, max_len=16,
+                           prefill_chunk=4)
+    rng = np.random.default_rng(26)
+    prompts = {rid: rng.integers(0, VOCAB, size=(5,)).astype(np.int32)
+               for rid in (0, 1)}
+
+    def submit_all(eng):
+        for rid in (0, 1):
+            eng.submit(ServeRequest(rid=rid, prompt=prompts[rid],
+                                    max_new=9, arrival=0.0))
+
+    ctrl = serve_factory(base_cfg)  # spec-off reference streams
+    submit_all(ctrl)
+    _drain(ctrl)
+    ref = _streams(ctrl)
+
+    eng = serve_factory(spec_cfg)
+    eng._drafter = _AlwaysDrafter()
+    submit_all(eng)
+    t = 0.0
+    for _ in range(3):  # into decode: drafts planned, span pages granted
+        t += eng.step(t).cost
+    assert eng.stats["spec_drafted"] > 0, "no draft pressure to strike"
+    reqs, evicted, handoff = eng.drain(t)
+    assert evicted > 0
+    assert eng.allocator.in_use == 0  # draft + request pages ALL back
+    # the displaced requests replay bitwise on a sibling spec engine
+    eng2 = serve_factory(spec_cfg)
+    eng2._drafter = _AlwaysDrafter()
+    for r in reqs:
+        eng2.submit(r)
+    _drain(eng2, t)
+    got = {**_streams(eng), **_streams(eng2)}
+    assert got == ref
+
+
+def test_resize_mid_spec_streams_bitwise(serve_factory):
+    """resize() scale-down striking speculative replicas mid-run: no
+    request lost, streams bitwise vs the un-resized control — the
+    PR 12 x PR 13 interaction end to end."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    spec_cfg = ServeConfig(max_batch=2, pool_pages=17, page=4, max_len=16,
+                           prefill_chunk=4, speculative="ngram:2:3",
+                           replicas=2)
+
+    def run(resizes):
+        srv = serve_factory(spec_cfg, server=True)
+        reqs = make_workload(seed=11, n_requests=10, vocab=VOCAB,
+                             arrival="closed", prompt_lo=2,
+                             prompt_typical=5, prompt_hi=8, out_lo=2,
+                             out_typical=5, out_hi=8, max_len=16)
+        run_closed_loop(srv, reqs, 5, resizes=list(resizes))
+        return srv
+
+    ctrl = run([])
+    rsz = run([(5.0, 1)])
+    fc, fr = _streams(ctrl), _streams(rsz)
+    assert set(fc) == set(fr) == set(range(10))
+    for rid in fc:
+        assert fc[rid] == fr[rid]
+    for eng in rsz.engines + rsz._retired:
+        assert eng.allocator.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# servechaos e2e (tiny LM, same compile the servebench e2e pays).
+# ---------------------------------------------------------------------------
+
+
+def _run_servechaos(extra=()):
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servechaos
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    buf = io.StringIO()
+    with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+            contextlib.redirect_stdout(buf):
+        rc = servechaos.main([
+            "-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+            "--concurrency", "4", "--requests", "10", "--max-batch", "2",
+            "--pool-pages", "9", "--page", "4", "--max-len", "16",
+            "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+            "--seed", "5", "--platform", "cpu", *extra])
+    assert rc == 0
+    return json.loads([l for l in buf.getvalue().splitlines()
+                       if l.startswith("{")][0])
+
+
+@pytest.mark.slow
+def test_servechaos_e2e_kill_stall_gates():
+    """The tool-level gates: kill -> requests_lost == 0, streams bitwise
+    vs the unfaulted control, mttr reported; stall -> heartbeat drains
+    within the window. One invocation covers both. Slow-marked (the
+    chaosbench-e2e precedent): every gate is ALSO pinned tier-1 at
+    engine level (test_fail_mid_decode_failover_bitwise,
+    test_stall_heartbeat_drains_within_window), and this invocation
+    compiles its own program set — the 870 s tier-1 gate has no
+    headroom for a double-covered compile bill."""
+    rec = _run_servechaos(("--replicas", "3", "--kill", "6:2",
+                           "--stall", "10:0:40", "--heartbeat", "4"))
+    assert rec["kills_fired"] == 1
+    assert rec["requests_lost"] == 0
+    assert rec["streams_match"] is True
+    assert rec["streams_compared"] == rec["completed"] == 10
+    assert rec["mttr_replica_s_mean"] is None or \
+        rec["mttr_replica_s_mean"] > 0
+    assert len(rec["mttr_replica_s"]) == 1
+    assert rec["stalls_fired"] == 1
+    assert rec["heartbeat_drains"] == 1
+    hb = rec["heartbeat_events"][0]
+    assert 4.0 < hb["stalled_for"] <= 4.0 + 8.0
+    assert rec["final_replicas"] == 1
+    assert rec["timeouts"] == 0 and rec["shed"] == 0
+    assert rec["jax_backend"] == "cpu"
+
+
+@pytest.mark.slow
+def test_servechaos_e2e_is_bitwise_reproducible():
+    """Same seed, same faults -> byte-identical JSON (wall clock off).
+    Slow-marked: two more full tool invocations for a repro property the
+    virtual-time design guarantees by construction (every ingredient is
+    pinned deterministic tier-1; this is the belt-and-braces e2e)."""
+    a = _run_servechaos(("--replicas", "2", "--kill", "8:1"))
+    b = _run_servechaos(("--replicas", "2", "--kill", "8:1"))
+    assert a == b
+    assert a["requests_lost"] == 0 and a["streams_match"] is True

@@ -480,6 +480,17 @@ TIMELINE_ROW_KEYS = PLAIN_ROW_KEYS | {
     "window", "timeline", "ttft_breakdown", "itl_breakdown",
     "decomp_exact",
 }
+# the ISSUE-15 chaos fields, flag-gated exactly like the PR 13 spec set:
+# a plain row must never carry any of these
+CHAOS_ROW_KEYS = {  # --deadline-slack (+ --retry)
+    "shed", "timeouts", "deadline_slack", "retry", "retries", "rejected",
+    "requests_lost", "shed_rate", "timeout_rate", "retry_amplification",
+}
+TIER_ROW_KEYS = {"tier_mix"} | {  # --tier-mix
+    f"{t}_{k}" for t in ("interactive", "batch")
+    for k in ("completed", "output_tokens", "ttft_p50", "ttft_p95",
+              "itl_p50", "slo_attainment", "goodput_tokens_per_unit")}
+HEARTBEAT_ROW_KEYS = {"heartbeat", "heartbeat_drains"}  # --heartbeat
 
 
 def _run_servebench(extra=()):
@@ -536,6 +547,32 @@ def test_servebench_report_schema_pinned(servebench_rows):
         assert set(timeline["ttft_breakdown"][comp]) \
             == {"p50", "p95", "p99", "mean"}
     assert set(timeline["itl_breakdown"]) == {"decode", "preempted"}
+
+
+def test_servebench_chaos_fields_flag_gated(servebench_rows):
+    """ISSUE-15 schema pin: the deadline/tier/heartbeat counters appear
+    ONLY under their flags — one fully-flagged invocation carries exactly
+    PLAIN + the three gated sets, and the plain row (pinned above to the
+    PR 13 key set) carries none of them."""
+    plain = json.loads(servebench_rows["plain"][0])
+    assert not (set(plain) & (CHAOS_ROW_KEYS | TIER_ROW_KEYS
+                              | HEARTBEAT_ROW_KEYS))
+    flagged = _run_servebench((
+        "--deadline-slack", "64", "--retry", "2:4", "--tier-mix", "0.5",
+        "--heartbeat", "8"))
+    row = json.loads(flagged[0])
+    assert set(row) == (PLAIN_ROW_KEYS | CHAOS_ROW_KEYS | TIER_ROW_KEYS
+                        | HEARTBEAT_ROW_KEYS)
+    # the no-loss gate (requests_lost is the residual after completed/
+    # timeouts/rejected, so asserting the sum would be a tautology —
+    # the claim with teeth is that the residual is ZERO: every request
+    # reached a driver-visible terminal state)
+    assert row["requests_lost"] == 0
+    assert row["completed"] + row["timeouts"] + row["rejected"] \
+        == row["requests"]
+    assert row["retry_amplification"] >= 1.0
+    assert row["interactive_completed"] + row["batch_completed"] \
+        == row["completed"]
 
 
 def test_serveview_cli_on_servebench_trace(servebench_rows, capsys):
