@@ -1062,6 +1062,13 @@ class ServeConfig:
     prefill_chunk: int = 16
     policy: str = "continuous"  # "continuous" | "static" (the A/B baseline)
     replicas: int = 1  # data-parallel serving replicas (mesh 'data' axis)
+    # tensor-parallel width of ONE replica (mesh 'model' axis): the serve
+    # jitted programs shard Megatron-style over tp devices — each holds
+    # its contiguous head group of every layer (tp_split_layer_params)
+    # and its slice of the KV pool, sharing ONE page table — so a model
+    # larger than one chip's HBM serves at all. tp=1 is the bitwise-
+    # pinned single-chip path (the programs are literally unchanged).
+    tp: int = 1
     # cross-request prefix cache (serve/prefix.py): admissions bind the
     # already-resident immutable KV pages of their longest cached prefix
     # and chunk-prefill only the uncached tail. Continuous policy only —
@@ -1146,9 +1153,11 @@ class ServeConfig:
         if self.policy not in ("continuous", "static"):
             raise ValueError(
                 f"policy must be continuous|static, got {self.policy!r}")
-        if min(self.max_batch, self.page, self.max_len, self.replicas) < 1:
+        if min(self.max_batch, self.page, self.max_len, self.replicas,
+               self.tp) < 1:
             raise ValueError(
-                "max_batch, page, max_len, and replicas must be positive")
+                "max_batch, page, max_len, replicas, and tp must be "
+                "positive")
         if self.prefill_chunk < 0 or self.token_budget < 0:
             # 0 means "resolve a default" for both; negatives would pass
             # the modulo/starvation checks and crash the engine mid-run

@@ -573,7 +573,12 @@ def _qkv_heads(p, x, n_heads: int):
     h = layer_norm(p["ln1"], x)
     qkv = h @ p["wqkv"].astype(x.dtype)
     q, k, v = jnp.split(qkv, 3, axis=-1)
-    return [t.reshape(B, T, n_heads, dh).transpose(0, 2, 1, 3)
+    # the LOCAL head count comes from the params actually held: a TP
+    # shard's wqkv is the [d, 3 * (d/tp)] column slice
+    # (tp_split_layer_params), so its q/k/v carry n_heads/tp heads.
+    # Unsliced params give n_local == n_heads — bitwise the old path.
+    n_local = q.shape[-1] // dh
+    return [t.reshape(B, T, n_local, dh).transpose(0, 2, 1, 3)
             for t in (q, k, v)]
 
 
@@ -650,9 +655,28 @@ def attn_serve_pool_init(n_heads: int, dh: int):
     def pool_init(p, n_pages, page, dtype):
         from ddlbench_tpu.ops.paged_decode import serve_pool_init
 
-        return serve_pool_init(n_pages, page, n_heads, dh, dtype)
+        # pool shape follows the params it serves: a TP shard's wqkv
+        # column slice produces n_heads/tp heads of K/V per position, so
+        # its pool slice holds exactly those. Full params keep the full
+        # head count — the single-chip layout, bitwise.
+        n_local = p["wqkv"].shape[1] // (3 * dh)
+        return serve_pool_init(n_pages, page, n_local, dh, dtype)
 
     return pool_init
+
+
+def _serve_proj(p, o2, x):
+    """Output projection + residual shared by the serve attention ops:
+    ``o2`` is the [B, T, n_local * dh] attention output. Row-parallel
+    under an active tensor_parallel context when this shard holds a wo
+    row slice (the attention_sublayer discipline — a replicated layer
+    computes the full projection on every shard and must NOT psum)."""
+    d = x.shape[-1]
+    proj = o2 @ p["wo"].astype(x.dtype)
+    tp = _tp_ctx()
+    if tp is not None and p["wqkv"].shape[1] < 3 * d:
+        proj = lax.psum(proj, tp[0])
+    return x + proj
 
 
 def _serve_pool_out(cache):
@@ -679,7 +703,7 @@ def attn_serve_prefill_op(p, x, pool, table, n_heads: int, start, npl: int,
     cache = paged_table_chunk_write(cache, k.transpose(0, 2, 1, 3),
                                     v.transpose(0, 2, 1, 3), start, page)
     o = paged_chunk_attention(q, cache, start, npl, page)  # [B, H, C, dh]
-    x = x + o.transpose(0, 2, 1, 3).reshape(B, C, d) @ p["wo"].astype(x.dtype)
+    x = _serve_proj(p, o.transpose(0, 2, 1, 3).reshape(B, C, -1), x)
     return x, _serve_pool_out(cache)
 
 
@@ -699,7 +723,7 @@ def attn_serve_decode_op(p, x, pool, table, n_heads: int, pos, npl: int,
                               v.transpose(0, 2, 1, 3), pos, page)
     o = paged_attention(q[:, :, 0].astype(x.dtype), cache, pos, npl,
                         page)  # [B, H, dh]
-    x = x + o.reshape(B, 1, d) @ p["wo"].astype(x.dtype)
+    x = _serve_proj(p, o.reshape(B, 1, -1), x)
     return x, _serve_pool_out(cache)
 
 
@@ -721,7 +745,7 @@ def attn_serve_verify_op(p, x, pool, table, n_heads: int, pos0, npl: int,
     cache = paged_table_span_write(cache, k.transpose(0, 2, 1, 3),
                                    v.transpose(0, 2, 1, 3), pos0, page)
     o = paged_chunk_attention(q, cache, pos0, npl, page)  # [B, H, W, dh]
-    x = x + o.transpose(0, 2, 1, 3).reshape(B, W, d) @ p["wo"].astype(x.dtype)
+    x = _serve_proj(p, o.transpose(0, 2, 1, 3).reshape(B, W, -1), x)
     return x, _serve_pool_out(cache)
 
 

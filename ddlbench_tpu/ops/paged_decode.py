@@ -478,6 +478,22 @@ def pool_quantized(cache_or_pool) -> bool:
     return "scale_k" in cache_or_pool
 
 
+def pool_page_bytes(pool, page_axis: int = 0) -> int:
+    """K/V payload bytes per page slot of ``pool`` (scale sidecars and
+    the ``kv_seed`` scalar excluded — the ``bytes_per_page``
+    convention). ``page_axis=1`` is the tp-stacked [tp, pages, ...]
+    layout, whose per-slot bytes sum over shards to exactly the
+    single-chip full-width page. An int8 pool reports exactly f32/4 —
+    the invariant the handoff wire accounting (serve/handoff.py)
+    inherits, since a ship is verbatim rows of this pool."""
+    total = 0
+    for name in ("pool_k", "pool_v"):
+        arr = pool[name]
+        total += int(arr.dtype.itemsize * math.prod(arr.shape)
+                     // arr.shape[page_axis])
+    return total
+
+
 def serve_pool_init(n_pages: int, page: int, n_heads: int, dh: int, dtype):
     """A shared K/V pool of ``n_pages`` free-list-managed slots (slot 0 is
     the scratch page — serve/allocator.py never hands it out). ``dtype``

@@ -26,7 +26,9 @@ from ddlbench_tpu.serve.workload import (  # noqa: F401
 )
 
 _ENGINE_NAMES = ("ReplicatedServer", "ServeEngine", "StepReport",
-                 "make_server", "supports_serve")
+                 "make_server", "supports_serve", "fleet_stats")
+_HANDOFF_NAMES = ("DisaggregatedServer", "export_request",
+                  "make_disaggregated")
 
 
 def __getattr__(name):  # PEP 562: engine (and with it jax) loads on demand
@@ -34,4 +36,8 @@ def __getattr__(name):  # PEP 562: engine (and with it jax) loads on demand
         from ddlbench_tpu.serve import engine
 
         return getattr(engine, name)
+    if name in _HANDOFF_NAMES:
+        from ddlbench_tpu.serve import handoff
+
+        return getattr(handoff, name)
     raise AttributeError(name)

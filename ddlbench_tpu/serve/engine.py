@@ -266,27 +266,36 @@ class ServeEngine:
         self.dtype = dtype if dtype is not None else kv_map[cfg.kv_dtype]
         self._put = (lambda t: jax.device_put(t, device)) if device \
             else (lambda t: t)
-        self.params = self._put(params)
-        self.state = self._put(state)
-        pools = []
-        self.bytes_per_page = 0  # K/V payload bytes per pool slot, summed
-        for li, (l, p) in enumerate(zip(model.layers, params)):
-            if l.serve is None or l.serve.pool_init is None:
-                pools.append(None)
-                continue
-            pool = l.serve.pool_init(p, cfg.pool_pages, cfg.page, self.dtype)
-            if "scale_k" in pool:
-                # per-layer counter seed for the write-boundary stochastic
-                # rounding: quantized bytes become a pure function of
-                # (values, layer, k/v tag, stream position) — recompute
-                # and prefix re-derivations replay bitwise
-                pool["kv_seed"] = jnp.int32(li)
-            for name in ("pool_k", "pool_v"):
-                arr = pool[name]
-                self.bytes_per_page += int(
-                    arr.dtype.itemsize * np.prod(arr.shape[1:]))
-            pools.append(pool)
-        self.pools = self._put(pools)
+        # page axis of the pool leaves: tp=1 pools are [n_pages, ...] (the
+        # single-chip layout, bitwise-unchanged); tp>1 stacks per-shard
+        # pool slices on a LEADING [tp] axis laid over the mesh 'model'
+        # axis, so the page axis moves to 1
+        self._page_axis = 0 if cfg.tp == 1 else 1
+        if cfg.tp == 1:
+            self.params = self._put(params)
+            self.state = self._put(state)
+            pools = []
+            self.bytes_per_page = 0  # K/V payload bytes per slot, summed
+            for li, (l, p) in enumerate(zip(model.layers, params)):
+                if l.serve is None or l.serve.pool_init is None:
+                    pools.append(None)
+                    continue
+                pool = l.serve.pool_init(p, cfg.pool_pages, cfg.page,
+                                         self.dtype)
+                if "scale_k" in pool:
+                    # per-layer counter seed for the write-boundary
+                    # stochastic rounding: quantized bytes become a pure
+                    # function of (values, layer, k/v tag, stream
+                    # position) — recompute and prefix re-derivations
+                    # replay bitwise
+                    pool["kv_seed"] = jnp.int32(li)
+                from ddlbench_tpu.ops.paged_decode import pool_page_bytes
+
+                self.bytes_per_page += pool_page_bytes(pool)
+                pools.append(pool)
+            self.pools = self._put(pools)
+        else:
+            self._init_tp(model, params, state, cfg)
         # self-drafting speculative decoding (cfg.speculative: ngram:N:K)
         self._spec = cfg.spec_params()
         self._drafter = NgramDrafter(*self._spec) if self._spec else None
@@ -382,8 +391,10 @@ class ServeEngine:
             # instead of re-tracing every npl variant per engine
             (self._decode_jit, self._prefill_jit, self._cow_jit,
              self._verify_jit) = shared_fns
-        else:
+        elif cfg.tp == 1:
             self._make_fns()
+        else:
+            self._make_tp_fns()
 
     def jit_fns(self):
         """The (decode, prefill, cow, verify) jitted callables, shareable
@@ -511,6 +522,237 @@ class ServeEngine:
             logits, pools = walk(params, states, pools, table, toks,
                                  "verify", pos0, npl, page)
             return jnp.argmax(logits, axis=-1).astype(jnp.int32), pools
+
+        self._decode_jit = jax.jit(decode_fn, static_argnums=(6,),
+                                   donate_argnums=(2,))
+        self._prefill_jit = jax.jit(prefill_fn, static_argnums=(7,),
+                                    donate_argnums=(2,))
+        self._cow_jit = jax.jit(cow_fn, donate_argnums=(0,))
+        self._verify_jit = jax.jit(verify_fn, static_argnums=(6,),
+                                   donate_argnums=(2,))
+
+    # -- tensor-parallel decode (cfg.tp > 1) -------------------------------
+
+    def _init_tp(self, model, params, state, cfg: ServeConfig) -> None:
+        """tp>1 layout: stack each layer's Megatron shard slices
+        (models/transformer.tp_split_layer_params — the SAME splitter the
+        training tp engine uses) on a leading [tp] axis laid over a mesh
+        'model' axis, and size each shard's KV-pool slice from the params
+        it actually holds (n_heads/tp head groups). The page table,
+        allocator, and every scheduler decision stay host-side and
+        per-ENGINE: a tp group is ONE replica — all tp shards hold their
+        head slice of the same page, addressed by the same table row."""
+        import jax
+        import jax.numpy as jnp
+        from jax.sharding import NamedSharding
+        from jax.sharding import PartitionSpec as P
+
+        from ddlbench_tpu.distributed import make_mesh
+        from ddlbench_tpu.models.transformer import tp_split_layer_params
+
+        tp = cfg.tp
+        if len(jax.devices()) < tp:
+            raise ValueError(
+                f"ServeConfig.tp={tp} needs {tp} devices; have "
+                f"{len(jax.devices())}")
+        self._mesh = make_mesh([("model", tp)])
+
+        def put(tree, spec_tree):
+            return jax.tree.map(
+                lambda a, s: jax.device_put(
+                    a, NamedSharding(self._mesh, s)), tree, spec_tree)
+
+        stacked_params = []
+        # per-layer frozenset of the stacked (shard-sliced) param keys —
+        # _make_tp_fns squeezes exactly these back to shard-local leaves
+        self._stacked: List[frozenset] = []
+        self._p_specs = []
+        for p in params:
+            shards, repl = tp_split_layer_params(p, tp)
+            if shards[0]:
+                merged = dict(repl)
+                merged.update({k: jnp.stack([sh[k] for sh in shards])
+                               for k in shards[0]})
+                sk = frozenset(shards[0])
+                # replicated leaves may be nested subtrees (ln dicts) —
+                # mirror their structure with P() per leaf
+                spec = {k: (P("model") if k in sk
+                            else jax.tree.map(lambda _: P(), merged[k]))
+                        for k in merged}
+            else:
+                merged, sk = p, frozenset()
+                spec = jax.tree.map(lambda _: P(), p)
+            stacked_params.append(merged)
+            self._stacked.append(sk)
+            self._p_specs.append(spec)
+        self.params = put(stacked_params, self._p_specs)
+        self.state = jax.tree.map(
+            lambda a: jax.device_put(
+                a, NamedSharding(self._mesh, P())), state)
+        pools = []
+        self._pool_specs = []
+        self.bytes_per_page = 0  # full-width payload bytes per slot
+        for li, (l, p) in enumerate(zip(model.layers, params)):
+            if l.serve is None or l.serve.pool_init is None:
+                pools.append(None)
+                self._pool_specs.append(None)
+                continue
+            shards, repl = tp_split_layer_params(p, tp)
+            views = ([{**repl, **sh} for sh in shards] if shards[0]
+                     else [p] * tp)
+            per = [l.serve.pool_init(v, cfg.pool_pages, cfg.page,
+                                     self.dtype) for v in views]
+            pool = {k: jnp.stack([sp[k] for sp in per]) for k in per[0]}
+            spec = {k: P("model") for k in pool}
+            if "scale_k" in pool:
+                # same per-layer counter seed on every shard: each shard
+                # stochastically rounds ITS head slice with the same
+                # position-keyed stream, so quantized bytes stay a pure
+                # function of (values, layer, k/v tag, position) — the
+                # handoff-reship bitwise argument holds shard-wise
+                pool["kv_seed"] = jnp.int32(li)
+                spec["kv_seed"] = P()
+            from ddlbench_tpu.ops.paged_decode import pool_page_bytes
+
+            # [tp, n_pages, page, H/tp, dh]: per-page bytes sum over
+            # shards to exactly the single-chip full-width page
+            self.bytes_per_page += pool_page_bytes(pool, page_axis=1)
+            pools.append(put(pool, spec))
+            self._pool_specs.append(spec)
+        self.pools = pools
+
+    def _make_tp_fns(self) -> None:
+        """The four serve programs sharded over the mesh 'model' axis:
+        one shard_map per program whose body squeezes each shard's
+        stacked param/pool slices, enters the ``tensor_parallel`` trace
+        context, and runs the SAME layer walk as the single-chip
+        programs. The attention/MLP row-parallel projections psum over
+        'model' (models/transformer.py), so activations, logits, and
+        tokens come out replicated (out_specs P()) while the pool slices
+        stay shard-resident (out_specs P('model')). tp=1 never enters
+        this path — ``_make_fns`` is byte-identical to the pre-tp
+        programs."""
+        import jax
+        import jax.numpy as jnp
+        from jax import lax
+        from jax.sharding import PartitionSpec as P
+
+        from ddlbench_tpu.compat import shard_map as _shard_map
+        from ddlbench_tpu.models.transformer import tensor_parallel
+
+        layers = self.model.layers
+        page = self.page
+        tp = self.cfg.tp
+        mesh = self._mesh
+        stacked = self._stacked
+        sampling = self._sampling
+        p_specs = self._p_specs
+        pool_specs = self._pool_specs
+        s_specs = jax.tree.map(lambda _: P(), self.state)
+
+        def local_params(params):
+            # a sliced leaf arrives as this shard's [1, ...] stack block
+            return [{k: (v[0] if k in sk else v) for k, v in p.items()}
+                    if sk else p for p, sk in zip(params, stacked)]
+
+        def local_pools(pools):
+            # array leaves are per-shard [1, ...] blocks; scalars
+            # (kv_seed) ride replicated
+            return [None if pool is None else
+                    {k: (v[0] if getattr(v, "ndim", 0) else v)
+                     for k, v in pool.items()} for pool in pools]
+
+        def restack(pools):
+            return [None if pool is None else
+                    {k: (v[None] if getattr(v, "ndim", 0) else v)
+                     for k, v in pool.items()} for pool in pools]
+
+        def walk(params, states, pools, table, h, op_name, *op_args):
+            out_pools = []
+            for layer, p, s, pool in zip(layers, params, states, pools):
+                if layer.serve is not None:
+                    op = getattr(layer.serve, op_name)
+                    h, pool = op(p, s, pool, table, h, *op_args)
+                else:  # pointwise (the LM head) — replicated compute
+                    h, _ = layer.apply(p, s, h, False)
+                out_pools.append(pool)
+            return h, out_pools
+
+        def decode_fn(params, states, pools, table, toks, pos, npl):
+            def inner(params, states, pools, table, toks, pos):
+                with tensor_parallel("model", tp):
+                    logits, out_pools = walk(
+                        local_params(params), states, local_pools(pools),
+                        table, toks, "decode", pos, npl, page)
+                out = (logits[:, 0, :].astype(jnp.float32) if sampling
+                       else jnp.argmax(logits[:, 0, :], axis=-1)
+                       .astype(jnp.int32))
+                return out, restack(out_pools)
+
+            return _shard_map(
+                inner, mesh=mesh,
+                in_specs=(p_specs, s_specs, pool_specs, P(), P(), P()),
+                out_specs=(P(), pool_specs))(
+                    params, states, pools, table, toks, pos)
+
+        n_body = len(layers)
+        while n_body and layers[n_body - 1].serve is None \
+                and layers[n_body - 1].pointwise:
+            n_body -= 1
+
+        def prefill_fn(params, states, pools, table, chunk, start, want,
+                       npl):
+            def inner(params, states, pools, table, chunk, start, want):
+                params_l = local_params(params)
+                pools_l = local_pools(pools)
+                with tensor_parallel("model", tp):
+                    h, out_pools = walk(
+                        params_l[:n_body], states[:n_body],
+                        pools_l[:n_body], table, chunk, "prefill",
+                        start, npl, page)
+                h = lax.dynamic_slice_in_dim(h, want, 1, axis=1)
+                for layer, p, s in zip(layers[n_body:],
+                                       params_l[n_body:],
+                                       states[n_body:]):
+                    h, _ = layer.apply(p, s, h, False)
+                out = (h[0, 0, :].astype(jnp.float32) if sampling
+                       else jnp.argmax(h[0, 0, :], axis=-1)
+                       .astype(jnp.int32))
+                return out, restack(out_pools + pools_l[n_body:])
+
+            return _shard_map(
+                inner, mesh=mesh,
+                in_specs=(p_specs, s_specs, pool_specs, P(), P(), P(),
+                          P()),
+                out_specs=(P(), pool_specs))(
+                    params, states, pools, table, chunk, start, want)
+
+        def cow_fn(pools, src, dst):
+            from ddlbench_tpu.ops.paged_decode import serve_page_copy
+
+            def inner(pools, src, dst):
+                return restack([serve_page_copy(pool, src, dst)
+                                if pool is not None else None
+                                for pool in local_pools(pools)])
+
+            return _shard_map(
+                inner, mesh=mesh, in_specs=(pool_specs, P(), P()),
+                out_specs=pool_specs)(pools, src, dst)
+
+        def verify_fn(params, states, pools, table, toks, pos0, npl):
+            def inner(params, states, pools, table, toks, pos0):
+                with tensor_parallel("model", tp):
+                    logits, out_pools = walk(
+                        local_params(params), states, local_pools(pools),
+                        table, toks, "verify", pos0, npl, page)
+                return (jnp.argmax(logits, axis=-1).astype(jnp.int32),
+                        restack(out_pools))
+
+            return _shard_map(
+                inner, mesh=mesh,
+                in_specs=(p_specs, s_specs, pool_specs, P(), P(), P()),
+                out_specs=(P(), pool_specs))(
+                    params, states, pools, table, toks, pos0)
 
         self._decode_jit = jax.jit(decode_fn, static_argnums=(6,),
                                    donate_argnums=(2,))
@@ -1413,6 +1655,116 @@ class ServeEngine:
         self._queued_at.clear()
         return reqs, rep.evicted, handoff
 
+    # -- cross-engine page shipping (serve/handoff.py) ---------------------
+
+    def fetch_pages(self, slots: List[int]) -> List[Optional[Dict[str,
+                                                                  Any]]]:
+        """Device->host copy of the given pool slots: payload + scale-
+        sidecar rows, one dict per serving layer (None for layers with no
+        pool). tp>1 pools fetch the [tp, ...] stacked slices, so the full
+        head width ships regardless of the shard layout. The per-layer
+        ``kv_seed`` never ships — it is layer-intrinsic and identical on
+        every engine built from the same model, which is exactly what
+        makes re-quantization after a decode-fleet failover bitwise."""
+        idx = np.asarray(slots, np.int64)
+        out: List[Optional[Dict[str, Any]]] = []
+        for pool in self.pools:
+            if pool is None:
+                out.append(None)
+                continue
+            out.append({k: np.asarray(v[idx] if self._page_axis == 0
+                                      else v[:, idx])
+                        for k, v in pool.items()
+                        if getattr(v, "ndim", 0)})
+        return out
+
+    def write_pages(self, slots: List[int], pages) -> None:
+        """Host->device import of ``fetch_pages`` rows into this engine's
+        pool at ``slots`` (the importer's own allocator grants). Bytes are
+        written verbatim — int8 payload and f32 scale sidecars land
+        bit-identical to the exporter's, so subsequent decode reads (and
+        the position-keyed stochastic-rounding re-writes of any future
+        positions) match the aggregated engine exactly."""
+        idx = np.asarray(slots, np.int64)
+        new_pools = []
+        for pool, rows in zip(self.pools, pages):
+            if pool is None:
+                new_pools.append(None)
+                continue
+            pool = dict(pool)
+            for k, v in rows.items():
+                arr = pool[k]
+                pool[k] = (arr.at[idx].set(v) if self._page_axis == 0
+                           else arr.at[:, idx].set(v))
+            new_pools.append(pool)
+        self.pools = new_pools
+
+    def extract_request(self, rid: int) -> Dict[str, Any]:
+        """Pop an in-flight DECODE-state request off this engine for
+        cross-engine shipping: copy its table-row pages to host
+        (:meth:`fetch_pages`), then free the row and its page refs —
+        prefix-registered blocks survive on the index's own refs, exactly
+        like eviction. Returns the ship dict :meth:`import_request`
+        accepts. Extraction is not a terminal state: nothing lands in
+        ``finished``/``evicted`` — the request continues elsewhere."""
+        a = next((x for x in self._active() if x.req.rid == rid), None)
+        if a is None or a.state != "decode":
+            raise ValueError(
+                f"extract_request: rid {rid} is not an in-flight decode "
+                "request")
+        slots = [int(s) for s in self.table[a.row, :a.n_pages]]
+        ship = {
+            "rid": rid, "req": a.req, "out": list(a.out),
+            "token_times": list(a.token_times),
+            "first_token_t": a.first_token_t,
+            "pending_tok": a.pending_tok,
+            "prefill_done": a.prefill_done,
+            "n_pages": a.n_pages,
+            "cached_tokens": self._cached_tokens.pop(rid, 0),
+            "pages": self.fetch_pages(slots),
+        }
+        self.allocator.free_request(rid)
+        self.table[a.row, :] = 0
+        self.rows[a.row] = None
+        self._queued_at.pop(rid, None)
+        self._evicted_rids.discard(rid)
+        return ship
+
+    def import_request(self, ship: Dict[str, Any], now: float) -> bool:
+        """Bind a shipped request's pages into this engine and resume it
+        mid-stream in decode state. All-or-nothing: returns False (engine
+        unchanged) when no free row or not enough free pages — the caller
+        parks the ship and retries next step. The imported request joins
+        the admission order at the tail, like any admission."""
+        row = self._free_row()
+        if row is None:
+            return False
+        req: ServeRequest = ship["req"]
+        slots = self._alloc(req.rid, ship["n_pages"])
+        if slots is None:
+            return False
+        self._now = now
+        self.write_pages(slots, ship["pages"])
+        a = _Active(req=req, row=row, admit_seq=self._admit_seq)
+        self._admit_seq += 1
+        a.state = "decode"
+        a.prefill_done = ship["prefill_done"]
+        a.n_pages = ship["n_pages"]
+        a.pending_tok = ship["pending_tok"]
+        a.out = list(ship["out"])
+        a.token_times = list(ship["token_times"])
+        a.first_token_t = ship["first_token_t"]
+        self.table[row, :] = 0
+        self.table[row, :a.n_pages] = slots
+        self.rows[row] = a
+        if ship["cached_tokens"]:
+            self._cached_tokens[req.rid] = ship["cached_tokens"]
+        if req.deadline is not None:
+            self._has_deadlines = True
+        self.stats["admitted"] += 1
+        self._trace_admit(a, ship["cached_tokens"])
+        return True
+
     def stats_summary(self) -> Dict[str, float]:
         s = dict(self.stats)
         calls = s.pop("decode_calls")
@@ -1609,7 +1961,8 @@ class ReplicatedServer:
 
     # -- serving-fleet chaos: hard kill, straggler stall, heartbeat --------
 
-    def fail(self, replica: int, now: float = 0.0) -> Dict[str, Any]:
+    def fail(self, replica: int, now: float = 0.0,
+             dispatch=None) -> Dict[str, Any]:
         """HARD-KILL the replica at fleet index ``replica``: the engine is
         discarded — its device pool (all resident KV, prefix cache
         included) is lost — and only host-side state survives: finished
@@ -1628,12 +1981,17 @@ class ReplicatedServer:
         least-loaded pick first), then the waiting queue in order. A
         resubmission can still be SHED by deadline admission control on
         the survivor — counted in the event's ``shed_on_failover`` (those
-        requests surface in servechaos's ``requests_lost``)."""
+        requests surface in servechaos's ``requests_lost``).
+
+        ``dispatch`` overrides where displaced requests resubmit: the
+        disaggregated server routes a killed DECODE replica's requests
+        back through the PREFILL fleet's dispatcher (the pages died with
+        the replica — they must re-prefill and re-ship)."""
         if not 0 <= replica < len(self.engines):
             raise IndexError(
                 f"fail: no replica at fleet index {replica} "
                 f"(fleet size {len(self.engines)})")
-        if len(self.engines) == 1:
+        if len(self.engines) == 1 and dispatch is None:
             raise ValueError(
                 "cannot fail the last replica — no survivor to fail over "
                 "to (the fleet analog of losing the whole pod)")
@@ -1657,10 +2015,11 @@ class ReplicatedServer:
         eng._stall_ticks = 0
         self._retired.append(eng)
         resubmitted = shed_n = 0
+        dispatch = dispatch if dispatch is not None else self._dispatch
         moves = [(a.req, True) for a in inflight] \
             + [(r, False) for r in queued]
         for r, was_active in moves:
-            tgt = self._dispatch(r, now=now)
+            tgt = dispatch(r, now=now)
             if tgt is not None:
                 resubmitted += 1
                 if was_active:
@@ -1839,36 +2198,44 @@ class ReplicatedServer:
         }
 
     def stats_summary(self) -> Dict[str, float]:
-        sums: Dict[str, float] = {}
-        fleet = self.engines + self._retired  # resize never loses counters
-        for e in fleet:
-            for k, v in e.stats_summary().items():
-                sums[k] = sums.get(k, 0) + v
-        for k in ("decode_batch_util", "mean_page_fragmentation"):
-            sums[k] /= len(fleet)
-        # peak occupancy is a saturation signal: averaging would hide one
-        # evicting, pool-bound replica behind its idle siblings — the
-        # shared-page peak is the same kind of signal
-        sums["peak_occupancy"] = max(
-            e.stats["peak_occupancy"] for e in fleet)
-        sums["shared_pages"] = max(
-            e.stats["shared_pages"] for e in fleet)
-        # per-slot layout is identical across the fleet (one model/config);
-        # pool_bytes is the LIVE fleet's total cache HBM — a drained
-        # (retired) engine's pool is released with it, so summing the
-        # whole fleet would over-report capacity after every scale-down
-        sums["bytes_per_page"] = fleet[0].bytes_per_page
-        sums["pool_bytes"] = sum(
-            e.bytes_per_page * e.cfg.pool_pages for e in self.engines)
-        # rates re-derive from the summed counters (a mean of per-replica
-        # ratios would weight an idle replica like a loaded one)
-        row_passes = sum(e.stats["decode_row_slots"] for e in fleet)
-        sums["spec_accept_rate"] = (
-            sums["spec_accepted"] / sums["spec_drafted"]
-            if sums["spec_drafted"] else 0.0)
-        sums["tokens_per_pass"] = (
-            sums["decode_tokens"] / row_passes if row_passes else 0.0)
-        return sums
+        return fleet_stats(self.engines, self._retired)
+
+
+def fleet_stats(live: List[ServeEngine],
+                retired: List[ServeEngine]) -> Dict[str, float]:
+    """Fleet-wide summary over live + retired engines — shared by
+    ReplicatedServer and the disaggregated server (serve/handoff.py),
+    whose fleet is the union of its prefill and decode engines."""
+    sums: Dict[str, float] = {}
+    fleet = live + retired  # resize/failure never loses counters
+    for e in fleet:
+        for k, v in e.stats_summary().items():
+            sums[k] = sums.get(k, 0) + v
+    for k in ("decode_batch_util", "mean_page_fragmentation"):
+        sums[k] /= len(fleet)
+    # peak occupancy is a saturation signal: averaging would hide one
+    # evicting, pool-bound replica behind its idle siblings — the
+    # shared-page peak is the same kind of signal
+    sums["peak_occupancy"] = max(
+        e.stats["peak_occupancy"] for e in fleet)
+    sums["shared_pages"] = max(
+        e.stats["shared_pages"] for e in fleet)
+    # per-slot layout is identical across the fleet (one model/config);
+    # pool_bytes is the LIVE fleet's total cache HBM — a drained
+    # (retired) engine's pool is released with it, so summing the
+    # whole fleet would over-report capacity after every scale-down
+    sums["bytes_per_page"] = fleet[0].bytes_per_page
+    sums["pool_bytes"] = sum(
+        e.bytes_per_page * e.cfg.pool_pages for e in live)
+    # rates re-derive from the summed counters (a mean of per-replica
+    # ratios would weight an idle replica like a loaded one)
+    row_passes = sum(e.stats["decode_row_slots"] for e in fleet)
+    sums["spec_accept_rate"] = (
+        sums["spec_accepted"] / sums["spec_drafted"]
+        if sums["spec_drafted"] else 0.0)
+    sums["tokens_per_pass"] = (
+        sums["decode_tokens"] / row_passes if row_passes else 0.0)
+    return sums
 
 
 def make_server(model: LayerModel, params, state, cfg: ServeConfig,
@@ -1891,8 +2258,10 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
     n = cfg.replicas
     if devices is None:
         devs = jax.devices()
-        devices = [devs[i] if n > 1 and i < len(devs) else None
-                   for i in range(n)]
+        # a tp>1 replica is placed by its mesh sharding (_init_tp), not a
+        # single device — per-replica device pinning applies to tp=1 only
+        devices = [devs[i] if n > 1 and cfg.tp == 1 and i < len(devs)
+                   else None for i in range(n)]
     rep_cfg = cfg.replace(replicas=1)
     engines = []
     for d in devices:
@@ -1909,8 +2278,8 @@ def make_server(model: LayerModel, params, state, cfg: ServeConfig,
         # the drained replicas vacated instead of stacking new replicas
         # on the default device
         devs = jax.devices()
-        device = (devs[slot] if fleet_size > 1 and slot < len(devs)
-                  else None)
+        device = (devs[slot] if fleet_size > 1 and rep_cfg.tp == 1
+                  and slot < len(devs) else None)
         return ServeEngine(model, params, state, rep_cfg, dtype=dtype,
                            device=device, shared_fns=fns, replica=replica)
 

@@ -1,0 +1,245 @@
+"""Disaggregated serving: a prefill fleet feeding a decode fleet by
+KV-page shipping.
+
+Prefill and decode have opposite hardware appetites — prefill is one
+compute-bound pass over the whole prompt, decode is hundreds of
+memory-bound single-token passes — so serving both phases on every
+replica makes each replica bad at one of them (the chunked-prefill
+token budget is exactly the knob that rations their interference). The
+disaggregated layout gives each phase its own fleet and moves a request
+ONCE, at the phase boundary:
+
+    prefill fleet                          decode fleet
+    admit -> chunk-prefill -> first token
+            export_request(rid)  ----->  import_request(ship)
+            (pages + scale sidecars       (bind into own allocator,
+             device->host, refs freed)     resume mid-stream in decode)
+
+The transfer primitive is the page pool itself: a request's KV state is
+its table-row page slots, so export is a device->host gather of those
+pool rows (payload + int8 scale sidecars), and import is an allocator
+grant plus a verbatim scatter on the receiving engine — the same
+refcount/free-list machinery the prefix cache's bind/COW path already
+exercises. int8 pools ship exactly f32/4 payload bytes; the f32 scale
+sidecar (8 B/position/layer) is accounted separately, mirroring the
+``bytes_per_page`` convention.
+
+Determinism: token streams are pure functions of (params, prompt, rid,
+token index) — greedy argmax and seeded sampling alike — and quantized
+page bytes are pure functions of (values, layer seed, k/v tag, stream
+position). So the disaggregated server's streams pin bitwise against
+the aggregated fleet, a prefill-replica kill mid-handoff loses nothing
+(displaced requests re-prefill on survivors, regenerating identical
+pages), and a decode-replica kill re-routes its requests through the
+PREFILL fleet's dispatcher (the pages died with the replica), where
+re-prefill re-quantizes byte-identical pages before re-shipping.
+"""
+
+from typing import Any, Dict, List, Optional
+
+from ddlbench_tpu.config import ServeConfig
+from ddlbench_tpu.serve.engine import (
+    ReplicatedServer,
+    ServeEngine,
+    StepReport,
+    fleet_stats,
+    make_server,
+)
+from ddlbench_tpu.serve.workload import ServeRequest
+
+PAYLOAD_KEYS = ("pool_k", "pool_v")
+SIDECAR_KEYS = ("scale_k", "scale_v")
+
+
+def ship_payload_bytes(ship: Dict[str, Any]) -> int:
+    """K/V payload bytes in one ship — for an int8 pool exactly 1/4 of
+    the f32 pool's bytes for the same pages (the EQuARX-style halving
+    argument, applied to the handoff wire)."""
+    return sum(rows[k].nbytes for rows in ship["pages"]
+               if rows is not None for k in PAYLOAD_KEYS)
+
+
+def ship_sidecar_bytes(ship: Dict[str, Any]) -> int:
+    """f32 scale-sidecar bytes in one ship (0 for unquantized pools)."""
+    return sum(rows[k].nbytes for rows in ship["pages"]
+               if rows is not None for k in SIDECAR_KEYS if k in rows)
+
+
+def export_request(engine: ServeEngine, rid: int) -> Dict[str, Any]:
+    """Pop ``rid`` off ``engine`` (ServeEngine.extract_request) and stamp
+    the ship with its wire-byte accounting."""
+    ship = engine.extract_request(rid)
+    ship["payload_bytes"] = ship_payload_bytes(ship)
+    ship["sidecar_bytes"] = ship_sidecar_bytes(ship)
+    return ship
+
+
+class DisaggregatedServer:
+    """A prefill ReplicatedServer feeding a decode ReplicatedServer.
+
+    Driver-compatible with ReplicatedServer (submit/has_work/step plus
+    the record/event surfaces servebench and servechaos read), so the
+    open/closed-loop generators drive both layouts unchanged. Traffic
+    enters the PREFILL fleet; after every global step, each prefill
+    engine's decode-state actives — requests whose prefill just finished
+    (their first token rode the last chunk) — are exported and imported
+    least-loaded into the decode fleet. A ship that finds no decode
+    capacity parks host-side and retries every step (``backpressure`` is
+    the decode fleet's admission story, not the prefill fleet's).
+    """
+
+    def __init__(self, prefill: ReplicatedServer,
+                 decode: ReplicatedServer):
+        self.prefill = prefill
+        self.decode = decode
+        self._pending: List[Dict[str, Any]] = []  # ships parked host-side
+        self.shipped: Dict[str, int] = {
+            "shipped_requests": 0, "shipped_pages": 0,
+            "shipped_payload_bytes": 0, "shipped_sidecar_bytes": 0}
+
+    # -- ReplicatedServer-compatible driver surface ------------------------
+
+    def submit(self, req: ServeRequest,
+               now: Optional[float] = None) -> bool:
+        return self.prefill.submit(req, now=now)
+
+    def has_work(self) -> bool:
+        return (bool(self._pending) or self.prefill.has_work()
+                or self.decode.has_work())
+
+    def step(self, now: float = 0.0) -> StepReport:
+        rep = StepReport()
+        if self.prefill.has_work():
+            rep.merge(self.prefill.step(now))
+        if self.decode.has_work():
+            rep.merge(self.decode.step(now))
+        if rep.cost == 0 and self.has_work():
+            rep.cost = 1  # parked ships alone still burn a time unit
+        self._ship(now + rep.cost)
+        return rep
+
+    def _ship(self, now: float) -> None:
+        """The handoff tick: export every prefill-side request whose
+        prefill completed this step, then bind pending ships into the
+        decode fleet in (load, index) order — all-or-nothing per ship,
+        parking what finds no room. Runs at the step's END, so a request
+        always takes its first decode pass on the decode fleet (at step
+        start the prefill fleet never holds a decode-state active)."""
+        for eng in self.prefill.engines:
+            ready = sorted((a for a in eng._active()
+                            if a.state == "decode"),
+                           key=lambda a: a.admit_seq)
+            for a in ready:
+                ship = export_request(eng, a.req.rid)
+                self.shipped["shipped_requests"] += 1
+                self.shipped["shipped_pages"] += ship["n_pages"]
+                self.shipped["shipped_payload_bytes"] += \
+                    ship["payload_bytes"]
+                self.shipped["shipped_sidecar_bytes"] += \
+                    ship["sidecar_bytes"]
+                self._pending.append(ship)
+        parked = []
+        for ship in self._pending:
+            order = sorted(enumerate(self.decode.engines),
+                           key=lambda ie: (ie[1].load(), ie[0]))
+            if not any(e.import_request(ship, now) for _, e in order):
+                parked.append(ship)
+        self._pending = parked
+
+    # -- chaos: per-fleet hard kills ---------------------------------------
+
+    def fail_prefill(self, index: int, now: float = 0.0) -> Dict[str, Any]:
+        """Kill the prefill replica at fleet index ``index``: displaced
+        requests (mid-prefill or queued — any already-exported ship is
+        host-side and unaffected) resubmit onto the surviving prefill
+        replicas and re-prefill from scratch, regenerating identical
+        pages."""
+        ev = self.prefill.fail(index, now)
+        ev["fleet"] = "prefill"
+        return ev
+
+    def fail_decode(self, index: int, now: float = 0.0) -> Dict[str, Any]:
+        """Kill the decode replica at fleet index ``index``: its imported
+        pages die with it, so displaced requests route back through the
+        PREFILL fleet's dispatcher — re-prefill re-quantizes the pages
+        byte-identically (position-keyed stochastic rounding) and the
+        handoff re-ships them."""
+        ev = self.decode.fail(index, now,
+                              dispatch=self.prefill._dispatch)
+        ev["fleet"] = "decode"
+        return ev
+
+    # -- record/event surfaces (servebench/servechaos read these) ----------
+
+    @property
+    def engines(self) -> List[ServeEngine]:
+        return self.prefill.engines + self.decode.engines
+
+    @property
+    def finished(self) -> List[Dict[str, Any]]:
+        return self.prefill.finished + self.decode.finished
+
+    @property
+    def timed_out(self) -> List[Dict[str, Any]]:
+        return self.prefill.timed_out + self.decode.timed_out
+
+    @property
+    def shed_records(self) -> List[Dict[str, Any]]:
+        return self.prefill.shed_records + self.decode.shed_records
+
+    @property
+    def fail_events(self) -> List[Dict[str, Any]]:
+        return self.prefill.fail_events + self.decode.fail_events
+
+    @property
+    def stall_events(self) -> List[Dict[str, Any]]:
+        return self.prefill.stall_events + self.decode.stall_events
+
+    @property
+    def heartbeat_events(self) -> List[Dict[str, Any]]:
+        return self.prefill.heartbeat_events + self.decode.heartbeat_events
+
+    @property
+    def resize_events(self) -> List[Dict[str, Any]]:
+        return self.prefill.resize_events + self.decode.resize_events
+
+    def snapshot(self) -> Dict[str, Any]:
+        return {"prefill": self.prefill.snapshot(),
+                "decode": self.decode.snapshot(),
+                "pending_ships": len(self._pending), **self.shipped}
+
+    def stats_summary(self) -> Dict[str, float]:
+        s = fleet_stats(self.prefill.engines + self.decode.engines,
+                        self.prefill._retired + self.decode._retired)
+        s.update(self.shipped)
+        return s
+
+
+def make_disaggregated(model, params, state, cfg: ServeConfig,
+                       prefill_replicas: int, decode_replicas: int,
+                       dtype=None, shared_fns=None) -> DisaggregatedServer:
+    """Build a P:D disaggregated server over one model/config. Both
+    fleets run the SAME jitted programs (disaggregation is a scheduling
+    split, not a program split), so they share one compiled-callable
+    cache; tp=1 fleets lay out on devices [0, P) and [P, P+D) when
+    enough exist (a tp>1 replica is mesh-placed instead)."""
+    import jax
+
+    if prefill_replicas < 1 or decode_replicas < 1:
+        raise ValueError(
+            f"disaggregation needs >= 1 replica per fleet, got "
+            f"{prefill_replicas}:{decode_replicas}")
+    devs = jax.devices()
+    total = prefill_replicas + decode_replicas
+    pre_devs = dec_devs = None
+    if cfg.tp == 1 and total > 1 and total <= len(devs):
+        pre_devs = list(devs[:prefill_replicas])
+        dec_devs = list(devs[prefill_replicas:total])
+    pre = make_server(model, params, state,
+                      cfg.replace(replicas=prefill_replicas), dtype=dtype,
+                      devices=pre_devs, shared_fns=shared_fns)
+    dec = make_server(model, params, state,
+                      cfg.replace(replicas=decode_replicas), dtype=dtype,
+                      devices=dec_devs,
+                      shared_fns=pre.engines[0].jit_fns())
+    return DisaggregatedServer(pre, dec)

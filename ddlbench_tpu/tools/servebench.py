@@ -89,6 +89,29 @@ _SPEC_FIELDS = frozenset((
 # (admission shedding / timeout cancellation): same flag-gating pattern
 _CHAOS_FIELDS = frozenset(("shed", "timeouts"))
 
+# stats keys only the disaggregated server emits (handoff wire-byte
+# accounting): gated so a plain aggregated row keeps the pinned schema
+# even if a future server grows the counters
+_DISAGG_FIELDS = frozenset((
+    "shipped_requests", "shipped_pages", "shipped_payload_bytes",
+    "shipped_sidecar_bytes"))
+
+
+def parse_disaggregate(spec, perr):
+    """Parse ``--disaggregate P:D`` (prefill:decode replica counts) —
+    shared with servechaos. Returns (P, D) or None for an absent spec."""
+    if not spec:
+        return None
+    try:
+        p_s, d_s = spec.split(":")
+        pd = (int(p_s), int(d_s))
+    except ValueError:
+        perr(f"--disaggregate wants P:D (prefill:decode replicas), "
+             f"got {spec!r}")
+    if pd[0] < 1 or pd[1] < 1:
+        perr(f"--disaggregate {spec!r}: both fleets need >= 1 replica")
+    return pd
+
 
 def _round6(v):
     """round(_, 6) through nested timeline/breakdown structures so the
@@ -334,6 +357,22 @@ def main(argv=None) -> int:
     p.add_argument("--replicas", type=int, default=1,
                    help="independent data-parallel serving replicas "
                         "(least-loaded dispatch)")
+    p.add_argument("--serve-tp", type=int, default=1, metavar="N",
+                   help="tensor-parallel width of ONE replica: the serve "
+                        "programs shard Megatron-style over a mesh "
+                        "'model' axis (N devices per replica share one "
+                        "page table), so models larger than one chip's "
+                        "HBM serve at all. Default 1 keeps the single-"
+                        "chip programs bitwise-unchanged")
+    p.add_argument("--disaggregate", default=None, metavar="P:D",
+                   help="disaggregated serving: a P-replica PREFILL fleet "
+                        "feeds a D-replica DECODE fleet by KV-page "
+                        "shipping (serve/handoff.py) — int8 pools ship "
+                        "f32/4 payload bytes. Token streams pin bitwise "
+                        "vs the aggregated fleet; the row gains "
+                        "disaggregate/prefill_replicas/decode_replicas + "
+                        "shipped_* fields. Continuous policy only; "
+                        "replaces --replicas and excludes --resize")
     p.add_argument("--resize", action="append", default=[], metavar="AT:N",
                    help="live replica resize schedule (repeatable): at "
                         "virtual time AT scale the fleet to N replicas "
@@ -499,6 +538,20 @@ def main(argv=None) -> int:
             p.error("--shared-prefix wants G:P (groups:prefix_tokens), "
                     f"got {args.shared_prefix!r}")
     retry = parse_retry(args.retry, p.error)
+    disagg = parse_disaggregate(args.disaggregate, p.error)
+    if args.serve_tp < 1:
+        p.error("--serve-tp must be >= 1")
+    if disagg:
+        if policies != ["continuous"]:
+            p.error("--disaggregate serves the continuous policy only "
+                    "(pass --policies continuous); the static baseline's "
+                    "fill/drain barrier has no phase boundary to ship at")
+        if args.replicas != 1:
+            p.error("--disaggregate P:D sets both fleet sizes; drop "
+                    "--replicas")
+        if args.resize:
+            p.error("--resize scales one aggregated fleet; it does not "
+                    "compose with --disaggregate")
     if args.deadline_slack is not None and args.deadline_slack <= 0:
         p.error("--deadline-slack must be > 0 time units")
     if args.retry and args.deadline_slack is None:
@@ -540,7 +593,8 @@ def main(argv=None) -> int:
         token_budget=args.token_budget,
         prefill_chunk=(args.page if args.prefill_chunk is None
                        else args.prefill_chunk),
-        replicas=args.replicas, temperature=temperature, top_k=top_k,
+        replicas=args.replicas, tp=args.serve_tp,
+        temperature=temperature, top_k=top_k,
         sample_seed=args.seed, trace=bool(args.trace),
         slo_ttft=args.slo_ttft, slo_itl=args.slo_itl,
         heartbeat=args.heartbeat,
@@ -571,8 +625,15 @@ def main(argv=None) -> int:
         # policy rows share the compiled programs (identical model and
         # shapes — policy/prefix_cache are host-side decisions), so only
         # the first row pays the trace
-        server = make_server(model, params, state, cfg,
-                             shared_fns=shared_fns)
+        if disagg:
+            from ddlbench_tpu.serve.handoff import make_disaggregated
+
+            server = make_disaggregated(model, params, state, cfg,
+                                        disagg[0], disagg[1],
+                                        shared_fns=shared_fns)
+        else:
+            server = make_server(model, params, state, cfg,
+                                 shared_fns=shared_fns)
         shared_fns = server.engines[0].jit_fns()
         # one fresh bounded ring per policy row, installed process-global
         # (the engines look it up lazily) and restored afterwards —
@@ -682,7 +743,16 @@ def main(argv=None) -> int:
                # key set
                if k != "completed"
                and (args.speculative or k not in _SPEC_FIELDS)
-               and (chaos or k not in _CHAOS_FIELDS)},
+               and (chaos or k not in _CHAOS_FIELDS)
+               and (disagg or k not in _DISAGG_FIELDS)},
+            # --serve-tp only (plain rows keep the pinned schema): the
+            # tp-group width every replica runs at
+            **({"serve_tp": cfg.tp} if args.serve_tp > 1 else {}),
+            # --disaggregate only: the fleet split (shipped_* counters
+            # ride the stats merge above under the same gate)
+            **({"disaggregate": args.disaggregate,
+                "prefill_replicas": disagg[0],
+                "decode_replicas": disagg[1]} if disagg else {}),
             # --kv-dtype / --speculative only (plain rows keep the
             # schema-pinned key set): the A/B axis made explicit
             **({"kv_dtype": cfg.kv_dtype} if args.kv_dtype else {}),

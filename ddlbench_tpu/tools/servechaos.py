@@ -56,15 +56,27 @@ import sys
 import time
 
 
-def _parse_kills(specs, perr):
+def _parse_kills(specs, perr, disagg=False):
+    """Kill specs as (t, fleet, index) triples. Aggregated grammar is
+    ``T:R`` (fleet None); under --disaggregate the index names its fleet:
+    ``T:pR`` kills prefill replica R, ``T:dR`` decode replica R."""
     out = []
     for s in specs:
         try:
             t_s, r_s = s.split(":")
-            out.append((float(t_s), int(r_s)))
+            if disagg:
+                fleet = r_s[:1]
+                if fleet not in ("p", "d") or not r_s[1:]:
+                    raise ValueError
+                out.append((float(t_s), fleet, int(r_s[1:])))
+            else:
+                out.append((float(t_s), None, int(r_s)))
         except ValueError:
+            if disagg:
+                perr(f"--kill under --disaggregate wants T:pR or T:dR "
+                     f"(virtual_time:fleet+index), got {s!r}")
             perr(f"--kill wants T:R (virtual_time:fleet_index), got {s!r}")
-        if out[-1][0] < 0 or out[-1][1] < 0:
+        if out[-1][0] < 0 or out[-1][2] < 0:
             perr(f"--kill {s!r}: T >= 0 and R >= 0")
     return out
 
@@ -89,10 +101,16 @@ def _fault_events(kills, stalls):
     so later specs address the surviving fleet's positions."""
     ev = []
 
-    def kill_fn(r):
+    def kill_fn(fleet, r):
         def fire(server, clock):
-            rep = server.fail(r, now=clock)
-            print(f"servechaos: kill @ {clock:g} -> replica "
+            if fleet == "p":
+                rep = server.fail_prefill(r, now=clock)
+            elif fleet == "d":
+                rep = server.fail_decode(r, now=clock)
+            else:
+                rep = server.fail(r, now=clock)
+            which = {"p": "prefill ", "d": "decode "}.get(fleet, "")
+            print(f"servechaos: kill @ {clock:g} -> {which}replica "
                   f"{rep['replica_id']} (salvaged {rep['salvaged']}, "
                   f"displaced {len(rep['displaced_inflight'])} in-flight "
                   f"+ {rep['displaced_queued']} queued)",
@@ -106,8 +124,8 @@ def _fault_events(kills, stalls):
                   f"for {d} steps", file=sys.stderr, flush=True)
         return fire
 
-    for t, r in kills:
-        ev.append((t, kill_fn(r)))
+    for t, fleet, r in kills:
+        ev.append((t, kill_fn(fleet, r)))
     for t, r, d in stalls:
         ev.append((t, stall_fn(r, d)))
     ev.sort(key=lambda e: e[0])
@@ -149,10 +167,20 @@ def main(argv=None) -> int:
     p.add_argument("-m", "--model", default="transformer_s")
     p.add_argument("-b", "--benchmark", default="synthtext")
     p.add_argument("--replicas", type=int, default=2)
+    p.add_argument("--disaggregate", default=None, metavar="P:D",
+                   help="chaos the disaggregated layout (serve/handoff): "
+                        "a P-replica prefill fleet feeding a D-replica "
+                        "decode fleet by KV-page shipping. Replaces "
+                        "--replicas; --kill takes T:pR / T:dR to name the "
+                        "fleet (a decode kill re-routes its requests "
+                        "through the prefill fleet — re-prefill "
+                        "re-quantizes the pages byte-identically and the "
+                        "handoff re-ships)")
     p.add_argument("--kill", action="append", default=[], metavar="T:R",
                    help="hard-kill the replica at fleet index R at "
                         "virtual time T (repeatable; pool lost, records "
-                        "salvaged, requests failed over bitwise)")
+                        "salvaged, requests failed over bitwise). Under "
+                        "--disaggregate: T:pR (prefill) / T:dR (decode)")
     p.add_argument("--stall", action="append", default=[], metavar="T:R:D",
                    help="straggler: replica at fleet index R makes no "
                         "progress for D global steps starting at time T "
@@ -202,11 +230,16 @@ def main(argv=None) -> int:
 
     add_platform_arg(p)
     args = p.parse_args(argv)
-    from ddlbench_tpu.tools.servebench import parse_retry
+    from ddlbench_tpu.tools.servebench import (parse_disaggregate,
+                                               parse_retry)
 
-    kills = _parse_kills(args.kill, p.error)
+    disagg = parse_disaggregate(args.disaggregate, p.error)
+    kills = _parse_kills(args.kill, p.error, disagg=bool(disagg))
     stalls = _parse_stalls(args.stall, p.error)
     retry = parse_retry(args.retry, p.error)
+    if disagg and stalls:
+        p.error("--stall addresses one aggregated fleet; it does not "
+                "compose with --disaggregate")
     if args.deadline_slack is not None and args.deadline_slack <= 0:
         p.error("--deadline-slack must be > 0 time units")
     if args.retry and args.deadline_slack is None:
@@ -215,31 +248,37 @@ def main(argv=None) -> int:
         p.error("--tier-mix is a probability in [0, 1]")
     if args.heartbeat < 0:
         p.error("--heartbeat must be >= 0 (0 = off)")
-    if args.replicas < 2 and kills:
+    if not disagg and args.replicas < 2 and kills:
         p.error("--kill needs --replicas >= 2 (a survivor to fail over to)")
     # statically hopeless schedules die HERE, not with an uncaught
     # traceback after the control run already burned its compiles: every
-    # kill GUARANTEES the fleet shrinks by one, so walking the kill
+    # kill GUARANTEES its fleet shrinks by one, so walking the kill
     # schedule in time order bounds each spec's valid indices exactly
     # (heartbeat drains can still shrink the fleet below a later spec's
     # index at runtime — fail() raises loudly in that case)
-    size = args.replicas
+    sizes = ({"p": disagg[0], "d": disagg[1]} if disagg
+             else {None: args.replicas})
     # sort by time ONLY (stable): equal-time kills fire in spec order at
     # runtime, and tuple-sorting by (t, index) would walk a different
     # order and falsely reject e.g. `--kill 5:2 --kill 5:0`
-    for t, r in sorted(kills, key=lambda k: k[0]):
-        if size <= 1:
-            p.error(f"--kill {t:g}:{r}: the fleet is already down to its "
-                    f"last replica by t={t:g} ({args.replicas} replicas, "
-                    f"{len(kills)} kills)")
-        if r >= size:
-            p.error(f"--kill {t:g}:{r}: fleet index {r} out of range — "
-                    f"at most {size} replicas remain by t={t:g}")
-        size -= 1
+    for t, fleet, r in sorted(kills, key=lambda k: k[0]):
+        name = {"p": "prefill ", "d": "decode "}.get(fleet, "")
+        if sizes[fleet] <= 1:
+            # a decode fleet must also keep a survivor: its pages can be
+            # regenerated via the prefill fleet, but ships need at least
+            # one live decode replica to bind into
+            p.error(f"--kill {t:g}:{fleet or ''}{r}: the {name}fleet is "
+                    f"already down to its last replica by t={t:g}")
+        if r >= sizes[fleet]:
+            p.error(f"--kill {t:g}:{fleet or ''}{r}: {name}fleet index "
+                    f"{r} out of range — at most {sizes[fleet]} replicas "
+                    f"remain by t={t:g}")
+        sizes[fleet] -= 1
     for t, r, d in stalls:
         # a stall's valid indices also shrink with every kill that fires
         # before (or, by the event sort's kill-first tie-break, at) it
-        size_at_t = args.replicas - sum(1 for kt, _ in kills if kt <= t)
+        size_at_t = args.replicas - sum(1 for kt, _, _ in kills
+                                        if kt <= t)
         if r >= size_at_t:
             p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of range "
                     f"— at most {size_at_t} replicas remain by t={t:g} "
@@ -283,7 +322,7 @@ def main(argv=None) -> int:
         token_budget=args.token_budget,
         prefill_chunk=(args.page if args.prefill_chunk is None
                        else args.prefill_chunk),
-        replicas=args.replicas, slo_ttft=args.slo_ttft,
+        replicas=1 if disagg else args.replicas, slo_ttft=args.slo_ttft,
         slo_itl=args.slo_itl, heartbeat=args.heartbeat,
         kv_dtype=args.kv_dtype or "float32",
         speculative=args.speculative or "none")
@@ -301,17 +340,26 @@ def main(argv=None) -> int:
             deadline_slack=args.deadline_slack,
             batch_frac=args.tier_mix or 0.0)
 
+    def build(shared):
+        if disagg:
+            from ddlbench_tpu.serve.handoff import make_disaggregated
+
+            return make_disaggregated(model, params, state, cfg,
+                                      disagg[0], disagg[1],
+                                      shared_fns=shared)
+        return make_server(model, params, state, cfg, shared_fns=shared)
+
     t0 = time.perf_counter()
     # -- control: the same workload, no faults — the bitwise stream
     # reference and the unfaulted goodput baseline (skippable)
     control = None
     shared_fns = None
     if not args.no_control:
-        control = make_server(model, params, state, cfg)
+        control = build(None)
         shared_fns = control.engines[0].jit_fns()
         _run(control, workload(), args, retry)
     # -- the chaos run
-    server = make_server(model, params, state, cfg, shared_fns=shared_fns)
+    server = build(shared_fns)
     dstats = {}
     duration = _run(server, workload(), args, retry,
                     events=_fault_events(kills, stalls),
@@ -355,6 +403,9 @@ def main(argv=None) -> int:
         "requests": args.requests,
         "seed": args.seed,
         "replicas": args.replicas,
+        **({"disaggregate": args.disaggregate,
+            "prefill_replicas": disagg[0],
+            "decode_replicas": disagg[1]} if disagg else {}),
         "max_batch": cfg.max_batch,
         "pool_pages": cfg.pool_pages,
         "page": cfg.page,

@@ -79,7 +79,7 @@ def train_factory():
 def serve_factory():
     """Session-shared serving fixture (tier-1 budget, ROADMAP item 5):
     ONE tiny LM plus a jitted-callable cache keyed by (page, sampling,
-    kv_dtype, speculative) — the things the engine's traced programs
+    kv_dtype, speculative, tp) — the things the engine's traced programs
     close over — so
     every serve test that builds an engine at the same page size reuses
     the compiled decode/prefill/COW programs instead of re-tracing them
@@ -101,11 +101,12 @@ def serve_factory():
     def make(cfg, *, server=False, **kw):
         from ddlbench_tpu.serve.engine import ServeEngine, make_server
 
-        # kv_dtype changes the pool layout every program closes over, and
-        # the speculative draft width K sets the verify program's span
-        # shape — both belong in the shared-callable key
+        # kv_dtype changes the pool layout every program closes over, the
+        # speculative draft width K sets the verify program's span shape,
+        # and tp rebuilds every program as a shard_map over the model
+        # mesh — all belong in the shared-callable key
         key = (cfg.page, cfg.temperature > 0.0, cfg.kv_dtype,
-               cfg.speculative)
+               cfg.speculative, cfg.tp)
         shared = fns.get(key)
         if server:
             out = make_server(model, params, state, cfg,

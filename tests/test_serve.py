@@ -41,15 +41,32 @@ def lm(serve_factory):
     return serve_factory.model, serve_factory.params, serve_factory.state
 
 
+_ORACLE_T = 16  # canonical decode horizon (== the suites' max_len)
+_ORACLE_MEMO = {}
+
+
 def _standalone_stream(lm, prompt, max_new):
-    """Oracle: the standalone KV-cached greedy continuation."""
+    """Oracle: the standalone KV-cached greedy continuation.
+
+    Decodes to ONE canonical horizon and truncates (greedy is
+    prefix-stable: token t depends only on the tokens before it, and
+    unwritten cache positions are masked), so every oracle call at a
+    given prompt length shares one compiled cache shape + decode loop
+    instead of paying a fresh compile per (prompt, max_new) pair —
+    tier-1 budget, ROADMAP item 5. Results are memoized: re-derivation
+    pins (eviction/recompute/failover) re-read streams they already
+    computed."""
     import ddlbench_tpu.models.decode as dec
 
     model, params, state = lm
-    total = prompt.shape[0] + max_new
-    out = dec.greedy_decode(model, params, state,
-                            jnp.asarray(prompt)[None], total)
-    return np.asarray(out)[0, prompt.shape[0]:]
+    S = prompt.shape[0]
+    key = (prompt.tobytes(), S, max_new)
+    if key not in _ORACLE_MEMO:
+        total = max(S + max_new, min(_ORACLE_T, model.in_shape[0]))
+        out = dec.greedy_decode(model, params, state,
+                                jnp.asarray(prompt)[None], total)
+        _ORACLE_MEMO[key] = np.asarray(out)[0, S:S + max_new]
+    return _ORACLE_MEMO[key]
 
 
 def _drain(engine_or_server, reqs=None, now=0.0):

@@ -1,0 +1,358 @@
+"""Disaggregated prefill/decode fleets + tp-sharded serve programs
+(ISSUE 16) — the serving-scale pins.
+
+The binding contracts:
+
+* **Disaggregation is invisible in the tokens** — a prefill fleet
+  feeding a decode fleet by KV-page shipping (serve/handoff.py) emits
+  token streams BITWISE equal to the aggregated fleet on the same
+  workload: streams are pure functions of (params, prompt), and a page
+  export/import moves bytes verbatim.
+* **int8 pages ship at exactly f32/4 payload bytes** — the PR 13
+  quantized pool crosses the handoff wire at its in-pool width; the f32
+  scale sidecar is accounted separately (the EQuARX-style halving
+  argument applied to inter-fleet traffic).
+* **Chaos composes with disaggregation** — a prefill-replica kill
+  mid-handoff loses nothing (displaced requests re-prefill on
+  survivors, pages regenerate byte-identically), and a decode-replica
+  kill re-routes through the PREFILL fleet where re-prefill re-quantizes
+  the shipped pages bitwise (the stochastic-rounding position-keying
+  argument, now crossing engines).
+* **tp widens a replica without touching its tokens** — ServeConfig.tp
+  shards every serve program over the mesh ``model`` axis (sliced
+  qkv/mlp + psum, the Megatron split the train side already uses);
+  tp=2 streams pin bitwise against tp=1, and tp=1 keeps the exact
+  single-chip programs (``_page_axis == 0``, no mesh).
+
+Engine tests ride the session ``serve_factory`` at the serve suites'
+dominant (page 4, max_len 16) shapes so only the tp=2 program set is a
+new compile (tier-1 budget); tool e2e runs are slow-marked per the
+servechaos precedent — every gate is also pinned tier-1 at engine
+level.
+"""
+
+import contextlib
+import io
+import json
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.disagg
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve.handoff import (DisaggregatedServer,  # noqa: E402
+                                        export_request)
+from ddlbench_tpu.serve.workload import (ServeRequest,  # noqa: E402
+                                         make_workload)
+
+VOCAB = TINY_LM.num_classes
+N_LAYERS = 2  # tiny_transformer depth (tiny_models.py)
+
+
+def _cfg(**kw):
+    # the test_serve_chaos/test_elastic shapes — the session
+    # serve_factory's compiled programs are shared, not paid again here
+    base = dict(max_batch=4, pool_pages=20, page=4, max_len=16,
+                prefill_chunk=4, replicas=2)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _workload(seed=3, n=12):
+    return make_workload(seed=seed, n_requests=n, vocab=VOCAB,
+                         arrival="closed", prompt_lo=2, prompt_typical=5,
+                         prompt_hi=9, out_lo=2, out_typical=4, out_hi=6,
+                         max_len=16)
+
+
+def _streams(server):
+    return {f["rid"]: f["tokens"] for f in server.finished}
+
+
+def _disagg(serve_factory, prefill=1, decode=1, **kw):
+    pre = serve_factory(_cfg(replicas=prefill, **kw), server=True)
+    dec = serve_factory(_cfg(replicas=decode, **kw), server=True)
+    return DisaggregatedServer(pre, dec)
+
+
+@pytest.fixture(scope="module")
+def agg_ctrl(serve_factory):
+    """ONE aggregated (non-disaggregated) control run per pool dtype,
+    shared by every bitwise pin here (tier-1 budget). Streams are pure
+    functions of (params, prompt) — replica count and fleet layout are
+    invisible in them — so one control serves every layout under test."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    out = {}
+    for dt in ("float32", "int8"):
+        srv = serve_factory(_cfg(kv_dtype=dt), server=True)
+        run_closed_loop(srv, _workload(), 6)
+        out[dt] = _streams(srv)
+        assert set(out[dt]) == set(range(12))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated streams pin bitwise vs the aggregated fleet.
+# ---------------------------------------------------------------------------
+
+
+def test_disagg_streams_bitwise_vs_aggregated(serve_factory, agg_ctrl):
+    """The tentpole acceptance pin: the 1:1 disaggregated layout emits
+    the aggregated fleet's token streams bitwise, every request ships
+    exactly once, and the handoff leaves no page behind on the prefill
+    side."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory)
+    run_closed_loop(dis, _workload(), 6)
+    ds = _streams(dis)
+    assert set(ds) == set(range(12))  # requests_lost == 0
+    for rid, toks in agg_ctrl["float32"].items():
+        assert ds[rid] == toks, f"stream diverged for rid {rid}"
+    # exactly-once finished records, all on the decode fleet (a request
+    # always takes its first decode pass post-ship)
+    rids = [f["rid"] for f in dis.finished]
+    assert len(rids) == len(set(rids)) == 12
+    assert dis.prefill.finished == []
+    s = dis.stats_summary()
+    assert s["shipped_requests"] == 12
+    assert s["shipped_pages"] > 0 and s["shipped_payload_bytes"] > 0
+    assert s["shipped_sidecar_bytes"] == 0  # f32 pool: no scale sidecar
+    # nothing parked, nothing leaked: every prefill-side page was freed
+    # at export
+    assert dis.snapshot()["pending_ships"] == 0
+    for eng in dis.prefill.engines:
+        assert eng.allocator.in_use == 0
+
+
+def test_disagg_int8_ships_quarter_payload(serve_factory, agg_ctrl):
+    """The wire-byte invariant: int8 pages cross the handoff at EXACTLY
+    f32/4 payload bytes for the same workload, the f32 scale sidecar is
+    accounted separately (page * 4 B * k/v * layers per shipped page),
+    and the quantized streams still pin bitwise vs the int8 aggregated
+    fleet — imported bytes are the exported bytes, verbatim."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    runs = {}
+    for dt in ("float32", "int8"):
+        dis = _disagg(serve_factory, kv_dtype=dt)
+        run_closed_loop(dis, _workload(), 6)
+        runs[dt] = dis
+        ds = _streams(dis)
+        for rid, toks in agg_ctrl[dt].items():
+            assert ds[rid] == toks, (dt, rid)
+    f32, i8 = runs["float32"].shipped, runs["int8"].shipped
+    assert f32["shipped_requests"] == i8["shipped_requests"] == 12
+    assert f32["shipped_pages"] == i8["shipped_pages"]
+    # the acceptance ratio, exact — not approximate
+    assert i8["shipped_payload_bytes"] * 4 == f32["shipped_payload_bytes"]
+    assert f32["shipped_sidecar_bytes"] == 0
+    cfg = _cfg()
+    assert i8["shipped_sidecar_bytes"] == \
+        i8["shipped_pages"] * cfg.page * 4 * 2 * N_LAYERS
+
+
+def test_export_import_roundtrip_single_request(serve_factory):
+    """The transfer primitive in isolation: extract a mid-stream request
+    from one engine, import it into another, finish it there — the
+    stitched stream equals the single-engine control token-for-token,
+    the export frees every prefill-side page, and the ship carries the
+    byte accounting export_request stamps."""
+    rng = np.random.default_rng(7)
+    prompt = rng.integers(0, VOCAB, size=(6,)).astype(np.int32)
+
+    def req():
+        return ServeRequest(rid=0, prompt=prompt.copy(), max_new=6,
+                            arrival=0.0)
+
+    # control: one engine end to end
+    ctrl = serve_factory(_cfg(replicas=1))
+    ctrl.submit(req())
+    now = 0.0
+    while ctrl.has_work():
+        now += ctrl.step(now).cost
+    want = ctrl.finished[0]["tokens"]
+
+    # split run: prefill on A, extract at first decode state, decode on B
+    a = serve_factory(_cfg(replicas=1))
+    b = serve_factory(_cfg(replicas=1))
+    a.submit(req())
+    now = 0.0
+    while not any(x.state == "decode" for x in a._active()):
+        assert a.has_work(), "request finished before it reached decode"
+        now += a.step(now).cost
+    ship = export_request(a, 0)
+    assert ship["payload_bytes"] > 0 and ship["sidecar_bytes"] == 0
+    assert ship["n_pages"] > 0
+    # one row-dict per serving layer with a pool (None elsewhere)
+    assert sum(r is not None for r in ship["pages"]) == N_LAYERS
+    assert a.allocator.in_use == 0 and not a.has_work()
+    assert b.import_request(ship, now)
+    while b.has_work():
+        now += b.step(now).cost
+    assert b.finished[0]["tokens"] == want
+    assert b.allocator.in_use == 0
+
+
+# ---------------------------------------------------------------------------
+# Chaos composes with disaggregation (satellites 2 + 3).
+# ---------------------------------------------------------------------------
+
+
+def test_prefill_kill_mid_handoff_bitwise(serve_factory, agg_ctrl):
+    """Satellite 2: kill a prefill replica while it holds live prefill
+    work — displaced requests resubmit onto the surviving prefill
+    replica, re-prefill from scratch, and every stream still pins
+    bitwise with ``requests_lost == 0``."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory, prefill=2, decode=1)
+    run_closed_loop(dis, _workload(), 6,
+                    events=[(1.0, lambda s, clock:
+                             s.fail_prefill(0, now=clock))])
+    assert len(dis.fail_events) == 1
+    ev = dis.fail_events[0]
+    assert ev["fleet"] == "prefill"
+    # the kill struck live work — otherwise this pins nothing
+    assert ev["displaced_inflight"] or ev["displaced_queued"], ev
+    ds = _streams(dis)
+    assert set(ds) == set(range(12))  # requests_lost == 0
+    for rid, toks in agg_ctrl["float32"].items():
+        assert ds[rid] == toks, f"stream diverged for rid {rid}"
+    rids = [f["rid"] for f in dis.finished]
+    assert len(rids) == len(set(rids)) == 12
+    assert len(dis.prefill.engines) == 1
+
+
+def test_decode_kill_reships_quantized_pages_bitwise(serve_factory,
+                                                     agg_ctrl):
+    """Satellite 3 (the PR 15 regression pin, crossing engines): kill a
+    decode replica AFTER handoff — its imported pages die with it, so
+    displaced requests re-route through the prefill fleet, re-prefill
+    re-quantizes their int8 pages byte-identically (position-keyed
+    stochastic rounding), and the handoff re-ships them. Streams pin
+    bitwise vs the int8 aggregated fleet and the ship counter shows the
+    second trip."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory, prefill=1, decode=2, kv_dtype="int8")
+    run_closed_loop(dis, _workload(), 6,
+                    events=[(8.0, lambda s, clock:
+                             s.fail_decode(1, now=clock))])
+    assert len(dis.fail_events) == 1
+    ev = dis.fail_events[0]
+    assert ev["fleet"] == "decode"
+    assert ev["displaced_inflight"], ev  # it held shipped requests
+    ds = _streams(dis)
+    assert set(ds) == set(range(12))  # requests_lost == 0
+    for rid, toks in agg_ctrl["int8"].items():
+        assert ds[rid] == toks, f"stream diverged for rid {rid}"
+    rids = [f["rid"] for f in dis.finished]
+    assert len(rids) == len(set(rids)) == 12
+    # displaced requests crossed the wire twice
+    assert dis.shipped["shipped_requests"] >= 12 + len(
+        ev["displaced_inflight"])
+    assert len(dis.decode.engines) == 1
+
+
+# ---------------------------------------------------------------------------
+# tp-sharded serve programs (ServeConfig.tp).
+# ---------------------------------------------------------------------------
+
+
+def test_tp1_keeps_single_chip_programs():
+    """tp=1 must stay bitwise-identical to today's programs — pinned
+    structurally: the default config is tp=1 and a tp=1 engine keeps the
+    single-chip pool layout (no leading shard axis, no mesh), so it IS
+    today's program set, not a 1-wide shard_map around it."""
+    assert ServeConfig().tp == 1
+    with pytest.raises(ValueError):
+        ServeConfig(tp=0).validate()
+
+
+def test_tp2_streams_bitwise_vs_tp1(serve_factory, agg_ctrl):
+    """The tp acceptance pin: a tp=2 replica — sliced qkv/mlp shards
+    plus psum, one shared page table — emits the tp=1 fleet's streams
+    bitwise on the same workload."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    tp1 = serve_factory(_cfg(replicas=1))
+    assert tp1._page_axis == 0  # today's layout, untouched
+    srv = serve_factory(_cfg(replicas=1, tp=2), server=True)
+    eng = srv.engines[0]
+    assert eng._page_axis == 1  # pools carry the [tp] shard axis
+    run_closed_loop(srv, _workload(), 6)
+    ds = _streams(srv)
+    assert set(ds) == set(range(12))
+    for rid, toks in agg_ctrl["float32"].items():
+        assert ds[rid] == toks, f"stream diverged for rid {rid}"
+
+
+# ---------------------------------------------------------------------------
+# Tool e2e (slow-marked per the servechaos precedent: every gate above
+# is tier-1 at engine level; these compile their own program sets).
+# ---------------------------------------------------------------------------
+
+_E2E_ARGS = ["-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+             "--concurrency", "4", "--requests", "10", "--max-batch", "2",
+             "--pool-pages", "12", "--page", "4", "--max-len", "16",
+             "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+             "--seed", "5", "--platform", "cpu"]
+
+
+def _run_tool(mod_name, extra):
+    import importlib
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+
+    tool = importlib.import_module(f"ddlbench_tpu.tools.{mod_name}")
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    buf = io.StringIO()
+    with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+            contextlib.redirect_stdout(buf):
+        rc = tool.main(_E2E_ARGS + list(extra))
+    assert rc == 0
+    return [json.loads(l) for l in buf.getvalue().splitlines()
+            if l.startswith("{")]
+
+
+@pytest.mark.slow
+def test_servebench_disaggregate_e2e_row():
+    """--disaggregate 1:1: the row carries the flag-gated shipping
+    fields; the plain continuous row stays byte-identical in schema
+    (the _CHAOS_FIELDS pattern — no new keys leak without the flag)."""
+    extra = ["--slo-ttft", "8", "--slo-itl", "2.5",
+             "--policies", "continuous"]
+    plain = _run_tool("servebench", extra)[0]
+    dis = _run_tool("servebench", extra + ["--disaggregate", "1:1"])[0]
+    for k in ("shipped_requests", "shipped_pages", "shipped_payload_bytes",
+              "shipped_sidecar_bytes", "disaggregate", "prefill_replicas",
+              "decode_replicas"):
+        assert k in dis and k not in plain, k
+    assert dis["disaggregate"] == "1:1"
+    assert dis["shipped_requests"] == dis["completed"] == plain["completed"]
+    tp = _run_tool("servebench", extra + ["--serve-tp", "2"])[0]
+    assert tp["serve_tp"] == 2 and "serve_tp" not in plain
+    assert tp["completed"] == plain["completed"]
+
+
+@pytest.mark.slow
+def test_servechaos_disaggregate_e2e_prefill_kill():
+    """The tool-level satellite-2 gate: --disaggregate 2:2 with a
+    prefill-replica kill completes everything, streams bitwise vs the
+    unfaulted disaggregated control, requests_lost == 0."""
+    rec = _run_tool("servechaos",
+                    ["--disaggregate", "2:2", "--kill", "2:p0"])[0]
+    assert rec["requests_lost"] == 0
+    assert rec["streams_match"] is True
+    assert rec["streams_compared"] == rec["completed"] == 10
+    assert rec["kills_fired"] == 1
+    assert rec["fail_events"][0]["fleet"] == "prefill"
+    assert rec["prefill_replicas"] == rec["decode_replicas"] == 2
+    assert rec["shipped_requests"] >= 10

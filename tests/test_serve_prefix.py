@@ -44,16 +44,27 @@ def lm(serve_factory):
     return serve_factory.model, serve_factory.params, serve_factory.state
 
 
+_ORACLE_T = 16  # canonical decode horizon (== the suite's max_len)
+_ORACLE_MEMO = {}
+
+
 def _standalone_stream(lm, prompt, max_new):
+    # canonical-horizon + memoized oracle (see test_serve.py's twin):
+    # greedy is prefix-stable, so decoding to one shared total_len per
+    # prompt length reuses ONE compiled cache shape + decode loop
     import jax.numpy as jnp
 
     import ddlbench_tpu.models.decode as dec
 
     model, params, state = lm
-    total = prompt.shape[0] + max_new
-    out = dec.greedy_decode(model, params, state,
-                            jnp.asarray(prompt)[None], total)
-    return np.asarray(out)[0, prompt.shape[0]:]
+    S = prompt.shape[0]
+    key = (prompt.tobytes(), S, max_new)
+    if key not in _ORACLE_MEMO:
+        total = max(S + max_new, min(_ORACLE_T, model.in_shape[0]))
+        out = dec.greedy_decode(model, params, state,
+                                jnp.asarray(prompt)[None], total)
+        _ORACLE_MEMO[key] = np.asarray(out)[0, S:S + max_new]
+    return _ORACLE_MEMO[key]
 
 
 def _drain(engine, reqs=None, now=0.0):
