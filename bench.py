@@ -141,6 +141,11 @@ def main() -> int:
                         "0 = synchronous batch generation on the timed path")
     p.add_argument("--quick", action="store_true", help="tiny run for smoke testing")
     p.add_argument("--probe-timeout-s", type=float, default=180.0)
+    p.add_argument("--audit", default=None, metavar="PATH",
+                   help="write the compiled step's audit manifest here "
+                        "(telemetry/audit.py: flops / HBM components / "
+                        "collective ledger + comm_stats tie-out) — reuses "
+                        "the timed executable, zero extra compiles")
     args = p.parse_args()
 
     if args.quick:
@@ -182,7 +187,8 @@ def main() -> int:
 
     from ddlbench_tpu.config import RunConfig
     from ddlbench_tpu.data.synthetic import make_synthetic
-    from ddlbench_tpu.distributed import (backend_provenance,
+    from ddlbench_tpu.distributed import (RECORD_SCHEMA_VERSION,
+                                          backend_provenance,
                                           enable_compilation_cache,
                                           warn_cpu_fallback)
     from ddlbench_tpu.parallel.api import make_strategy
@@ -276,6 +282,7 @@ def main() -> int:
         "platform": platform_note or jax.devices()[0].platform,
         **{k: v for k, v in backend_provenance(env_platform).items()
            if k in ("jax_backend", "jax_device_count", "cpu_fallback")},
+        "schema_version": RECORD_SCHEMA_VERSION,
     }
     if not platform_note:  # probe fallback already warned with its reason
         warn_cpu_fallback(record, "bench")
@@ -304,6 +311,23 @@ def main() -> int:
                 byts / step_s / cfg.hardware.hbm_bandwidth, 4)
     except Exception:
         pass
+    if args.audit:
+        # full audit manifest from the SAME executable the loop timed —
+        # the collective ledger and comm_stats tie-out ride the run free
+        from ddlbench_tpu.telemetry.audit import (program_manifest,
+                                                  reconcile_train,
+                                                  write_manifests)
+
+        man = program_manifest(
+            step_fn, f"bench/{args.framework}/{args.arch}@{n_chips}",
+            mesh=getattr(strategy, "mesh", None))
+        man["reconcile"] = reconcile_train(strategy, man)
+        write_manifests(args.audit, [man],
+                        header={"tool": "bench",
+                                "schema_version": RECORD_SCHEMA_VERSION,
+                                "platform": record["platform"]})
+        record["audit"] = args.audit
+        record["audit_tie_ok"] = man["reconcile"].get("ok")
     print(json.dumps(record))
     return 0
 

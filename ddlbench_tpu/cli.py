@@ -194,6 +194,12 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--trace-capacity", type=int, default=200_000,
                    help="span ring-buffer bound; the newest events win "
                         "when a run outlives it")
+    p.add_argument("--audit", default=None, metavar="PATH",
+                   help="write the compiled train step's audit manifest "
+                        "here (telemetry/audit.py: flops, HBM components, "
+                        "per-collective ledger from the optimized HLO, "
+                        "comm_stats wire-byte tie-out) — AOT introspection "
+                        "only, the run itself is untouched")
     p.add_argument("--checkpoint-dir", default=None,
                    help="save a checkpoint per epoch here (orbax, atomic "
                         "commit protocol)")
@@ -349,6 +355,7 @@ def config_from_args(args) -> RunConfig:
                   if args.hbm_gb is not None else HardwareModel()),
         trace=args.trace,
         trace_capacity=args.trace_capacity,
+        audit=args.audit,
         trace_dir=args.trace_dir,
         xla_trace_steps=_parse_step_window(args.xla_trace_steps),
         activation_log_dir=args.log_activations_dir,

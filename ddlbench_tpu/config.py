@@ -446,6 +446,12 @@ class RunConfig:
     # (epoch boundaries do not reset the counter; warmup is excluded).
     trace_dir: Optional[str] = None
     xla_trace_steps: Optional[Tuple[int, int]] = None
+    # Compiled-program audit manifest (telemetry/audit.py): AOT-lower the
+    # train step once before the run, extract flops / HBM components / the
+    # per-collective ledger out of the optimized HLO, cross-check the
+    # comm_stats wire-byte formulas, and write the ledger JSON here. One
+    # extra trace of the already-compiled program shapes; never executes.
+    audit: Optional[str] = None
 
     # Activation/gradient deep-dive logging (torchlogger analog, SURVEY.md
     # §5.5; reference profiler main.py:543-582): every activation_log_freq

@@ -154,6 +154,23 @@ def warn_cpu_fallback(prov: dict, what: str) -> bool:
     return True
 
 
+# Version stamp for every JSON record the tools emit (bench/scalebench/
+# servebench/chaosbench/planbench rows and audit manifests). Bump when a
+# record's field set changes incompatibly so downstream diff tooling
+# (tools/auditbench.py, perf_runs consumers) can refuse mixed ledgers.
+RECORD_SCHEMA_VERSION = 1
+
+
+def record_provenance(platform_arg: Optional[str] = None,
+                      what: str = "measurement") -> dict:
+    """The one shared record header: ``schema_version`` + the
+    :func:`backend_provenance` fields, with the cpu-fallback warning fired
+    here so no tool can forget it. Merge into every emitted JSON row."""
+    prov = backend_provenance(platform_arg)
+    warn_cpu_fallback(prov, what)
+    return {"schema_version": RECORD_SCHEMA_VERSION, **prov}
+
+
 def apply_platform(platform) -> None:
     """Apply a --platform override before the first backend touch. Safe on
     images whose sitecustomize imports jax early: jax.config works until a

@@ -92,6 +92,11 @@ class Candidate:
     peak_bytes_per_chip: float
     feasible: bool
     reason: str = ""  # why infeasible / pricing notes
+    # per-stage predicted resident bytes/chip (peak is their max) — the
+    # audit plane (telemetry/audit.py planner_stage_hbm_audit) prices the
+    # HBM model's signed per-stage error against memory_analysis() with
+    # these, recorded under plan_auto["hbm_audit"] in partition.json
+    stage_mem: Optional[Tuple[float, ...]] = None
 
     def mix(self) -> str:
         return f"pp={self.pp} dp={self.dp} tp={self.tp} @{self.schedule}"
@@ -106,6 +111,8 @@ class Candidate:
             "peak_bytes_per_chip": round(self.peak_bytes_per_chip, 1),
             "feasible": self.feasible,
             "reason": self.reason,
+            "stage_mem": ([round(m, 1) for m in self.stage_mem]
+                          if self.stage_mem else None),
         }
 
 
@@ -383,9 +390,11 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
         sync = max(_ring_ms(span_p(bounds[s], bounds[s + 1]) / tp, dp,
                             hw.ici_bandwidth)
                    for s in range(pp))
-        peak = max(stage_mem(bounds[s], bounds[s + 1]) for s in range(pp))
+        mems = tuple(stage_mem(bounds[s], bounds[s + 1])
+                     for s in range(pp))
         candidates.append(Candidate(
-            pp, dp, tp, schedule, tuple(bounds), pipe + sync, peak, True))
+            pp, dp, tp, schedule, tuple(bounds), pipe + sync, max(mems),
+            True, stage_mem=mems))
 
     pps = [d for d in range(1, world + 1) if world % d == 0]
     if pin_pp is not None:

@@ -402,6 +402,37 @@ class ServeEngine:
         return (self._decode_jit, self._prefill_jit, self._cow_jit,
                 self._verify_jit)
 
+    def audit_programs(self):
+        """``(name, jitfn, example_args)`` for the audit plane
+        (telemetry/audit.py): representative zero-token instantiations of
+        the serve programs at ``npl=1``, the same shapes the scheduler
+        calls with. AOT lowering never executes, so the donated pool
+        arguments are safe to keep using afterwards."""
+        import jax.numpy as jnp
+
+        cfg = self.cfg
+        B, G = cfg.max_batch, self.npg_max
+        table = jnp.zeros((B, G), jnp.int32)
+        toks = jnp.zeros((B, 1), jnp.int32)
+        pos = jnp.zeros((B,), jnp.int32)
+        chunk = jnp.zeros((1, cfg.resolved_prefill_chunk()), jnp.int32)
+        progs = [
+            ("decode", self._decode_jit,
+             (self.params, self.state, self.pools, table, toks, pos, 1)),
+            ("prefill", self._prefill_jit,
+             (self.params, self.state, self.pools, table[:1], chunk,
+              jnp.int32(0), jnp.int32(0), 1)),
+            ("cow", self._cow_jit,
+             (self.pools, jnp.int32(0), jnp.int32(1))),
+        ]
+        if self._spec is not None:
+            W = self._spec[1] + 1
+            progs.append(
+                ("verify", self._verify_jit,
+                 (self.params, self.state, self.pools, table,
+                  jnp.zeros((B, W), jnp.int32), pos, 1)))
+        return progs
+
     # -- request-lifecycle tracing (virtual-time, metrics-neutral) ---------
 
     def _tr(self):

@@ -22,6 +22,11 @@ This package is the decomposition layer the ROADMAP north star needs:
   reduce to exact TTFT queue/prefill/decode/sched-gap decompositions,
   ITL decode/preempted splits, and the windowed SLO-attainment + goodput
   time series ROADMAP item 2c's autoscaler consumes.
+* :mod:`telemetry.audit` — the compiled-program audit plane: per-program
+  manifests (flops / HBM components / the per-collective ledger walked
+  out of the optimized HLO) cross-checked EXACTLY against the analytic
+  models (``comm_stats`` wire bytes, the planner's HBM model,
+  ``pool_page_bytes``), plus the ``auditbench diff`` regression gate.
 
 Host spans align with device traces through
 ``jax.profiler.StepTraceAnnotation`` wrapping in ``train/loop.py`` and the
@@ -42,6 +47,16 @@ from ddlbench_tpu.telemetry.export import (  # noqa: F401
     export_chrome_trace,
     trace_truncation,
     warn_if_truncated,
+)
+from ddlbench_tpu.telemetry.audit import (  # noqa: F401
+    AUDIT_SCHEMA_VERSION,
+    CollectiveOp,
+    collective_ledger,
+    diff_manifests,
+    lower_manifest,
+    program_manifest,
+    reconcile_train,
+    serve_pool_audit,
 )
 from ddlbench_tpu.telemetry.overlap import overlap_fraction  # noqa: F401
 from ddlbench_tpu.telemetry.bubble import bubble_fraction  # noqa: F401

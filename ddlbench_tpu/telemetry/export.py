@@ -37,6 +37,24 @@ from ddlbench_tpu.telemetry.tracer import Tracer
 _PID = 1  # single host process; one pid keeps Perfetto's track grouping flat
 
 
+def _runtime_metadata() -> Dict[str, Any]:
+    """jax/jaxlib versions + backend + attached-device count, best-effort
+    (the exporter must keep working where jax is absent or not yet
+    initialized — e.g. pure-host serve traces in stripped test envs)."""
+    out: Dict[str, Any] = {}
+    try:
+        import jax
+        import jaxlib
+
+        out["jax_version"] = jax.__version__
+        out["jaxlib_version"] = jaxlib.__version__
+        out["backend"] = jax.default_backend()
+        out["device_count"] = jax.device_count()
+    except Exception:  # pragma: no cover - stripped environments
+        pass
+    return out
+
+
 def chrome_trace_dict(tracer: Tracer,
                       extra_metadata: Optional[Dict[str, Any]] = None,
                       ) -> Dict[str, Any]:
@@ -70,6 +88,9 @@ def chrome_trace_dict(tracer: Tracer,
         "producer": "ddlbench_tpu.telemetry",
         "dropped_events": tracer.dropped_events,
         "capacity": tracer.capacity,
+        # runtime provenance: traces and audit manifests (telemetry/
+        # audit.py ledgers stamp the same fields) are joinable by run
+        **_runtime_metadata(),
     }
     if extra_metadata:
         metadata.update(extra_metadata)

@@ -392,7 +392,10 @@ def _verify_maps(baseline: Tuple[Dict, Dict], chaos: Tuple[Dict, Dict]
 
 
 def run_chaos(args) -> Dict[str, Any]:
-    workdir = args.workdir or os.path.join("chaosbench_runs", str(os.getpid()))
+    # absolute: orbax rejects relative checkpoint paths at RESTORE time,
+    # which otherwise burns the whole restart budget on the default workdir
+    workdir = os.path.abspath(
+        args.workdir or os.path.join("chaosbench_runs", str(os.getpid())))
     os.makedirs(workdir, exist_ok=True)
     ckpt_dir = os.path.join(workdir, "ckpt")
     reshapes = parse_reshapes(getattr(args, "reshape", []))
@@ -429,7 +432,15 @@ def run_chaos(args) -> Dict[str, Any]:
     budget = (args.restart_budget if args.restart_budget is not None
               else len(schedule) + 3)
 
+    # actual backend record (shared classification + loud cpu-fallback
+    # warning — distributed.record_provenance); the children run the
+    # compute but on the same machine, so the supervisor's backend is
+    # the fleet's backend
+    from ddlbench_tpu.distributed import record_provenance
+
+    prov = record_provenance(args.platform, "chaosbench")
     report: Dict[str, Any] = {
+        **prov,
         "metric": "chaosbench_recovery",
         "benchmark": args.benchmark, "arch": args.model,
         "framework": args.framework,

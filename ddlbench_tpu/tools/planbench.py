@@ -35,7 +35,8 @@ import sys
 DEFAULT_PAIRS = "lenet:mnist,resnet18:cifar10,transformer_s:synthtext"
 
 
-def bench_pair(arch: str, benchmark: str, world: int, args) -> dict:
+def bench_pair(arch: str, benchmark: str, world: int, args,
+               audit_manifests=None) -> dict:
     """One (model, world) row: solve, execute, compare."""
     import jax
     import jax.numpy as jnp
@@ -82,6 +83,34 @@ def bench_pair(arch: str, benchmark: str, world: int, args) -> dict:
     row["measured_ms"] = round(measured, 4)
     row["err_frac"] = round((measured - w.step_time_ms) / measured, 4) \
         if measured > 0 else None
+    if audit_manifests is not None:
+        # compiled-program audit for the winner: manifest + comm_stats
+        # tie-out, plus the planner's per-stage HBM-model signed error vs
+        # memory_analysis() (recorded into partition.json when the run
+        # has a persisted plan — here it rides the row)
+        from ddlbench_tpu.telemetry.audit import (planner_stage_hbm_audit,
+                                                  lower_manifest,
+                                                  reconcile_train,
+                                                  record_hbm_audit)
+
+        x0, y0 = data.batch(0, 0)
+        # some engines wrap their jit in a telemetry-span function; lower
+        # the underlying executable either way (bench.py idiom)
+        jit_step = getattr(strategy, "_jit_train_step", None) \
+            or strategy.train_step
+        man = lower_manifest(
+            jit_step, (ts, *strategy.shard_batch(x0, y0), lr),
+            f"plan/{arch}:{benchmark}@{world}",
+            mesh=getattr(strategy, "mesh", None))
+        man["reconcile"] = reconcile_train(strategy, man)
+        hbm = planner_stage_hbm_audit(w.as_record(), man, world)
+        man["hbm_audit"] = hbm
+        audit_manifests.append(man)
+        if hbm is not None:
+            row["hbm_err_frac_per_stage"] = [
+                round(s["err_frac"], 4) if s["err_frac"] is not None
+                else None for s in hbm["stages"]]
+            record_hbm_audit(cfg, hbm)
     return row
 
 
@@ -104,6 +133,11 @@ def main(argv=None) -> int:
                         "rather than the TPU constants; flops is the "
                         "deterministic device-free mode")
     p.add_argument("--dtype", default="float32")
+    p.add_argument("--audit", default=None, metavar="PATH",
+                   help="also emit the winner's compiled-program audit "
+                        "manifest per point (telemetry/audit.py) — "
+                        "includes the planner's per-stage HBM error vs "
+                        "memory_analysis() — into one ledger JSON")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -112,15 +146,15 @@ def main(argv=None) -> int:
 
     import jax
 
-    from ddlbench_tpu.distributed import backend_provenance, warn_cpu_fallback
+    from ddlbench_tpu.distributed import record_provenance
 
-    prov = backend_provenance(args.platform)
+    prov = record_provenance(args.platform, "planbench")
     print(json.dumps({"provenance": {**prov,
                                      "platform_arg": args.platform}}),
           flush=True)
-    warn_cpu_fallback(prov, "planbench")
     avail = len(jax.devices())
     rows = []
+    audit_manifests = [] if args.audit else None
     for pair in args.pairs.split(","):
         arch, benchmark = pair.strip().split(":")
         for world in (int(v) for v in args.worlds.split(",")):
@@ -130,12 +164,23 @@ def main(argv=None) -> int:
                                   f"attached"}), flush=True)
                 continue
             try:
-                row = bench_pair(arch, benchmark, world, args)
+                row = bench_pair(arch, benchmark, world, args,
+                                 audit_manifests)
             except ValueError as e:  # e.g. branchy arch, no feasible mix
                 row = {"arch": arch, "benchmark": benchmark,
                        "world": world, "error": str(e)}
+            row = {**row, "schema_version": prov["schema_version"],
+                   "jax_backend": prov["jax_backend"],
+                   "cpu_fallback": prov["cpu_fallback"]}
             print(json.dumps(row), flush=True)
             rows.append(row)
+    if args.audit:
+        from ddlbench_tpu.telemetry.audit import write_manifests
+
+        write_manifests(args.audit, audit_manifests,
+                        header={**prov, "tool": "planbench"})
+        print(json.dumps({"audit": args.audit,
+                          "programs": len(audit_manifests)}), flush=True)
     good = [r for r in rows if "err_frac" in r and r["err_frac"] is not None]
     if good:
         errs = sorted(abs(r["err_frac"]) for r in good)

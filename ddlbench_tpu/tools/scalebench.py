@@ -128,6 +128,12 @@ def main(argv=None) -> int:
                         "--dp-shard-update, gpipe points run the hybrid "
                         "PP x ZeRO-1 engine — opt_state_bytes_per_chip in "
                         "the JSON is where the memory win shows up")
+    p.add_argument("--audit", default=None, metavar="PATH",
+                   help="also emit the compiled-program audit manifest per "
+                        "point (telemetry/audit.py: flops/HBM/per-"
+                        "collective ledger + comm_stats tie-outs) into one "
+                        "ledger JSON — the tools/auditbench.py diff "
+                        "substrate")
     from ddlbench_tpu.distributed import (add_platform_arg, apply_comm_flags,
                                           apply_platform)
 
@@ -144,15 +150,15 @@ def main(argv=None) -> int:
 
     enable_compilation_cache()
     # Backend provenance header: one JSON line recording what jax ACTUALLY
-    # selected (shared classification — distributed.backend_provenance),
-    # so every scalebench artifact self-identifies and a cpu backend
-    # nobody asked for warns loudly on stderr.
-    from ddlbench_tpu.distributed import backend_provenance, warn_cpu_fallback
+    # selected (shared helper — distributed.record_provenance: adds
+    # schema_version and fires the cpu-fallback warning), so every
+    # scalebench artifact self-identifies.
+    from ddlbench_tpu.distributed import record_provenance
 
-    prov = backend_provenance(args.platform)
+    prov = record_provenance(args.platform, "scalebench")
     print(json.dumps({"provenance": {**prov, "platform_arg": args.platform}}),
           flush=True)
-    warn_cpu_fallback(prov, "scalebench")
+    audit_manifests = []
     avail = len(jax.devices())
     if args.devices:
         counts = [int(c) for c in args.devices.split(",")]
@@ -170,6 +176,7 @@ def main(argv=None) -> int:
     anchor, anchor_opt = _run_point(anchor_cfg, args.steps, args.warmup,
                                     args.repeats)
     print(json.dumps({"strategy": "single", "devices": 1,
+                      "schema_version": prov["schema_version"],
                       "samples_per_sec": round(anchor, 2),
                       "per_chip": round(anchor, 2), "efficiency": 1.0,
                       "opt_state_bytes_per_chip": anchor_opt}),
@@ -244,17 +251,34 @@ def main(argv=None) -> int:
                         point["bubble_analytic_is_lower_bound"] = True
                 ips, opt_bytes = _run_point(cfg, args.steps, args.warmup,
                                             args.repeats)
+                if args.audit:
+                    from ddlbench_tpu.telemetry.audit import \
+                        audit_train_config
+
+                    man, _ = audit_train_config(
+                        cfg, name=f"scale/{strat}@{n}")
+                    audit_manifests.append(man)
+                    point["audit_tie_ok"] = man["reconcile"].get("ok")
+                    point["audit_tieable"] = man["reconcile"]["tieable"]
             except Exception as e:  # point failures shouldn't kill the sweep
                 print(json.dumps({**point, "error": str(e)[:200]}),
                       flush=True)
                 continue
             print(json.dumps({
                 **point,
+                "schema_version": prov["schema_version"],
                 "samples_per_sec": round(ips, 2),
                 "per_chip": round(ips / n, 2),
                 "efficiency": round(ips / n / anchor, 4),
                 "opt_state_bytes_per_chip": opt_bytes,
             }), flush=True)
+    if args.audit:
+        from ddlbench_tpu.telemetry.audit import write_manifests
+
+        write_manifests(args.audit, audit_manifests,
+                        header={**prov, "tool": "scalebench"})
+        print(json.dumps({"audit": args.audit,
+                          "programs": len(audit_manifests)}), flush=True)
     return 0
 
 

@@ -487,6 +487,11 @@ def main(argv=None) -> int:
     p.add_argument("--wall-clock", action="store_true",
                    help="also report real elapsed seconds (off by default "
                         "so the JSON stays bitwise-reproducible)")
+    p.add_argument("--audit", default=None, metavar="PATH",
+                   help="emit the serve programs' compiled audit manifests "
+                        "(telemetry/audit.py: flops / HBM / collective "
+                        "ledger + pool_page_bytes tie-out) into one ledger "
+                        "JSON next to the row")
     from ddlbench_tpu.distributed import add_platform_arg, apply_platform
 
     add_platform_arg(p)
@@ -499,13 +504,11 @@ def main(argv=None) -> int:
 
     import jax
 
-    from ddlbench_tpu.distributed import (backend_provenance,
-                                          enable_compilation_cache,
-                                          warn_cpu_fallback)
+    from ddlbench_tpu.distributed import (enable_compilation_cache,
+                                          record_provenance)
 
     enable_compilation_cache()
-    prov = backend_provenance(args.platform)
-    warn_cpu_fallback(prov, "servebench")
+    prov = record_provenance(args.platform, "servebench")
 
     from ddlbench_tpu.config import DATASETS, ServeConfig
     from ddlbench_tpu.models import init_model
@@ -635,6 +638,21 @@ def main(argv=None) -> int:
             server = make_server(model, params, state, cfg,
                                  shared_fns=shared_fns)
         shared_fns = server.engines[0].jit_fns()
+        if args.audit:
+            # compiled-program audit for this serve layout: every engine
+            # shares the compiled programs, so engine[0] speaks for the
+            # fleet (one ledger per run; policies share shapes)
+            from ddlbench_tpu.telemetry.audit import (audit_serve_engine,
+                                                      write_manifests)
+
+            mans, pool_audit = audit_serve_engine(
+                server.engines[0], prefix=f"serve/{args.model}")
+            write_manifests(args.audit, mans,
+                            header={**prov, "tool": "servebench"})
+            print(f"servebench: {len(mans)} audit manifests -> "
+                  f"{args.audit} (pool_ok={pool_audit['ok']})",
+                  file=sys.stderr, flush=True)
+            args.audit = None
         # one fresh bounded ring per policy row, installed process-global
         # (the engines look it up lazily) and restored afterwards —
         # recording never reorders the scheduler, so the run below is
@@ -692,6 +710,7 @@ def main(argv=None) -> int:
                     else f"{args.trace}.{policy}")
             n = export_chrome_trace(tracer, path, extra_metadata={
                 "serve": {"tool": "servebench", "policy": policy,
+                          "tp": cfg.tp, "replicas": cfg.replicas,
                           "slo_ttft": args.slo_ttft,
                           "slo_itl": args.slo_itl,
                           "time_unit": "model_pass",

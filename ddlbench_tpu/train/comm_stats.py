@@ -20,9 +20,22 @@ topology — same numbers, no instrumentation overhead:
   ring all-reduce: half the gradient wire bytes, same pattern.
 * gpipe: every microbatch crosses every interior stage boundary twice
   (activation forward, gradient backward) + one per-step gradient all-reduce
-  across each stage's 'data' replicas.
+  across each stage's 'data' replicas. The physical_* twins price what the
+  compiled scan actually ships: the conveyor ppermutes the full packed
+  activation buffer over every (stage, replica) link on every one of the
+  T = M*V + S - 1 ticks (forward + its autodiff transpose), and the
+  replicated gradient sync rings the PADDED packed stage rows.
 * pipedream: same boundary traffic, but the intra-stage replica all-reduce
   happens once per microbatch (per-microbatch updates).
+* tpp (TPGPipeStrategy): the Megatron activation psums inside every stage
+  are priced PER COLLECTIVE (``tp_psum_payload_bytes`` — the audit plane
+  ties every 'model'-axis all-reduce in the optimized HLO to one of the
+  analytic payload classes reported here), plus the conveyor boundary and
+  the packed-row gradient/state syncs over 'data' and 'data x model'.
+
+Every byte figure here is cross-checked against the per-collective ledger
+the audit plane (telemetry/audit.py) walks out of the compiled HLO — the
+exact tie-outs are pinned in tests/test_audit.py.
 """
 
 from __future__ import annotations
@@ -132,6 +145,47 @@ def comm_stats(strategy) -> Dict[str, float]:
             ring_ticks = 1
         out["physical_conveyor_bytes"] = conveyors * ticks * R * links * buf
         out["physical_allreduce_bytes"] = float(Rg * ring_ticks * n_ring) * Lmax
+    elif name == "TPGPipeStrategy":
+        # Megatron-in-stage pipeline (parallel/tpp.py). boundary/allreduce
+        # stay LOGICAL (reference RuntimeStats parity); every physical
+        # payload class the compiled program ships is priced separately so
+        # the audit plane can classify each HLO collective exactly:
+        #   * 'model'-axis activation psums: one [mb, seq, d_model] block
+        #     output per row-parallel projection (count is XLA's business —
+        #     CSE merges some — so we pin the PAYLOAD, not the count)
+        #   * 'data'-axis grad sync of the padded sliced rows (S*tp groups)
+        #   * 'data x model' grad sync of the padded replicated rows (S)
+        #   * state rows pmean'd over 'data' then 'model'
+        #   * the stage conveyor: 2 ppermutes x T ticks x (S-1)*dp*tp pairs
+        itemsize = strategy.compute_dtype.itemsize
+        M, mb = strategy.num_microbatches, strategy.mb
+        dp, tp, S = strategy.dp, strategy.tp, strategy.num_stages
+        bounds, shapes = strategy.bounds, strategy.shapes
+        boundary = 0.0
+        for s in range(1, S):
+            act = mb * math.prod(shapes[bounds[s]]) * itemsize
+            boundary += 2.0 * M * act
+        out["boundary_bytes"] = boundary * dp
+        out["allreduce_bytes"] = sum(
+            tp * _ring_allreduce_bytes(4.0 * strategy._sl_lens[c], dp)
+            + _ring_allreduce_bytes(4.0 * strategy._rp_lens[c], dp * tp)
+            for c in range(S))
+        L_sl = max(max(strategy._sl_lens), 1)
+        L_rp = max(max(strategy._rp_lens), 1)
+        L_st = max(max(strategy._st_lens), 1)
+        out["tp_psum_payload_bytes"] = (
+            float(mb) * math.prod(shapes[1]) * itemsize)
+        out["tp_grad_sliced_row_bytes"] = 4.0 * L_sl
+        out["tp_grad_repl_row_bytes"] = 4.0 * L_rp
+        out["tp_state_row_bytes"] = 4.0 * L_st
+        out["physical_allreduce_bytes"] = (
+            S * tp * _ring_allreduce_bytes(4.0 * L_sl, dp)
+            + S * _ring_allreduce_bytes(4.0 * L_rp, dp * tp)
+            + S * tp * _ring_allreduce_bytes(4.0 * L_st, dp)
+            + S * dp * _ring_allreduce_bytes(4.0 * L_st, tp))
+        T = M + S - 1
+        out["physical_boundary_bytes"] = (
+            2.0 * T * (S - 1) * dp * tp * strategy._act_size * itemsize)
     else:  # pipeline strategies (gpipe / pipedream)
         itemsize = strategy.compute_dtype.itemsize
         M, mb, dp = strategy.num_microbatches, strategy.mb, strategy.dp
@@ -142,6 +196,15 @@ def comm_stats(strategy) -> Dict[str, float]:
             act = mb * math.prod(shapes[bounds[s]]) * itemsize
             boundary += 2.0 * M * act  # activation fwd + gradient bwd
         out["boundary_bytes"] = boundary * dp  # per replica column
+        if name == "GPipeStrategy":
+            # physical conveyor: the compiled scan ppermutes the full
+            # packed activation buffer (fwd + the autodiff transpose) over
+            # every interior link of every replica column on every one of
+            # the T = M*V + S - 1 ticks
+            V = strategy.num_chunks // S
+            T = M * V + S - 1
+            out["physical_boundary_bytes"] = (
+                2.0 * T * (S - 1) * dp * strategy._act_size * itemsize)
         if dp > 1:
             grad_bytes = sum(
                 4.0 * strategy._p_lens[c]
@@ -167,6 +230,17 @@ def comm_stats(strategy) -> Dict[str, float]:
                 per_sync = _ring_allreduce_bytes(grad_bytes, dp)
                 syncs = M if name == "PipeDreamStrategy" else 1
                 out["allreduce_bytes"] = per_sync * syncs
+                if name == "GPipeStrategy":
+                    # physical grad/state sync: one ring per stage group
+                    # over the PADDED [V, Lmax] device rows
+                    V = strategy.num_chunks // S
+                    Lp = max(max(strategy._p_lens), 1)
+                    Ls = max(max(strategy._s_lens), 1)
+                    out["gp_grad_row_bytes"] = 4.0 * V * Lp
+                    out["gp_state_row_bytes"] = 4.0 * V * Ls
+                    out["physical_allreduce_bytes"] = S * (
+                        _ring_allreduce_bytes(4.0 * V * Lp, dp)
+                        + _ring_allreduce_bytes(4.0 * V * Ls, dp))
     out["total_bytes"] = (out["boundary_bytes"] + out["allreduce_bytes"]
                           + out["reduce_scatter_bytes"]
                           + out["all_gather_bytes"])

@@ -70,6 +70,58 @@ class _XlaWindow:
                   f"written to {self.trace_dir}", flush=True)
 
 
+def _write_audit(cfg: RunConfig, strategy) -> None:
+    """--audit PATH: AOT-lower the train step at the run's exact shapes,
+    extract the compiled-program manifest (flops, HBM components, the
+    per-collective ledger out of the optimized HLO), tie it to comm_stats,
+    and write the ledger next to the run's JSON. Lowering with a synthetic
+    shape-double never executes and never consumes the real data stream;
+    with `--plan auto` + a persisted plan the planner's per-stage HBM
+    error also lands in partition.json (plan_auto.hbm_audit)."""
+    from ddlbench_tpu.telemetry.audit import (lower_manifest,
+                                              planner_stage_hbm_audit,
+                                              reconcile_train,
+                                              record_hbm_audit,
+                                              write_manifests)
+    from ddlbench_tpu.distributed import record_provenance
+
+    prov = record_provenance(None, "train --audit")
+    probe = make_synthetic(cfg.dataset(), cfg.global_batch(),
+                           steps_per_epoch=1)
+    ts0 = strategy.init(jax.random.key(cfg.seed))
+    x0, y0 = probe.batch(0, 0)
+    jit_step = getattr(strategy, "_jit_train_step", None) \
+        or strategy.train_step
+    man = lower_manifest(
+        jit_step, (ts0, *strategy.shard_batch(x0, y0),
+                   jnp.float32(cfg.resolved_lr())),
+        name=f"train/{cfg.strategy}/{cfg.arch}@{cfg.num_devices}",
+        mesh=getattr(strategy, "mesh", None))
+    man["reconcile"] = reconcile_train(strategy, man)
+    if cfg.plan == "auto" and cfg.checkpoint_dir:
+        # the resolved plan is persisted; grade its HBM model against
+        # memory_analysis() and record the signed per-stage error there
+        import json as _json
+
+        from ddlbench_tpu.parallel.api import _plan_path
+
+        path = _plan_path(cfg)
+        if path and os.path.exists(path):
+            with open(path) as f:
+                winner = _json.load(f).get("plan_auto", {}).get("winner")
+            if winner:
+                hbm = planner_stage_hbm_audit(winner, man,
+                                              cfg.num_devices)
+                man["hbm_audit"] = hbm
+                if hbm is not None:
+                    record_hbm_audit(cfg, hbm)
+    write_manifests(cfg.audit, [man],
+                    header={**prov, "tool": "train"})
+    rec = man["reconcile"]
+    print(f"audit: manifest -> {cfg.audit} "
+          f"(tieable={rec['tieable']} ok={rec.get('ok')})", flush=True)
+
+
 def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] = None,
                   warmup_steps: int = 1) -> Dict[str, Any]:
     """Run the full 3-epoch benchmark protocol; returns the summary dict."""
@@ -134,6 +186,8 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
                   f"{global_ms:.2f} ms/global-batch "
                   f"({input_ms:.3f} ms/microbatch)", flush=True)
         strategy = make_strategy(cfg, input_time_ms=input_ms)
+    if cfg.audit:
+        _write_audit(cfg, strategy)
     logger = logger or MetricLogger(cfg.epochs, cfg.log_interval)
 
     # Step-level telemetry (ddlbench_tpu/telemetry/): a fresh bounded
@@ -242,7 +296,14 @@ def run_benchmark(cfg: RunConfig, strategy=None, logger: Optional[MetricLogger] 
             tracer.disable()
             set_tracer(prev_tracer)  # drop the ring; untraced runs follow
             try:
-                n = export_chrome_trace(tracer, cfg.trace)
+                # mesh shape + run identity: joins this trace to the
+                # run's audit manifest (same fields in the ledger header)
+                mesh = getattr(strategy, "mesh", None)
+                n = export_chrome_trace(tracer, cfg.trace, extra_metadata={
+                    "train": {"strategy": cfg.strategy, "arch": cfg.arch,
+                              "num_devices": cfg.num_devices,
+                              "mesh_shape": (dict(mesh.shape)
+                                             if mesh is not None else None)}})
             except OSError as e:  # never mask the run's own exception
                 print(f"telemetry: trace export to {cfg.trace} failed: {e}",
                       flush=True)

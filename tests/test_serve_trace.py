@@ -473,8 +473,9 @@ PLAIN_ROW_KEYS = {
     "prefix_tokens_saved", "cow_copies", "shared_pages", "prefill_tokens",
     "decode_calls", "decode_batch_util", "mean_page_fragmentation",
     "pool_bytes", "bytes_per_page",
-    # backend provenance
+    # backend provenance + record schema (distributed.record_provenance)
     "jax_backend", "jax_device_count", "cpu_requested", "cpu_fallback",
+    "schema_version",
 }
 TIMELINE_ROW_KEYS = PLAIN_ROW_KEYS | {
     "window", "timeline", "ttft_breakdown", "itl_breakdown",
