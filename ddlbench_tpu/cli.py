@@ -69,8 +69,26 @@ def build_parser() -> argparse.ArgumentParser:
                         "one-forward-one-backward, interleaved = 1F1B over "
                         "stages x --virtual-stages chunks, zero-bubble = "
                         "ZB-H1 split backward (weight-grad events fill the "
-                        "drain bubble). pipedream remains the ASYNC 1F1B "
-                        "engine (weight stashing)")
+                        "drain bubble; composes with --virtual-stages), "
+                        "zero-bubble-h2 = ZB-H2 (--zb-h2-stash extra "
+                        "in-flight microbatches + trailing W deferred past "
+                        "the step boundary; steady bubble -> 0), searched "
+                        "= budgeted local search seeded by both heuristics "
+                        "(partition/schedule_search.py; never worse than "
+                        "1f1b/zero-bubble at their activation memory). "
+                        "pipedream remains the ASYNC 1F1B engine (weight "
+                        "stashing)")
+    p.add_argument("--zb-h2-stash", type=int, default=1,
+                   help="zero-bubble-h2's extra in-flight activation stash "
+                        "(microbatches per chunk): more hides more warmup "
+                        "idle, costs that many extra stashed boundary "
+                        "activations in the planner's memory term")
+    p.add_argument("--sched-search-budget", type=int, default=256,
+                   help="searched-schedule move-evaluation budget; same "
+                        "budget + --sched-search-seed reproduce the table "
+                        "bitwise")
+    p.add_argument("--sched-search-seed", type=int, default=0,
+                   help="rng seed for the searched schedule's shift moves")
     p.add_argument("--pipe-costs", default="unit", choices=("unit", "profile"),
                    help="timetable cost model for the event schedules: "
                         "unit = F=B=W half-ticks (the classic grids); "
@@ -308,6 +326,9 @@ def config_from_args(args) -> RunConfig:
         num_stages=args.stages,
         virtual_stages=args.virtual_stages,
         pipe_schedule=args.pipe_schedule,
+        zb_h2_stash=args.zb_h2_stash,
+        sched_search_budget=args.sched_search_budget,
+        sched_search_seed=args.sched_search_seed,
         pipe_costs=args.pipe_costs,
         schedule_trace=args.schedule_trace,
         dp_replicas=args.dp_replicas,

@@ -266,9 +266,29 @@ class RunConfig:
     #                   one update per step; bubble 2(S-1)/(3M+2(S-1))),
     # * "interleaved" — interleaved 1F1B over S x virtual_stages chunks,
     # * "zero-bubble" — ZB-H1-style split backward: weight-grad events
-    #                   fill the drain bubble ((S-1)/(3M+S-1)).
+    #                   fill the drain bubble ((S-1)/(3M+S-1)); composes
+    #                   with virtual_stages > 1,
+    # * "zero-bubble-h2" — ZB-H2-style: zb_h2_stash extra in-flight
+    #                   microbatches per chunk and the trailing W events
+    #                   deferred past the step boundary (steady-state
+    #                   bubble -> 0 at the price of the extra stash),
+    # * "searched"    — partition/schedule_search.py: deterministic
+    #                   budgeted local search seeded by both heuristics;
+    #                   never packs worse than 1f1b/zero-bubble, keeps
+    #                   their 1F1B activation memory.
     # pipedream keeps its own ASYNC 1F1B engine (weight stashing).
     pipe_schedule: str = "fill-drain"
+    # zero-bubble-h2's extra in-flight activation stash, microbatches per
+    # chunk. More stash hides more warmup idle (steady bubble ~
+    # max(0, S-1-stash)/(3M+S-1-stash)) but costs that many extra stashed
+    # boundary activations per chunk in the planner's memory term.
+    zb_h2_stash: int = 1
+    # The searched packer's move-evaluation budget and rng seed
+    # (partition/schedule_search.py). Same (budget, seed) -> bitwise the
+    # same table; the planner prices searched candidates at exactly these
+    # values so the priced table is the one the runtime executes.
+    sched_search_budget: int = 256
+    sched_search_seed: int = 0
     # Cost model for the pipeline timetable (partition/schedule.py):
     # * "unit"    — the F=B=W unit-cost grids (the PR 7 tables, bitwise);
     # * "profile" — per-chunk F/B/W cost vectors summed from the
@@ -842,14 +862,14 @@ class RunConfig:
                 raise ValueError(
                     "stage_replication (hetero pipeline) executes the "
                     "fill-drain schedule only")
-            if self.pipe_schedule == "1f1b" and self.virtual_stages > 1:
-                raise ValueError(
-                    "1f1b is the V=1 schedule; use "
-                    "pipe_schedule='interleaved' with virtual_stages")
-            if self.pipe_schedule == "zero-bubble" and \
-                    self.virtual_stages > 1:
-                raise ValueError(
-                    "zero-bubble (ZB-H1) is scoped to virtual_stages=1")
+            # 1f1b/zero-bubble at virtual_stages > 1 are the COMPOSED
+            # schedules (the interleaved / W-deferring interleaved tables)
+            # since PR 18 — no V gate here; the M % S grammar below holds
+            # for the whole event family.
+        if self.zb_h2_stash < 0:
+            raise ValueError("zb_h2_stash must be >= 0")
+        if self.sched_search_budget < 0:
+            raise ValueError("sched_search_budget must be >= 0")
         if self.update_interval < 1:
             raise ValueError("update_interval must be >= 1")
         if self.update_interval > 1:
@@ -974,9 +994,9 @@ class RunConfig:
             if self.pipe_schedule == "fill-drain":
                 raise ValueError(
                     "pipe_costs='profile' needs an event schedule "
-                    "(--pipe-schedule 1f1b/interleaved/zero-bubble); the "
-                    "fill-drain autodiff scan executes the unit timetable "
-                    "by construction")
+                    "(--pipe-schedule 1f1b/interleaved/zero-bubble/"
+                    "zero-bubble-h2/searched); the fill-drain autodiff "
+                    "scan executes the unit timetable by construction")
         if self.schedule_trace is not None:
             if self.strategy != "gpipe" or not self.auto_partition:
                 raise ValueError(
@@ -991,8 +1011,9 @@ class RunConfig:
             if self.pipe_schedule == "fill-drain":
                 raise ValueError(
                     "cost-weighted timetables execute on the EVENT "
-                    "schedules (1f1b/interleaved/zero-bubble); the "
-                    "fill-drain autodiff scan is lockstep by construction")
+                    "schedules (1f1b/interleaved/zero-bubble/"
+                    "zero-bubble-h2/searched); the fill-drain autodiff "
+                    "scan is lockstep by construction")
             from ddlbench_tpu.partition.schedule import normalize_costs
 
             normalize_costs(  # raises on malformed vectors

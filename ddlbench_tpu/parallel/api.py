@@ -429,14 +429,25 @@ def make_strategy(cfg: RunConfig, devices: Optional[Sequence[jax.Device]] = None
                 # then executes a table packed for the plan's genuinely
                 # uneven chunks instead of the F=B=W unit fiction
                 from ddlbench_tpu.partition.schedule import (
-                    quantize_cost_vectors)
+                    quantize_cost_vectors_clipped)
                 from ddlbench_tpu.profiler.profile import chunk_cost_ms
 
                 f_ms, b_ms = chunk_cost_ms(graph, stage_bounds)
-                vectors = quantize_cost_vectors(f_ms, b_ms)
+                # the searched packer needs to SEE the real unevenness:
+                # an 8-half-tick cap flattens extreme profiles into the
+                # same grid the heuristics already pack (no-silent-caps)
+                max_units = 64 if cfg.pipe_schedule == "searched" else 8
+                vectors, clipped = quantize_cost_vectors_clipped(
+                    f_ms, b_ms, max_units=max_units)
                 cfg = cfg.replace(pipe_cost_vectors=vectors)
                 print(f"auto-partition: cost-weighted timetable vectors "
                       f"(f/b/w half-ticks per chunk) {vectors}", flush=True)
+                if clipped:
+                    print(f"auto-partition: WARNING {clipped} event cost(s) "
+                          f"clipped at the {max_units}-half-tick "
+                          f"quantization cap — the timetable underweights "
+                          f"the most expensive chunks (profile is more "
+                          f"uneven than the grid can express)", flush=True)
             if not keep_existing:
                 _save_plan(plan_key, cfg, stage_bounds)
         if dag is not None:

@@ -2,8 +2,10 @@
 
 ROADMAP item 2 / Piper's thesis (PAPERS.md): a pipeline SCHEDULE should be
 a description consumed by one runtime, not an engine. partition/schedule.py
-ships four timetables as data — fill-drain (GPipe), synchronous 1F1B,
-interleaved-1F1B, zero-bubble (ZB-H1-style split backward) — and this
+ships a FAMILY of timetables as data — fill-drain (GPipe), synchronous
+1F1B, interleaved-1F1B, zero-bubble (ZB-H1-style split backward),
+zero-bubble-h2 (lifted in-flight cap + boundary-deferred W) and searched
+tables (partition/schedule_search.py's budgeted local search) — and this
 module compiles any of them to the one-XLA-program scan+ppermute machinery
 the legacy gpipe/pipedream engines each reimplemented:
 
@@ -147,8 +149,9 @@ def make_stage_fwd_fused(strategy, c: int):
 
 
 class ScheduledPipelineStrategy(GPipeStrategy):
-    """``--pipe-schedule {1f1b, interleaved, zero-bubble}``: the event-mode
-    pipeline runtime (module docstring). Inherits gpipe's mesh, stage
+    """``--pipe-schedule {1f1b, interleaved, zero-bubble, zero-bubble-h2,
+    searched}``: the event-mode pipeline runtime (module docstring).
+    Inherits gpipe's mesh, stage
     packing, balanced partitioning, eval pipeline (the synchronous
     fill-drain eval is schedule-independent), checkpointing surface and
     state layout — including the hybrid PP x ZeRO-1 row layout and with
@@ -167,10 +170,15 @@ class ScheduledPipelineStrategy(GPipeStrategy):
     def _timetable(self) -> Timetable:
         # cost-aware timetables (ISSUE 8): per-chunk (f, b, w) half-tick
         # vectors from the profiler / persisted plan ride cfg; None (or
-        # all-unit) reproduces the PR 7 unit-cost tables bitwise
+        # all-unit) reproduces the PR 7 unit-cost tables bitwise. The
+        # zb-h2 stash and search knobs travel too, so the table the engine
+        # compiles is exactly the one the planner priced.
         return make_timetable(self.schedule, self.num_stages,
                               self.num_microbatches, self.vstages,
-                              costs=self.cfg.pipe_cost_vectors)
+                              costs=self.cfg.pipe_cost_vectors,
+                              stash=self.cfg.zb_h2_stash,
+                              search_budget=self.cfg.sched_search_budget,
+                              search_seed=self.cfg.sched_search_seed)
 
     def _make_train_step(self):
         S, M, mb = self.num_stages, self.num_microbatches, self.mb

@@ -52,10 +52,13 @@ the in-slice mixes the PR 7/8 runtime executes):
 * memory/chip: ``(weights + grads + opt) * P_s / tp`` with the optimizer
   slots divided by dp under ZeRO-1, plus the schedule's in-flight
   activation stash (all M microbatches for fill-drain, <= pp for the 1F1B
-  family; remat keeps one boundary activation per in-flight microbatch
-  plus one layer's working set) — candidates whose peak exceeds
-  ``hw.hbm_bytes`` are infeasible, which is how a tight cap provably
-  flips the chosen mix toward pp > 1.
+  family INCLUDING searched tables — the packer enforces the 1F1B cap —
+  and <= pp + stash for zero-bubble-h2, whose extra in-flight
+  microbatches are exactly what a tight ``--hbm-gb`` cap rejects; remat
+  keeps one boundary activation per in-flight microbatch plus one
+  layer's working set) — candidates whose peak exceeds ``hw.hbm_bytes``
+  are infeasible, which is how a tight cap provably flips the chosen mix
+  toward pp > 1 (or away from ZB-H2's stash).
 """
 
 from __future__ import annotations
@@ -97,6 +100,11 @@ class Candidate:
     # HBM model's signed per-stage error against memory_analysis() with
     # these, recorded under plan_auto["hbm_audit"] in partition.json
     stage_mem: Optional[Tuple[float, ...]] = None
+    # EXTRA activation bytes/chip the schedule's lifted in-flight cap
+    # stashes beyond the 1F1B family's (zero-bubble-h2 only; 0 elsewhere)
+    # — recorded so partition.json says what memory the bubble was bought
+    # with
+    stash_bytes: float = 0.0
 
     def mix(self) -> str:
         return f"pp={self.pp} dp={self.dp} tp={self.tp} @{self.schedule}"
@@ -113,6 +121,7 @@ class Candidate:
             "reason": self.reason,
             "stage_mem": ([round(m, 1) for m in self.stage_mem]
                           if self.stage_mem else None),
+            "stash_bytes": round(self.stash_bytes, 1),
         }
 
 
@@ -178,12 +187,21 @@ def _reprice_float(tt, F: Sequence[float], B: Sequence[float]) -> float:
 
 
 def _pipe_ms(schedule: str, pp: int, M: int,
-             F: Sequence[float], B: Sequence[float]) -> float:
+             F: Sequence[float], B: Sequence[float], *,
+             h2_stash: int = 1, search_budget: int = 256,
+             search_seed: int = 0) -> float:
     """Predicted pipeline portion of one step in ms: per-chunk forward /
     backward costs F/B (already per-chip), M microbatches, one of the
     V=1 schedules. Where the table is small enough, build the weighted
     timetable and reprice its event order under the true float costs
-    (:func:`_reprice_float`); analytic bubble closed forms beyond."""
+    (:func:`_reprice_float`); analytic bubble closed forms beyond.
+
+    The search path quantizes at max_units=64 instead of 8 — the packer
+    needs to SEE the real unevenness to place events around it, and a
+    clipped vector would hand it the same flattened profile the
+    heuristics already pack. zero-bubble-h2 is priced at its steady-state
+    period (the per-step cost of back-to-back steps; the deferred tail-W
+    overlaps the next step's warmup)."""
     if pp == 1:
         return M * (F[0] + B[0])
     from ddlbench_tpu.partition.schedule import (make_timetable,
@@ -191,11 +209,17 @@ def _pipe_ms(schedule: str, pp: int, M: int,
                                                  schedule_bubble_fraction)
 
     if pp * M <= _EXACT_TABLE_EVENTS:
-        costs = quantize_cost_vectors(F, B)
-        tt = make_timetable(schedule, pp, M, 1, costs)
-        return _reprice_float(tt, F, B)
+        max_units = 64 if schedule == "searched" else 8
+        costs = quantize_cost_vectors(F, B, max_units=max_units)
+        tt = make_timetable(schedule, pp, M, 1, costs, stash=h2_stash,
+                            search_budget=search_budget,
+                            search_seed=search_seed)
+        ms = _reprice_float(tt, F, B)
+        if schedule == "zero-bubble-h2":
+            ms *= tt.steady_period() / tt.half_ticks
+        return ms
     ideal = M * max(F[s] + B[s] for s in range(pp))
-    frac = schedule_bubble_fraction(schedule, pp, M)
+    frac = schedule_bubble_fraction(schedule, pp, M, stash=h2_stash)
     return ideal / max(1e-9, 1.0 - frac)
 
 
@@ -205,7 +229,9 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
                tp_candidates: Optional[Sequence[int]] = None,
                remat: bool = True, pin_pp: Optional[int] = None,
                pin_bounds: Optional[Sequence[int]] = None,
-               zero1: bool = True) -> PlanResult:
+               zero1: bool = True, h2_stash: int = 1,
+               search_budget: int = 256,
+               search_seed: int = 0) -> PlanResult:
     """Solve the dp/pp/tp mix + stage split + schedule for one profile
     graph on ``world`` chips. Pure host math — no devices touched.
 
@@ -216,7 +242,10 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
     permutation); tp candidates are then excluded (the recorded ZeRO-1
     flat layouts have no tp axis). ``zero1=False`` prices the replicated
     optimizer state (MoE archs, where the explicit dp collective engine
-    is unavailable)."""
+    is unavailable). ``h2_stash`` sizes zero-bubble-h2's extra in-flight
+    stash (both its memory term and its steady-state pricing);
+    ``search_budget``/``search_seed`` parameterize the searched packer so
+    the priced table is exactly the one the runtime will execute."""
     hw = hw or HardwareModel()
     order = graph.topological_sort()
     n = len(order)
@@ -291,6 +320,12 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
         shard = zero1 and tp == 1  # the engines the mapping selects
         pmult = 2.0 + opt_slots / (dp if shard else 1)
 
+        def _inflight():
+            if schedule == "fill-drain":
+                return M
+            extra = h2_stash if schedule == "zero-bubble-h2" else 0
+            return min(M, pp + extra)
+
         def stage_mem(i, j):
             """Predicted resident bytes/chip for span [i, j)."""
             weights = pmult * span_p(i, j) / tp
@@ -300,7 +335,10 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
                 # rows land in one forward)
                 acts = span_a(i, j) * M / denom
             else:
-                inflight = M if schedule == "fill-drain" else min(M, pp)
+                # searched tables keep the strict 1F1B cap (the packer
+                # rejects cap-busting orders); zero-bubble-h2 stashes
+                # h2_stash extra in-flight microbatches per chunk
+                inflight = _inflight()
                 # remat stashes one boundary activation per in-flight
                 # microbatch (+ one layer's working set during recompute);
                 # without it the whole span's interiors stay live
@@ -308,6 +346,16 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
                 stash = (boundary if remat else span_a(i, j))
                 acts = (inflight * stash + max_a(i, j)) / denom
             return weights + acts
+
+        def stage_stash_extra(i, j):
+            """Bytes/chip the schedule stashes BEYOND the 1F1B cap."""
+            if pp == 1 or schedule in ("fill-drain",):
+                return 0.0
+            extra = _inflight() - min(M, pp)
+            if extra <= 0:
+                return 0.0
+            boundary = a[i - 1] if i > 0 else a[0]
+            return extra * (boundary if remat else span_a(i, j)) / denom
 
         def stage_ms_f(i, j):
             t = span_f(i, j) / denom
@@ -381,7 +429,9 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
             return
         F = [stage_ms_f(bounds[s], bounds[s + 1]) for s in range(pp)]
         B = [stage_ms_b(bounds[s], bounds[s + 1]) for s in range(pp)]
-        pipe = _pipe_ms(schedule, pp, M, F, B)
+        pipe = _pipe_ms(schedule, pp, M, F, B, h2_stash=h2_stash,
+                        search_budget=search_budget,
+                        search_seed=search_seed)
         # steady-state boundary bottleneck (activation fwd + gradient bwd
         # per microbatch per interior cut), partition_hierarchical-style
         if pp > 1:
@@ -394,7 +444,9 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
                      for s in range(pp))
         candidates.append(Candidate(
             pp, dp, tp, schedule, tuple(bounds), pipe + sync, max(mems),
-            True, stage_mem=mems))
+            True, stage_mem=mems,
+            stash_bytes=max(stage_stash_extra(bounds[s], bounds[s + 1])
+                            for s in range(pp))))
 
     pps = [d for d in range(1, world + 1) if world % d == 0]
     if pin_pp is not None:
@@ -429,7 +481,8 @@ def solve_plan(graph: Graph, world: int, micro_batch: int,
                 # the tpp composition executes the fill-drain scan only
                 consider(pp, dp, tp, "fill-drain")
             else:
-                for schedule in ("fill-drain", "1f1b", "zero-bubble"):
+                for schedule in ("fill-drain", "1f1b", "zero-bubble",
+                                 "zero-bubble-h2", "searched"):
                     consider(pp, dp, tp, schedule)
 
     feasible = [c for c in candidates if c.feasible]
@@ -635,7 +688,9 @@ def plan_for_config(cfg: RunConfig, input_time_ms: float = 0.0
         tp_candidates=(_model_tp_widths(cfg.arch, cfg.num_devices)
                        if token_model else []),
         remat=cfg.remat_stages, pin_pp=pin_pp, pin_bounds=pin_bounds,
-        zero1="moe" not in cfg.arch)
+        zero1="moe" not in cfg.arch, h2_stash=cfg.zb_h2_stash,
+        search_budget=cfg.sched_search_budget,
+        search_seed=cfg.sched_search_seed)
     rewrite = _rewrite_fields(cfg, plan.winner, mb, chunks,
                               force_shard=force_shard)
     return plan, rewrite, graph

@@ -4,19 +4,37 @@ The schedule-programmable pipeline runtime (parallel/pipeline_rt.py)
 consumes a :class:`Timetable` — a dense ``(half_tick, device) -> {fwd,
 bwd_input, bwd_weight, idle}`` description — rather than baking a schedule
 into engine code (Piper's "schedules are descriptions" design, PAPERS.md).
-This module is where the four shipped schedules live:
+This module is where the shipped schedule FAMILY lives:
 
 * ``fill-drain``   — GPipe: all forwards flush through, then the combined
   backward drains in reverse (the autodiff schedule of parallel/gpipe.py).
 * ``1f1b``         — synchronous 1F1B: warmup of ``S-1-s`` forwards per
   stage, then one-forward-one-backward steady state; same weights for every
-  microbatch (no stashing, unlike pipedream's ASYNC 1F1B).
+  microbatch (no stashing, unlike pipedream's ASYNC 1F1B). At V > 1 it IS
+  the interleaved table (the composed schedule, not an error).
 * ``interleaved``  — interleaved 1F1B over ``C = S*V`` model chunks
   (generalizing ``cfg.virtual_stages`` beyond the fill-drain schedule).
 * ``zero-bubble``  — ZB-H1-style: the backward is split into an input-grad
   event (B, produces the upstream cotangent) and a weight-grad event (W,
   consumes the stashed input + cotangent), and W is deferred to fill the
-  fill/drain bubbles.
+  fill/drain bubbles. At V > 1 the same W-deferral composes with the
+  interleaved chunk rows (``defer_weight_grads`` over C = S*V chunks).
+* ``zero-bubble-h2`` — ZB-H2-style: the 1F1B in-flight cap is lifted by a
+  configurable extra activation stash (``stash`` microbatches per chunk)
+  and up to ``stash`` trailing W events per chunk are DEFERRED PAST THE
+  STEP BOUNDARY into the next step's warmup idle. Execution stays linear
+  (the deferred W events still run at the step's tail, before the
+  optimizer update, so per-step math is unchanged and trajectories stay
+  pinned); the deferral is the STEADY-STATE accounting —
+  :meth:`Timetable.bubble_fraction` prices the wrapped period
+  :meth:`Timetable.steady_period` instead of the linear makespan. The
+  extra stash is priced into the planner's memory term, so a tight
+  ``--hbm-gb`` cap can reject H2 for exactly that memory.
+* ``searched``     — partition/schedule_search.py: deterministic budgeted
+  local search (per-device swap/shift moves on the weighted event grid,
+  seeded by BOTH heuristics of every 1F1B-memory family) that never packs
+  worse than the min-of-two-heuristics table and strictly beats it on
+  genuinely uneven profiled costs.
 
 Event cost model (the half-tick grid): one F, one B (input grad) or one W
 (weight grad) each occupy ONE half-tick, one event per device per half-tick
@@ -66,7 +84,13 @@ import numpy as np
 EVENT_IDLE, EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W = 0, 1, 2, 3
 EVENT_NAMES = ("idle", "F", "B", "W")
 
-PIPE_SCHEDULES = ("fill-drain", "1f1b", "interleaved", "zero-bubble")
+PIPE_SCHEDULES = ("fill-drain", "1f1b", "interleaved", "zero-bubble",
+                  "zero-bubble-h2", "searched")
+
+# the 1F1B-memory event family the searched packer draws its seeds from
+# (fill-drain is the autodiff scan; zero-bubble-h2 trades memory for its
+# bubble, so a searched table must not silently inherit its lifted cap)
+SEARCH_SEED_SCHEDULES = ("1f1b", "zero-bubble")
 
 # costs = (f, b, w): three length-C tuples of positive ints, half-ticks per
 # chunk event. None = the F=B=W unit-cost model.
@@ -118,6 +142,12 @@ class Timetable:
     # weighted event occupies ``cost`` consecutive grid cells starting at
     # its event_times() half-tick.
     costs: Optional[CostVectors] = None
+    # (chunk, microbatch) W events the STEADY-STATE model defers past the
+    # step boundary (ZB-H2): they are still painted (and executed) at the
+    # step's tail — per-step math unchanged — but bubble_fraction prices
+    # the wrapped steady_period instead of the linear makespan, because in
+    # back-to-back steps those cells overlap the next step's warmup idle.
+    deferred_w: Optional[Tuple[Tuple[int, int], ...]] = None
 
     @property
     def num_chunks(self) -> int:
@@ -139,10 +169,46 @@ class Timetable:
         """Idle fraction of the device-time grid: idle half-ticks over
         S * H. This is THE schedule's analytic bubble — the runtime executes
         the table verbatim, and telemetry/bubble.py measures the same
-        quantity from emitted tick spans."""
+        quantity from emitted tick spans.
+
+        With ``deferred_w`` set (ZB-H2) the fraction is priced over the
+        STEADY-STATE period instead: idle cells over
+        ``S * steady_period()``. A single linear step still measures the
+        grid fraction (``bubble_is_estimate`` flags exactly this gap for
+        telemetry consumers)."""
         total = self.events.size
         busy = int(np.count_nonzero(self.events))
-        return (total - busy) / total if total else 0.0
+        if not total:
+            return 0.0
+        if self.deferred_w:
+            P = self.steady_period()
+            return (self.num_stages * P - busy) / (self.num_stages * P)
+        return (total - busy) / total
+
+    def steady_period(self) -> int:
+        """Half-ticks per step in the back-to-back steady state.
+
+        Without deferral this is the linear makespan (the grid height H).
+        With ``deferred_w``, each stage's deferred tail-W cells wrap into
+        the NEXT step's idle, so the per-stage period is
+        ``max(end of last non-deferred event, total busy cells)`` — the
+        first term keeps the in-step critical path, the second is work
+        conservation (wrapped cells must fit in that stage's idle). The
+        step period is the max over stages."""
+        if not self.deferred_w:
+            return self.half_ticks
+        deferred = set(self.deferred_w)
+        S = self.num_stages
+        busy = [0] * S
+        e_nondef = [0] * S
+        for kind in (EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W):
+            for (c, m), h in self.event_times(kind).items():
+                s = c % S
+                cost = self.cost_of(kind, c)
+                busy[s] += cost
+                if not (kind == EVENT_BWD_W and (c, m) in deferred):
+                    e_nondef[s] = max(e_nondef[s], h + cost)
+        return max(max(e_nondef[s], busy[s]) for s in range(S))
 
     def event_times(self, kind: int) -> Dict[Tuple[int, int], int]:
         """{(chunk, microbatch): START half_tick} for one event kind.
@@ -204,6 +270,29 @@ class Timetable:
         assert all(int(self.chunks[h, s]) % S == s
                    for h, s in zip(hs.tolist(), ss.tolist())), (
             f"{self.name}: an event landed on a foreign device")
+        if self.deferred_w:
+            # ZB-H2 accounting soundness: a deferred W must be a real W
+            # event forming its stage's TAIL (it starts at/after every
+            # non-deferred event on that stage ends), so wrapping it into
+            # the next period cannot collide with in-step work
+            deferred = set(self.deferred_w)
+            for (c, m) in deferred:
+                assert (c, m) in W, (
+                    f"{self.name}: deferred_w ({c},{m}) is not a W event")
+            e_nondef = [0] * S
+            for table, kind in ((F, EVENT_FWD), (B, EVENT_BWD_IN),
+                                (W, EVENT_BWD_W)):
+                for (c, m), h in table.items():
+                    if kind == EVENT_BWD_W and (c, m) in deferred:
+                        continue
+                    s = c % S
+                    e_nondef[s] = max(e_nondef[s],
+                                      h + self.cost_of(kind, c))
+            for (c, m) in deferred:
+                assert W[(c, m)] >= e_nondef[c % S], (
+                    f"{self.name}: deferred W({c},{m})@{W[(c, m)]} is not "
+                    f"its stage's tail (non-deferred work ends at "
+                    f"{e_nondef[c % S]})")
 
     def forward_tick_arrays(self) -> Tuple[np.ndarray, np.ndarray,
                                            np.ndarray]:
@@ -472,7 +561,8 @@ def fill_drain_timetable(S: int, M: int, V: int = 1,
 @functools.lru_cache(maxsize=64)
 def _greedy_timetable(name: str, S: int, M: int, V: int,
                       defer_weight_grads: bool,
-                      costs: Optional[CostVectors] = None) -> Timetable:
+                      costs: Optional[CostVectors] = None,
+                      extra_inflight: int = 0) -> Timetable:
     """Event-driven greedy generator for the synchronous 1F1B family.
 
     Closed-form rule set (this IS the schedule description; the dense table
@@ -480,7 +570,9 @@ def _greedy_timetable(name: str, S: int, M: int, V: int,
 
     * chunk c runs a warmup of ``C - 1 - c`` forwards, i.e. at most
       ``C - c`` microbatches may be in flight (F done, B not) — the classic
-      1F1B in-flight cap over C = S*V chunks;
+      1F1B in-flight cap over C = S*V chunks. ``extra_inflight`` (ZB-H2)
+      LIFTS the cap to ``min(M, C - c + extra_inflight)``: deeper warmup,
+      more stashed activations, fewer forced idles;
     * readiness: F(c, m) one half-tick after F(c-1, m) ENDS; B(c, m) one
       after B(c+1, m) ends (after F(c, m) ends on the last chunk); W(c, m)
       any time after B(c, m) ends;
@@ -511,7 +603,7 @@ def _greedy_timetable(name: str, S: int, M: int, V: int,
             return False
         if c > 0 and F.get((c - 1, m), h) + fc[c - 1] > h:
             return False
-        return inflight[c] < C - c
+        return inflight[c] < min(M, C - c + extra_inflight)
 
     def ready_b(c, m, h):
         if (c, m) in B or (c, m) not in F:
@@ -580,17 +672,94 @@ def sync_1f1b_timetable(S: int, M: int, V: int = 1,
                              costs=normalize_costs(costs, S * V))
 
 
-def zero_bubble_timetable(S: int, M: int,
+def zero_bubble_timetable(S: int, M: int, V: int = 1,
                           costs: Optional[CostVectors] = None) -> Timetable:
     """ZB-H1-style: weight-grad events deferred to fill the drain bubble
-    (same in-flight cap as 1F1B, so activation memory is 1F1B-equal)."""
-    return _greedy_timetable("zero-bubble", S, M, 1,
+    (same in-flight cap as 1F1B, so activation memory is 1F1B-equal).
+    V > 1 composes the same W-deferral with the interleaved chunk rows —
+    the ``defer_weight_grads`` priority over C = S*V chunks."""
+    return _greedy_timetable("zero-bubble", S, M, V,
                              defer_weight_grads=True,
-                             costs=normalize_costs(costs, S))
+                             costs=normalize_costs(costs, S * V))
+
+
+def _defer_tail_w(tt: Timetable, stash: int) -> Timetable:
+    """Mark up to ``stash`` trailing W events per chunk as deferred past
+    the step boundary (the ZB-H2 steady-state accounting). Only a stage's
+    TAIL is eligible — a contiguous run of W events after every other
+    event on that stage — so the wrapped cells provably land in the next
+    period's idle (Timetable.validate pins the invariant). Execution is
+    untouched: the events stay painted where they are."""
+    if stash <= 0:
+        return tt
+    S = tt.num_stages
+    # per-stage events sorted by start
+    per_stage: Dict[int, List[Tuple[int, int, int, int]]] = {
+        s: [] for s in range(S)}
+    for kind in (EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W):
+        for (c, m), h in tt.event_times(kind).items():
+            per_stage[c % S].append((h, kind, c, m))
+    deferred: List[Tuple[int, int]] = []
+    for s in range(S):
+        taken: Dict[int, int] = {}  # chunk -> deferred count
+        for h, kind, c, m in sorted(per_stage[s], reverse=True):
+            if kind != EVENT_BWD_W or taken.get(c, 0) >= stash:
+                break  # the tail run ended (or this chunk's stash is full)
+            taken[c] = taken.get(c, 0) + 1
+            deferred.append((c, m))
+    if not deferred:
+        return tt
+    return dataclasses.replace(tt, deferred_w=tuple(sorted(deferred)))
+
+
+@functools.lru_cache(maxsize=64)
+def zero_bubble_h2_timetable(S: int, M: int, V: int = 1,
+                             costs: Optional[CostVectors] = None,
+                             stash: int = 1) -> Timetable:
+    """ZB-H2-style: the greedy W-deferring packer with the 1F1B in-flight
+    cap LIFTED by ``stash`` extra microbatches per chunk, then up to
+    ``stash`` trailing W events per chunk marked deferred past the step
+    boundary. The linear event order still executes within the step (so
+    trajectories pin against 1f1b exactly like zero-bubble); the payoff is
+    the steady-state period — bubble_fraction prices the wrapped schedule,
+    which the lifted warmup + boundary deferral drive toward zero at the
+    price of ``stash`` extra stashed activations per chunk (the planner's
+    stage_mem term; a tight --hbm-gb cap rejects exactly this)."""
+    tt = _greedy_timetable("zero-bubble-h2", S, M, V,
+                           defer_weight_grads=True,
+                           costs=normalize_costs(costs, S * V),
+                           extra_inflight=stash)
+    out = _defer_tail_w(tt, stash)
+    out.validate()
+    return out
+
+
+def timetable_from_times(name: str, S: int, V: int, M: int,
+                         F: Dict[Tuple[int, int], int],
+                         B: Dict[Tuple[int, int], int],
+                         W: Dict[Tuple[int, int], int],
+                         costs: Optional[CostVectors]) -> Timetable:
+    """Materialize a dense validated grid from start-time tables — the
+    shared tail of :func:`reprice_timetable` and the searched packer's
+    list scheduler (partition/schedule_search.py)."""
+    fc, bc, wc = costs if costs is not None else ((1,) * (S * V),) * 3
+    H = max(max(h + wc[c] for (c, _), h in W.items()),
+            max(h + bc[c] for (c, _), h in B.items()),
+            max(h + fc[c] for (c, _), h in F.items()))
+    events, mbs, chunks = _empty(H, S)
+    for table, kind, cv in ((F, EVENT_FWD, fc), (B, EVENT_BWD_IN, bc),
+                            (W, EVENT_BWD_W, wc)):
+        for (c, m), h in table.items():
+            _paint(events, mbs, chunks, h, c % S, kind, c, m, cv[c])
+    out = Timetable(name, S, V, M, events, mbs, chunks, costs)
+    out.validate()
+    return out
 
 
 def make_timetable(schedule: str, S: int, M: int, V: int = 1,
-                   costs: Optional[CostVectors] = None) -> Timetable:
+                   costs: Optional[CostVectors] = None, *,
+                   stash: int = 1, search_budget: int = 256,
+                   search_seed: int = 0) -> Timetable:
     """Factory keyed by the ``--pipe-schedule`` flag value. ``costs`` are
     per-chunk (f, b, w) half-tick vectors (None / all-unit = the PR 7
     unit-cost tables, reproduced bitwise).
@@ -601,22 +770,29 @@ def make_timetable(schedule: str, S: int, M: int, V: int = 1,
     returns the lower-bubble one: the greedy is a heuristic that can
     commit early where the unit order happens to interleave better, so
     taking the min guarantees a weighted timetable never packs WORSE
-    than executing the classic schedule on the same uneven chunks."""
+    than executing the classic schedule on the same uneven chunks.
+
+    ``1f1b``/``zero-bubble`` at V > 1 return the COMPOSED schedules (the
+    interleaved table; the W-deferring interleaved table) instead of the
+    pre-PR-18 ValueError. ``stash`` sizes zero-bubble-h2's extra in-flight
+    stash; ``search_budget``/``search_seed`` parameterize the searched
+    packer (deterministic: same budget + seed reproduce the table
+    bitwise)."""
     costs = normalize_costs(costs, S * V)
     if schedule == "fill-drain":
         return fill_drain_timetable(S, M, V, costs)
-    if schedule == "1f1b" and V != 1:
-        raise ValueError("1f1b is the V=1 schedule; use "
-                         "--pipe-schedule interleaved with "
-                         "--virtual-stages for V > 1")
-    if schedule == "zero-bubble" and V != 1:
-        raise ValueError("zero-bubble (ZB-H1) is scoped to V = 1; "
-                         "combine interleaving and W-deferral in a "
-                         "future schedule")
+    if schedule == "searched":
+        from ddlbench_tpu.partition.schedule_search import searched_timetable
+
+        return searched_timetable(S, M, V, costs, budget=search_budget,
+                                  seed=search_seed)
     if schedule in ("1f1b", "interleaved"):
+        # 1f1b at V > 1 IS the interleaved table (the composed schedule)
         gen = lambda c: sync_1f1b_timetable(S, M, V, c)
     elif schedule == "zero-bubble":
-        gen = lambda c: zero_bubble_timetable(S, M, c)
+        gen = lambda c: zero_bubble_timetable(S, M, V, c)
+    elif schedule == "zero-bubble-h2":
+        gen = lambda c: zero_bubble_h2_timetable(S, M, V, c, stash=stash)
     else:
         raise ValueError(f"unknown pipe schedule {schedule!r} "
                          f"(choose from {', '.join(PIPE_SCHEDULES)})")
@@ -624,6 +800,11 @@ def make_timetable(schedule: str, S: int, M: int, V: int = 1,
         return gen(None)
     aware = gen(costs)
     repriced = reprice_timetable(gen(None), costs)
+    if schedule == "zero-bubble-h2":
+        # compare on the steady-state accounting both candidates use:
+        # repricing rebuilds the grid, so re-mark its deferred tail
+        repriced = _defer_tail_w(repriced, stash)
+        repriced.validate()
     return (aware if aware.bubble_fraction() <= repriced.bubble_fraction()
             else repriced)
 
@@ -670,22 +851,18 @@ def reprice_timetable(tt: Timetable, costs: CostVectors) -> Timetable:
             start = max(free[s], B[(c, m)] + bc[c])
             W[(c, m)] = start
             free[s] = start + wc[c]
-    H = max(free)
-    events, mbs, chunks = _empty(H, tt.num_stages)
-    for table, kind, cv in ((F, EVENT_FWD, fc), (B, EVENT_BWD_IN, bc),
-                            (W, EVENT_BWD_W, wc)):
-        for (c, m), h in table.items():
-            _paint(events, mbs, chunks, h, c % tt.num_stages, kind, c, m,
-                   cv[c])
-    out = Timetable(tt.name, tt.num_stages, tt.virtual_stages,
-                    tt.num_microbatches, events, mbs, chunks, costs)
-    out.validate()
-    return out
+    return timetable_from_times(tt.name, tt.num_stages, tt.virtual_stages,
+                                tt.num_microbatches, F, B, W, costs)
 
 
-def quantize_cost_vectors(f_ms, b_ms, w_ms=None,
-                          max_units: int = 8) -> CostVectors:
-    """Per-chunk profiled milliseconds -> integer half-tick cost vectors.
+def quantize_cost_vectors_clipped(
+        f_ms, b_ms, w_ms=None,
+        max_units: int = 8) -> Tuple[CostVectors, int]:
+    """Per-chunk profiled milliseconds -> integer half-tick cost vectors,
+    plus HOW MANY events the ``max_units`` cap clipped (the no-silent-caps
+    rule: a clipped vector flattens genuinely uneven profiles, and the
+    caller should say so — parallel/api.py logs it, and the search path
+    raises the cap so the packer sees the real unevenness).
 
     The cheapest event maps to one half-tick; everything else scales
     relative to it, rounded, capped at ``max_units`` (bounding the
@@ -702,9 +879,18 @@ def quantize_cost_vectors(f_ms, b_ms, w_ms=None,
         w_ms = [float(v) for v in w_ms]
     lo = min(v for v in f_ms + b_ms + w_ms if v > 0) if any(
         v > 0 for v in f_ms + b_ms + w_ms) else 1.0
+    clipped = sum(1 for v in f_ms + b_ms + w_ms
+                  if int(round(v / lo)) > max_units)
     q = lambda v: max(1, min(max_units, int(round(v / lo))))
     return (tuple(q(v) for v in f_ms), tuple(q(v) for v in b_ms),
-            tuple(q(v) for v in w_ms))
+            tuple(q(v) for v in w_ms)), clipped
+
+
+def quantize_cost_vectors(f_ms, b_ms, w_ms=None,
+                          max_units: int = 8) -> CostVectors:
+    """:func:`quantize_cost_vectors_clipped` without the clip count — for
+    callers that handle/report clipping elsewhere (or don't care)."""
+    return quantize_cost_vectors_clipped(f_ms, b_ms, w_ms, max_units)[0]
 
 
 # -- analytic bubble fractions (module docstring's closed forms) -----------
@@ -724,51 +910,75 @@ def pipeline_bubble_fraction(num_stages: int, num_microbatches: int,
 def schedule_bubble_fraction(schedule: str, num_stages: int,
                              num_microbatches: int,
                              virtual_stages: int = 1,
-                             costs: Optional[CostVectors] = None) -> float:
+                             costs: Optional[CostVectors] = None,
+                             stash: int = 1) -> float:
     """Analytic bubble fraction for one shipped schedule at (S, M, V).
 
     fill-drain / 1f1b / zero-bubble use the closed forms (module
-    docstring); interleaved is measured from its table (its fill/drain
-    compression depends on how the greedy packer interleaves chunk rows).
-    Closed forms are pinned against table-derived fractions by the
-    ``pipesched`` suite. With ``costs`` the WEIGHTED bubble is measured
-    from the cost-aware table (no closed forms exist for uneven chunks).
-    """
+    docstring); interleaved / zero-bubble-h2 / searched are measured from
+    their tables at runtime-plausible shapes (their packing depends on how
+    the generator interleaves / defers / searches) and fall back to
+    lower-bound closed forms at advisory scale. Closed forms are pinned
+    against table-derived fractions by the ``pipesched`` suite. With
+    ``costs`` the WEIGHTED bubble is measured from the cost-aware table
+    (no closed forms exist for uneven chunks). ``stash`` is
+    zero-bubble-h2's extra in-flight stash."""
     S, M, V = num_stages, num_microbatches, virtual_stages
     if S <= 1:
         return 0.0
     costs = normalize_costs(costs, S * V)
     if costs is not None:
-        return make_timetable(schedule, S, M, V, costs).bubble_fraction()
+        return make_timetable(schedule, S, M, V, costs,
+                              stash=stash).bubble_fraction()
     if schedule == "fill-drain":
         return pipeline_bubble_fraction(S, M, V)
-    if schedule == "1f1b" or (schedule == "interleaved" and V == 1):
+    if schedule == "1f1b" and V == 1 or schedule == "interleaved" and V == 1:
         return 2 * (S - 1) / (3 * M + 2 * (S - 1))
-    if schedule == "zero-bubble":
+    if schedule == "zero-bubble" and V == 1:
         return (S - 1) / (3 * M + (S - 1))
-    if schedule == "interleaved":
-        if bubble_is_estimate(schedule, S, M, V):
-            # advisory-scale guard: the greedy generator is pure Python
-            # (O(H*S*V*M^2) worst case) — beyond a few thousand events,
-            # report the ideal-packing LOWER BOUND (fill/drain shrunk by
-            # V) instead of materializing the table for a printed hint;
-            # the runtime still builds (and caches) the exact table when
-            # the schedule actually executes
+    if bubble_is_estimate(schedule, S, M, V):
+        # advisory-scale guard: the generators are pure Python (the greedy
+        # O(H*S*V*M^2) worst case; the searched packer budget * O(events)
+        # on top) — beyond a few thousand events, report the ideal-packing
+        # LOWER BOUND instead of materializing the table for a printed
+        # hint; the runtime still builds (and caches) the exact table when
+        # the schedule actually executes
+        if schedule in ("1f1b", "interleaved"):
             return 2 * (S - 1) / (3 * M * V + 2 * (S - 1))
-        return make_timetable("interleaved", S, M, V).bubble_fraction()
-    raise ValueError(f"unknown pipe schedule {schedule!r}")
+        if schedule == "zero-bubble":
+            return (S - 1) / (3 * M * V + (S - 1))
+        if schedule == "zero-bubble-h2":
+            # the zero-bubble form with the fill shrunk by the stash —
+            # each extra in-flight microbatch hides one warmup idle
+            d = max(0, S - 1 - stash)
+            return d / (3 * M * V + d) if d else 0.0
+        if schedule == "searched":
+            # searched seeds include zero-bubble, so its form bounds below
+            return (S - 1) / (3 * M * V + (S - 1))
+    if schedule not in PIPE_SCHEDULES:
+        raise ValueError(f"unknown pipe schedule {schedule!r}")
+    return make_timetable(schedule, S, M, V, stash=stash).bubble_fraction()
 
 
 def bubble_is_estimate(schedule: str, num_stages: int,
                        num_microbatches: int,
                        virtual_stages: int = 1) -> bool:
-    """True when :func:`schedule_bubble_fraction` returns the
-    ideal-packing LOWER BOUND instead of the exact table-derived value
-    (large interleaved shapes) — callers reporting the figure (scalebench
-    ``bubble_analytic``) tag it so measured-vs-analytic comparisons don't
-    read an optimistic bound as the schedule's true prediction."""
-    return (schedule == "interleaved" and virtual_stages > 1
-            and num_stages * virtual_stages * num_microbatches > 2048)
+    """True when :func:`schedule_bubble_fraction` returns a value a
+    single-step measured trace will NOT reproduce — either an
+    ideal-packing LOWER BOUND (large table-derived shapes, where the pure-
+    Python generators are too slow for a printed hint), or zero-bubble-h2
+    ALWAYS (its analytic figure prices the wrapped steady-state period;
+    one linear step measures the strictly-higher grid fraction). Callers
+    reporting the figure (scalebench ``bubble_analytic``) tag it so
+    measured-vs-analytic comparisons don't read an optimistic bound as
+    the schedule's true prediction."""
+    S, V, M = num_stages, virtual_stages, num_microbatches
+    if schedule == "zero-bubble-h2":
+        return True
+    if schedule == "searched":
+        return S * V * M > 512
+    return (schedule in ("1f1b", "interleaved", "zero-bubble")
+            and V > 1 and S * V * M > 2048)
 
 
 def recommend_schedule(num_stages: int, num_microbatches: int,
@@ -778,7 +988,9 @@ def recommend_schedule(num_stages: int, num_microbatches: int,
                        ) -> List[dict]:
     """Feasible schedules at (S, M, V) with their analytic bubbles, best
     first — what --auto-partition's advisor now reports alongside the best
-    V. zero-bubble/1f1b rows appear only where their constraints hold.
+    V. Ranks the FULL grown family (fill-drain, 1f1b, interleaved,
+    zero-bubble, zero-bubble-h2, searched); the 1f1b row is skipped at
+    V > 1 where it aliases the interleaved table.
 
     ``costs``: per-chunk (f, b, w) half-tick vectors — rows then carry the
     WEIGHTED analytic bubble of each schedule's cost-aware table.
@@ -790,17 +1002,18 @@ def recommend_schedule(num_stages: int, num_microbatches: int,
     S, M, V = num_stages, num_microbatches, virtual_stages
     rows = []
     for name in PIPE_SCHEDULES:
-        if name in ("1f1b", "zero-bubble") and V != 1:
-            continue
-        if name == "interleaved" and V > 1 and M % S:
-            continue  # interleaved groups microbatches in rounds of S
+        if name == "1f1b" and V != 1:
+            continue  # at V > 1 the 1f1b row IS the interleaved row
+        if name != "fill-drain" and V > 1 and M % S:
+            continue  # event schedules group microbatches in rounds of S
         row = {
             "schedule": name,
             "bubble": round(
                 schedule_bubble_fraction(name, S, M, V, costs), 4),
-            "virtual_stages": V if name in ("fill-drain", "interleaved")
-            else 1,
+            "virtual_stages": V,
         }
+        if bubble_is_estimate(name, S, M, V):
+            row["bubble_is_estimate"] = True
         if measured and name in measured:
             row["bubble_measured"] = round(float(measured[name]), 4)
         rows.append(row)

@@ -71,6 +71,7 @@ def emit_tick_spans(tracer, timetable, t0_ns: int, t1_ns: int,
     H = timetable.half_ticks
     S = timetable.num_stages
     tick_ns = max(1, (t1_ns - t0_ns)) / H
+    deferred = set(timetable.deferred_w or ())
     n = 0
     for kind in (EVENT_FWD, EVENT_BWD_IN, EVENT_BWD_W):
         for (c, m), h in sorted(timetable.event_times(kind).items()):
@@ -85,6 +86,13 @@ def emit_tick_spans(tracer, timetable, t0_ns: int, t1_ns: int,
                 "half_tick": int(h),
                 "schedule": timetable.name,
             }
+            if kind == EVENT_BWD_W and (c, m) in deferred:
+                # ZB-H2: this W is deferred past the step boundary in the
+                # steady-state accounting — trace viewers can see which
+                # tail cells overlap the next step's warmup, and the
+                # measured single-step fraction explains its gap vs the
+                # steady analytic (bubble_is_estimate)
+                args["deferred"] = True
             if step is not None:
                 args["step"] = step
             tracer.complete("pipe_tick", a, b, args)

@@ -1,0 +1,166 @@
+"""Schedule-quality harness: every timetable priced on a (S, M, V, costs)
+grid, with the searched-packer audit gate.
+
+For each (stages, microbatches, virtual-stages) shape x cost profile this
+tool builds EVERY shipped schedule's timetable (partition/schedule.py,
+including the searched packer of partition/schedule_search.py) and prints
+one JSON row per point:
+
+    {"S": 3, "M": 6, "V": 1, "profile": "spike",
+     "schedules": {"1f1b": {"bubble": N, "makespan": N}, ...},
+     "heuristic_min_bubble": N, "searched_bubble": N, "searched_win": N}
+
+``heuristic_min_bubble`` is the min over the pre-search family
+(SEARCH_SEED_SCHEDULES: 1f1b and zero-bubble — the min-of-two the factory
+shipped before the searched packer existed); ``searched_win`` is
+heuristic_min - searched (>= 0 by construction, > 0 where the search found
+a genuinely better packing). zero-bubble-h2 rows also carry the
+steady-state period vs the linear makespan (its bubble IS the steady
+figure; bubble_is_estimate).
+
+**Audit gate** (the tools/servechaos.py requests_lost==0 pattern): if the
+searched table's bubble exceeds the heuristic min on ANY point — the
+seeded search regressed, which its construction forbids — the summary row
+says so and the exit code is nonzero.
+
+Pure host math: no devices are touched, rows are bitwise-reproducible
+(fixed search budget + seed). Tier-1 smokes the tiny default grid through
+main(); bigger sweeps ride --runslow (tests/test_schedule_costs.py).
+
+Usage:
+    python -m ddlbench_tpu.tools.schedbench \
+        [--shapes 2:4:1,3:6:1,4:8:1] [--profiles unit,spike,ramp,valley,tilt] \
+        [--budget 256] [--seed 0] [--platform cpu]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+DEFAULT_SHAPES = "2:4:1,3:6:1,4:8:1,2:4:2"
+DEFAULT_PROFILES = "unit,spike,ramp,valley,tilt"
+
+# deterministic per-chunk cost templates, parameterized only by the chunk
+# count (no rng: a profile name + shape IS the fixture)
+_PROFILES = {
+    "unit": lambda C: None,
+    # one chunk an order of magnitude heavier — the [1,1,10,1]-style
+    # bottleneck fixture of the uneven-cost acceptance suite
+    "spike": lambda C: (tuple(10 if c == C // 2 else 1 for c in range(C)),
+                        tuple(10 if c == C // 2 else 1 for c in range(C)),
+                        (1,) * C),
+    # smoothly skewed F/B/W, phase-shifted per kind
+    "ramp": lambda C: (tuple(c % 3 + 1 for c in range(C)),
+                       tuple((c + 1) % 3 + 1 for c in range(C)),
+                       tuple((c + 2) % 3 + 1 for c in range(C))),
+    # cheap middle, heavy ends with a heavy W tail — the shape the
+    # strictly-better searched fixtures come from (heuristics commit the
+    # first device before seeing the tail's W pressure)
+    "valley": lambda C: (tuple(3 if c == 0 else 1 for c in range(C)),
+                         tuple(2 + (c % 2) for c in range(C)),
+                         tuple(4 if c == C - 1 else 1 for c in range(C))),
+    # F shrinks down the ring while the LAST stage owns a heavy W: the
+    # greedy heuristics pack the early stages' W eagerly and eat the tail
+    # stall — at C=3 this is exactly the ((3,2,1),(2,3,1),(1,1,4)) fixture
+    # the searched packer strictly beats (tests/test_schedule_costs.py)
+    "tilt": lambda C: (tuple(max(1, 3 - c % 3) for c in range(C)),
+                       tuple((2, 3, 1)[c % 3] for c in range(C)),
+                       tuple(4 if c == C - 1 else 1 for c in range(C))),
+}
+
+
+def bench_point(S: int, M: int, V: int, profile: str, budget: int,
+                seed: int) -> dict:
+    """One (shape, profile) row: every schedule's bubble + makespan."""
+    from ddlbench_tpu.partition.schedule import (PIPE_SCHEDULES,
+                                                 SEARCH_SEED_SCHEDULES,
+                                                 make_timetable)
+
+    costs = _PROFILES[profile](S * V)
+    row = {"S": S, "M": M, "V": V, "profile": profile,
+           "budget": budget, "seed": seed, "schedules": {}}
+    for name in PIPE_SCHEDULES:
+        if V > 1 and M % S and name != "fill-drain":
+            continue  # event schedules group microbatches in rounds of S
+        tt = make_timetable(name, S, M, V, costs, search_budget=budget,
+                            search_seed=seed)
+        ent = {"bubble": round(tt.bubble_fraction(), 4),
+               "makespan": tt.half_ticks}
+        if tt.deferred_w:
+            ent["steady_period"] = tt.steady_period()
+            ent["deferred_w"] = len(tt.deferred_w)
+        row["schedules"][name] = ent
+    sch = row["schedules"]
+    if "searched" in sch:
+        hmin = min(sch[n]["bubble"] for n in SEARCH_SEED_SCHEDULES
+                   if n in sch)
+        row["heuristic_min_bubble"] = hmin
+        row["searched_bubble"] = sch["searched"]["bubble"]
+        row["searched_win"] = round(hmin - sch["searched"]["bubble"], 4)
+    return row
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--shapes", default=DEFAULT_SHAPES,
+                   help="comma list of S:M[:V] shapes to sweep")
+    p.add_argument("--profiles", default=DEFAULT_PROFILES,
+                   help=f"comma list of cost profiles "
+                        f"({', '.join(_PROFILES)})")
+    p.add_argument("--budget", type=int, default=256,
+                   help="searched-packer move-evaluation budget")
+    p.add_argument("--seed", type=int, default=0,
+                   help="searched-packer shift-move rng seed")
+    from ddlbench_tpu.distributed import add_platform_arg, apply_platform
+
+    add_platform_arg(p)
+    args = p.parse_args(argv)
+    apply_platform(args.platform)
+
+    from ddlbench_tpu.distributed import record_provenance
+
+    prov = record_provenance(args.platform, "schedbench")
+    print(json.dumps({"provenance": {**prov,
+                                     "platform_arg": args.platform}}),
+          flush=True)
+    rows = []
+    regressions = []
+    for shape in args.shapes.split(","):
+        parts = [int(v) for v in shape.strip().split(":")]
+        S, M = parts[0], parts[1]
+        V = parts[2] if len(parts) > 2 else 1
+        for profile in args.profiles.split(","):
+            profile = profile.strip()
+            if profile not in _PROFILES:
+                raise SystemExit(f"unknown cost profile {profile!r} "
+                                 f"(choose from {', '.join(_PROFILES)})")
+            row = bench_point(S, M, V, profile, args.budget, args.seed)
+            row = {**row, "schema_version": prov["schema_version"]}
+            print(json.dumps(row), flush=True)
+            rows.append(row)
+            if row.get("searched_win", 0) < 0:
+                regressions.append(
+                    f"S={S} M={M} V={V} {profile}: searched "
+                    f"{row['searched_bubble']} > heuristic min "
+                    f"{row['heuristic_min_bubble']}")
+    gated = [r for r in rows if "searched_win" in r]
+    wins = sum(1 for r in gated if r["searched_win"] > 0)
+    print(json.dumps({
+        "summary": {
+            "points": len(rows),
+            "gated_points": len(gated),
+            "searched_strict_wins": wins,
+            "regressions": regressions,
+        }}), flush=True)
+    if regressions:
+        print(json.dumps({"error": "searched packer regressed below the "
+                                   "heuristic min (see regressions)"}),
+              flush=True)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

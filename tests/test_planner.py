@@ -38,33 +38,45 @@ def chain_graph(times, params=None, acts=None):
 # ---- solver unit pins ------------------------------------------------------
 
 
-def test_solver_prefers_dp_when_light():
-    """4 equal light layers on 4 chips: pure dp has no bubble and near-free
-    allreduce — hand check: step = M * (f+b)/dp = 8 * 12 / 4 = 24 ms plus
-    a sub-0.01 ms ring term."""
+def test_solver_light_layers_h2_noses_out_dp():
+    """4 equal light layers on 4 chips: both pure dp and a ZB-H2 pipeline
+    price at the compute bound — hand check: step = M * (f+b)/dp = 8 * 12
+    / 4 = 24 ms (dp pays a sub-0.01 ms ring; the deferred schedule's
+    steady pricing has NO bubble and pays only the boundary p2p term, so
+    it noses ahead). dp remains the best non-deferred mix."""
     g = chain_graph([3.0] * 4, params=[1e4] * 4, acts=[1e5] * 4)
     plan = solve_plan(g, 4, 8, 8)
     w = plan.winner
-    assert (w.pp, w.dp, w.tp) == (1, 4, 1)
+    assert (w.pp, w.dp, w.tp, w.schedule) == (2, 2, 1, "zero-bubble-h2")
     assert w.step_time_ms == pytest.approx(24.0, abs=0.1)
-    assert w.feasible and w.bounds == (0, 4)
+    assert w.stash_bytes > 0  # the bubble was bought with stash memory
+    best_dp = min((c for c in plan.candidates if c.pp == 1 and c.tp == 1),
+                  key=lambda c: c.step_time_ms)
+    assert best_dp.dp == 4 and best_dp.feasible
+    assert best_dp.step_time_ms == pytest.approx(24.0, abs=0.1)
     # every enumerated mix is in the record, schedules included
     mixes = {(c.pp, c.dp, c.tp, c.schedule) for c in plan.candidates}
     assert (4, 1, 1, "zero-bubble") in mixes
     assert (2, 2, 1, "1f1b") in mixes
+    assert (2, 2, 1, "zero-bubble-h2") in mixes
+    assert (2, 2, 1, "searched") in mixes
     assert "ms/step" in plan.reason
 
 
 def test_memory_cap_flips_mix():
-    """THE acceptance pin: a tight HBM cap provably flips the chosen mix.
-    4e7 param bytes total: pure dp wins with room (ring ~1.3 ms < the
-    ~3 ms pipeline bubble), but one chip must hold weights + grads +
-    sharded opt = 2.25 x 4e7 = 9e7 bytes, so a 6e7 cap kills every pp=1
-    candidate and a pipeline split (params spread across stages) wins."""
+    """THE acceptance pin: a tight HBM cap provably flips mixes. 4e7 param
+    bytes total: pure dp prices ~25.3 ms with room (ring ~1.3 ms) but one
+    chip must hold weights + grads + sharded opt = 2.25 x 4e7 = 9e7 bytes,
+    so a 6e7 cap kills every pp=1 candidate; a pipeline split (params
+    spread across stages) wins both ways — since the searched-timetable PR
+    its steady-priced ZB-H2 schedule outruns dp even when memory is
+    roomy."""
     times, params, acts = [3.0] * 4, [1e7] * 4, [1e5] * 4
     roomy = solve_plan(chain_graph(times, params, acts), 4, 8, 8,
                        HardwareModel(hbm_bytes=64 * 1024**3))
-    assert roomy.winner.pp == 1 and roomy.winner.dp == 4
+    assert roomy.winner.pp == 2 and roomy.winner.schedule == "zero-bubble-h2"
+    roomy_dp = [c for c in roomy.candidates if c.pp == 1 and c.tp == 1]
+    assert any(c.feasible for c in roomy_dp)
 
     capped = solve_plan(chain_graph(times, params, acts), 4, 8, 8,
                         HardwareModel(hbm_bytes=6e7))
@@ -74,6 +86,35 @@ def test_memory_cap_flips_mix():
     assert all("HBM" in c.reason for c in dp_rows)
     # peak bytes are recorded for the winner and stay under the cap
     assert 0 < capped.winner.peak_bytes_per_chip <= 6e7
+
+
+def test_hbm_cap_rejects_h2_stash_and_flips_schedule():
+    """The ISSUE 18 planner pin: ZB-H2's deferred tail is priced into
+    stage memory (stash_bytes = one extra in-flight microbatch's boundary
+    activations per stash slot). Activation-dominated fixture: with a
+    roomy cap the steady-priced ZB-H2 wins and partition.json records
+    what the bubble cost in bytes; a cap between the 1F1B-family peak
+    (6.005e7) and the h2 peak (8.005e7) rejects EXACTLY the stash, and
+    the winner flips to the searched packer at the same mix."""
+    g = chain_graph([3.0] * 4, params=[1e4] * 4, acts=[4e7] * 4)
+    roomy = solve_plan(g, 4, 8, 8, HardwareModel(hbm_bytes=64 * 1024**3))
+    w = roomy.winner
+    assert (w.pp, w.dp, w.schedule) == (2, 2, "zero-bubble-h2")
+    assert w.stash_bytes == pytest.approx(2e7)
+    assert w.as_record()["stash_bytes"] == pytest.approx(2e7)
+    assert w.peak_bytes_per_chip == pytest.approx(8.005e7)
+    zb = next(c for c in roomy.candidates
+              if (c.pp, c.dp, c.schedule) == (2, 2, "zero-bubble"))
+    assert zb.stash_bytes == 0.0
+    assert w.step_time_ms < zb.step_time_ms  # the stash bought real time
+
+    capped = solve_plan(g, 4, 8, 8, HardwareModel(hbm_bytes=7e7))
+    h2 = next(c for c in capped.candidates
+              if (c.pp, c.dp, c.schedule) == (2, 2, "zero-bubble-h2"))
+    assert not h2.feasible and "HBM" in h2.reason
+    cw = capped.winner
+    assert (cw.pp, cw.dp, cw.schedule) == (2, 2, "searched")
+    assert cw.peak_bytes_per_chip <= 7e7
 
 
 def test_uneven_costs_force_unbalanced_split():
