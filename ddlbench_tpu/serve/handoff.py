@@ -169,6 +169,22 @@ class DisaggregatedServer:
         ev["fleet"] = "decode"
         return ev
 
+    # -- autoscale (serve/autoscaler.py attaches per fleet) ----------------
+
+    def controllers(self, policy, start: float = 0.0):
+        """Per-fleet autoscale controllers: prefill and decode have
+        opposite hardware appetites, so they scale INDEPENDENTLY — each
+        fleet gets its own FleetController reading its own signals,
+        clamped to the same [lo, hi] band. (A decode-side kill repairs on
+        the decode fleet even though its displaced requests re-enter via
+        the prefill dispatcher: the dead capacity was decode capacity.)"""
+        from ddlbench_tpu.serve.autoscaler import FleetController
+
+        return [FleetController(self.prefill, policy, name="prefill",
+                                start=start),
+                FleetController(self.decode, policy, name="decode",
+                                start=start)]
+
     # -- record/event surfaces (servebench/servechaos read these) ----------
 
     @property

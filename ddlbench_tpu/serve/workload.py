@@ -26,6 +26,16 @@ Arrival processes:
 * ``bursty``  — square-wave-modulated Poisson: requests arrive in groups of
   ``burst_size`` at ``rate * burst_factor``, with the gaps between groups
   at ``rate / burst_factor`` (open loop with queue-building bursts).
+
+Traffic SHAPES (``shape=`` on top of ``poisson``, for the autoscaler A/B):
+``diurnal`` (raised-cosine rate curve — trough/peak/trough, the daily load
+cycle), ``ramp`` (linear ramp from trough to peak), ``spike`` (flat
+baseline with a short high-multiplier flash crowd mid-run). The shaped
+arrival uniforms come from a SEPARATE seeded stream
+(``Random(f"{seed}:shape")``, the tier-mix pattern), so the main stream
+never sees them: prompts and output lengths are bitwise-identical across
+every ``shape=`` value at a fixed seed — an autoscale-vs-static A/B
+differs only in WHEN requests arrive, never in WHAT they ask.
 """
 
 from __future__ import annotations
@@ -38,6 +48,10 @@ from typing import List, Optional
 import numpy as np
 
 ARRIVALS = ("closed", "poisson", "bursty")
+
+# rate-curve shapes layered on the poisson process (serve/autoscaler.py's
+# traffic fixtures); see _shape_factor for the exact curves
+SHAPES = ("diurnal", "ramp", "spike")
 
 
 TIERS = ("interactive", "batch")
@@ -91,8 +105,28 @@ def heavy_tail_length(rng: random.Random, lo: int, typical: int, hi: int,
     return lo + int(rng.random() * (typical - lo + 1))
 
 
+def _shape_factor(shape: str, i: int, n: int) -> float:
+    """Arrival-rate multiplier for request ``i`` of ``n`` under a traffic
+    shape. Peak multiplier is 1.0 (so ``rate`` stays the peak rate and a
+    shaped run never arrives faster than the plain poisson run at the
+    same ``rate``); troughs bottom out at 0.15 to keep inter-arrivals
+    finite. ``spike`` is the adversarial fixture: a 6.67x flash crowd
+    over 15% of the run — steeper than one controller cooldown can
+    track, which is exactly where the autoscaler loses (PERF.md)."""
+    x = i / max(1, n - 1)
+    if shape == "diurnal":
+        # raised cosine: trough at both ends, peak mid-run
+        return 0.15 + 0.85 * 0.5 * (1.0 - math.cos(2.0 * math.pi * x))
+    if shape == "ramp":
+        return 0.15 + 0.85 * x
+    if shape == "spike":
+        return 1.0 if 0.45 <= x < 0.60 else 0.15
+    raise ValueError(f"shape must be one of {SHAPES}, got {shape!r}")
+
+
 def make_workload(*, seed: int, n_requests: int, vocab: int,
                   arrival: str = "poisson", rate: float = 0.5,
+                  shape: Optional[str] = None,
                   burst_size: int = 8, burst_factor: float = 4.0,
                   prompt_lo: int = 4, prompt_typical: int = 16,
                   prompt_hi: int = 64, out_lo: int = 2, out_typical: int = 16,
@@ -130,9 +164,24 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
     tiered-vs-plain A/B differs only in the labels. Interactive traffic
     admits ahead of batch and batch is the preemptible lane
     (serve/engine.py).
+
+    TRAFFIC SHAPES (``shape``, poisson only): the inter-arrival draw moves
+    to its own ``Random(f"{seed}:shape")`` stream and is scaled by the
+    shape's rate curve (``_shape_factor``). Because the main stream stops
+    drawing arrivals entirely, prompts/lengths are bitwise-identical
+    across all three shape values at a fixed seed — the property the
+    autoscaler A/B pins ride on.
     """
     if arrival not in ARRIVALS:
         raise ValueError(f"arrival must be one of {ARRIVALS}, got {arrival!r}")
+    if shape is not None:
+        if shape not in SHAPES:
+            raise ValueError(
+                f"shape must be one of {SHAPES}, got {shape!r}")
+        if arrival != "poisson":
+            raise ValueError(
+                "traffic shapes modulate the poisson process; "
+                f"pass arrival='poisson' (got {arrival!r})")
     if prefix_groups < 0 or prefix_len < 0:
         raise ValueError("prefix_groups and prefix_len must be >= 0")
     if deadline_slack is not None and deadline_slack <= 0:
@@ -152,6 +201,9 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
     # tiers ride their own stream so a tier-mix A/B keeps the exact same
     # prompts/arrivals (and batch_frac=0 consumes nothing anywhere)
     trng = random.Random(f"{seed}:tier")
+    # shaped arrivals likewise ride their own stream (shape=None consumes
+    # nothing from it), so the prompt/length draws below are untouched
+    srng = random.Random(f"{seed}:shape")
     prefixes = [
         np.array([rng.randrange(vocab) for _ in range(prefix_len)], np.int32)
         for _ in range(prefix_groups)
@@ -183,7 +235,11 @@ def make_workload(*, seed: int, n_requests: int, vocab: int,
                 [rng.randrange(vocab) for _ in range(s)], np.int32)
         when: Optional[float] = None
         if arrival == "poisson":
-            t += -math.log(1.0 - rng.random()) / rate
+            if shape is not None:
+                r = rate * _shape_factor(shape, i, n_requests)
+                t += -math.log(1.0 - srng.random()) / r
+            else:
+                t += -math.log(1.0 - rng.random()) / rate
             when = t
         elif arrival == "bursty":
             in_burst = (i // burst_size) % 2 == 0

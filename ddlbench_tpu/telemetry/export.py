@@ -112,6 +112,40 @@ def export_chrome_trace(tracer: Tracer, path: str,
     return sum(1 for e in doc["traceEvents"] if e["ph"] != "M")
 
 
+AUTOSCALE_PREFIX = "autoscale:"
+
+
+def autoscale_decisions(doc: Any) -> List[Dict[str, Any]]:
+    """Pull the autoscaler's decision instants back out of a trace.
+
+    serve/autoscaler.py emits one ``"i"`` instant per actuation
+    (``autoscale:scale_up`` / ``:scale_down`` / ``:repair`` /
+    ``:budget_exhausted``) on an ``autoscale/<fleet>`` track, with the
+    full ledger event — including the triggering signal snapshot — in
+    ``args``. This reducer returns them in trace order as
+    ``{"t": <model passes>, "kind": ..., **args}``, so "why did the
+    fleet resize at t=384?" is answerable from the trace alone.
+    Accepts a live Tracer or an exported trace dict/event list."""
+    out: List[Dict[str, Any]] = []
+    if hasattr(doc, "events"):  # a live telemetry.Tracer
+        for phase, name, t0_ns, _dur, _tid, _tname, args in doc.events():
+            if phase == "i" and name.startswith(AUTOSCALE_PREFIX):
+                out.append({"t": t0_ns / 1e3,
+                            "kind": name[len(AUTOSCALE_PREFIX):],
+                            **(args or {})})
+        return out
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    for e in events:
+        name = str(e.get("name", ""))
+        if e.get("ph") == "i" and name.startswith(AUTOSCALE_PREFIX):
+            # serve traces stamp 1 model pass = 1000 trace-ns, and the
+            # exporter writes ts in us — so ts IS virtual model passes
+            out.append({"t": float(e.get("ts", 0.0)),
+                        "kind": name[len(AUTOSCALE_PREFIX):],
+                        **(e.get("args") or {})})
+    return out
+
+
 def trace_truncation(doc: Any) -> int:
     """Drop count recorded in a trace's metadata block: > 0 means the ring
     overflowed and the OLDEST events are gone. 0 for bare event lists and

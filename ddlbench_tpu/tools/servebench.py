@@ -51,6 +51,18 @@ decode step into a drafted verify pass (token streams bitwise identical
 to greedy; ``spec_accept_rate``/``tokens_per_pass`` report whether the
 traffic's self-similarity paid for it).
 
+Self-healing autoscaler (ISSUE 19): ``--autoscale LO:HI`` puts a
+FleetController (serve/autoscaler.py) in the loop — per-window SLO
+attainment/goodput + shed/timeout/queue signals drive live ``resize()``
+within [LO, HI] clamps (hysteresis, per-direction cooldowns, bounded
+actuation budget), and a dead or heartbeat-drained replica is
+auto-repaired through the factory spawn. ``--shape diurnal|ramp|spike``
+grows the matching traffic curves (prompts bitwise-identical across
+shapes), so the headline A/B is ``--shape diurnal --autoscale 1:N`` vs a
+static ``--replicas N`` fleet: equal goodput, strictly fewer
+replica-hours. The tool exits nonzero if an autoscaled run loses a
+request.
+
 The prefix-cache A/B: ``--shared-prefix G:P`` synthesizes G groups of
 requests sharing a P-token prompt head, and ``--prefix-cache`` lets the
 continuous engine serve cached heads from resident KV pages — compare the
@@ -139,6 +151,21 @@ def parse_retry(spec, perr):
     if retry[0] < 1 or retry[1] < 0:
         perr(f"--retry {spec!r}: N >= 1 and B >= 0")
     return retry
+
+
+def parse_autoscale(spec, perr):
+    """Parse ``--autoscale LO:HI`` (replica clamps for the closed-loop
+    controller) — shared with servechaos. Returns (lo, hi) or None."""
+    if not spec:
+        return None
+    try:
+        lo_s, hi_s = spec.split(":")
+        lohi = (int(lo_s), int(hi_s))
+    except ValueError:
+        perr(f"--autoscale wants LO:HI (min:max replicas), got {spec!r}")
+    if lohi[0] < 1 or lohi[1] < lohi[0]:
+        perr(f"--autoscale {spec!r}: needs 1 <= LO <= HI")
+    return lohi
 
 
 def shed_accounting(requests, completed, shed, timeouts, driver_stats):
@@ -242,12 +269,22 @@ class _Submitter:
         return self.pending[0][0] if self.pending else None
 
 
+def _advance_controllers(controllers, clock: float):
+    """Kick every autoscale controller up to the virtual clock — called
+    after each global step and idle jump so decisions land at
+    deterministic instants (serve/autoscaler.py's driver contract)."""
+    for c in controllers or ():
+        c.advance(clock)
+
+
 def run_open_loop(server, reqs, resizes=None, events=None, retry=None,
-                  deadline_slack=None, driver_stats=None):
+                  deadline_slack=None, driver_stats=None, controllers=None):
     """Release requests at their arrival times; returns the final clock.
     ``events`` is a list of timed ``(at, fn(server, clock))`` injections
     (resizes are sugar for them); ``retry=(N, backoff)`` arms the shed
-    retry policy and ``driver_stats`` (a dict) receives its counters."""
+    retry policy and ``driver_stats`` (a dict) receives its counters;
+    ``controllers`` are autoscale FleetControllers advanced in lockstep
+    with the virtual clock (they resize/repair the fleet live)."""
     clock, i = 0.0, 0
     ev = _merge_events(resizes, events)
     sub = _Submitter(server, retry, deadline_slack, driver_stats)
@@ -271,15 +308,19 @@ def run_open_loop(server, reqs, resizes=None, events=None, retry=None,
             if not nxts:
                 break
             clock = max(clock, min(nxts))
+            # the controller sees idle time too — that is where the
+            # diurnal trough's scale-downs come from
+            _advance_controllers(controllers, clock)
             continue
         rep = server.step(clock)
         clock += rep.cost
+        _advance_controllers(controllers, clock)
     return clock
 
 
 def run_closed_loop(server, reqs, concurrency: int, resizes=None,
                     events=None, retry=None, deadline_slack=None,
-                    driver_stats=None):
+                    driver_stats=None, controllers=None):
     """Keep ``concurrency`` requests in flight; each TERMINAL event —
     completion, timeout, or a shed request exhausting its retries —
     releases the next. Returns the final clock."""
@@ -314,6 +355,7 @@ def run_closed_loop(server, reqs, concurrency: int, resizes=None,
                     if t is not None]
             if nxts:
                 clock = max(clock, min(nxts))
+                _advance_controllers(controllers, clock)
                 continue
             if outstanding:
                 # a server-INTERNAL shed (failover/drain/resize under
@@ -330,6 +372,7 @@ def run_closed_loop(server, reqs, concurrency: int, resizes=None,
             break  # everything released went terminal
         rep = server.step(clock)
         clock += rep.cost
+        _advance_controllers(controllers, clock)
         term = len(rep.completed) + len(rep.timed_out)
         done += term
         outstanding -= term
@@ -383,10 +426,42 @@ def main(argv=None) -> int:
                         "and token streams stay bitwise vs an un-resized "
                         "control (pinned); the JSON row gains "
                         "resize_events/requests_lost fields")
+    p.add_argument("--autoscale", default=None, metavar="LO:HI",
+                   help="close the loop: a FleetController "
+                        "(serve/autoscaler.py) watches windowed SLO "
+                        "attainment/goodput + shed/timeout/queue signals "
+                        "and actuates resize() live — scale-up under "
+                        "pressure, scale-down in idle troughs, AUTO-REPAIR "
+                        "of dead/heartbeat-drained replicas through the "
+                        "factory spawn — with the fleet clamped to "
+                        "[LO, HI]. The row gains replica_hours/"
+                        "scale_events/repairs/autoscale_attainment + the "
+                        "decision ledger, and the tool exits nonzero if "
+                        "the run loses a request. Excludes --resize "
+                        "(the controller owns the schedule); with "
+                        "--disaggregate each fleet gets its own "
+                        "controller")
+    p.add_argument("--scale-window", type=float, default=32.0, metavar="W",
+                   help="autoscale observation-window width in time units "
+                        "(one decision per window)")
+    p.add_argument("--scale-cooldown", type=float, default=64.0,
+                   metavar="C",
+                   help="min time between same-direction autoscale "
+                        "actuations (repair is exempt: restoring capacity "
+                        "the policy already chose is not a scale decision)")
     p.add_argument("--arrival", default="poisson",
                    choices=("poisson", "bursty", "closed"))
+    p.add_argument("--shape", default=None,
+                   choices=("diurnal", "ramp", "spike"),
+                   help="traffic shape layered on --arrival poisson: the "
+                        "rate curve (daily cycle / linear ramp / flash "
+                        "crowd) modulates inter-arrivals drawn from a "
+                        "separate seeded stream, so prompts stay bitwise-"
+                        "identical across shapes (the autoscale A/B "
+                        "fixture)")
     p.add_argument("--rate", type=float, default=0.5,
-                   help="open-loop arrival rate (requests per model pass)")
+                   help="open-loop arrival rate (requests per model pass; "
+                        "with --shape, the PEAK rate)")
     p.add_argument("--burst-size", type=int, default=8)
     p.add_argument("--burst-factor", type=float, default=4.0)
     p.add_argument("--concurrency", type=int, default=16,
@@ -542,6 +617,18 @@ def main(argv=None) -> int:
                     f"got {args.shared_prefix!r}")
     retry = parse_retry(args.retry, p.error)
     disagg = parse_disaggregate(args.disaggregate, p.error)
+    autoscale = parse_autoscale(args.autoscale, p.error)
+    if autoscale:
+        if args.resize:
+            p.error("--autoscale closes the resize loop itself; it does "
+                    "not compose with a scripted --resize schedule")
+        if args.scale_window <= 0:
+            p.error("--scale-window must be > 0 time units")
+        if args.scale_cooldown < 0:
+            p.error("--scale-cooldown must be >= 0 time units")
+    if args.shape and args.arrival != "poisson":
+        p.error("--shape modulates the poisson arrival process; pass "
+                "--arrival poisson")
     if args.serve_tp < 1:
         p.error("--serve-tp must be >= 1")
     if disagg:
@@ -590,13 +677,17 @@ def main(argv=None) -> int:
         if temperature <= 0.0:
             p.error("--sample needs temperature:T with T > 0 "
                     "(omit --sample for greedy)")
+    # under --autoscale the INITIAL fleet is --replicas clamped into the
+    # band (start inside the clamps; the controller takes it from there)
+    replicas0 = (max(autoscale[0], min(autoscale[1], args.replicas))
+                 if autoscale else args.replicas)
     base = ServeConfig(
         max_batch=args.max_batch, pool_pages=args.pool_pages,
         page=args.page, max_len=min(args.max_len, spec.seq_len),
         token_budget=args.token_budget,
         prefill_chunk=(args.page if args.prefill_chunk is None
                        else args.prefill_chunk),
-        replicas=args.replicas, tp=args.serve_tp,
+        replicas=replicas0, tp=args.serve_tp,
         temperature=temperature, top_k=top_k,
         sample_seed=args.seed, trace=bool(args.trace),
         slo_ttft=args.slo_ttft, slo_itl=args.slo_itl,
@@ -605,6 +696,7 @@ def main(argv=None) -> int:
         speculative=args.speculative or "none")
 
     shared_fns = None
+    rc = 0
     for policy in policies:
         # the static baseline is cache-off by definition (it measures
         # whole-batch scheduling); its JSON rows still carry the prefix
@@ -618,6 +710,7 @@ def main(argv=None) -> int:
         reqs = make_workload(
             seed=args.seed, n_requests=args.requests,
             vocab=spec.num_classes, arrival=args.arrival, rate=args.rate,
+            shape=args.shape,
             burst_size=args.burst_size, burst_factor=args.burst_factor,
             prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
             out_lo=olo, out_typical=otyp, out_hi=ohi,
@@ -638,6 +731,18 @@ def main(argv=None) -> int:
             server = make_server(model, params, state, cfg,
                                  shared_fns=shared_fns)
         shared_fns = server.engines[0].jit_fns()
+        controllers = None
+        if autoscale:
+            from ddlbench_tpu.serve.autoscaler import (
+                AutoscalePolicy, combined_attainment, make_controllers,
+                replica_hours)
+
+            policy_cfg = AutoscalePolicy(
+                lo=autoscale[0], hi=autoscale[1],
+                window=args.scale_window,
+                cooldown_up=args.scale_cooldown,
+                cooldown_down=args.scale_cooldown)
+            controllers = make_controllers(server, policy_cfg)
         if args.audit:
             # compiled-program audit for this serve layout: every engine
             # shares the compiled programs, so engine[0] speaks for the
@@ -671,12 +776,18 @@ def main(argv=None) -> int:
                 duration = run_closed_loop(server, reqs, args.concurrency,
                                            resizes=resizes, retry=retry,
                                            deadline_slack=args.deadline_slack,
-                                           driver_stats=dstats)
+                                           driver_stats=dstats,
+                                           controllers=controllers)
             else:
                 duration = run_open_loop(server, reqs, resizes=resizes,
                                          retry=retry,
                                          deadline_slack=args.deadline_slack,
-                                         driver_stats=dstats)
+                                         driver_stats=dstats,
+                                         controllers=controllers)
+            if controllers:
+                # settle the ledgers at the final clock (integrates
+                # replica-hours through any trailing idle segment)
+                _advance_controllers(controllers, duration)
         finally:
             if tracer is not None:
                 tracer.disable()
@@ -736,6 +847,9 @@ def main(argv=None) -> int:
             "benchmark": args.benchmark,
             "policy": policy,
             "arrival": args.arrival,
+            # --shape only (plain rows keep the pinned schema): the
+            # traffic rate curve the arrivals followed
+            **({"shape": args.shape} if args.shape else {}),
             "rate": args.rate if args.arrival != "closed" else None,
             "concurrency": (args.concurrency if args.arrival == "closed"
                             else None),
@@ -809,6 +923,24 @@ def main(argv=None) -> int:
                 "final_replicas": len(server.engines),
                 "requests_lost": lost}
                if args.resize else {}),
+            # --autoscale only (plain rows keep the schema-pinned key
+            # set): the closed-loop economics — replica-hours actually
+            # consumed (the static baseline pays replicas * duration),
+            # every decision with its triggering signal, and the
+            # no-loss invariant the tool's exit code gates on
+            **({"autoscale": args.autoscale,
+                "scale_window": args.scale_window,
+                "scale_cooldown": args.scale_cooldown,
+                "replica_hours": round(replica_hours(controllers), 6),
+                "scale_events": sum(c.scale_events for c in controllers),
+                "repairs": sum(c.repairs for c in controllers),
+                "autoscale_attainment": round(
+                    combined_attainment(controllers), 6),
+                "autoscale_events": _round6(
+                    [e for c in controllers for e in c.events]),
+                "final_replicas": len(server.engines),
+                "requests_lost": lost}
+               if autoscale else {}),
             # actual backend record (shared classification —
             # distributed.backend_provenance); cpu-fallback rows must be
             # identifiable as harness validation, not chip numbers
@@ -817,7 +949,15 @@ def main(argv=None) -> int:
         if args.wall_clock:
             rec["wall_s"] = round(wall, 3)
         print(json.dumps(rec), flush=True)
-    return 0
+        if autoscale and lost != 0:
+            # the no-loss gate extends from the chaos tools to the
+            # controller path: a self-scaling fleet that loses requests
+            # is a broken controller, and CI must see it
+            print(f"servebench: FAILED no-loss gate under --autoscale: "
+                  f"requests_lost={lost} on policy {policy}",
+                  file=sys.stderr, flush=True)
+            rc = 1
+    return rc
 
 
 if __name__ == "__main__":

@@ -40,12 +40,22 @@ plus ``requests_lost`` (gate: 0 for failover-covered kills),
 shed/timeout/retry rates, per-tier SLO attainment, heartbeat drains, and
 the final fleet size.
 
+Self-healing (ISSUE 19): ``--autoscale LO:HI`` runs the same faults
+under an ACTIVE FleetController (serve/autoscaler.py) — a killed or
+heartbeat-drained replica is auto-repaired through the factory spawn,
+so MTTR becomes a controller property. The tool then ALSO runs the
+scripted-recovery baseline (same faults, no controller — the PR 15
+behavior) when that schedule survives a non-repairing fleet, and
+reports ``mttr_scripted_*`` next to ``mttr_replica_s*`` plus the
+``repair_mttr_le_scripted`` verdict; the headline gate is
+``requests_lost == 0`` AND auto-repair MTTR <= scripted MTTR.
+
 Usage:
     python -m ddlbench_tpu.tools.servechaos [-m transformer_s]
         [-b synthtext] [--replicas 2] [--kill 12:1] [--stall 8:0:6]
         [--heartbeat 4] [--deadline-slack 32] [--retry 2:4]
-        [--tier-mix 0.5] [--arrival poisson|bursty|closed] [--rate 0.5]
-        [--requests 64] [--no-control] [--platform cpu]
+        [--tier-mix 0.5] [--autoscale 2:2] [--arrival poisson|closed]
+        [--rate 0.5] [--requests 64] [--no-control] [--platform cpu]
 """
 
 from __future__ import annotations
@@ -132,17 +142,36 @@ def _fault_events(kills, stalls):
     return ev
 
 
-def _run(server, reqs, args, retry, events=None, driver_stats=None):
+def _run(server, reqs, args, retry, events=None, driver_stats=None,
+         controllers=None):
     from ddlbench_tpu.tools.servebench import run_closed_loop, run_open_loop
 
     if args.arrival == "closed":
-        return run_closed_loop(server, reqs, args.concurrency,
-                               events=events, retry=retry,
-                               deadline_slack=args.deadline_slack,
-                               driver_stats=driver_stats)
-    return run_open_loop(server, reqs, events=events, retry=retry,
-                         deadline_slack=args.deadline_slack,
-                         driver_stats=driver_stats)
+        dur = run_closed_loop(server, reqs, args.concurrency,
+                              events=events, retry=retry,
+                              deadline_slack=args.deadline_slack,
+                              driver_stats=driver_stats,
+                              controllers=controllers)
+    else:
+        dur = run_open_loop(server, reqs, events=events, retry=retry,
+                            deadline_slack=args.deadline_slack,
+                            driver_stats=driver_stats,
+                            controllers=controllers)
+    for c in controllers or ():
+        c.advance(dur)  # settle ledgers/replica-hours at the final clock
+    return dur
+
+
+def _static_walk_ok(kills, sizes):
+    """Would this kill schedule survive on a fleet with NO repair (every
+    kill permanently shrinks its fleet)? — the feasibility check for the
+    scripted-recovery baseline run under --autoscale."""
+    sizes = dict(sizes)
+    for t, fleet, r in sorted(kills, key=lambda k: k[0]):
+        if sizes[fleet] <= 1 or r >= sizes[fleet]:
+            return False
+        sizes[fleet] -= 1
+    return True
 
 
 def mttr_from_events(fail_events, finished):
@@ -222,6 +251,23 @@ def main(argv=None) -> int:
                    choices=("float32", "bfloat16", "int8"))
     p.add_argument("--speculative", default=None, metavar="ngram:N:K")
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--autoscale", default=None, metavar="LO:HI",
+                   help="run the faults under an ACTIVE FleetController "
+                        "(serve/autoscaler.py): a killed or heartbeat-"
+                        "drained replica is auto-repaired through the "
+                        "factory spawn, so MTTR is a controller property. "
+                        "Adds a scripted-recovery BASELINE run (same "
+                        "faults, no controller — the PR 15 behavior) when "
+                        "the schedule survives a non-repairing fleet; the "
+                        "row gains repairs/replica_hours/autoscale_events "
+                        "+ mttr_scripted_* and the repair-vs-scripted "
+                        "MTTR verdict")
+    p.add_argument("--scale-window", type=float, default=32.0, metavar="W",
+                   help="autoscale observation-window width in time units")
+    p.add_argument("--scale-cooldown", type=float, default=64.0,
+                   metavar="C",
+                   help="min time between same-direction scale actuations "
+                        "(repairs are exempt)")
     p.add_argument("--no-control", action="store_true",
                    help="skip the unfaulted control run (streams_match "
                         "reported as null)")
@@ -230,13 +276,20 @@ def main(argv=None) -> int:
 
     add_platform_arg(p)
     args = p.parse_args(argv)
-    from ddlbench_tpu.tools.servebench import (parse_disaggregate,
+    from ddlbench_tpu.tools.servebench import (parse_autoscale,
+                                               parse_disaggregate,
                                                parse_retry)
 
     disagg = parse_disaggregate(args.disaggregate, p.error)
     kills = _parse_kills(args.kill, p.error, disagg=bool(disagg))
     stalls = _parse_stalls(args.stall, p.error)
     retry = parse_retry(args.retry, p.error)
+    autoscale = parse_autoscale(args.autoscale, p.error)
+    if autoscale:
+        if args.scale_window <= 0:
+            p.error("--scale-window must be > 0 time units")
+        if args.scale_cooldown < 0:
+            p.error("--scale-cooldown must be >= 0 time units")
     if disagg and stalls:
         p.error("--stall addresses one aggregated fleet; it does not "
                 "compose with --disaggregate")
@@ -258,31 +311,50 @@ def main(argv=None) -> int:
     # index at runtime — fail() raises loudly in that case)
     sizes = ({"p": disagg[0], "d": disagg[1]} if disagg
              else {None: args.replicas})
-    # sort by time ONLY (stable): equal-time kills fire in spec order at
-    # runtime, and tuple-sorting by (t, index) would walk a different
-    # order and falsely reject e.g. `--kill 5:2 --kill 5:0`
-    for t, fleet, r in sorted(kills, key=lambda k: k[0]):
-        name = {"p": "prefill ", "d": "decode "}.get(fleet, "")
-        if sizes[fleet] <= 1:
-            # a decode fleet must also keep a survivor: its pages can be
-            # regenerated via the prefill fleet, but ships need at least
-            # one live decode replica to bind into
-            p.error(f"--kill {t:g}:{fleet or ''}{r}: the {name}fleet is "
-                    f"already down to its last replica by t={t:g}")
-        if r >= sizes[fleet]:
-            p.error(f"--kill {t:g}:{fleet or ''}{r}: {name}fleet index "
-                    f"{r} out of range — at most {sizes[fleet]} replicas "
-                    f"remain by t={t:g}")
-        sizes[fleet] -= 1
-    for t, r, d in stalls:
-        # a stall's valid indices also shrink with every kill that fires
-        # before (or, by the event sort's kill-first tie-break, at) it
-        size_at_t = args.replicas - sum(1 for kt, _, _ in kills
-                                        if kt <= t)
-        if r >= size_at_t:
-            p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of range "
-                    f"— at most {size_at_t} replicas remain by t={t:g} "
-                    f"({args.replicas} replicas, kills before it)")
+    scripted_ok = _static_walk_ok(kills, sizes)
+    if not autoscale:
+        # sort by time ONLY (stable): equal-time kills fire in spec order
+        # at runtime, and tuple-sorting by (t, index) would walk a
+        # different order and falsely reject e.g. `--kill 5:2 --kill 5:0`
+        for t, fleet, r in sorted(kills, key=lambda k: k[0]):
+            name = {"p": "prefill ", "d": "decode "}.get(fleet, "")
+            if sizes[fleet] <= 1:
+                # a decode fleet must also keep a survivor: its pages can
+                # be regenerated via the prefill fleet, but ships need at
+                # least one live decode replica to bind into
+                p.error(f"--kill {t:g}:{fleet or ''}{r}: the {name}fleet "
+                        f"is already down to its last replica by t={t:g}")
+            if r >= sizes[fleet]:
+                p.error(f"--kill {t:g}:{fleet or ''}{r}: {name}fleet "
+                        f"index {r} out of range — at most {sizes[fleet]} "
+                        f"replicas remain by t={t:g}")
+            sizes[fleet] -= 1
+        for t, r, d in stalls:
+            # a stall's valid indices also shrink with every kill that
+            # fires before (or, by the event sort's kill-first tie-break,
+            # at) it
+            size_at_t = args.replicas - sum(1 for kt, _, _ in kills
+                                            if kt <= t)
+            if r >= size_at_t:
+                p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of "
+                        f"range — at most {size_at_t} replicas remain by "
+                        f"t={t:g} ({args.replicas} replicas, kills before "
+                        f"it)")
+    else:
+        # under a repairing controller the fleet RE-GROWS between faults,
+        # so the shrink-walk above is wrong; each spec just has to address
+        # the full fleet (a too-fast second kill that beats its repair
+        # still fails loudly at fire time — fail() raises)
+        for t, fleet, r in kills:
+            if r >= sizes[fleet]:
+                name = {"p": "prefill ", "d": "decode "}.get(fleet, "")
+                p.error(f"--kill {t:g}:{fleet or ''}{r}: {name}fleet "
+                        f"index {r} out of range for a {sizes[fleet]}-"
+                        f"replica fleet")
+        for t, r, d in stalls:
+            if r >= args.replicas:
+                p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of "
+                        f"range for a {args.replicas}-replica fleet")
     if stalls and not args.heartbeat:
         print("servechaos: WARNING --stall without --heartbeat: the "
               "straggler is never detected, its requests just wait it "
@@ -358,12 +430,41 @@ def main(argv=None) -> int:
         control = build(None)
         shared_fns = control.engines[0].jit_fns()
         _run(control, workload(), args, retry)
+    # -- scripted-recovery baseline (--autoscale only): the SAME faults
+    # with NO controller — the PR 15 behavior where a killed replica
+    # stays dead — so the headline "auto-repair MTTR <= scripted MTTR"
+    # is measured in-run, against the identical workload and compiles
+    scripted_mttrs = None
+    if autoscale and kills:
+        if scripted_ok:
+            baseline = build(shared_fns)
+            shared_fns = baseline.engines[0].jit_fns()
+            _run(baseline, workload(), args, retry,
+                 events=_fault_events(kills, stalls))
+            scripted_mttrs = mttr_from_events(baseline.fail_events,
+                                              baseline.finished)
+        else:
+            print("servechaos: NOTE kill schedule needs the controller's "
+                  "repairs to stay feasible; skipping the scripted-"
+                  "recovery baseline (mttr_scripted_* reported as null)",
+                  file=sys.stderr, flush=True)
     # -- the chaos run
     server = build(shared_fns)
+    controllers = None
+    if autoscale:
+        from ddlbench_tpu.serve.autoscaler import (AutoscalePolicy,
+                                                   make_controllers,
+                                                   replica_hours)
+
+        pol = AutoscalePolicy(lo=autoscale[0], hi=autoscale[1],
+                              window=args.scale_window,
+                              cooldown_up=args.scale_cooldown,
+                              cooldown_down=args.scale_cooldown)
+        controllers = make_controllers(server, pol)
     dstats = {}
     duration = _run(server, workload(), args, retry,
                     events=_fault_events(kills, stalls),
-                    driver_stats=dstats)
+                    driver_stats=dstats, controllers=controllers)
     wall = time.perf_counter() - t0
 
     fin = server.finished
@@ -371,13 +472,21 @@ def main(argv=None) -> int:
     summary = serve_summary(fin, duration=duration, slo_ttft=args.slo_ttft,
                             slo_itl=args.slo_itl,
                             per_tier=args.tier_mix is not None)
-    from ddlbench_tpu.tools.servebench import shed_accounting
+    from ddlbench_tpu.tools.servebench import _round6, shed_accounting
 
     acct = shed_accounting(args.requests, len(fin),
                            int(eng_stats["shed"]),
                            int(eng_stats["timeouts"]), dstats)
     mttrs = mttr_from_events(server.fail_events, fin)
     mttr_ok = [m for m in mttrs if m is not None]
+    # the headline repair verdict: mean auto-repair MTTR vs the
+    # scripted-recovery baseline's (None when either side has no sample)
+    scripted_ok_mttrs = [m for m in (scripted_mttrs or []) if m is not None]
+    repair_le_scripted = None
+    if mttr_ok and scripted_ok_mttrs:
+        repair_le_scripted = (sum(mttr_ok) / len(mttr_ok)
+                              <= sum(scripted_ok_mttrs)
+                              / len(scripted_ok_mttrs))
     # bitwise failover gate: every rid completed in BOTH runs must carry
     # the identical token stream; the compared set is the intersection
     # (deadline runs can legitimately time out different rids per run)
@@ -445,6 +554,27 @@ def main(argv=None) -> int:
         "control_completed": (len(control.finished)
                               if control is not None else None),
         "final_replicas": len(server.engines),
+        # --autoscale only: the controller's repair ledger + economics,
+        # the scripted-recovery baseline MTTRs (PR 15 behavior, same
+        # faults, no controller), and the repair-vs-scripted verdict
+        **({"autoscale": args.autoscale,
+            "scale_window": args.scale_window,
+            "scale_cooldown": args.scale_cooldown,
+            "repairs": sum(c.repairs for c in controllers),
+            "scale_events": sum(c.scale_events for c in controllers),
+            "replica_hours": round(replica_hours(controllers), 6),
+            "autoscale_events": _round6(
+                [e for c in controllers for e in c.events]),
+            "mttr_scripted_s": (None if scripted_mttrs is None else
+                                [m if m is None else round(m, 6)
+                                 for m in scripted_mttrs]),
+            "mttr_scripted_s_mean": (round(sum(scripted_ok_mttrs)
+                                           / len(scripted_ok_mttrs), 6)
+                                     if scripted_ok_mttrs else None),
+            "mttr_scripted_s_max": (round(max(scripted_ok_mttrs), 6)
+                                    if scripted_ok_mttrs else None),
+            "repair_mttr_le_scripted": repair_le_scripted}
+           if autoscale else {}),
         **{k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in summary.items()},
         # completed comes from serve_summary; timeouts/shed are already
