@@ -1145,6 +1145,23 @@ class ServeConfig:
     # into the attention kernels/references. Output quality is pinned by
     # an accparity-style digits gate (tests/test_serve_quant.py).
     kv_dtype: str = "float32"
+    # silent-data-corruption defense (serve/integrity.py): when True the
+    # engine keeps a host-side crc32c ledger over every pool page's
+    # payload + sidecar rows, stamped at the pool-write boundary and
+    # verified at every trust boundary (handoff export/import, COW
+    # source pages, prefix-hit binds, eviction-recompute). A mismatch
+    # quarantines the slot (excluded from allocation for the rest of
+    # the run) and recovers every referencing request through the
+    # existing re-prefill path, which regenerates pages byte-identically
+    # — so detected corruption never reaches a token stream. Off (the
+    # default) is bitwise the pre-SDC engine: no ledger, no checks.
+    integrity: bool = False
+    # background scrub budget: verify up to this many resident stamped
+    # pages per step (round-robin cursor), catching latent corruption on
+    # cold prefix pages before a full-hit serves them. 0 disables the
+    # scrubber; > 0 requires integrity (there is no ledger to check
+    # against otherwise).
+    scrub: int = 0
     # self-drafting speculative decoding: "none" (every decode pass emits
     # one token per row) or "ngram:N:K" — a host-side N-gram drafter
     # proposes up to K tokens per decode row from the row's own emitted
@@ -1230,6 +1247,14 @@ class ServeConfig:
             raise ValueError(
                 f"heartbeat must be >= 0 time units (0 disables straggler "
                 f"detection), got {self.heartbeat}")
+        if self.scrub < 0:
+            raise ValueError(
+                f"scrub must be >= 0 pages/step (0 disables the "
+                f"scrubber), got {self.scrub}")
+        if self.scrub and not self.integrity:
+            raise ValueError(
+                "scrub without integrity has no checksum ledger to "
+                "verify against — enable integrity or drop scrub")
         if self.kv_dtype not in ("float32", "bfloat16", "int8"):
             raise ValueError(
                 f"kv_dtype must be float32|bfloat16|int8, got "

@@ -494,6 +494,17 @@ def pool_page_bytes(pool, page_axis: int = 0) -> int:
     return total
 
 
+def pool_checksum_keys(pool) -> tuple:
+    """Keys of ``pool`` covered by the SDC checksum ledger
+    (serve/integrity.py): every per-slot array the three table-write
+    primitives scatter — payload rows plus the quantized scale sidecars
+    — in sorted order (the deterministic CRC chain order). The 0-dim
+    ``kv_seed`` scalar is excluded: it is not per-slot state and no
+    write primitive touches it."""
+    return tuple(sorted(
+        k for k, v in pool.items() if getattr(v, "ndim", 0)))
+
+
 def serve_pool_init(n_pages: int, page: int, n_heads: int, dh: int, dtype):
     """A shared K/V pool of ``n_pages`` free-list-managed slots (slot 0 is
     the scratch page — serve/allocator.py never hands it out). ``dtype``

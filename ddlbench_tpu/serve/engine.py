@@ -126,7 +126,7 @@ from __future__ import annotations
 import dataclasses
 import random
 from collections import deque
-from typing import Any, Dict, List, Optional
+from typing import Any, Dict, List, Optional, Tuple
 
 import numpy as np
 
@@ -134,6 +134,11 @@ from ddlbench_tpu.config import ServeConfig
 from ddlbench_tpu.models.layers import LayerModel
 from ddlbench_tpu.serve.allocator import PageAllocator
 from ddlbench_tpu.serve.draft import NgramDrafter
+from ddlbench_tpu.serve.integrity import (
+    PageLedger,
+    page_checksum,
+    ship_checksums,
+)
 from ddlbench_tpu.serve.prefix import PrefixIndex
 from ddlbench_tpu.serve.workload import TIERS, ServeRequest
 from ddlbench_tpu.telemetry.stats import request_slo_ok
@@ -365,6 +370,26 @@ class ServeEngine:
         # on a replica that still holds work is the straggler verdict
         self.monitor: Optional[ProgressMonitor] = (
             ProgressMonitor(cfg.heartbeat) if cfg.heartbeat > 0 else None)
+        # -- SDC defense state (ISSUE 20; serve/integrity.py). With
+        # cfg.integrity off there is NO ledger, no stamps, no verifies —
+        # scheduling and streams are bitwise the pre-SDC engine.
+        self.integrity: Optional[PageLedger] = (
+            PageLedger() if cfg.integrity else None)
+        # detection/quarantine ledger (t/slot/where/displaced rids) —
+        # servechaos derives MTTD and quarantine-MTTR from these
+        self.sdc_events: List[Dict[str, Any]] = []
+        self._scrub_cursor = 0
+        # eviction-recompute re-derivation expectations: rid -> {(layer,
+        # page_idx): crc} of the FULLY-written prompt pages at eviction —
+        # the replayed prefill must regenerate the same bytes (the
+        # byte-identical re-prefill invariant, now checked, not assumed)
+        self._recompute_expect: Dict[int, Dict[Tuple[int, int], int]] = {}
+        if self.integrity is not None:
+            # a slot physically returning to the free list invalidates
+            # its ledger entries (the next tenant re-stamps at its own
+            # write) — without this, the scrubber would flag every
+            # legitimate reuse as corruption
+            self.allocator.on_slot_free = self.integrity.drop_slot
         self.stats: Dict[str, float] = {
             "steps": 0, "model_calls": 0, "prefill_calls": 0,
             "decode_calls": 0, "decode_row_slots": 0, "admitted": 0,
@@ -384,6 +409,12 @@ class ServeEngine:
             # tokens-per-pass numerator; prefill first tokens excluded)
             "spec_passes": 0, "spec_drafted": 0, "spec_accepted": 0,
             "decode_tokens": 0,
+            # SDC-defense counters (always present — integrity-off runs
+            # report 0; servebench gates them out of plain rows via
+            # _SDC_FIELDS, the _CHAOS_FIELDS pattern)
+            "sdc_injected": 0, "sdc_detected": 0, "sdc_quarantined": 0,
+            "sdc_recovered": 0, "sdc_scrubbed": 0,
+            "sdc_recompute_checks": 0,
         }
         if shared_fns is not None:
             # replicas of one server share the jitted callables (same model
@@ -802,6 +833,152 @@ class ServeEngine:
                                 rid, token_index)
         return int(raw)
 
+    # -- SDC defense: stamp / verify / quarantine (serve/integrity.py) -----
+
+    def _slot_crc(self, li: int, slot: int) -> int:
+        """Checksum of (layer, slot)'s current device bytes: payload +
+        sidecar rows fetched to host, chained in sorted key order (the
+        ops/paged_decode.pool_checksum_keys domain)."""
+        pool = self.pools[li]
+        rows = {k: np.asarray(v[slot] if self._page_axis == 0
+                              else v[:, slot])
+                for k, v in pool.items() if getattr(v, "ndim", 0)}
+        return page_checksum(rows)
+
+    def _stamp_slot(self, slot: int) -> None:
+        """Stamp every serving layer's ledger entry for ``slot`` from the
+        bytes just written — the pool-write-boundary hook."""
+        for li, pool in enumerate(self.pools):
+            if pool is None:
+                continue
+            self.integrity.stamp(li, slot, self._slot_crc(li, slot))
+
+    def _verify_slot(self, slot: int, where: str,
+                     rep: Optional[StepReport] = None) -> bool:
+        """Trust-boundary check of ``slot`` against the ledger. True =
+        intact (or never stamped — unwritten pages carry no expectation);
+        on any layer mismatch the slot is quarantined, every holder is
+        recovered, and False returns — the caller must not serve it."""
+        for li, pool in enumerate(self.pools):
+            if pool is None:
+                continue
+            if self.integrity.verify(li, slot,
+                                     self._slot_crc(li, slot)) is False:
+                self._quarantine_slot(slot, where, rep)
+                return False
+        return True
+
+    def _quarantine_slot(self, slot: int, where: str,
+                         rep: Optional[StepReport] = None) -> None:
+        """Detection -> quarantine -> recovery: pull the slot out of
+        circulation for good, purge its prefix-index entry, and EVICT
+        every request referencing it (a corrupted SHARED page walks its
+        refcounts) onto the existing recompute path — re-prefill
+        regenerates pages byte-identically and a recovered request's
+        FULL stream regenerates from scratch, so final token streams
+        stay bitwise vs an unfaulted control."""
+        if rep is None:
+            rep = StepReport()  # detections outside step() (export path)
+        holders = self.allocator.holders(slot)
+        self.allocator.quarantine(slot)
+        if self.prefix is not None:
+            self.prefix.drop_slot(slot)
+        displaced: List[int] = []
+        for rid in holders:
+            victim = next((x for x in self._active()
+                           if x.req.rid == rid), None)
+            if victim is not None and self.rows[victim.row] is victim:
+                self._evict(victim, rep)
+                displaced.append(rid)
+        self.integrity.drop_slot(slot)
+        self.stats["sdc_detected"] += 1
+        self.stats["sdc_quarantined"] += 1
+        self.stats["sdc_recovered"] += len(displaced)
+        self.sdc_events.append({"t": self._now, "slot": int(slot),
+                                "where": where, "displaced": displaced})
+        self._sdc_trace("detect", slot=int(slot), where=where)
+        self._sdc_trace("quarantine", slot=int(slot),
+                        displaced=len(displaced))
+
+    def _sdc_trace(self, kind: str, **args: Any) -> None:
+        """``sdc:*`` instants on the replica's sdc track — the
+        telemetry/export.py ``sdc_events`` reducer collects them."""
+        tr = self._tr()
+        if tr is not None:
+            tr.emit("i", f"sdc:{kind}", _vns(self._now),
+                    track=f"{self._trk}/sdc", args=args)
+
+    def _capture_recompute_expect(self, victim: "_Active") -> None:
+        """At eviction, snapshot the ledger CRCs of the victim's FULLY
+        prefilled prompt pages: the recompute replay's chunk writes must
+        regenerate exactly these bytes (checked in
+        :meth:`_stamp_prefill_pages` — the byte-identical re-prefill
+        invariant, verified instead of assumed)."""
+        exp: Dict[Tuple[int, int], int] = {}
+        full = min(victim.prefill_done, victim.req.prompt_len) // self.page
+        for idx in range(full):
+            slot = int(self.table[victim.row, idx])
+            if not slot:
+                continue
+            for li, pool in enumerate(self.pools):
+                if pool is None:
+                    continue
+                crc = self.integrity.expected(li, slot)
+                if crc is not None:
+                    exp[(li, idx)] = crc
+        if exp:
+            self._recompute_expect[victim.req.rid] = exp
+
+    def _stamp_prefill_pages(self, a: "_Active", start: int,
+                             end_real: int) -> None:
+        """Stamp the pages a prefill chunk wrote ([start, end_real) plus
+        the padded tail inside the last allocated page) and check every
+        FULLY rewritten page against any eviction-recompute
+        expectation."""
+        exp = self._recompute_expect.get(a.req.rid)
+        full_end = end_real // self.page
+        for idx in range(start // self.page, self._pages_for(end_real)):
+            slot = int(self.table[a.row, idx])
+            if not slot:
+                continue
+            for li, pool in enumerate(self.pools):
+                if pool is None:
+                    continue
+                crc = self._slot_crc(li, slot)
+                self.integrity.stamp(li, slot, crc)
+                if exp is None or idx >= full_end:
+                    continue
+                want = exp.pop((li, idx), None)
+                if want is None:
+                    continue
+                self.stats["sdc_recompute_checks"] += 1
+                if crc != want:
+                    # the replay did NOT regenerate the original bytes —
+                    # either the original write was already corrupt or
+                    # re-derivation determinism broke. Recorded as a
+                    # detection, not quarantined: the fresh bytes are the
+                    # re-derived truth.
+                    self.stats["sdc_detected"] += 1
+                    self.sdc_events.append({
+                        "t": self._now, "slot": slot,
+                        "where": "recompute", "displaced": []})
+                    self._sdc_trace("recompute_mismatch", slot=slot,
+                                    layer=li, page=idx)
+
+    def _scrub(self, rep: StepReport) -> None:
+        """Budgeted background scrubber: verify up to ``cfg.scrub``
+        stamped slots per step, round-robin over the sorted stamped-slot
+        list — latent corruption on cold prefix pages is caught before a
+        full-hit (or a ship) can serve it."""
+        for _ in range(self.cfg.scrub):
+            slots = self.integrity.stamped_slots()
+            if not slots:
+                return
+            slot = slots[self._scrub_cursor % len(slots)]
+            self._scrub_cursor += 1
+            self.stats["sdc_scrubbed"] += 1
+            self._verify_slot(slot, "scrub", rep)
+
     # -- allocation under pool pressure ------------------------------------
 
     def _alloc(self, rid: int, n: int) -> Optional[List[int]]:
@@ -958,6 +1135,10 @@ class ServeEngine:
     def _evict(self, victim: _Active, rep: StepReport) -> None:
         """Free the victim's pages and re-queue it (front) for
         recomputation — greedy decode regenerates the same tokens."""
+        if self.integrity is not None:
+            # snapshot BEFORE the frees drop the ledger entries: the
+            # recompute replay is checked against these CRCs
+            self._capture_recompute_expect(victim)
         self.allocator.free_request(victim.req.rid)
         self.table[victim.row, :] = 0
         self.rows[victim.row] = None
@@ -1024,6 +1205,7 @@ class ServeEngine:
         })
         rep.completed.append(a.req.rid)
         self.stats["completed"] += 1
+        self._recompute_expect.pop(a.req.rid, None)
         tr = self._tr()
         if tr is not None:
             f = self.finished[-1]
@@ -1046,6 +1228,7 @@ class ServeEngine:
         self._queued_at.pop(rid, None)
         self._evicted_rids.discard(rid)
         self._cached_tokens.pop(rid, None)
+        self._recompute_expect.pop(rid, None)
         tr = self._tr()
         if tr is not None:
             tr.emit("i", "timeout", _vns(now), track=self._req_track(rid),
@@ -1147,6 +1330,16 @@ class ServeEngine:
         token costs one decode pass."""
         S = req.prompt_len
         nblk = S // self.page
+        # trust boundary: a full hit serves these pages WITHOUT any
+        # recompute — verify before binding (a stale corrupted cold page
+        # is exactly what the scrubber and this check exist for). On a
+        # mismatch the slot quarantines (its index entry purged, holders
+        # recovered) and the admission bails: the next step's match
+        # misses the purged block and takes the prefill path.
+        if self.integrity is not None:
+            for s in hit[:nblk]:
+                if not self._verify_slot(int(s), "prefix_hit", rep):
+                    return None
         # pin every matched page (including the COW source) before
         # allocating: _alloc's cache reclaim frees index-only pages, and
         # the hit slots are exactly that once their owner completed — see
@@ -1178,6 +1371,17 @@ class ServeEngine:
         # reclaim cannot have freed it between match and this copy
         self.pools = self._cow_jit(self.pools, np.int32(hit[nblk - 1]),
                                    np.int32(priv[0]))
+        if self.integrity is not None:
+            # serve_page_copy moves device bytes verbatim: the COW
+            # destination inherits the (just-verified) source's ledger
+            # CRCs without another host fetch
+            src = int(hit[nblk - 1])
+            for li, pool in enumerate(self.pools):
+                if pool is None:
+                    continue
+                crc = self.integrity.expected(li, src)
+                if crc is not None:
+                    self.integrity.stamp(li, priv[0], crc)
         # release the admission pins (the bind above keeps its own refs on
         # the shared blocks; the COW source drops back to its cache ref)
         for s in hit[:nblk]:
@@ -1223,6 +1427,18 @@ class ServeEngine:
         # step (the scan arms only after a deadlined request ever arrived)
         if self._has_deadlines:
             self._cancel_expired(now, rep)
+        # budgeted background scrub, BEFORE any program reads pool pages
+        # this step: a latent flip on a settled page must be caught ahead
+        # of the decode/prefill pass that would attend over it (detection
+        # evicts the holders onto the recompute path before the poisoned
+        # read, keeping recovered streams bitwise). Running it at the
+        # step's end instead loses the race when a victim completes — and
+        # frees its pages — in the same step the flip landed.
+        # (cfg.scrub pages/step; a host-side ledger walk — the virtual
+        # cost model is unchanged, the real overhead is the device->host
+        # fetches, measured on-chip in PERF.md round 23)
+        if self.integrity is not None and self.cfg.scrub:
+            self._scrub(rep)
         C = self.cfg.resolved_prefill_chunk()
 
         # 1) decode set: every decode row gets its next page (evictions may
@@ -1282,6 +1498,15 @@ class ServeEngine:
             # first-token logits need at least the last prompt position to
             # run through a (page-aligned) prefill chunk anyway
             nbind = min(len(hit), (S - 1) // self.page)
+            # trust boundary: verify the hit pages before binding (the
+            # full-hit sibling check). A mismatch quarantines the slot —
+            # possibly evicting holders onto the queue front, which
+            # shifts qi — so the admission just stops for this step; the
+            # next match misses the purged block.
+            if nbind and self.integrity is not None and not all(
+                    self._verify_slot(int(s), "prefix_hit", rep)
+                    for s in hit[:nbind]):
+                break
             cached = nbind * self.page
             end0 = min(cached + C, S)  # first tail chunk's frontier
             if self.cfg.policy == "static":
@@ -1338,6 +1563,16 @@ class ServeEngine:
         # 4) price the step, then run it. A verify pass is ONE model pass
         #    (the same price as the decode step it replaces — the honest
         #    virtual-cost accounting the goodput A/B rides on)
+        if self.integrity is not None:
+            # an admission-time integrity check may have quarantined a
+            # shared page and evicted a holder already scheduled this
+            # step — never run a dead row
+            prefill_calls = [a for a in prefill_calls
+                             if self.rows[a.row] is a]
+            decode_set = [a for a in decode_set if self.rows[a.row] is a]
+            if draft_plan is not None:
+                draft_plan = [p for p in draft_plan
+                              if self.rows[p[0].row] is p[0]]
         cost = len(prefill_calls) + (1 if decode_set else 0)
         t_end = now + cost
         for a in prefill_calls:
@@ -1503,6 +1738,18 @@ class ServeEngine:
             accepted = len(emitted) - 1
             self.stats["spec_accepted"] += accepted
             self.stats["decode_tokens"] += len(emitted)
+            if self.integrity is not None:
+                # the span write touched every allocated page under
+                # [pos0, pos0 + W) — stamp them (rejected-tail bytes
+                # included: they are real device state) before the
+                # completion/rollback below can free any of them
+                p0 = int(pos0[a.row]) // self.page
+                p1 = min(a.n_pages,
+                         (int(pos0[a.row]) + W - 1) // self.page + 1)
+                for idx in range(p0, p1):
+                    slot = int(self.table[a.row, idx])
+                    if slot:
+                        self._stamp_slot(slot)
             if tr is not None:
                 trk = self._req_track(a.req.rid)
                 tr.emit("X", "verify", d0, d1 - d0, track=trk,
@@ -1563,6 +1810,8 @@ class ServeEngine:
             jnp.asarray(self.table[a.row:a.row + 1]), jnp.asarray(chunk),
             np.int32(start), np.int32(want), npl)
         a.prefill_done = end_real
+        if self.integrity is not None:
+            self._stamp_prefill_pages(a, start, end_real)
         rep.prefill_calls += 1
         self.stats["prefill_calls"] += 1
         self.stats["prefill_tokens"] += end_real - start
@@ -1638,6 +1887,13 @@ class ServeEngine:
             self.params, self.state, self.pools, jnp.asarray(dec_table),
             jnp.asarray(toks), jnp.asarray(pos), npl)
         nxt = np.asarray(nxt)
+        if self.integrity is not None:
+            # stamp each row's written page from the SAVED pos array,
+            # BEFORE the emission loop can complete (and free) a request
+            for a in decode_set:
+                slot = int(self.table[a.row, pos[a.row] // self.page])
+                if slot:
+                    self._stamp_slot(slot)
         rep.decode_rows = len(decode_set)
         self.stats["decode_calls"] += 1
         self.stats["decode_row_slots"] += len(decode_set)
@@ -1730,20 +1986,32 @@ class ServeEngine:
             new_pools.append(pool)
         self.pools = new_pools
 
-    def extract_request(self, rid: int) -> Dict[str, Any]:
+    def extract_request(self, rid: int) -> Optional[Dict[str, Any]]:
         """Pop an in-flight DECODE-state request off this engine for
         cross-engine shipping: copy its table-row pages to host
         (:meth:`fetch_pages`), then free the row and its page refs —
         prefix-registered blocks survive on the index's own refs, exactly
         like eviction. Returns the ship dict :meth:`import_request`
         accepts. Extraction is not a terminal state: nothing lands in
-        ``finished``/``evicted`` — the request continues elsewhere."""
+        ``finished``/``evicted`` — the request continues elsewhere.
+
+        With integrity on, export is a trust boundary: every page is
+        verified against the ledger BEFORE it can ship. A mismatch
+        quarantines the slot — which evicts this very request onto the
+        local recompute path — and returns None: corrupt bytes never
+        leave the engine, and the request re-prefills and re-ships clean
+        ones. Clean ships carry per-(layer, page) ``checksums`` the
+        importer re-verifies and stamps from."""
         a = next((x for x in self._active() if x.req.rid == rid), None)
         if a is None or a.state != "decode":
             raise ValueError(
                 f"extract_request: rid {rid} is not an in-flight decode "
                 "request")
         slots = [int(s) for s in self.table[a.row, :a.n_pages]]
+        if self.integrity is not None:
+            for s in slots:
+                if not self._verify_slot(s, "export"):
+                    return None  # quarantined + evicted: nothing ships
         ship = {
             "rid": rid, "req": a.req, "out": list(a.out),
             "token_times": list(a.token_times),
@@ -1754,6 +2022,14 @@ class ServeEngine:
             "cached_tokens": self._cached_tokens.pop(rid, 0),
             "pages": self.fetch_pages(slots),
         }
+        if self.integrity is not None:
+            # wire checksums straight from the (just-verified) ledger —
+            # one word per (layer, page); None for poolless layers and
+            # for not-yet-stamped partial tail pages
+            ship["checksums"] = [
+                None if pool is None else
+                [self.integrity.expected(li, s) for s in slots]
+                for li, pool in enumerate(self.pools)]
         self.allocator.free_request(rid)
         self.table[a.row, :] = 0
         self.rows[a.row] = None
@@ -1771,11 +2047,39 @@ class ServeEngine:
         if row is None:
             return False
         req: ServeRequest = ship["req"]
+        if self.integrity is not None and \
+                ship.get("checksums") is not None:
+            # trust boundary: re-checksum the ship's host bytes against
+            # the exporter's words BEFORE any allocation or pool write —
+            # a corrupt ship is rejected all-or-nothing (engine
+            # untouched) and rides the parked-ship retry, where the
+            # handoff wire repair retransmits intact bytes
+            self._now = now
+            calc = ship_checksums(ship["pages"], self._page_axis)
+            for li, want in enumerate(ship["checksums"]):
+                if want is None:
+                    continue
+                for p, w in enumerate(want):
+                    if w is not None and w != calc[li][p]:
+                        self.stats["sdc_detected"] += 1
+                        self._sdc_trace("ship_reject", rid=req.rid,
+                                        layer=li, page=p)
+                        return False
         slots = self._alloc(req.rid, ship["n_pages"])
         if slots is None:
             return False
         self._now = now
         self.write_pages(slots, ship["pages"])
+        if self.integrity is not None and \
+                ship.get("checksums") is not None:
+            # the scatter is verbatim: destination slots inherit the
+            # ship's verified checksums without a fresh device fetch
+            for li, want in enumerate(ship["checksums"]):
+                if want is None:
+                    continue
+                for p, w in enumerate(want):
+                    if w is not None:
+                        self.integrity.stamp(li, slots[p], w)
         a = _Active(req=req, row=row, admit_seq=self._admit_seq)
         self._admit_seq += 1
         a.state = "decode"
@@ -2206,6 +2510,16 @@ class ReplicatedServer:
         for e in self.engines + self._retired:
             out.extend(e.shed)
         return out
+
+    @property
+    def sdc_events(self) -> List[Dict[str, Any]]:
+        """Every SDC detection/quarantine record across the fleet
+        (retired replicas included), time-ordered — servechaos derives
+        MTTD and quarantine-recovery MTTR from these."""
+        out = []
+        for e in self.engines + self._retired:
+            out.extend(e.sdc_events)
+        return sorted(out, key=lambda ev: ev["t"])
 
     def snapshot(self) -> Dict[str, Any]:
         """Fleet snapshot: per-replica snapshots plus the aggregates a

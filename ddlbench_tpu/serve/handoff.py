@@ -45,6 +45,11 @@ from ddlbench_tpu.serve.engine import (
     fleet_stats,
     make_server,
 )
+from ddlbench_tpu.serve.integrity import (
+    CHECKSUM_BYTES,
+    repair_ship,
+    ship_checksums,
+)
 from ddlbench_tpu.serve.workload import ServeRequest
 
 PAYLOAD_KEYS = ("pool_k", "pool_v")
@@ -65,12 +70,27 @@ def ship_sidecar_bytes(ship: Dict[str, Any]) -> int:
                if rows is not None for k in SIDECAR_KEYS if k in rows)
 
 
-def export_request(engine: ServeEngine, rid: int) -> Dict[str, Any]:
+def ship_checksum_bytes(ship: Dict[str, Any]) -> int:
+    """Integrity-word bytes riding the wire with one ship: CHECKSUM_BYTES
+    per attached (layer, page) checksum word (0 when the exporter runs
+    without integrity — the wire overhead is strictly flag-gated)."""
+    return CHECKSUM_BYTES * sum(
+        sum(1 for w in per_layer if w is not None)
+        for per_layer in ship.get("checksums") or [] if per_layer is not None)
+
+
+def export_request(engine: ServeEngine, rid: int) -> Optional[Dict[str, Any]]:
     """Pop ``rid`` off ``engine`` (ServeEngine.extract_request) and stamp
-    the ship with its wire-byte accounting."""
+    the ship with its wire-byte accounting. Returns None when export-time
+    integrity verification caught a corrupt page — the request was
+    quarantine-evicted onto the engine's local recompute path and nothing
+    ships (it re-exports clean bytes after re-prefill)."""
     ship = engine.extract_request(rid)
+    if ship is None:
+        return None
     ship["payload_bytes"] = ship_payload_bytes(ship)
     ship["sidecar_bytes"] = ship_sidecar_bytes(ship)
+    ship["checksum_bytes"] = ship_checksum_bytes(ship)
     return ship
 
 
@@ -95,7 +115,21 @@ class DisaggregatedServer:
         self._pending: List[Dict[str, Any]] = []  # ships parked host-side
         self.shipped: Dict[str, int] = {
             "shipped_requests": 0, "shipped_pages": 0,
-            "shipped_payload_bytes": 0, "shipped_sidecar_bytes": 0}
+            "shipped_payload_bytes": 0, "shipped_sidecar_bytes": 0,
+            "shipped_checksum_bytes": 0}
+        # wire-transit SDC: ships whose host bytes failed their attached
+        # checksums at the handoff pre-import check (detected once here,
+        # not once per decode engine tried), and how many were repaired
+        # by modelled retransmission from the exporter's intact buffer
+        self.wire_sdc: Dict[str, int] = {
+            "sdc_wire_detected": 0, "sdc_wire_repaired": 0}
+        self.wire_events: List[Dict[str, Any]] = []
+        # optional fault hook fired on every pending ship between export
+        # and import — the only window that models wire-transit
+        # corruption (a ship normally exports and imports within one
+        # ``_ship`` tick, so nothing outside this hook can touch it
+        # in flight). servechaos --corrupt ...:ship arms it one-shot.
+        self.wire_fault_hook: Optional[Any] = None
 
     # -- ReplicatedServer-compatible driver surface ------------------------
 
@@ -131,20 +165,73 @@ class DisaggregatedServer:
                            key=lambda a: a.admit_seq)
             for a in ready:
                 ship = export_request(eng, a.req.rid)
+                if ship is None:
+                    # export verify caught corruption: the request was
+                    # quarantine-evicted locally and re-ships after its
+                    # recompute — corrupt bytes never reach the wire
+                    continue
                 self.shipped["shipped_requests"] += 1
                 self.shipped["shipped_pages"] += ship["n_pages"]
                 self.shipped["shipped_payload_bytes"] += \
                     ship["payload_bytes"]
                 self.shipped["shipped_sidecar_bytes"] += \
                     ship["sidecar_bytes"]
+                self.shipped["shipped_checksum_bytes"] += \
+                    ship["checksum_bytes"]
                 self._pending.append(ship)
+        for ship in self._pending:
+            if self.wire_fault_hook is None:
+                break  # one-shot hooks disarm themselves mid-iteration
+            self.wire_fault_hook(ship)
         parked = []
         for ship in self._pending:
+            verdict = self._wire_corrupt(ship, now)
+            if verdict == "park":
+                parked.append(ship)  # repaired; retransmission costs a step
+                continue
+            if verdict == "drop":
+                continue  # unrepairable: re-routed through prefill
             order = sorted(enumerate(self.decode.engines),
                            key=lambda ie: (ie[1].load(), ie[0]))
             if not any(e.import_request(ship, now) for _, e in order):
                 parked.append(ship)
         self._pending = parked
+
+    def _wire_corrupt(self, ship: Dict[str, Any],
+                      now: float) -> Optional[str]:
+        """Pre-import wire check: re-checksum a pending ship's host bytes
+        against the exporter's attached words. On mismatch, count the
+        detection ONCE (the importer's own all-or-nothing check would
+        fire per decode engine tried) and repair from the stashed
+        original byte — the model of the exporter retransmitting from its
+        intact source buffer — parking the ship one step for the
+        retransmit ("park"). If nothing intact remains to retransmit the
+        ship is dropped and the request re-routes through the PREFILL
+        dispatcher, the decode-kill recovery path: re-prefill regenerates
+        the pages byte-identically and the handoff re-ships ("drop").
+        Ships without checksums (integrity off) pass untouched (None)."""
+        want = ship.get("checksums")
+        if want is None:
+            return None
+        axis = (self.prefill.engines or self.decode.engines)[0]._page_axis
+        calc = ship_checksums(ship["pages"], axis)
+        for li, per_layer in enumerate(want):
+            if per_layer is None:
+                continue
+            for p, w in enumerate(per_layer):
+                if w is not None and w != calc[li][p]:
+                    self.wire_sdc["sdc_wire_detected"] += 1
+                    repaired = repair_ship(ship)
+                    if repaired:
+                        self.wire_sdc["sdc_wire_repaired"] += 1
+                    else:
+                        self.prefill._dispatch(ship["req"], now)
+                    self.wire_events.append({
+                        "t": now, "slot": -1, "where": "wire",
+                        "rid": ship["rid"], "layer": li, "page": p,
+                        "repaired": repaired, "displaced": []})
+                    return "park" if repaired else "drop"
+        return None
 
     # -- chaos: per-fleet hard kills ---------------------------------------
 
@@ -219,6 +306,13 @@ class DisaggregatedServer:
     def resize_events(self) -> List[Dict[str, Any]]:
         return self.prefill.resize_events + self.decode.resize_events
 
+    @property
+    def sdc_events(self) -> List[Dict[str, Any]]:
+        """Pool detections from both fleets plus wire-transit detections
+        from the handoff pre-import check, time-ordered."""
+        return sorted(self.prefill.sdc_events + self.decode.sdc_events
+                      + self.wire_events, key=lambda ev: ev["t"])
+
     def snapshot(self) -> Dict[str, Any]:
         return {"prefill": self.prefill.snapshot(),
                 "decode": self.decode.snapshot(),
@@ -228,6 +322,7 @@ class DisaggregatedServer:
         s = fleet_stats(self.prefill.engines + self.decode.engines,
                         self.prefill._retired + self.decode._retired)
         s.update(self.shipped)
+        s.update(self.wire_sdc)
         return s
 
 

@@ -1,0 +1,265 @@
+"""Silent-data-corruption defense for the serving data plane: a host-side
+per-page checksum ledger over the shared KV pool.
+
+A flipped bit in a pool page, scale sidecar, or in-flight handoff payload
+is invisible to every existing guard — the device happily attends over the
+poisoned bytes and the stream diverges silently, including prefix-cache
+full-hits that serve the corrupted context to *future* sessions. This
+module is the serving analog of the train loop's guard/ + chaosbench
+treatment: detect at trust boundaries, quarantine the bad page, and
+recover through the machinery that already exists.
+
+The ledger
+----------
+One crc32 word per (layer, slot), chained over the slot's rows of every
+per-slot pool array in sorted key order (``pool_checksum_keys`` in
+ops/paged_decode.py: payload ``pool_k``/``pool_v`` plus the int8
+``scale_k``/``scale_v`` sidecars — the exact domain the three table-write
+primitives scatter). Entries carry a WRITE GENERATION so a re-stamp after
+a legitimate overwrite (decode filling a page, COW, rollback re-derive)
+is distinguishable from a stale expectation; ``verify`` only ever
+compares against the latest generation.
+
+The checksum is crc32c when the hardware-accelerated wheel is importable
+and stdlib ``zlib.crc32`` otherwise — both are 4-byte words with the same
+error-detection class, and the choice never leaks into pinned artifacts
+(checksums are host-side state, not part of any row schema or stream).
+
+Trust boundaries (serve/engine.py + serve/handoff.py wire the calls):
+
+* pool writes (decode/prefill-chunk/COW) STAMP the written slots;
+* handoff ``export_request`` verifies fetched bytes against the ledger
+  and attaches per-(layer, page) checksums to the ship;
+  ``import_request`` verifies the ship before any pool write and stamps
+  the destination slots from the ship's checksums (all-or-nothing: a
+  corrupt ship writes nothing and rides the parked-ship retry);
+* prefix-hit binds (full and partial) verify the hit slots before a
+  request attaches to them;
+* COW verifies the SOURCE page before copying (a corrupted shared page
+  must not propagate through the copy);
+* a budgeted background scrubber (cfg.scrub pages/step) walks stamped
+  slots round-robin, catching latent corruption on cold pages before a
+  full-hit serves them.
+
+Detection -> quarantine -> recovery: the allocator marks the slot
+quarantined (never handed out again), the prefix index drops its entry,
+and every request referencing the slot takes the existing
+eviction-recompute path — re-prefill regenerates int8 pages
+byte-identically (counter-based rounding seeds), and a recovered
+request's FULL stream is regenerated from scratch, so any detection
+before completion yields bitwise-identical final streams vs an unfaulted
+control. That is the headline gate tools/servechaos.py pins.
+"""
+
+from __future__ import annotations
+
+import zlib
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+try:  # hardware crc32c when the wheel is present; stdlib crc32 otherwise
+    from crc32c import crc32c as _crc32c  # type: ignore
+except ImportError:  # pragma: no cover - container ships without crc32c
+    _crc32c = None
+
+# one checksum word per (layer, page) on the handoff wire — the constant
+# the ship_checksum_bytes accounting and the serve_pool_audit tie share
+CHECKSUM_BYTES = 4
+
+
+def checksum(data: bytes, crc: int = 0) -> int:
+    """4-byte checksum of ``data`` chained onto ``crc`` (crc32c if
+    available, zlib.crc32 otherwise), masked to an unsigned word."""
+    if _crc32c is not None:
+        return _crc32c(data, crc) & 0xFFFFFFFF
+    return zlib.crc32(data, crc) & 0xFFFFFFFF
+
+
+def page_checksum(rows: Dict[str, np.ndarray]) -> int:
+    """CRC of one pool slot's fetched rows, chained over sorted key order
+    so payload and sidecar corruption are both visible in the one word."""
+    crc = 0
+    for key in sorted(rows):
+        crc = checksum(np.ascontiguousarray(rows[key]).tobytes(), crc)
+    return crc
+
+
+def ship_checksums(pages: List[Optional[Dict[str, np.ndarray]]],
+                   page_axis: int = 0) -> List[Optional[List[int]]]:
+    """Per-(layer, page) checksums of a handoff ship's fetched rows —
+    exactly the values a local per-slot fetch would ledger, so import can
+    stamp destination slots straight from the ship."""
+    out: List[Optional[List[int]]] = []
+    for per_layer in pages:
+        if per_layer is None:  # layers with no pool ship nothing
+            out.append(None)
+            continue
+        keys = sorted(per_layer)
+        n = per_layer[keys[0]].shape[page_axis]
+        out.append([
+            page_checksum({k: (per_layer[k][p] if page_axis == 0
+                               else per_layer[k][:, p]) for k in keys})
+            for p in range(n)])
+    return out
+
+
+class PageLedger:
+    """Host-side (layer, slot) -> (write-generation, crc) ledger."""
+
+    def __init__(self) -> None:
+        self._crc: Dict[Tuple[int, int], Tuple[int, int]] = {}
+        self.stamps = 0
+        self.verifies = 0
+        self.mismatches = 0
+
+    def __len__(self) -> int:
+        return len(self._crc)
+
+    def stamp(self, layer: int, slot: int, crc: int) -> int:
+        """Record ``crc`` as the latest contents of (layer, slot); bumps
+        the write generation. Returns the new generation."""
+        gen = self._crc.get((layer, slot), (0, 0))[0] + 1
+        self._crc[(layer, slot)] = (gen, crc)
+        self.stamps += 1
+        return gen
+
+    def expected(self, layer: int, slot: int) -> Optional[int]:
+        ent = self._crc.get((layer, slot))
+        return None if ent is None else ent[1]
+
+    def generation(self, layer: int, slot: int) -> int:
+        return self._crc.get((layer, slot), (0, 0))[0]
+
+    def verify(self, layer: int, slot: int, crc: int) -> Optional[bool]:
+        """Compare ``crc`` against the latest stamp. True = intact,
+        False = MISMATCH (counted), None = the slot was never stamped
+        (unwritten/partial pages carry no expectation)."""
+        exp = self.expected(layer, slot)
+        if exp is None:
+            return None
+        self.verifies += 1
+        if crc != exp:
+            self.mismatches += 1
+            return False
+        return True
+
+    def drop_slot(self, slot: int) -> int:
+        """Forget every layer's entry for ``slot`` (the slot returned to
+        the free list or was quarantined — its next tenant re-stamps).
+        Returns how many entries dropped."""
+        dead = [k for k in self._crc if k[1] == slot]
+        for k in dead:
+            del self._crc[k]
+        return len(dead)
+
+    def stamped_slots(self) -> List[int]:
+        """Distinct slots with at least one stamped layer, sorted — the
+        scrubber's deterministic round-robin domain."""
+        return sorted({s for (_, s) in self._crc})
+
+
+# ---------------------------------------------------------------------------
+# Fault injection (tools/servechaos.py + tests). The flip is REAL: the
+# device buffer (or the in-flight host ship) holds different bytes
+# afterward, and only checksum verification can tell.
+
+
+def pool_layers(engine) -> List[int]:
+    """Model-layer indices that own a KV pool (attention layers) — the
+    valid ``layer`` domain for ``flip_pool_bit`` and the servechaos
+    ``--corrupt`` @L suffix."""
+    return [li for li, pool in enumerate(engine.pools) if pool is not None]
+
+
+def stable_stamped_slots(engine) -> List[int]:
+    """Stamped slots that are NOT any active row's current write frontier,
+    sorted — the deterministic injection domain chaos tooling targets.
+
+    A flip into the page a row is about to append to races the next
+    write's re-stamp, which checksums the whole page — corrupted residue
+    included — and blesses the corruption. That is the honest TOCTOU
+    window of any write-boundary ledger (a flip landing mid-write is
+    indistinguishable from the write); targeting settled pages is what
+    makes an injection experiment measure DETECTION, not the race."""
+    if engine.integrity is None:
+        return []
+    hot = set()
+    for a in engine._active():
+        if a.state == "decode":
+            p0 = a.decode_pos // engine.page
+            pages = range(p0, min(a.n_pages, p0 + 2))
+        else:  # prefill frontier page (partially written, not yet stamped)
+            pages = range(a.prefill_done // engine.page,
+                          min(a.n_pages, a.prefill_done // engine.page + 1))
+        for idx in pages:
+            hot.add(int(engine.table[a.row, idx]))
+    return [s for s in engine.integrity.stamped_slots() if s not in hot]
+
+
+def flip_pool_bit(engine, layer: int, slot: int,
+                  key: Optional[str] = None, index: int = 0,
+                  bit: int = 0) -> Dict[str, int]:
+    """Flip ONE bit of pool array ``key`` inside ``slot``'s rows of layer
+    ``layer`` on the DEVICE (functional update via device_put, so no
+    recompile — the buffer is replaced, not re-traced). ``key`` None
+    picks the first checksum-domain key (payload); pass ``"scale_k"`` to
+    corrupt the int8 sidecar. Returns a record of what flipped."""
+    import jax  # deferred: the ledger half of this module stays jax-free
+
+    pool = engine.pools[layer]
+    if pool is None:
+        raise ValueError(
+            f"layer {layer} owns no KV pool (valid: {pool_layers(engine)})")
+    if key is None:
+        key = sorted(k for k, v in pool.items()
+                     if getattr(v, "ndim", 0))[0]
+    arr = pool[key]
+    host = np.array(np.asarray(arr), copy=True)
+    rows = host[slot] if engine._page_axis == 0 else host[:, slot]
+    sub = np.array(rows, copy=True)
+    flat = sub.reshape(-1).view(np.uint8)
+    byte = int(index) % flat.size
+    flat[byte] ^= np.uint8(1 << (bit % 8))
+    if engine._page_axis == 0:
+        host[slot] = sub
+    else:
+        host[:, slot] = sub
+    npool = dict(pool)
+    npool[key] = jax.device_put(host, arr.sharding)
+    engine.pools[layer] = npool
+    return {"layer": int(layer), "slot": int(slot), "key": key,
+            "byte": byte, "bit": bit % 8}
+
+
+def flip_ship_bit(ship: dict, layer: int = 0, key: Optional[str] = None,
+                  index: int = 0, bit: int = 0) -> Dict[str, int]:
+    """Flip one bit of an in-flight handoff ship's page rows (host-side
+    numpy — the wire-transit fault model). The original byte is stashed
+    in ``ship["_wire_fault"]`` so the handoff retry can model
+    retransmission from the exporter's intact source buffer."""
+    pages = ship["pages"][layer]
+    if key is None:
+        key = sorted(pages)[0]
+    arr = np.array(pages[key], copy=True)  # fetched rows may be read-only
+    flat = arr.reshape(-1).view(np.uint8)
+    byte = int(index) % flat.size
+    orig = int(flat[byte])
+    flat[byte] = orig ^ (1 << (bit % 8))
+    pages[key] = arr
+    ship["_wire_fault"] = {"layer": int(layer), "key": key, "byte": byte,
+                           "orig": orig}
+    return {"layer": int(layer), "key": key, "byte": byte, "bit": bit % 8}
+
+
+def repair_ship(ship: dict) -> bool:
+    """Undo a stashed wire fault — the model of the exporter
+    retransmitting from its intact host buffer after the importer
+    rejected the corrupt ship. Returns True if a fault was repaired."""
+    fault = ship.pop("_wire_fault", None)
+    if fault is None:
+        return False
+    arr = np.array(ship["pages"][fault["layer"]][fault["key"]], copy=True)
+    arr.reshape(-1).view(np.uint8)[fault["byte"]] = fault["orig"]
+    ship["pages"][fault["layer"]][fault["key"]] = arr
+    return True

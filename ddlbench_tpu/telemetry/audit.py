@@ -556,6 +556,21 @@ def serve_pool_audit(engine) -> Dict[str, Any]:
     if engine.dtype == jnp.int8:
         checks.append(_check("int8_page_is_f32_quarter",
                              per_page_f32 / 4.0, per_page))
+    # SDC checksum sidecar (serve/integrity.py): when the ledger is armed
+    # the handoff wire ships one CHECKSUM_BYTES word per (pool layer,
+    # page) next to payload + scale sidecars — tie this audit's own pool
+    # walk against integrity's notion of the checksum domain, the exact
+    # per-page constant behind the fleet's shipped_checksum_bytes.
+    from ddlbench_tpu.serve.integrity import CHECKSUM_BYTES, pool_layers
+    integrity_on = getattr(engine, "integrity", None) is not None
+    pooled_layers = sum(1 for pool in engine.pools if pool is not None)
+    checksum_page = float(CHECKSUM_BYTES * pooled_layers
+                          if integrity_on else 0)
+    if integrity_on:
+        checks.append(_check(
+            "checksum_bytes_per_page",
+            float(CHECKSUM_BYTES * len(pool_layers(engine))),
+            checksum_page))
     res = {
         "kv_dtype": str(engine.cfg.kv_dtype),
         "tp": int(engine.cfg.tp),
@@ -564,6 +579,8 @@ def serve_pool_audit(engine) -> Dict[str, Any]:
         "n_pages": n_pages,
         "payload_bytes": payload,
         "sidecar_bytes": sidecar,
+        "integrity": integrity_on,
+        "checksum_bytes_per_page": checksum_page,
         "checks": checks,
         "ok": all(c["ok"] for c in checks),
     }

@@ -146,6 +146,39 @@ def autoscale_decisions(doc: Any) -> List[Dict[str, Any]]:
     return out
 
 
+SDC_PREFIX = "sdc:"
+
+
+def sdc_events(doc: Any) -> List[Dict[str, Any]]:
+    """Pull the SDC defense's detection instants back out of a trace.
+
+    serve/engine.py emits one ``"i"`` instant per ledger event
+    (``sdc:detect`` / ``:quarantine``) on a ``<replica>/sdc`` track with
+    the slot, trust boundary, and displaced-request count in ``args``.
+    Returned in trace order as ``{"t": <model passes>, "kind": ...,
+    **args}`` — the same contract as :func:`autoscale_decisions` — so
+    "which boundary caught the flip at t=6?" is answerable from the
+    trace alone. Accepts a live Tracer or an exported trace dict/list."""
+    out: List[Dict[str, Any]] = []
+    if hasattr(doc, "events"):  # a live telemetry.Tracer
+        for phase, name, t0_ns, _dur, _tid, _tname, args in doc.events():
+            if phase == "i" and name.startswith(SDC_PREFIX):
+                out.append({"t": t0_ns / 1e3,
+                            "kind": name[len(SDC_PREFIX):],
+                            **(args or {})})
+        return out
+    events = doc.get("traceEvents", []) if isinstance(doc, dict) else doc
+    for e in events:
+        name = str(e.get("name", ""))
+        if e.get("ph") == "i" and name.startswith(SDC_PREFIX):
+            # serve traces stamp 1 model pass = 1000 trace-ns → ts in us
+            # IS virtual model passes (the autoscale_decisions convention)
+            out.append({"t": float(e.get("ts", 0.0)),
+                        "kind": name[len(SDC_PREFIX):],
+                        **(e.get("args") or {})})
+    return out
+
+
 def trace_truncation(doc: Any) -> int:
     """Drop count recorded in a trace's metadata block: > 0 means the ring
     overflowed and the OLDEST events are gone. 0 for bare event lists and

@@ -106,7 +106,16 @@ _CHAOS_FIELDS = frozenset(("shed", "timeouts"))
 # even if a future server grows the counters
 _DISAGG_FIELDS = frozenset((
     "shipped_requests", "shipped_pages", "shipped_payload_bytes",
-    "shipped_sidecar_bytes"))
+    "shipped_sidecar_bytes", "shipped_checksum_bytes"))
+
+# engine stats keys that only carry signal when the SDC checksum ledger
+# is armed (--scrub here; --corrupt in servechaos): plain rows stay
+# byte-identical in schema — the engine always counts, the row only
+# shows the counters when the flag asked for them
+_SDC_FIELDS = frozenset((
+    "sdc_injected", "sdc_detected", "sdc_quarantined", "sdc_recovered",
+    "sdc_scrubbed", "sdc_recompute_checks", "sdc_wire_detected",
+    "sdc_wire_repaired"))
 
 
 def parse_disaggregate(spec, perr):
@@ -499,6 +508,14 @@ def main(argv=None) -> int:
                         "acceptance keeps token streams bitwise identical "
                         "to non-speculative. The row gains speculative/"
                         "spec_*/tokens_per_pass fields")
+    p.add_argument("--scrub", type=int, default=None, metavar="N",
+                   help="arm the SDC checksum ledger (serve/integrity.py) "
+                        "and scrub N stamped pool pages per step (0 = "
+                        "boundary verification only) — the clean-traffic "
+                        "overhead measurement for the defense servechaos "
+                        "exercises under --corrupt. The row gains the "
+                        "sdc_* counters (all zero without injected "
+                        "faults); plain rows keep the pinned schema")
     p.add_argument("--sample", default=None, metavar="temperature:T[,top-k:K]",
                    help="sample instead of greedy argmax: softmax(logits/T)"
                         " with optional top-k restriction, counter-based "
@@ -651,6 +668,9 @@ def main(argv=None) -> int:
         p.error("--tier-mix is a probability in [0, 1]")
     if args.heartbeat < 0:
         p.error("--heartbeat must be >= 0 time units (0 = off)")
+    if args.scrub is not None and args.scrub < 0:
+        p.error("--scrub must be >= 0 pages per step (0 arms the ledger "
+                "with boundary verification only)")
     resizes = []
     for rspec in args.resize:
         try:
@@ -693,7 +713,8 @@ def main(argv=None) -> int:
         slo_ttft=args.slo_ttft, slo_itl=args.slo_itl,
         heartbeat=args.heartbeat,
         kv_dtype=args.kv_dtype or "float32",
-        speculative=args.speculative or "none")
+        speculative=args.speculative or "none",
+        integrity=args.scrub is not None, scrub=args.scrub or 0)
 
     shared_fns = None
     rc = 0
@@ -837,6 +858,7 @@ def main(argv=None) -> int:
                                 per_tier=args.tier_mix is not None)
         eng_stats = server.stats_summary()
         chaos = args.deadline_slack is not None
+        sdc = args.scrub is not None
         acct = shed_accounting(args.requests, len(fin),
                                int(eng_stats["shed"]),
                                int(eng_stats["timeouts"]), dstats)
@@ -877,7 +899,8 @@ def main(argv=None) -> int:
                if k != "completed"
                and (args.speculative or k not in _SPEC_FIELDS)
                and (chaos or k not in _CHAOS_FIELDS)
-               and (disagg or k not in _DISAGG_FIELDS)},
+               and (disagg or k not in _DISAGG_FIELDS)
+               and (sdc or k not in _SDC_FIELDS)},
             # --serve-tp only (plain rows keep the pinned schema): the
             # tp-group width every replica runs at
             **({"serve_tp": cfg.tp} if args.serve_tp > 1 else {}),
@@ -891,6 +914,10 @@ def main(argv=None) -> int:
             **({"kv_dtype": cfg.kv_dtype} if args.kv_dtype else {}),
             **({"speculative": cfg.speculative}
                if args.speculative else {}),
+            # --scrub only (plain rows keep the schema-pinned key set):
+            # the scrub budget behind the sdc_* counters riding the
+            # stats merge above
+            **({"scrub": cfg.scrub} if sdc else {}),
             # --timeline only: windowed SLO/goodput series + TTFT/ITL
             # component breakdowns (absent otherwise so a plain row stays
             # bitwise identical traced or untraced)

@@ -30,6 +30,24 @@ Faults (virtual-time schedule, repeatable flags):
   SHEDS requests whose projected completion already misses the deadline,
   the driver retries sheds with bounded backoff, and interactive traffic
   admits ahead of (and preempts) the batch tier.
+* ``--corrupt T:R:TARGET[@L.S]`` — SILENT DATA CORRUPTION: flip one real
+  bit at virtual time T in replica R's serving data plane
+  (serve/integrity.py). TARGET picks the victim: ``payload`` (a settled
+  KV pool page), ``sidecar`` (an int8 scale row — needs --kv-dtype
+  int8), ``prefix`` (a prefix-cache-shared page: every referencing
+  request read poisoned bytes), or ``ship`` (an in-flight handoff
+  payload — needs --disaggregate; R must be 0, the wire has no replica
+  index). ``@L.S`` optionally pins the model layer and pool slot;
+  omitted, a deterministic settled resident page is picked at fire
+  time. Arming any --corrupt turns the checksum ledger ON
+  (cfg.integrity) unless ``--no-detect`` asks for the honest
+  no-defense measurement; ``--scrub N`` budgets the background
+  scrubber at N pages/step (default: a full sweep when detection is
+  armed). The headline gate mirrors --kill's: with detection on, token
+  streams pin BITWISE vs the unfaulted control and
+  ``requests_lost == 0`` (detection -> quarantine -> re-prefill
+  regenerates int8 pages byte-identically); with --no-detect the row
+  reports the ESCAPED divergence instead of hiding it.
 
 Reported: ``mttr_replica_s`` — per kill, the virtual time from the kill
 until the LAST displaced in-flight request emits its first post-failover
@@ -53,6 +71,7 @@ reports ``mttr_scripted_*`` next to ``mttr_replica_s*`` plus the
 Usage:
     python -m ddlbench_tpu.tools.servechaos [-m transformer_s]
         [-b synthtext] [--replicas 2] [--kill 12:1] [--stall 8:0:6]
+        [--corrupt 10:0:payload] [--no-detect] [--scrub 4]
         [--heartbeat 4] [--deadline-slack 32] [--retry 2:4]
         [--tier-mix 0.5] [--autoscale 2:2] [--arrival poisson|closed]
         [--rate 0.5] [--requests 64] [--no-control] [--platform cpu]
@@ -89,6 +108,86 @@ def _parse_kills(specs, perr, disagg=False):
         if out[-1][0] < 0 or out[-1][2] < 0:
             perr(f"--kill {s!r}: T >= 0 and R >= 0")
     return out
+
+
+_CORRUPT_TARGETS = ("payload", "sidecar", "prefix", "ship")
+
+
+def _parse_corrupts(specs, perr, disagg=False):
+    """Corrupt specs as (t, fleet, index, target, layer, slot) tuples.
+    Grammar ``T:R:TARGET[@L.S]``; under --disaggregate pool targets name
+    their fleet like --kill (``T:pR:...`` / ``T:dR:...``) while the
+    ``ship`` target keeps ``T:0:ship`` — the wire has no replica index."""
+    out = []
+    for s in specs:
+        try:
+            t_s, r_s, rest = s.split(":", 2)
+            layer = slot = None
+            if "@" in rest:
+                tgt, at = rest.split("@", 1)
+                l_s, p_s = at.split(".")
+                layer, slot = int(l_s), int(p_s)
+            else:
+                tgt = rest
+            t = float(t_s)
+            if disagg and tgt != "ship":
+                fleet = r_s[:1]
+                if fleet not in ("p", "d") or not r_s[1:]:
+                    raise ValueError
+                r = int(r_s[1:])
+            else:
+                fleet, r = None, int(r_s)
+            out.append((t, fleet, r, tgt, layer, slot))
+        except ValueError:
+            if disagg:
+                perr(f"--corrupt under --disaggregate wants "
+                     f"T:pR:TARGET[@L.S], T:dR:TARGET[@L.S] or T:0:ship, "
+                     f"got {s!r}")
+            perr(f"--corrupt wants T:R:TARGET[@LAYER.SLOT] "
+                 f"(virtual_time:fleet_index:target), got {s!r}")
+        t, fleet, r, tgt, layer, slot = out[-1]
+        if tgt not in _CORRUPT_TARGETS:
+            perr(f"--corrupt {s!r}: target must be one of "
+                 f"{'/'.join(_CORRUPT_TARGETS)}, got {tgt!r}")
+        if t < 0 or r < 0:
+            perr(f"--corrupt {s!r}: T >= 0 and R >= 0")
+        if slot is not None and slot < 1:
+            perr(f"--corrupt {s!r}: slot 0 is the scratch page (it holds "
+                 f"no request data); slots start at 1")
+        if layer is not None and layer < 0:
+            perr(f"--corrupt {s!r}: layer must be >= 0")
+    return out
+
+
+def _pick_slot(eng, target):
+    """Deterministic fire-time victim: a SETTLED resident page (below
+    every active row's write frontier — a flip into the page about to be
+    appended to races the next write's re-stamp, which would bless the
+    corruption; see integrity.stable_stamped_slots). For ``prefix`` the
+    victim is a prefix-indexed page, shared (refcount >= 2) when one
+    exists. Returns None when nothing is resident yet."""
+    if target == "prefix":
+        idx = sorted(set(eng.prefix._slots.values()))
+        shared = [s for s in idx if eng.allocator.refcount(s) >= 2]
+        return (shared or idx or [None])[0]
+    hot, cand = set(), []
+    for a in eng._active():
+        if a.state == "decode":
+            p0 = a.decode_pos // eng.page
+            for i in range(a.n_pages):
+                s = int(eng.table[a.row, i])
+                (hot.add(s) if i >= p0 else cand.append(s))
+        else:
+            fp = a.prefill_done // eng.page
+            for i in range(min(a.n_pages, fp)):
+                cand.append(int(eng.table[a.row, i]))
+            if fp < a.n_pages:
+                hot.add(int(eng.table[a.row, fp]))
+    picks = sorted(set(cand) - hot - {0})
+    if eng.integrity is not None:
+        stamped = set(eng.integrity.stamped_slots())
+        picks = [s for s in picks if s in stamped]
+    return picks[0] if picks else None
 
 
 def _parse_stalls(specs, perr):
@@ -142,6 +241,64 @@ def _fault_events(kills, stalls):
     return ev
 
 
+def _corrupt_events(corrupts, fired):
+    """SDC injections as ``(at, fn(server, clock))`` closures. Each fire
+    flips ONE real bit (serve/integrity.py flip helpers) and appends a
+    record to ``fired`` — a fire that finds no resident victim (pool
+    still empty at T) records nothing and warns, so ``corrupts_fired``
+    stays honest. Byte 3 / bit 6 of the first element lands in the f32
+    exponent (and flips an int8 payload value by 64): big enough that an
+    ESCAPED flip visibly diverges the argmax stream instead of hiding in
+    low mantissa bits."""
+    from ddlbench_tpu.serve import integrity as I
+
+    def corrupt_fn(spec):
+        t, fleet, r, tgt, layer, slot = spec
+
+        def fire(server, clock):
+            if tgt == "ship":
+                def hook(ship):
+                    if server.wire_fault_hook is not hook:
+                        return  # one-shot: a later spec re-armed it
+                    li = (layer if layer is not None else
+                          I.pool_layers(server.prefill.engines[0])[0])
+                    rec = I.flip_ship_bit(ship, layer=li, index=3, bit=6)
+                    fired.append({"t": clock, "target": tgt,
+                                  "rid": ship["rid"], **rec})
+                    server.wire_fault_hook = None
+                    print(f"servechaos: corrupt @ {clock:g} -> in-flight "
+                          f"ship rid {ship['rid']} layer {rec['layer']} "
+                          f"(bit {rec['bit']} of byte {rec['byte']})",
+                          file=sys.stderr, flush=True)
+                server.wire_fault_hook = hook
+                return
+            if fleet == "p":
+                eng = server.prefill.engines[r]
+            elif fleet == "d":
+                eng = server.decode.engines[r]
+            else:
+                eng = server.engines[r]
+            li = layer if layer is not None else I.pool_layers(eng)[0]
+            key = "scale_k" if tgt == "sidecar" else None
+            s = slot if slot is not None else _pick_slot(eng, tgt)
+            if s is None:
+                print(f"servechaos: WARNING corrupt @ {clock:g} "
+                      f"({tgt}): no settled resident page to flip yet — "
+                      f"injection skipped", file=sys.stderr, flush=True)
+                return
+            rec = I.flip_pool_bit(eng, li, s, key=key, index=3, bit=6)
+            eng.stats["sdc_injected"] += 1
+            fired.append({"t": clock, "target": tgt, **rec})
+            print(f"servechaos: corrupt @ {clock:g} -> {tgt} layer "
+                  f"{rec['layer']} slot {rec['slot']} key {rec['key']} "
+                  f"(bit {rec['bit']} of byte {rec['byte']}, refcount "
+                  f"{eng.allocator.refcount(s)})",
+                  file=sys.stderr, flush=True)
+        return fire
+
+    return [(spec[0], corrupt_fn(spec)) for spec in corrupts]
+
+
 def _run(server, reqs, args, retry, events=None, driver_stats=None,
          controllers=None):
     from ddlbench_tpu.tools.servebench import run_closed_loop, run_open_loop
@@ -191,6 +348,57 @@ def mttr_from_events(fail_events, finished):
     return out
 
 
+def _sdc_block(args, corrupts, fired, detect, cfg, server, fin, control,
+               streams_diverged, acct):
+    """The --corrupt row fields (spread AFTER the engine-stats spread so
+    the tool-counted ``sdc_injected`` — which includes wire injections no
+    engine's stats can see — wins over the fleet sum). ``sdc_escaped``
+    is derived from OBSERVED outcomes, never from injected-minus-detected
+    arithmetic: a flip the next write legitimately overwrote hurt nobody,
+    while a flip that reached a stream shows up as divergence or loss."""
+    if not corrupts:
+        return {}
+    from ddlbench_tpu.tools.servebench import _round6
+
+    sdc_evs = server.sdc_events
+    fin_by = {f["rid"]: f for f in fin}
+    # MTTD: each injection paired with the first detection at/after it
+    mttds = []
+    for f_ev in fired:
+        det = [ev["t"] for ev in sdc_evs if ev["t"] >= f_ev["t"]]
+        mttds.append(round(min(det) - f_ev["t"], 6) if det else None)
+    mttd_ok = [m for m in mttds if m is not None]
+    # quarantine MTTR: per detection that displaced requests, the virtual
+    # time until the LAST displaced request's recovered stream re-emitted
+    # its first token (mttr_from_events' definition, on the SDC events)
+    mttr_sdc = []
+    for ev in sdc_evs:
+        disp = ev.get("displaced") or []
+        if not disp:
+            continue
+        recov = [fin_by[rid]["first_token_t"] - ev["t"]
+                 for rid in disp if rid in fin_by]
+        mttr_sdc.append(round(max(recov), 6) if recov else None)
+    mttr_ok = [m for m in mttr_sdc if m is not None]
+    return {
+        "corrupt": args.corrupt,
+        "sdc_detect": detect,
+        "scrub": cfg.scrub,
+        "corrupts_fired": len(fired),
+        "corrupt_events": _round6(fired),
+        "sdc_injected": len(fired),
+        "sdc_escaped": (None if control is None else
+                        streams_diverged + acct["requests_lost"]),
+        "sdc_events": _round6(sdc_evs),
+        "mttd_sdc": mttds,
+        "mttd_sdc_mean": (round(sum(mttd_ok) / len(mttd_ok), 6)
+                          if mttd_ok else None),
+        "mttr_sdc_s": mttr_sdc,
+        "mttr_sdc_s_mean": (round(sum(mttr_ok) / len(mttr_ok), 6)
+                            if mttr_ok else None),
+    }
+
+
 def main(argv=None) -> int:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("-m", "--model", default="transformer_s")
@@ -210,6 +418,26 @@ def main(argv=None) -> int:
                         "virtual time T (repeatable; pool lost, records "
                         "salvaged, requests failed over bitwise). Under "
                         "--disaggregate: T:pR (prefill) / T:dR (decode)")
+    p.add_argument("--corrupt", action="append", default=[],
+                   metavar="T:R:TARGET[@L.S]",
+                   help="flip one bit at virtual time T in replica R's "
+                        "data plane (repeatable). TARGET: payload | "
+                        "sidecar (int8 scale row, needs --kv-dtype int8) "
+                        "| prefix (a prefix-cache-shared page) | ship "
+                        "(in-flight handoff payload, needs --disaggregate "
+                        "and R=0). @L.S pins model layer + pool slot; "
+                        "omitted, a settled resident page is picked at "
+                        "fire time. Arms the checksum ledger "
+                        "(cfg.integrity) unless --no-detect")
+    p.add_argument("--no-detect", action="store_true",
+                   help="run --corrupt WITHOUT the checksum ledger: the "
+                        "honest no-defense measurement — the row reports "
+                        "the escaped stream divergence instead of "
+                        "recovery")
+    p.add_argument("--scrub", type=int, default=None, metavar="N",
+                   help="background-scrubber budget in pages/step "
+                        "(needs --corrupt; default: a full pool sweep "
+                        "per step when detection is armed)")
     p.add_argument("--stall", action="append", default=[], metavar="T:R:D",
                    help="straggler: replica at fleet index R makes no "
                         "progress for D global steps starting at time T "
@@ -247,6 +475,18 @@ def main(argv=None) -> int:
     p.add_argument("--tail-frac", type=float, default=0.25)
     p.add_argument("--slo-ttft", type=float, default=16.0)
     p.add_argument("--slo-itl", type=float, default=2.0)
+    p.add_argument("--prefix-cache", action="store_true",
+                   help="enable the cross-request prefix cache "
+                        "(serve/prefix.py) — required by the `prefix` "
+                        "--corrupt target, which flips a bit in a "
+                        "cache-shared page")
+    p.add_argument("--shared-prefix", default=None, metavar="G:P",
+                   help="shared-prefix workload mode (servebench's flag): "
+                        "prompts draw from G groups sharing a P-token "
+                        "prefix — with --prefix-cache this is what gives "
+                        "the `prefix` --corrupt target a genuinely SHARED "
+                        "page (refcount >= 2) to flip, so the quarantine "
+                        "walk recovers several holders at once")
     p.add_argument("--kv-dtype", default=None,
                    choices=("float32", "bfloat16", "int8"))
     p.add_argument("--speculative", default=None, metavar="ngram:N:K")
@@ -283,8 +523,40 @@ def main(argv=None) -> int:
     disagg = parse_disaggregate(args.disaggregate, p.error)
     kills = _parse_kills(args.kill, p.error, disagg=bool(disagg))
     stalls = _parse_stalls(args.stall, p.error)
+    corrupts = _parse_corrupts(args.corrupt, p.error, disagg=bool(disagg))
     retry = parse_retry(args.retry, p.error)
     autoscale = parse_autoscale(args.autoscale, p.error)
+    if args.no_detect and not corrupts:
+        p.error("--no-detect needs --corrupt (there is nothing to not "
+                "detect)")
+    if args.scrub is not None:
+        if args.scrub < 0:
+            p.error("--scrub must be >= 0 pages/step")
+        if not corrupts:
+            p.error("--scrub needs --corrupt (measure clean scrub "
+                    "overhead with servebench --scrub instead)")
+        if args.no_detect and args.scrub:
+            p.error("--scrub needs the checksum ledger; drop --no-detect")
+    for t, fleet, r, tgt, layer, slot in corrupts:
+        if tgt == "ship":
+            if not disagg:
+                p.error(f"--corrupt {t:g}:{r}:ship: the ship target "
+                        f"corrupts an in-flight handoff payload — it "
+                        f"needs --disaggregate")
+            if r != 0:
+                p.error(f"--corrupt {t:g}:{r}:ship: the wire has no "
+                        f"replica index; use T:0:ship")
+        if tgt == "sidecar" and (args.kv_dtype or "float32") != "int8":
+            p.error(f"--corrupt {t:g}:...:sidecar: the scale sidecar "
+                    f"only exists for --kv-dtype int8")
+        if tgt == "prefix" and not args.prefix_cache:
+            p.error(f"--corrupt {t:g}:...:prefix: the prefix target "
+                    f"flips a cache-shared page — it needs "
+                    f"--prefix-cache")
+        if slot is not None and slot >= args.pool_pages:
+            p.error(f"--corrupt @{layer}.{slot}: slot {slot} out of "
+                    f"range for --pool-pages {args.pool_pages} "
+                    f"(valid slots: 1..{args.pool_pages - 1})")
     if autoscale:
         if args.scale_window <= 0:
             p.error("--scale-window must be > 0 time units")
@@ -355,6 +627,21 @@ def main(argv=None) -> int:
             if r >= args.replicas:
                 p.error(f"--stall {t:g}:{r}:{d}: fleet index {r} out of "
                         f"range for a {args.replicas}-replica fleet")
+    # corrupt specs address the KILL-WALKED fleet: a replica dead by T
+    # cannot host a bit-flip (under --autoscale repairs re-grow the
+    # fleet, so only the full-size bound applies — like --kill's walk)
+    for t, fleet, r, tgt, layer, slot in corrupts:
+        if tgt == "ship":
+            continue
+        full = ({"p": disagg[0], "d": disagg[1]} if disagg
+                else {None: args.replicas})[fleet]
+        dead = (0 if autoscale else
+                sum(1 for kt, kf, _ in kills if kf == fleet and kt <= t))
+        if r >= full - dead:
+            name = {"p": "prefill ", "d": "decode "}.get(fleet, "")
+            p.error(f"--corrupt {t:g}:{fleet or ''}{r}:{tgt}: "
+                    f"{name}fleet index {r} out of range — at most "
+                    f"{full - dead} replicas remain by t={t:g}")
     if stalls and not args.heartbeat:
         print("servechaos: WARNING --stall without --heartbeat: the "
               "straggler is never detected, its requests just wait it "
@@ -388,6 +675,21 @@ def main(argv=None) -> int:
 
     plo, ptyp, phi = (int(x) for x in args.prompt_lens.split(","))
     olo, otyp, ohi = (int(x) for x in args.out_lens.split(","))
+    groups = prefix_len = 0
+    if args.shared_prefix:
+        try:
+            groups, prefix_len = (int(x)
+                                  for x in args.shared_prefix.split(":"))
+        except ValueError:
+            p.error("--shared-prefix wants G:P (groups:prefix_tokens), "
+                    f"got {args.shared_prefix!r}")
+    # --corrupt arms the checksum ledger unless --no-detect asks for the
+    # honest no-defense run; the scrubber defaults to a full pool sweep
+    # per step so a settled-page flip is always caught within one step
+    # (--scrub N trades detection latency for the verify budget)
+    detect = bool(corrupts) and not args.no_detect
+    scrub = (0 if not detect else
+             (args.scrub if args.scrub is not None else args.pool_pages))
     cfg = ServeConfig(
         max_batch=args.max_batch, pool_pages=args.pool_pages,
         page=args.page, max_len=min(args.max_len, spec.seq_len),
@@ -397,6 +699,8 @@ def main(argv=None) -> int:
         replicas=1 if disagg else args.replicas, slo_ttft=args.slo_ttft,
         slo_itl=args.slo_itl, heartbeat=args.heartbeat,
         kv_dtype=args.kv_dtype or "float32",
+        prefix_cache=args.prefix_cache,
+        integrity=detect, scrub=scrub,
         speculative=args.speculative or "none")
     cfg.validate()
 
@@ -408,7 +712,8 @@ def main(argv=None) -> int:
             burst_size=args.burst_size, burst_factor=args.burst_factor,
             prompt_lo=plo, prompt_typical=ptyp, prompt_hi=phi,
             out_lo=olo, out_typical=otyp, out_hi=ohi,
-            tail_frac=args.tail_frac, max_len=cfg.max_len,
+            tail_frac=args.tail_frac, prefix_groups=groups,
+            prefix_len=prefix_len, max_len=cfg.max_len,
             deadline_slack=args.deadline_slack,
             batch_frac=args.tier_mix or 0.0)
 
@@ -421,6 +726,19 @@ def main(argv=None) -> int:
                                       shared_fns=shared)
         return make_server(model, params, state, cfg, shared_fns=shared)
 
+    def check_layers(srv):
+        # an explicit @L pin must name a layer that owns a KV pool —
+        # checked on the first built server, before any run burns steps
+        if not corrupts:
+            return
+        from ddlbench_tpu.serve.integrity import pool_layers
+
+        valid = pool_layers(srv.engines[0])
+        for t, fleet, r, tgt, layer, slot in corrupts:
+            if layer is not None and layer not in valid:
+                p.error(f"--corrupt @{layer}.{slot}: model layer {layer} "
+                        f"owns no KV pool (attention layers: {valid})")
+
     t0 = time.perf_counter()
     # -- control: the same workload, no faults — the bitwise stream
     # reference and the unfaulted goodput baseline (skippable)
@@ -428,6 +746,7 @@ def main(argv=None) -> int:
     shared_fns = None
     if not args.no_control:
         control = build(None)
+        check_layers(control)
         shared_fns = control.engines[0].jit_fns()
         _run(control, workload(), args, retry)
     # -- scripted-recovery baseline (--autoscale only): the SAME faults
@@ -450,6 +769,8 @@ def main(argv=None) -> int:
                   file=sys.stderr, flush=True)
     # -- the chaos run
     server = build(shared_fns)
+    if args.no_control:
+        check_layers(server)
     controllers = None
     if autoscale:
         from ddlbench_tpu.serve.autoscaler import (AutoscalePolicy,
@@ -462,8 +783,12 @@ def main(argv=None) -> int:
                               cooldown_down=args.scale_cooldown)
         controllers = make_controllers(server, pol)
     dstats = {}
+    corrupts_fired = []
     duration = _run(server, workload(), args, retry,
-                    events=_fault_events(kills, stalls),
+                    events=sorted(
+                        _fault_events(kills, stalls)
+                        + _corrupt_events(corrupts, corrupts_fired),
+                        key=lambda e: e[0]),
                     driver_stats=dstats, controllers=controllers)
     wall = time.perf_counter() - t0
 
@@ -529,6 +854,12 @@ def main(argv=None) -> int:
         "tier_mix": args.tier_mix,
         "kv_dtype": cfg.kv_dtype,
         "speculative": cfg.speculative,
+        # --prefix-cache only (plain rows keep their key set): the cache
+        # the `prefix` corrupt target flips shared pages in, plus the
+        # shared-prefix traffic shape that makes those pages shared
+        **({"prefix_cache": True,
+            "shared_prefix": args.shared_prefix}
+           if args.prefix_cache else {}),
         "kills_fired": len(server.fail_events),
         "stalls_fired": len(server.stall_events),
         "heartbeat_drains": len(server.heartbeat_events),
@@ -582,6 +913,8 @@ def main(argv=None) -> int:
         **{k: (round(v, 6) if isinstance(v, float) else v)
            for k, v in eng_stats.items()
            if k not in ("completed", "timeouts", "shed")},
+        **_sdc_block(args, corrupts, corrupts_fired, detect, cfg, server,
+                     fin, control, streams_diverged, acct),
         **prov,
     }
     if args.wall_clock:

@@ -1,0 +1,332 @@
+#!/usr/bin/env bash
+# Round-23 opportunistic TPU collector. Carries the still-unlanded
+# round-9..22 queue (same task names, so any .ok marker earned in a
+# previous window sticks), then adds the SDC-DEFENSE round (ISSUE 20):
+# the page-checksum ledger measured on chip:
+#
+#   * corrupt-vs-control bitwise gate first: servechaos --corrupt flips a
+#     REAL device bit in a settled pool page (exponent byte), the armed
+#     run detects, quarantines, and recovers — token streams BITWISE vs
+#     the unfaulted control, requests_lost == 0, with mttd_sdc /
+#     mttr_sdc_s in the row; the --no-detect twin on the same seed
+#     honestly reports nonzero escaped stream divergence;
+#   * the scrub-budget sweep {0,1,4,16} on CLEAN traffic: the ledger's
+#     host-side overhead as a wall-clock delta at bitwise-identical
+#     virtual-time metrics (the --scrub row's sdc_scrubbed counts the
+#     verified pages);
+#   * handoff wire faults under disaggregation: a corrupt in-flight ship
+#     is rejected all-or-nothing and retransmitted (sdc_wire_detected ==
+#     sdc_wire_repaired == 1, shipped_checksum_bytes in the wire bill),
+#     plus a decode-fleet pool flip composed with a prefill kill.
+#
+# Expectations in PERF.md § round 23.
+#
+# Usage: scripts/tpu_round23.sh [max_hours]   (prefer scripts/watcher_ctl.sh)
+set -u
+cd "$(dirname "$0")/.."
+. scripts/tpu_window_lib.sh
+
+# -- carried queue (names unchanged; earlier windows' .ok markers count) ----
+add_task bench_r4              python bench.py --probe-timeout-s 60 --prefetch-depth ${BENCH_PREFETCH_DEPTH:-2}
+add_task accparity_tpu_r4      python -m ddlbench_tpu.tools.accparity --engines single --platform tpu
+add_task bench_ov_b4_f32_r9  python bench.py --probe-timeout-s 60 -f dp -g 4 --batch-size 64 --dp-shard-update --comm-buckets 4
+add_task accparity_int8_r9 python -m ddlbench_tpu.tools.accparity --engines single,dp,dp-int8,dp-shard-int8,dp-shard-ov4
+add_task pipe_zerobubble_r10 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_zerobubble_r10.jsonl --trace perf_runs/trace_zerobubble_r10.json --trace-dir perf_runs/xla_zerobubble_r10 --xla-trace-steps 10:14
+add_task pipe_hyb_1f1b_r11      python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 8 -e 1 --steps-per-epoch 30 --pipe-schedule 1f1b --dp-shard-update --comm-buckets 4 --jsonl perf_runs/pipe_hyb_1f1b_r11.jsonl --trace perf_runs/trace_hyb_1f1b_r11.json --trace-dir perf_runs/xla_hyb_1f1b_r11 --xla-trace-steps 10:14
+add_task serve_poisson_mid_r12 python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 0.5
+add_task serve_rep4_r12        python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 12 --wall-clock --platform tpu --arrival poisson --rate 2.0 --replicas 4 --requests 192
+add_task decodebench_prov_r12  python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu
+PFX_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 128 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu"
+add_task serve_pfx_on_lo_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache
+add_task serve_pfx_off_lo_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64
+add_task serve_pfx_on_hi_r13   python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384 --prefix-cache
+add_task serve_pfx_off_hi_r13  python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 2:384
+add_task serve_pfx_ctl_r13     python -m ddlbench_tpu.tools.servebench $PFX_COMMON --prefix-cache
+PFX_SMALL="-m transformer_s -b synthtext --max-batch 8 --pool-pages 48 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 13 --wall-clock --platform tpu --shared-prefix 4:64"
+add_task serve_pfx_smallpool_r13     python -m ddlbench_tpu.tools.servebench $PFX_SMALL --prefix-cache
+add_task serve_pfx_smallpool_off_r13 python -m ddlbench_tpu.tools.servebench $PFX_SMALL
+add_task serve_sample_r13      python -m ddlbench_tpu.tools.servebench $PFX_COMMON --shared-prefix 4:64 --prefix-cache --sample temperature:0.8,top-k:40
+add_task decodebench_chunk_r13    python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16
+add_task decodebench_chunk_ew_r13 python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --chunk-prefill --chunk-sizes 64,128 --chunk-pages 4,16 --paged-kernel elementwise
+
+# -- round-14a: tracing overhead gate (bitwise JSON, wall_s within noise) --
+# SAME seeded bursty heavy-tail traffic, traced vs untraced. Virtual-time
+# fields must match bit for bit; wall_s delta is the tracing cost.
+TRC_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 14 --wall-clock --platform tpu --policies continuous"
+add_task serve_trace_off_r14   python -m ddlbench_tpu.tools.servebench $TRC_COMMON
+add_task serve_trace_on_r14    python -m ddlbench_tpu.tools.servebench $TRC_COMMON --trace perf_runs/serve_trace_r14.json --timeline --window 64
+
+# -- round-14b: serveview reduction of the traced bursty run ---------------
+# (runs after 14a writes the trace; windowed attainment should dip through
+# the burst and recover; decomp_exact must be true)
+add_task serveview_bursty_r14  python -m ddlbench_tpu.telemetry.serveview perf_runs/serve_trace_r14.json --window 64 --per-request
+
+# -- round-14c: eviction waste decomposed (small pool, traced) -------------
+add_task serve_trace_evict_r14 python -m ddlbench_tpu.tools.servebench -m transformer_s -b synthtext --max-batch 8 --pool-pages 40 --page 16 --max-len 512 --requests 64 --arrival poisson --rate 0.6 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 14 --wall-clock --platform tpu --policies continuous --trace perf_runs/serve_trace_evict_r14.json --timeline --window 64
+
+
+# -- round-15a: elastic chaos A/B (dp ZeRO-1, shrink then grow) ------------
+# trajectory_match + post_reshape_divergence==0.0 are the gates; the MTTR
+# split (mttr_reshape_s vs the kill run's mttr_s) is the measurement.
+CHAOS_R15="-b mnist -m lenet -f dp --steps-per-epoch 30 -e 2 --checkpoint-every-steps 10 --log-interval 1"
+add_task chaos_reshape_r15 python -m ddlbench_tpu.tools.chaosbench --kills 0 --reshape shrink@1:20:2 --reshape grow@2:10:4 $CHAOS_R15 -g 4 --batch-size 8 --json perf_runs/chaos_reshape_r15.json --platform tpu -- --dp-shard-update --elastic-slices 4
+add_task chaos_kill_r15    python -m ddlbench_tpu.tools.chaosbench --kills 2 $CHAOS_R15 -g 4 --batch-size 8 --json perf_runs/chaos_kill_r15.json --platform tpu -- --dp-shard-update --elastic-slices 4
+
+# -- round-15b: the elastic-slices tax (step-time A/B at a fixed world) ----
+# (non-BN arch: the canonical-tree mode is scoped to stateless models)
+ELX_R15="-b synthtext -m transformer_s -f dp -g 4 --batch-size 4 -e 1 --steps-per-epoch 60 --dp-shard-update"
+add_task dp_elastic_off_r15 python -m ddlbench_tpu.cli $ELX_R15 --dtype float32 --jsonl perf_runs/dp_elastic_off_r15.jsonl
+add_task dp_elastic_on_r15  python -m ddlbench_tpu.cli $ELX_R15 --dtype float32 --elastic-slices 4 --jsonl perf_runs/dp_elastic_on_r15.jsonl
+
+# -- round-15c: live serving resize under bursty load ----------------------
+RSZ_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 128 --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 15 --wall-clock --platform tpu --policies continuous --replicas 4"
+add_task serve_resize_r15     python -m ddlbench_tpu.tools.servebench $RSZ_COMMON --resize 120:2 --resize 360:4 --trace perf_runs/serve_resize_r15.json --timeline --window 64
+add_task serve_resize_ctl_r15 python -m ddlbench_tpu.tools.servebench $RSZ_COMMON
+
+# -- round-16a: int8 KV capacity A/B -----------------------------------------
+# Same seeded bursty heavy-tail traffic per dtype at EQUAL pages, then the
+# equal-HBM run: int8 at 2x the pages of bf16 (pool_bytes equal — the row
+# reports both). Goodput/evictions/backpressure are the capacity signal.
+KV_COMMON="-m transformer_s -b synthtext --max-batch 8 --page 16 --max-len 512 --requests 96 --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 16 --wall-clock --platform tpu --policies continuous"
+add_task serve_kv_f32_r16       python -m ddlbench_tpu.tools.servebench $KV_COMMON --pool-pages 64 --kv-dtype float32
+add_task serve_kv_bf16_r16      python -m ddlbench_tpu.tools.servebench $KV_COMMON --pool-pages 64 --kv-dtype bfloat16
+add_task serve_kv_int8_r16      python -m ddlbench_tpu.tools.servebench $KV_COMMON --pool-pages 64 --kv-dtype int8
+add_task serve_kv_int8_eqhbm_r16 python -m ddlbench_tpu.tools.servebench $KV_COMMON --pool-pages 128 --kv-dtype int8
+
+# -- round-16b: the digits gate on chip --------------------------------------
+# Closed-loop (completion-deterministic) f32 vs int8: compare token streams
+# offline; agreement must stay within the CPU-pinned budget
+# (tests/test_serve_quant.py DIGITS_GATE).
+KV_GATE="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 64 --arrival closed --concurrency 16 --prompt-lens 16,64,96 --out-lens 8,32,64 --seed 16 --wall-clock --platform tpu --policies continuous"
+add_task serve_kv_digits_f32_r16  python -m ddlbench_tpu.tools.servebench $KV_GATE --kv-dtype float32
+add_task serve_kv_digits_int8_r16 python -m ddlbench_tpu.tools.servebench $KV_GATE --kv-dtype int8
+
+# -- round-16c: fused-dequant kernel vs XLA reference per dtype --------------
+add_task decodebench_kv_r16    python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --kv-dtype float32,bfloat16,int8 --chunk-sizes 64,128 --chunk-pages 4,16
+add_task decodebench_kv_ew_r16 python -m ddlbench_tpu.tools.decodebench -m seq2seq_s -b synthmt --skip-uncached --repeats 3 --platform tpu --kv-dtype float32,bfloat16,int8 --chunk-sizes 64,128 --chunk-pages 4,16 --paged-kernel elementwise
+
+# -- round-16d: speculative decode on/off x {closed, bursty} -----------------
+# Streams are pinned bitwise on the CPU fixtures; compare the on/off token
+# streams here too (ARCHITECTURE.md's verify-vs-decode near-tie caveat)
+# before reading the headline: does tokens_per_pass beat the verify pass's
+# (K+1)x FLOP cost in wall clock?
+SPEC_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 16 --wall-clock --platform tpu --policies continuous"
+add_task serve_spec_on_closed_r16  python -m ddlbench_tpu.tools.servebench $SPEC_COMMON --arrival closed --concurrency 16 --speculative ngram:3:4
+add_task serve_spec_off_closed_r16 python -m ddlbench_tpu.tools.servebench $SPEC_COMMON --arrival closed --concurrency 16
+add_task serve_spec_on_bursty_r16  python -m ddlbench_tpu.tools.servebench $SPEC_COMMON --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8 --speculative ngram:3:4
+add_task serve_spec_off_bursty_r16 python -m ddlbench_tpu.tools.servebench $SPEC_COMMON --arrival bursty --rate 0.5 --burst-size 16 --burst-factor 8
+
+# -- round-16e: acceptance vs prompt entropy ---------------------------------
+# Shared-prefix low-entropy traffic (the repetitive case the self-drafter
+# exists for): spec_accept_rate > 0 and tokens_per_pass > 1 are the win
+# condition; compose with the prefix cache to stack both savings.
+SPEC_REP="-m transformer_s -b synthtext --max-batch 8 --pool-pages 128 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,96 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 16 --wall-clock --platform tpu --shared-prefix 2:384"
+add_task serve_spec_rep_r16     python -m ddlbench_tpu.tools.servebench $SPEC_REP --prefix-cache --speculative ngram:3:4
+add_task serve_spec_rep_ctl_r16 python -m ddlbench_tpu.tools.servebench $SPEC_REP --prefix-cache
+
+# -- round-17a: --plan auto vs each fixed strategy (same global batch) ------
+# resnet152: global batch 32 = micro 8 x chunks 4 (gpipe grammar); the dp
+# rows run batch-size 32/world equivalents. transformer_m: 4 x 8 = 32 rows.
+# The auto rows leave every mix flag unset — the planner owns them; the
+# decision lands in perf_runs/plan_r17_*/partition.json.
+RSN_R17="-b imagenet -m resnet152 -e 1 --steps-per-epoch 30 --dtype float32"
+TFM_R17="-b synthtext -m transformer_m -e 1 --steps-per-epoch 30 --dtype float32"
+add_task plan_auto_rsn_g2_r17  python -m ddlbench_tpu.cli $RSN_R17 -f gpipe -g 2 --plan auto --micro-batch-size 8 --num-microbatches 4 --profile-mode time --checkpoint-dir perf_runs/plan_r17_rsn_g2 --jsonl perf_runs/plan_auto_rsn_g2_r17.jsonl
+add_task plan_auto_rsn_g4_r17  python -m ddlbench_tpu.cli $RSN_R17 -f gpipe -g 4 --plan auto --micro-batch-size 8 --num-microbatches 4 --profile-mode time --checkpoint-dir perf_runs/plan_r17_rsn_g4 --jsonl perf_runs/plan_auto_rsn_g4_r17.jsonl
+add_task plan_auto_tfm_g2_r17  python -m ddlbench_tpu.cli $TFM_R17 -f gpipe -g 2 --plan auto --micro-batch-size 4 --num-microbatches 8 --profile-mode time --checkpoint-dir perf_runs/plan_r17_tfm_g2 --jsonl perf_runs/plan_auto_tfm_g2_r17.jsonl
+add_task plan_auto_tfm_g4_r17  python -m ddlbench_tpu.cli $TFM_R17 -f gpipe -g 4 --plan auto --micro-batch-size 4 --num-microbatches 8 --profile-mode time --checkpoint-dir perf_runs/plan_r17_tfm_g4 --jsonl perf_runs/plan_auto_tfm_g4_r17.jsonl
+add_task plan_fixed_rsn_dp_g4_r17   python -m ddlbench_tpu.cli $RSN_R17 -f dp -g 4 --batch-size 8 --dp-shard-update --jsonl perf_runs/plan_fixed_rsn_dp_g4_r17.jsonl
+add_task plan_fixed_rsn_fd_g4_r17   python -m ddlbench_tpu.cli $RSN_R17 -f gpipe -g 4 --stages 4 --micro-batch-size 8 --num-microbatches 4 --jsonl perf_runs/plan_fixed_rsn_fd_g4_r17.jsonl
+add_task plan_fixed_rsn_1f1b_g4_r17 python -m ddlbench_tpu.cli $RSN_R17 -f gpipe -g 4 --stages 4 --micro-batch-size 8 --num-microbatches 4 --pipe-schedule 1f1b --jsonl perf_runs/plan_fixed_rsn_1f1b_g4_r17.jsonl
+add_task plan_fixed_tfm_dp_g4_r17   python -m ddlbench_tpu.cli $TFM_R17 -f dp -g 4 --batch-size 8 --dp-shard-update --jsonl perf_runs/plan_fixed_tfm_dp_g4_r17.jsonl
+add_task plan_fixed_tfm_1f1b_g4_r17 python -m ddlbench_tpu.cli $TFM_R17 -f gpipe -g 4 --stages 4 --micro-batch-size 4 --num-microbatches 8 --pipe-schedule 1f1b --jsonl perf_runs/plan_fixed_tfm_1f1b_g4_r17.jsonl
+
+# -- round-17b: the on-chip memory-cap flip ---------------------------------
+# Same resnet152 g4 auto run under a 2 GiB cap: every pp=1 candidate goes
+# infeasible (weights+grads+opt on one chip) and the winner must flip to a
+# pipeline split — compare partition.json vs the roomy run's.
+add_task plan_auto_rsn_cap_r17 python -m ddlbench_tpu.cli $RSN_R17 -f gpipe -g 4 --plan auto --micro-batch-size 8 --num-microbatches 4 --profile-mode time --hbm-gb 2 --checkpoint-dir perf_runs/plan_r17_rsn_cap --jsonl perf_runs/plan_auto_rsn_cap_r17.jsonl
+
+# -- round-17c: planbench prediction-error rows -----------------------------
+# time mode = the judged err_frac rows; flops mode = provenance only (the
+# v5e constants price the real machine here, unlike the CPU fallback).
+add_task planbench_time_r17  python -m ddlbench_tpu.tools.planbench --pairs lenet:mnist,resnet18:cifar10,resnet152:imagenet,transformer_s:synthtext,transformer_m:synthtext --worlds 2,4 --steps 20 --warmup 4 --profile-mode time --platform tpu
+add_task planbench_flops_r17 python -m ddlbench_tpu.tools.planbench --pairs resnet152:imagenet,transformer_m:synthtext --worlds 2,4 --steps 20 --warmup 4 --profile-mode flops --platform tpu
+
+# -- round-18a: kill/stall failover A/B vs unfaulted control ----------------
+# Same seeded Poisson heavy-tail traffic over 4 replicas. Gates on chip
+# match the CPU pins (requests_lost 0, streams_match true); the chip
+# numbers are mttr_replica_s and the TTFT hump through the failover.
+SC_COMMON="-m transformer_s -b synthtext --replicas 4 --max-batch 8 --page 16 --max-len 512 --requests 128 --arrival poisson --rate 2.0 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 18 --wall-clock --platform tpu"
+add_task servechaos_ctrl_r18  python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --no-control
+add_task servechaos_kill_r18  python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --kill 120:3
+add_task servechaos_stall_r18 python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --stall 120:1:80 --heartbeat 16
+# heartbeat-window sweep: MTTR ~linear in W, zero false positives
+add_task servechaos_stall_w8_r18  python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --stall 120:1:80 --heartbeat 8
+add_task servechaos_stall_w32_r18 python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --stall 120:1:80 --heartbeat 32
+
+# -- round-18b: pool-pressure MTTR (the kill at half the pool) --------------
+add_task servechaos_kill_small_r18 python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 48 --kill 120:3
+
+# -- round-18c: tiered overload (interactive SLO held, batch sheds) ---------
+# ~1.5x capacity; the per-tier split lands in the JSON row. The untiered
+# twin at the same load is the inertness/overall-attainment baseline.
+OVL_R18="-m transformer_s -b synthtext --replicas 2 --max-batch 8 --pool-pages 64 --page 16 --max-len 512 --requests 128 --arrival poisson --rate 3.0 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 18 --wall-clock --platform tpu --no-control"
+add_task servechaos_tier_r18     python -m ddlbench_tpu.tools.servechaos $OVL_R18 --tier-mix 0.5
+add_task servechaos_untier_r18   python -m ddlbench_tpu.tools.servechaos $OVL_R18
+
+# -- round-18d: the shed-vs-timeout deadline sweep --------------------------
+# Fixed overload, slack swept: tight slack converts timeouts to sheds
+# (goodput knee), retry 2:8 prices the resubmission pressure. The
+# accounting identity completed+timeouts+rejected+lost==requests holds
+# on every row with lost==0.
+for S in 16 32 64 128; do
+  add_task servechaos_dl${S}_r18 python -m ddlbench_tpu.tools.servechaos $OVL_R18 --deadline-slack $S --retry 2:8
+done
+# deadline x kill: shed/timeout economics while failing over
+add_task servechaos_dl_kill_r18 python -m ddlbench_tpu.tools.servechaos $SC_COMMON --pool-pages 96 --deadline-slack 64 --retry 2:8 --kill 120:3
+
+# -- round-19a: disaggregated vs aggregated at equal chips ------------------
+# Same seeded Poisson heavy-tail traffic, 4 chips each way. The continuous
+# policy only (disaggregation presupposes it); --no-control on the chaos
+# rows below keeps windows short.
+DIS_COMMON="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 128 --arrival poisson --rate 2.0 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 19 --wall-clock --platform tpu --policies continuous"
+add_task serve_agg_r19        python -m ddlbench_tpu.tools.servebench $DIS_COMMON --replicas 4
+add_task serve_disagg_13_r19  python -m ddlbench_tpu.tools.servebench $DIS_COMMON --disaggregate 1:3
+add_task serve_disagg_22_r19  python -m ddlbench_tpu.tools.servebench $DIS_COMMON --disaggregate 2:2
+add_task serve_disagg_31_r19  python -m ddlbench_tpu.tools.servebench $DIS_COMMON --disaggregate 3:1
+# light load: where aggregated should still win (no interference to remove)
+DIS_LIGHT="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 64 --arrival poisson --rate 0.4 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 19 --wall-clock --platform tpu --policies continuous"
+add_task serve_agg_light_r19    python -m ddlbench_tpu.tools.servebench $DIS_LIGHT --replicas 2
+add_task serve_disagg_light_r19 python -m ddlbench_tpu.tools.servebench $DIS_LIGHT --disaggregate 1:1
+
+# -- round-19b: the handoff wire bill per pool dtype ------------------------
+# shipped_payload_bytes must quarter exactly f32 -> int8 at equal pages;
+# sidecar bytes land in their own counter.
+add_task serve_disagg_f32_r19  python -m ddlbench_tpu.tools.servebench $DIS_COMMON --disaggregate 2:2 --kv-dtype float32
+add_task serve_disagg_int8_r19 python -m ddlbench_tpu.tools.servebench $DIS_COMMON --disaggregate 2:2 --kv-dtype int8
+
+# -- round-19c: per-fleet kills (vs the round-18 aggregated kill) -----------
+SC19="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 128 --arrival poisson --rate 2.0 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 19 --wall-clock --platform tpu"
+add_task servechaos_disagg_pkill_r19 python -m ddlbench_tpu.tools.servechaos $SC19 --disaggregate 2:2 --kill 120:p1
+add_task servechaos_disagg_dkill_r19 python -m ddlbench_tpu.tools.servechaos $SC19 --disaggregate 2:2 --kill 120:d1
+add_task servechaos_disagg_dkill_int8_r19 python -m ddlbench_tpu.tools.servechaos $SC19 --disaggregate 2:2 --kill 120:d1 --kv-dtype int8
+
+# -- round-19d: tp scaling efficiency (memory-motivated sharding) -----------
+TP19="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 64 --arrival closed --concurrency 16 --prompt-lens 16,64,96 --out-lens 8,32,64 --seed 19 --wall-clock --platform tpu --policies continuous"
+add_task serve_tp1_r19 python -m ddlbench_tpu.tools.servebench $TP19
+add_task serve_tp2_r19 python -m ddlbench_tpu.tools.servebench $TP19 --serve-tp 2
+add_task serve_tp4_r19 python -m ddlbench_tpu.tools.servebench $TP19 --serve-tp 4
+
+# -- round-20a: the tie-out on the real compiler ----------------------------
+# Each --audit manifest carries its own reconcile verdict; grep
+# '"ok": false' across perf_runs/audit_*_r20.json is the round's gate.
+AUD_TRAIN="-b mnist -m lenet -e 1 --steps-per-epoch 10 --dtype float32"
+add_task audit_dp_shard_r20 python -m ddlbench_tpu.cli $AUD_TRAIN -f dp -g 4 --batch-size 8 --dp-shard-update --comm-buckets 4 --audit perf_runs/audit_dp_shard_r20.json
+add_task audit_dp_int8_r20  python -m ddlbench_tpu.cli $AUD_TRAIN -f dp -g 4 --batch-size 8 --dp-shard-update --comm-buckets 4 --allreduce-dtype int8 --audit perf_runs/audit_dp_int8_r20.json
+add_task audit_gpipe_r20    python -m ddlbench_tpu.cli -b synthtext -m transformer_s -e 1 --steps-per-epoch 10 --dtype float32 -f gpipe -g 4 --stages 2 --dp-replicas 2 --micro-batch-size 2 --num-microbatches 4 --dp-shard-update --audit perf_runs/audit_gpipe_r20.json
+add_task audit_tpp_r20      python -m ddlbench_tpu.cli -b synthtext -m transformer_t -e 1 --steps-per-epoch 10 --dtype float32 -f gpipe -g 4 --stages 2 --tp-size 2 --micro-batch-size 2 --num-microbatches 2 --no-fused-head-loss --audit perf_runs/audit_tpp_r20.json
+
+# -- round-20b: headline bench with its program fingerprint -----------------
+add_task audit_bench_r20 python bench.py --probe-timeout-s 60 --audit perf_runs/audit_bench_r20.json
+
+# -- round-20c: planner HBM model vs the chip's memory_analysis -------------
+# (also lands hbm_audit into each pair's partition.json via --plan auto)
+add_task audit_planbench_r20 python -m ddlbench_tpu.tools.planbench --pairs resnet18:cifar10,transformer_s:synthtext --worlds 2,4 --steps 10 --warmup 2 --profile-mode time --platform tpu --audit perf_runs/audit_planbench_r20.json
+
+# -- round-20d: serve-pool bytes across kv_dtype x tp -----------------------
+AUD_SERVE="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 32 --arrival closed --concurrency 8 --prompt-lens 16,64,96 --out-lens 8,32,64 --seed 20 --wall-clock --platform tpu --policies continuous"
+add_task audit_serve_f32_r20     python -m ddlbench_tpu.tools.servebench $AUD_SERVE --audit perf_runs/audit_serve_f32_r20.json
+add_task audit_serve_int8_r20    python -m ddlbench_tpu.tools.servebench $AUD_SERVE --kv-dtype int8 --audit perf_runs/audit_serve_int8_r20.json
+add_task audit_serve_tp2_r20     python -m ddlbench_tpu.tools.servebench $AUD_SERVE --serve-tp 2 --audit perf_runs/audit_serve_tp2_r20.json
+
+
+# -- carried round-21a: schedbench analytic grid (host math; audit gate) ------------
+add_task schedbench_grid_r21 python -m ddlbench_tpu.tools.schedbench --platform tpu
+
+# -- carried round-21b: measured bubble A/B across the schedule family --------------
+# Same pipeline shape as the round-10 zero-bubble row; the trace reduces to
+# the measured fraction via `python -m ddlbench_tpu.telemetry.bubble`.
+PIPE_R21="-b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30"
+add_task pipe_zb_h2_r21    python -m ddlbench_tpu.cli $PIPE_R21 --pipe-schedule zero-bubble-h2 --jsonl perf_runs/pipe_zb_h2_r21.jsonl --trace perf_runs/trace_zb_h2_r21.json
+add_task pipe_searched_r21 python -m ddlbench_tpu.cli $PIPE_R21 --pipe-schedule searched --jsonl perf_runs/pipe_searched_r21.jsonl --trace perf_runs/trace_searched_r21.json
+
+# -- carried round-21c: uneven chunks (profiled costs, raised quantization cap) -----
+# The packer's win condition: cost-weighted timetables on the REAL uneven
+# auto-partitioned split; the searched row quantizes at 64 half-ticks so
+# the search sees the unevenness the 8-cap would flatten (a clip is logged).
+UNEV_R21="-b synthtext -m transformer_m -f gpipe -g 4 --stages 4 --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --auto-partition --pipe-costs profile"
+add_task pipe_prof_zb_r21       python -m ddlbench_tpu.cli $UNEV_R21 --pipe-schedule zero-bubble --jsonl perf_runs/pipe_prof_zb_r21.jsonl
+add_task pipe_prof_searched_r21 python -m ddlbench_tpu.cli $UNEV_R21 --pipe-schedule searched --jsonl perf_runs/pipe_prof_searched_r21.jsonl
+
+# -- carried round-21d: --plan auto over the six-schedule family --------------------
+# The decision (winner, all candidates, stash_bytes) lands in
+# partition.json; the tight --hbm-gb row must record the h2 rejection.
+add_task plan_family_r21       python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --plan auto --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --jsonl perf_runs/plan_family_r21.jsonl
+add_task plan_family_tight_r21 python -m ddlbench_tpu.cli -b synthtext -m transformer_m -f gpipe -g 4 --plan auto --micro-batch-size 2 --num-microbatches 16 -e 1 --steps-per-epoch 30 --hbm-gb 2 --jsonl perf_runs/plan_family_tight_r21.jsonl
+
+
+# -- round-22a: the autoscaler headline A/B (diurnal shape) -----------------
+# Same serving shape as the round-12 open-loop rows; the A/B is
+# "autoscaler tracks the load curve": equal goodput within the pinned
+# tolerance at STRICTLY fewer replica-hours than the static-max fleet.
+# The autoscaled row exits nonzero if it loses a request (the tool gate).
+AS_R22="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 192 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 22 --wall-clock --platform tpu --arrival poisson --rate 2.0 --shape diurnal"
+add_task serve_diurnal_static_r22 python -m ddlbench_tpu.tools.servebench $AS_R22 --replicas 4
+add_task serve_diurnal_auto_r22   python -m ddlbench_tpu.tools.servebench $AS_R22 --replicas 2 --autoscale 1:4 --scale-window 32 --scale-cooldown 32
+
+# -- round-22b: where the controller loses (spike inside one cooldown) ------
+# The adversarial fixture: a 6.67x flash crowd over 15% of the run,
+# steeper than one cooldown can track — documents the loss, not a gate.
+add_task serve_spike_auto_r22 python -m ddlbench_tpu.tools.servebench $AS_R22 --shape spike --replicas 2 --autoscale 1:4 --scale-window 32 --scale-cooldown 32
+
+# -- round-22c: kill under an active controller (self-healing MTTR) ---------
+# servechaos runs the scripted-recovery baseline (same faults, no
+# controller) alongside; the row gates requests_lost == 0, streams
+# bitwise vs control, and repair MTTR <= the scripted baseline's.
+add_task servechaos_repair_r22 python -m ddlbench_tpu.tools.servechaos -m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 22 --wall-clock --platform tpu --replicas 3 --kill 200:1 --heartbeat 16 --autoscale 3:3 --scale-window 32 --scale-cooldown 32
+
+
+# -- round-23a: the corrupt-vs-control bitwise gate -------------------------
+# Aggregated fleet, one settled-payload flip per run; servechaos runs the
+# unfaulted control alongside (shared compile cache) and the row gates
+# streams bitwise + requests_lost == 0 with detection armed. f32 and int8
+# (int8 recovery leans on the counter-seeded re-quantization), plus the
+# int8 scale-sidecar target — corruption OUTSIDE the payload bytes.
+SDC_R23="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 2.0 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 23 --wall-clock --platform tpu --replicas 2"
+add_task servechaos_sdc_f32_r23     python -m ddlbench_tpu.tools.servechaos $SDC_R23 --corrupt 120:0:payload
+add_task servechaos_sdc_int8_r23    python -m ddlbench_tpu.tools.servechaos $SDC_R23 --corrupt 120:0:payload --kv-dtype int8
+add_task servechaos_sdc_sidecar_r23 python -m ddlbench_tpu.tools.servechaos $SDC_R23 --corrupt 120:0:sidecar --kv-dtype int8
+
+# -- round-23b: the disarmed twin (honest escape) ---------------------------
+# Same seed, same flip, ledger off: the row must report sdc_escaped > 0
+# (visible stream divergence vs control) — the defense is measured against
+# a twin that genuinely corrupts, not a no-op.
+add_task servechaos_sdc_escape_r23 python -m ddlbench_tpu.tools.servechaos $SDC_R23 --corrupt 120:0:payload --no-detect
+
+# -- round-23c: shared-page blast radius (prefix target) --------------------
+# Flip a prefix-cache slot with live references: the quarantine walks the
+# refcounts and every holder re-prefills bitwise; the slot leaves the
+# index for good.
+add_task servechaos_sdc_prefix_r23 python -m ddlbench_tpu.tools.servechaos $SDC_R23 --corrupt 120:0:prefix --prefix-cache --shared-prefix 4:64 --prompt-lens 16,64,96
+
+# -- round-23d: scrub-budget sweep on clean traffic -------------------------
+# The ledger's price: virtual-time metrics must stay bitwise vs the
+# unarmed control row; wall_s delta across {0,1,4,16} pages/step is the
+# host-side checksum cost curve (0 = boundary verification only).
+SCRUB_R23="-m transformer_s -b synthtext --max-batch 8 --pool-pages 96 --page 16 --max-len 512 --requests 96 --arrival poisson --rate 0.5 --prompt-lens 16,64,384 --out-lens 8,64,256 --slo-ttft 24 --slo-itl 2.0 --seed 23 --wall-clock --platform tpu --policies continuous"
+add_task serve_scrub_off_r23 python -m ddlbench_tpu.tools.servebench $SCRUB_R23
+for N in 0 1 4 16; do
+  add_task serve_scrub${N}_r23 python -m ddlbench_tpu.tools.servebench $SCRUB_R23 --scrub $N
+done
+
+# -- round-23e: handoff wire faults under disaggregation --------------------
+# A corrupt in-flight ship is rejected BEFORE any decode-pool write and
+# retransmitted from the exporter's intact buffer (park one step); the
+# decode-fleet pool flip composes with a prefill kill — detection and
+# failover recovery stack, requests_lost == 0, streams bitwise.
+add_task servechaos_sdc_ship_r23      python -m ddlbench_tpu.tools.servechaos $SDC_R23 --replicas 1 --disaggregate 2:2 --corrupt 120:0:ship
+add_task servechaos_sdc_ship_int8_r23 python -m ddlbench_tpu.tools.servechaos $SDC_R23 --replicas 1 --disaggregate 2:2 --corrupt 120:0:ship --kv-dtype int8
+add_task servechaos_sdc_dkill_r23     python -m ddlbench_tpu.tools.servechaos $SDC_R23 --replicas 1 --disaggregate 2:2 --corrupt 150:d0:payload --kill 120:p1
+
+window_loop "${1:-12}"

@@ -1,0 +1,521 @@
+"""Silent-data-corruption defense for the serving data plane (ISSUE 20)
+— the detect / quarantine / recover pins.
+
+The binding contracts:
+
+* **Detection restores bitwise streams** — an injected bit-flip in a
+  settled pool page (payload or int8 scale sidecar) is caught by the
+  checksum ledger (serve/integrity.py), the slot is quarantined, every
+  holder takes the existing eviction-recompute path, and the final
+  token streams equal an UNFAULTED control bitwise with zero requests
+  lost. int8 re-prefill regenerates pages byte-identically
+  (counter-seeded rounding), which is what makes recovery exact.
+* **Detection off is honest** — the SAME flip with the ledger disarmed
+  escapes: at least one stream visibly diverges from the control (the
+  exponent-byte flip moves the argmax). The defense is measured against
+  a twin that genuinely corrupts.
+* **Corrupt ships are rejected all-or-nothing** — a wire flip on an
+  in-flight handoff ship is caught BEFORE any pool write on the decode
+  side, the ship parks one step, the exporter "retransmits" (the stashed
+  byte restored), and the delivered streams stay bitwise. The per-page
+  checksum words ride the wire accounting (``shipped_checksum_bytes``).
+* **A corrupted shared page recovers every holder** — when a prefix-
+  cache slot with live references is flipped, the quarantine walks the
+  refcounts and every referencing request re-prefills to a bitwise
+  stream; the slot never circulates again.
+
+Engine tests ride the session ``serve_factory`` at the serve suites'
+dominant (page 4, max_len 16/24) shapes — integrity/scrub are host-side
+and not part of the compiled-program key, so this file adds ZERO new
+compiles. Injections use ``flip_pool_bit(index=3, bit=6)`` — the f32
+exponent byte — so an escaped flip is observable, and target
+``stable_stamped_slots`` so the experiment measures detection, not the
+write-frontier TOCTOU race (see the integrity module docstring).
+"""
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.sdc
+
+from tiny_models import TINY_LM  # noqa: E402
+
+from ddlbench_tpu.config import ServeConfig  # noqa: E402
+from ddlbench_tpu.serve import integrity  # noqa: E402
+from ddlbench_tpu.serve.handoff import DisaggregatedServer  # noqa: E402
+from ddlbench_tpu.serve.integrity import (CHECKSUM_BYTES,  # noqa: E402
+                                          PageLedger, checksum,
+                                          flip_pool_bit, flip_ship_bit,
+                                          page_checksum, pool_layers,
+                                          repair_ship,
+                                          stable_stamped_slots)
+from ddlbench_tpu.serve.workload import make_workload  # noqa: E402
+
+VOCAB = TINY_LM.num_classes
+POOL = 20  # pool_pages; also the full-sweep scrub budget the tests use
+
+
+def _cfg(**kw):
+    # the test_serve_chaos/test_serve_disagg shapes — the session
+    # serve_factory's compiled programs are shared, not paid again here
+    base = dict(max_batch=4, pool_pages=POOL, page=4, max_len=16,
+                prefill_chunk=4)
+    base.update(kw)
+    return ServeConfig(**base)
+
+
+def _armed(**kw):
+    # full-sweep scrub: every stamped page verified every step, so a
+    # latent flip is caught on the first step after it lands
+    base = dict(integrity=True, scrub=POOL)
+    base.update(kw)
+    return _cfg(**base)
+
+
+def _workload(seed=3, n=12):
+    return make_workload(seed=seed, n_requests=n, vocab=VOCAB,
+                         arrival="closed", prompt_lo=2, prompt_typical=5,
+                         prompt_hi=9, out_lo=2, out_typical=4, out_hi=6,
+                         max_len=16)
+
+
+def _streams(server):
+    return {f["rid"]: f["tokens"] for f in server.finished}
+
+
+def _flip_event(t, *, key=None, engine=lambda srv: srv.engines[0],
+                prefer_shared=False):
+    """A closed-loop injection event: at ``t`` (retrying each later
+    firing until pages are resident) flip one exponent bit in a SETTLED
+    stamped page of ``engine(server)``. Returns (events, record)."""
+    rec = {}
+
+    def fire(srv, clock):
+        if rec:
+            return
+        eng = engine(srv)
+        if eng.integrity is None:
+            # disarmed twin: no ledger to consult — pick a settled page
+            # straight off the decode rows' page tables (same domain the
+            # armed picker would stamp)
+            slots = sorted({
+                int(eng.table[a.row, idx])
+                for a in eng._active() if a.state == "decode"
+                for idx in range(a.decode_pos // eng.page)} - {0})
+        else:
+            slots = stable_stamped_slots(eng)
+        if prefer_shared:
+            shared = [s for s in slots
+                      if eng.allocator.refcount(s) >= 2
+                      and s in set(eng.prefix._slots.values())]
+            slots = shared or slots
+        if not slots:
+            return  # nothing settled yet; the next firing retries
+        li = pool_layers(eng)[0]
+        rec.update(flip_pool_bit(eng, li, slots[0], key=key,
+                                 index=3, bit=6))
+        rec["t"] = clock
+        rec["holders"] = eng.allocator.holders(slots[0])
+        eng.stats["sdc_injected"] += 1
+
+    return [(float(ti), fire) for ti in (t, t + 1, t + 2, t + 3)], rec
+
+
+@pytest.fixture(scope="module")
+def ctrl(serve_factory):
+    """ONE unfaulted control run per pool dtype, shared by every bitwise
+    pin here (tier-1 budget). Streams are pure functions of
+    (params, prompt): the ledger, scrub budget, and fleet layout are all
+    invisible in them, so one clean run is the control for every armed
+    and faulted variant."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    out = {}
+    for dt in ("float32", "int8"):
+        srv = serve_factory(_cfg(kv_dtype=dt), server=True)
+        run_closed_loop(srv, _workload(), 6)
+        out[dt] = _streams(srv)
+        assert set(out[dt]) == set(range(12))
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Ledger unit pins.
+# ---------------------------------------------------------------------------
+
+
+def test_checksum_covers_payload_and_sidecar():
+    """page_checksum chains every pool array of the slot in sorted key
+    order: one corrupted byte in EITHER payload or sidecar moves the
+    word, and the chaining makes it order-stable."""
+    rows = {"pool_k": np.arange(32, dtype=np.float32),
+            "pool_v": np.arange(32, 64, dtype=np.float32),
+            "scale_k": np.ones(2, dtype=np.float32)}
+    base = page_checksum(rows)
+    assert base == page_checksum(dict(reversed(list(rows.items()))))
+    for key in rows:
+        bad = {k: v.copy() for k, v in rows.items()}
+        bad[key].view(np.uint8)[3] ^= 0x40
+        assert page_checksum(bad) != base, key
+    # chaining: crc(a then b) differs from crc(b then a) at the
+    # primitive level, which is why page_checksum sorts
+    a, b = b"settled", b"pages"
+    assert checksum(b, checksum(a)) != checksum(a, checksum(b))
+
+
+def test_page_ledger_generations_and_drop():
+    led = PageLedger()
+    assert led.verify(0, 3, 123) is None  # never stamped: no expectation
+    g1 = led.stamp(0, 3, 111)
+    g2 = led.stamp(0, 3, 222)  # legitimate overwrite bumps generation
+    assert (g1, g2) == (1, 2) and led.generation(0, 3) == 2
+    assert led.expected(0, 3) == 222  # only the latest stamp binds
+    assert led.verify(0, 3, 222) is True
+    assert led.verify(0, 3, 111) is False  # stale bytes = mismatch
+    assert (led.stamps, led.verifies, led.mismatches) == (2, 2, 1)
+    led.stamp(1, 3, 333)
+    led.stamp(0, 7, 444)
+    assert led.stamped_slots() == [3, 7]
+    assert led.drop_slot(3) == 2  # both layers forget the freed slot
+    assert led.stamped_slots() == [7]
+    assert led.verify(0, 3, 222) is None
+
+
+# ---------------------------------------------------------------------------
+# Clean traffic: the armed ledger is invisible in the streams.
+# ---------------------------------------------------------------------------
+
+
+def test_clean_traffic_bitwise_with_ledger_armed(serve_factory, ctrl):
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_armed(), server=True)
+    run_closed_loop(srv, _workload(), 6)
+    assert _streams(srv) == ctrl["float32"]
+    eng = srv.engines[0]
+    assert eng.integrity.stamps > 0 and eng.integrity.verifies > 0
+    assert eng.integrity.mismatches == 0
+    st = srv.stats_summary()
+    assert st["sdc_scrubbed"] > 0
+    assert st["sdc_detected"] == st["sdc_quarantined"] == 0
+    assert st["sdc_recovered"] == 0
+
+
+# ---------------------------------------------------------------------------
+# The headline gate: injected flip -> detect -> quarantine -> bitwise
+# recovery, f32 and int8, payload and sidecar.
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.parametrize("kv_dtype,key", [
+    ("float32", None),          # payload
+    ("int8", None),             # quantized payload
+    ("int8", "scale_k"),        # f32 scale sidecar
+])
+def test_flip_detected_quarantined_recovered_bitwise(serve_factory, ctrl,
+                                                     kv_dtype, key):
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_armed(kv_dtype=kv_dtype), server=True)
+    events, rec = _flip_event(4.0, key=key)
+    run_closed_loop(srv, _workload(), 6, events=events)
+    assert rec, "injection never found a settled stamped page"
+    st = srv.stats_summary()
+    assert st["sdc_injected"] == 1
+    assert st["sdc_detected"] >= 1 and st["sdc_quarantined"] >= 1
+    # requests_lost == 0 and every stream equals the unfaulted control
+    assert _streams(srv) == ctrl[kv_dtype]
+    eng = srv.engines[0]
+    assert eng.allocator.quarantined >= 1
+    assert rec["slot"] not in eng.integrity.stamped_slots()
+    ev = [e for e in srv.sdc_events if e["slot"] == rec["slot"]]
+    assert ev and ev[0]["t"] >= rec["t"]  # detection at/after injection
+    if rec["holders"]:  # a live holder was displaced and recovered
+        assert st["sdc_recovered"] >= 1
+
+
+def test_detection_off_same_flip_escapes(serve_factory, ctrl):
+    """The honesty twin: the identical flip with the ledger disarmed
+    reaches the attention reads and at least one stream diverges from
+    the control (the defense is measured against real corruption)."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    srv = serve_factory(_cfg(), server=True)
+    events, rec = _flip_event(4.0)
+    run_closed_loop(srv, _workload(), 6, events=events)
+    assert rec and rec["holders"], "flip must land on a held page"
+    got = _streams(srv)
+    assert set(got) == set(range(12))  # nothing crashes or hangs...
+    diverged = [r for r, t in ctrl["float32"].items() if got[r] != t]
+    assert diverged, "disarmed exponent flip must visibly diverge"
+    assert set(diverged) <= set(rec["holders"])  # blast radius = holders
+
+
+# ---------------------------------------------------------------------------
+# Shared-page quarantine: every holder of a corrupted prefix page
+# recovers.
+# ---------------------------------------------------------------------------
+
+
+def _shared_workload(seed=3, n=12):
+    return make_workload(seed=seed, n_requests=n, vocab=VOCAB,
+                         arrival="closed", prompt_lo=1, prompt_typical=4,
+                         prompt_hi=8, out_lo=2, out_typical=4, out_hi=6,
+                         prefix_groups=2, prefix_len=8, max_len=24)
+
+
+def test_shared_prefix_flip_recovers_every_holder(serve_factory):
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    clean = serve_factory(_armed(prefix_cache=True, max_len=24),
+                          server=True)
+    run_closed_loop(clean, _shared_workload(), 6)
+    want = _streams(clean)
+    assert set(want) == set(range(12))
+
+    srv = serve_factory(_armed(prefix_cache=True, max_len=24),
+                        server=True)
+    events, rec = _flip_event(5.0, prefer_shared=True)
+    run_closed_loop(srv, _shared_workload(), 6, events=events)
+    assert rec, "injection never found a settled stamped page"
+    st = srv.stats_summary()
+    assert st["sdc_detected"] >= 1 and st["sdc_quarantined"] >= 1
+    assert _streams(srv) == want  # every holder recovered bitwise
+    eng = srv.engines[0]
+    assert eng.allocator.quarantined >= 1
+    # the quarantined slot left the prefix index for good
+    assert rec["slot"] not in set(eng.prefix._slots.values())
+    ev = [e for e in srv.sdc_events if e["slot"] == rec["slot"]]
+    assert ev and set(ev[0]["displaced"]) >= set(rec["holders"])
+
+
+# ---------------------------------------------------------------------------
+# Handoff wire: corrupt ships are rejected all-or-nothing and retried.
+# ---------------------------------------------------------------------------
+
+
+def _disagg(serve_factory, **kw):
+    pre = serve_factory(_armed(**kw), server=True)
+    dec = serve_factory(_armed(**kw), server=True)
+    return DisaggregatedServer(pre, dec)
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_corrupt_ship_rejected_and_retransmitted(serve_factory, ctrl,
+                                                 kv_dtype):
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory, kv_dtype=kv_dtype)
+    hit = {}
+
+    def hook(ship):
+        li = pool_layers(dis.decode.engines[0])[0]
+        hit.update(flip_ship_bit(ship, layer=li, index=3, bit=6))
+        hit["rid"] = ship["rid"]
+        dis.wire_fault_hook = None  # one-shot
+
+    dis.wire_fault_hook = hook
+    run_closed_loop(dis, _workload(), 6)
+    assert hit, "no ship ever crossed the wire"
+    st = dis.stats_summary()
+    assert st["sdc_wire_detected"] == 1 and st["sdc_wire_repaired"] == 1
+    assert st["shipped_checksum_bytes"] > 0
+    # all-or-nothing: nothing poisoned landed — streams stay bitwise and
+    # the decode pool never quarantines
+    assert _streams(dis) == ctrl[kv_dtype]
+    assert all(e.allocator.quarantined == 0 for e in dis.decode.engines)
+    wire = [e for e in dis.sdc_events if e["where"] == "wire"]
+    assert len(wire) == 1 and wire[0]["rid"] == hit["rid"]
+    assert wire[0]["repaired"] is True
+
+
+def test_ship_checksum_accounting_and_repair_roundtrip(serve_factory):
+    """Per-ship checksum words are CHECKSUM_BYTES x (pool layers x
+    pages), the fleet total matches, and repair_ship restores the exact
+    flipped byte (the retransmission model is byte-faithful)."""
+    from ddlbench_tpu.serve.handoff import (export_request,
+                                            ship_checksum_bytes)
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory)
+    ships = []
+    real_hook = dis._pending  # sanity: capture ships via the fault hook
+
+    def spy(ship):
+        if not ships:
+            ships.append({"pages": ship["pages"],
+                          "n_pages": ship["n_pages"],
+                          "bytes": ship_checksum_bytes(ship),
+                          "stamped": ship["checksum_bytes"]})
+    dis.wire_fault_hook = spy
+    run_closed_loop(dis, _workload(), 6)
+    assert ships, "no ship ever crossed the wire"
+    s = ships[0]
+    n_layers = len(pool_layers(dis.decode.engines[0]))
+    assert s["bytes"] == s["stamped"] == (
+        CHECKSUM_BYTES * n_layers * s["n_pages"])
+    assert dis.stats_summary()["shipped_checksum_bytes"] >= s["bytes"]
+    # repair round-trip on a synthetic ship
+    ship = {"pages": [None, {"pool_k": np.arange(8, dtype=np.float32)}]}
+    before = ship["pages"][1]["pool_k"].tobytes()
+    flip_ship_bit(ship, layer=1, index=3, bit=6)
+    assert ship["pages"][1]["pool_k"].tobytes() != before
+    assert repair_ship(ship) is True
+    assert ship["pages"][1]["pool_k"].tobytes() == before
+    assert repair_ship(ship) is False  # nothing stashed twice
+
+
+@pytest.mark.parametrize("kv_dtype", ["float32", "int8"])
+def test_disagg_decode_pool_flip_recovers_bitwise(serve_factory, ctrl,
+                                                  kv_dtype):
+    """The headline's disaggregated half: a flip in the DECODE fleet's
+    pool (pages that arrived by ship) is detected by the decode-side
+    scrub, the displaced request re-routes through the prefill fleet,
+    and re-prefill regenerates the shipped pages byte-identically."""
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    dis = _disagg(serve_factory, kv_dtype=kv_dtype)
+    events, rec = _flip_event(4.0,
+                              engine=lambda s: s.decode.engines[0])
+    run_closed_loop(dis, _workload(), 6, events=events)
+    assert rec, "no shipped page ever settled on the decode fleet"
+    st = dis.stats_summary()
+    assert st["sdc_detected"] >= 1 and st["sdc_quarantined"] >= 1
+    assert _streams(dis) == ctrl[kv_dtype]  # requests_lost == 0, bitwise
+    assert dis.decode.engines[0].allocator.quarantined >= 1
+
+
+# ---------------------------------------------------------------------------
+# Tool e2e (slow-marked per the servechaos precedent: every gate above
+# is tier-1 at engine level; these compile their own program sets).
+# ---------------------------------------------------------------------------
+
+_E2E_ARGS = ["-m", "transformer_t", "-b", "tinylm", "--arrival", "closed",
+             "--concurrency", "4", "--requests", "10", "--max-batch", "2",
+             "--pool-pages", "12", "--page", "4", "--max-len", "16",
+             "--prompt-lens", "2,4,8", "--out-lens", "2,4,8",
+             "--seed", "5", "--platform", "cpu", "--replicas", "2"]
+
+
+def _run_chaos(extra):
+    import contextlib
+    import io
+    import json
+    import unittest.mock as mock
+
+    import ddlbench_tpu.config as config
+    from ddlbench_tpu.tools import servechaos
+
+    patched = dict(config.DATASETS)
+    patched["tinylm"] = TINY_LM
+    buf = io.StringIO()
+    with mock.patch.dict("ddlbench_tpu.config.DATASETS", patched), \
+            contextlib.redirect_stdout(buf):
+        rc = servechaos.main(_E2E_ARGS + list(extra))
+    assert rc == 0
+    return [json.loads(l) for l in buf.getvalue().splitlines()
+            if l.startswith("{")][0]
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("extra", [
+    [],                                          # f32 aggregated
+    ["--kv-dtype", "int8"],                      # int8 aggregated
+    ["--replicas", "1", "--disaggregate", "1:1",
+     "--corrupt", "6:d0:payload"],               # decode-fleet flip
+    ["--replicas", "1", "--disaggregate", "1:1",
+     "--corrupt", "6:0:ship"],                   # wire flip
+], ids=["f32", "int8", "disagg-pool", "disagg-ship"])
+def test_servechaos_corrupt_e2e_headline(extra):
+    """The acceptance gate at TOOL level: --corrupt with detection armed
+    reports requests_lost == 0, sdc_escaped == 0, streams bitwise vs the
+    unfaulted control — f32 and int8, aggregated and disaggregated."""
+    row = _run_chaos((["--corrupt", "3:0:payload"]
+                      if "--corrupt" not in extra else []) + extra)
+    assert row["sdc_detect"] is True
+    assert row["sdc_injected"] >= 1
+    assert row["requests_lost"] == 0
+    assert row["sdc_escaped"] == 0
+    assert row["streams_match"] is True
+    if "ship" in " ".join(extra):
+        assert row["sdc_wire_detected"] == 1
+        assert row["sdc_wire_repaired"] == 1
+    else:
+        assert row["sdc_detected"] >= 1
+
+
+@pytest.mark.slow
+def test_servechaos_no_detect_e2e_escape():
+    """The disarmed twin: the SAME flip spec as the armed headline run
+    (seed 5, t=3, replica 0 payload) with the ledger off — nonzero
+    escaped divergence, measured from observed stream divergence + loss,
+    never from injected-minus-detected arithmetic."""
+    row = _run_chaos(["--corrupt", "3:0:payload", "--no-detect"])
+    assert row["sdc_detect"] is False
+    assert row["sdc_injected"] >= 1
+    assert row["sdc_escaped"] >= 1
+    assert row["streams_match"] is False
+    assert row["sdc_detected"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Telemetry: trace instants + audit tie.
+# ---------------------------------------------------------------------------
+
+
+def test_sdc_trace_instants_and_audit_tie(serve_factory):
+    from ddlbench_tpu.telemetry.audit import serve_pool_audit
+    from ddlbench_tpu.telemetry.export import (chrome_trace_dict,
+                                               sdc_events)
+    from ddlbench_tpu.telemetry.tracer import (Tracer, get_tracer,
+                                               set_tracer)
+    from ddlbench_tpu.tools.servebench import run_closed_loop
+
+    prev = get_tracer()
+    tracer = set_tracer(Tracer(50_000)).enable()
+    try:
+        srv = serve_factory(_armed(trace=True), server=True)
+        events, rec = _flip_event(4.0)
+        run_closed_loop(srv, _workload(), 6, events=events)
+    finally:
+        set_tracer(prev)
+    assert rec
+    live = sdc_events(tracer)
+    assert live == sdc_events(chrome_trace_dict(tracer))  # round-trip
+    kinds = [e["kind"] for e in live]
+    assert "detect" in kinds and "quarantine" in kinds
+    det = next(e for e in live if e["kind"] == "detect")
+    assert det["slot"] == rec["slot"] and det["t"] >= rec["t"]
+    # audit: the wire's per-page checksum constant ties to the pool walk
+    eng = srv.engines[0]
+    pa = serve_pool_audit(eng)
+    assert pa["ok"], [c for c in pa["checks"] if not c["ok"]]
+    assert pa["integrity"] is True
+    assert pa["checksum_bytes_per_page"] == (
+        CHECKSUM_BYTES * len(pool_layers(eng)))
+    cold = serve_pool_audit(serve_factory(_cfg()))
+    assert cold["integrity"] is False
+    assert cold["checksum_bytes_per_page"] == 0
+
+
+# ---------------------------------------------------------------------------
+# Config surface.
+# ---------------------------------------------------------------------------
+
+
+def test_integrity_config_validation():
+    _armed().validate()
+    _cfg(integrity=True, scrub=0).validate()  # boundary-only is legal
+    with pytest.raises(ValueError, match="scrub"):
+        _cfg(integrity=True, scrub=-1).validate()
+    with pytest.raises(ValueError, match="integrity"):
+        _cfg(integrity=False, scrub=4).validate()
+
+
+def test_stable_slots_empty_when_disarmed(serve_factory):
+    eng = serve_factory(_cfg())
+    assert eng.integrity is None
+    assert stable_stamped_slots(eng) == []
+    with pytest.raises(ValueError, match="no KV pool"):
+        flip_pool_bit(eng, 0, 1)  # the embedding layer owns no pool
+    assert pool_layers(eng) and 0 not in pool_layers(eng)
